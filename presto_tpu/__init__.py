@@ -1,0 +1,25 @@
+"""presto_tpu: a TPU-native distributed SQL query engine.
+
+A ground-up reimplementation of the capabilities of Presto SQL
+(reference: presto-root 328, ``io.prestosql``) designed for TPU hardware:
+
+- Columnar data lives in HBM as struct-of-device-arrays (``Batch``), the
+  TPU-native analogue of the reference's ``Page``/``Block`` model
+  (presto-spi/src/main/java/io/prestosql/spi/Page.java:34).
+- The reference's runtime-bytecode codegen tier
+  (presto-main/.../sql/gen/ExpressionCompiler.java:55) is replaced by
+  RowExpression -> jaxpr -> XLA compilation with a persistent jit cache.
+- Hash join / group-by hash operators become vectorized device kernels
+  (sort + segment-reduce + searchsorted expansion, Pallas where it pays).
+- Inter-node exchange (presto-main/.../operator/exchange/) becomes XLA
+  collectives (``all_to_all``/``all_gather``/``ppermute``) over a
+  ``jax.sharding.Mesh`` within a slice, plus a host-side token-acked pull
+  protocol across slices.
+
+Nothing in this package is a translation of the reference's Java; it is an
+independent TPU-first design built to the same observable behavior.
+"""
+
+from presto_tpu import config as _config  # noqa: F401  (applies jax x64 setup)
+
+__version__ = "0.1.0"

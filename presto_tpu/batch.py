@@ -1,0 +1,328 @@
+"""Columnar batches: the device-native Page.
+
+The reference's unit of data flow is the ``Page`` — a horizontal batch of
+immutable columnar ``Block``s (presto-spi/.../Page.java:34,
+presto-spi/.../block/Block.java:25).  The TPU-native equivalent is
+``Batch``: a struct of device arrays, one ``Column`` per channel, where
+
+- fixed-width blocks (LongArrayBlock, IntArrayBlock, ...) become value
+  arrays of the type's dtype,
+- null flags become an optional packed validity mask (None == no nulls,
+  matching ``Block.mayHaveNull``),
+- VariableWidthBlock (strings) becomes dictionary codes + a host-side
+  dictionary (strings never live in HBM; see types.VarcharType),
+- DictionaryBlock / RunLengthEncodedBlock compression is subsumed by the
+  dictionary representation plus XLA gather fusion,
+- ``Page.getPositions`` (selection vectors) becomes device gather.
+
+Batches are immutable: every transformation returns a new ``Batch`` sharing
+untouched arrays (the reference relies on the same immutability for its
+concurrency discipline, SURVEY §5.2).
+
+Arrays may be padded beyond ``num_rows`` so that device kernels see a small
+set of static shapes (XLA recompiles per shape; the padding bucket policy
+lives in ``pad_rows``).  Logical rows always occupy positions
+``[0, num_rows)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from presto_tpu import types as T
+
+Array = Any  # np.ndarray | jax.Array
+
+_UNSET = object()  # sentinel: "keep existing validity" in Column.with_values
+
+
+def next_bucket(n: int, minimum: int = 1024) -> int:
+    """Smallest power-of-two >= max(n, minimum): the shape-bucket policy."""
+    cap = max(int(n), int(minimum), 1)
+    return 1 << (cap - 1).bit_length()
+
+
+class Dictionary:
+    """A host-side value dictionary for string-ish columns.
+
+    Append-only interning table: code -> value and value -> code.  Shared by
+    reference between columns; never mutated through a Column (codes remain
+    stable), so sharing is safe.
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: Sequence[str] = ()):  # noqa: D401
+        self.values: List[str] = list(values)
+        self._index = {v: i for i, v in enumerate(self.values)}
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, value: str) -> Optional[int]:
+        return self._index.get(value)
+
+    def intern(self, value: str) -> int:
+        code = self._index.get(value)
+        if code is None:
+            code = len(self.values)
+            self.values.append(value)
+            self._index[value] = code
+        return code
+
+    def intern_many(self, values: Iterable[str]) -> np.ndarray:
+        return np.fromiter((self.intern(v) for v in values), dtype=np.int32)
+
+    def decode(self, codes: np.ndarray) -> List[str]:
+        vals = self.values
+        return [vals[c] for c in np.asarray(codes)]
+
+    def sort_ranks(self) -> np.ndarray:
+        """rank[code] = lexicographic rank; used to ORDER BY a dictionary
+        column on device without materializing strings."""
+        order = np.argsort(np.asarray(self.values, dtype=object), kind="stable")
+        ranks = np.empty(len(self.values), dtype=np.int32)
+        ranks[order] = np.arange(len(self.values), dtype=np.int32)
+        return ranks
+
+    def remap_into(self, target: "Dictionary") -> np.ndarray:
+        """Return old-code -> target-code mapping, interning as needed."""
+        return np.fromiter(
+            (target.intern(v) for v in self.values), dtype=np.int32,
+            count=len(self.values),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Column:
+    """One channel of a Batch: values + optional validity (+ dictionary)."""
+
+    type: T.Type
+    values: Array
+    valid: Optional[Array] = None  # bool array; None == all valid
+    dictionary: Optional[Dictionary] = None
+
+    def __post_init__(self):
+        if self.type.is_dictionary and self.dictionary is None:
+            raise ValueError(f"{self.type} column requires a dictionary")
+
+    @property
+    def may_have_nulls(self) -> bool:
+        return self.valid is not None
+
+    def with_values(self, values: Array, valid: Optional[Array] = _UNSET) -> "Column":
+        return Column(self.type, values,
+                      self.valid if valid is _UNSET else valid, self.dictionary)
+
+    def take(self, indices: Array) -> "Column":
+        xp = _xp(self.values)
+        values = xp.take(self.values, indices, axis=0)
+        valid = None if self.valid is None else xp.take(self.valid, indices, axis=0)
+        return Column(self.type, values, valid, self.dictionary)
+
+    def to_numpy(self) -> "Column":
+        valid = None if self.valid is None else np.asarray(self.valid)
+        return Column(self.type, np.asarray(self.values), valid, self.dictionary)
+
+    def to_pylist(self, num_rows: int) -> List[Any]:
+        col = self.to_numpy()
+        vals = col.values[:num_rows]
+        valid = None if col.valid is None else col.valid[:num_rows]
+        if self.type.is_dictionary:
+            out: List[Any] = [
+                self.dictionary.values[int(c)] if 0 <= int(c) < len(self.dictionary)
+                else None
+                for c in vals
+            ]
+        else:
+            out = [self.type.to_python(v) for v in vals]
+        if valid is not None:
+            out = [v if ok else None for v, ok in zip(out, valid)]
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Batch:
+    """A horizontal slice of columnar data (the Page equivalent)."""
+
+    columns: Tuple[Column, ...]
+    num_rows: int
+
+    def __post_init__(self):
+        for c in self.columns:
+            if c.values.shape[0] < self.num_rows:
+                raise ValueError(
+                    f"column has {c.values.shape[0]} rows < num_rows={self.num_rows}")
+
+    # -- structural ------------------------------------------------------
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.columns[0].values.shape[0]) if self.columns else self.num_rows
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def select_channels(self, channels: Sequence[int]) -> "Batch":
+        """Page.getColumns analogue (zero copy)."""
+        return Batch(tuple(self.columns[i] for i in channels), self.num_rows)
+
+    def append_column(self, col: Column) -> "Batch":
+        return Batch(self.columns + (col,), self.num_rows)
+
+    # -- data movement ---------------------------------------------------
+    def take(self, indices: Array) -> "Batch":
+        """Page.getPositions analogue: gather rows (device-friendly)."""
+        n = int(indices.shape[0])
+        return Batch(tuple(c.take(indices) for c in self.columns), n)
+
+    def head(self, n: int) -> "Batch":
+        n = min(n, self.num_rows)
+        return Batch(tuple(
+            Column(c.type, c.values[:n],
+                   None if c.valid is None else c.valid[:n], c.dictionary)
+            for c in self.columns), n)
+
+    def pad_rows(self, capacity: int) -> "Batch":
+        """Pad every column to ``capacity`` rows (zero fill, invalid)."""
+        if self.capacity >= capacity:
+            return self
+        pad = capacity - self.capacity
+        cols = []
+        for c in self.columns:
+            xp = _xp(c.values)
+            values = xp.concatenate(
+                [c.values, xp.zeros((pad,) + c.values.shape[1:], c.values.dtype)])
+            valid = c.valid
+            if valid is not None:
+                valid = xp.concatenate([valid, xp.zeros((pad,), bool)])
+            cols.append(Column(c.type, values, valid, c.dictionary))
+        return Batch(tuple(cols), self.num_rows)
+
+    def compact(self) -> "Batch":
+        """Drop padding (host copy if padded)."""
+        if self.capacity == self.num_rows:
+            return self
+        return self.head(self.num_rows)
+
+    def to_numpy(self) -> "Batch":
+        return Batch(tuple(c.to_numpy() for c in self.columns), self.num_rows)
+
+    def to_device(self) -> "Batch":
+        import jax
+
+        cols = []
+        for c in self.columns:
+            values = jax.device_put(c.values)
+            valid = None if c.valid is None else jax.device_put(c.valid)
+            cols.append(Column(c.type, values, valid, c.dictionary))
+        return Batch(tuple(cols), self.num_rows)
+
+    # -- interop ---------------------------------------------------------
+    def to_pylist(self) -> List[Tuple[Any, ...]]:
+        cols = [c.to_pylist(self.num_rows) for c in self.columns]
+        return list(zip(*cols)) if cols else [() for _ in range(self.num_rows)]
+
+    @property
+    def size_bytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            total += int(np.prod(c.values.shape)) * c.values.dtype.itemsize
+            if c.valid is not None:
+                total += int(np.prod(c.valid.shape))
+        return total
+
+    def __repr__(self) -> str:  # pragma: no cover
+        ts = ", ".join(c.type.display() for c in self.columns)
+        return f"Batch[{self.num_rows} rows; {ts}]"
+
+
+def _xp(arr):
+    """numpy-or-jnp dispatch for code shared by host oracle and device path."""
+    if isinstance(arr, np.ndarray):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# Builders (BlockBuilder/PageBuilder analogue, presto-spi/.../PageBuilder.java)
+# ---------------------------------------------------------------------------
+
+def column_from_pylist(typ: T.Type, values: Sequence[Any],
+                       dictionary: Optional[Dictionary] = None) -> Column:
+    """Build a Column from Python values (None == NULL)."""
+    n = len(values)
+    has_null = any(v is None for v in values)
+    valid = None
+    if has_null:
+        valid = np.fromiter((v is not None for v in values), dtype=bool, count=n)
+    if typ.is_dictionary:
+        dictionary = dictionary or Dictionary()
+        codes = np.fromiter(
+            (dictionary.intern(v) if v is not None else 0 for v in values),
+            dtype=np.int32, count=n)
+        return Column(typ, codes, valid, dictionary)
+    storage = np.zeros(n, dtype=typ.np_dtype)
+    for i, v in enumerate(values):
+        if v is not None:
+            storage[i] = typ.from_python(v)
+    return Column(typ, storage, valid)
+
+
+def batch_from_pylist(schema: Sequence[T.Type],
+                      rows: Sequence[Sequence[Any]]) -> Batch:
+    """RowPagesBuilder analogue (presto-main test fixture) for tests."""
+    cols = []
+    for i, typ in enumerate(schema):
+        cols.append(column_from_pylist(typ, [r[i] for r in rows]))
+    return Batch(tuple(cols), len(rows))
+
+
+def concat_batches(batches: Sequence[Batch]) -> Batch:
+    """Concatenate compacted batches (dictionary columns are re-coded into a
+    shared dictionary — the DictionaryBlock 'compact' analogue)."""
+    batches = [b.compact().to_numpy() for b in batches if b.num_rows > 0]
+    if not batches:
+        raise ValueError("concat of zero rows needs a schema; use empty_batch")
+    first = batches[0]
+    out_cols = []
+    for ci in range(first.num_columns):
+        cols = [b.columns[ci] for b in batches]
+        typ = cols[0].type
+        if typ.is_dictionary:
+            target = Dictionary()
+            parts = []
+            for c in cols:
+                remap = c.dictionary.remap_into(target)
+                parts.append(remap[np.asarray(c.values)]
+                             if len(remap) else np.asarray(c.values))
+            values = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+            dictionary = target
+        else:
+            values = np.concatenate([np.asarray(c.values) for c in cols])
+            dictionary = None
+        if any(c.valid is not None for c in cols):
+            valid = np.concatenate([
+                np.asarray(c.valid) if c.valid is not None
+                else np.ones(b.num_rows, bool)
+                for c, b in zip(cols, batches)])
+        else:
+            valid = None
+        out_cols.append(Column(typ, values, valid, dictionary))
+    return Batch(tuple(out_cols), sum(b.num_rows for b in batches))
+
+
+def empty_batch(schema: Sequence[T.Type]) -> Batch:
+    cols = []
+    for typ in schema:
+        dictionary = Dictionary() if typ.is_dictionary else None
+        cols.append(Column(typ, np.zeros(0, typ.np_dtype), None, dictionary))
+    return Batch(tuple(cols), 0)

@@ -1,0 +1,57 @@
+"""Global engine configuration.
+
+The reference splits configuration into static host config (airlift
+``@Config`` beans, e.g. presto-main/.../sql/analyzer/FeaturesConfig.java:61)
+and per-query session properties
+(presto-main/.../SystemSessionProperties.java:51).  We keep the same split:
+``EngineConfig`` is the static host config; ``Session`` (session.py) carries
+per-query overrides.
+
+SQL semantics require 64-bit integers (BIGINT, short DECIMAL as scaled
+int64), so x64 is enabled at import.  TPUs execute int64 element-wise ops as
+pairs of int32 ops; the MXU-bound paths in this engine are int32/float32 by
+construction, so enabling x64 does not put float64 on the hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Static engine configuration (the FeaturesConfig/TaskManagerConfig role).
+
+    Defaults are chosen for a single v5e chip; tests override freely.
+    """
+
+    # Capacity buckets: device arrays are padded to the next power of two at
+    # least this size, bounding the number of distinct compiled shapes
+    # (the reference instead recompiles nothing because the JVM tolerates
+    # dynamic sizes; XLA does not).
+    min_batch_capacity: int = 1024
+    # Rows per Batch produced by scans (the Page-size analogue,
+    # reference default 1024 positions / 1MB).
+    scan_batch_rows: int = 65536
+    # Default hash-aggregation group capacity per kernel invocation.
+    group_capacity: int = 1 << 20
+    # Default join match-expansion capacity multiplier (output rows per
+    # probe batch before chunked re-probe kicks in).
+    join_expansion_factor: int = 4
+    # Number of drivers per pipeline on one host (the task.concurrency
+    # analogue); device kernels are internally parallel so this mostly
+    # governs host-side feed parallelism.
+    task_concurrency: int = 4
+    # Maximum partial-aggregation memory before flush, bytes.
+    partial_agg_max_bytes: int = 256 << 20
+    # Spill directory (host-RAM/disk tier below HBM).
+    spill_path: str = os.environ.get("PRESTO_TPU_SPILL", "/tmp/presto_tpu_spill")
+    spill_enabled: bool = True
+
+
+DEFAULT = EngineConfig()

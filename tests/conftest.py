@@ -1,0 +1,18 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+Multi-chip behavior is tested the way the reference tests multi-node
+behavior — in one process (DistributedQueryRunner boots coordinator+workers
+in one JVM, presto-testing/.../DistributedQueryRunner.java:73).  Here the
+"cluster" is 8 virtual XLA CPU devices, so sharding/collective code paths
+compile and execute without TPU hardware.
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,92 @@
+"""Batch/Column tests (reference tier: presto-spi Page/Block tests —
+round-trip, regions, positions; SURVEY §4.1)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.batch import (
+    Batch, Column, Dictionary, batch_from_pylist, concat_batches,
+    column_from_pylist, empty_batch, next_bucket,
+)
+
+
+def test_next_bucket():
+    assert next_bucket(0) == 1024
+    assert next_bucket(1024) == 1024
+    assert next_bucket(1025) == 2048
+    assert next_bucket(3, minimum=2) == 4
+
+
+def test_pylist_roundtrip():
+    schema = [T.BIGINT, T.DOUBLE, T.VARCHAR, T.DATE]
+    rows = [
+        (1, 1.5, "alpha", "1995-01-01"),
+        (2, None, "beta", "1996-06-30"),
+        (None, 3.5, "alpha", None),
+    ]
+    b = batch_from_pylist(schema, rows)
+    assert b.num_rows == 3
+    out = b.to_pylist()
+    import datetime
+
+    assert out[0][0] == 1 and out[0][2] == "alpha"
+    assert out[1][1] is None
+    assert out[2][0] is None and out[2][3] is None
+    assert out[0][3] == datetime.date(1995, 1, 1)
+    # dictionary got deduped
+    assert len(b.columns[2].dictionary) == 2
+
+
+def test_take_and_channels():
+    b = batch_from_pylist([T.BIGINT, T.VARCHAR],
+                          [(10, "x"), (20, "y"), (30, "z")])
+    g = b.take(np.array([2, 0]))
+    assert g.to_pylist() == [(30, "z"), (10, "x")]
+    assert b.select_channels([1]).to_pylist() == [("x",), ("y",), ("z",)]
+
+
+def test_pad_and_compact():
+    b = batch_from_pylist([T.BIGINT], [(1,), (2,), (3,)])
+    p = b.pad_rows(8)
+    assert p.capacity == 8 and p.num_rows == 3
+    assert p.to_pylist() == [(1,), (2,), (3,)]
+    assert p.compact().capacity == 3
+
+
+def test_concat_merges_dictionaries():
+    b1 = batch_from_pylist([T.VARCHAR], [("a",), ("b",)])
+    b2 = batch_from_pylist([T.VARCHAR], [("b",), ("c",)])
+    out = concat_batches([b1, b2])
+    assert out.to_pylist() == [("a",), ("b",), ("b",), ("c",)]
+    assert len(out.columns[0].dictionary) == 3
+
+
+def test_concat_nulls():
+    b1 = batch_from_pylist([T.BIGINT], [(1,), (None,)])
+    b2 = batch_from_pylist([T.BIGINT], [(3,)])
+    out = concat_batches([b1, b2])
+    assert out.to_pylist() == [(1,), (None,), (3,)]
+
+
+def test_dictionary_ranks():
+    d = Dictionary(["pear", "apple", "zebra"])
+    ranks = d.sort_ranks()
+    assert list(ranks) == [1, 0, 2]
+
+
+def test_dictionary_column_requires_dictionary():
+    with pytest.raises(ValueError):
+        Column(T.VARCHAR, np.zeros(2, np.int32))
+
+
+def test_empty_batch():
+    b = empty_batch([T.BIGINT, T.VARCHAR])
+    assert b.num_rows == 0 and b.to_pylist() == []
+
+
+def test_device_roundtrip():
+    b = batch_from_pylist([T.BIGINT, T.DOUBLE], [(1, 2.0), (3, 4.0)])
+    d = b.to_device()
+    assert d.to_pylist() == b.to_pylist()
+    assert d.size_bytes == b.size_bytes

@@ -1,0 +1,55 @@
+"""Type system unit tests (reference tier: presto-spi type tests)."""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+
+
+def test_simple_dtypes():
+    assert T.BIGINT.np_dtype == np.dtype("int64")
+    assert T.INTEGER.np_dtype == np.dtype("int32")
+    assert T.DOUBLE.np_dtype == np.dtype("float64")
+    assert T.BOOLEAN.np_dtype == np.dtype("bool_")
+    assert T.DATE.np_dtype == np.dtype("int32")
+    assert T.VARCHAR.np_dtype == np.dtype("int32")
+    assert T.VARCHAR.is_dictionary
+
+
+def test_decimal_roundtrip():
+    d = T.DecimalType("decimal", precision=15, scale=2)
+    assert d.from_python("12.34") == 1234
+    assert d.from_python("12.345") == 1235  # half-up
+    assert d.to_python(1234) == decimal.Decimal("12.34")
+    assert d.display() == "decimal(15,2)"
+
+
+def test_date_roundtrip():
+    import datetime
+
+    assert T.DATE.from_python("1995-01-01") == 9131
+    assert T.DATE.to_python(9131) == datetime.date(1995, 1, 1)
+
+
+def test_parse_type():
+    assert T.parse_type("bigint") is T.BIGINT
+    assert T.parse_type("decimal(15,2)") == T.DecimalType("decimal", 15, 2)
+    assert T.parse_type("varchar(25)") == T.VarcharType("varchar", 25)
+    assert T.parse_type("double") is T.DOUBLE
+    with pytest.raises(ValueError):
+        T.parse_type("frobnicate")
+
+
+def test_common_super_type():
+    assert T.common_super_type(T.INTEGER, T.BIGINT) is T.BIGINT
+    assert T.common_super_type(T.BIGINT, T.DOUBLE) is T.DOUBLE
+    assert T.common_super_type(T.UNKNOWN, T.DATE) is T.DATE
+    d1 = T.DecimalType("decimal", 15, 2)
+    assert T.common_super_type(d1, T.BIGINT) == T.DecimalType("decimal", 21, 2)
+    assert T.common_super_type(
+        T.VarcharType("varchar", 5), T.VarcharType("varchar", 9)
+    ) == T.VarcharType("varchar", 9)
+    assert T.common_super_type(T.DATE, T.TIMESTAMP) is T.TIMESTAMP
+    assert T.common_super_type(T.BOOLEAN, T.BIGINT) is None
