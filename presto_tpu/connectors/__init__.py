@@ -1,0 +1,7 @@
+"""Connector implementations (the presto-tpch / presto-memory /
+presto-blackhole role) behind the SPI in :mod:`presto_tpu.connectors.api`."""
+
+from presto_tpu.connectors.api import (  # noqa: F401
+    ColumnMetadata, Connector, ConnectorRegistry, PageSource, Split,
+    TableHandle, TableSchema,
+)

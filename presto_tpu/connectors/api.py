@@ -1,0 +1,155 @@
+"""Connector SPI.
+
+The reference externalizes all storage behind a connector SPI
+(presto-spi/.../connector/, 60 files: Connector, ConnectorMetadata,
+ConnectorSplitManager, ConnectorPageSourceProvider, ConnectorPageSinkProvider,
+loaded by PluginManager into ConnectorManager —
+presto-main/.../connector/ConnectorManager.java:83).
+
+This is the same contract collapsed to its essentials, columnar-first:
+
+- ``Connector`` exposes metadata (schemas/tables/columns + optional stats),
+- ``get_splits`` partitions a table scan into independently-generatable
+  ``Split``s (the unit of scheduling, P5 in SURVEY §2.13),
+- ``page_source(split, columns)`` yields host-side ``Batch``es for the
+  requested channels only (column pruning is the connector's job, the
+  ``ConnectorPageSource`` + lazy-block analogue); the runtime stages them
+  into HBM asynchronously.
+
+Write support (``ConnectorPageSink``) is the ``begin_insert``/``PageSink``
+pair, used by the memory and blackhole connectors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnMetadata:
+    name: str
+    type: T.Type
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSchema:
+    name: str
+    columns: Tuple[ColumnMetadata, ...]
+
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def column_index(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+    def column_type(self, name: str) -> T.Type:
+        return self.columns[self.column_index(name)].type
+
+
+@dataclasses.dataclass(frozen=True)
+class TableHandle:
+    """Connector-scoped table reference (ConnectorTableHandle analogue)."""
+
+    catalog: str
+    table: str
+    extra: Any = None  # connector-private (e.g. tpch scale factor)
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """An independently scannable shard of a table
+    (presto-spi ConnectorSplit analogue)."""
+
+    handle: TableHandle
+    info: Any  # connector-private split descriptor (e.g. a row range)
+    # Estimated rows, for scheduler balancing; -1 when unknown.
+    estimated_rows: int = -1
+
+
+@dataclasses.dataclass
+class TableStatistics:
+    """Coarse table stats for the cost-based optimizer
+    (presto-spi/.../statistics/TableStatistics.java role)."""
+
+    row_count: float
+    # per-column distinct-count estimates, keyed by column name
+    ndv: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+class PageSource:
+    """Iterator of Batches for one split
+    (ConnectorPageSource.getNextPage analogue)."""
+
+    def __iter__(self) -> Iterator[Batch]:
+        raise NotImplementedError
+
+
+class PageSink:
+    """Write target for INSERT/CTAS (ConnectorPageSink analogue)."""
+
+    def append(self, batch: Batch) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> int:
+        """Commit; returns row count written."""
+        raise NotImplementedError
+
+
+class Connector:
+    """One mounted catalog (Connector + ConnectorMetadata +
+    ConnectorSplitManager + ConnectorPageSourceProvider in one object)."""
+
+    name: str = "connector"
+
+    # -- metadata -------------------------------------------------------
+    def list_tables(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_table(self, table: str) -> Optional[TableHandle]:
+        raise NotImplementedError
+
+    def table_schema(self, handle: TableHandle) -> TableSchema:
+        raise NotImplementedError
+
+    def table_statistics(self, handle: TableHandle) -> Optional[TableStatistics]:
+        return None
+
+    # -- reads ----------------------------------------------------------
+    def get_splits(self, handle: TableHandle, desired_splits: int) -> List[Split]:
+        raise NotImplementedError
+
+    def page_source(self, split: Split, columns: Sequence[str],
+                    batch_rows: int = 65536) -> PageSource:
+        raise NotImplementedError
+
+    # -- writes (optional) ----------------------------------------------
+    def create_table(self, name: str, schema: TableSchema) -> TableHandle:
+        raise NotImplementedError(f"{self.name}: CREATE TABLE not supported")
+
+    def page_sink(self, handle: TableHandle) -> PageSink:
+        raise NotImplementedError(f"{self.name}: INSERT not supported")
+
+
+class ConnectorRegistry:
+    """Mounted catalogs (ConnectorManager/catalog properties analogue)."""
+
+    def __init__(self):
+        self._catalogs: Dict[str, Connector] = {}
+
+    def register(self, catalog: str, connector: Connector) -> None:
+        self._catalogs[catalog] = connector
+
+    def get(self, catalog: str) -> Connector:
+        if catalog not in self._catalogs:
+            raise KeyError(f"catalog not registered: {catalog}")
+        return self._catalogs[catalog]
+
+    def catalogs(self) -> List[str]:
+        return sorted(self._catalogs)

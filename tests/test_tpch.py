@@ -1,0 +1,161 @@
+"""TPC-H connector tests (reference tier: presto-tpch connector tests —
+determinism, schema shape, distribution sanity)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.batch import concat_batches
+from presto_tpu.connectors.tpch import CURRENT_DATE, TpchConnector
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(scale=0.01)
+
+
+def scan(conn, table, columns, desired_splits=1):
+    handle = conn.get_table(table)
+    batches = []
+    for split in conn.get_splits(handle, desired_splits):
+        for b in conn.page_source(split, columns, batch_rows=5000):
+            batches.append(b)
+    return concat_batches(batches)
+
+
+def test_tables_and_schema(conn):
+    assert conn.list_tables() == [
+        "customer", "lineitem", "nation", "orders", "part", "partsupp",
+        "region", "supplier"]
+    schema = conn.table_schema(conn.get_table("lineitem"))
+    assert schema.column_names()[:4] == [
+        "l_orderkey", "l_partkey", "l_suppkey", "l_linenumber"]
+    assert schema.column_type("l_extendedprice") is T.DOUBLE
+    assert schema.column_type("l_shipdate") is T.DATE
+
+
+def test_fixed_tables(conn):
+    region = scan(conn, "region", ["r_regionkey", "r_name"])
+    assert region.num_rows == 5
+    assert region.to_pylist()[2] == (2, "ASIA")
+    nation = scan(conn, "nation", ["n_nationkey", "n_name", "n_regionkey"])
+    assert nation.num_rows == 25
+    rows = nation.to_pylist()
+    assert rows[6] == (6, "FRANCE", 3)
+    assert rows[24] == (24, "UNITED STATES", 1)
+
+
+def test_row_counts(conn):
+    assert scan(conn, "supplier", ["s_suppkey"]).num_rows == 100
+    assert scan(conn, "customer", ["c_custkey"]).num_rows == 1500
+    assert scan(conn, "part", ["p_partkey"]).num_rows == 2000
+    assert scan(conn, "partsupp", ["ps_partkey"]).num_rows == 8000
+    assert scan(conn, "orders", ["o_orderkey"]).num_rows == 15000
+
+
+def test_split_invariance(conn):
+    """Any split decomposition generates identical data (counter-based)."""
+    one = scan(conn, "orders", ["o_orderkey", "o_custkey", "o_totalprice"], 1)
+    many = scan(conn, "orders", ["o_orderkey", "o_custkey", "o_totalprice"], 7)
+    assert one.to_pylist() == many.to_pylist()
+
+
+def test_column_lazy_consistency(conn):
+    """The same column requested alone or with others is identical."""
+    a = scan(conn, "lineitem", ["l_orderkey", "l_quantity"])
+    b = scan(conn, "lineitem", ["l_quantity"])
+    assert a.select_channels([1]).to_pylist() == b.to_pylist()
+
+
+def test_lineitem_invariants(conn):
+    b = scan(conn, "lineitem", [
+        "l_orderkey", "l_linenumber", "l_quantity", "l_discount",
+        "l_shipdate", "l_commitdate", "l_receiptdate", "l_returnflag",
+        "l_linestatus"])
+    okey = np.asarray(b.columns[0].values)
+    ln = np.asarray(b.columns[1].values)
+    qty = np.asarray(b.columns[2].values)
+    disc = np.asarray(b.columns[3].values)
+    ship = np.asarray(b.columns[4].values)
+    receipt = np.asarray(b.columns[6].values)
+    assert (qty >= 1).all() and (qty <= 50).all()
+    assert (disc >= 0).all() and (disc <= 0.10).all()
+    assert (receipt > ship).all()
+    # linenumbers are 1..count per order
+    assert ln.min() == 1 and ln.max() <= 7
+    assert (np.diff(okey) >= 0).all()
+    # returnflag/linestatus derivation
+    flags = b.columns[7].to_pylist(b.num_rows)
+    status = b.columns[8].to_pylist(b.num_rows)
+    ship_py = np.asarray(ship)
+    for i in range(0, b.num_rows, 997):
+        if receipt[i] <= CURRENT_DATE:
+            assert flags[i] in ("R", "A")
+        else:
+            assert flags[i] == "N"
+        assert status[i] == ("O" if ship_py[i] > CURRENT_DATE else "F")
+
+
+def test_referential_integrity(conn):
+    orders = scan(conn, "orders", ["o_custkey"])
+    ck = np.asarray(orders.columns[0].values)
+    assert (ck >= 1).all() and (ck <= 1500).all()
+    assert (ck % 3 != 0).all()  # 2/3-customer rule
+    li = scan(conn, "lineitem", ["l_partkey", "l_suppkey"])
+    pk = np.asarray(li.columns[0].values)
+    sk = np.asarray(li.columns[1].values)
+    assert (pk >= 1).all() and (pk <= 2000).all()
+    assert (sk >= 1).all() and (sk <= 100).all()
+    # lineitem (partkey, suppkey) pairs exist in partsupp
+    ps = scan(conn, "partsupp", ["ps_partkey", "ps_suppkey"])
+    pairs = set(zip(np.asarray(ps.columns[0].values).tolist(),
+                    np.asarray(ps.columns[1].values).tolist()))
+    for i in range(0, li.num_rows, 499):
+        assert (int(pk[i]), int(sk[i])) in pairs
+
+
+def test_orderstatus_totalprice_consistency(conn):
+    orders = scan(conn, "orders", ["o_orderkey", "o_orderstatus", "o_totalprice"])
+    li = scan(conn, "lineitem", [
+        "l_orderkey", "l_extendedprice", "l_discount", "l_tax", "l_linestatus"])
+    rows = li.to_pylist()
+    by_order = {}
+    for okey, ext, disc, tax, ls in rows:
+        tot, statuses = by_order.setdefault(okey, [0.0, set()])
+        by_order[okey][0] = tot + round(ext * 100) * (100 - round(disc * 100)) \
+            * (100 + round(tax * 100)) // 10_000 / 100.0
+        statuses.add(ls)
+    for okey, st, total in orders.to_pylist()[:200]:
+        exp_total, statuses = by_order[okey]
+        assert abs(exp_total - total) < 0.5
+        expected = "O" if statuses == {"O"} else ("F" if statuses == {"F"} else "P")
+        assert st == expected
+
+
+def test_enum_distributions(conn):
+    b = scan(conn, "customer", ["c_mktsegment"])
+    segs = set(b.columns[0].to_pylist(b.num_rows))
+    assert segs == {"AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
+                    "HOUSEHOLD"}
+    b = scan(conn, "lineitem", ["l_shipmode"])
+    modes = set(b.columns[0].to_pylist(b.num_rows))
+    assert len(modes) == 7
+
+
+def test_part_name_contains_colors(conn):
+    b = scan(conn, "part", ["p_name"])
+    names = b.columns[0].to_pylist(b.num_rows)
+    assert any("green" in n.split() for n in names)
+    assert all(len(n.split()) == 5 for n in names[:50])
+
+
+def test_retailprice_formula(conn):
+    b = scan(conn, "part", ["p_partkey", "p_retailprice"])
+    for pk, rp in b.to_pylist()[:100]:
+        expected = (90000 + (pk // 10) % 20001 + 100 * (pk % 1000)) / 100.0
+        assert abs(rp - expected) < 1e-9
+
+
+def test_statistics(conn):
+    stats = conn.table_statistics(conn.get_table("orders"))
+    assert stats.row_count == 15000
