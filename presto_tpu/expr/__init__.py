@@ -1,0 +1,7 @@
+"""Expression engine: RowExpression IR -> dual-backend (numpy oracle / XLA)
+evaluation.  Replaces the reference's runtime-bytecode tier
+(presto-main/.../sql/gen/ExpressionCompiler.java:55, SURVEY §2.7)."""
+
+from presto_tpu.expr.ir import (  # noqa: F401
+    Call, Constant, InputRef, RowExpression, SpecialForm,
+)

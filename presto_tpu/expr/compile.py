@@ -1,0 +1,384 @@
+"""RowExpression -> executable closure (the ExpressionCompiler replacement).
+
+The reference compiles RowExpressions to JVM bytecode PageProcessors
+(presto-main/.../sql/gen/ExpressionCompiler.java:55,
+PageFunctionCompiler.java:98).  Here compilation produces a Python closure
+over the ``xp`` array namespace:
+
+- run it with numpy       -> the interpreter / correctness oracle
+  (the role H2 plays for the reference, SURVEY §4.2),
+- trace it under jax.jit  -> the XLA/TPU path; XLA's fusion replaces the
+  reference's hand-scheduled page loops, and the jit cache replaces the
+  generated-class cache.
+
+String expressions never execute on device: they are computed ONCE per
+*dictionary entry* at compile time (dictionaries are compile-time constants
+bound to the input schema) and become lookup-table gathers on device — the
+generalization of the reference's DictionaryAwarePageProjection
+(presto-main/.../operator/project/PageProcessor.java:54).
+
+Null semantics are the SQL three-valued logic: each compiled node yields
+``(values, valid)`` with ``valid=None`` meaning "no nulls" (the
+Block.mayHaveNull fast path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, Dictionary
+from presto_tpu.expr import functions as F
+from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression, SpecialForm
+
+Pair = Tuple[Any, Optional[Any]]  # (values, valid|None)
+
+
+@dataclasses.dataclass
+class CompiledExpr:
+    """One compiled expression node graph.
+
+    ``run(cols, n, xp)``: cols is the list of input-channel (values, valid)
+    pairs, n the row count (only used when the expr has no inputs), xp the
+    array namespace.  Returns (values, valid|None).
+    """
+
+    type: T.Type
+    run: Callable[[Sequence[Pair], Any, Any], Pair]
+    dictionary: Optional[Dictionary] = None   # set when type is string-ish
+    const_str: Optional[str] = None           # set for string constants
+
+
+def _and_valid(xp, a: Optional[Any], b: Optional[Any]) -> Optional[Any]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _filled(xp, values, valid, fill):
+    if valid is None:
+        return values
+    return xp.where(valid, values, fill)
+
+
+class ExprCompiler:
+    def __init__(self, dictionaries: Dict[int, Dictionary]):
+        self.dicts = dictionaries
+
+    def compile(self, expr: RowExpression) -> CompiledExpr:
+        if isinstance(expr, InputRef):
+            return self._input(expr)
+        if isinstance(expr, Constant):
+            return self._constant(expr)
+        if isinstance(expr, Call):
+            return self._call(expr)
+        if isinstance(expr, SpecialForm):
+            return self._special(expr)
+        raise TypeError(f"unknown expression node: {expr!r}")
+
+    # -- leaves ----------------------------------------------------------
+    def _input(self, expr: InputRef) -> CompiledExpr:
+        i = expr.index
+
+        def run(cols, n, xp):
+            return cols[i]
+
+        d = self.dicts.get(i) if expr.type.is_dictionary else None
+        if expr.type.is_dictionary and d is None:
+            raise ValueError(f"no dictionary bound for string channel {i}")
+        return CompiledExpr(expr.type, run, dictionary=d)
+
+    def _constant(self, expr: Constant) -> CompiledExpr:
+        t = expr.type
+        if expr.value is None:
+            dt = t.np_dtype
+
+            def run(cols, n, xp):
+                nn = _rowcount(cols, n, xp)
+                return xp.zeros(nn, dt), xp.zeros(nn, bool)
+
+            d = Dictionary([""]) if t.is_dictionary else None
+            return CompiledExpr(t, run, dictionary=d)
+        if t.is_dictionary:
+            s = str(expr.value)
+            d = Dictionary([s])
+
+            def run(cols, n, xp):
+                return xp.zeros(_rowcount(cols, n, xp), np.int32), None
+
+            return CompiledExpr(t, run, dictionary=d, const_str=s)
+        value = expr.value
+        dt = t.np_dtype
+
+        def run(cols, n, xp):
+            return xp.full(_rowcount(cols, n, xp), value, dt), None
+
+        return CompiledExpr(t, run)
+
+    # -- calls -----------------------------------------------------------
+    def _call(self, expr: Call) -> CompiledExpr:
+        fn: F.Scalar = expr.fn
+        if fn is None:
+            raise ValueError(f"unresolved call {expr.name}")
+        cargs = [self.compile(a) for a in expr.args]
+        if fn.null_mode == "is_null":
+            (a,) = cargs
+
+            def run(cols, n, xp):
+                v, valid = a.run(cols, n, xp)
+                if valid is None:
+                    return xp.zeros(v.shape[0], bool), None
+                return ~valid, None
+
+            return CompiledExpr(T.BOOLEAN, run)
+        if fn.null_mode == "is_not_null":
+            (a,) = cargs
+
+            def run(cols, n, xp):
+                v, valid = a.run(cols, n, xp)
+                if valid is None:
+                    return xp.ones(v.shape[0], bool), None
+                return valid, None
+
+            return CompiledExpr(T.BOOLEAN, run)
+        if fn.kind == "string":
+            return self._string_call(expr, fn, cargs)
+        impl = fn.impl
+        if fn.null_mode == "custom_divzero":
+            a, b = cargs
+
+            def run(cols, n, xp):
+                av, avalid = a.run(cols, n, xp)
+                bv, bvalid = b.run(cols, n, xp)
+                nonzero = bv != 0
+                safe_b = xp.where(nonzero, bv, bv.dtype.type(1))
+                out = impl(xp, av, safe_b)
+                valid = _and_valid(xp, _and_valid(xp, avalid, bvalid), nonzero)
+                return out, valid
+
+            return CompiledExpr(fn.result_type, run)
+
+        def run(cols, n, xp):
+            vals = []
+            valid = None
+            for c in cargs:
+                v, cv = c.run(cols, n, xp)
+                vals.append(v)
+                valid = _and_valid(xp, valid, cv)
+            return impl(xp, *vals), valid
+
+        return CompiledExpr(fn.result_type, run)
+
+    def _string_call(self, expr: Call, fn: F.Scalar,
+                     cargs: List[CompiledExpr]) -> CompiledExpr:
+        """Host-side per-dictionary-entry evaluation, device gather."""
+        # Identify the (single) dictionary-column argument; all others must
+        # be constants.
+        dict_arg_idx = None
+        const_vals: List[Any] = []
+        for i, (ca, node) in enumerate(zip(cargs, expr.args)):
+            if ca.const_str is not None:
+                const_vals.append(ca.const_str)
+            elif isinstance(node, Constant):
+                const_vals.append(node.value)
+            elif ca.type.is_dictionary:
+                if dict_arg_idx is not None:
+                    raise NotImplementedError(
+                        "string functions over multiple string columns are "
+                        "not yet supported on device")
+                dict_arg_idx = i
+                const_vals.append(None)
+            else:
+                raise NotImplementedError(
+                    f"string function {fn.name} with non-constant non-string "
+                    "argument")
+        if dict_arg_idx is None:
+            # all-constant: fold at compile time
+            result = fn.impl(*const_vals)
+            return self._constant(Constant(result, fn.result_type))
+        src = cargs[dict_arg_idx]
+        entries = src.dictionary.values
+        per_entry = []
+        for e in entries:
+            args = list(const_vals)
+            args[dict_arg_idx] = e
+            per_entry.append(fn.impl(*args))
+        rt = fn.result_type
+        if rt.is_dictionary:
+            out_dict = Dictionary(per_entry)
+
+            def run(cols, n, xp):
+                return src.run(cols, n, xp)
+
+            return CompiledExpr(rt, run, dictionary=out_dict)
+        lookup_np = np.asarray(per_entry, dtype=rt.np_dtype)
+
+        def run(cols, n, xp):
+            codes, valid = src.run(cols, n, xp)
+            table = xp.asarray(lookup_np)
+            return xp.take(table, codes, axis=0), valid
+
+        return CompiledExpr(rt, run)
+
+    # -- special forms ---------------------------------------------------
+    def _special(self, expr: SpecialForm) -> CompiledExpr:
+        form = expr.form
+        if form == "AND" or form == "OR":
+            a, b = (self.compile(x) for x in expr.args)
+            is_and = form == "AND"
+
+            def run(cols, n, xp):
+                av, avalid = a.run(cols, n, xp)
+                bv, bvalid = b.run(cols, n, xp)
+                fill = is_and  # AND fills nulls True; OR fills False
+                af = _filled(xp, av, avalid, fill)
+                bf = _filled(xp, bv, bvalid, fill)
+                out = (af & bf) if is_and else (af | bf)
+                if avalid is None and bvalid is None:
+                    return out, None
+                ones = xp.ones(af.shape[0], bool)
+                avl = avalid if avalid is not None else ones
+                bvl = bvalid if bvalid is not None else ones
+                if is_and:
+                    known = (avl & ~af) | (bvl & ~bf)
+                else:
+                    known = (avl & af) | (bvl & bf)
+                return out, (avl & bvl) | known
+
+            return CompiledExpr(T.BOOLEAN, run)
+        if form == "IF":
+            cond, then, other = (self.compile(x) for x in expr.args)
+            return self._if(expr.type, cond, then, other)
+        if form == "SWITCH":
+            # args = [default, cond1, v1, cond2, v2, ...] -> nested IFs
+            default = expr.args[0]
+            pairs = list(zip(expr.args[1::2], expr.args[2::2]))
+            node: RowExpression = default
+            for cond, val in reversed(pairs):
+                node = SpecialForm("IF", (cond, val, node), expr.type)
+            return self.compile(node)
+        if form == "COALESCE":
+            cargs = [self.compile(a) for a in expr.args]
+            return self._coalesce(expr.type, cargs)
+        if form == "IN":
+            return self._in(expr)
+        raise ValueError(f"unknown special form {form}")
+
+    def _if(self, rt: T.Type, cond: CompiledExpr, then: CompiledExpr,
+            other: CompiledExpr) -> CompiledExpr:
+        out_dict = None
+        remap_then = remap_other = None
+        if rt.is_dictionary:
+            out_dict = Dictionary()
+            remap_then = then.dictionary.remap_into(out_dict)
+            remap_other = other.dictionary.remap_into(out_dict)
+
+        def run(cols, n, xp):
+            cv, cvalid = cond.run(cols, n, xp)
+            tv, tvalid = then.run(cols, n, xp)
+            ov, ovalid = other.run(cols, n, xp)
+            take_then = _filled(xp, cv, cvalid, False)
+            if remap_then is not None:
+                tv = xp.take(xp.asarray(remap_then), tv, axis=0)
+                ov = xp.take(xp.asarray(remap_other), ov, axis=0)
+            out = xp.where(take_then, tv, ov)
+            if tvalid is None and ovalid is None:
+                return out, None
+            ones = xp.ones(out.shape[0], bool)
+            tvl = tvalid if tvalid is not None else ones
+            ovl = ovalid if ovalid is not None else ones
+            return out, xp.where(take_then, tvl, ovl)
+
+        return CompiledExpr(rt, run, dictionary=out_dict)
+
+    def _coalesce(self, rt: T.Type, cargs: List[CompiledExpr]) -> CompiledExpr:
+        out_dict = None
+        remaps = None
+        if rt.is_dictionary:
+            out_dict = Dictionary()
+            remaps = [c.dictionary.remap_into(out_dict) for c in cargs]
+
+        def run(cols, n, xp):
+            acc_v = acc_valid = None
+            for i, c in enumerate(cargs):
+                v, valid = c.run(cols, n, xp)
+                if remaps is not None:
+                    v = xp.take(xp.asarray(remaps[i]), v, axis=0)
+                if acc_v is None:
+                    acc_v, acc_valid = v, valid
+                else:
+                    need = ~acc_valid  # positions still null
+                    acc_v = xp.where(need, v, acc_v)
+                    if valid is None:
+                        acc_valid = None
+                    else:
+                        acc_valid = acc_valid | valid
+                if acc_valid is None:
+                    break
+            return acc_v, acc_valid
+
+        return CompiledExpr(rt, run, dictionary=out_dict)
+
+    def _in(self, expr: SpecialForm) -> CompiledExpr:
+        value = self.compile(expr.args[0])
+        items = expr.args[1:]
+        if value.type.is_dictionary:
+            consts = {str(i.value) for i in items
+                      if isinstance(i, Constant) and i.value is not None}
+            if len(consts) != len(items):
+                raise NotImplementedError("IN over non-constant string list")
+            lookup_np = np.asarray(
+                [e in consts for e in value.dictionary.values], dtype=bool)
+
+            def run(cols, n, xp):
+                codes, valid = value.run(cols, n, xp)
+                return xp.take(xp.asarray(lookup_np), codes, axis=0), valid
+
+            return CompiledExpr(T.BOOLEAN, run)
+        citems = [self.compile(i) for i in items]
+
+        def run(cols, n, xp):
+            v, valid = value.run(cols, n, xp)
+            out = None
+            for ci in citems:
+                iv, ivalid = ci.run(cols, n, xp)
+                valid = _and_valid(xp, valid, ivalid)
+                eq = v == iv
+                out = eq if out is None else (out | eq)
+            return out, valid
+
+        return CompiledExpr(T.BOOLEAN, run)
+
+
+def _rowcount(cols, n, xp):
+    for v, _ in cols:
+        return v.shape[0]
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Convenience entry points
+# ---------------------------------------------------------------------------
+
+def compile_expr(expr: RowExpression,
+                 dictionaries: Optional[Dict[int, Dictionary]] = None
+                 ) -> CompiledExpr:
+    return ExprCompiler(dictionaries or {}).compile(expr)
+
+
+def batch_dictionaries(batch: Batch) -> Dict[int, Dictionary]:
+    return {i: c.dictionary for i, c in enumerate(batch.columns)
+            if c.dictionary is not None}
+
+
+def evaluate(expr: RowExpression, batch: Batch, xp=np) -> Column:
+    """Interpret one expression over a Batch (the oracle path)."""
+    compiled = compile_expr(expr, batch_dictionaries(batch))
+    cols = [(c.values, c.valid) for c in batch.columns]
+    values, valid = compiled.run(cols, batch.num_rows, xp)
+    return Column(compiled.type, values, valid, compiled.dictionary)

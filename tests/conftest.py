@@ -11,8 +11,19 @@ Must run before the first ``import jax`` anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU backend even when the environment points JAX at real TPU
+# hardware (JAX_PLATFORMS=axon + a sitecustomize hook that re-selects the
+# axon platform): unit tests must be hardware-independent and fast; the
+# driver benchmarks on real chips separately.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The sitecustomize hook may already have switched jax_platforms to the
+# axon TPU plugin; switch back before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,229 @@
+"""Expression engine tests (reference tier: TestExpressionCompiler /
+operator/scalar tests — same expression evaluated through the interpreter
+and through the compiled path must agree; SURVEY §4.1)."""
+
+import datetime
+import decimal
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.batch import batch_from_pylist
+from presto_tpu.expr import build as B
+from presto_tpu.expr.compile import batch_dictionaries, compile_expr, evaluate
+
+
+def run_both(expr, batch):
+    """Evaluate via numpy (oracle) and under jax.jit (XLA); assert equal."""
+    import jax
+    import jax.numpy as jnp
+
+    out_np = evaluate(expr, batch)
+    compiled = compile_expr(expr, batch_dictionaries(batch))
+
+    cols = tuple((c.values, c.valid) for c in batch.columns)
+
+    @jax.jit
+    def kernel(cols):
+        return compiled.run(cols, batch.num_rows, jnp)
+
+    values, valid = kernel(cols)
+    np.testing.assert_allclose(np.asarray(values),
+                               np.asarray(out_np.values), rtol=1e-12)
+    if out_np.valid is None:
+        assert valid is None or bool(np.asarray(valid).all())
+    else:
+        np.testing.assert_array_equal(np.asarray(valid), np.asarray(out_np.valid))
+    from presto_tpu.batch import Column
+    return Column(out_np.type, out_np.values, out_np.valid,
+                  out_np.dictionary).to_pylist(batch.num_rows)
+
+
+DEC = T.DecimalType("decimal", 15, 2)
+
+
+def test_arith_bigint():
+    b = batch_from_pylist([T.BIGINT, T.BIGINT], [(7, 2), (-7, 2), (5, None)])
+    assert run_both(B.call("add", B.ref(0, T.BIGINT), B.ref(1, T.BIGINT)),
+                    b) == [9, -5, None]
+    assert run_both(B.call("divide", B.ref(0, T.BIGINT), B.ref(1, T.BIGINT)),
+                    b) == [3, -3, None]  # truncates toward zero
+    assert run_both(B.call("modulus", B.ref(0, T.BIGINT), B.ref(1, T.BIGINT)),
+                    b) == [1, -1, None]
+
+
+def test_divide_by_zero_is_null():
+    b = batch_from_pylist([T.BIGINT, T.BIGINT], [(7, 0), (8, 2)])
+    assert run_both(B.call("divide", B.ref(0, T.BIGINT), B.ref(1, T.BIGINT)),
+                    b) == [None, 4]
+
+
+def test_decimal_arith():
+    b = batch_from_pylist([DEC, DEC], [("12.34", "1.11"), ("-5.00", "2.50")])
+    add = B.call("add", B.ref(0, DEC), B.ref(1, DEC))
+    assert add.type == T.DecimalType("decimal", 16, 2)
+    assert run_both(add, b) == [decimal.Decimal("13.45"), decimal.Decimal("-2.50")]
+    mul = B.call("multiply", B.ref(0, DEC), B.ref(1, DEC))
+    assert mul.type.scale == 4
+    assert run_both(mul, b) == [decimal.Decimal("13.6974"),
+                                decimal.Decimal("-12.5000")]
+    div = B.call("divide", B.ref(0, DEC), B.ref(1, DEC))
+    assert run_both(div, b) == [decimal.Decimal("11.12"),  # 11.117→11.12 half-up
+                                decimal.Decimal("-2.00")]
+
+
+def test_decimal_int_mixed():
+    b = batch_from_pylist([DEC, T.BIGINT], [("12.34", 2)])
+    out = run_both(B.call("multiply", B.ref(0, DEC), B.ref(1, T.BIGINT)), b)
+    assert out == [decimal.Decimal("24.68")]
+
+
+def test_double_decimal_mixed():
+    b = batch_from_pylist([DEC, T.DOUBLE], [("12.00", 0.5)])
+    out = run_both(B.call("multiply", B.ref(0, DEC), B.ref(1, T.DOUBLE)), b)
+    assert out == [6.0]
+
+
+def test_comparisons():
+    b = batch_from_pylist([T.BIGINT, T.DOUBLE], [(1, 1.5), (2, 2.0), (3, None)])
+    assert run_both(B.comparison("<", B.ref(0, T.BIGINT), B.ref(1, T.DOUBLE)),
+                    b) == [True, False, None]
+    d = batch_from_pylist([DEC, DEC], [("1.10", "1.2"), ("3.00", "3.00")])
+    assert run_both(B.comparison("<", B.ref(0, DEC), B.ref(1, DEC)),
+                    d) == [True, False]
+
+
+def test_kleene_and_or():
+    b = batch_from_pylist([T.BOOLEAN, T.BOOLEAN],
+                          [(True, None), (False, None), (None, None),
+                           (True, True), (True, False)])
+    a = B.and_(B.ref(0, T.BOOLEAN), B.ref(1, T.BOOLEAN))
+    assert run_both(a, b) == [None, False, None, True, False]
+    o = B.or_(B.ref(0, T.BOOLEAN), B.ref(1, T.BOOLEAN))
+    assert run_both(o, b) == [True, None, None, True, True]
+
+
+def test_is_null_not():
+    b = batch_from_pylist([T.BIGINT], [(1,), (None,), (3,)])
+    assert run_both(B.call("is_null", B.ref(0, T.BIGINT)), b) == \
+        [False, True, False]
+    assert run_both(B.call("is_not_null", B.ref(0, T.BIGINT)), b) == \
+        [True, False, True]
+    assert run_both(B.not_(B.call("is_null", B.ref(0, T.BIGINT))), b) == \
+        [True, False, True]
+
+
+def test_string_predicates():
+    b = batch_from_pylist([T.VARCHAR],
+                          [("BUILDING",), ("AUTOMOBILE",), ("HOUSEHOLD",)])
+    eq = B.comparison("=", B.ref(0, T.VARCHAR), B.const("BUILDING", T.VARCHAR))
+    assert run_both(eq, b) == [True, False, False]
+    like = B.call("like", B.ref(0, T.VARCHAR), B.const("%HOLD", T.VARCHAR))
+    assert run_both(like, b) == [False, False, True]
+    isin = B.in_(B.ref(0, T.VARCHAR), [B.const("BUILDING", T.VARCHAR),
+                                       B.const("HOUSEHOLD", T.VARCHAR)])
+    assert run_both(isin, b) == [True, False, True]
+
+
+def test_string_functions_produce_dictionary():
+    b = batch_from_pylist([T.VARCHAR], [("PROMO BRUSHED TIN",), ("STANDARD X",)])
+    sub = B.call("substr", B.ref(0, T.VARCHAR), B.const(1, T.BIGINT),
+                 B.const(5, T.BIGINT))
+    col = evaluate(sub, b)
+    assert col.to_pylist(2) == ["PROMO", "STAND"]
+    ln = B.call("length", B.ref(0, T.VARCHAR))
+    assert run_both(ln, b) == [17, 10]
+
+
+def test_in_numeric():
+    b = batch_from_pylist([T.BIGINT], [(1,), (2,), (9,)])
+    e = B.in_(B.ref(0, T.BIGINT),
+              [B.const(1, T.BIGINT), B.const(9, T.BIGINT)])
+    assert run_both(e, b) == [True, False, True]
+
+
+def test_dates():
+    b = batch_from_pylist([T.DATE], [("1995-03-15",), ("1998-12-01",),
+                                     ("1996-02-29",)])
+    y = B.call("extract_year", B.ref(0, T.DATE))
+    assert run_both(y, b) == [1995, 1998, 1996]
+    m = B.call("extract_month", B.ref(0, T.DATE))
+    assert run_both(m, b) == [3, 12, 2]
+    d = B.call("extract_day", B.ref(0, T.DATE))
+    assert run_both(d, b) == [15, 1, 29]
+    q = B.call("extract_quarter", B.ref(0, T.DATE))
+    assert run_both(q, b) == [1, 4, 1]
+    plus90 = B.call("add_days", B.ref(0, T.DATE), B.const(90, T.INTEGER))
+    assert run_both(plus90, b)[0] == datetime.date(1995, 6, 13)
+    plus3m = B.call("add_months", B.ref(0, T.DATE), B.const(3, T.INTEGER))
+    out = run_both(plus3m, b)
+    assert out[0] == datetime.date(1995, 6, 15)
+    assert out[2] == datetime.date(1996, 5, 29)
+    minus1m = B.call("add_months", B.ref(0, T.DATE), B.const(-12, T.INTEGER))
+    assert run_both(minus1m, b)[2] == datetime.date(1995, 2, 28)  # clamped
+
+
+def test_date_comparison_with_literal():
+    b = batch_from_pylist([T.DATE], [("1995-03-15",), ("1998-12-01",)])
+    e = B.comparison("<", B.ref(0, T.DATE), B.const("1996-01-01", T.DATE))
+    assert run_both(e, b) == [True, False]
+
+
+def test_case_if_coalesce():
+    b = batch_from_pylist([T.BIGINT], [(1,), (2,), (None,)])
+    e = B.if_(B.comparison("=", B.ref(0, T.BIGINT), B.const(1, T.BIGINT)),
+              B.const(10, T.BIGINT), B.const(20, T.BIGINT))
+    assert run_both(e, b) == [10, 20, 20]
+    c = B.case_when(
+        [(B.comparison("=", B.ref(0, T.BIGINT), B.const(1, T.BIGINT)),
+          B.const(100, T.BIGINT)),
+         (B.comparison("=", B.ref(0, T.BIGINT), B.const(2, T.BIGINT)),
+          B.const(200, T.BIGINT))], None)
+    assert run_both(c, b) == [100, 200, None]
+    co = B.coalesce(B.ref(0, T.BIGINT), B.const(-1, T.BIGINT))
+    assert run_both(co, b) == [1, 2, -1]
+
+
+def test_if_over_strings_merges_dictionaries():
+    b = batch_from_pylist([T.BOOLEAN], [(True,), (False,)])
+    e = B.if_(B.ref(0, T.BOOLEAN), B.const("yes", T.VARCHAR),
+              B.const("no", T.VARCHAR))
+    col = evaluate(e, b)
+    assert col.to_pylist(2) == ["yes", "no"]
+
+
+def test_casts():
+    b = batch_from_pylist([T.BIGINT], [(3,), (-3,)])
+    assert run_both(B.cast(B.ref(0, T.BIGINT), T.DOUBLE), b) == [3.0, -3.0]
+    assert run_both(B.cast(B.ref(0, T.BIGINT), DEC), b) == \
+        [decimal.Decimal("3.00"), decimal.Decimal("-3.00")]
+    d = batch_from_pylist([T.DOUBLE], [(2.5,), (-2.5,), (2.4,)])
+    assert run_both(B.cast(B.ref(0, T.DOUBLE), T.BIGINT), d) == [3, -3, 2]
+    s = batch_from_pylist([T.VARCHAR], [("1995-06-17",)])
+    assert run_both(B.cast(B.ref(0, T.VARCHAR), T.DATE), s) == \
+        [datetime.date(1995, 6, 17)]
+
+
+def test_round_and_math():
+    b = batch_from_pylist([T.DOUBLE], [(2.5,), (-2.5,), (1.234,)])
+    assert run_both(B.round_digits(B.ref(0, T.DOUBLE), 0), b) == [3.0, -3.0, 1.0]
+    assert run_both(B.round_digits(B.ref(0, T.DOUBLE), 2), b) == \
+        [2.5, -2.5, 1.23]
+    d = batch_from_pylist([DEC], [("2.345",)])  # scale 2 -> 2.35 storage 235
+    assert run_both(B.call("abs", B.ref(0, DEC)), d) == [decimal.Decimal("2.35")]
+    assert run_both(B.call("ceil", B.ref(0, DEC)), d) == [decimal.Decimal(3)]
+    assert run_both(B.call("floor", B.ref(0, DEC)), d) == [decimal.Decimal(2)]
+
+
+def test_between():
+    b = batch_from_pylist([T.BIGINT], [(5,), (15,), (10,)])
+    e = B.between(B.ref(0, T.BIGINT), B.const(5, T.BIGINT),
+                  B.const(10, T.BIGINT))
+    assert run_both(e, b) == [True, False, True]
+
+
+def test_constant_fold_string():
+    b = batch_from_pylist([T.BIGINT], [(1,), (2,)])
+    e = B.call("length", B.const("hello", T.VARCHAR))
+    assert run_both(e, b) == [5, 5]
