@@ -1,0 +1,7 @@
+"""Device kernels for the relational hot path.
+
+These replace the reference's JVM-codegen'd operators and hash structures —
+PagesHash (presto-main/.../operator/PagesHash.java:34), GroupByHash
+(MultiChannelGroupByHash.java:54), compiled PageFilter/PageProjection
+(sql/gen/PageFunctionCompiler.java:98) — with vectorized XLA programs over
+static shapes (SURVEY §3.4's five hot loops)."""

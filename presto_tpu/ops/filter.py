@@ -1,0 +1,32 @@
+"""Filter compaction kernel.
+
+The reference's compiled PageFilter produces SelectedPositions consumed by
+projections (presto-main/.../operator/project/PageProcessor.java:100).  The
+device equivalent turns a boolean mask into a static-capacity gather index
+vector plus a live count — XLA's `nonzero(size=...)` pattern — after which
+every downstream op is a plain gather.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def selected_positions(mask: jax.Array, valid, num_rows: jax.Array,
+                       out_capacity: int):
+    """(selection indices [out_capacity], count).
+
+    ``mask`` may be None (select-all).  NULL predicate results are "not
+    selected" (SQL WHERE semantics).  ``count`` can exceed out_capacity only
+    if out_capacity < capacity; callers size out_capacity == input capacity
+    to make overflow impossible (filters never grow rows).
+    """
+    cap = mask.shape[0] if mask is not None else None
+    live = jnp.arange(cap) < num_rows
+    if mask is not None:
+        live = live & mask
+    if valid is not None:
+        live = live & valid
+    idx = jnp.nonzero(live, size=out_capacity, fill_value=0)[0]
+    return idx, live.sum()

@@ -1,0 +1,166 @@
+"""Grouped aggregation kernel.
+
+The reference's HashAggregationOperator drives GroupByHash — open-addressing
+linear probing with rehash (presto-main/.../operator/MultiChannelGroupByHash.java:273-286)
+— and codegen'd accumulators (AccumulatorCompiler.java:80).
+
+The TPU-native design is *sort-based*: scatter-free, shape-static, and
+entirely made of primitives XLA schedules well on the VPU:
+
+    normalize keys -> lexsort -> run-boundary detection -> segment reduce
+
+- No rehash problem (hard part #1 in SURVEY §7): capacity is a static
+  bucket; a ``num_groups`` scalar reports overflow so the host can re-run
+  at the next bucket (the recompile-on-bucket-change policy).
+- Padding rows sort to the end (pad flag is the primary sort word) and fall
+  into a trailing garbage group that is simply not counted.
+- Exact grouping: sorting compares full key words, so there are no hash
+  collisions to resolve — the 1-byte-hash-prefix trick of PagesHash:49 has
+  no analogue because there is no probe loop at all.
+
+Aggregation primitives are sum/count/min/max (planner decomposes
+avg/stddev/... into these, mirroring the partial/final Step split of
+HashAggregationOperator.Step:61).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.ops.keys import normalize_keys
+
+# One aggregation input: (prim, values, valid|None) with prim in
+# {'sum','count','min','max'}; 'count' ignores values.
+AggIn = Tuple[str, Optional[jax.Array], Optional[jax.Array]]
+
+
+def _segment_ids(key_words: List[jax.Array], pad: jax.Array):
+    """Sort rows by (pad, keys); return (perm, gid_sorted, boundaries)."""
+    # zero pad rows' keys so they collide into one trailing run
+    cleaned = [jnp.where(pad, jnp.int64(0), w) for w in key_words]
+    # lexsort: LAST key is primary; we want pad primary, then keys.
+    perm = jnp.lexsort(tuple(cleaned[::-1]) + (pad.astype(jnp.int8),))
+    sorted_pad = pad[perm]
+    boundary = jnp.zeros(perm.shape[0], dtype=bool).at[0].set(True)
+    for w in cleaned:
+        ws = w[perm]
+        boundary = boundary.at[1:].set(boundary[1:] | (ws[1:] != ws[:-1]))
+    boundary = boundary.at[1:].set(
+        boundary[1:] | (sorted_pad[1:] != sorted_pad[:-1]))
+    gid = jnp.cumsum(boundary) - 1
+    return perm, gid, boundary
+
+
+def _min_identity(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(True, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+def _max_identity(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(False, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).min, dtype)
+
+
+def grouped_aggregate(
+    key_columns: Sequence[Tuple[jax.Array, Optional[jax.Array], T.Type]],
+    aggs: Sequence[AggIn],
+    num_rows: jax.Array,
+    group_capacity: int,
+):
+    """Aggregate ``aggs`` per distinct key tuple.
+
+    All arrays share one (padded) row capacity; ``num_rows`` is the dynamic
+    live-row count.  Returns::
+
+        (group_index: int array [group_capacity]   # row index of each
+                                                   # group's representative
+         num_groups: int scalar,                   # may EXCEED capacity ->
+                                                   # caller re-runs bigger
+         results: [(values[group_capacity], count_nonnull[group_capacity])])
+
+    Key/grouped-output columns are gathered by the caller via
+    ``group_index`` (valid for the first ``min(num_groups, capacity)``
+    entries), which keeps this kernel agnostic of output channel count.
+    """
+    cap = key_columns[0][0].shape[0]
+    pad = jnp.arange(cap) >= num_rows
+    key_words, _ = normalize_keys(jnp, key_columns, nulls_equal=True)
+    perm, gid, boundary = _segment_ids(key_words, pad)
+    total_segments = gid[-1] + 1
+    # trailing pad segment (present iff any pad row) is not a real group
+    any_pad = pad.any()
+    num_groups = total_segments - any_pad.astype(total_segments.dtype)
+
+    # representative input row per group (first sorted row of the segment)
+    first_sorted_pos = jnp.nonzero(boundary, size=group_capacity,
+                                   fill_value=cap - 1)[0]
+    group_index = perm[first_sorted_pos]
+
+    results = []
+    for prim, values, valid in aggs:
+        live = ~pad
+        if valid is not None:
+            live = live & valid
+        live_sorted = live[perm]
+        cnt = jax.ops.segment_sum(live_sorted.astype(jnp.int64), gid,
+                                  num_segments=group_capacity)
+        if prim == "count":
+            results.append((cnt, cnt))
+            continue
+        v = values[perm]
+        if prim == "sum":
+            zero = jnp.asarray(0, values.dtype)
+            v = jnp.where(live_sorted, v, zero)
+            out = jax.ops.segment_sum(v, gid, num_segments=group_capacity)
+        elif prim == "min":
+            ident = _min_identity(values.dtype)
+            v = jnp.where(live_sorted, v, ident)
+            out = jax.ops.segment_min(v, gid, num_segments=group_capacity)
+        elif prim == "max":
+            ident = _max_identity(values.dtype)
+            v = jnp.where(live_sorted, v, ident)
+            out = jax.ops.segment_max(v, gid, num_segments=group_capacity)
+        else:
+            raise ValueError(f"unknown aggregation primitive {prim}")
+        results.append((out, cnt))
+    return group_index, num_groups, results
+
+
+def global_aggregate(aggs: Sequence[AggIn], num_rows: jax.Array):
+    """Ungrouped aggregation (AggregationOperator analogue): one output row
+    always (SQL: aggregates over empty input yield count=0 / sum=NULL)."""
+    results = []
+    for prim, values, valid in aggs:
+        cap = (values.shape[0] if values is not None else num_rows)
+        live = jnp.arange(cap) < num_rows if values is not None else None
+        if values is None:  # count(*)
+            results.append((num_rows.astype(jnp.int64),
+                            num_rows.astype(jnp.int64)))
+            continue
+        if valid is not None:
+            live = live & valid
+        cnt = live.sum().astype(jnp.int64)
+        if prim == "count":
+            results.append((cnt, cnt))
+            continue
+        if prim == "sum":
+            out = jnp.where(live, values, jnp.asarray(0, values.dtype)).sum()
+        elif prim == "min":
+            out = jnp.where(live, values, _min_identity(values.dtype)).min()
+        elif prim == "max":
+            out = jnp.where(live, values, _max_identity(values.dtype)).max()
+        else:
+            raise ValueError(prim)
+        results.append((out, cnt))
+    return results

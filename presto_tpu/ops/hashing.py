@@ -1,0 +1,42 @@
+"""Row hashing for partitioned exchange.
+
+The reference hashes rows for repartitioning via InterpretedHashGenerator /
+precomputed $hashValue columns (presto-main/.../operator/InterpretedHashGenerator.java:31,
+HashGenerationOptimizer.java:96).  Grouping/joining here never hashes (they
+sort exact keys), so hashing survives only where it is genuinely needed:
+choosing a partition for exchange (P1 in SURVEY §2.13).  splitmix64 over
+normalized key words, combined multiplicatively across channels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.ops.keys import normalize_keys
+
+_GOLDEN = 0x9E3779B97F4A7C15
+
+
+def _mix64(x: jax.Array) -> jax.Array:
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def row_hash(columns: Sequence[Tuple[jax.Array, Optional[jax.Array], T.Type]]
+             ) -> jax.Array:
+    """uint64 hash per row over the key channels (nulls hash as a class)."""
+    words, _ = normalize_keys(jnp, columns, nulls_equal=True)
+    acc = jnp.full(words[0].shape[0], 0x243F6A8885A308D3, jnp.uint64)
+    for w in words:
+        acc = _mix64(acc * jnp.uint64(_GOLDEN) + w.astype(jnp.uint64))
+    return acc
+
+
+def partition_of(hashes: jax.Array, num_partitions: int) -> jax.Array:
+    return (hashes % jnp.uint64(num_partitions)).astype(jnp.int32)

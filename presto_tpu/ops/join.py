@@ -1,0 +1,192 @@
+"""Hash-join kernel family (sorted-build design).
+
+The reference's join is PagesHash — open-addressing table over PagesIndex
+with synthetic addresses, probed row-at-a-time
+(presto-main/.../operator/PagesHash.java:63-121, JoinProbe.java:74-80,
+LookupJoinPageBuilder.java:74).  A probe loop with data-dependent chaining
+is the worst possible shape for a TPU, so the design here is different:
+
+  build:  normalize keys -> canonical dense ids -> sort build ids
+  probe:  vectorized binary search (searchsorted left/right) -> per-probe
+          match counts -> prefix-sum expansion -> two gathers
+
+Everything is a sort, a searchsorted, a cumsum, or a gather — all
+XLA-native, all static-shape.  The expansion output is a static capacity
+with a ``total`` scalar; overflow means the host re-runs at the next bucket
+(same policy as groupby).  Duplicate build keys need no PositionLinks
+chains: they are adjacent runs in the sorted order.
+
+Multi-channel keys are canonicalized into dense int64 ids by sorting the
+UNION of build and probe keys (exact, collision-free — no hash needed),
+after which matching is single-word.  Null join keys never match (SQL
+semantics), encoded as distinct negative sentinels per side.
+
+Join variants mirror LookupJoinOperators.java:45-60: inner, probe-outer
+(left), semi, anti; build-side-outer composes from ``matched_build``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.ops.keys import normalize_keys
+
+_BUILD_DEAD = jnp.int64(-2)   # build row excluded (null key or padding)
+_PROBE_DEAD = jnp.int64(-1)   # probe row excluded (null key or padding)
+
+
+def canonical_ids(
+    build_keys: Sequence[Tuple[jax.Array, Optional[jax.Array], T.Type]],
+    probe_keys: Sequence[Tuple[jax.Array, Optional[jax.Array], T.Type]],
+    n_build: jax.Array,
+    n_probe: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Map equal key tuples (across both sides) to equal dense ids >= 0.
+
+    Returns (build_ids [cap_b], probe_ids [cap_p]) with dead rows mapped to
+    the side's negative sentinel.
+    """
+    cap_b = build_keys[0][0].shape[0]
+    cap_p = probe_keys[0][0].shape[0]
+    bw, bnull = normalize_keys(jnp, build_keys, nulls_equal=False)
+    pw, pnull = normalize_keys(jnp, probe_keys, nulls_equal=False)
+    words = [jnp.concatenate([b, p]) for b, p in zip(bw, pw)]
+    n = cap_b + cap_p
+    if len(words) == 1:
+        combined = words[0]
+        perm = jnp.argsort(combined)
+        sorted_words = [combined[perm]]
+    else:
+        perm = jnp.lexsort(tuple(words[::-1]))
+        sorted_words = [w[perm] for w in words]
+    boundary = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for ws in sorted_words:
+        boundary = boundary.at[1:].set(boundary[1:] | (ws[1:] != ws[:-1]))
+    gid_sorted = jnp.cumsum(boundary) - 1
+    ids = jnp.zeros(n, jnp.int64).at[perm].set(gid_sorted)
+    build_ids, probe_ids = ids[:cap_b], ids[cap_b:]
+    dead_b = jnp.arange(cap_b) >= n_build
+    dead_p = jnp.arange(cap_p) >= n_probe
+    if bnull is not None:
+        dead_b = dead_b | bnull
+    if pnull is not None:
+        dead_p = dead_p | pnull
+    build_ids = jnp.where(dead_b, _BUILD_DEAD, build_ids)
+    probe_ids = jnp.where(dead_p, _PROBE_DEAD, probe_ids)
+    return build_ids, probe_ids
+
+
+def single_word_ids(
+    build_key: Tuple[jax.Array, Optional[jax.Array], T.Type],
+    probe_key: Tuple[jax.Array, Optional[jax.Array], T.Type],
+    n_build: jax.Array,
+    n_probe: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fast path for one integer-typed key channel: values ARE the ids.
+
+    Requires a type whose normalized word is the value itself (ints, dates,
+    decimals, dictionary codes).  Negative values are lifted by shifting is
+    NOT done — instead dead rows use sentinels below int64 min-plausible
+    keys; to stay exact we offset values by +2 and reserve {-2,-1}.
+    """
+    bvals, bvalid, btyp = build_key
+    pvals, pvalid, ptyp = probe_key
+    b = bvals.astype(jnp.int64)
+    p = pvals.astype(jnp.int64)
+    # shift by +2 so sentinels are strictly below every live id
+    b = b + 2
+    p = p + 2
+    cap_b, cap_p = b.shape[0], p.shape[0]
+    dead_b = jnp.arange(cap_b) >= n_build
+    dead_p = jnp.arange(cap_p) >= n_probe
+    if bvalid is not None:
+        dead_b = dead_b | ~bvalid
+    if pvalid is not None:
+        dead_p = dead_p | ~pvalid
+    return (jnp.where(dead_b, _BUILD_DEAD, b),
+            jnp.where(dead_p, _PROBE_DEAD, p))
+
+
+def build_index(build_ids: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Sort the build side: the LookupSource build
+    (HashBuilderOperator finish -> PagesHash ctor analogue)."""
+    perm = jnp.argsort(build_ids)
+    return build_ids[perm], perm
+
+
+def probe_counts(sorted_build: jax.Array, perm_b: jax.Array,
+                 probe_ids: jax.Array):
+    """Per-probe-row match range in the sorted build order."""
+    lo = jnp.searchsorted(sorted_build, probe_ids, side="left")
+    hi = jnp.searchsorted(sorted_build, probe_ids, side="right")
+    live = probe_ids >= 0
+    counts = jnp.where(live, hi - lo, 0)
+    return lo, counts
+
+
+def expand_matches(lo: jax.Array, counts: jax.Array, perm_b: jax.Array,
+                   out_capacity: int):
+    """Prefix-sum expansion: emit (probe_row, build_row) pairs (inner join;
+    left-outer variant below).
+
+    Returns (probe_idx [out_cap], build_idx [out_cap], row_valid [out_cap],
+    unmatched [out_cap], total).  ``total`` may exceed out_capacity (host
+    re-runs bigger).
+    """
+    inclusive = jnp.cumsum(counts)
+    total = inclusive[-1]
+    starts = inclusive - counts
+    j = jnp.arange(out_capacity)
+    probe_idx = jnp.searchsorted(inclusive, j, side="right")
+    probe_idx = jnp.minimum(probe_idx, counts.shape[0] - 1)
+    k = j - starts[probe_idx]
+    build_sorted_pos = jnp.minimum(lo[probe_idx] + k, perm_b.shape[0] - 1)
+    build_idx = perm_b[build_sorted_pos]
+    row_valid = j < total
+    unmatched = jnp.zeros(out_capacity, bool)
+    return probe_idx, build_idx, row_valid, unmatched, total
+
+
+def expand_matches_outer(lo: jax.Array, counts: jax.Array, live_probe: jax.Array,
+                         perm_b: jax.Array, out_capacity: int):
+    """Left-outer expansion: every live probe row emits max(count, 1) rows."""
+    emit = jnp.where(live_probe, jnp.maximum(counts, 1), 0)
+    inclusive = jnp.cumsum(emit)
+    total = inclusive[-1]
+    starts = inclusive - emit
+    j = jnp.arange(out_capacity)
+    probe_idx = jnp.searchsorted(inclusive, j, side="right")
+    probe_idx = jnp.minimum(probe_idx, counts.shape[0] - 1)
+    k = j - starts[probe_idx]
+    unmatched = counts[probe_idx] == 0
+    build_sorted_pos = jnp.minimum(lo[probe_idx] + k, perm_b.shape[0] - 1)
+    build_idx = jnp.where(unmatched, 0, perm_b[build_sorted_pos])
+    row_valid = j < total
+    return probe_idx, build_idx, row_valid, unmatched, total
+
+
+def semi_mask(counts: jax.Array, live_probe: jax.Array, anti: bool):
+    """Semi/anti join: boolean mask over probe rows
+    (HashSemiJoinOperator / anti-join analogue)."""
+    if anti:
+        return live_probe & (counts == 0)
+    return live_probe & (counts > 0)
+
+
+def matched_build_mask(lo: jax.Array, counts: jax.Array, cap_b: int,
+                       perm_b: jax.Array) -> jax.Array:
+    """Which build rows matched >= 1 probe row (for right/full outer).
+
+    Range-mark trick: +1 at lo, -1 at lo+count per probing row, cumsum > 0
+    over the sorted build domain, then permute back.
+    """
+    has = (counts > 0).astype(jnp.int32)
+    delta = jnp.zeros(cap_b + 1, jnp.int32)
+    delta = delta.at[lo].add(has)
+    delta = delta.at[lo + counts].add(-has)
+    matched_sorted = jnp.cumsum(delta[:-1]) > 0
+    return jnp.zeros(cap_b, bool).at[perm_b].set(matched_sorted)

@@ -1,0 +1,73 @@
+"""Key normalization for grouping / joining / partitioning.
+
+The reference specializes hash strategies per key-channel types via runtime
+bytecode (JoinCompiler.compilePagesHashStrategy,
+presto-main/.../sql/gen/JoinCompiler.java:93).  Here every key column is
+normalized into an order-preserving int64 array, so grouping and joining
+reduce to integer sort/compare problems the TPU vector unit eats:
+
+- integral/date/timestamp/decimal -> the storage integer itself,
+- boolean -> 0/1,
+- float64/float32 -> order-preserving bit twiddle (sign-magnitude to
+  two's-complement flip),
+- dictionary codes -> the code (equality-correct within one dictionary;
+  callers joining across dictionaries remap host-side first).
+
+Null handling is the SQL rule, split by use:
+- GROUP BY: nulls form a group (null flag becomes an extra key word),
+- JOIN keys: null never equals anything (row is masked out of matching).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+
+
+def to_sortable_i64(xp, values, typ: T.Type):
+    """Map a value array to int64 preserving the type's sort order."""
+    if typ.name in ("double", "real"):
+        import numpy as np
+
+        f64 = values.astype("float64")
+        if isinstance(f64, np.ndarray):
+            bits = f64.view("int64")
+        else:
+            import jax
+
+            bits = jax.lax.bitcast_convert_type(f64, xp.int64)
+        # signed-comparison order fix: negative floats have reversed bit
+        # order, so flip their non-sign bits; positives compare correctly.
+        return xp.where(bits < 0, bits ^ xp.int64(0x7FFFFFFFFFFFFFFF), bits)
+    if typ.name == "boolean":
+        return values.astype("int64")
+    return values.astype("int64")
+
+
+def normalize_keys(xp, columns: Sequence[Tuple[object, Optional[object], T.Type]],
+                   nulls_equal: bool):
+    """Returns (key_words: List[int64 array], null_row: bool array | None).
+
+    ``nulls_equal=True`` (GROUP BY / IS NOT DISTINCT FROM): null flags join
+    the key; null_row is None.
+    ``nulls_equal=False`` (JOIN): any-null rows are reported in null_row so
+    the caller can exclude them from matching.
+    """
+    words: List[object] = []
+    null_row = None
+    for values, valid, typ in columns:
+        w = to_sortable_i64(xp, values, typ)
+        if valid is not None:
+            if nulls_equal:
+                # zero the value so all-null rows collide, key the flag
+                w = xp.where(valid, w, xp.int64(0))
+                words.append(w)
+                words.append((~valid).astype("int64"))
+            else:
+                words.append(w)
+                nv = ~valid
+                null_row = nv if null_row is None else (null_row | nv)
+        else:
+            words.append(w)
+    return words, null_row

@@ -1,0 +1,44 @@
+"""ORDER BY / TopN kernels.
+
+The reference sorts via PagesIndex + codegen'd comparators
+(OrderByOperator.java:45, OrderingCompiler.java:62) and keeps a bounded
+heap for TopN (TopNOperator.java:35).  On TPU both are the same primitive:
+a multi-word lexicographic sort over order-preserving int64 key words
+(XLA's sort is a vectorized bitonic/radix network), with TopN simply
+truncating the permutation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.ops.keys import to_sortable_i64
+
+# (values, valid|None, type, descending, nulls_first)
+SortKey = Tuple[jax.Array, Optional[jax.Array], T.Type, bool, bool]
+
+
+def sort_permutation(keys: Sequence[SortKey], num_rows: jax.Array) -> jax.Array:
+    """Stable permutation ordering live rows by the sort spec; padding rows
+    sort to the end."""
+    cap = keys[0][0].shape[0]
+    pad = (jnp.arange(cap) >= num_rows).astype(jnp.int8)
+    major = []  # built major-to-minor, reversed for lexsort below
+    for values, valid, typ, desc, nulls_first in keys:
+        w = to_sortable_i64(jnp, values, typ)
+        if desc:
+            w = ~w  # exact order reversal for two's-complement words
+        if valid is not None:
+            null_word = jnp.where(valid,
+                                  jnp.int8(1 if nulls_first else 0),
+                                  jnp.int8(0 if nulls_first else 1))
+            w = jnp.where(valid, w, jnp.int64(0))
+            major.append(null_word)
+        major.append(w)
+    # lexsort: last element of the tuple is the PRIMARY key
+    minor_to_major = tuple(reversed(major)) + (pad,)
+    return jnp.lexsort(minor_to_major)

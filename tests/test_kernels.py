@@ -1,0 +1,309 @@
+"""Device-kernel tests, diffed against naive Python oracles
+(reference tier: TestGroupByHash / TestHashJoinOperator golden-page style,
+SURVEY §4.1)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from presto_tpu import types as T  # noqa: E402
+from presto_tpu.ops import join as J  # noqa: E402
+from presto_tpu.ops.filter import selected_positions  # noqa: E402
+from presto_tpu.ops.groupby import global_aggregate, grouped_aggregate  # noqa: E402
+from presto_tpu.ops.hashing import partition_of, row_hash  # noqa: E402
+from presto_tpu.ops.sort import sort_permutation  # noqa: E402
+
+
+def pad_to(a, cap, fill=0):
+    a = np.asarray(a)
+    out = np.full(cap, fill, a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# grouped aggregation
+# ---------------------------------------------------------------------------
+
+def test_grouped_aggregate_single_key():
+    rng = np.random.default_rng(0)
+    n, cap, gcap = 1000, 1024, 64
+    keys = rng.integers(0, 37, n).astype(np.int64)
+    vals = rng.integers(-100, 100, n).astype(np.int64)
+    gi, ng, results = grouped_aggregate(
+        [(jnp.asarray(pad_to(keys, cap)), None, T.BIGINT)],
+        [("sum", jnp.asarray(pad_to(vals, cap)), None),
+         ("count", jnp.asarray(pad_to(vals, cap)), None),
+         ("min", jnp.asarray(pad_to(vals, cap)), None),
+         ("max", jnp.asarray(pad_to(vals, cap)), None)],
+        jnp.asarray(n), gcap)
+    ng = int(ng)
+    expected = {}
+    for k, v in zip(keys, vals):
+        e = expected.setdefault(k, [0, 0, 10**9, -10**9])
+        e[0] += v
+        e[1] += 1
+        e[2] = min(e[2], v)
+        e[3] = max(e[3], v)
+    assert ng == len(expected)
+    out_keys = np.asarray(jnp.asarray(pad_to(keys, cap))[gi])[:ng]
+    sums = np.asarray(results[0][0])[:ng]
+    cnts = np.asarray(results[1][0])[:ng]
+    mins = np.asarray(results[2][0])[:ng]
+    maxs = np.asarray(results[3][0])[:ng]
+    assert sorted(out_keys) == sorted(expected)
+    for k, s, c, lo, hi in zip(out_keys, sums, cnts, mins, maxs):
+        e = expected[k]
+        assert (s, c, lo, hi) == (e[0], e[1], e[2], e[3])
+
+
+def test_grouped_aggregate_multi_key_with_nulls():
+    # keys: (a, b) where b has nulls; SQL groups nulls together
+    a = np.array([1, 1, 2, 2, 1, 1], dtype=np.int64)
+    b = np.array([10, 10, 20, 20, 0, 0], dtype=np.int64)
+    bvalid = np.array([True, True, True, True, False, False])
+    v = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    cap, gcap = 8, 8
+    gi, ng, results = grouped_aggregate(
+        [(jnp.asarray(pad_to(a, cap)), None, T.BIGINT),
+         (jnp.asarray(pad_to(b, cap)), jnp.asarray(pad_to(bvalid, cap)),
+          T.BIGINT)],
+        [("sum", jnp.asarray(pad_to(v, cap)), None)],
+        jnp.asarray(6), gcap)
+    assert int(ng) == 3
+    sums = sorted(np.asarray(results[0][0])[:3].tolist())
+    assert sums == [3.0, 7.0, 11.0]
+
+
+def test_grouped_aggregate_null_values_and_overflow():
+    # agg input nulls are ignored; count counts non-null only
+    k = np.array([1, 1, 2], dtype=np.int64)
+    v = np.array([5.0, 0.0, 7.0])
+    vvalid = np.array([True, False, True])
+    gi, ng, results = grouped_aggregate(
+        [(jnp.asarray(pad_to(k, 4)), None, T.BIGINT)],
+        [("sum", jnp.asarray(pad_to(v, 4)), jnp.asarray(pad_to(vvalid, 4))),
+         ("count", jnp.asarray(pad_to(v, 4)), jnp.asarray(pad_to(vvalid, 4)))],
+        jnp.asarray(3), 8)
+    assert int(ng) == 2
+    cnt = np.asarray(results[1][0])[:2]
+    assert sorted(cnt.tolist()) == [1, 1]
+    # overflow: 5 distinct keys, capacity 4 -> num_groups reports 5
+    k5 = np.arange(5, dtype=np.int64)
+    gi, ng, _ = grouped_aggregate(
+        [(jnp.asarray(pad_to(k5, 8)), None, T.BIGINT)],
+        [("count", jnp.asarray(pad_to(k5, 8)), None)],
+        jnp.asarray(5), 4)
+    assert int(ng) == 5  # caller re-runs with bigger capacity
+
+
+def test_grouped_aggregate_empty():
+    gi, ng, results = grouped_aggregate(
+        [(jnp.zeros(8, jnp.int64), None, T.BIGINT)],
+        [("sum", jnp.zeros(8, jnp.float64), None)],
+        jnp.asarray(0), 4)
+    assert int(ng) == 0
+
+
+def test_global_aggregate():
+    v = np.array([1.0, 2.0, 3.0, 0.0])
+    valid = np.array([True, True, False, True])
+    results = global_aggregate(
+        [("sum", jnp.asarray(v), jnp.asarray(valid)),
+         ("count", jnp.asarray(v), jnp.asarray(valid)),
+         ("min", jnp.asarray(v), jnp.asarray(valid)),
+         ("max", jnp.asarray(v), jnp.asarray(valid))],
+        jnp.asarray(4))
+    assert float(results[0][0]) == 3.0  # 1 + 2 + 0 (3.0 is NULL)
+    assert int(results[1][0]) == 3
+    assert float(results[2][0]) == 0.0
+    assert float(results[3][0]) == 2.0
+
+
+def test_global_aggregate_empty_input():
+    results = global_aggregate(
+        [("sum", jnp.zeros(4), None)], jnp.asarray(0))
+    assert int(results[0][1]) == 0  # count 0 -> SQL NULL sum
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+def reference_inner_join(bkeys, pkeys):
+    build_pos = collections.defaultdict(list)
+    for i, k in enumerate(bkeys):
+        build_pos[k].append(i)
+    out = []
+    for j, k in enumerate(pkeys):
+        for i in build_pos.get(k, []):
+            out.append((j, i))
+    return out
+
+
+def run_join(bkeys, pkeys, cap_b=None, cap_p=None, out_cap=64):
+    cap_b = cap_b or len(bkeys)
+    cap_p = cap_p or len(pkeys)
+    bids, pids = J.single_word_ids(
+        (jnp.asarray(pad_to(bkeys, cap_b)), None, T.BIGINT),
+        (jnp.asarray(pad_to(pkeys, cap_p)), None, T.BIGINT),
+        jnp.asarray(len(bkeys)), jnp.asarray(len(pkeys)))
+    sb, perm_b = J.build_index(bids)
+    lo, counts = J.probe_counts(sb, perm_b, pids)
+    return bids, pids, sb, perm_b, lo, counts
+
+
+def test_inner_join_with_duplicates():
+    bkeys = [1, 2, 2, 3, 5]
+    pkeys = [2, 3, 4, 2, 1]
+    bids, pids, sb, perm_b, lo, counts = run_join(bkeys, pkeys)
+    probe_idx, build_idx, valid, _, total = J.expand_matches(
+        lo, counts, perm_b, 16)
+    got = sorted((int(p), int(b)) for p, b, ok in
+                 zip(probe_idx, build_idx, valid) if ok)
+    assert got == sorted(reference_inner_join(bkeys, pkeys))
+    assert int(total) == len(got)
+
+
+def test_left_outer_join():
+    bkeys = [1, 2, 2]
+    pkeys = [2, 4, 1]
+    bids, pids, sb, perm_b, lo, counts = run_join(bkeys, pkeys)
+    live = pids >= 0
+    probe_idx, build_idx, valid, unmatched, total = J.expand_matches_outer(
+        lo, counts, live, perm_b, 16)
+    rows = [(int(p), int(b), bool(u)) for p, b, u, ok in
+            zip(probe_idx, build_idx, unmatched, valid) if ok]
+    assert int(total) == 4
+    # probe row 1 (key 4) must appear exactly once, unmatched
+    assert (1, 0, True) in rows
+    matched = [(p, b) for p, b, u in rows if not u]
+    assert sorted(matched) == [(0, 1), (0, 2), (2, 0)]
+
+
+def test_semi_anti():
+    bkeys = [2, 3]
+    pkeys = [1, 2, 3, 4]
+    bids, pids, sb, perm_b, lo, counts = run_join(bkeys, pkeys)
+    live = pids >= 0
+    semi = np.asarray(J.semi_mask(counts, live, anti=False))
+    anti = np.asarray(J.semi_mask(counts, live, anti=True))
+    assert semi.tolist() == [False, True, True, False]
+    assert anti.tolist() == [True, False, False, True]
+
+
+def test_null_keys_never_match():
+    cap = 4
+    bvals = jnp.asarray(pad_to([1, 2], cap))
+    bvalid = jnp.asarray(pad_to([True, False], cap))
+    pvals = jnp.asarray(pad_to([1, 2], cap))
+    pvalid = jnp.asarray(pad_to([False, True], cap))
+    bids, pids = J.single_word_ids(
+        (bvals, bvalid, T.BIGINT), (pvals, pvalid, T.BIGINT),
+        jnp.asarray(2), jnp.asarray(2))
+    sb, perm_b = J.build_index(bids)
+    lo, counts = J.probe_counts(sb, perm_b, pids)
+    assert np.asarray(counts).tolist() == [0, 0, 0, 0]
+
+
+def test_multi_key_canonical_ids():
+    bk = [(1, 10), (1, 20), (2, 10)]
+    pk = [(1, 10), (2, 10), (2, 20), (1, 20)]
+    cap = 4
+    build_cols = [
+        (jnp.asarray(pad_to([a for a, _ in bk], cap)), None, T.BIGINT),
+        (jnp.asarray(pad_to([b for _, b in bk], cap)), None, T.BIGINT)]
+    probe_cols = [
+        (jnp.asarray(pad_to([a for a, _ in pk], cap)), None, T.BIGINT),
+        (jnp.asarray(pad_to([b for _, b in pk], cap)), None, T.BIGINT)]
+    bids, pids = J.canonical_ids(build_cols, probe_cols,
+                                 jnp.asarray(3), jnp.asarray(4))
+    sb, perm_b = J.build_index(bids)
+    lo, counts = J.probe_counts(sb, perm_b, pids)
+    probe_idx, build_idx, valid, _, total = J.expand_matches(
+        lo, counts, perm_b, 16)
+    got = sorted((int(p), int(b)) for p, b, ok in
+                 zip(probe_idx, build_idx, valid) if ok)
+    assert got == sorted(reference_inner_join(bk, pk))
+
+
+def test_matched_build_mask():
+    bkeys = [1, 2, 2, 9]
+    pkeys = [2, 7]
+    bids, pids, sb, perm_b, lo, counts = run_join(bkeys, pkeys)
+    matched = np.asarray(J.matched_build_mask(lo, counts, 4, perm_b))
+    assert matched.tolist() == [False, True, True, False]
+
+
+def test_join_overflow_reports_total():
+    bkeys = [1] * 10
+    pkeys = [1] * 10
+    bids, pids, sb, perm_b, lo, counts = run_join(bkeys, pkeys)
+    _, _, valid, _, total = J.expand_matches(lo, counts, perm_b, 16)
+    assert int(total) == 100  # exceeds out_cap; host re-runs bigger
+    assert int(np.asarray(valid).sum()) == 16
+
+
+# ---------------------------------------------------------------------------
+# filter / sort / hash
+# ---------------------------------------------------------------------------
+
+def test_selected_positions_exact():
+    mask = jnp.asarray([True, False, True, True, False, True, False, False])
+    idx, cnt = selected_positions(mask, None, jnp.asarray(6), 8)
+    assert int(cnt) == 4
+    assert np.asarray(idx)[:4].tolist() == [0, 2, 3, 5]
+    valid = jnp.asarray([True, True, False, True, True, True, True, True])
+    idx, cnt = selected_positions(mask, valid, jnp.asarray(6), 8)
+    assert int(cnt) == 3
+    assert np.asarray(idx)[:3].tolist() == [0, 3, 5]
+
+
+def test_sort_permutation():
+    vals = np.array([3.0, 1.0, 2.0, 0.0, 9.9], dtype=np.float64)
+    valid = np.array([True, True, True, False, True])
+    perm = sort_permutation(
+        [(jnp.asarray(vals), jnp.asarray(valid), T.DOUBLE, False, False)],
+        jnp.asarray(5))
+    # ascending, nulls last: 1.0, 2.0, 3.0, 9.9, NULL
+    assert np.asarray(perm).tolist() == [1, 2, 0, 4, 3]
+    perm = sort_permutation(
+        [(jnp.asarray(vals), jnp.asarray(valid), T.DOUBLE, True, True)],
+        jnp.asarray(5))
+    # descending, nulls first
+    assert np.asarray(perm).tolist() == [3, 4, 0, 2, 1]
+
+
+def test_sort_negative_floats_and_padding():
+    vals = np.array([-1.5, 2.0, -3.0, 0.0, 99.0, 99.0], dtype=np.float64)
+    perm = sort_permutation(
+        [(jnp.asarray(vals), None, T.DOUBLE, False, False)],
+        jnp.asarray(4))  # rows 4,5 are padding
+    assert np.asarray(perm)[:4].tolist() == [2, 0, 3, 1]
+
+
+def test_sort_multi_key():
+    a = np.array([1, 2, 1, 2], dtype=np.int64)
+    b = np.array([9, 8, 7, 6], dtype=np.int64)
+    perm = sort_permutation(
+        [(jnp.asarray(a), None, T.BIGINT, False, False),
+         (jnp.asarray(b), None, T.BIGINT, True, False)],
+        jnp.asarray(4))
+    # a asc, b desc: (1,9),(1,7),(2,8),(2,6)
+    assert np.asarray(perm).tolist() == [0, 2, 1, 3]
+
+
+def test_row_hash_partitions():
+    vals = jnp.asarray(np.arange(1000, dtype=np.int64))
+    h = row_hash([(vals, None, T.BIGINT)])
+    parts = np.asarray(partition_of(h, 8))
+    # roughly balanced
+    counts = np.bincount(parts, minlength=8)
+    assert counts.min() > 80
+    # deterministic
+    h2 = row_hash([(vals, None, T.BIGINT)])
+    assert np.array_equal(np.asarray(h), np.asarray(h2))
