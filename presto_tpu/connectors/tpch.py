@@ -351,10 +351,13 @@ class TpchGenerator:
         return Batch(tuple(cols), hi - lo)
 
     def _psupp_suppkey(self, partkey: np.ndarray, i: np.ndarray) -> np.ndarray:
-        """Supplier-spread formula (TPC-H 4.3 §4.2.3): the i-th of 4 suppliers
-        for a part, scattered across the supplier space."""
+        """Supplier-spread formula (TPC-H 4.3 §4.2.3 shape): the i-th of 4
+        suppliers for a part, scattered across the supplier space.  Unlike
+        dbgen's exact formula this guarantees 4 *distinct* suppliers at any
+        scale (i*(S//4) < S for i<4), which the spec requires and tiny test
+        scales would otherwise violate."""
         s = self.n_supplier
-        return (partkey + i * (s // 4 + (partkey - 1) // s)) % s + 1
+        return (partkey + i * max(s // 4, 1)) % s + 1
 
     def gen_partsupp(self, columns: Sequence[str], lo: int, hi: int) -> Batch:
         """Range is over partkeys; each part contributes 4 rows."""

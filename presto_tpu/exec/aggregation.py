@@ -1,0 +1,199 @@
+"""Aggregation operators.
+
+Reference models: HashAggregationOperator.java:48 (grouped; partial/final
+Step) and AggregationOperator.java:35 (global).  The TPU version
+materializes its input (as the reference's builders do), then runs the
+sort-based grouped_aggregate kernel once, retrying at the next capacity
+bucket when ``num_groups`` overflows — the device-side answer to
+GroupByHash's rehash-with-memory-reservation (MultiChannelGroupByHash.java:87).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, next_bucket
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import Operator, OperatorFactory, device_concat
+
+
+@dataclasses.dataclass(frozen=True)
+class AggChannel:
+    """One primitive reduction: prim in {'sum','count','min','max'},
+    over input channel ``channel`` (None == count(*))."""
+
+    prim: str
+    channel: Optional[int]
+    out_type: T.Type
+
+
+class HashAggregationOperator(Operator):
+    def __init__(self, ctx: OperatorContext, group_channels: Sequence[int],
+                 aggs: Sequence[AggChannel], input_types: Sequence[T.Type]):
+        super().__init__(ctx)
+        self.group_channels = list(group_channels)
+        self.aggs = list(aggs)
+        self.input_types = list(input_types)
+        self._batches: List[Batch] = []
+        self._output: Optional[Batch] = None
+        self._done = False
+
+    def add_input(self, batch: Batch) -> None:
+        self._batches.append(batch)
+        self.ctx.stats.input_batches += 1
+        self.ctx.stats.input_rows += batch.num_rows
+        self.ctx.memory.reserve(batch.size_bytes)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        self._output = self._compute()
+        self._batches = []
+        self.ctx.memory.free()
+
+    def _compute(self) -> Optional[Batch]:
+        import jax
+        import jax.numpy as jnp
+
+        from presto_tpu.ops.groupby import grouped_aggregate
+
+        data = device_concat(self._batches,
+                             self.ctx.config.min_batch_capacity)
+        if data is None:
+            return None  # grouped aggregation of zero rows -> zero rows
+        key_cols = [(data.columns[c].values, data.columns[c].valid,
+                     data.columns[c].type) for c in self.group_channels]
+        agg_ins = []
+        for a in self.aggs:
+            if a.channel is None:
+                col = data.columns[0]
+                agg_ins.append(("count", jnp.zeros_like(
+                    col.values, shape=(data.capacity,)), None))
+            else:
+                col = data.columns[a.channel]
+                agg_ins.append((a.prim, col.values, col.valid))
+        n = jnp.asarray(data.num_rows)
+        group_cap = next_bucket(1, min(max(data.num_rows, 1), 1 << 16))
+        while True:
+            gi, ng, results = grouped_aggregate(key_cols, agg_ins, n,
+                                                group_cap)
+            num_groups = int(ng)
+            if num_groups <= group_cap:
+                break
+            group_cap = next_bucket(num_groups)
+        cols = []
+        for c in self.group_channels:
+            src = data.columns[c]
+            values = src.values[gi]
+            valid = None if src.valid is None else src.valid[gi]
+            cols.append(Column(src.type, values, valid, src.dictionary))
+        for a, (values, cnt) in zip(self.aggs, results):
+            if a.prim == "count":
+                cols.append(Column(a.out_type, values.astype("int64")))
+            else:
+                cols.append(Column(a.out_type,
+                                   values.astype(a.out_type.np_dtype),
+                                   cnt > 0))
+        out = Batch(tuple(cols), num_groups)
+        self.ctx.stats.output_rows += num_groups
+        return out
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._output = self._output, None
+        if out is not None:
+            self._done = True
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._output is None
+
+
+class HashAggregationOperatorFactory(OperatorFactory):
+    def __init__(self, group_channels, aggs, input_types):
+        self.group_channels = list(group_channels)
+        self.aggs = list(aggs)
+        self.input_types = list(input_types)
+
+    def create(self, ctx: OperatorContext) -> HashAggregationOperator:
+        return HashAggregationOperator(ctx, self.group_channels, self.aggs,
+                                       self.input_types)
+
+
+class GlobalAggregationOperator(Operator):
+    """Ungrouped aggregation: exactly one output row, even on empty input."""
+
+    def __init__(self, ctx: OperatorContext, aggs: Sequence[AggChannel],
+                 input_types: Sequence[T.Type]):
+        super().__init__(ctx)
+        self.aggs = list(aggs)
+        self.input_types = list(input_types)
+        self._batches: List[Batch] = []
+        self._output: Optional[Batch] = None
+
+    def add_input(self, batch: Batch) -> None:
+        self._batches.append(batch)
+        self.ctx.stats.input_rows += batch.num_rows
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        import jax.numpy as jnp
+        import numpy as np
+
+        from presto_tpu.ops.groupby import global_aggregate
+
+        data = device_concat(self._batches,
+                             self.ctx.config.min_batch_capacity)
+        self._batches = []
+        cols = []
+        if data is None:
+            for a in self.aggs:
+                if a.prim == "count":
+                    cols.append(Column(a.out_type, np.zeros(1, np.int64)))
+                else:
+                    cols.append(Column(a.out_type,
+                                       np.zeros(1, a.out_type.np_dtype),
+                                       np.zeros(1, bool)))
+            self._output = Batch(tuple(cols), 1)
+            return
+        agg_ins = []
+        for a in self.aggs:
+            if a.channel is None:
+                agg_ins.append(("count", data.columns[0].values, None))
+            else:
+                col = data.columns[a.channel]
+                agg_ins.append((a.prim, col.values, col.valid))
+        results = global_aggregate(agg_ins, jnp.asarray(data.num_rows))
+        for a, (value, cnt) in zip(self.aggs, results):
+            import numpy as np
+
+            if a.prim == "count":
+                cols.append(Column(a.out_type,
+                                   np.asarray([int(value)], np.int64)))
+            else:
+                nonempty = int(cnt) > 0
+                cols.append(Column(
+                    a.out_type,
+                    np.asarray([value], a.out_type.np_dtype),
+                    None if nonempty else np.zeros(1, bool)))
+        self._output = Batch(tuple(cols), 1)
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._output = self._output, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._output is None
+
+
+class GlobalAggregationOperatorFactory(OperatorFactory):
+    def __init__(self, aggs, input_types):
+        self.aggs = list(aggs)
+        self.input_types = list(input_types)
+
+    def create(self, ctx: OperatorContext) -> GlobalAggregationOperator:
+        return GlobalAggregationOperator(ctx, self.aggs, self.input_types)

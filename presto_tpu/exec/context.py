@@ -1,0 +1,99 @@
+"""Execution contexts: memory accounting + operator statistics.
+
+Mirrors the reference's context tree — QueryContext -> TaskContext ->
+PipelineContext -> DriverContext -> OperatorContext
+(presto-main/.../memory/QueryContext.java, operator/OperatorContext.java) —
+and its hierarchical memory contexts (presto-memory-context, SURVEY §2.2):
+reservations roll up to the query root, which enforces a limit.
+
+Stats mirror OperatorStats -> ...  -> QueryStats rollups (SURVEY §5.1): the
+Driver records per-operator wall time and row/batch counts around every
+get_output/add_input call, which is what EXPLAIN ANALYZE renders.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from presto_tpu.config import DEFAULT, EngineConfig
+
+
+class MemoryReservationError(RuntimeError):
+    pass
+
+
+class MemoryContext:
+    """One node in the reservation tree (LocalMemoryContext analogue)."""
+
+    def __init__(self, parent: Optional["MemoryContext"], name: str,
+                 limit: Optional[int] = None):
+        self.parent = parent
+        self.name = name
+        self.limit = limit
+        self.reserved = 0
+        self.peak = 0
+
+    def reserve(self, bytes_: int) -> None:
+        self.set_bytes(self.reserved + bytes_)
+
+    def set_bytes(self, bytes_: int) -> None:
+        delta = bytes_ - self.reserved
+        node = self
+        while node is not None:
+            new = node.reserved + delta
+            if delta > 0 and node.limit is not None and new > node.limit:
+                raise MemoryReservationError(
+                    f"memory limit exceeded at {node.name}: "
+                    f"{new} > {node.limit}")
+            node = node.parent
+        node = self
+        while node is not None:
+            node.reserved += delta
+            node.peak = max(node.peak, node.reserved)
+            node = node.parent
+
+    def free(self) -> None:
+        self.set_bytes(0)
+
+
+@dataclasses.dataclass
+class OperatorStats:
+    operator: str = ""
+    input_batches: int = 0
+    input_rows: int = 0
+    output_batches: int = 0
+    output_rows: int = 0
+    wall_ns: int = 0
+    finish_wall_ns: int = 0
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class QueryContext:
+    def __init__(self, config: EngineConfig = DEFAULT,
+                 memory_limit: Optional[int] = None):
+        self.config = config
+        self.memory = MemoryContext(None, "query", limit=memory_limit)
+        self.start_time = time.time()
+
+
+class TaskContext:
+    def __init__(self, query: QueryContext, task_id: str = "task-0"):
+        self.query = query
+        self.task_id = task_id
+        self.config = query.config
+        self.memory = MemoryContext(query.memory, f"task:{task_id}")
+        self.operator_stats: List[OperatorStats] = []
+
+
+class OperatorContext:
+    def __init__(self, task: TaskContext, name: str):
+        self.task = task
+        self.config = task.config
+        self.name = name
+        self.memory = MemoryContext(task.memory, f"op:{name}")
+        self.stats = OperatorStats(operator=name)
+        task.operator_stats.append(self.stats)

@@ -1,0 +1,416 @@
+"""Hash join operators: build + probe pair sharing a LookupSource.
+
+Reference models: HashBuilderOperator.java:51 (build side ->
+PartitionedLookupSourceFactory), LookupJoinOperator.java:64 (probe),
+HashSemiJoinOperator/SetBuilderOperator (semi), with variants per
+LookupJoinOperators.java:45-60 (inner / probe-outer / semi / anti).
+
+TPU design (ops/join.py): the LookupSource is a *sorted id index*, not a
+hash table.  Three id strategies, chosen at build finish:
+
+- 'single': one integer-ish key channel; values are ids directly.
+- 'packed': multi-channel integer keys packed into one 63-bit word using
+  build-side [min,max] ranges; probe values outside a channel's build range
+  cannot match and map to the dead sentinel (keeps packing exact).
+- 'canonical': arbitrary keys; probe side must materialize, ids come from
+  a union sort (exact, collision-free).
+
+Probe is streaming for 'single'/'packed' (one jitted program per probe
+batch shape), with output-capacity retry on expansion overflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, next_bucket
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import (
+    Operator, OperatorFactory, column_pairs, device_concat,
+)
+
+_PACKABLE = ("bigint", "integer", "smallint", "tinyint", "date", "boolean")
+
+
+def _is_single_word_type(t: T.Type) -> bool:
+    return (T.is_integral(t) or t.name in ("date", "timestamp", "boolean")
+            or isinstance(t, T.DecimalType) or t.is_dictionary)
+
+
+@dataclasses.dataclass
+class LookupSource:
+    """Build-side product handed to probe operators."""
+
+    mode: str                      # 'single' | 'packed' | 'canonical'
+    sorted_ids: object             # int64 [cap_b] (single/packed)
+    perm: object                   # int64 [cap_b]
+    data: Batch                    # padded device build batch
+    n_build: int
+    key_channels: List[int]
+    mins: Optional[np.ndarray] = None     # packed: per-channel min
+    strides: Optional[np.ndarray] = None  # packed: per-channel stride
+    maxs: Optional[np.ndarray] = None
+
+
+class LookupSourceFactory:
+    """Rendezvous between build and probe pipelines
+    (PartitionedLookupSourceFactory analogue; single-partition here — the
+    multi-device partitioned variant lives in parallel/)."""
+
+    def __init__(self):
+        self.source: Optional[LookupSource] = None
+
+    def set(self, source: LookupSource) -> None:
+        self.source = source
+
+    def get(self) -> LookupSource:
+        if self.source is None:
+            raise RuntimeError("build side not finished before probe "
+                               "(pipeline ordering bug)")
+        return self.source
+
+
+class HashBuildOperator(Operator):
+    def __init__(self, ctx: OperatorContext, factory: "HashBuildOperatorFactory"):
+        super().__init__(ctx)
+        self.f = factory
+        self._batches: List[Batch] = []
+
+    def add_input(self, batch: Batch) -> None:
+        self._batches.append(batch)
+        self.ctx.stats.input_rows += batch.num_rows
+        self.ctx.memory.reserve(batch.size_bytes)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        import jax.numpy as jnp
+
+        from presto_tpu import types as TT
+        from presto_tpu.exec.operator import pad_batch
+        from presto_tpu.ops import join as J
+
+        data = device_concat(self._batches, self.ctx.config.min_batch_capacity)
+        if data is None:
+            # empty build side: synthesize a 0-row padded batch
+            from presto_tpu.batch import empty_batch
+
+            data = pad_batch(empty_batch(self.f.input_types),
+                             self.ctx.config.min_batch_capacity)
+        self._batches = []
+        chans = self.f.key_channels
+        cap_b = data.capacity
+        n_build = data.num_rows
+        dead = jnp.arange(cap_b) >= n_build
+        for c in chans:
+            if data.columns[c].valid is not None:
+                dead = dead | ~data.columns[c].valid
+        if len(chans) == 1 and _is_single_word_type(data.columns[chans[0]].type):
+            ids = data.columns[chans[0]].values.astype(jnp.int64) + 2
+            ids = jnp.where(dead, jnp.int64(-2), ids)
+            sb, perm = J.build_index(ids)
+            self.f.lookup.set(LookupSource("single", sb, perm, data, n_build,
+                                           chans))
+            return
+        if all(_is_single_word_type(data.columns[c].type) for c in chans):
+            # pack multi-channel integer keys using build-side ranges
+            mins, maxs, strides = [], [], []
+            live_any = n_build > 0
+            span_product = 1
+            for c in chans:
+                v = np.asarray(data.columns[c].values.astype(jnp.int64))
+                livemask = ~np.asarray(dead)
+                lv = v[livemask] if live_any else np.zeros(1, np.int64)
+                lo = int(lv.min()) if lv.size else 0
+                hi = int(lv.max()) if lv.size else 0
+                mins.append(lo)
+                maxs.append(hi)
+                strides.append(span_product)
+                span_product *= (hi - lo + 1)
+            if span_product < (1 << 62):
+                mins_a = np.asarray(mins, np.int64)
+                maxs_a = np.asarray(maxs, np.int64)
+                strides_a = np.asarray(strides, np.int64)
+                ids = jnp.zeros(cap_b, jnp.int64)
+                for i, c in enumerate(chans):
+                    v = data.columns[c].values.astype(jnp.int64)
+                    ids = ids + (v - int(mins_a[i])) * int(strides_a[i])
+                ids = jnp.where(dead, jnp.int64(-2), ids)
+                sb, perm = J.build_index(ids)
+                self.f.lookup.set(LookupSource(
+                    "packed", sb, perm, data, n_build, chans,
+                    mins=mins_a, strides=strides_a, maxs=maxs_a))
+                return
+        # general path: probe side will materialize and union-sort
+        self.f.lookup.set(LookupSource("canonical", None, None, data,
+                                       n_build, chans))
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class HashBuildOperatorFactory(OperatorFactory):
+    def __init__(self, key_channels: Sequence[int],
+                 input_types: Sequence[T.Type]):
+        self.key_channels = list(key_channels)
+        self.input_types = list(input_types)
+        self.lookup = LookupSourceFactory()
+
+    def create(self, ctx: OperatorContext) -> HashBuildOperator:
+        return HashBuildOperator(ctx, self)
+
+
+class LookupJoinOperator(Operator):
+    """Probe side.  Output layout: all probe channels, then all build
+    channels (planner projects away what it does not need).  semi/anti emit
+    probe channels only."""
+
+    def __init__(self, ctx: OperatorContext, factory: "LookupJoinOperatorFactory"):
+        super().__init__(ctx)
+        self.f = factory
+        self._pending: List[Batch] = []
+        self._out: List[Batch] = []
+        self._kernels: Dict[tuple, object] = {}
+        self._drained = False
+
+    # -- probe id computation -------------------------------------------
+    def _probe_ids(self, jnp, src: LookupSource, batch: Batch, num_rows):
+        chans = self.f.probe_key_channels
+        cap = batch.capacity
+        dead = jnp.arange(cap) >= num_rows
+        for c in chans:
+            if batch.columns[c].valid is not None:
+                dead = dead | ~batch.columns[c].valid
+        if src.mode == "single":
+            ids = batch.columns[chans[0]].values.astype(jnp.int64) + 2
+            return jnp.where(dead, jnp.int64(-1), ids)
+        assert src.mode == "packed"
+        ids = jnp.zeros(cap, jnp.int64)
+        for i, c in enumerate(chans):
+            v = batch.columns[c].values.astype(jnp.int64)
+            lo = int(src.mins[i])
+            hi = int(src.maxs[i])
+            dead = dead | (v < lo) | (v > hi)
+            ids = ids + (v - lo) * int(src.strides[i])
+        return jnp.where(dead, jnp.int64(-1), ids)
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_rows += batch.num_rows
+        src = self.f.build.lookup.get()
+        if src.mode == "canonical":
+            self._pending.append(batch)
+            self.ctx.memory.reserve(batch.size_bytes)
+            return
+        out = self._probe_streaming(src, batch)
+        if out is not None and out.num_rows > 0:
+            self._out.append(out)
+
+    def _probe_streaming(self, src: LookupSource, batch: Batch) -> Optional[Batch]:
+        import jax
+        import jax.numpy as jnp
+
+        from presto_tpu.ops import join as J
+
+        join_type = self.f.join_type
+        cap = batch.capacity
+        n = jnp.asarray(batch.num_rows)
+        if join_type in ("semi", "anti"):
+            out_cap = cap
+        else:
+            out_cap = next_bucket(cap * self.f.expansion)
+        while True:
+            kernel = self._kernel(src, cap, out_cap)
+            outs, count = kernel(tuple(column_pairs(batch)),
+                                 tuple(column_pairs(src.data)), n)
+            total = int(count)
+            if total <= out_cap:
+                break
+            out_cap = next_bucket(total)
+        cols = []
+        probe_cols = [batch.columns[i] for i in range(batch.num_columns)]
+        if join_type in ("semi", "anti"):
+            for c, (v, valid) in zip(probe_cols, outs):
+                cols.append(Column(c.type, v, valid, c.dictionary))
+        else:
+            nb = batch.num_columns
+            for c, (v, valid) in zip(probe_cols, outs[:nb]):
+                cols.append(Column(c.type, v, valid, c.dictionary))
+            for c, (v, valid) in zip(src.data.columns, outs[nb:]):
+                cols.append(Column(c.type, v, valid, c.dictionary))
+        out = Batch(tuple(cols), min(total, out_cap))
+        self.ctx.stats.output_rows += out.num_rows
+        return out
+
+    def _kernel(self, src: LookupSource, cap: int, out_cap: int):
+        import jax
+        import jax.numpy as jnp
+
+        from presto_tpu.ops import join as J
+        from presto_tpu.ops.filter import selected_positions
+
+        key = (src.mode, cap, out_cap, self.f.join_type, id(src))
+        hit = self._kernels.get(key)
+        if hit is not None:
+            return hit
+        join_type = self.f.join_type
+        probe_op = self
+
+        def kernel(probe_cols_pairs, build_cols_pairs, num_rows):
+            pb = _RebuiltBatch(probe_cols_pairs)
+            ids = probe_op._probe_ids(jnp, src, pb, num_rows)
+            lo, counts = J.probe_counts(src.sorted_ids, src.perm, ids)
+            live = ids >= 0
+            if join_type in ("semi", "anti"):
+                mask = J.semi_mask(counts, live, anti=(join_type == "anti"))
+                # anti join must also keep live=false? dead rows from
+                # padding excluded; null-key rows: SQL anti (NOT EXISTS)
+                # keeps them:
+                if join_type == "anti":
+                    pad = jnp.arange(cap) >= num_rows
+                    nullkey = (~live) & (~pad)
+                    mask = mask | nullkey
+                idx, count = selected_positions(mask, None, num_rows, out_cap)
+                outs = tuple(
+                    (v[idx], None if valid is None else valid[idx])
+                    for v, valid in probe_cols_pairs)
+                return outs, count
+            if join_type == "left":
+                # every real probe row emits >=1 row (null-key rows emit the
+                # unmatched form); padding rows emit nothing
+                pi, bi, rv, unmatched, total = J.expand_matches_outer(
+                    lo, counts, jnp.arange(cap) < num_rows,
+                    src.perm, out_cap)
+            else:
+                pi, bi, rv, unmatched, total = J.expand_matches(
+                    lo, counts, src.perm, out_cap)
+            outs = []
+            for v, valid in probe_cols_pairs:
+                outs.append((v[pi], None if valid is None else valid[pi]))
+            ones = jnp.ones(out_cap, bool)
+            for v, valid in build_cols_pairs:
+                bvalid = ones if valid is None else valid[bi]
+                bvalid = bvalid & ~unmatched
+                outs.append((v[bi], bvalid))
+            return tuple(outs), total
+
+        jitted = jax.jit(kernel)
+        self._kernels[key] = jitted
+        return jitted
+
+    def _probe_canonical(self) -> None:
+        import jax.numpy as jnp
+
+        from presto_tpu.ops import join as J
+        from presto_tpu.ops.filter import selected_positions
+
+        src = self.f.build.lookup.get()
+        probe = device_concat(self._pending,
+                              self.ctx.config.min_batch_capacity)
+        self._pending = []
+        if probe is None:
+            return
+        bcols = [(src.data.columns[c].values, src.data.columns[c].valid,
+                  src.data.columns[c].type) for c in self.f.build.key_channels]
+        pcols = [(probe.columns[c].values, probe.columns[c].valid,
+                  probe.columns[c].type) for c in self.f.probe_key_channels]
+        bids, pids = J.canonical_ids(bcols, pcols,
+                                     jnp.asarray(src.data.num_rows),
+                                     jnp.asarray(probe.num_rows))
+        sb, perm = J.build_index(bids)
+        lo, counts = J.probe_counts(sb, perm, pids)
+        live = pids >= 0
+        cap = probe.capacity
+        n = jnp.asarray(probe.num_rows)
+        join_type = self.f.join_type
+        if join_type in ("semi", "anti"):
+            mask = J.semi_mask(counts, live, anti=(join_type == "anti"))
+            if join_type == "anti":
+                pad = jnp.arange(cap) >= n
+                mask = mask | ((~live) & (~pad))
+            idx, count = selected_positions(mask, None, n, cap)
+            cols = tuple(
+                Column(c.type, c.values[idx],
+                       None if c.valid is None else c.valid[idx],
+                       c.dictionary)
+                for c in probe.columns)
+            self._out.append(Batch(cols, int(count)))
+            return
+        out_cap = next_bucket(cap * self.f.expansion)
+        while True:
+            if join_type == "left":
+                pi, bi, rv, unmatched, total = J.expand_matches_outer(
+                    lo, counts, jnp.arange(cap) < n, perm, out_cap)
+            else:
+                pi, bi, rv, unmatched, total = J.expand_matches(
+                    lo, counts, perm, out_cap)
+            if int(total) <= out_cap:
+                break
+            out_cap = next_bucket(int(total))
+        cols = []
+        for c in probe.columns:
+            cols.append(Column(c.type, c.values[pi],
+                               None if c.valid is None else c.valid[pi],
+                               c.dictionary))
+        ones = jnp.ones(out_cap, bool)
+        for c in src.data.columns:
+            bvalid = ones if c.valid is None else c.valid[bi]
+            cols.append(Column(c.type, c.values[bi], bvalid & ~unmatched,
+                               c.dictionary))
+        self._out.append(Batch(tuple(cols), int(total)))
+
+    # -- protocol --------------------------------------------------------
+    def get_output(self) -> Optional[Batch]:
+        if self._out:
+            return self._out.pop(0)
+        return None
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        if self._pending:
+            self._probe_canonical()
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._out and not self._pending
+
+
+class _RebuiltBatch:
+    """Adapter presenting (values, valid) pairs as Batch-ish columns for
+    _probe_ids inside a jit trace."""
+
+    def __init__(self, pairs):
+        self.capacity = pairs[0][0].shape[0]
+        self.columns = [_Col(v, valid) for v, valid in pairs]
+
+
+class _Col:
+    __slots__ = ("values", "valid")
+
+    def __init__(self, values, valid):
+        self.values = values
+        self.valid = valid
+
+
+class LookupJoinOperatorFactory(OperatorFactory):
+    def __init__(self, build: HashBuildOperatorFactory,
+                 probe_key_channels: Sequence[int],
+                 probe_types: Sequence[T.Type],
+                 join_type: str = "inner", expansion: int = 2):
+        assert join_type in ("inner", "left", "semi", "anti")
+        self.build = build
+        self.probe_key_channels = list(probe_key_channels)
+        self.probe_types = list(probe_types)
+        self.join_type = join_type
+        self.expansion = expansion
+
+    def create(self, ctx: OperatorContext) -> LookupJoinOperator:
+        return LookupJoinOperator(ctx, self)

@@ -1,0 +1,113 @@
+"""Operator protocol + shared device-batch plumbing.
+
+The contract is the reference's Operator SPI verbatim
+(presto-main/.../operator/Operator.java:20-102):
+
+    needs_input() / add_input(batch) / get_output() / finish() /
+    is_finished()
+
+kept because the *control plane* of a pull/push pipeline is
+hardware-agnostic; what changes on TPU is that each operator's data plane
+is a jitted XLA program over padded static shapes.  ``accumulate``-style
+operators (agg, join build, sort) materialize their input exactly like the
+reference's PagesIndex-backed operators do, then run one kernel at finish.
+
+``device_concat`` / ``pad_columns`` implement the padding-bucket policy
+(SURVEY §7 hard part #1): every kernel sees power-of-two row capacities so
+XLA compiles a small, reusable set of programs per query shape.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, next_bucket
+from presto_tpu.exec.context import OperatorContext
+
+
+class Operator:
+    """One physical operator instance (single driver)."""
+
+    def __init__(self, ctx: OperatorContext):
+        self.ctx = ctx
+        self._finishing = False
+
+    # -- control protocol (reference-identical) -------------------------
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        raise NotImplementedError
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def finish(self) -> None:
+        """No more input will arrive (Operator.finish)."""
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        self.ctx.memory.free()
+
+
+class OperatorFactory:
+    """Creates per-driver Operator instances
+    (reference OperatorFactory; duplicated per driver for parallelism)."""
+
+    def create(self, ctx: OperatorContext) -> Operator:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Factory", "")
+
+
+class SourceOperator(Operator):
+    """An operator at pipeline position 0 fed by splits, not batches
+    (reference SourceOperator; split delivery is the scheduler's job)."""
+
+    def add_split(self, split) -> None:
+        raise NotImplementedError
+
+    def no_more_splits(self) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Device-batch helpers
+# ---------------------------------------------------------------------------
+
+def pad_batch(batch: Batch, min_capacity: int = 1024) -> Batch:
+    """Pad to the power-of-two bucket and move to device."""
+    cap = next_bucket(batch.num_rows, min_capacity)
+    return batch.pad_rows(cap).to_device()
+
+
+def device_concat(batches: Sequence[Batch], min_capacity: int = 1024) -> Batch:
+    """Concatenate batches into one padded device Batch.
+
+    Dictionary columns are re-coded into a shared dictionary host-side
+    first (cheap: dictionary sizes << row counts)."""
+    import jax.numpy as jnp
+
+    from presto_tpu.batch import concat_batches
+
+    live = [b for b in batches if b.num_rows > 0]
+    if not live:
+        return None
+    if len(live) == 1:
+        return pad_batch(live[0].compact(), min_capacity)
+    # host-side concat handles dictionary merging; arrays may be device or
+    # numpy — normalize host-side, then stage once.
+    merged = concat_batches([b.to_numpy() for b in live])
+    return pad_batch(merged, min_capacity)
+
+
+def column_pairs(batch: Batch) -> List[Tuple[object, object]]:
+    return [(c.values, c.valid) for c in batch.columns]

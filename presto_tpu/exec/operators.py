@@ -1,0 +1,279 @@
+"""Leaf / streaming operators: scan, values, filter+project, limit, output.
+
+Reference models: TableScanOperator.java:46, ValuesOperator.java:27,
+FilterAndProjectOperator.java:38 (+ compiled PageProcessor), LimitOperator
+.java:24, TaskOutputOperator.java:33.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, next_bucket
+from presto_tpu.connectors.api import Connector, Split
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import (
+    Operator, OperatorFactory, SourceOperator, column_pairs, pad_batch,
+)
+from presto_tpu.expr.compile import ExprCompiler
+from presto_tpu.expr.ir import RowExpression
+
+
+class TableScanOperator(SourceOperator):
+    """Pulls host batches from the connector PageSource and stages them to
+    device (the LazyBlock-load + ConnectorPageSource.getNextPage path)."""
+
+    def __init__(self, ctx: OperatorContext, connector: Connector,
+                 columns: Sequence[str], batch_rows: int, to_device: bool):
+        super().__init__(ctx)
+        self.connector = connector
+        self.columns = list(columns)
+        self.batch_rows = batch_rows
+        self.to_device = to_device
+        self._splits: List[Split] = []
+        self._no_more_splits = False
+        self._iter = None
+
+    def add_split(self, split: Split) -> None:
+        self._splits.append(split)
+
+    def no_more_splits(self) -> None:
+        self._no_more_splits = True
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[Batch]:
+        while True:
+            if self._iter is None:
+                if not self._splits:
+                    return None
+                split = self._splits.pop(0)
+                self._iter = iter(self.connector.page_source(
+                    split, self.columns, self.batch_rows))
+            try:
+                batch = next(self._iter)
+            except StopIteration:
+                self._iter = None
+                continue
+            if batch.num_rows == 0:
+                continue
+            self.ctx.memory.set_bytes(batch.size_bytes)
+            if self.to_device:
+                return pad_batch(batch, self.ctx.config.min_batch_capacity)
+            return batch
+
+    def is_finished(self) -> bool:
+        return (self._no_more_splits and not self._splits
+                and self._iter is None) or self._finishing
+
+
+class TableScanOperatorFactory(OperatorFactory):
+    def __init__(self, connector: Connector, columns: Sequence[str],
+                 batch_rows: int = 65536, to_device: bool = True):
+        self.connector = connector
+        self.columns = list(columns)
+        self.batch_rows = batch_rows
+        self.to_device = to_device
+
+    def create(self, ctx: OperatorContext) -> TableScanOperator:
+        return TableScanOperator(ctx, self.connector, self.columns,
+                                 self.batch_rows, self.to_device)
+
+
+class ValuesOperator(Operator):
+    def __init__(self, ctx: OperatorContext, batches: Sequence[Batch]):
+        super().__init__(ctx)
+        self._batches = list(batches)
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[Batch]:
+        if self._batches:
+            return self._batches.pop(0)
+        return None
+
+    def is_finished(self) -> bool:
+        return not self._batches
+
+
+class ValuesOperatorFactory(OperatorFactory):
+    def __init__(self, batches: Sequence[Batch]):
+        self.batches = list(batches)
+
+    def create(self, ctx: OperatorContext) -> ValuesOperator:
+        return ValuesOperator(ctx, self.batches)
+
+
+class FilterProjectOperator(Operator):
+    """filter -> compact -> project, fused into one jitted XLA program per
+    (capacity, dictionary-binding) — the PageProcessor replacement.
+
+    The compiled program returns projected columns plus the selected-row
+    count; intermediate selection vectors never leave the device.
+    """
+
+    def __init__(self, ctx: OperatorContext,
+                 filter_expr: Optional[RowExpression],
+                 projections: Sequence[RowExpression],
+                 input_types: Sequence[T.Type]):
+        super().__init__(ctx)
+        self.filter_expr = filter_expr
+        self.projections = list(projections)
+        self.input_types = list(input_types)
+        self._pending: Optional[Batch] = None
+        self._kernels: Dict[tuple, object] = {}
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._pending = batch
+        self.ctx.stats.input_batches += 1
+        self.ctx.stats.input_rows += batch.num_rows
+
+    def _kernel_for(self, batch: Batch):
+        import jax
+
+        dict_key = tuple(id(c.dictionary) for c in batch.columns)
+        key = (batch.capacity, dict_key)
+        hit = self._kernels.get(key)
+        if hit is not None:
+            return hit
+        compiler = ExprCompiler({i: c.dictionary
+                                 for i, c in enumerate(batch.columns)
+                                 if c.dictionary is not None})
+        cfilter = (compiler.compile(self.filter_expr)
+                   if self.filter_expr is not None else None)
+        cprojs = [compiler.compile(p) for p in self.projections]
+        cap = batch.capacity
+
+        def kernel(cols, num_rows):
+            import jax.numpy as jnp
+
+            from presto_tpu.ops.filter import selected_positions
+
+            if cfilter is not None:
+                mask, mvalid = cfilter.run(cols, num_rows, jnp)
+                idx, count = selected_positions(mask, mvalid, num_rows, cap)
+                gathered = tuple(
+                    (v[idx], None if valid is None else valid[idx])
+                    for v, valid in cols)
+            else:
+                gathered, count = cols, num_rows
+            outs = [p.run(gathered, count, jnp) for p in cprojs]
+            return outs, count
+
+        entry = (jax.jit(kernel), cprojs)
+        self._kernels[key] = entry
+        return entry
+
+    def get_output(self) -> Optional[Batch]:
+        if self._pending is None:
+            return None
+        batch, self._pending = self._pending, None
+        jitted, cprojs = self._kernel_for(batch)
+        outs, count = jitted(tuple(column_pairs(batch)), batch.num_rows)
+        n = int(count)
+        cols = tuple(
+            Column(p.type, v, valid, p.dictionary)
+            for p, (v, valid) in zip(cprojs, outs))
+        out = Batch(cols, n)
+        self.ctx.stats.output_batches += 1
+        self.ctx.stats.output_rows += n
+        if n == 0:
+            return None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+class FilterProjectOperatorFactory(OperatorFactory):
+    def __init__(self, filter_expr: Optional[RowExpression],
+                 projections: Sequence[RowExpression],
+                 input_types: Sequence[T.Type]):
+        self.filter_expr = filter_expr
+        self.projections = list(projections)
+        self.input_types = list(input_types)
+
+    def create(self, ctx: OperatorContext) -> FilterProjectOperator:
+        return FilterProjectOperator(ctx, self.filter_expr, self.projections,
+                                     self.input_types)
+
+
+class LimitOperator(Operator):
+    def __init__(self, ctx: OperatorContext, limit: int):
+        super().__init__(ctx)
+        self.remaining = limit
+        self._pending: Optional[Batch] = None
+
+    def needs_input(self) -> bool:
+        return (self._pending is None and self.remaining > 0
+                and not self._finishing)
+
+    def add_input(self, batch: Batch) -> None:
+        if batch.num_rows > self.remaining:
+            batch = batch.head(self.remaining)
+        self.remaining -= batch.num_rows
+        self._pending = batch
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return out
+
+    def is_finished(self) -> bool:
+        return (self.remaining == 0 or self._finishing) and \
+            self._pending is None
+
+
+class LimitOperatorFactory(OperatorFactory):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def create(self, ctx: OperatorContext) -> LimitOperator:
+        return LimitOperator(ctx, self.limit)
+
+
+class OutputCollector(Operator):
+    """Terminal sink gathering result batches host-side
+    (TaskOutputOperator / test MaterializedResult role)."""
+
+    def __init__(self, ctx: OperatorContext):
+        super().__init__(ctx)
+        self.batches: List[Batch] = []
+
+    def add_input(self, batch: Batch) -> None:
+        if batch.num_rows:
+            self.batches.append(batch.compact().to_numpy())
+        self.ctx.stats.input_batches += 1
+        self.ctx.stats.input_rows += batch.num_rows
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+    def rows(self) -> List[tuple]:
+        out: List[tuple] = []
+        for b in self.batches:
+            out.extend(b.to_pylist())
+        return out
+
+
+class OutputCollectorFactory(OperatorFactory):
+    def __init__(self):
+        self.collectors: List[OutputCollector] = []
+
+    def create(self, ctx: OperatorContext) -> OutputCollector:
+        c = OutputCollector(ctx)
+        self.collectors.append(c)
+        return c
+
+    def rows(self) -> List[tuple]:
+        out = []
+        for c in self.collectors:
+            out.extend(c.rows())
+        return out

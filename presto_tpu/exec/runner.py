@@ -1,0 +1,32 @@
+"""Single-process pipeline runner (LocalQueryRunner's execution half).
+
+The reference's LocalQueryRunner plans SQL then hand-pumps drivers in one
+process (presto-main/.../testing/LocalQueryRunner.java:214,616-665).  This
+module is the pumping half: it executes a DAG of Pipelines in dependency
+order.  The SQL half (sql/ package) lowers plans into these pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from presto_tpu.config import DEFAULT, EngineConfig
+from presto_tpu.exec.context import QueryContext, TaskContext
+from presto_tpu.exec.driver import Pipeline
+
+
+def execute_pipelines(pipelines: Sequence[Pipeline],
+                      config: EngineConfig = DEFAULT,
+                      memory_limit: Optional[int] = None) -> TaskContext:
+    """Run pipelines sequentially in the given (dependency) order.
+
+    Build pipelines come before their probe pipelines — the planner emits
+    them in that order, mirroring how the reference sequences via
+    LookupSourceFactory futures.  Returns the TaskContext (stats).
+    """
+    query = QueryContext(config, memory_limit)
+    task = TaskContext(query)
+    for p in pipelines:
+        driver = p.instantiate(task)
+        driver.run_to_completion()
+    return task

@@ -1,0 +1,92 @@
+"""ORDER BY / TopN operators (OrderByOperator.java:45, TopNOperator.java:35).
+
+Both materialize (as the reference's PagesIndex does), run the device
+sort-permutation kernel once, and gather.  TopN is the same kernel with a
+truncated gather — a bounded-heap has no TPU advantage over a full
+vectorized sort at these sizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import Operator, OperatorFactory, device_concat
+
+
+@dataclasses.dataclass(frozen=True)
+class SortSpec:
+    channel: int
+    descending: bool = False
+    nulls_first: bool = False
+
+
+class OrderByOperator(Operator):
+    def __init__(self, ctx: OperatorContext, specs: Sequence[SortSpec],
+                 limit: Optional[int] = None):
+        super().__init__(ctx)
+        self.specs = list(specs)
+        self.limit = limit
+        self._batches: List[Batch] = []
+        self._output: Optional[Batch] = None
+
+    def add_input(self, batch: Batch) -> None:
+        self._batches.append(batch)
+        self.ctx.stats.input_rows += batch.num_rows
+        self.ctx.memory.reserve(batch.size_bytes)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        import jax.numpy as jnp
+
+        from presto_tpu.ops.sort import sort_permutation
+
+        data = device_concat(self._batches, self.ctx.config.min_batch_capacity)
+        self._batches = []
+        self.ctx.memory.free()
+        if data is None:
+            return
+        keys = []
+        for s in self.specs:
+            c = data.columns[s.channel]
+            if c.type.is_dictionary:
+                # order by lexicographic rank, computed host-side over the
+                # dictionary (strings never sort on device)
+                ranks = c.dictionary.sort_ranks()
+                values = jnp.asarray(ranks)[c.values]
+                keys.append((values, c.valid, T.INTEGER, s.descending,
+                             s.nulls_first))
+            else:
+                keys.append((c.values, c.valid, c.type, s.descending,
+                             s.nulls_first))
+        perm = sort_permutation(keys, jnp.asarray(data.num_rows))
+        n = data.num_rows if self.limit is None else min(self.limit,
+                                                         data.num_rows)
+        cols = tuple(
+            Column(c.type, c.values[perm],
+                   None if c.valid is None else c.valid[perm], c.dictionary)
+            for c in data.columns)
+        self._output = Batch(cols, n)
+        self.ctx.stats.output_rows += n
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._output = self._output, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._output is None
+
+
+class OrderByOperatorFactory(OperatorFactory):
+    def __init__(self, specs: Sequence[SortSpec],
+                 limit: Optional[int] = None):
+        self.specs = list(specs)
+        self.limit = limit
+
+    def create(self, ctx: OperatorContext) -> OrderByOperator:
+        return OrderByOperator(ctx, self.specs, self.limit)
