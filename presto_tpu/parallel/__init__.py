@@ -1,0 +1,19 @@
+"""Multi-chip parallelism: mesh, collective exchange, partitioned operators.
+
+The reference moves data between nodes by hash-partitioning pages into
+HTTP-served output buffers (presto-main/.../operator/PartitionedOutputOperator.java:48,
+execution/buffer/PartitionedOutputBuffer.java:42) that consumers long-poll
+(operator/HttpPageBufferClient.java:297).  Within a TPU slice that entire
+data plane becomes XLA collectives over ICI under ``shard_map``:
+
+- P1 FIXED_HASH     -> ``all_to_all``   (exchange.repartition)
+- P2 FIXED_BROADCAST-> ``all_gather``   (exchange.broadcast_rows)
+- P4 SINGLE         -> gather-to-host   (steps return per-shard results)
+
+(SURVEY §2.13 parallelism inventory.)  Static shapes throughout: every
+shard sends fixed-capacity slots and reports true counts; overflow is a
+flag the host reacts to by re-running at the next capacity bucket — the
+same policy the single-chip kernels use for hash-table growth.
+"""
+
+from presto_tpu.parallel.mesh import make_mesh, shard_batch_arrays  # noqa: F401

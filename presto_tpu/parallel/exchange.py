@@ -1,0 +1,132 @@
+"""Collective exchange primitives (called inside ``shard_map``).
+
+These are the ICI-native replacements for the reference's exchange data
+plane: PagePartitioner.partitionPage's row-at-a-time bucket copy
+(presto-main/.../operator/PartitionedOutputOperator.java:377-414) becomes a
+vectorized sort-by-destination plus one ``all_to_all``; BroadcastOutputBuffer
+(execution/buffer/BroadcastOutputBuffer.java:51) becomes ``all_gather``.
+LZ4 serde and token-ack pulls have no intra-slice role — ICI moves raw
+device arrays; the host pull protocol survives only across slices/stages
+(presto_tpu.dist).
+
+Shape discipline: a shard holds C live-capacity rows and sends a fixed
+``slot_cap``-row slot to each of the P peers.  True per-slot counts ride
+along; receivers compact live rows to the front.  ``overflow`` is reported
+per shard (any send slot truncated, or receive capacity exceeded) so the
+host can re-run the step at the next capacity bucket — the distributed
+version of the kernels' recompile-on-bucket-change policy.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def repartition(
+    arrays: Sequence[jax.Array],
+    live: jax.Array,
+    dest: jax.Array,
+    slot_cap: int,
+    out_cap: int,
+    axis_name: str,
+) -> Tuple[List[jax.Array], jax.Array, jax.Array]:
+    """Hash-partitioned exchange (P1, FIXED_HASH_DISTRIBUTION).
+
+    Per-shard view: ``arrays`` are row-parallel [C, ...]; ``live`` marks
+    real rows; ``dest`` gives each row's destination shard in [0, P).
+    Every shard sends at most ``slot_cap`` rows to each peer and compacts
+    what it receives into [out_cap, ...].
+
+    Returns (arrays_out, num_out, overflow) — all per-shard.
+    """
+    P = jax.lax.axis_size(axis_name)
+    C = dest.shape[0]
+    d = jnp.where(live, dest.astype(jnp.int32), jnp.int32(P))
+    order = jnp.argsort(d)  # stable: preserves row order within a bucket
+    ds = d[order]
+    buckets = jnp.arange(P, dtype=ds.dtype)
+    starts = jnp.searchsorted(ds, buckets, side="left")
+    ends = jnp.searchsorted(ds, buckets, side="right")
+    counts = ends - starts                              # rows per dest
+    within = jnp.arange(C) - starts[jnp.clip(ds, 0, P - 1)]
+    ok = (ds < P) & (within < slot_cap)
+    slot = jnp.where(ok, jnp.clip(ds, 0, P - 1) * slot_cap + within,
+                     P * slot_cap)                      # OOB -> dropped
+    send_overflow = (counts > slot_cap).any()
+
+    recv_counts = jax.lax.all_to_all(
+        jnp.minimum(counts, slot_cap).reshape(P, 1), axis_name,
+        split_axis=0, concat_axis=0, tiled=True).reshape(P)
+    total = recv_counts.sum()
+
+    # receive-side compaction addresses
+    offs = jnp.concatenate([jnp.zeros(1, recv_counts.dtype),
+                            jnp.cumsum(recv_counts)[:-1]])
+    within_r = jnp.arange(slot_cap)
+    live_r = within_r[None, :] < recv_counts[:, None]   # [P, slot_cap]
+    dst = jnp.where(live_r, offs[:, None] + within_r[None, :],
+                    out_cap).reshape(-1)                # OOB -> dropped
+
+    outs = []
+    for a in arrays:
+        tail = a.shape[1:]
+        buf = jnp.zeros((P * slot_cap,) + tail, a.dtype)
+        buf = buf.at[slot].set(a[order], mode="drop")
+        recv = jax.lax.all_to_all(
+            buf.reshape((P, slot_cap) + tail), axis_name,
+            split_axis=0, concat_axis=0, tiled=True)
+        out = jnp.zeros((out_cap,) + tail, a.dtype)
+        out = out.at[dst].set(recv.reshape((P * slot_cap,) + tail),
+                              mode="drop")
+        outs.append(out)
+    num_out = jnp.minimum(total, out_cap).astype(jnp.int64)
+    overflow = send_overflow | (total > out_cap)
+    return outs, num_out, overflow
+
+
+def broadcast_rows(
+    arrays: Sequence[jax.Array],
+    num_rows: jax.Array,
+    out_cap: int,
+    axis_name: str,
+) -> Tuple[List[jax.Array], jax.Array, jax.Array]:
+    """Broadcast exchange (P2): every shard receives ALL rows, compacted.
+
+    Per-shard view: arrays [C, ...] with ``num_rows`` live.  Result is the
+    identical [out_cap, ...] union on every shard (the all-gathered build
+    side of a broadcast join).
+    """
+    P = jax.lax.axis_size(axis_name)
+    counts = jax.lax.all_gather(num_rows.reshape(()), axis_name)  # [P]
+    total = counts.sum()
+    C = arrays[0].shape[0]
+    offs = jnp.concatenate([jnp.zeros(1, counts.dtype),
+                            jnp.cumsum(counts)[:-1]])
+    within = jnp.arange(C)
+    live = within[None, :] < counts[:, None]            # [P, C]
+    dst = jnp.where(live, offs[:, None] + within[None, :],
+                    out_cap).reshape(-1)
+    outs = []
+    for a in arrays:
+        tail = a.shape[1:]
+        g = jax.lax.all_gather(a, axis_name, axis=0)    # [P, C, ...]
+        out = jnp.zeros((out_cap,) + tail, a.dtype)
+        out = out.at[dst].set(g.reshape((P * C,) + tail), mode="drop")
+        outs.append(out)
+    num_out = jnp.minimum(total, out_cap).astype(jnp.int64)
+    return outs, num_out, total > out_cap
+
+
+def gather_to_first(
+    arrays: Sequence[jax.Array],
+    num_rows: jax.Array,
+    out_cap: int,
+    axis_name: str,
+) -> Tuple[List[jax.Array], jax.Array, jax.Array]:
+    """SINGLE-distribution gather (P4): same data movement as broadcast —
+    on TPU the cheap correct move is all_gather; the host then reads one
+    shard's copy."""
+    return broadcast_rows(arrays, num_rows, out_cap, axis_name)

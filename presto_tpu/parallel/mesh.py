@@ -1,0 +1,46 @@
+"""Device mesh construction and row-sharding helpers.
+
+The reference's analogue of a mesh is the worker set tracked by
+DiscoveryNodeManager (presto-main/.../metadata/DiscoveryNodeManager.java:68)
+plus the bucket-to-node map of NodePartitioningManager
+(sql/planner/NodePartitioningManager.java:53).  Here partitions are mesh
+shards: a 1-D ``jax.sharding.Mesh`` over the devices of a slice, with the
+row dimension of every exchange-partitioned array sharded over it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "part"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)}; tests force a "
+                "virtual CPU mesh via XLA_FLAGS=--xla_force_host_platform_"
+                "device_count")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def row_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard dim 0 (rows) over the mesh axis; replicate the rest."""
+    spec = P(mesh.axis_names[0], *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch_arrays(mesh: Mesh, arrays: Sequence[jax.Array]) -> List[jax.Array]:
+    """Place [P*C, ...] global arrays with rows sharded over the mesh."""
+    return [jax.device_put(a, row_sharding(mesh, a.ndim)) for a in arrays]

@@ -1,0 +1,183 @@
+"""Multi-chip exchange + partitioned-operator tests on a virtual 8-device
+CPU mesh (the DistributedQueryRunner-in-one-process pattern, SURVEY §4.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.parallel.exchange import broadcast_rows, repartition
+from presto_tpu.parallel.mesh import AXIS, make_mesh, row_sharding
+from presto_tpu.parallel.steps import (
+    jit_step, make_partitioned_aggregate_step, make_partitioned_join_step,
+)
+
+NDEV = 8
+CAP = 64  # per-shard row capacity
+
+
+def _mesh():
+    return make_mesh(NDEV)
+
+
+def _shard(mesh, arr):
+    return jax.device_put(jnp.asarray(arr), row_sharding(mesh, arr.ndim))
+
+
+def _make_rows(rng, total_live):
+    """Global [NDEV*CAP] arrays with ragged per-shard live counts."""
+    counts = rng.multinomial(total_live, [1 / NDEV] * NDEV)
+    counts = np.minimum(counts, CAP)
+    vals = np.zeros(NDEV * CAP, dtype=np.int64)
+    keys = np.zeros(NDEV * CAP, dtype=np.int64)
+    live_keys, live_vals = [], []
+    for s in range(NDEV):
+        n = counts[s]
+        k = rng.integers(0, 13, size=n)
+        v = rng.integers(-50, 50, size=n)
+        keys[s * CAP:s * CAP + n] = k
+        vals[s * CAP:s * CAP + n] = v
+        live_keys.append(k)
+        live_vals.append(v)
+    return (keys, vals, counts.astype(np.int64),
+            np.concatenate(live_keys), np.concatenate(live_vals))
+
+
+def test_repartition_round_trip():
+    mesh = _mesh()
+    rng = np.random.default_rng(7)
+    keys, vals, counts, live_k, live_v = _make_rows(rng, 300)
+
+    def shard_fn(k, v, n):
+        live = jnp.arange(CAP) < n[0]
+        dest = (k % NDEV).astype(jnp.int32)
+        (k2, v2), n2, of = repartition([k, v], live, dest,
+                                       slot_cap=CAP, out_cap=NDEV * CAP,
+                                       axis_name=AXIS)
+        return k2, v2, n2.reshape(1), of.reshape(1)
+
+    from jax.sharding import PartitionSpec as P
+    row = P(AXIS)
+    fn = jit_step(mesh, shard_fn, (row, row, row), (row, row, row, row))
+    k2, v2, n2, of = fn(_shard(mesh, keys), _shard(mesh, vals),
+                        _shard(mesh, counts))
+    k2, v2 = np.asarray(k2), np.asarray(v2)
+    n2, of = np.asarray(n2), np.asarray(of)
+    assert not of.any()
+    assert n2.sum() == len(live_k)
+    got = []
+    out_cap = NDEV * CAP
+    for s in range(NDEV):
+        n = n2[s]
+        ks = k2[s * out_cap:s * out_cap + n]
+        vs = v2[s * out_cap:s * out_cap + n]
+        # every row landed on its hash destination
+        assert (ks % NDEV == s).all()
+        got.append(np.stack([ks, vs], 1))
+    got = np.concatenate(got)
+    want = np.stack([live_k, live_v], 1)
+    assert (got[np.lexsort(got.T)] == want[np.lexsort(want.T)]).all()
+
+
+def test_broadcast_rows():
+    mesh = _mesh()
+    rng = np.random.default_rng(3)
+    keys, vals, counts, live_k, live_v = _make_rows(rng, 150)
+    out_cap = 512
+
+    def shard_fn(k, v, n):
+        (k2, v2), n2, of = broadcast_rows([k, v], n[0], out_cap, AXIS)
+        return k2, v2, n2.reshape(1), of.reshape(1)
+
+    from jax.sharding import PartitionSpec as P
+    row = P(AXIS)
+    fn = jit_step(mesh, shard_fn, (row, row, row), (row, row, row, row))
+    k2, v2, n2, of = fn(_shard(mesh, keys), _shard(mesh, vals),
+                        _shard(mesh, counts))
+    k2, n2 = np.asarray(k2), np.asarray(n2)
+    assert not np.asarray(of).any()
+    want = np.sort(live_k)
+    for s in range(NDEV):
+        assert n2[s] == len(live_k)
+        ks = k2[s * out_cap:s * out_cap + n2[s]]
+        assert (np.sort(ks) == want).all()
+
+
+def test_partitioned_aggregate_matches_numpy():
+    mesh = _mesh()
+    rng = np.random.default_rng(11)
+    keys, vals, counts, live_k, live_v = _make_rows(rng, 350)
+    all_true = np.ones(NDEV * CAP, bool)
+
+    shard_fn, in_specs, out_specs = make_partitioned_aggregate_step(
+        key_types=[T.BIGINT], agg_prims=["sum", "count", "min"],
+        group_cap=128, slot_cap=128, out_cap=128)
+    fn = jit_step(mesh, shard_fn, in_specs, out_specs)
+    (okv, okg, ovals, ocnts, ng, of) = fn(
+        [_shard(mesh, keys)], [_shard(mesh, all_true)],
+        [_shard(mesh, vals), _shard(mesh, vals), _shard(mesh, vals)],
+        [_shard(mesh, all_true)] * 3,
+        _shard(mesh, counts))
+    assert not np.asarray(of).any()
+    ng = np.asarray(ng)
+    kv = np.asarray(okv[0])
+    sums = np.asarray(ovals[0])
+    cnt_agg = np.asarray(ovals[1])
+    mins = np.asarray(ovals[2])
+
+    got = {}
+    for s in range(NDEV):
+        for i in range(ng[s]):
+            j = s * 128 + i
+            assert kv[j] not in got, "key landed on two shards"
+            got[kv[j]] = (sums[j], cnt_agg[j], mins[j])
+    want = {}
+    for k in np.unique(live_k):
+        sel = live_v[live_k == k]
+        want[k] = (sel.sum(), len(sel), sel.min())
+    assert got == {k: (int(a), int(b), int(c))
+                   for k, (a, b, c) in want.items()}
+
+
+@pytest.mark.parametrize("broadcast", [False, True])
+def test_partitioned_join_matches_numpy(broadcast):
+    mesh = _mesh()
+    rng = np.random.default_rng(23)
+    bk, bv, bn, blive_k, blive_v = _make_rows(rng, 120)
+    pk, pv, pn, plive_k, plive_v = _make_rows(rng, 260)
+    all_true = np.ones(NDEV * CAP, bool)
+
+    shard_fn, in_specs, out_specs = make_partitioned_join_step(
+        key_types=[T.BIGINT], n_build_payload=2, n_probe_payload=2,
+        slot_cap=256, local_cap=1024, out_cap=4096,
+        broadcast_build=broadcast)
+    fn = jit_step(mesh, shard_fn, in_specs, out_specs)
+    b_out, p_out, total, of = fn(
+        [_shard(mesh, bk)], [_shard(mesh, all_true)],
+        [_shard(mesh, bk), _shard(mesh, bv)],
+        [_shard(mesh, pk)], [_shard(mesh, all_true)],
+        [_shard(mesh, pk), _shard(mesh, pv)],
+        _shard(mesh, bn), _shard(mesh, pn))
+    assert not np.asarray(of).any()
+    total = np.asarray(total)
+    rows = []
+    for s in range(NDEV):
+        n = total[s]
+        sl = slice(s * 4096, s * 4096 + n)
+        rows.append(np.stack([np.asarray(b_out[0])[sl],
+                              np.asarray(b_out[1])[sl],
+                              np.asarray(p_out[0])[sl],
+                              np.asarray(p_out[1])[sl]], 1))
+    got = np.concatenate(rows)
+    assert (got[:, 0] == got[:, 2]).all()  # join keys equal
+
+    want = []
+    for i in range(len(blive_k)):
+        for j in range(len(plive_k)):
+            if blive_k[i] == plive_k[j]:
+                want.append((blive_k[i], blive_v[i],
+                             plive_k[j], plive_v[j]))
+    want = np.asarray(sorted(want), dtype=np.int64).reshape(-1, 4)
+    assert got.shape == want.shape
+    assert (got[np.lexsort(got.T[::-1])] == want).all()
