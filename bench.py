@@ -1,0 +1,121 @@
+"""Benchmark: TPC-H SF1 Q1 rows/sec/chip through the fused TPU pipeline.
+
+Pinned config #1 of BASELINE.md (single-table scan + grouped aggregation,
+the reference's HandTpchQuery1 / HashAggregationOperator path,
+presto-benchmark/.../HandTpchQuery1.java).  The reference publishes no
+absolute numbers (BASELINE.md), so ``vs_baseline`` compares the device
+kernel against a measured vectorized-numpy CPU implementation of the same
+query on this host — a stand-in for the reference's CPU operator pipeline
+(its Java codegen also reduces to tight CPU loops over columnar arrays).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _cpu_q1(rf, ls, qty, price, disc, tax, shipdate, n):
+    """Vectorized numpy Q1 (the CPU-engine stand-in baseline)."""
+    sel = shipdate[:n] <= 10471
+    rf, ls = rf[:n][sel], ls[:n][sel]
+    qty, price = qty[:n][sel], price[:n][sel]
+    disc, tax = disc[:n][sel], tax[:n][sel]
+    disc_price = price * (1.0 - disc)
+    charge = disc_price * (1.0 + tax)
+    key = rf.astype(np.int64) * 64 + ls
+    uniq, inv = np.unique(key, return_inverse=True)
+    out = []
+    for col in (qty, price, disc_price, charge, disc):
+        out.append(np.bincount(inv, weights=col, minlength=len(uniq)))
+    out.append(np.bincount(inv, minlength=len(uniq)))
+    return uniq, out
+
+
+def main() -> None:
+    import jax
+
+    from __graft_entry__ import _q1_arrays, q1_step
+
+    scale = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+    args = _q1_arrays(scale)
+
+    # Timing methodology (axon quirks, see memory/verify notes): (a) a
+    # device->host read switches the process into ~1s-per-call sync
+    # polling, and (b) block_until_ready under-reports on the tunnel.  So:
+    # run K dependence-chained iterations INSIDE one jitted fori_loop,
+    # materialize one scalar, and take the slope between two K values —
+    # RPC overhead and polling granularity cancel out.
+    import jax.numpy as jnp
+
+    def chained(k):
+        def body(_, carry):
+            a, acc = carry
+            out = q1_step(*a[:2], a[2] + (acc - acc).astype(a[2].dtype),
+                          *a[3:])
+            return (a, acc + out[3][0])
+        return jax.jit(lambda a: jax.lax.fori_loop(
+            0, k, body, (a, jnp.float64(0.0)))[1])
+
+    # calibrate so the k-spread contributes >> RPC jitter (~100ms)
+    f5 = chained(5)
+    np.asarray(f5(args))
+    t0 = time.perf_counter()
+    np.asarray(f5(args))
+    rough = max((time.perf_counter() - t0) / 5, 1e-5)
+    k1 = 3
+    k2 = k1 + max(20, min(2000, int(4.0 / rough)))
+    ts = []
+    for k in (k1, k2):
+        f = chained(k)
+        np.asarray(f(args))  # compile + warm (sync via host read)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(f(args))
+            best = min(best, time.perf_counter() - t0)
+        ts.append(best)
+    device_s = max((ts[1] - ts[0]) / (k2 - k1), 1e-9)
+    n = int(args[-1])
+    rows_per_sec = n / device_s
+
+    jitted = jax.jit(q1_step)
+    out = jitted(*args)
+
+    host = [np.asarray(a) for a in args[:-1]]
+    t0 = time.perf_counter()
+    cpu = _cpu_q1(*host, n)
+    cpu_s = time.perf_counter() - t0
+
+    # parity check: device sums must match the CPU oracle
+    ng = int(out[2])
+    dev_key = (np.asarray(out[0])[:ng].astype(np.int64) * 64
+               + np.asarray(out[1])[:ng])
+    order = np.argsort(dev_key)
+    ok = bool(np.array_equal(dev_key[order], cpu[0]))
+    for i, want in enumerate(cpu[1]):
+        got = np.asarray(out[3 + i])[:ng][order]
+        # MXU hi/lo-split sums carry ~1e-9 rel error (SQL float aggregation
+        # has no bit-exact ordering guarantee; the reference reorders too)
+        ok = ok and bool(np.allclose(got, want, rtol=1e-6))
+    if not ok:
+        print(json.dumps({"metric": "tpch_q1_parity_failure", "value": 0.0,
+                          "unit": "rows/s", "vs_baseline": 0.0}))
+        return
+
+    print(json.dumps({
+        "metric": f"tpch_sf{scale:g}_q1_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round((n / cpu_s) and rows_per_sec / (n / cpu_s), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,10 @@ class EngineConfig:
     scan_batch_rows: int = 65536
     # Default hash-aggregation group capacity per kernel invocation.
     group_capacity: int = 1 << 20
+    # Largest packed key domain for the gather-free direct GROUP BY path
+    # (mixed-radix ids + segment reduce; ~100x the sort path on v5e when it
+    # applies).  Above this, scatter cost grows and the sort path wins.
+    direct_groupby_max_domain: int = 1 << 12
     # Default join match-expansion capacity multiplier (output rows per
     # probe batch before chunked re-probe kicks in).
     join_expansion_factor: int = 4

@@ -54,6 +54,64 @@ class HashAggregationOperator(Operator):
         self._batches = []
         self.ctx.memory.free()
 
+    def _direct_domains(self, data: Batch) -> Optional[List[int]]:
+        """Per-key domain sizes when every key column is bounded (dictionary
+        codes / booleans) and the packed domain is small; else None."""
+        doms = []
+        for c in self.group_channels:
+            col = data.columns[c]
+            if col.dictionary is not None:
+                doms.append(len(col.dictionary))
+            elif col.type.name == "boolean":
+                doms.append(2)
+            else:
+                return None
+        total = 1
+        for d, c in zip(doms, self.group_channels):
+            total *= d + (1 if data.columns[c].valid is not None else 0)
+        if not doms or total > self.ctx.config.direct_groupby_max_domain:
+            return None
+        return doms
+
+    def _compute_direct(self, data: Batch, doms: List[int]) -> Batch:
+        """Gather-free fast path (see ops.groupby.direct_grouped_aggregate)."""
+        import jax.numpy as jnp
+
+        from presto_tpu.ops.groupby import (
+            decode_direct_keys, direct_grouped_aggregate,
+        )
+
+        key_cols = [data.columns[c] for c in self.group_channels]
+        key_codes = [(c.values, c.valid) for c in key_cols]
+        agg_ins = []
+        for a in self.aggs:
+            if a.channel is None:
+                agg_ins.append(("count", None, None))  # count(*): no values
+            else:
+                col = data.columns[a.channel]
+                agg_ins.append((a.prim, col.values, col.valid))
+        n = jnp.asarray(data.num_rows)
+        present, results = direct_grouped_aggregate(
+            key_codes, doms, agg_ins, n)
+        domain = present.shape[0]
+        slots = jnp.nonzero(present, size=domain, fill_value=0)[0]
+        num_groups = int(present.sum())
+        decoded = decode_direct_keys(
+            slots, [c.valid is not None for c in key_cols], doms)
+        cols = []
+        for src, (codes, valid) in zip(key_cols, decoded):
+            cols.append(Column(src.type, codes.astype(src.values.dtype),
+                               valid, src.dictionary))
+        for a, (values, cnt) in zip(self.aggs, results):
+            if a.prim == "count":
+                cols.append(Column(a.out_type, values[slots].astype("int64")))
+            else:
+                cols.append(Column(a.out_type,
+                                   values[slots].astype(a.out_type.np_dtype),
+                                   cnt[slots] > 0))
+        self.ctx.stats.output_rows += num_groups
+        return Batch(tuple(cols), num_groups)
+
     def _compute(self) -> Optional[Batch]:
         import jax
         import jax.numpy as jnp
@@ -64,6 +122,9 @@ class HashAggregationOperator(Operator):
                              self.ctx.config.min_batch_capacity)
         if data is None:
             return None  # grouped aggregation of zero rows -> zero rows
+        doms = self._direct_domains(data)
+        if doms is not None:
+            return self._compute_direct(data, doms)
         key_cols = [(data.columns[c].values, data.columns[c].valid,
                      data.columns[c].type) for c in self.group_channels]
         agg_ins = []

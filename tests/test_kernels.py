@@ -307,3 +307,95 @@ def test_row_hash_partitions():
     # deterministic
     h2 = row_hash([(vals, None, T.BIGINT)])
     assert np.array_equal(np.asarray(h), np.asarray(h2))
+
+
+class TestDirectGroupby:
+    """direct (mixed-radix + segment reduce) vs sort-based grouped
+    aggregation must agree, including nullable keys and fused filters."""
+
+    def _run_both(self, key_codes_np, key_valid_np, doms, vals_np,
+                  vals_valid_np, live_np, n):
+        import jax.numpy as jnp
+
+        from presto_tpu import types as T
+        from presto_tpu.ops.groupby import (
+            decode_direct_keys, direct_grouped_aggregate, grouped_aggregate,
+        )
+
+        keys = [(jnp.asarray(c), None if v is None else jnp.asarray(v))
+                for c, v in zip(key_codes_np, key_valid_np)]
+        aggs = [("sum", jnp.asarray(vals_np),
+                 None if vals_valid_np is None else jnp.asarray(vals_valid_np)),
+                ("count", jnp.asarray(vals_np), None),
+                ("min", jnp.asarray(vals_np), None),
+                ("max", jnp.asarray(vals_np), None)]
+        live = None if live_np is None else jnp.asarray(live_np)
+        present, results = direct_grouped_aggregate(
+            keys, doms, aggs, jnp.asarray(n), live_mask=live)
+        slots = jnp.nonzero(present, size=present.shape[0], fill_value=0)[0]
+        ngd = int(present.sum())
+        decoded = decode_direct_keys(
+            slots, [v is not None for v in key_valid_np], doms)
+        direct = {}
+        for i in range(ngd):
+            key = tuple(
+                None if (valid is not None and not bool(valid[i]))
+                else int(codes[i]) for codes, valid in decoded)
+            direct[key] = tuple(
+                int(np.asarray(v)[slots[i]]) for v, _ in results)
+
+        # sort path needs compacted live rows; emulate by masking via numpy
+        mask = np.ones(len(vals_np), bool) if live_np is None else live_np.copy()
+        mask &= np.arange(len(vals_np)) < n
+        idx = np.nonzero(mask)[0]
+        cap = max(1, 1 << int(np.ceil(np.log2(max(len(idx), 1)))))
+        def padc(a, fill=0):
+            out = np.full(cap, fill, dtype=np.asarray(a).dtype)
+            out[:len(idx)] = np.asarray(a)[idx]
+            return jnp.asarray(out)
+        skeys = []
+        for c, v in zip(key_codes_np, key_valid_np):
+            skeys.append((padc(c), None if v is None else padc(v, False),
+                          T.INTEGER))
+        saggs = [("sum", padc(vals_np),
+                  None if vals_valid_np is None else padc(vals_valid_np, False)),
+                 ("count", padc(vals_np), None),
+                 ("min", padc(vals_np), None),
+                 ("max", padc(vals_np), None)]
+        gi, ng, sres = grouped_aggregate(skeys, saggs, jnp.asarray(len(idx)),
+                                         cap)
+        ngs = int(ng)
+        sorted_out = {}
+        for i in range(ngs):
+            row = int(np.asarray(gi)[i])
+            key = []
+            for c, v in zip(key_codes_np, key_valid_np):
+                cc = padc(c); vv = None if v is None else padc(v, False)
+                key.append(None if (vv is not None and not bool(np.asarray(vv)[row]))
+                           else int(np.asarray(cc)[row]))
+            sorted_out[tuple(key)] = tuple(
+                int(np.asarray(v)[i]) for v, _ in sres)
+        return direct, sorted_out
+
+    def test_matches_sort_path_with_nulls_and_filter(self):
+        rng = np.random.default_rng(5)
+        n, cap = 900, 1024
+        k1 = rng.integers(0, 5, cap).astype(np.int32)
+        k1v = rng.random(cap) > 0.2
+        k2 = rng.integers(0, 3, cap).astype(np.int32)
+        vals = rng.integers(-100, 100, cap)
+        vv = rng.random(cap) > 0.1
+        live = rng.random(cap) > 0.3
+        direct, sorted_out = self._run_both(
+            [k1, k2], [k1v, None], [5, 3], vals, vv, live, n)
+        assert direct == sorted_out
+        assert len(direct) > 0
+
+    def test_null_key_forms_one_group(self):
+        k = np.zeros(8, np.int32)
+        kv = np.array([True, False, True, False] * 2)
+        vals = np.arange(8)
+        direct, sorted_out = self._run_both(
+            [k], [kv], [1], vals, None, None, 8)
+        assert direct == sorted_out
+        assert set(direct) == {(0,), (None,)}
