@@ -1,0 +1,540 @@
+"""Recursive-descent SQL parser.
+
+Covers the query shape of SqlBase.g4 that the engine executes: SELECT
+[DISTINCT] items FROM relations (comma / JOIN ... ON) WHERE ... GROUP BY
+... HAVING ... ORDER BY ... LIMIT n, WITH ctes, subqueries (FROM,
+IN/EXISTS/scalar), CASE, CAST, EXTRACT, BETWEEN, LIKE, interval & typed
+literals, EXPLAIN, SHOW TABLES/COLUMNS.  Operator precedence mirrors the
+reference grammar: OR < AND < NOT < predicate < additive < multiplicative
+< unary < primary.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from presto_tpu.sql import tree as t
+from presto_tpu.sql.lexer import SqlSyntaxError, Token, tokenize
+
+
+def parse_statement(sql: str) -> t.Node:
+    return _Parser(tokenize(sql)).parse_statement()
+
+
+def parse_expression(sql: str) -> t.Expression:
+    p = _Parser(tokenize(sql))
+    e = p.expression()
+    p.expect_eof()
+    return e
+
+
+class _Parser:
+    def __init__(self, tokens: List[Token]):
+        self.toks = tokens
+        self.pos = 0
+
+    # --- token helpers -----------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.pos + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        tok = self.toks[self.pos]
+        if tok.kind != "EOF":
+            self.pos += 1
+        return tok
+
+    def at_kw(self, *words: str) -> bool:
+        tok = self.peek()
+        return tok.kind == "KEYWORD" and tok.text in words
+
+    def at_op(self, *ops: str) -> bool:
+        tok = self.peek()
+        return tok.kind == "OP" and tok.text in ops
+
+    def accept_kw(self, *words: str) -> Optional[str]:
+        if self.at_kw(*words):
+            return self.next().text
+        return None
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        if self.at_op(*ops):
+            return self.next().text
+        return None
+
+    def expect_kw(self, word: str) -> None:
+        tok = self.next()
+        if tok.kind != "KEYWORD" or tok.text != word:
+            raise SqlSyntaxError(f"expected {word.upper()}, found "
+                                 f"{tok.text or 'end of input'!r}",
+                                 tok.line, tok.col)
+
+    def expect_op(self, op: str) -> None:
+        tok = self.next()
+        if tok.kind != "OP" or tok.text != op:
+            raise SqlSyntaxError(f"expected {op!r}, found "
+                                 f"{tok.text or 'end of input'!r}",
+                                 tok.line, tok.col)
+
+    def expect_eof(self) -> None:
+        tok = self.peek()
+        if tok.kind != "EOF":
+            raise SqlSyntaxError(f"unexpected {tok.text!r}", tok.line,
+                                 tok.col)
+
+    def identifier(self) -> str:
+        tok = self.next()
+        if tok.kind in ("IDENT", "QIDENT"):
+            return tok.text
+        # non-reserved keywords usable as identifiers
+        if tok.kind == "KEYWORD" and tok.text in (
+                "year", "month", "day", "hour", "minute", "second", "date",
+                "time", "first", "last", "tables", "columns", "show"):
+            return tok.text
+        raise SqlSyntaxError(f"expected identifier, found "
+                             f"{tok.text or 'end of input'!r}",
+                             tok.line, tok.col)
+
+    def qualified_name(self) -> Tuple[str, ...]:
+        parts = [self.identifier()]
+        while self.at_op("."):
+            self.next()
+            parts.append(self.identifier())
+        return tuple(parts)
+
+    # --- statements --------------------------------------------------------
+    def parse_statement(self) -> t.Node:
+        if self.accept_kw("explain"):
+            analyze = bool(self.accept_kw("analyze"))
+            inner = self.parse_statement()
+            return t.Explain(inner, analyze)
+        if self.accept_kw("show"):
+            if self.accept_kw("tables"):
+                node: t.Node = t.ShowTables()
+            else:
+                self.expect_kw("columns")
+                self.expect_kw("from")
+                node = t.ShowColumns(self.qualified_name())
+            self.accept_op(";")
+            self.expect_eof()
+            return node
+        q = self.query()
+        self.accept_op(";")
+        self.expect_eof()
+        return q
+
+    def query(self) -> t.Query:
+        with_queries: List[Tuple[str, t.Query]] = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.identifier()
+                self.expect_kw("as")
+                self.expect_op("(")
+                with_queries.append((name, self.query()))
+                self.expect_op(")")
+                if not self.accept_op(","):
+                    break
+        body = self.query_body()
+        return t.Query(body.select, body.relations, body.where,
+                       body.group_by, body.having, body.order_by,
+                       body.limit, body.distinct, tuple(with_queries))
+
+    def query_body(self) -> t.Query:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        self.accept_kw("all")
+        select = [self.select_item()]
+        while self.accept_op(","):
+            select.append(self.select_item())
+
+        relations: List[t.Relation] = []
+        if self.accept_kw("from"):
+            relations.append(self.relation())
+            while self.accept_op(","):
+                relations.append(self.relation())
+
+        where = self.expression() if self.accept_kw("where") else None
+
+        group_by: List[t.Expression] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.expression())
+            while self.accept_op(","):
+                group_by.append(self.expression())
+
+        having = self.expression() if self.accept_kw("having") else None
+
+        order_by: List[t.SortItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.sort_item())
+            while self.accept_op(","):
+                order_by.append(self.sort_item())
+
+        limit = None
+        if self.accept_kw("limit"):
+            tok = self.next()
+            if tok.kind != "NUMBER":
+                raise SqlSyntaxError("expected LIMIT count", tok.line,
+                                     tok.col)
+            limit = int(tok.text)
+        return t.Query(tuple(select), tuple(relations), where,
+                       tuple(group_by), having, tuple(order_by), limit,
+                       distinct)
+
+    def select_item(self) -> t.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return t.SelectItem(t.Star())
+        # t.* form
+        if (self.peek().kind in ("IDENT", "QIDENT")
+                and self.peek(1).kind == "OP" and self.peek(1).text == "."
+                and self.peek(2).kind == "OP" and self.peek(2).text == "*"):
+            name = self.identifier()
+            self.next()
+            self.next()
+            return t.SelectItem(t.Star((name,)))
+        expr = self.expression()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.identifier()
+        elif self.peek().kind in ("IDENT", "QIDENT"):
+            alias = self.identifier()
+        return t.SelectItem(expr, alias)
+
+    def sort_item(self) -> t.SortItem:
+        expr = self.expression()
+        ascending = True
+        if self.accept_kw("asc"):
+            ascending = True
+        elif self.accept_kw("desc"):
+            ascending = False
+        nulls_first = None
+        if self.accept_kw("nulls"):
+            if self.accept_kw("first"):
+                nulls_first = True
+            else:
+                self.expect_kw("last")
+                nulls_first = False
+        return t.SortItem(expr, ascending, nulls_first)
+
+    # --- relations ---------------------------------------------------------
+    def relation(self) -> t.Relation:
+        rel = self.relation_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.relation_primary()
+                rel = t.Join("cross", rel, right)
+                continue
+            kind = None
+            if self.at_kw("join"):
+                kind = "inner"
+            elif self.at_kw("inner"):
+                self.next()
+                kind = "inner"
+            elif self.at_kw("left", "right", "full"):
+                kind = self.next().text
+                self.accept_kw("outer")
+            if kind is None:
+                return rel
+            self.expect_kw("join")
+            right = self.relation_primary()
+            self.expect_kw("on")
+            on = self.expression()
+            rel = t.Join(kind, rel, right, on)
+
+    def relation_primary(self) -> t.Relation:
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                q = self.query()
+                self.expect_op(")")
+                alias, col_aliases = self._relation_alias()
+                return t.SubqueryRelation(q, alias, col_aliases)
+            rel = self.relation()
+            self.expect_op(")")
+            return rel
+        name = self.qualified_name()
+        alias, _ = self._relation_alias()
+        return t.Table(name, alias)
+
+    def _relation_alias(self):
+        alias = None
+        col_aliases: Tuple[str, ...] = ()
+        if self.accept_kw("as"):
+            alias = self.identifier()
+        elif self.peek().kind in ("IDENT", "QIDENT"):
+            alias = self.identifier()
+        if alias is not None and self.at_op("("):
+            self.next()
+            cols = [self.identifier()]
+            while self.accept_op(","):
+                cols.append(self.identifier())
+            self.expect_op(")")
+            col_aliases = tuple(cols)
+        return alias, col_aliases
+
+    # --- expressions (precedence climbing) ---------------------------------
+    def expression(self) -> t.Expression:
+        return self.or_expr()
+
+    def or_expr(self) -> t.Expression:
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = t.LogicalBinary("or", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> t.Expression:
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = t.LogicalBinary("and", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> t.Expression:
+        if self.accept_kw("not"):
+            return t.Not(self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> t.Expression:
+        left = self.additive()
+        while True:
+            if self.at_op("=", "<", "<=", ">", ">=", "<>", "!="):
+                op = self.next().text
+                if op == "!=":
+                    op = "<>"
+                right = self.additive()
+                left = t.Comparison(op, left, right)
+                continue
+            negated = False
+            save = self.pos
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("between"):
+                low = self.additive()
+                self.expect_kw("and")
+                high = self.additive()
+                left = t.Between(left, low, high, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    q = self.query()
+                    self.expect_op(")")
+                    left = t.InSubquery(left, q, negated)
+                else:
+                    items = [self.expression()]
+                    while self.accept_op(","):
+                        items.append(self.expression())
+                    self.expect_op(")")
+                    left = t.InList(left, tuple(items), negated)
+                continue
+            if self.accept_kw("like"):
+                pattern = self.additive()
+                escape = None
+                if self.accept_kw("escape"):
+                    escape = self.additive()
+                left = t.Like(left, pattern, escape, negated)
+                continue
+            if negated:
+                self.pos = save  # bare NOT belongs to not_expr
+                return left
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                left = t.IsNull(left, neg)
+                continue
+            return left
+
+    def additive(self) -> t.Expression:
+        left = self.multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().text
+                left = t.ArithmeticBinary(op, left, self.multiplicative())
+            elif self.at_op("||"):
+                self.next()
+                left = t.FunctionCall("concat",
+                                      (left, self.multiplicative()))
+            else:
+                return left
+
+    def multiplicative(self) -> t.Expression:
+        left = self.unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().text
+            left = t.ArithmeticBinary(op, left, self.unary())
+        return left
+
+    def unary(self) -> t.Expression:
+        if self.at_op("-"):
+            self.next()
+            return t.ArithmeticUnary("-", self.unary())
+        if self.at_op("+"):
+            self.next()
+            return self.unary()
+        return self.primary()
+
+    def primary(self) -> t.Expression:
+        tok = self.peek()
+        if tok.kind == "NUMBER":
+            self.next()
+            return t.NumberLiteral(tok.text)
+        if tok.kind == "STRING":
+            self.next()
+            return t.StringLiteral(tok.text)
+        if tok.kind == "OP" and tok.text == "(":
+            self.next()
+            if self.at_kw("select", "with"):
+                q = self.query()
+                self.expect_op(")")
+                return t.ScalarSubquery(q)
+            e = self.expression()
+            self.expect_op(")")
+            return e
+        if tok.kind == "KEYWORD":
+            return self._keyword_primary(tok)
+        if tok.kind in ("IDENT", "QIDENT"):
+            # function call?
+            if (self.peek(1).kind == "OP" and self.peek(1).text == "("):
+                return self.function_call(self.identifier())
+            return t.Identifier(self.qualified_name())
+        raise SqlSyntaxError(f"unexpected {tok.text or 'end of input'!r}",
+                             tok.line, tok.col)
+
+    def _keyword_primary(self, tok: Token) -> t.Expression:
+        word = tok.text
+        if word == "null":
+            self.next()
+            return t.NullLiteral()
+        if word in ("true", "false"):
+            self.next()
+            return t.BooleanLiteral(word == "true")
+        if word in ("date", "timestamp", "time"):
+            if self.peek(1).kind == "STRING":
+                self.next()
+                return t.TypedLiteral(word, self.next().text)
+            self.next()
+            return t.Identifier((word,))
+        if word == "interval":
+            self.next()
+            sign = 1
+            if self.accept_op("-"):
+                sign = -1
+            val = self.next()
+            if val.kind != "STRING":
+                raise SqlSyntaxError("expected interval string", val.line,
+                                     val.col)
+            unit_tok = self.next()
+            unit = unit_tok.text
+            if unit not in ("year", "month", "day", "hour", "minute",
+                            "second"):
+                raise SqlSyntaxError(f"bad interval unit {unit!r}",
+                                     unit_tok.line, unit_tok.col)
+            return t.IntervalLiteral(val.text, unit, sign)
+        if word == "case":
+            self.next()
+            operand = None
+            if not self.at_kw("when"):
+                operand = self.expression()
+            whens = []
+            while self.accept_kw("when"):
+                cond = self.expression()
+                self.expect_kw("then")
+                whens.append((cond, self.expression()))
+            default = self.expression() if self.accept_kw("else") else None
+            self.expect_kw("end")
+            return t.Case(operand, tuple(whens), default)
+        if word == "cast":
+            self.next()
+            self.expect_op("(")
+            e = self.expression()
+            self.expect_kw("as")
+            type_name = self.type_name()
+            self.expect_op(")")
+            return t.Cast(e, type_name)
+        if word == "extract":
+            self.next()
+            self.expect_op("(")
+            field = self.next().text
+            self.expect_kw("from")
+            e = self.expression()
+            self.expect_op(")")
+            return t.Extract(field, e)
+        if word == "coalesce":
+            self.next()
+            self.expect_op("(")
+            args = [self.expression()]
+            while self.accept_op(","):
+                args.append(self.expression())
+            self.expect_op(")")
+            return t.Coalesce(tuple(args))
+        if word == "nullif":
+            self.next()
+            self.expect_op("(")
+            first = self.expression()
+            self.expect_op(",")
+            second = self.expression()
+            self.expect_op(")")
+            return t.NullIf(first, second)
+        if word == "substring":
+            self.next()
+            self.expect_op("(")
+            e = self.expression()
+            if self.accept_kw("from"):
+                start = self.expression()
+                length = self.expression() if self.accept_kw("for") else None
+            else:
+                self.expect_op(",")
+                start = self.expression()
+                length = None
+                if self.accept_op(","):
+                    length = self.expression()
+            self.expect_op(")")
+            args = (e, start) if length is None else (e, start, length)
+            return t.FunctionCall("substr", args)
+        if word == "exists":
+            self.next()
+            self.expect_op("(")
+            q = self.query()
+            self.expect_op(")")
+            return t.Exists(q)
+        if word in ("year", "month", "day", "hour", "minute", "second",
+                    "first", "last"):
+            if self.peek(1).kind == "OP" and self.peek(1).text == "(":
+                self.next()
+                return self.function_call(word)
+            self.next()
+            return t.Identifier((word,))
+        raise SqlSyntaxError(f"unexpected keyword {word!r}", tok.line,
+                             tok.col)
+
+    def function_call(self, name: str) -> t.Expression:
+        self.expect_op("(")
+        if self.accept_op("*"):
+            self.expect_op(")")
+            return t.FunctionCall(name, (), is_star=True)
+        if self.at_op(")"):
+            self.next()
+            return t.FunctionCall(name, ())
+        distinct = bool(self.accept_kw("distinct"))
+        self.accept_kw("all")
+        args = [self.expression()]
+        while self.accept_op(","):
+            args.append(self.expression())
+        self.expect_op(")")
+        return t.FunctionCall(name, tuple(args), distinct)
+
+    def type_name(self) -> str:
+        tok = self.next()
+        if tok.kind not in ("IDENT", "KEYWORD"):
+            raise SqlSyntaxError("expected type name", tok.line, tok.col)
+        name = tok.text
+        if name == "double" and self.peek().text == "precision":
+            self.next()
+        if self.at_op("("):
+            self.next()
+            params = [self.next().text]
+            while self.accept_op(","):
+                params.append(self.next().text)
+            self.expect_op(")")
+            name = f"{name}({','.join(params)})"
+        return name

@@ -1,0 +1,236 @@
+"""Logical plan IR.
+
+Channel-based analogue of the reference's PlanNode tree
+(presto-main/.../sql/planner/plan/, 49 node types; this subset covers the
+engine's executable shapes).  Unlike the reference's symbol-based plans,
+expressions here reference *channel indices* of the child's output — the
+planner resolves names once, and optimizer rewrites remap channels
+explicitly (the HashGenerationOptimizer-style passes operate the same way).
+
+Every node carries ``columns``: the output schema as (name, Type) pairs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.expr.functions import AggSpec
+from presto_tpu.expr.ir import RowExpression
+
+Column = Tuple[str, T.Type]
+
+
+class PlanNode:
+    columns: Tuple[Column, ...]
+    sources: Tuple["PlanNode", ...] = ()
+
+    @property
+    def types(self) -> List[T.Type]:
+        return [t for _, t in self.columns]
+
+    @property
+    def names(self) -> List[str]:
+        return [n for n, _ in self.columns]
+
+
+D = dataclasses.dataclass
+
+
+@D(frozen=True)
+class TableScanNode(PlanNode):
+    """Leaf scan (TableScanNode.java analogue); ``column_names`` are the
+    connector-side names in output order."""
+
+    catalog: str
+    table: str
+    column_names: Tuple[str, ...]
+    columns: Tuple[Column, ...]
+
+
+@D(frozen=True)
+class ValuesNode(PlanNode):
+    columns: Tuple[Column, ...]
+    rows: Tuple[Tuple[object, ...], ...]
+
+
+@D(frozen=True)
+class FilterNode(PlanNode):
+    source: PlanNode
+    predicate: RowExpression
+
+    @property
+    def columns(self):  # type: ignore[override]
+        return self.source.columns
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
+class ProjectNode(PlanNode):
+    source: PlanNode
+    expressions: Tuple[RowExpression, ...]
+    columns: Tuple[Column, ...]
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
+class PlanAggregate:
+    """One aggregate: resolved spec + input channel (None = count(*))."""
+
+    spec: AggSpec
+    channel: Optional[int]
+    distinct: bool = False
+    output_name: str = ""
+
+
+@D(frozen=True)
+class AggregationNode(PlanNode):
+    source: PlanNode
+    group_channels: Tuple[int, ...]
+    aggregates: Tuple[PlanAggregate, ...]
+    columns: Tuple[Column, ...]  # group keys then aggregate results
+    step: str = "single"         # single | partial | final
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
+class JoinNode(PlanNode):
+    """Equi-join (JoinNode.java analogue).  Output = left columns then
+    right columns.  ``residual`` is evaluated over that concatenated
+    channel space against matched pairs (JoinFilterFunction role)."""
+
+    kind: str                    # inner | left | right | full | cross
+    left: PlanNode
+    right: PlanNode
+    left_keys: Tuple[int, ...]
+    right_keys: Tuple[int, ...]
+    columns: Tuple[Column, ...]
+    residual: Optional[RowExpression] = None
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.left, self.right)
+
+
+@D(frozen=True)
+class SemiJoinNode(PlanNode):
+    """Filters ``source`` rows by key membership in ``filtering``
+    (SemiJoinNode + the consuming filter, fused).  Output = source columns.
+    ``residual`` (if any) is evaluated over [source columns, filtering
+    columns] per candidate pair — the correlated-EXISTS residual."""
+
+    source: PlanNode
+    filtering: PlanNode
+    source_keys: Tuple[int, ...]
+    filtering_keys: Tuple[int, ...]
+    negated: bool = False        # NOT IN / NOT EXISTS (anti join)
+    residual: Optional[RowExpression] = None
+
+    @property
+    def columns(self):  # type: ignore[override]
+        return self.source.columns
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source, self.filtering)
+
+
+@D(frozen=True)
+class SortNode(PlanNode):
+    source: PlanNode
+    sort_keys: Tuple[Tuple[int, bool, Optional[bool]], ...]
+    # (channel, ascending, nulls_first)
+
+    @property
+    def columns(self):  # type: ignore[override]
+        return self.source.columns
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
+class LimitNode(PlanNode):
+    source: PlanNode
+    count: int
+
+    @property
+    def columns(self):  # type: ignore[override]
+        return self.source.columns
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
+class EnforceSingleRowNode(PlanNode):
+    """Scalar subquery guard (EnforceSingleRowOperator analogue): errors if
+    the source yields >1 row; yields a single all-NULL row if empty."""
+
+    source: PlanNode
+
+    @property
+    def columns(self):  # type: ignore[override]
+        return self.source.columns
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
+class OutputNode(PlanNode):
+    source: PlanNode
+    columns: Tuple[Column, ...]  # output names (possibly renamed)
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+def format_plan(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style text rendering (planPrinter role)."""
+    pad = "  " * indent
+    name = type(node).__name__.replace("Node", "")
+    detail = ""
+    if isinstance(node, TableScanNode):
+        detail = f" {node.catalog}.{node.table}"
+    elif isinstance(node, FilterNode):
+        detail = f" [{node.predicate}]"
+    elif isinstance(node, ProjectNode):
+        detail = " [" + ", ".join(map(str, node.expressions)) + "]"
+    elif isinstance(node, AggregationNode):
+        aggs = ", ".join(
+            f"{a.spec.name}(#{a.channel if a.channel is not None else '*'})"
+            + ("/distinct" if a.distinct else "")
+            for a in node.aggregates)
+        detail = f" keys={list(node.group_channels)} [{aggs}]"
+    elif isinstance(node, JoinNode):
+        detail = (f" {node.kind} on {list(node.left_keys)}="
+                  f"{list(node.right_keys)}")
+        if node.residual is not None:
+            detail += f" residual=[{node.residual}]"
+    elif isinstance(node, SemiJoinNode):
+        detail = (f" {'anti' if node.negated else 'semi'} on "
+                  f"{list(node.source_keys)}={list(node.filtering_keys)}")
+    elif isinstance(node, SortNode):
+        detail = " " + str([(c, "asc" if a else "desc")
+                            for c, a, _ in node.sort_keys])
+    elif isinstance(node, LimitNode):
+        detail = f" {node.count}"
+    out = f"{pad}{name}{detail}  => {[n for n, _ in node.columns]}\n"
+    for s in node.sources:
+        out += format_plan(s, indent + 1)
+    return out

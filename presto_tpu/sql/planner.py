@@ -1,0 +1,1044 @@
+"""Analyzer + logical planner: AST -> PlanNode tree.
+
+Combines the roles of the reference's StatementAnalyzer (scopes, name
+resolution, type checking — presto-main/.../sql/analyzer/StatementAnalyzer
+.java:243), SqlToRowExpressionTranslator (sql/relational/
+SqlToRowExpressionTranslator.java:122) and LogicalPlanner/QueryPlanner/
+SubqueryPlanner (sql/planner/LogicalPlanner.java:176, QueryPlanner.java:97,
+SubqueryPlanner.java:71) into one bottom-up pass.  Subquery handling
+mirrors the reference's decorrelation rules: uncorrelated IN -> semi join,
+correlated EXISTS -> semi/anti join on the correlation equalities (residual
+kept on the join), correlated scalar aggregate -> group-by on the
+correlation keys + inner join (TransformCorrelated* rules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.connectors.api import Connector, ConnectorRegistry
+from presto_tpu.expr import build as B
+from presto_tpu.expr.functions import (
+    FunctionError, resolve_aggregate, resolve_scalar,
+)
+from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression, SpecialForm
+from presto_tpu.sql import tree as t
+from presto_tpu.sql.plan import (
+    AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
+    OutputNode, PlanAggregate, PlanNode, ProjectNode, SemiJoinNode,
+    SortNode, TableScanNode, ValuesNode,
+)
+
+AGG_NAMES = {"count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
+             "stddev_pop", "variance", "var_samp", "var_pop"}
+
+
+class SqlAnalysisError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    qualifier: Optional[str]
+    type: T.Type
+
+
+class Scope:
+    def __init__(self, fields: Sequence[Field],
+                 parent: Optional["Scope"] = None):
+        self.fields = list(fields)
+        self.parent = parent
+
+    def try_resolve(self, parts: Tuple[str, ...]) -> Optional[int]:
+        """Channel index in THIS scope only (no parent chain)."""
+        if len(parts) == 1:
+            hits = [i for i, f in enumerate(self.fields)
+                    if f.name == parts[0]]
+        elif len(parts) == 2:
+            hits = [i for i, f in enumerate(self.fields)
+                    if f.name == parts[1] and f.qualifier == parts[0]]
+        else:
+            return None
+        if len(hits) > 1:
+            raise SqlAnalysisError(f"column {'.'.join(parts)} is ambiguous")
+        return hits[0] if hits else None
+
+    def resolves_locally(self, expr: t.Expression) -> Optional[bool]:
+        """True if every identifier in expr resolves here, False if every
+        one resolves only in the parent chain, None if mixed/unresolved."""
+        local = outer = 0
+        for ident in _identifiers(expr):
+            if self.try_resolve(ident.parts) is not None:
+                local += 1
+            elif self.parent is not None and _chain_resolves(self.parent,
+                                                            ident.parts):
+                outer += 1
+            else:
+                raise SqlAnalysisError(
+                    f"column {ident} cannot be resolved")
+        if outer == 0:
+            return True
+        if local == 0:
+            return False
+        return None
+
+
+def _chain_resolves(scope: Scope, parts) -> bool:
+    s: Optional[Scope] = scope
+    while s is not None:
+        if s.try_resolve(parts) is not None:
+            return True
+        s = s.parent
+    return False
+
+
+def _identifiers(expr: t.Node):
+    """All Identifier leaves (not descending into subqueries)."""
+    if isinstance(expr, t.Identifier):
+        yield expr
+        return
+    if isinstance(expr, (t.InSubquery, t.Exists, t.ScalarSubquery)):
+        if isinstance(expr, t.InSubquery):
+            yield from _identifiers(expr.expr)
+        return
+    for f in getattr(expr, "__dataclass_fields__", {}):
+        v = getattr(expr, f)
+        if isinstance(v, t.Node):
+            yield from _identifiers(v)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, t.Node):
+                    yield from _identifiers(item)
+                elif (isinstance(item, tuple) and len(item) == 2
+                        and isinstance(item[0], t.Node)):
+                    yield from _identifiers(item[0])
+                    yield from _identifiers(item[1])
+
+
+def _contains_subquery(expr: t.Node) -> bool:
+    if isinstance(expr, (t.InSubquery, t.Exists, t.ScalarSubquery)):
+        return True
+    for f in getattr(expr, "__dataclass_fields__", {}):
+        v = getattr(expr, f)
+        if isinstance(v, t.Node) and _contains_subquery(v):
+            return True
+        if isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, t.Node) and _contains_subquery(item):
+                    return True
+                if isinstance(item, tuple):
+                    for sub in item:
+                        if isinstance(sub, t.Node) and _contains_subquery(sub):
+                            return True
+    return False
+
+
+def _contains_aggregate(expr: t.Node) -> bool:
+    if isinstance(expr, t.FunctionCall) and expr.name in AGG_NAMES:
+        return True
+    if isinstance(expr, (t.InSubquery, t.Exists, t.ScalarSubquery)):
+        return False
+    for f in getattr(expr, "__dataclass_fields__", {}):
+        v = getattr(expr, f)
+        if isinstance(v, t.Node) and _contains_aggregate(v):
+            return True
+        if isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, t.Node) and _contains_aggregate(item):
+                    return True
+                if isinstance(item, tuple):
+                    for sub in item:
+                        if isinstance(sub, t.Node) and _contains_aggregate(sub):
+                            return True
+    return False
+
+
+def split_conjuncts(expr: Optional[t.Expression]) -> List[t.Expression]:
+    if expr is None:
+        return []
+    if isinstance(expr, t.LogicalBinary) and expr.op == "and":
+        return split_conjuncts(expr.left) + split_conjuncts(expr.right)
+    return [expr]
+
+
+# ---------------------------------------------------------------------------
+# Metadata
+# ---------------------------------------------------------------------------
+
+class Metadata:
+    """Catalog facade (Metadata.java:66 role)."""
+
+    def __init__(self, registry: ConnectorRegistry, default_catalog: str):
+        self.registry = registry
+        self.default_catalog = default_catalog
+
+    def resolve_table(self, parts: Tuple[str, ...]):
+        if len(parts) == 1:
+            catalog, table = self.default_catalog, parts[0]
+        elif len(parts) == 2:
+            catalog, table = parts
+        else:
+            catalog, table = parts[0], parts[-1]  # catalog.schema.table
+        conn = self.registry.get(catalog)
+        handle = conn.get_table(table)
+        if handle is None:
+            raise SqlAnalysisError(f"table {'.'.join(parts)} does not exist")
+        schema = conn.table_schema(handle)
+        return catalog, table, conn, schema
+
+
+# ---------------------------------------------------------------------------
+# Expression translation
+# ---------------------------------------------------------------------------
+
+class Translator:
+    """AST expression -> RowExpression over a scope's channels."""
+
+    def __init__(self, scope: Scope,
+                 grouped: Optional["GroupingContext"] = None):
+        self.scope = scope
+        self.grouped = grouped
+
+    def translate(self, expr: t.Expression) -> RowExpression:
+        if self.grouped is not None:
+            hit = self.grouped.lookup(expr)
+            if hit is not None:
+                return hit
+            if isinstance(expr, t.FunctionCall) and expr.name in AGG_NAMES:
+                raise SqlAnalysisError(
+                    f"aggregate {expr.name} not found in grouping context")
+        return self._translate(expr)
+
+    def _translate(self, e: t.Expression) -> RowExpression:
+        if isinstance(e, t.Identifier):
+            idx = self.scope.try_resolve(e.parts)
+            if idx is None:
+                if self.grouped is not None:
+                    raise SqlAnalysisError(
+                        f"column {e} must appear in GROUP BY or inside an "
+                        "aggregate")
+                raise SqlAnalysisError(f"column {e} cannot be resolved")
+            return B.ref(idx, self.scope.fields[idx].type)
+        if isinstance(e, t.NumberLiteral):
+            return _number_literal(e.text)
+        if isinstance(e, t.StringLiteral):
+            return B.const(e.value, T.VARCHAR)
+        if isinstance(e, t.BooleanLiteral):
+            return B.const(e.value, T.BOOLEAN)
+        if isinstance(e, t.NullLiteral):
+            return B.null(T.UNKNOWN)
+        if isinstance(e, t.TypedLiteral):
+            typ = T.parse_type(e.type_name)
+            return B.const(e.value, typ)
+        if isinstance(e, t.IntervalLiteral):
+            raise SqlAnalysisError(
+                "interval literal outside +/- date arithmetic")
+        if isinstance(e, t.ArithmeticBinary):
+            return self._arithmetic(e)
+        if isinstance(e, t.ArithmeticUnary):
+            arg = self.translate(e.expr)
+            if isinstance(arg, Constant) and arg.value is not None:
+                return B.const(-arg.value, arg.type)
+            return B.call("negate", arg)
+        if isinstance(e, t.Comparison):
+            return B.comparison(e.op, self.translate(e.left),
+                                self.translate(e.right))
+        if isinstance(e, t.Between):
+            v = self.translate(e.expr)
+            out = B.between(v, self.translate(e.low), self.translate(e.high))
+            return B.not_(out) if e.negated else out
+        if isinstance(e, t.InList):
+            v = self.translate(e.expr)
+            out = B.in_(v, [self.translate(i) for i in e.items])
+            return B.not_(out) if e.negated else out
+        if isinstance(e, t.Like):
+            v = self.translate(e.expr)
+            pat = self.translate(e.pattern)
+            args = (v, pat)
+            if e.escape is not None:
+                args = args + (self.translate(e.escape),)
+            out = B.call("like", *args)
+            return B.not_(out) if e.negated else out
+        if isinstance(e, t.IsNull):
+            v = self.translate(e.expr)
+            name = "is_not_null" if e.negated else "is_null"
+            return B.call(name, v)
+        if isinstance(e, t.Not):
+            return B.not_(self.translate(e.expr))
+        if isinstance(e, t.LogicalBinary):
+            fn = B.and_ if e.op == "and" else B.or_
+            return fn(self.translate(e.left), self.translate(e.right))
+        if isinstance(e, t.Case):
+            whens = []
+            for cond, val in e.whens:
+                if e.operand is not None:
+                    c = B.comparison("=", self.translate(e.operand),
+                                     self.translate(cond))
+                else:
+                    c = self.translate(cond)
+                whens.append((c, self.translate(val)))
+            default = (self.translate(e.default)
+                       if e.default is not None else None)
+            # unify result types (numeric widening)
+            rtype = _common_type(
+                [v.type for _, v in whens]
+                + ([default.type] if default is not None else []))
+            whens = [(c, _coerce(v, rtype)) for c, v in whens]
+            if default is not None:
+                default = _coerce(default, rtype)
+            return B.case_when(whens, default, rtype)
+        if isinstance(e, t.Coalesce):
+            args = [self.translate(a) for a in e.args]
+            rtype = _common_type([a.type for a in args])
+            return B.coalesce(*[_coerce(a, rtype) for a in args])
+        if isinstance(e, t.NullIf):
+            first = self.translate(e.first)
+            second = self.translate(e.second)
+            cond = B.comparison("=", first, second)
+            return B.case_when([(cond, B.null(first.type))], first,
+                               first.type)
+        if isinstance(e, t.Cast):
+            return B.cast(self.translate(e.expr), T.parse_type(e.type_name))
+        if isinstance(e, t.Extract):
+            return B.call(f"extract_{e.field.lower()}",
+                          self.translate(e.expr))
+        if isinstance(e, t.FunctionCall):
+            if e.name in AGG_NAMES:
+                raise SqlAnalysisError(
+                    f"aggregate {e.name} used outside aggregation context")
+            return B.call(e.name, *[self.translate(a) for a in e.args])
+        raise SqlAnalysisError(
+            f"unsupported expression {type(e).__name__}")
+
+    def _arithmetic(self, e: t.ArithmeticBinary) -> RowExpression:
+        # date +/- interval folds into add_days/add_months with constant
+        op_name = {"+": "add", "-": "subtract", "*": "multiply",
+                   "/": "divide", "%": "modulus"}[e.op]
+        if isinstance(e.right, t.IntervalLiteral) and e.op in "+-":
+            base = self.translate(e.left)
+            return _date_interval(base, e.right, negate=(e.op == "-"))
+        if isinstance(e.left, t.IntervalLiteral) and e.op == "+":
+            base = self.translate(e.right)
+            return _date_interval(base, e.left, negate=False)
+        return B.call(op_name, self.translate(e.left),
+                      self.translate(e.right))
+
+
+def _date_interval(base: RowExpression, iv: t.IntervalLiteral,
+                   negate: bool) -> RowExpression:
+    n = int(iv.value) * iv.sign * (-1 if negate else 1)
+    if iv.unit == "year":
+        return B.call("add_months", base, B.const(12 * n, T.INTEGER))
+    if iv.unit == "month":
+        return B.call("add_months", base, B.const(n, T.INTEGER))
+    if iv.unit == "day":
+        return B.call("add_days", base, B.const(n, T.INTEGER))
+    if base.type.name == "timestamp":
+        scale = {"hour": 3_600_000_000, "minute": 60_000_000,
+                 "second": 1_000_000}[iv.unit]
+        return B.call("add", base, B.const(n * scale, T.BIGINT))
+    raise SqlAnalysisError(f"interval unit {iv.unit} on {base.type.name}")
+
+
+def _number_literal(text: str) -> Constant:
+    if "." in text or "e" in text.lower():
+        return B.const(float(text), T.DOUBLE)
+    v = int(text)
+    if -(2 ** 31) <= v < 2 ** 31:
+        return B.const(v, T.INTEGER)
+    return B.const(v, T.BIGINT)
+
+
+_NUM_ORDER = ["tinyint", "smallint", "integer", "bigint", "real", "double"]
+
+
+def _common_type(types: List[T.Type]) -> T.Type:
+    known = [x for x in types if not isinstance(x, T.UnknownType)]
+    if not known:
+        return T.UNKNOWN
+    out = known[0]
+    for x in known[1:]:
+        if x == out:
+            continue
+        if x.name in _NUM_ORDER and out.name in _NUM_ORDER:
+            out = x if (_NUM_ORDER.index(x.name)
+                        > _NUM_ORDER.index(out.name)) else out
+        elif T.is_string(x) and T.is_string(out):
+            out = T.VARCHAR
+        elif isinstance(x, T.DecimalType) or isinstance(out, T.DecimalType):
+            out = T.DOUBLE if (x.name in _NUM_ORDER
+                               or out.name in _NUM_ORDER) else out
+        else:
+            raise SqlAnalysisError(
+                f"mismatched types {out.display()} vs {x.display()}")
+    return out
+
+
+def _coerce(expr: RowExpression, typ: T.Type) -> RowExpression:
+    if expr.type == typ or isinstance(expr.type, T.UnknownType):
+        return expr
+    return B.cast(expr, typ)
+
+
+# ---------------------------------------------------------------------------
+# Grouping context
+# ---------------------------------------------------------------------------
+
+class GroupingContext:
+    """Maps group-by ASTs and aggregate-call ASTs to agg-output channels."""
+
+    def __init__(self, group_asts: List[t.Expression],
+                 agg_asts: List[t.FunctionCall],
+                 out_fields: List[Field]):
+        self.group_asts = group_asts
+        self.agg_asts = agg_asts
+        self.out_fields = out_fields
+
+    def lookup(self, expr: t.Expression) -> Optional[RowExpression]:
+        for i, g in enumerate(self.group_asts):
+            if expr == g:
+                return B.ref(i, self.out_fields[i].type)
+        base = len(self.group_asts)
+        for j, a in enumerate(self.agg_asts):
+            if expr == a:
+                return B.ref(base + j, self.out_fields[base + j].type)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RelationPlan:
+    node: PlanNode
+    scope: Scope
+
+
+class Planner:
+    """One instance per statement (LogicalPlanner.java:176 role)."""
+
+    def __init__(self, metadata: Metadata):
+        self.metadata = metadata
+        self.ctes: List[Dict[str, t.Query]] = []
+
+    # --- entry -------------------------------------------------------------
+    def plan(self, query: t.Query) -> OutputNode:
+        rel = self.plan_query(query, None)
+        cols = tuple((f.name, f.type) for f in rel.scope.fields)
+        return OutputNode(rel.node, cols)
+
+    # --- query -------------------------------------------------------------
+    def plan_query(self, q: t.Query, outer: Optional[Scope]) -> RelationPlan:
+        if q.with_queries:
+            self.ctes.append(dict(q.with_queries))
+        try:
+            return self._plan_query_body(q, outer)
+        finally:
+            if q.with_queries:
+                self.ctes.pop()
+
+    def _plan_query_body(self, q: t.Query,
+                         outer: Optional[Scope]) -> RelationPlan:
+        # FROM
+        if q.relations:
+            rel = self.plan_relation(q.relations[0], outer)
+            for r in q.relations[1:]:
+                right = self.plan_relation(r, outer)
+                rel = self._cross_join(rel, right)
+        else:
+            rel = RelationPlan(ValuesNode((("dummy", T.BIGINT),), ((0,),)),
+                               Scope([Field("dummy", None, T.BIGINT)]))
+        rel.scope.parent = outer
+
+        # WHERE (incl. subquery conjuncts)
+        rel = self._plan_where(rel, q.where)
+
+        has_aggs = (q.group_by
+                    or any(_contains_aggregate(i.expr) for i in q.select)
+                    or (q.having is not None
+                        and _contains_aggregate(q.having)))
+        if has_aggs:
+            rel, grouping = self._plan_aggregation(rel, q)
+            # HAVING: plain conjuncts filter; subquery conjuncts transform
+            plain_h: List[t.Expression] = []
+            for c in split_conjuncts(q.having):
+                if _contains_subquery(c):
+                    rel = self._apply_subquery_conjunct(rel, c, grouping)
+                else:
+                    plain_h.append(c)
+            if plain_h:
+                htr = Translator(rel.scope, grouping)
+                rel = RelationPlan(
+                    FilterNode(rel.node,
+                               _and_all([htr.translate(c)
+                                         for c in plain_h])), rel.scope)
+            tr = Translator(rel.scope, grouping)
+        else:
+            grouping = None
+            tr = Translator(rel.scope)
+            if q.having is not None:
+                raise SqlAnalysisError("HAVING without aggregation")
+
+        # SELECT projection
+        exprs: List[RowExpression] = []
+        fields: List[Field] = []
+        item_asts: List[Optional[t.Expression]] = []
+        for item in q.select:
+            if isinstance(item.expr, t.Star):
+                for i, f in enumerate(rel.scope.fields):
+                    if (item.expr.qualifier is not None
+                            and f.qualifier != item.expr.qualifier[0]):
+                        continue
+                    exprs.append(B.ref(i, f.type))
+                    fields.append(Field(f.name, None, f.type))
+                    item_asts.append(t.Identifier((f.name,))
+                                     if f.qualifier is None else
+                                     t.Identifier((f.qualifier, f.name)))
+                continue
+            rex = tr.translate(item.expr)
+            name = item.alias or _derive_name(item.expr, len(fields))
+            exprs.append(rex)
+            fields.append(Field(name, None, rex.type))
+            item_asts.append(item.expr)
+        node = ProjectNode(rel.node, tuple(exprs),
+                           tuple((f.name, f.type) for f in fields))
+        out = RelationPlan(node, Scope(fields, outer))
+
+        if q.distinct:
+            cols = out.node.columns
+            out = RelationPlan(
+                AggregationNode(out.node,
+                                tuple(range(len(cols))), (), cols),
+                out.scope)
+
+        # ORDER BY over the output scope (alias / ordinal / select-expr)
+        if q.order_by:
+            keys = []
+            for item in q.order_by:
+                ch = self._order_channel(item.expr, q, item_asts, out.scope)
+                keys.append((ch, item.ascending, item.nulls_first))
+            out = RelationPlan(SortNode(out.node, tuple(keys)), out.scope)
+        if q.limit is not None:
+            out = RelationPlan(LimitNode(out.node, q.limit), out.scope)
+        return out
+
+    def _order_channel(self, e: t.Expression, q: t.Query,
+                       item_asts: List[Optional[t.Expression]],
+                       out_scope: Scope) -> int:
+        if isinstance(e, t.NumberLiteral) and e.text.isdigit():
+            n = int(e.text)
+            if not (1 <= n <= len(out_scope.fields)):
+                raise SqlAnalysisError(f"ORDER BY position {n} out of range")
+            return n - 1
+        if isinstance(e, t.Identifier) and len(e.parts) == 1:
+            idx = out_scope.try_resolve(e.parts)
+            if idx is not None:
+                return idx
+        for i, ast in enumerate(item_asts):
+            if ast == e:
+                return i
+        raise SqlAnalysisError(
+            f"ORDER BY expression must appear in the select list: {e}")
+
+    # --- relations ---------------------------------------------------------
+    def plan_relation(self, r: t.Relation,
+                      outer: Optional[Scope]) -> RelationPlan:
+        if isinstance(r, t.Table):
+            return self._plan_table(r, outer)
+        if isinstance(r, t.SubqueryRelation):
+            sub = self.plan_query(r.query, outer)
+            fields = []
+            for i, f in enumerate(sub.scope.fields):
+                name = (r.column_aliases[i] if i < len(r.column_aliases)
+                        else f.name)
+                fields.append(Field(name, r.alias, f.type))
+            return RelationPlan(sub.node, Scope(fields, outer))
+        if isinstance(r, t.Join):
+            return self._plan_join(r, outer)
+        raise SqlAnalysisError(f"unsupported relation {type(r).__name__}")
+
+    def _plan_table(self, r: t.Table,
+                    outer: Optional[Scope]) -> RelationPlan:
+        # CTE reference?
+        if len(r.name) == 1:
+            for frame in reversed(self.ctes):
+                if r.name[0] in frame:
+                    sub = self.plan_query(frame[r.name[0]], outer)
+                    qualifier = r.alias or r.name[0]
+                    fields = [Field(f.name, qualifier, f.type)
+                              for f in sub.scope.fields]
+                    return RelationPlan(sub.node, Scope(fields, outer))
+        catalog, table, conn, schema = self.metadata.resolve_table(r.name)
+        names = schema.column_names()
+        cols = tuple((n, schema.column_type(n)) for n in names)
+        node = TableScanNode(catalog, table, tuple(names), cols)
+        qualifier = r.alias or r.name[-1]
+        fields = [Field(n, qualifier, typ) for n, typ in cols]
+        return RelationPlan(node, Scope(fields, outer))
+
+    def _cross_join(self, left: RelationPlan,
+                    right: RelationPlan) -> RelationPlan:
+        cols = left.node.columns + right.node.columns
+        node = JoinNode("cross", left.node, right.node, (), (), cols)
+        return RelationPlan(node,
+                            Scope(left.scope.fields + right.scope.fields,
+                                  left.scope.parent))
+
+    def _plan_join(self, r: t.Join,
+                   outer: Optional[Scope]) -> RelationPlan:
+        left = self.plan_relation(r.left, outer)
+        right = self.plan_relation(r.right, outer)
+        combined = RelationPlan(
+            None,  # type: ignore[arg-type]
+            Scope(left.scope.fields + right.scope.fields, outer))
+        if r.kind == "cross" or r.on is None:
+            return self._cross_join(left, right)
+
+        nleft = len(left.scope.fields)
+        left_keys: List[int] = []
+        right_keys: List[int] = []
+        residuals: List[t.Expression] = []
+        left_only: List[t.Expression] = []
+        right_only: List[t.Expression] = []
+        lscope = Scope(left.scope.fields, None)
+        rscope = Scope(right.scope.fields, None)
+        for c in split_conjuncts(r.on):
+            side = _conjunct_side(c, lscope, rscope)
+            if side == "both" and isinstance(c, t.Comparison) and c.op == "=":
+                l_idx = _try_translate_side(c.left, lscope)
+                r_idx = _try_translate_side(c.right, rscope)
+                if l_idx is None or r_idx is None:
+                    l_idx = _try_translate_side(c.right, lscope)
+                    r_idx = _try_translate_side(c.left, rscope)
+                if l_idx is not None and r_idx is not None:
+                    left_keys.append(l_idx)
+                    right_keys.append(r_idx)
+                    continue
+            if side == "left":
+                left_only.append(c)
+            elif side == "right":
+                right_only.append(c)
+            else:
+                residuals.append(c)
+
+        # single-side conjuncts push into the inputs (safe for inner and
+        # for the preserved side's opposite input on outer joins)
+        if left_only:
+            if r.kind in ("inner", "left"):
+                left = self._filter_rel(left, left_only)
+            else:
+                residuals.extend(left_only)
+        if right_only:
+            if r.kind in ("inner", "right") or r.kind == "left":
+                # left outer: filtering the build side is ON-clause
+                # semantics (non-matching right rows just don't match)
+                right = self._filter_rel(right, right_only)
+            else:
+                residuals.extend(right_only)
+
+        cols = left.node.columns + right.node.columns
+        residual_rex = None
+        if residuals:
+            comb_tr = Translator(Scope(left.scope.fields
+                                       + right.scope.fields, None))
+            residual_rex = _and_all(
+                [comb_tr.translate(c) for c in residuals])
+        if not left_keys:
+            if r.kind != "inner":
+                raise SqlAnalysisError(
+                    f"{r.kind} join requires at least one equi condition")
+            node: PlanNode = JoinNode("cross", left.node, right.node, (), (),
+                                      cols)
+            if residual_rex is not None:
+                node = FilterNode(node, residual_rex)
+        else:
+            node = JoinNode(r.kind, left.node, right.node,
+                            tuple(left_keys), tuple(right_keys), cols,
+                            residual_rex)
+        return RelationPlan(node, combined.scope)
+
+    def _filter_rel(self, rel: RelationPlan,
+                    conjuncts: List[t.Expression]) -> RelationPlan:
+        tr = Translator(Scope(rel.scope.fields, None))
+        pred = _and_all([tr.translate(c) for c in conjuncts])
+        return RelationPlan(FilterNode(rel.node, pred), rel.scope)
+
+    # --- WHERE & subqueries ------------------------------------------------
+    def _plan_where(self, rel: RelationPlan,
+                    where: Optional[t.Expression]) -> RelationPlan:
+        plain: List[t.Expression] = []
+        for c in split_conjuncts(where):
+            if _contains_subquery(c):
+                rel = self._apply_subquery_conjunct(rel, c)
+            else:
+                plain.append(c)
+        if plain:
+            tr = Translator(rel.scope)
+            rel = RelationPlan(
+                FilterNode(rel.node, _and_all([tr.translate(c)
+                                               for c in plain])),
+                rel.scope)
+        return rel
+
+    def _apply_subquery_conjunct(
+            self, rel: RelationPlan, c: t.Expression,
+            grouping: Optional[GroupingContext] = None) -> RelationPlan:
+        negated = False
+        inner = c
+        if isinstance(inner, t.Not):
+            negated = True
+            inner = inner.expr
+        if isinstance(inner, t.InSubquery):
+            return self._plan_in_subquery(rel, inner,
+                                          negated != inner.negated)
+        if isinstance(inner, t.Exists):
+            return self._plan_exists(rel, inner.query,
+                                     negated != inner.negated)
+        if isinstance(inner, t.Comparison) and not negated:
+            if isinstance(inner.right, t.ScalarSubquery):
+                return self._plan_scalar_compare(rel, inner.op, inner.left,
+                                                 inner.right.query, grouping)
+            if isinstance(inner.left, t.ScalarSubquery):
+                flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                           "=": "=", "<>": "<>"}[inner.op]
+                return self._plan_scalar_compare(rel, flipped, inner.right,
+                                                 inner.left.query, grouping)
+        raise SqlAnalysisError(
+            f"unsupported subquery predicate {type(inner).__name__}")
+
+    def _plan_in_subquery(self, rel: RelationPlan, e: t.InSubquery,
+                          negated: bool) -> RelationPlan:
+        sub = self.plan_query(e.query, rel.scope)
+        if len(sub.scope.fields) != 1:
+            raise SqlAnalysisError("IN subquery must return one column")
+        tr = Translator(rel.scope)
+        key = tr.translate(e.expr)
+        src, key_ch = _channel_for(rel, key)
+        node = SemiJoinNode(src.node, sub.node, (key_ch,), (0,), negated)
+        return RelationPlan(node, src.scope)
+
+    def _plan_exists(self, rel: RelationPlan, q: t.Query,
+                     negated: bool) -> RelationPlan:
+        sub_from, corr_eq, corr_other = self._plan_correlated_from(rel, q)
+        if not corr_eq:
+            raise SqlAnalysisError(
+                "uncorrelated EXISTS is not supported (always true/false)")
+        outer_keys = []
+        sub_keys = []
+        tr = Translator(rel.scope)
+        src = rel
+        for sub_ch, outer_ast in corr_eq:
+            key = tr.translate(outer_ast)
+            src, ch = _channel_for(src, key)
+            tr = Translator(src.scope)
+            outer_keys.append(ch)
+            sub_keys.append(sub_ch)
+        residual = None
+        if corr_other:
+            comb = Scope(src.scope.fields + sub_from.scope.fields, None)
+            ctr = Translator(comb)
+            residual = _and_all([ctr.translate(c) for c in corr_other])
+        node = SemiJoinNode(src.node, sub_from.node, tuple(outer_keys),
+                            tuple(sub_keys), negated, residual)
+        return RelationPlan(node, src.scope)
+
+    def _plan_scalar_compare(
+            self, rel: RelationPlan, op: str, lhs: t.Expression,
+            q: t.Query,
+            grouping: Optional[GroupingContext] = None) -> RelationPlan:
+        probe = self._try_uncorrelated(q, rel)
+        if probe is not None:
+            sub = probe
+            # cross join a single row, filter, project away
+            nleft = len(rel.scope.fields)
+            single = EnforceSingleRowNode(sub.node)
+            cols = rel.node.columns + sub.node.columns
+            joined = JoinNode("cross", rel.node, single, (), (), cols)
+            scope = Scope(rel.scope.fields
+                          + [Field(f.name, "$subquery", f.type)
+                             for f in sub.scope.fields], rel.scope.parent)
+            tr = Translator(scope, grouping)
+            pred = B.comparison(op, tr.translate(lhs),
+                                B.ref(nleft, sub.scope.fields[0].type))
+            filtered = FilterNode(joined, pred)
+            keep = tuple(range(nleft))
+            proj = ProjectNode(
+                filtered,
+                tuple(B.ref(i, rel.node.columns[i][1]) for i in keep),
+                rel.node.columns)
+            return RelationPlan(proj, rel.scope)
+        # correlated scalar aggregate -> group by correlation keys + join
+        sub_from, corr_eq, corr_other = self._plan_correlated_from(rel, q)
+        if corr_other:
+            raise SqlAnalysisError(
+                "only equality correlation is supported in scalar "
+                "subqueries")
+        if not (len(q.select) == 1
+                and _contains_aggregate(q.select[0].expr)):
+            raise SqlAnalysisError(
+                "correlated scalar subquery must be a single aggregate")
+        sub_keys = [ch for ch, _ in corr_eq]
+        # aggregate the subquery over its correlation keys
+        agg_asts: List[t.FunctionCall] = []
+        _collect_aggs(q.select[0].expr, agg_asts)
+        sub_tr = Translator(sub_from.scope)
+        pre_exprs = [B.ref(ch, sub_from.scope.fields[ch].type)
+                     for ch in sub_keys]
+        aggs: List[PlanAggregate] = []
+        agg_inputs: List[RowExpression] = []
+        for a in agg_asts:
+            if a.is_star or not a.args:
+                spec = resolve_aggregate("count", None)
+                aggs.append(PlanAggregate(spec, None, a.distinct))
+                continue
+            arg = sub_tr.translate(a.args[0])
+            agg_inputs.append(arg)
+            spec = resolve_aggregate(a.name, arg.type)
+            aggs.append(PlanAggregate(spec,
+                                      len(pre_exprs) + len(agg_inputs) - 1,
+                                      a.distinct))
+        pre_cols = (tuple((f"k{i}", x.type)
+                          for i, x in enumerate(pre_exprs))
+                    + tuple((f"a{i}", x.type)
+                            for i, x in enumerate(agg_inputs)))
+        pre = ProjectNode(sub_from.node, tuple(pre_exprs + agg_inputs),
+                          pre_cols)
+        agg_cols = (tuple(pre_cols[:len(sub_keys)])
+                    + tuple((f"agg{i}", a.spec.result_type)
+                            for i, a in enumerate(aggs)))
+        agg_node = AggregationNode(pre, tuple(range(len(sub_keys))),
+                                   tuple(aggs), agg_cols)
+        # value expression over [keys..., agg results...]
+        g_fields = [Field(n, None, typ) for n, typ in agg_cols]
+        gctx = GroupingContext([], agg_asts, g_fields)
+        # shift agg channels past keys
+        gctx.group_asts = [None] * len(sub_keys)  # type: ignore[list-item]
+        val_tr = Translator(Scope(g_fields), gctx)
+        value = val_tr.translate(q.select[0].expr)
+        val_cols = agg_cols[:len(sub_keys)] + (("$value", value.type),)
+        val_proj = ProjectNode(
+            agg_node,
+            tuple(B.ref(i, agg_cols[i][1]) for i in range(len(sub_keys)))
+            + (value,),
+            val_cols)
+        # join outer on correlation keys
+        outer_keys = []
+        src = rel
+        tr = Translator(src.scope)
+        for _, outer_ast in corr_eq:
+            key = tr.translate(outer_ast)
+            src, ch = _channel_for(src, key)
+            tr = Translator(src.scope)
+            outer_keys.append(ch)
+        nleft = len(src.scope.fields)
+        cols = src.node.columns + val_cols
+        joined = JoinNode("inner", src.node, val_proj, tuple(outer_keys),
+                          tuple(range(len(sub_keys))), cols)
+        jscope = Scope(src.scope.fields
+                       + [Field(n, "$subquery", typ) for n, typ in val_cols],
+                       src.scope.parent)
+        jtr = Translator(jscope)
+        pred = B.comparison(op, jtr.translate(lhs),
+                            B.ref(nleft + len(sub_keys), value.type))
+        filtered = FilterNode(joined, pred)
+        keep = tuple(range(len(rel.scope.fields)))
+        proj = ProjectNode(
+            filtered,
+            tuple(B.ref(i, src.node.columns[i][1]) for i in keep),
+            tuple(src.node.columns[i] for i in keep))
+        return RelationPlan(proj, rel.scope)
+
+    def _try_uncorrelated(self, q: t.Query,
+                          rel: RelationPlan) -> Optional[RelationPlan]:
+        """Plan q with NO outer scope; None if it references the outer."""
+        try:
+            return self.plan_query(q, None)
+        except SqlAnalysisError:
+            return None
+
+    def _plan_correlated_from(self, rel: RelationPlan, q: t.Query):
+        """Plan a correlated subquery's FROM + local WHERE; classify
+        correlated conjuncts.
+
+        Returns (sub_plan, corr_eq, corr_other) where corr_eq is a list of
+        (sub_channel, outer_ast) equality pairs and corr_other the AST
+        conjuncts mixing both sides (to become join/semi residuals,
+        translated over [outer fields + sub fields])."""
+        if q.group_by or q.order_by or q.limit or q.distinct:
+            raise SqlAnalysisError(
+                "unsupported correlated subquery shape")
+        sub = (self.plan_relation(q.relations[0], rel.scope)
+               if q.relations else None)
+        for r in (q.relations[1:] if q.relations else ()):
+            sub = self._cross_join(sub, self.plan_relation(r, rel.scope))
+        if sub is None:
+            raise SqlAnalysisError("correlated subquery requires FROM")
+        sub.scope.parent = rel.scope
+
+        local: List[t.Expression] = []
+        corr_eq: List[Tuple[int, t.Expression]] = []
+        corr_other: List[t.Expression] = []
+        sub_scope_only = Scope(sub.scope.fields, None)
+        for c in split_conjuncts(q.where):
+            if _contains_subquery(c):
+                # nested subquery inside a correlated subquery: plan it
+                # against the sub scope
+                sub = self._apply_subquery_conjunct(sub, c)
+                sub_scope_only = Scope(sub.scope.fields, None)
+                continue
+            locality = Scope(sub.scope.fields,
+                             rel.scope).resolves_locally(c)
+            if locality is True:
+                local.append(c)
+                continue
+            if (isinstance(c, t.Comparison) and c.op == "="):
+                sub_ch = _try_translate_side(c.left, sub_scope_only)
+                outer_ast = c.right
+                if sub_ch is None:
+                    sub_ch = _try_translate_side(c.right, sub_scope_only)
+                    outer_ast = c.left
+                outer_ok = (Scope([], rel.scope).resolves_locally(outer_ast)
+                            is False) if sub_ch is not None else False
+                if sub_ch is not None and outer_ok:
+                    corr_eq.append((sub_ch, outer_ast))
+                    continue
+            corr_other.append(c)
+        if local:
+            tr = Translator(sub_scope_only)
+            sub = RelationPlan(
+                FilterNode(sub.node,
+                           _and_all([tr.translate(c) for c in local])),
+                sub.scope)
+        return sub, corr_eq, corr_other
+
+    # --- aggregation -------------------------------------------------------
+    def _plan_aggregation(self, rel: RelationPlan, q: t.Query):
+        scope = rel.scope
+        tr = Translator(scope)
+        # group expressions (support ordinals into the select list)
+        group_asts: List[t.Expression] = []
+        for g in q.group_by:
+            if isinstance(g, t.NumberLiteral) and g.text.isdigit():
+                item = q.select[int(g.text) - 1]
+                group_asts.append(item.expr)
+            else:
+                group_asts.append(g)
+        group_rex = [tr.translate(g) for g in group_asts]
+
+        agg_asts: List[t.FunctionCall] = []
+        for item in q.select:
+            _collect_aggs(item.expr, agg_asts)
+        if q.having is not None:
+            _collect_aggs(q.having, agg_asts)
+        for s in q.order_by:
+            _collect_aggs(s.expr, agg_asts)
+
+        pre_exprs: List[RowExpression] = list(group_rex)
+        aggs: List[PlanAggregate] = []
+        for a in agg_asts:
+            if a.is_star or not a.args:
+                spec = resolve_aggregate("count", None)
+                aggs.append(PlanAggregate(spec, None, a.distinct))
+                continue
+            arg = tr.translate(a.args[0])
+            spec = resolve_aggregate(a.name, arg.type)
+            aggs.append(PlanAggregate(spec, len(pre_exprs), a.distinct))
+            pre_exprs.append(arg)
+        pre_cols = tuple((f"c{i}", x.type) for i, x in enumerate(pre_exprs))
+        pre = ProjectNode(rel.node, tuple(pre_exprs), pre_cols)
+        out_cols = (tuple((f"g{i}", x.type)
+                          for i, x in enumerate(group_rex))
+                    + tuple((f"agg{i}", a.spec.result_type)
+                            for i, a in enumerate(aggs)))
+        node = AggregationNode(pre, tuple(range(len(group_rex))),
+                               tuple(aggs), out_cols)
+        out_fields = [Field(n, None, typ) for n, typ in out_cols]
+        grouping = GroupingContext(group_asts, agg_asts, out_fields)
+        out = RelationPlan(node, Scope(out_fields, scope.parent))
+        # HAVING is handled by the caller (it may contain subqueries); the
+        # grouped translator resolves via GroupingContext.lookup, so scope
+        # names stay synthetic
+        return out, grouping
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _collect_aggs(e: t.Node, out: List[t.FunctionCall]):
+    if isinstance(e, t.FunctionCall) and e.name in AGG_NAMES:
+        if e not in out:
+            out.append(e)
+        return
+    if isinstance(e, (t.InSubquery, t.Exists, t.ScalarSubquery)):
+        return
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, t.Node):
+            _collect_aggs(v, out)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, t.Node):
+                    _collect_aggs(item, out)
+                elif isinstance(item, tuple):
+                    for sub in item:
+                        if isinstance(sub, t.Node):
+                            _collect_aggs(sub, out)
+
+
+def _conjunct_side(c: t.Expression, lscope: Scope, rscope: Scope) -> str:
+    sides = set()
+    for ident in _identifiers(c):
+        if lscope.try_resolve(ident.parts) is not None:
+            sides.add("left")
+        elif rscope.try_resolve(ident.parts) is not None:
+            sides.add("right")
+        else:
+            raise SqlAnalysisError(f"column {ident} cannot be resolved "
+                                   "in join condition")
+    if sides == {"left"}:
+        return "left"
+    if sides == {"right"}:
+        return "right"
+    return "both"
+
+
+def _try_translate_side(e: t.Expression, scope: Scope) -> Optional[int]:
+    """Channel index if e is a bare column of this scope, else None."""
+    if isinstance(e, t.Identifier):
+        return scope.try_resolve(e.parts)
+    return None
+
+
+def _channel_for(rel: RelationPlan, key: RowExpression):
+    """Ensure ``key`` is available as a bare channel, appending a
+    projection when it is computed."""
+    if isinstance(key, InputRef):
+        return rel, key.index
+    n = len(rel.node.columns)
+    exprs = tuple(B.ref(i, typ) for i, (_, typ) in
+                  enumerate(rel.node.columns)) + (key,)
+    cols = rel.node.columns + (("$key", key.type),)
+    node = ProjectNode(rel.node, exprs, cols)
+    scope = Scope(rel.scope.fields + [Field("$key", None, key.type)],
+                  rel.scope.parent)
+    return RelationPlan(node, scope), n
+
+
+def _and_all(exprs: List[RowExpression]) -> RowExpression:
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = B.and_(out, e)
+    return out
+
+
+def _derive_name(e: t.Expression, idx: int) -> str:
+    if isinstance(e, t.Identifier):
+        return e.parts[-1]
+    if isinstance(e, t.FunctionCall):
+        return e.name
+    return f"_col{idx}"

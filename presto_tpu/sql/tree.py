@@ -1,0 +1,267 @@
+"""SQL AST.
+
+Compact dataclass analogue of the reference's ~170 node classes under
+presto-parser/src/main/java/io/prestosql/sql/tree/ — one node kind per
+grammar production the engine supports.  Positions are (line, col) for
+error messages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+D = dataclasses.dataclass
+
+
+class Node:
+    pass
+
+
+class Expression(Node):
+    pass
+
+
+# --- literals / terms ------------------------------------------------------
+
+@D(frozen=True)
+class Identifier(Expression):
+    parts: Tuple[str, ...]  # a.b.c; lowercased unless quoted
+
+    def __str__(self):
+        return ".".join(self.parts)
+
+
+@D(frozen=True)
+class NumberLiteral(Expression):
+    text: str  # original text; analyzer decides integer/decimal/double
+
+
+@D(frozen=True)
+class StringLiteral(Expression):
+    value: str
+
+
+@D(frozen=True)
+class BooleanLiteral(Expression):
+    value: bool
+
+
+@D(frozen=True)
+class NullLiteral(Expression):
+    pass
+
+
+@D(frozen=True)
+class TypedLiteral(Expression):
+    """DATE 'x' / TIMESTAMP 'x' / DECIMAL 'x' / CHAR 'x'."""
+
+    type_name: str
+    value: str
+
+
+@D(frozen=True)
+class IntervalLiteral(Expression):
+    value: str
+    unit: str       # year|month|day|hour|minute|second
+    sign: int = 1
+
+
+@D(frozen=True)
+class Star(Expression):
+    qualifier: Optional[Tuple[str, ...]] = None  # t.* qualifier
+
+
+@D(frozen=True)
+class Parameter(Expression):
+    index: int
+
+
+# --- compound expressions --------------------------------------------------
+
+@D(frozen=True)
+class FunctionCall(Expression):
+    name: str
+    args: Tuple[Expression, ...]
+    distinct: bool = False           # count(DISTINCT x)
+    is_star: bool = False            # count(*)
+
+
+@D(frozen=True)
+class Cast(Expression):
+    expr: Expression
+    type_name: str                   # e.g. "double", "decimal(12,2)"
+
+
+@D(frozen=True)
+class Extract(Expression):
+    field: str                       # year|month|day|...
+    expr: Expression
+
+
+@D(frozen=True)
+class ArithmeticBinary(Expression):
+    op: str                          # + - * / %
+    left: Expression
+    right: Expression
+
+
+@D(frozen=True)
+class ArithmeticUnary(Expression):
+    op: str                          # -
+    expr: Expression
+
+
+@D(frozen=True)
+class Comparison(Expression):
+    op: str                          # = != <> < <= > >=
+    left: Expression
+    right: Expression
+
+
+@D(frozen=True)
+class Between(Expression):
+    expr: Expression
+    low: Expression
+    high: Expression
+    negated: bool = False
+
+
+@D(frozen=True)
+class InList(Expression):
+    expr: Expression
+    items: Tuple[Expression, ...]
+    negated: bool = False
+
+
+@D(frozen=True)
+class InSubquery(Expression):
+    expr: Expression
+    query: "Query"
+    negated: bool = False
+
+
+@D(frozen=True)
+class Exists(Expression):
+    query: "Query"
+    negated: bool = False
+
+
+@D(frozen=True)
+class ScalarSubquery(Expression):
+    query: "Query"
+
+
+@D(frozen=True)
+class Like(Expression):
+    expr: Expression
+    pattern: Expression
+    escape: Optional[Expression] = None
+    negated: bool = False
+
+
+@D(frozen=True)
+class IsNull(Expression):
+    expr: Expression
+    negated: bool = False
+
+
+@D(frozen=True)
+class Not(Expression):
+    expr: Expression
+
+
+@D(frozen=True)
+class LogicalBinary(Expression):
+    op: str                          # and|or
+    left: Expression
+    right: Expression
+
+
+@D(frozen=True)
+class Case(Expression):
+    operand: Optional[Expression]    # CASE x WHEN ... vs CASE WHEN ...
+    whens: Tuple[Tuple[Expression, Expression], ...]
+    default: Optional[Expression]
+
+
+@D(frozen=True)
+class Coalesce(Expression):
+    args: Tuple[Expression, ...]
+
+
+@D(frozen=True)
+class NullIf(Expression):
+    first: Expression
+    second: Expression
+
+
+# --- relations -------------------------------------------------------------
+
+class Relation(Node):
+    pass
+
+
+@D(frozen=True)
+class Table(Relation):
+    name: Tuple[str, ...]            # [catalog.][schema.]table
+    alias: Optional[str] = None
+
+
+@D(frozen=True)
+class SubqueryRelation(Relation):
+    query: "Query"
+    alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
+
+
+@D(frozen=True)
+class Join(Relation):
+    kind: str                        # inner|left|right|full|cross
+    left: Relation
+    right: Relation
+    on: Optional[Expression] = None
+
+
+# --- query -----------------------------------------------------------------
+
+@D(frozen=True)
+class SelectItem(Node):
+    expr: Expression
+    alias: Optional[str] = None
+
+
+@D(frozen=True)
+class SortItem(Node):
+    expr: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@D(frozen=True)
+class Query(Node):
+    select: Tuple[SelectItem, ...]
+    relations: Tuple[Relation, ...]  # FROM a, b, c (implicit cross joins)
+    where: Optional[Expression] = None
+    group_by: Tuple[Expression, ...] = ()
+    having: Optional[Expression] = None
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    distinct: bool = False
+    with_queries: Tuple[Tuple[str, "Query"], ...] = ()
+
+
+@D(frozen=True)
+class Explain(Node):
+    statement: Node
+    analyze: bool = False
+
+
+@D(frozen=True)
+class ShowTables(Node):
+    pass
+
+
+@D(frozen=True)
+class ShowColumns(Node):
+    table: Tuple[str, ...]
