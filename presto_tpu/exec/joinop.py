@@ -213,6 +213,21 @@ class LookupJoinOperator(Operator):
         if out is not None and out.num_rows > 0:
             self._out.append(out)
 
+    def _residual_compiled(self, batch: Batch, src: LookupSource):
+        """Compile the residual over [probe channels..., build channels...]
+        (JoinFilterFunctionCompiler role)."""
+        if self.f.residual is None:
+            return None
+        from presto_tpu.expr.compile import ExprCompiler
+
+        nprobe = batch.num_columns
+        dicts = {i: c.dictionary for i, c in enumerate(batch.columns)
+                 if c.dictionary is not None}
+        for j, c in enumerate(src.data.columns):
+            if c.dictionary is not None:
+                dicts[nprobe + j] = c.dictionary
+        return ExprCompiler(dicts).compile(self.f.residual)
+
     def _probe_streaming(self, src: LookupSource, batch: Batch) -> Optional[Batch]:
         import jax
         import jax.numpy as jnp
@@ -222,18 +237,19 @@ class LookupJoinOperator(Operator):
         join_type = self.f.join_type
         cap = batch.capacity
         n = jnp.asarray(batch.num_rows)
-        if join_type in ("semi", "anti"):
+        if join_type in ("semi", "anti") and self.f.residual is None:
             out_cap = cap
         else:
             out_cap = next_bucket(cap * self.f.expansion)
+        cres = self._residual_compiled(batch, src)
         while True:
-            kernel = self._kernel(src, cap, out_cap)
-            outs, count = kernel(tuple(column_pairs(batch)),
-                                 tuple(column_pairs(src.data)), n)
+            kernel = self._kernel(src, cap, out_cap, cres)
+            outs, count, expand_total = kernel(
+                tuple(column_pairs(batch)), tuple(column_pairs(src.data)), n)
             total = int(count)
-            if total <= out_cap:
+            if int(expand_total) <= out_cap:
                 break
-            out_cap = next_bucket(total)
+            out_cap = next_bucket(int(expand_total))
         cols = []
         probe_cols = [batch.columns[i] for i in range(batch.num_columns)]
         if join_type in ("semi", "anti"):
@@ -249,7 +265,8 @@ class LookupJoinOperator(Operator):
         self.ctx.stats.output_rows += out.num_rows
         return out
 
-    def _kernel(self, src: LookupSource, cap: int, out_cap: int):
+    def _kernel(self, src: LookupSource, cap: int, out_cap: int,
+                cres=None):
         import jax
         import jax.numpy as jnp
 
@@ -262,26 +279,49 @@ class LookupJoinOperator(Operator):
             return hit
         join_type = self.f.join_type
         probe_op = self
+        residual = None if cres is None else cres.run
 
         def kernel(probe_cols_pairs, build_cols_pairs, num_rows):
             pb = _RebuiltBatch(probe_cols_pairs)
             ids = probe_op._probe_ids(jnp, src, pb, num_rows)
             lo, counts = J.probe_counts(src.sorted_ids, src.perm, ids)
             live = ids >= 0
+            zero = jnp.int64(0)
             if join_type in ("semi", "anti"):
-                mask = J.semi_mask(counts, live, anti=(join_type == "anti"))
-                # anti join must also keep live=false? dead rows from
-                # padding excluded; null-key rows: SQL anti (NOT EXISTS)
-                # keeps them:
-                if join_type == "anti":
-                    pad = jnp.arange(cap) >= num_rows
-                    nullkey = (~live) & (~pad)
-                    mask = mask | nullkey
-                idx, count = selected_positions(mask, None, num_rows, out_cap)
+                if residual is not None:
+                    pi, bi, rv, _, etotal = J.expand_matches(
+                        lo, counts, src.perm, out_cap)
+                    pairs = tuple(
+                        (v[pi], None if g is None else g[pi])
+                        for v, g in probe_cols_pairs) + tuple(
+                        (v[bi], None if g is None else g[bi])
+                        for v, g in build_cols_pairs)
+                    rmask, rvalid = residual(pairs, etotal, jnp)
+                    ok = rv & rmask
+                    if rvalid is not None:
+                        ok = ok & rvalid
+                    any_pass = jnp.zeros(cap, bool).at[pi].max(
+                        ok, mode="drop")
+                    mask = live & any_pass
+                    if join_type == "anti":
+                        pad = jnp.arange(cap) >= num_rows
+                        mask = (live & ~any_pass) | ((~live) & (~pad))
+                else:
+                    etotal = zero
+                    mask = J.semi_mask(counts, live,
+                                       anti=(join_type == "anti"))
+                    # null-key rows: SQL anti (NOT EXISTS) keeps them:
+                    if join_type == "anti":
+                        pad = jnp.arange(cap) >= num_rows
+                        nullkey = (~live) & (~pad)
+                        mask = mask | nullkey
+                idx, count = selected_positions(mask, None, num_rows,
+                                                cap)
+                idx = idx.astype(jnp.int32)
                 outs = tuple(
                     (v[idx], None if valid is None else valid[idx])
                     for v, valid in probe_cols_pairs)
-                return outs, count
+                return outs, count, etotal
             if join_type == "left":
                 # every real probe row emits >=1 row (null-key rows emit the
                 # unmatched form); padding rows emit nothing
@@ -291,6 +331,8 @@ class LookupJoinOperator(Operator):
             else:
                 pi, bi, rv, unmatched, total = J.expand_matches(
                     lo, counts, src.perm, out_cap)
+            pi = pi.astype(jnp.int32)
+            bi = bi.astype(jnp.int32)
             outs = []
             for v, valid in probe_cols_pairs:
                 outs.append((v[pi], None if valid is None else valid[pi]))
@@ -299,7 +341,7 @@ class LookupJoinOperator(Operator):
                 bvalid = ones if valid is None else valid[bi]
                 bvalid = bvalid & ~unmatched
                 outs.append((v[bi], bvalid))
-            return tuple(outs), total
+            return tuple(outs), total, total
 
         jitted = jax.jit(kernel)
         self._kernels[key] = jitted
@@ -331,7 +373,31 @@ class LookupJoinOperator(Operator):
         n = jnp.asarray(probe.num_rows)
         join_type = self.f.join_type
         if join_type in ("semi", "anti"):
-            mask = J.semi_mask(counts, live, anti=(join_type == "anti"))
+            cres = self._residual_compiled(probe, src)
+            if cres is None:
+                mask = J.semi_mask(counts, live, anti=(join_type == "anti"))
+            else:
+                out_cap = next_bucket(cap * self.f.expansion)
+                while True:
+                    pi, bi, rv, _, etotal = J.expand_matches(
+                        lo, counts, perm, out_cap)
+                    if int(etotal) <= out_cap:
+                        break
+                    out_cap = next_bucket(int(etotal))
+                pi = pi.astype(jnp.int32)
+                bi = bi.astype(jnp.int32)
+                pairs = tuple(
+                    (c.values[pi], None if c.valid is None else c.valid[pi])
+                    for c in probe.columns) + tuple(
+                    (c.values[bi], None if c.valid is None else c.valid[bi])
+                    for c in src.data.columns)
+                rmask, rvalid = cres.run(pairs, etotal, jnp)
+                ok = rv & rmask
+                if rvalid is not None:
+                    ok = ok & rvalid
+                any_pass = jnp.zeros(cap, bool).at[pi].max(ok, mode="drop")
+                mask = (live & ~any_pass if join_type == "anti"
+                        else live & any_pass)
             if join_type == "anti":
                 pad = jnp.arange(cap) >= n
                 mask = mask | ((~live) & (~pad))
@@ -404,13 +470,21 @@ class LookupJoinOperatorFactory(OperatorFactory):
     def __init__(self, build: HashBuildOperatorFactory,
                  probe_key_channels: Sequence[int],
                  probe_types: Sequence[T.Type],
-                 join_type: str = "inner", expansion: int = 2):
+                 join_type: str = "inner", expansion: int = 2,
+                 residual=None):
         assert join_type in ("inner", "left", "semi", "anti")
+        if residual is not None and join_type not in ("semi", "anti"):
+            # inner-join residuals become post-join filters in the
+            # optimizer; outer-join residuals are pushed into the build
+            # input (planner) — only semi/anti need in-kernel residuals
+            raise NotImplementedError(
+                "residual filters only on semi/anti joins")
         self.build = build
         self.probe_key_channels = list(probe_key_channels)
         self.probe_types = list(probe_types)
         self.join_type = join_type
         self.expansion = expansion
+        self.residual = residual
 
     def create(self, ctx: OperatorContext) -> LookupJoinOperator:
         return LookupJoinOperator(ctx, self)

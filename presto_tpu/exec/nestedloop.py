@@ -1,0 +1,172 @@
+"""Cross join + scalar-subquery guard operators.
+
+Reference models: NestedLoopJoinOperator/NestedLoopBuildOperator
+(presto-main/.../operator/NestedLoopJoinOperator.java:36) and
+EnforceSingleRowOperator (EnforceSingleRowOperator.java:27).  The dominant
+use here is the scalar-subquery shape the planner emits (EnforceSingleRow
+-> cross join of exactly one row), so the product kernel is optimized for
+a small build side: probe rows are tiled ``n_build`` times per chunk with
+plain gathers — no keys, no sort.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, next_bucket
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import Operator, OperatorFactory, device_concat
+
+
+class NestedLoopBuildOperator(Operator):
+    """Materializes the build side into the shared holder."""
+
+    def __init__(self, ctx: OperatorContext,
+                 factory: "NestedLoopBuildOperatorFactory"):
+        super().__init__(ctx)
+        self.f = factory
+        self._batches: List[Batch] = []
+
+    def add_input(self, batch: Batch) -> None:
+        self._batches.append(batch)
+        self.ctx.stats.input_rows += batch.num_rows
+        self.ctx.memory.reserve(batch.size_bytes)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        data = device_concat(self._batches, 1)
+        if data is None:
+            from presto_tpu.batch import empty_batch
+
+            data = empty_batch(self.f.input_types)
+        self.f.data = data
+        self._batches = []
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class NestedLoopBuildOperatorFactory(OperatorFactory):
+    def __init__(self, input_types: Sequence[T.Type]):
+        self.input_types = list(input_types)
+        self.data: Optional[Batch] = None
+
+    def create(self, ctx: OperatorContext) -> NestedLoopBuildOperator:
+        return NestedLoopBuildOperator(ctx, self)
+
+
+class NestedLoopJoinOperator(Operator):
+    """Probe side: emits the cartesian product probe x build.  Output
+    layout matches LookupJoinOperator: probe channels then build
+    channels."""
+
+    def __init__(self, ctx: OperatorContext,
+                 build: NestedLoopBuildOperatorFactory,
+                 max_output_rows: int):
+        super().__init__(ctx)
+        self.build = build
+        self.max_output_rows = max_output_rows
+        self._out: List[Batch] = []
+
+    def add_input(self, batch: Batch) -> None:
+        import jax.numpy as jnp
+
+        self.ctx.stats.input_rows += batch.num_rows
+        build = self.build.data
+        if build is None:
+            raise RuntimeError("cross-join build side not finished")
+        nb = build.num_rows
+        if nb == 0 or batch.num_rows == 0:
+            return
+        npr = batch.num_rows
+        # chunk the build side so each product batch stays bounded
+        chunk = max(1, self.max_output_rows // max(batch.capacity, 1))
+        for lo in range(0, nb, chunk):
+            k = min(chunk, nb - lo)
+            cap_out = next_bucket(batch.capacity * k)
+            j = jnp.arange(cap_out)
+            pi = (j // k).astype(jnp.int32)
+            pi = jnp.minimum(pi, batch.capacity - 1)
+            bi = (lo + (j % k)).astype(jnp.int32)
+            total = npr * k
+            cols = []
+            for c in batch.columns:
+                cols.append(Column(c.type, c.values[pi],
+                                   None if c.valid is None else c.valid[pi],
+                                   c.dictionary))
+            for c in build.columns:
+                cols.append(Column(c.type, c.values[bi],
+                                   None if c.valid is None else c.valid[bi],
+                                   c.dictionary))
+            out = Batch(tuple(cols), total)
+            self.ctx.stats.output_rows += total
+            self._out.append(out)
+
+    def get_output(self) -> Optional[Batch]:
+        if self._out:
+            return self._out.pop(0)
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._out
+
+
+class NestedLoopJoinOperatorFactory(OperatorFactory):
+    def __init__(self, build: NestedLoopBuildOperatorFactory,
+                 max_output_rows: int = 1 << 22):
+        self.build = build
+        self.max_output_rows = max_output_rows
+
+    def create(self, ctx: OperatorContext) -> NestedLoopJoinOperator:
+        return NestedLoopJoinOperator(ctx, self.build, self.max_output_rows)
+
+
+class EnforceSingleRowOperator(Operator):
+    """Scalar subqueries must yield exactly one row; zero rows yield one
+    all-NULL row (SQL scalar subquery semantics)."""
+
+    def __init__(self, ctx: OperatorContext, types: Sequence[T.Type]):
+        super().__init__(ctx)
+        self.types = list(types)
+        self._rows = 0
+        self._batches: List[Batch] = []
+        self._emitted = False
+
+    def add_input(self, batch: Batch) -> None:
+        self._rows += batch.num_rows
+        if self._rows > 1:
+            raise RuntimeError(
+                "scalar subquery returned more than one row")
+        self._batches.append(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        if self._rows == 1:
+            return self._batches[0]
+        # zero rows -> one all-NULL row
+        cols = []
+        for typ in self.types:
+            values = np.zeros(1, dtype=typ.np_dtype)
+            cols.append(Column(typ, values, np.zeros(1, bool)))
+        return Batch(tuple(cols), 1)
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class EnforceSingleRowOperatorFactory(OperatorFactory):
+    def __init__(self, types: Sequence[T.Type]):
+        self.types = list(types)
+
+    def create(self, ctx: OperatorContext) -> EnforceSingleRowOperator:
+        return EnforceSingleRowOperator(ctx, self.types)

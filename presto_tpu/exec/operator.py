@@ -83,6 +83,20 @@ class SourceOperator(Operator):
 # Device-batch helpers
 # ---------------------------------------------------------------------------
 
+def rebucket(batch: Batch, min_capacity: int = 1024) -> Batch:
+    """Re-pad a sparsely occupied batch down to its capacity bucket.
+
+    Expansion-sized join/filter outputs otherwise amplify capacity
+    multiplicatively down an operator chain (126 live rows riding a
+    67M-row padded batch after 5 joins); two static-shape device copies
+    (slice + zero-pad) reset the invariant.
+    """
+    cap = next_bucket(batch.num_rows, min_capacity)
+    if batch.capacity <= cap:
+        return batch
+    return batch.head(batch.num_rows).pad_rows(cap)
+
+
 def pad_batch(batch: Batch, min_capacity: int = 1024) -> Batch:
     """Pad to the power-of-two bucket and move to device."""
     cap = next_bucket(batch.num_rows, min_capacity)

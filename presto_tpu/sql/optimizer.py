@@ -1,0 +1,481 @@
+"""Logical plan optimizer.
+
+The reference runs ~40 ordered passes (PlanOptimizers.java:160) with 113
+iterative rules; this module implements the subset that changes the game
+for the executable query shapes, in the same spirit:
+
+- ``extract_joins``: Filter-over-cross-join -> equi-join tree with pushed
+  single-relation predicates and residual placement (the PredicatePushDown
+  + join-graph part of the reference's AddExchanges preparation).  Join
+  order is greedy over the connectivity graph, probe side = largest
+  estimated relation (the DetermineJoinDistributionType/ReorderJoins
+  stand-in until a real CBO lands).
+- ``prune_columns``: unreferenced-output elimination down to the scans
+  (PruneUnreferencedOutputs + pushdown-into-scan).
+- ``rewrite_distinct_aggregates``: count(DISTINCT x) -> two-level
+  aggregation (SingleDistinctAggregationToGroupBy rule analogue).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.expr import build as B
+from presto_tpu.expr.functions import resolve_aggregate
+from presto_tpu.expr.ir import (
+    Call, Constant, InputRef, RowExpression, SpecialForm, input_channels,
+)
+from presto_tpu.sql.plan import (
+    AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
+    OutputNode, PlanAggregate, PlanNode, ProjectNode, SemiJoinNode,
+    SortNode, TableScanNode, ValuesNode,
+)
+
+
+def optimize(plan: OutputNode, metadata=None) -> OutputNode:
+    node = _rewrite_bottom_up(plan, metadata)
+    node = prune_columns(node)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# expression channel remapping
+# ---------------------------------------------------------------------------
+
+def remap(expr: RowExpression, mapping: Dict[int, int]) -> RowExpression:
+    if isinstance(expr, InputRef):
+        return InputRef(mapping[expr.index], expr.type)
+    if isinstance(expr, Call):
+        return Call(expr.name, tuple(remap(a, mapping) for a in expr.args),
+                    expr.type, expr.fn)
+    if isinstance(expr, SpecialForm):
+        return SpecialForm(expr.form,
+                           tuple(remap(a, mapping) for a in expr.args),
+                           expr.type)
+    return expr
+
+
+def split_and(expr: RowExpression) -> List[RowExpression]:
+    if isinstance(expr, SpecialForm) and expr.form == "AND":
+        out: List[RowExpression] = []
+        for a in expr.args:
+            out.extend(split_and(a))
+        return out
+    return [expr]
+
+
+def and_all(exprs: Sequence[RowExpression]) -> RowExpression:
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = B.and_(out, e)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# join extraction
+# ---------------------------------------------------------------------------
+
+def _rewrite_bottom_up(node: PlanNode, metadata) -> PlanNode:
+    node = _replace_sources(
+        node, [_rewrite_bottom_up(s, metadata) for s in node.sources])
+    if isinstance(node, FilterNode) and _is_cross_tree(node.source):
+        return extract_joins(node, metadata)
+    if isinstance(node, AggregationNode) and any(
+            a.distinct for a in node.aggregates):
+        return rewrite_distinct_aggregates(node)
+    return node
+
+
+def _replace_sources(node: PlanNode,
+                     sources: List[PlanNode]) -> PlanNode:
+    if not sources:
+        return node
+    fields: Dict[str, object] = {}
+    names = [f.name for f in dataclasses.fields(node)]
+    if "source" in names:
+        fields["source"] = sources[0]
+    if "left" in names:
+        fields["left"] = sources[0]
+        fields["right"] = sources[1]
+    if "filtering" in names:
+        fields["source"] = sources[0]
+        fields["filtering"] = sources[1]
+    return dataclasses.replace(node, **fields)
+
+
+def _is_cross_tree(node: PlanNode) -> bool:
+    return (isinstance(node, JoinNode) and node.kind == "cross"
+            and not node.left_keys)
+
+
+def _cross_leaves(node: PlanNode) -> List[PlanNode]:
+    if _is_cross_tree(node):
+        return _cross_leaves(node.left) + _cross_leaves(node.right)  # type: ignore[attr-defined]
+    return [node]
+
+
+def _estimate_rows(node: PlanNode, metadata) -> float:
+    if isinstance(node, TableScanNode) and metadata is not None:
+        try:
+            _, _, conn, _ = metadata.resolve_table((node.catalog,
+                                                    node.table))
+            handle = conn.get_table(node.table)
+            stats = conn.table_statistics(handle)
+            if stats is not None and getattr(stats, "row_count", None):
+                return float(stats.row_count)
+        except Exception:
+            pass
+        return 1e6
+    if isinstance(node, (FilterNode, ProjectNode, LimitNode, SortNode)):
+        return _estimate_rows(node.sources[0], metadata) * (
+            0.3 if isinstance(node, FilterNode) else 1.0)
+    if isinstance(node, AggregationNode):
+        return _estimate_rows(node.sources[0], metadata) * 0.1
+    if isinstance(node, JoinNode):
+        return max(_estimate_rows(node.left, metadata),
+                   _estimate_rows(node.right, metadata))
+    if isinstance(node, SemiJoinNode):
+        return _estimate_rows(node.sources[0], metadata)
+    if isinstance(node, EnforceSingleRowNode):
+        return 1.0
+    return 1e4
+
+
+def factor_or_conjuncts(expr: RowExpression) -> List[RowExpression]:
+    """OR(a AND x, a AND y) -> [a, OR(x, y)] (ExtractCommonPredicates
+    rewriter analogue) — lets each OR branch's shared join equalities
+    become join keys (TPC-H Q19's p_partkey = l_partkey)."""
+    if not (isinstance(expr, SpecialForm) and expr.form == "OR"):
+        return [expr]
+    branches = []
+    stack = [expr]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, SpecialForm) and e.form == "OR":
+            stack.extend(e.args)
+        else:
+            branches.append(split_and(e))
+    common = [c for c in branches[0]
+              if all(any(c == d for d in b) for b in branches[1:])]
+    if not common:
+        return [expr]
+    out = list(common)
+    rests = []
+    for b in branches:
+        rest = [c for c in b if not any(c == d for d in common)]
+        if not rest:
+            return out  # one branch is fully covered: OR part is TRUE
+        rests.append(and_all(rest))
+    ored = rests[0]
+    for r in rests[1:]:
+        ored = B.or_(ored, r)
+    out.append(ored)
+    return out
+
+
+def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
+    """Filter(cross-join tree) -> pushed filters + left-deep equi joins."""
+    leaves = _cross_leaves(filter_node.source)
+    offsets = []
+    off = 0
+    for leaf in leaves:
+        offsets.append(off)
+        off += len(leaf.columns)
+    total = off
+
+    def leaf_of(ch: int) -> int:
+        for i in range(len(leaves) - 1, -1, -1):
+            if ch >= offsets[i]:
+                return i
+        raise AssertionError
+
+    conjuncts = []
+    for c in split_and(filter_node.predicate):
+        conjuncts.extend(factor_or_conjuncts(c))
+    pushed: List[List[RowExpression]] = [[] for _ in leaves]
+    edges: List[Tuple[int, int, int, int]] = []  # (la, cha, lb, chb)
+    residual: List[RowExpression] = []
+    for c in conjuncts:
+        chans = input_channels(c)
+        ls = {leaf_of(ch) for ch in chans}
+        if len(ls) == 1:
+            li = ls.pop()
+            pushed[li].append(
+                remap(c, {ch: ch - offsets[li] for ch in chans}))
+        elif (len(ls) == 2 and isinstance(c, Call) and c.name == "eq"
+                and len(c.args) == 2
+                and all(isinstance(a, InputRef) for a in c.args)):
+            a, b = c.args  # type: ignore[misc]
+            la, lb = leaf_of(a.index), leaf_of(b.index)
+            edges.append((la, a.index - offsets[la],
+                          lb, b.index - offsets[lb]))
+        else:
+            residual.append(c)
+
+    nodes: List[PlanNode] = []
+    for leaf, preds in zip(leaves, pushed):
+        nodes.append(FilterNode(leaf, and_all(preds)) if preds else leaf)
+
+    # greedy left-deep order: start at the largest relation (probe side),
+    # join connected relations build-side (the broadcast-join shape)
+    sizes = [_estimate_rows(n, metadata) for n in nodes]
+    remaining = set(range(len(nodes)))
+    start = max(remaining, key=lambda i: sizes[i])
+    joined = [start]
+    remaining.discard(start)
+    current = nodes[start]
+    # channel map: (leaf, local_ch) -> current output channel
+    chan_map: Dict[Tuple[int, int], int] = {
+        (start, i): i for i in range(len(nodes[start].columns))}
+    used_edges = [False] * len(edges)
+    pending_residual = list(residual)
+
+    def connected() -> Optional[int]:
+        for i, (la, _, lb, _) in enumerate(edges):
+            if used_edges[i]:
+                continue
+            if la in joined and lb in remaining:
+                return lb
+            if lb in joined and la in remaining:
+                return la
+        return next(iter(remaining)) if remaining else None
+
+    while remaining:
+        nxt = connected()
+        if nxt is None:
+            break
+        nxt_node = nodes[nxt]
+        left_keys: List[int] = []
+        right_keys: List[int] = []
+        extra_eq: List[Tuple[int, int]] = []  # both keys already joined
+        for i, (la, ca, lb, cb) in enumerate(edges):
+            if used_edges[i]:
+                continue
+            if la in joined and lb == nxt:
+                left_keys.append(chan_map[(la, ca)])
+                right_keys.append(cb)
+                used_edges[i] = True
+            elif lb in joined and la == nxt:
+                left_keys.append(chan_map[(lb, cb)])
+                right_keys.append(ca)
+                used_edges[i] = True
+        base = len(current.columns)
+        cols = current.columns + nxt_node.columns
+        if left_keys:
+            current = JoinNode("inner", current, nxt_node,
+                               tuple(left_keys), tuple(right_keys), cols)
+        else:
+            current = JoinNode("cross", current, nxt_node, (), (), cols)
+        for j in range(len(nxt_node.columns)):
+            chan_map[(nxt, j)] = base + j
+        joined.append(nxt)
+        remaining.discard(nxt)
+
+        # equality edges whose both leaves are now joined but were not
+        # usable as keys become filters immediately (current-space refs)
+        extra_now: List[RowExpression] = []
+        for i, (la, ca, lb, cb) in enumerate(edges):
+            if not used_edges[i] and la in joined and lb in joined:
+                used_edges[i] = True
+                extra_now.append(
+                    B.comparison("=",
+                                 _ref_at(current, chan_map[(la, ca)]),
+                                 _ref_at(current, chan_map[(lb, cb)])))
+        if extra_now:
+            current = FilterNode(current, and_all(extra_now))
+        ready = []
+        rest = []
+        for c in pending_residual:
+            chans = input_channels(c)
+            if all(leaf_of(ch) in joined for ch in chans):
+                ready.append(remap(c, {
+                    ch: chan_map[(leaf_of(ch), ch - offsets[leaf_of(ch)])]
+                    for ch in chans}))
+            else:
+                rest.append(c)
+        pending_residual = rest
+        if ready:
+            current = FilterNode(current, and_all(ready))
+
+    # restore original channel order for the parent
+    out_exprs = []
+    for li, leaf in enumerate(leaves):
+        for j in range(len(leaf.columns)):
+            ch = chan_map[(li, j)]
+            out_exprs.append(InputRef(ch, current.columns[ch][1]))
+    orig_cols = tuple(col for leaf in leaves for col in leaf.columns)
+    return ProjectNode(current, tuple(out_exprs), orig_cols)
+
+
+def _ref_at(node: PlanNode, ch: int) -> InputRef:
+    # build an InputRef in the *pre-remap* leaf space is incorrect here;
+    # these equality folds are already in current-channel space, so remap
+    # in the caller is an identity for them — construct directly.
+    return InputRef(ch, node.columns[ch][1])
+
+
+# ---------------------------------------------------------------------------
+# distinct aggregate rewrite
+# ---------------------------------------------------------------------------
+
+def rewrite_distinct_aggregates(node: AggregationNode) -> PlanNode:
+    """Aggregate(keys, [agg(distinct x)]) ->
+    Aggregate(keys, [agg(x)]) over Aggregate(keys + x, [])."""
+    if not all(a.distinct for a in node.aggregates):
+        raise NotImplementedError(
+            "mixed DISTINCT and plain aggregates are not supported yet")
+    in_channels = sorted({a.channel for a in node.aggregates
+                          if a.channel is not None})
+    inner_keys = tuple(node.group_channels) + tuple(in_channels)
+    src = node.source
+    inner_cols = tuple(src.columns[c] for c in inner_keys)
+    inner = AggregationNode(src, inner_keys, (), inner_cols)
+    ch_pos = {c: i for i, c in enumerate(inner_keys)}
+    aggs = []
+    for a in node.aggregates:
+        spec = a.spec
+        if spec.name in ("count", "count_star"):
+            # count(distinct x): count non-null x per group
+            arg_t = inner_cols[ch_pos[a.channel]][1]
+            spec = resolve_aggregate("count", arg_t)
+        aggs.append(PlanAggregate(spec,
+                                  ch_pos.get(a.channel), False,
+                                  a.output_name))
+    return AggregationNode(inner,
+                           tuple(range(len(node.group_channels))),
+                           tuple(aggs), node.columns)
+
+
+# ---------------------------------------------------------------------------
+# column pruning
+# ---------------------------------------------------------------------------
+
+def prune_columns(plan: OutputNode) -> OutputNode:
+    src, mapping = _prune(plan.source,
+                          sorted(range(len(plan.source.columns))))
+    return dataclasses.replace(plan, source=src)
+
+
+def _prune(node: PlanNode,
+           needed: List[int]) -> Tuple[PlanNode, Dict[int, int]]:
+    """Returns (pruned node, old-channel -> new-channel mapping covering at
+    least ``needed``)."""
+    if not needed:
+        needed = [0]  # count(*)-style shapes still need row counts
+    if isinstance(node, TableScanNode):
+        names = [node.column_names[i] for i in needed]
+        cols = tuple(node.columns[i] for i in needed)
+        return (dataclasses.replace(node, column_names=tuple(names),
+                                    columns=cols),
+                {ch: i for i, ch in enumerate(needed)})
+    if isinstance(node, ValuesNode):
+        cols = tuple(node.columns[i] for i in needed)
+        rows = tuple(tuple(r[i] for i in needed) for r in node.rows)
+        return (ValuesNode(cols, rows),
+                {ch: i for i, ch in enumerate(needed)})
+    if isinstance(node, FilterNode):
+        child_needed = sorted(set(needed)
+                              | set(input_channels(node.predicate)))
+        src, m = _prune(node.source, child_needed)
+        return (FilterNode(src, remap(node.predicate, m)),
+                {ch: m[ch] for ch in needed})
+    if isinstance(node, ProjectNode):
+        child_needed = sorted({ch for i in needed
+                               for ch in input_channels(
+                                   node.expressions[i])})
+        src, m = _prune(node.source, child_needed)
+        exprs = tuple(remap(node.expressions[i], m) for i in needed)
+        cols = tuple(node.columns[i] for i in needed)
+        return (ProjectNode(src, exprs, cols),
+                {ch: i for i, ch in enumerate(needed)})
+    if isinstance(node, AggregationNode):
+        ngroups = len(node.group_channels)
+        agg_needed = [i - ngroups for i in needed if i >= ngroups]
+        keep_aggs = [node.aggregates[i] for i in agg_needed]
+        child_needed = sorted(set(node.group_channels)
+                              | {a.channel for a in keep_aggs
+                                 if a.channel is not None})
+        src, m = _prune(node.source, child_needed)
+        aggs = tuple(dataclasses.replace(
+            a, channel=None if a.channel is None else m[a.channel])
+            for a in keep_aggs)
+        out_cols = (tuple(node.columns[:ngroups])
+                    + tuple(node.columns[ngroups + i] for i in agg_needed))
+        new_node = AggregationNode(
+            src, tuple(m[c] for c in node.group_channels), aggs, out_cols,
+            node.step)
+        mapping = {c: i for i, c in enumerate(range(ngroups))}
+        for newpos, i in enumerate(agg_needed):
+            mapping[ngroups + i] = ngroups + newpos
+        return new_node, {ch: mapping[ch] for ch in
+                          list(range(ngroups)) + [n + ngroups
+                                                  for n in agg_needed]}
+    if isinstance(node, JoinNode):
+        nleft = len(node.left.columns)
+        res_chans = (input_channels(node.residual)
+                     if node.residual is not None else ())
+        left_needed = sorted({ch for ch in set(needed) | set(res_chans)
+                              if ch < nleft} | set(node.left_keys))
+        right_needed = sorted({ch - nleft
+                               for ch in set(needed) | set(res_chans)
+                               if ch >= nleft} | set(node.right_keys))
+        lsrc, lm = _prune(node.left, left_needed)
+        rsrc, rm = _prune(node.right, right_needed)
+        nleft_new = len(lsrc.columns)
+        mapping = {}
+        for ch in left_needed:
+            mapping[ch] = lm[ch]
+        for ch in right_needed:
+            mapping[ch + nleft] = rm[ch] + nleft_new
+        # children may keep extra channels (their own join keys), so the
+        # pruned schema comes from their ACTUAL outputs
+        cols = tuple(lsrc.columns) + tuple(rsrc.columns)
+        residual = (remap(node.residual, mapping)
+                    if node.residual is not None else None)
+        new_node = JoinNode(node.kind, lsrc, rsrc,
+                            tuple(lm[c] for c in node.left_keys),
+                            tuple(rm[c] for c in node.right_keys),
+                            cols, residual)
+        return new_node, {ch: mapping[ch] for ch in needed}
+    if isinstance(node, SemiJoinNode):
+        nsrc = len(node.source.columns)
+        res_chans = (input_channels(node.residual)
+                     if node.residual is not None else ())
+        src_needed = sorted({ch for ch in set(needed) | set(res_chans)
+                             if ch < nsrc} | set(node.source_keys))
+        filt_needed = sorted({ch - nsrc for ch in res_chans
+                              if ch >= nsrc} | set(node.filtering_keys))
+        ssrc, sm = _prune(node.source, src_needed)
+        fsrc, fm = _prune(node.filtering, filt_needed)
+        mapping = {}
+        for ch in src_needed:
+            mapping[ch] = sm[ch]
+        for ch in filt_needed:
+            mapping[ch + nsrc] = fm[ch] + len(ssrc.columns)
+        residual = (remap(node.residual, mapping)
+                    if node.residual is not None else None)
+        new_node = SemiJoinNode(ssrc, fsrc,
+                                tuple(sm[c] for c in node.source_keys),
+                                tuple(fm[c] for c in node.filtering_keys),
+                                node.negated, residual)
+        return new_node, {ch: sm[ch] for ch in needed}
+    if isinstance(node, SortNode):
+        child_needed = sorted(set(needed)
+                              | {c for c, _, _ in node.sort_keys})
+        src, m = _prune(node.source, child_needed)
+        keys = tuple((m[c], asc, nf) for c, asc, nf in node.sort_keys)
+        return SortNode(src, keys), {ch: m[ch] for ch in needed}
+    if isinstance(node, LimitNode):
+        src, m = _prune(node.source, needed)
+        return LimitNode(src, node.count), m
+    if isinstance(node, EnforceSingleRowNode):
+        # must keep all columns (the NULL-row synthesis needs the schema)
+        src, m = _prune(node.source,
+                        sorted(range(len(node.source.columns))))
+        return EnforceSingleRowNode(src), m
+    if isinstance(node, OutputNode):
+        src, m = _prune(node.source, needed)
+        return dataclasses.replace(node, source=src), m
+    raise NotImplementedError(f"prune: {type(node).__name__}")

@@ -1,0 +1,303 @@
+"""Physical planner: logical PlanNode tree -> executable Pipelines.
+
+The LocalExecutionPlanner analogue (presto-main/.../sql/planner/
+LocalExecutionPlanner.java:291): a bottom-up visitor mapping each PlanNode
+to OperatorFactory chains, breaking pipelines at join build sides exactly
+where the reference's LookupSourceFactory rendezvous sits (build pipelines
+are emitted before the pipeline that probes them, matching
+execute_pipelines' sequential contract).
+
+Aggregate decomposition happens here: a PlanAggregate's AggSpec components
+become primitive AggChannels (sum/count/min/max; sumsq pre-projects x*x)
+and ``finalize`` becomes a post-aggregation projection (avg = sum/count,
+stddev/variance from the moment components) — the role the reference's
+AccumulatorCompiler + partial/final Step split plays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.config import DEFAULT, EngineConfig
+from presto_tpu.connectors.api import ConnectorRegistry, Split
+from presto_tpu.exec.aggregation import (
+    AggChannel, GlobalAggregationOperatorFactory,
+    HashAggregationOperatorFactory,
+)
+from presto_tpu.exec.driver import Pipeline
+from presto_tpu.exec.joinop import (
+    HashBuildOperatorFactory, LookupJoinOperatorFactory,
+)
+from presto_tpu.exec.nestedloop import (
+    EnforceSingleRowOperatorFactory, NestedLoopBuildOperatorFactory,
+    NestedLoopJoinOperatorFactory,
+)
+from presto_tpu.exec.operators import (
+    FilterProjectOperatorFactory, LimitOperatorFactory,
+    OutputCollectorFactory, TableScanOperatorFactory, ValuesOperatorFactory,
+)
+from presto_tpu.exec.sortop import OrderByOperatorFactory, SortSpec
+from presto_tpu.expr import build as B
+from presto_tpu.expr.ir import InputRef, RowExpression
+from presto_tpu.sql.plan import (
+    AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
+    OutputNode, PlanAggregate, PlanNode, ProjectNode, SemiJoinNode,
+    SortNode, TableScanNode, ValuesNode,
+)
+
+
+@dataclasses.dataclass
+class PhysicalPlan:
+    pipelines: List[Pipeline]
+    collector: OutputCollectorFactory
+    column_names: List[str]
+    column_types: List[T.Type]
+
+
+class PhysicalPlanner:
+    def __init__(self, registry: ConnectorRegistry,
+                 config: EngineConfig = DEFAULT):
+        self.registry = registry
+        self.config = config
+        self._done_pipelines: List[Pipeline] = []
+        self._counter = 0
+
+    def plan(self, root: OutputNode) -> PhysicalPlan:
+        factories, splits = self._lower(root.source)
+        collector = OutputCollectorFactory()
+        factories.append(collector)
+        self._done_pipelines.append(
+            Pipeline(factories, splits, name="output"))
+        return PhysicalPlan(self._done_pipelines, collector,
+                            [n for n, _ in root.columns],
+                            [t for _, t in root.columns])
+
+    # -- lowering -----------------------------------------------------------
+    def _lower(self, node: PlanNode):
+        """Returns (operator factory chain, splits) producing node's
+        output batches; build-side pipelines are appended to
+        self._done_pipelines in dependency order."""
+        if isinstance(node, TableScanNode):
+            conn = self.registry.get(node.catalog)
+            handle = conn.get_table(node.table)
+            splits = conn.get_splits(handle, 1)
+            return ([TableScanOperatorFactory(
+                conn, node.column_names,
+                batch_rows=self.config.scan_batch_rows)], splits)
+        if isinstance(node, ValuesNode):
+            from presto_tpu.batch import batch_from_pylist
+
+            batch = batch_from_pylist(node.types, list(node.rows))
+            return ([ValuesOperatorFactory([batch.to_device()])], [])
+        if isinstance(node, (FilterNode, ProjectNode)):
+            return self._lower_filter_project(node)
+        if isinstance(node, AggregationNode):
+            return self._lower_aggregation(node)
+        if isinstance(node, JoinNode):
+            return self._lower_join(node)
+        if isinstance(node, SemiJoinNode):
+            return self._lower_semijoin(node)
+        if isinstance(node, SortNode):
+            chain, splits = self._lower(node.source)
+            specs = [SortSpec(c, not asc, bool(nf))
+                     for c, asc, nf in node.sort_keys]
+            chain.append(OrderByOperatorFactory(specs))
+            return chain, splits
+        if isinstance(node, LimitNode):
+            chain, splits = self._lower(node.source)
+            chain.append(LimitOperatorFactory(node.count))
+            return chain, splits
+        if isinstance(node, EnforceSingleRowNode):
+            chain, splits = self._lower(node.source)
+            chain.append(EnforceSingleRowOperatorFactory(node.types))
+            return chain, splits
+        raise NotImplementedError(
+            f"physical lowering for {type(node).__name__}")
+
+    def _lower_filter_project(self, node: PlanNode):
+        """Fuse adjacent Filter/Project chains into one PageProcessor-style
+        operator (ScanFilterAndProjectOperator fusion)."""
+        filters: List[RowExpression] = []
+        projections: Optional[Tuple[RowExpression, ...]] = None
+        cur = node
+        # walk down: Project over (Filter*) — compose
+        if isinstance(cur, ProjectNode):
+            projections = cur.expressions
+            cur = cur.source
+        while isinstance(cur, FilterNode):
+            filters.append(cur.predicate)
+            cur = cur.source
+        chain, splits = self._lower(cur)
+        input_types = [t for _, t in cur.columns]
+        filt = None
+        if filters:
+            filt = filters[-1]
+            for f in reversed(filters[:-1]):
+                filt = B.and_(filt, f)
+        if projections is None:
+            projections = tuple(InputRef(i, t)
+                                for i, t in enumerate(input_types))
+        chain.append(FilterProjectOperatorFactory(
+            filt, list(projections), input_types))
+        return chain, splits
+
+    def _lower_aggregation(self, node: AggregationNode):
+        chain, splits = self._lower(node.source)
+        input_types = [t for _, t in node.source.columns]
+
+        # pre-projection for sumsq components (x*x channels)
+        pre_exprs = [InputRef(i, t) for i, t in enumerate(input_types)]
+        agg_channels: List[AggChannel] = []
+        finalize_specs: List[Tuple[PlanAggregate, List[int]]] = []
+        for agg in node.aggregates:
+            comp_channels: List[int] = []
+            for prim, ctype in agg.spec.components:
+                if agg.channel is None:
+                    agg_channels.append(AggChannel("count", None, ctype))
+                    comp_channels.append(len(agg_channels) - 1)
+                    continue
+                in_ref = InputRef(agg.channel, input_types[agg.channel])
+                if prim == "sumsq":
+                    sq = B.call("multiply", in_ref, in_ref)
+                    pre_exprs.append(_coerce_to(sq, ctype))
+                    ch = len(pre_exprs) - 1
+                    agg_channels.append(AggChannel("sum", ch, ctype))
+                elif prim in ("sum", "min", "max", "count"):
+                    arg = in_ref
+                    if prim == "sum" and arg.type != ctype:
+                        pre_exprs.append(_coerce_to(arg, ctype))
+                        ch = len(pre_exprs) - 1
+                    else:
+                        ch = agg.channel
+                    agg_channels.append(AggChannel(prim, ch, ctype))
+                else:
+                    raise NotImplementedError(f"agg component {prim}")
+                comp_channels.append(len(agg_channels) - 1)
+            finalize_specs.append((agg, comp_channels))
+
+        needs_pre = len(pre_exprs) > len(input_types)
+        if needs_pre:
+            pre_types = [e.type for e in pre_exprs]
+            chain.append(FilterProjectOperatorFactory(
+                None, pre_exprs, input_types))
+            input_types = pre_types
+
+        ngroups = len(node.group_channels)
+        if ngroups:
+            chain.append(HashAggregationOperatorFactory(
+                list(node.group_channels), agg_channels, input_types))
+        else:
+            chain.append(GlobalAggregationOperatorFactory(
+                agg_channels, input_types))
+
+        # finalize projection: [keys..., finalized aggs...]
+        key_types = [input_types[c] for c in node.group_channels]
+        post_in = key_types + [a.out_type for a in agg_channels]
+        exprs: List[RowExpression] = [InputRef(i, t)
+                                      for i, t in enumerate(key_types)]
+        for agg, comps in finalize_specs:
+            base = [InputRef(ngroups + c, agg_channels[c].out_type)
+                    for c in comps]
+            exprs.append(_finalize(agg, base))
+        if (len(exprs) != len(post_in)
+                or any(not isinstance(e, InputRef) or e.index != i
+                       for i, e in enumerate(exprs))):
+            chain.append(FilterProjectOperatorFactory(
+                None, exprs, post_in))
+        return chain, splits
+
+    def _lower_join(self, node: JoinNode):
+        if node.kind == "cross":
+            build_chain, build_splits = self._lower(node.right)
+            build = NestedLoopBuildOperatorFactory(
+                [t for _, t in node.right.columns])
+            build_chain.append(build)
+            self._done_pipelines.append(
+                Pipeline(build_chain, build_splits,
+                         name=self._name("xbuild")))
+            chain, splits = self._lower(node.left)
+            chain.append(NestedLoopJoinOperatorFactory(build))
+            return chain, splits
+        if node.kind in ("inner", "left"):
+            build_chain, build_splits = self._lower(node.right)
+            build = HashBuildOperatorFactory(
+                list(node.right_keys), [t for _, t in node.right.columns])
+            build_chain.append(build)
+            self._done_pipelines.append(
+                Pipeline(build_chain, build_splits,
+                         name=self._name("build")))
+            chain, splits = self._lower(node.left)
+            chain.append(LookupJoinOperatorFactory(
+                build, list(node.left_keys),
+                [t for _, t in node.left.columns],
+                join_type=node.kind,
+                expansion=self.config.join_expansion_factor))
+            if node.residual is not None:
+                if node.kind != "inner":
+                    raise NotImplementedError(
+                        "left-join residual not supported")
+                types = [t for _, t in node.columns]
+                proj = [InputRef(i, t) for i, t in enumerate(types)]
+                chain.append(FilterProjectOperatorFactory(
+                    node.residual, proj, types))
+            return chain, splits
+        raise NotImplementedError(f"{node.kind} join")
+
+    def _lower_semijoin(self, node: SemiJoinNode):
+        build_chain, build_splits = self._lower(node.filtering)
+        build = HashBuildOperatorFactory(
+            list(node.filtering_keys),
+            [t for _, t in node.filtering.columns])
+        build_chain.append(build)
+        self._done_pipelines.append(
+            Pipeline(build_chain, build_splits, name=self._name("sbuild")))
+        chain, splits = self._lower(node.source)
+        chain.append(LookupJoinOperatorFactory(
+            build, list(node.source_keys),
+            [t for _, t in node.source.columns],
+            join_type="anti" if node.negated else "semi",
+            expansion=self.config.join_expansion_factor,
+            residual=node.residual))
+        return chain, splits
+
+    def _name(self, prefix: str) -> str:
+        self._counter += 1
+        return f"{prefix}{self._counter}"
+
+
+def _coerce_to(expr: RowExpression, typ: T.Type) -> RowExpression:
+    if expr.type == typ:
+        return expr
+    return B.cast(expr, typ)
+
+
+def _finalize(agg: PlanAggregate, comps: List[RowExpression]
+              ) -> RowExpression:
+    fin = agg.spec.finalize
+    if fin == "identity":
+        out = comps[0]
+        if out.type != agg.spec.result_type:
+            out = B.cast(out, agg.spec.result_type)
+        return out
+    if fin == "avg":
+        s, c = comps
+        if agg.spec.result_type.name == "double":
+            return B.call("divide", _coerce_to(s, T.DOUBLE),
+                          B.cast(c, T.DOUBLE))
+        return B.call("divide", s, c)
+    if fin in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
+        s, sq, n = comps
+        nd = B.cast(n, T.DOUBLE)
+        mean_sq = B.call("divide", B.call("multiply", s, s), nd)
+        num = B.call("subtract", sq, mean_sq)
+        if fin.endswith("_pop"):
+            var = B.call("divide", num, nd)
+        else:
+            var = B.call("divide", num,
+                         B.call("subtract", nd, B.const(1.0, T.DOUBLE)))
+        if fin.startswith("stddev"):
+            return B.call("sqrt", var)
+        return var
+    raise NotImplementedError(f"finalize {fin}")

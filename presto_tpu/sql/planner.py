@@ -670,18 +670,20 @@ class Planner:
     # --- WHERE & subqueries ------------------------------------------------
     def _plan_where(self, rel: RelationPlan,
                     where: Optional[t.Expression]) -> RelationPlan:
-        plain: List[t.Expression] = []
-        for c in split_conjuncts(where):
-            if _contains_subquery(c):
-                rel = self._apply_subquery_conjunct(rel, c)
-            else:
-                plain.append(c)
+        # plain conjuncts filter FIRST so the optimizer sees the
+        # Filter-over-cross-join pattern and can extract equi joins;
+        # subquery transforms stack above (AND order is irrelevant)
+        plain = [c for c in split_conjuncts(where)
+                 if not _contains_subquery(c)]
         if plain:
             tr = Translator(rel.scope)
             rel = RelationPlan(
                 FilterNode(rel.node, _and_all([tr.translate(c)
                                                for c in plain])),
                 rel.scope)
+        for c in split_conjuncts(where):
+            if _contains_subquery(c):
+                rel = self._apply_subquery_conjunct(rel, c)
         return rel
 
     def _apply_subquery_conjunct(
@@ -948,6 +950,8 @@ class Planner:
             spec = resolve_aggregate(a.name, arg.type)
             aggs.append(PlanAggregate(spec, len(pre_exprs), a.distinct))
             pre_exprs.append(arg)
+        if not pre_exprs:  # bare count(*): keep one channel for row counts
+            pre_exprs = [B.ref(0, scope.fields[0].type)]
         pre_cols = tuple((f"c{i}", x.type) for i, x in enumerate(pre_exprs))
         pre = ProjectNode(rel.node, tuple(pre_exprs), pre_cols)
         out_cols = (tuple((f"g{i}", x.type)
