@@ -1,0 +1,198 @@
+// LZ4 block-format codec, written from scratch against the public format
+// description (https://github.com/lz4/lz4/blob/dev/doc/lz4_Block_format.md).
+//
+// Role in the framework: the reference compresses every page crossing a
+// process boundary (exchange wire + spill files) with LZ4
+// (presto-main/.../execution/buffer/PagesSerdeFactory.java:16-33,
+// PagesSerde.java:60-70).  This is the equivalent native tier for our host
+// runtime: a C++ codec the Python/C++ serde layers call through ctypes.
+//
+// Format recap (block format, no frame):
+//   sequence := token | literal-length ext* | literals | offset(2, LE)
+//               | match-length ext*
+//   token    := (literalLength:4 high | matchLength-4 :4 low), 15 == extend
+//   The last sequence is literals-only.  Spec constraints honoured by the
+//   compressor: the last 5 bytes are always literals; no match starts
+//   within the last 12 bytes ("mflimit"); offsets in [1, 65535].
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int MINMATCH = 4;
+constexpr int MFLIMIT = 12;      // no match may start within this tail
+constexpr int LASTLITERALS = 5;  // spec: last 5 bytes are literals
+constexpr int HASH_LOG = 14;
+constexpr uint32_t HASH_SIZE = 1u << HASH_LOG;
+
+inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+inline uint32_t hash4(uint32_t v) {
+    // Fibonacci-style multiplicative hash over the 4-byte sequence.
+    return (v * 2654435761u) >> (32 - HASH_LOG);
+}
+
+inline uint8_t* write_length(uint8_t* op, size_t len) {
+    // Emit the 255-run extension bytes for a length field that hit 15.
+    while (len >= 255) {
+        *op++ = 255;
+        len -= 255;
+    }
+    *op++ = static_cast<uint8_t>(len);
+    return op;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Worst-case compressed size for n input bytes (matches the classic bound).
+int64_t pt_lz4_compress_bound(int64_t n) {
+    if (n < 0) return -1;
+    return n + n / 255 + 16;
+}
+
+// Compress src[0..n) into dst; returns compressed size, or -1 if dst is too
+// small.  Greedy single-pass with a 16k-entry hash table of recent 4-byte
+// sequences — the standard "fast" strategy.
+int64_t pt_lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                        int64_t dst_cap) {
+    if (n < 0 || dst_cap < 0) return -1;
+    const uint8_t* ip = src;
+    const uint8_t* const iend = src + n;
+    const uint8_t* anchor = src;  // start of pending literals
+    uint8_t* op = dst;
+    uint8_t* const oend = dst + dst_cap;
+
+    if (n >= MFLIMIT) {
+        const uint8_t* const mflimit = iend - MFLIMIT;
+        uint32_t table[HASH_SIZE];  // offsets from src, +1 (0 == empty)
+        std::memset(table, 0, sizeof(table));
+
+        while (ip <= mflimit) {
+            const uint32_t seq = read32(ip);
+            const uint32_t h = hash4(seq);
+            const uint8_t* match = src + table[h] - 1;
+            const bool hit = table[h] != 0 && read32(match) == seq &&
+                             static_cast<uint64_t>(ip - match) <= 65535 &&
+                             ip != match;
+            table[h] = static_cast<uint32_t>(ip - src) + 1;
+            if (!hit) {
+                ++ip;
+                continue;
+            }
+
+            // Extend the match forward (stop LASTLITERALS short of end).
+            const uint8_t* const matchlimit = iend - LASTLITERALS;
+            const uint8_t* mp = match + MINMATCH;
+            const uint8_t* cp = ip + MINMATCH;
+            while (cp < matchlimit && *cp == *mp) {
+                ++cp;
+                ++mp;
+            }
+            const size_t match_len = static_cast<size_t>(cp - ip);
+            const size_t lit_len = static_cast<size_t>(ip - anchor);
+
+            // token + worst-case length bytes + literals + offset
+            if (op + 1 + (lit_len / 255 + 1) + lit_len + 2 +
+                    ((match_len - MINMATCH) / 255 + 1) >
+                oend)
+                return -1;
+
+            uint8_t* const token = op++;
+            const size_t ml = match_len - MINMATCH;
+            *token = static_cast<uint8_t>(
+                ((lit_len >= 15 ? 15 : lit_len) << 4) |
+                (ml >= 15 ? 15 : ml));
+            if (lit_len >= 15) op = write_length(op, lit_len - 15);
+            std::memcpy(op, anchor, lit_len);
+            op += lit_len;
+            const uint16_t offset = static_cast<uint16_t>(ip - match);
+            *op++ = static_cast<uint8_t>(offset & 0xff);
+            *op++ = static_cast<uint8_t>(offset >> 8);
+            if (ml >= 15) op = write_length(op, ml - 15);
+
+            ip = cp;
+            anchor = ip;
+            // Re-seed the table inside the match so overlapping repeats
+            // are still findable.
+            if (ip - 2 > src && ip <= mflimit)
+                table[hash4(read32(ip - 2))] =
+                    static_cast<uint32_t>(ip - 2 - src) + 1;
+        }
+    }
+
+    // Final literals-only sequence.
+    const size_t lit_len = static_cast<size_t>(iend - anchor);
+    if (op + 1 + (lit_len / 255 + 1) + lit_len > oend) return -1;
+    uint8_t* const token = op++;
+    *token = static_cast<uint8_t>((lit_len >= 15 ? 15 : lit_len) << 4);
+    if (lit_len >= 15) op = write_length(op, lit_len - 15);
+    std::memcpy(op, anchor, lit_len);
+    op += lit_len;
+    return static_cast<int64_t>(op - dst);
+}
+
+// Decompress src[0..n) into dst[0..dst_cap); returns decompressed size or
+// -1 on malformed input / overflow.  Byte-exact inverse of the block
+// format; copies are done byte-wise where the match overlaps itself.
+int64_t pt_lz4_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
+                          int64_t dst_cap) {
+    if (n < 0 || dst_cap < 0) return -1;
+    const uint8_t* ip = src;
+    const uint8_t* const iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* const oend = dst + dst_cap;
+
+    while (ip < iend) {
+        const uint8_t token = *ip++;
+        // Literals.
+        size_t lit_len = token >> 4;
+        if (lit_len == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                lit_len += b;
+            } while (b == 255);
+        }
+        if (ip + lit_len > iend || op + lit_len > oend) return -1;
+        std::memcpy(op, ip, lit_len);
+        ip += lit_len;
+        op += lit_len;
+        if (ip >= iend) break;  // literals-only terminal sequence
+
+        // Match.
+        if (ip + 2 > iend) return -1;
+        const uint32_t offset =
+            static_cast<uint32_t>(ip[0]) | (static_cast<uint32_t>(ip[1]) << 8);
+        ip += 2;
+        if (offset == 0 || dst + offset > op) return -1;
+        size_t match_len = (token & 0x0f);
+        if (match_len == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                match_len += b;
+            } while (b == 255);
+        }
+        match_len += MINMATCH;
+        if (op + match_len > oend) return -1;
+        const uint8_t* match = op - offset;
+        if (offset >= match_len) {
+            std::memcpy(op, match, match_len);
+            op += match_len;
+        } else {
+            for (size_t i = 0; i < match_len; ++i) *op++ = *match++;
+        }
+    }
+    return static_cast<int64_t>(op - dst);
+}
+
+}  // extern "C"

@@ -1,0 +1,164 @@
+"""Batch wire serde: the PagesSerde equivalent.
+
+The reference serializes Pages into length-prefixed, LZ4-compressed
+``SerializedPage``s for the exchange wire and spill files
+(presto-main/.../execution/buffer/PagesSerde.java:42,60-70, block encodings
+in presto-spi/.../block/*BlockEncoding.java).  Same role here: a Batch
+(columnar host arrays + optional validity + host-side string dictionaries)
+round-trips through a compact binary frame, compressed by the native C++
+LZ4 codec (presto_tpu/native) with XXH64 integrity checksum, falling back
+to uncompressed frames when the native library is unavailable.
+
+Frame layout (little-endian):
+    magic  'PTPG'            4
+    version u8               1
+    flags   u8               1   bit0 = lz4-compressed payload
+    num_columns u32          4
+    num_rows    u64          8
+    uncompressed_size u64    8
+    payload_size u64         8   (== uncompressed_size when not compressed)
+    checksum u64             8   XXH64 of payload bytes (0 if no native lib)
+    payload...
+
+Payload, per column:
+    type_len u16, type utf8  (types.parse_type round-trip)
+    has_valid u8, has_dict u8
+    values   num_rows * itemsize bytes (C order)
+    valid    num_rows bytes (uint8) when has_valid
+    dict     u32 count, then per entry: u32 byte-length + utf8 bytes
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+from presto_tpu import native
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, Dictionary
+
+MAGIC = b"PTPG"
+VERSION = 1
+FLAG_LZ4 = 1
+_HEADER = struct.Struct("<4sBBIQQQQ")
+
+
+def _encode_payload(batch: Batch) -> bytes:
+    batch = batch.compact().to_numpy()
+    parts: List[bytes] = []
+    for col in batch.columns:
+        type_str = col.type.display().encode("utf-8")
+        parts.append(struct.pack("<H", len(type_str)))
+        parts.append(type_str)
+        parts.append(struct.pack(
+            "<BB", col.valid is not None, col.dictionary is not None))
+        values = np.ascontiguousarray(col.values[:batch.num_rows])
+        parts.append(values.tobytes())
+        if col.valid is not None:
+            parts.append(np.ascontiguousarray(
+                col.valid[:batch.num_rows]).astype(np.uint8).tobytes())
+        if col.dictionary is not None:
+            entries = col.dictionary.values
+            parts.append(struct.pack("<I", len(entries)))
+            for v in entries:
+                b = v.encode("utf-8")
+                parts.append(struct.pack("<I", len(b)))
+                parts.append(b)
+    return b"".join(parts)
+
+
+def serialize_batch(batch: Batch, compress: bool = True) -> bytes:
+    payload = _encode_payload(batch)
+    raw_size = len(payload)
+    flags = 0
+    checksum = 0
+    if compress and native.available():
+        compressed = native.lz4_compress(payload)
+        # Keep the compressed form only when it actually wins (the
+        # reference does the same ratio check in PagesSerde.serialize).
+        if len(compressed) < raw_size:
+            payload = compressed
+            flags |= FLAG_LZ4
+    if native.available():
+        checksum = native.xxh64(payload)
+    header = _HEADER.pack(MAGIC, VERSION, flags, batch.num_columns,
+                          batch.num_rows, raw_size, len(payload), checksum)
+    return header + payload
+
+
+class SerdeError(ValueError):
+    pass
+
+
+def deserialize_batch(data: bytes) -> Batch:
+    if len(data) < _HEADER.size:
+        raise SerdeError("truncated frame header")
+    (magic, version, flags, num_columns, num_rows, raw_size, payload_size,
+     checksum) = _HEADER.unpack_from(data, 0)
+    if magic != MAGIC or version != VERSION:
+        raise SerdeError(f"bad frame magic/version {magic!r}/{version}")
+    payload = data[_HEADER.size:_HEADER.size + payload_size]
+    if len(payload) != payload_size:
+        raise SerdeError("truncated frame payload")
+    if checksum:  # 0 == sender had no checksum support
+        actual = native.xxh64(bytes(payload))
+        if actual != checksum:
+            raise SerdeError(
+                f"page checksum mismatch ({actual:#x} != {checksum:#x})")
+    if flags & FLAG_LZ4:
+        try:
+            payload = native.lz4_decompress(bytes(payload), raw_size)
+        except RuntimeError as e:
+            raise SerdeError(str(e)) from e
+
+    try:
+        return _decode_payload(payload, num_columns, num_rows)
+    except SerdeError:
+        raise
+    except Exception as e:  # malformed bytes must surface as SerdeError
+        raise SerdeError(f"malformed page payload: {e}") from e
+
+
+def _decode_payload(payload: bytes, num_columns: int, num_rows: int) -> Batch:
+    off = 0
+    cols: List[Column] = []
+    for _ in range(num_columns):
+        (type_len,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        typ = T.parse_type(payload[off:off + type_len].decode("utf-8"))
+        off += type_len
+        has_valid, has_dict = struct.unpack_from("<BB", payload, off)
+        off += 2
+        itemsize = np.dtype(typ.np_dtype).itemsize
+        values = np.frombuffer(
+            payload, dtype=typ.np_dtype, count=num_rows, offset=off).copy()
+        off += num_rows * itemsize
+        valid: Optional[np.ndarray] = None
+        if has_valid:
+            valid = np.frombuffer(
+                payload, dtype=np.uint8, count=num_rows,
+                offset=off).astype(bool)
+            off += num_rows
+        dictionary: Optional[Dictionary] = None
+        if has_dict:
+            (count,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            entries = []
+            for _ in range(count):
+                (blen,) = struct.unpack_from("<I", payload, off)
+                off += 4
+                entries.append(payload[off:off + blen].decode("utf-8"))
+                off += blen
+            dictionary = Dictionary(entries)
+        cols.append(Column(typ, values, valid, dictionary))
+    return Batch(tuple(cols), num_rows)
+
+
+def frame_size(data: bytes, offset: int = 0) -> int:
+    """Total byte length of the frame starting at ``offset`` (for streams)."""
+    if len(data) - offset < _HEADER.size:
+        raise SerdeError("truncated frame header")
+    payload_size = _HEADER.unpack_from(data, offset)[6]
+    return _HEADER.size + payload_size
