@@ -1,0 +1,113 @@
+"""Client: the REST statement protocol + a DBAPI-flavored wrapper.
+
+The reference's client stack (SURVEY L7): StatementClientV1 POSTs
+/v1/statement then follows ``nextUri`` until the query reaches a terminal
+state (presto-client/.../StatementClientV1.java:86,342-354), receiving
+JSON ``QueryResults`` pages; presto-jdbc wraps that in JDBC.  Here
+``StatementClient`` speaks the same shape against our coordinator and
+``connect()`` provides the PEP 249-style Connection/Cursor wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import List, Optional, Sequence, Tuple
+
+
+class QueryFailed(RuntimeError):
+    pass
+
+
+class StatementClient:
+    def __init__(self, coordinator_uri: str, poll_interval_s: float = 0.05):
+        self.base = coordinator_uri.rstrip("/")
+        self.poll_interval_s = poll_interval_s
+
+    def execute(self, sql: str,
+                timeout_s: float = 300.0
+                ) -> Tuple[List[dict], List[list]]:
+        """Returns (columns, rows); raises QueryFailed on query error."""
+        req = urllib.request.Request(
+            f"{self.base}/v1/statement", data=sql.encode("utf-8"),
+            method="POST", headers={"Content-Type": "text/plain"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = json.loads(resp.read())
+        deadline = time.monotonic() + timeout_s
+        while True:
+            state = payload.get("stats", {}).get("state")
+            if state == "FAILED" or "error" in payload:
+                raise QueryFailed(
+                    payload.get("error", {}).get("message", "query failed"))
+            if "data" in payload or state == "FINISHED":
+                return payload.get("columns", []), payload.get("data", [])
+            next_uri = payload.get("nextUri")
+            if next_uri is None:
+                return payload.get("columns", []), payload.get("data", [])
+            if time.monotonic() > deadline:
+                raise QueryFailed("client timeout")
+            time.sleep(self.poll_interval_s)
+            with urllib.request.urlopen(next_uri, timeout=120) as resp:
+                payload = json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# PEP 249-flavored wrapper (the presto-jdbc role for Python callers)
+# ---------------------------------------------------------------------------
+
+class Cursor:
+    def __init__(self, client: StatementClient):
+        self._client = client
+        self.description: Optional[List[Tuple]] = None
+        self._rows: List[tuple] = []
+        self._pos = 0
+        self.rowcount = -1
+
+    def execute(self, sql: str, params: Optional[Sequence] = None) -> None:
+        if params:
+            raise NotImplementedError("parameter binding not supported")
+        columns, data = self._client.execute(sql)
+        self.description = [(c["name"], c["type"], None, None, None, None,
+                             None) for c in columns]
+        self._rows = [tuple(r) for r in data]
+        self._pos = 0
+        self.rowcount = len(self._rows)
+
+    def fetchone(self) -> Optional[tuple]:
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: int = 1024) -> List[tuple]:
+        out = self._rows[self._pos:self._pos + size]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self) -> List[tuple]:
+        out = self._rows[self._pos:]
+        self._pos = len(self._rows)
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class Connection:
+    def __init__(self, coordinator_uri: str):
+        self._client = StatementClient(coordinator_uri)
+
+    def cursor(self) -> Cursor:
+        return Cursor(self._client)
+
+    def close(self) -> None:
+        pass
+
+    def commit(self) -> None:  # autocommit (per-query transactions)
+        pass
+
+
+def connect(coordinator_uri: str) -> Connection:
+    return Connection(coordinator_uri)

@@ -574,11 +574,14 @@ class _TpchPageSource(PageSource):
         self.lo, self.hi, self.batch_rows = lo, hi, batch_rows
 
     def __iter__(self):
-        if self.table == "region":
-            yield self.gen.gen_region(self.columns)
-            return
-        if self.table == "nation":
-            yield self.gen.gen_nation(self.columns)
+        if self.table in ("region", "nation"):
+            gen = (self.gen.gen_region if self.table == "region"
+                   else self.gen.gen_nation)
+            full = gen(self.columns)
+            # honor the split's key range (keys == row indices here)
+            import numpy as np
+
+            yield full.take(np.arange(self.lo, min(self.hi, full.num_rows)))
             return
         fn = {
             "supplier": self.gen.gen_supplier,

@@ -29,6 +29,27 @@ class AggChannel:
     out_type: T.Type
 
 
+def _minmax_dict_input(a: "AggChannel", col):
+    """min/max over a dictionary column reduce *lexicographic ranks* (codes
+    are interning order, not sort order); the returned postprocess maps the
+    winning rank back to a code and reattaches the dictionary."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if a.prim not in ("min", "max") or col.dictionary is None:
+        return col.values, None
+    ranks = col.dictionary.sort_ranks()          # code -> rank
+    order = np.argsort(ranks).astype(col.values.dtype)  # rank -> code
+    vals = jnp.asarray(ranks)[col.values]
+    dictionary = col.dictionary
+
+    def post(agg_ranks):
+        codes = jnp.asarray(order)[jnp.clip(agg_ranks, 0, len(order) - 1)]
+        return codes, dictionary
+
+    return vals, post
+
+
 class HashAggregationOperator(Operator):
     def __init__(self, ctx: OperatorContext, group_channels: Sequence[int],
                  aggs: Sequence[AggChannel], input_types: Sequence[T.Type]):
@@ -84,12 +105,16 @@ class HashAggregationOperator(Operator):
         key_cols = [data.columns[c] for c in self.group_channels]
         key_codes = [(c.values, c.valid) for c in key_cols]
         agg_ins = []
+        posts = []
         for a in self.aggs:
             if a.channel is None:
                 agg_ins.append(("count", None, None))  # count(*): no values
+                posts.append(None)
             else:
                 col = data.columns[a.channel]
-                agg_ins.append((a.prim, col.values, col.valid))
+                vals, post = _minmax_dict_input(a, col)
+                agg_ins.append((a.prim, vals, col.valid))
+                posts.append(post)
         n = jnp.asarray(data.num_rows)
         present, results = direct_grouped_aggregate(
             key_codes, doms, agg_ins, n)
@@ -102,13 +127,18 @@ class HashAggregationOperator(Operator):
         for src, (codes, valid) in zip(key_cols, decoded):
             cols.append(Column(src.type, codes.astype(src.values.dtype),
                                valid, src.dictionary))
-        for a, (values, cnt) in zip(self.aggs, results):
+        for a, post, (values, cnt) in zip(self.aggs, posts, results):
             if a.prim == "count":
                 cols.append(Column(a.out_type, values[slots].astype("int64")))
             else:
+                vals = values[slots]
+                if post is not None:
+                    vals, dictionary = post(vals)
+                else:
+                    dictionary = None
                 cols.append(Column(a.out_type,
-                                   values[slots].astype(a.out_type.np_dtype),
-                                   cnt[slots] > 0))
+                                   vals.astype(a.out_type.np_dtype),
+                                   cnt[slots] > 0, dictionary))
         self.ctx.stats.output_rows += num_groups
         return Batch(tuple(cols), num_groups)
 
@@ -128,14 +158,18 @@ class HashAggregationOperator(Operator):
         key_cols = [(data.columns[c].values, data.columns[c].valid,
                      data.columns[c].type) for c in self.group_channels]
         agg_ins = []
+        posts = []
         for a in self.aggs:
             if a.channel is None:
                 col = data.columns[0]
                 agg_ins.append(("count", jnp.zeros_like(
                     col.values, shape=(data.capacity,)), None))
+                posts.append(None)
             else:
                 col = data.columns[a.channel]
-                agg_ins.append((a.prim, col.values, col.valid))
+                vals, post = _minmax_dict_input(a, col)
+                agg_ins.append((a.prim, vals, col.valid))
+                posts.append(post)
         n = jnp.asarray(data.num_rows)
         group_cap = next_bucket(1, min(max(data.num_rows, 1), 1 << 16))
         while True:
@@ -151,13 +185,17 @@ class HashAggregationOperator(Operator):
             values = src.values[gi]
             valid = None if src.valid is None else src.valid[gi]
             cols.append(Column(src.type, values, valid, src.dictionary))
-        for a, (values, cnt) in zip(self.aggs, results):
+        for a, post, (values, cnt) in zip(self.aggs, posts, results):
             if a.prim == "count":
                 cols.append(Column(a.out_type, values.astype("int64")))
             else:
+                if post is not None:
+                    values, dictionary = post(values)
+                else:
+                    dictionary = None
                 cols.append(Column(a.out_type,
                                    values.astype(a.out_type.np_dtype),
-                                   cnt > 0))
+                                   cnt > 0, dictionary))
         out = Batch(tuple(cols), num_groups)
         self.ctx.stats.output_rows += num_groups
         return out
@@ -216,31 +254,41 @@ class GlobalAggregationOperator(Operator):
                 if a.prim == "count":
                     cols.append(Column(a.out_type, np.zeros(1, np.int64)))
                 else:
+                    from presto_tpu.batch import Dictionary
+
+                    dictionary = (Dictionary()
+                                  if a.out_type.is_dictionary else None)
                     cols.append(Column(a.out_type,
                                        np.zeros(1, a.out_type.np_dtype),
-                                       np.zeros(1, bool)))
+                                       np.zeros(1, bool), dictionary))
             self._output = Batch(tuple(cols), 1)
             return
         agg_ins = []
+        posts = []
         for a in self.aggs:
             if a.channel is None:
                 agg_ins.append(("count", data.columns[0].values, None))
+                posts.append(None)
             else:
                 col = data.columns[a.channel]
-                agg_ins.append((a.prim, col.values, col.valid))
+                vals, post = _minmax_dict_input(a, col)
+                agg_ins.append((a.prim, vals, col.valid))
+                posts.append(post)
         results = global_aggregate(agg_ins, jnp.asarray(data.num_rows))
-        for a, (value, cnt) in zip(self.aggs, results):
-            import numpy as np
-
+        for a, post, (value, cnt) in zip(self.aggs, posts, results):
             if a.prim == "count":
                 cols.append(Column(a.out_type,
                                    np.asarray([int(value)], np.int64)))
             else:
                 nonempty = int(cnt) > 0
+                dictionary = None
+                if post is not None:
+                    value, dictionary = post(jnp.asarray([value]))
+                    value = np.asarray(value)[0]
                 cols.append(Column(
                     a.out_type,
                     np.asarray([value], a.out_type.np_dtype),
-                    None if nonempty else np.zeros(1, bool)))
+                    None if nonempty else np.zeros(1, bool), dictionary))
         self._output = Batch(tuple(cols), 1)
 
     def get_output(self) -> Optional[Batch]:

@@ -1,0 +1,81 @@
+"""UNION ALL plumbing: N source pipelines feeding one consumer chain.
+
+Reference model: the reference plans UNION as an ExchangeNode/LocalExchange
+gathering multiple driver pipelines into one (LocalExchange.java:53 with
+passthrough exchangers).  In the single-process runner the same rendezvous
+is a shared buffer: each input branch runs as its own pipeline ending in a
+``UnionSinkOperator``; the consuming pipeline starts with a
+``UnionSourceOperator`` that drains the buffer.  Pipelines execute in
+dependency order (the execute_pipelines contract), so all sinks finish
+before the source starts — identical to how build sides rendezvous with
+probes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from presto_tpu.batch import Batch
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import Operator, OperatorFactory
+
+
+class UnionBuffer:
+    """Shared rendezvous between sink pipelines and the source."""
+
+    def __init__(self, n_sinks: int):
+        self.batches: List[Batch] = []
+        self.remaining_sinks = n_sinks
+
+
+class UnionSinkOperator(Operator):
+    def __init__(self, ctx: OperatorContext, buffer: UnionBuffer):
+        super().__init__(ctx)
+        self.buffer = buffer
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_rows += batch.num_rows
+        self.buffer.batches.append(batch)
+
+    def finish(self) -> None:
+        if not self._finishing:
+            self.buffer.remaining_sinks -= 1
+        super().finish()
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class UnionSinkOperatorFactory(OperatorFactory):
+    def __init__(self, buffer: UnionBuffer):
+        self.buffer = buffer
+
+    def create(self, ctx: OperatorContext) -> UnionSinkOperator:
+        return UnionSinkOperator(ctx, self.buffer)
+
+
+class UnionSourceOperator(Operator):
+    def __init__(self, ctx: OperatorContext, buffer: UnionBuffer):
+        super().__init__(ctx)
+        self.buffer = buffer
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[Batch]:
+        if self.buffer.batches:
+            batch = self.buffer.batches.pop(0)
+            self.ctx.stats.output_rows += batch.num_rows
+            return batch
+        return None
+
+    def is_finished(self) -> bool:
+        return self.buffer.remaining_sinks == 0 and not self.buffer.batches
+
+
+class UnionSourceOperatorFactory(OperatorFactory):
+    def __init__(self, buffer: UnionBuffer):
+        self.buffer = buffer
+
+    def create(self, ctx: OperatorContext) -> UnionSourceOperator:
+        return UnionSourceOperator(ctx, self.buffer)

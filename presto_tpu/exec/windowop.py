@@ -1,0 +1,223 @@
+"""WindowOperator: partition-sorted segmented-scan window evaluation.
+
+Reference model: WindowOperator (presto-main/.../operator/
+WindowOperator.java:61) sorts a PagesIndex by (partition, order) keys and
+walks it row-by-row, partition-by-partition, with per-function framing
+(operator/window/FrameInfo).  The TPU formulation materializes, runs the
+sort-permutation kernel once over all partitions, derives partition/peer
+segment ids from adjacent-row key equality, and evaluates every window
+function as a data-parallel segmented scan (ops/window.py) — one XLA
+program, no per-partition loop.
+
+Output rows come out partition/order-sorted (the reference's output order
+as well); the appended channels hold the function results.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import Operator, OperatorFactory, device_concat
+from presto_tpu.exec.sortop import SortSpec
+from presto_tpu.sql.plan import PlanWindowFunction
+
+
+class WindowOperator(Operator):
+    def __init__(self, ctx: OperatorContext,
+                 partition_channels: Sequence[int],
+                 order_keys: Sequence[Tuple[int, bool, Optional[bool]]],
+                 functions: Sequence[PlanWindowFunction]):
+        super().__init__(ctx)
+        self.partition_channels = list(partition_channels)
+        self.order_keys = list(order_keys)
+        self.functions = list(functions)
+        self._batches: List[Batch] = []
+        self._output: Optional[Batch] = None
+
+    def add_input(self, batch: Batch) -> None:
+        self._batches.append(batch)
+        self.ctx.stats.input_rows += batch.num_rows
+        self.ctx.memory.reserve(batch.size_bytes)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        data = device_concat(self._batches, self.ctx.config.min_batch_capacity)
+        self._batches = []
+        self.ctx.memory.free()
+        if data is None:
+            return
+        self._output = self._evaluate(data)
+        self.ctx.stats.output_rows += self._output.num_rows
+
+    def _evaluate(self, data: Batch) -> Batch:
+        import jax.numpy as jnp
+
+        from presto_tpu.ops import window as W
+        from presto_tpu.ops.sort import sort_permutation
+
+        n = data.num_rows
+        cap = data.capacity
+
+        def sort_key(channel: int, desc: bool, nulls_first: bool):
+            c = data.columns[channel]
+            if c.type.is_dictionary:
+                ranks = c.dictionary.sort_ranks()
+                return (jnp.asarray(ranks)[c.values], c.valid, T.INTEGER,
+                        desc, nulls_first)
+            return (c.values, c.valid, c.type, desc, nulls_first)
+
+        keys = [sort_key(ch, False, False) for ch in self.partition_channels]
+        keys += [sort_key(ch, not asc, bool(nf))
+                 for ch, asc, nf in self.order_keys]
+        if keys:
+            perm = sort_permutation(keys, jnp.asarray(n))
+            data = Batch(tuple(
+                Column(c.type, c.values[perm],
+                       None if c.valid is None else c.valid[perm],
+                       c.dictionary)
+                for c in data.columns), n)
+
+        # adjacent-row equality -> partition segments / peer groups.
+        # liveness participates as a pseudo-key so padding rows (all
+        # sorted past the live rows) can never merge into the last
+        # partition.
+        live = jnp.arange(cap) < n
+
+        def eq_prev(channel: int):
+            c = data.columns[channel]
+            v = c.values
+            same = jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), v[1:] == v[:-1]])
+            if c.valid is not None:
+                g = c.valid
+                both_null = jnp.concatenate(
+                    [jnp.ones((1,), jnp.bool_), (~g[1:]) & (~g[:-1])])
+                both_ok = jnp.concatenate(
+                    [jnp.ones((1,), jnp.bool_), g[1:] & g[:-1]])
+                same = both_null | (both_ok & same)
+            return same
+
+        part_eq = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                   live[1:] == live[:-1]])
+        for ch in self.partition_channels:
+            part_eq = part_eq & eq_prev(ch)
+        seg = W.segment_ids(part_eq)
+        peer_eq = part_eq
+        for ch, _, _ in self.order_keys:
+            peer_eq = peer_eq & eq_prev(ch)
+        peer = W.segment_ids(peer_eq)
+
+        out_cols = list(data.columns)
+        for fn in self.functions:
+            out_cols.append(self._eval_function(fn, data, seg, peer))
+        return Batch(tuple(out_cols), n)
+
+    def _eval_function(self, fn: PlanWindowFunction, data: Batch,
+                       seg, peer) -> Column:
+        import jax.numpy as jnp
+
+        from presto_tpu.ops import window as W
+
+        name = fn.name
+        rt = fn.result_type
+        if name == "row_number":
+            return Column(rt, W.row_number(seg))
+        if name == "rank":
+            return Column(rt, W.rank(seg, peer))
+        if name == "dense_rank":
+            return Column(rt, W.dense_rank(seg, peer))
+        if name == "percent_rank":
+            return Column(rt, W.percent_rank(seg, peer))
+        if name == "cume_dist":
+            return Column(rt, W.cume_dist(seg, peer))
+        if name == "ntile":
+            return Column(rt, W.ntile(seg, fn.offset))
+
+        if name in ("lag", "lead"):
+            c = data.columns[fn.arg_channels[0]]
+            default = (data.columns[fn.default_channel].values
+                       if fn.default_channel is not None else None)
+            off = fn.offset if name == "lag" else -fn.offset
+            vals, ok = W.shift_in_partition(seg, c.values, c.valid, off,
+                                            default)
+            return Column(rt, vals, ok, c.dictionary)
+
+        lo, hi = W.frame_ends(seg, peer, fn.frame_unit, fn.frame_start,
+                              fn.frame_end, fn.frame_start_offset,
+                              fn.frame_end_offset)
+        if name in ("first_value", "nth_value"):
+            c = data.columns[fn.arg_channels[0]]
+            k = fn.offset or 1
+            target = lo + (k - 1)
+            in_frame = target <= hi
+            tc = jnp.clip(target, 0, c.values.shape[0] - 1)
+            vals = c.values[tc]
+            ok = in_frame if c.valid is None else (in_frame & c.valid[tc])
+            return Column(rt, vals, ok, c.dictionary)
+        if name == "last_value":
+            c = data.columns[fn.arg_channels[0]]
+            vals, ok = W.value_at(c.values, c.valid, hi)
+            ok = ok & (lo <= hi)
+            return Column(rt, vals, ok, c.dictionary)
+
+        # framed aggregates
+        if name == "count":
+            if not fn.arg_channels:
+                ones = jnp.ones(seg.shape[0], jnp.int64)
+                s, _ = W.framed_sum_count(seg, ones, None, lo, hi)
+                return Column(rt, s)
+            c = data.columns[fn.arg_channels[0]]
+            _, cnt = W.framed_sum_count(
+                seg, jnp.zeros(seg.shape[0], jnp.int64), c.valid, lo, hi)
+            return Column(rt, cnt)
+        if name in ("sum", "avg"):
+            c = data.columns[fn.arg_channels[0]]
+            vals = c.values
+            if T.is_integral(c.type) or isinstance(c.type, T.DecimalType):
+                vals = vals.astype(jnp.int64)
+            s, cnt = W.framed_sum_count(seg, vals, c.valid, lo, hi)
+            if name == "sum":
+                ok = cnt > 0
+                return Column(rt, s.astype(rt.np_dtype), ok)
+            ok = cnt > 0
+            cnt_safe = jnp.maximum(cnt, 1)
+            if isinstance(rt, T.DecimalType):
+                # scaled-integer average, round half away from zero
+                q = s / cnt_safe
+                avg = jnp.where(q >= 0, jnp.floor(q + 0.5),
+                                jnp.ceil(q - 0.5)).astype(jnp.int64)
+                return Column(rt, avg, ok)
+            avg = s.astype(jnp.float64) / cnt_safe.astype(jnp.float64)
+            return Column(rt, avg, ok)
+        if name in ("min", "max"):
+            c = data.columns[fn.arg_channels[0]]
+            vals, ok = W.framed_minmax(seg, peer, c.values, c.valid,
+                                       fn.frame_unit, fn.frame_start,
+                                       fn.frame_end, is_max=(name == "max"))
+            return Column(rt, vals, ok, c.dictionary)
+        raise NotImplementedError(f"window function {name}")
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._output = self._output, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._output is None
+
+
+class WindowOperatorFactory(OperatorFactory):
+    def __init__(self, partition_channels: Sequence[int],
+                 order_keys: Sequence[Tuple[int, bool, Optional[bool]]],
+                 functions: Sequence[PlanWindowFunction]):
+        self.partition_channels = list(partition_channels)
+        self.order_keys = list(order_keys)
+        self.functions = list(functions)
+
+    def create(self, ctx: OperatorContext) -> WindowOperator:
+        return WindowOperator(ctx, self.partition_channels,
+                              self.order_keys, self.functions)

@@ -209,19 +209,37 @@ class ExprCompiler:
             args[dict_arg_idx] = e
             per_entry.append(fn.impl(*args))
         rt = fn.result_type
+        # a None per-entry result means NULL for rows holding that code
+        # (split_part past the end, regexp_extract without a match, ...)
+        null_codes = [i for i, v in enumerate(per_entry) if v is None]
+        ok_np = None
+        if null_codes:
+            ok_np = np.ones(len(per_entry), dtype=bool)
+            ok_np[null_codes] = False
         if rt.is_dictionary:
-            out_dict = Dictionary(per_entry)
+            out_dict = Dictionary([v if v is not None else ""
+                                   for v in per_entry])
 
             def run(cols, n, xp):
-                return src.run(cols, n, xp)
+                codes, valid = src.run(cols, n, xp)
+                if ok_np is not None:
+                    ok = xp.take(xp.asarray(ok_np), codes, axis=0)
+                    valid = ok if valid is None else (valid & ok)
+                return codes, valid
 
             return CompiledExpr(rt, run, dictionary=out_dict)
-        lookup_np = np.asarray(per_entry, dtype=rt.np_dtype)
+        lookup_np = np.asarray(
+            [v if v is not None else 0 for v in per_entry],
+            dtype=rt.np_dtype)
 
         def run(cols, n, xp):
             codes, valid = src.run(cols, n, xp)
             table = xp.asarray(lookup_np)
-            return xp.take(table, codes, axis=0), valid
+            out = xp.take(table, codes, axis=0)
+            if ok_np is not None:
+                ok = xp.take(xp.asarray(ok_np), codes, axis=0)
+                valid = ok if valid is None else (valid & ok)
+            return out, valid
 
         return CompiledExpr(rt, run)
 

@@ -70,7 +70,7 @@ class LocalQueryRunner:
                 ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
                 [(n, schema.column_type(n).display())
                  for n in schema.column_names()])
-        if not isinstance(stmt, t.Query):
+        if not isinstance(stmt, (t.Query, t.SetOperation)):
             raise ValueError(f"unsupported statement {type(stmt).__name__}")
         return self._execute_query(stmt)
 
@@ -81,13 +81,13 @@ class LocalQueryRunner:
         return self.explain_text(stmt)
 
     def explain_text(self, stmt: t.Node) -> str:
-        if not isinstance(stmt, t.Query):
+        if not isinstance(stmt, (t.Query, t.SetOperation)):
             raise ValueError("EXPLAIN requires a query")
         logical = Planner(self.metadata).plan(stmt)
         optimized = optimize(logical, self.metadata)
         return format_plan(optimized)
 
-    def _execute_query(self, q: t.Query) -> QueryResult:
+    def _execute_query(self, q: t.Node) -> QueryResult:
         logical = Planner(self.metadata).plan(q)
         optimized = optimize(logical, self.metadata)
         phys = PhysicalPlanner(self.registry, self.config).plan(optimized)

@@ -1,0 +1,19 @@
+"""Distributed control plane: coordinator, workers, exchange, discovery.
+
+The host-side cluster runtime around the TPU compute path, mirroring the
+reference's layered control plane (SURVEY §1 L5-L7, §2.5, §2.8, §5.8):
+
+- ``fragmenter``   — AddExchanges + PlanFragmenter role: logical plan ->
+                     PlanFragments cut at exchange boundaries
+- ``buffers``      — worker-side OutputBuffers with the token-ack pull
+                     protocol (PartitionedOutputBuffer et al.)
+- ``exchangeop``   — PartitionedOutput/TaskOutput sinks and the Exchange
+                     source operator + HTTP ExchangeClient
+- ``task``         — worker task instantiation/execution (SqlTaskExecution)
+- ``worker``       — worker HTTP server (TaskResource)
+- ``coordinator``  — coordinator HTTP server: statement protocol, dispatch,
+                     discovery, heartbeat failure detection, scheduling
+- ``dqr``          — DistributedQueryRunner: real coordinator + N workers
+                     with real HTTP on ephemeral ports, in one process
+                     (DistributedQueryRunner.java:73 pattern)
+"""

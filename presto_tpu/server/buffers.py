@@ -1,0 +1,122 @@
+"""Worker-side output buffers with the token-acknowledged pull protocol.
+
+The reference's producer side holds serialized pages per consumer until the
+consumer GETs ``/results/{buffer}/{token}`` and implicitly acks everything
+below ``token`` (presto-main/.../execution/buffer/PartitionedOutputBuffer
+.java:42, client side HttpPageBufferClient.java:297) — at-least-once
+delivery with client-side dedup by token, backpressure via bounded bytes.
+Same semantics here: ``OutputBufferManager`` keeps one ``ClientBuffer`` per
+consumer partition; pages are wire-serialized Batches (presto_tpu.serde).
+
+Broadcast buffers enqueue every page to every partition (BroadcastOutput
+Buffer.java:51 role).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class ClientBuffer:
+    """Pages for one consumer, addressed by monotonically increasing
+    sequence tokens."""
+
+    def __init__(self):
+        self.pages: List[bytes] = []   # pages[token - base] = wire bytes
+        self.base = 0                  # token of pages[0]
+        self.no_more_pages = False
+
+    @property
+    def end_token(self) -> int:
+        return self.base + len(self.pages)
+
+
+class OutputBufferManager:
+    """All output buffers of one task (LazyOutputBuffer role: the topology
+    — number of partitions, broadcast or not — is set at task create)."""
+
+    def __init__(self, n_partitions: int, broadcast: bool = False,
+                 max_buffer_bytes: int = 256 << 20):
+        self.broadcast = broadcast
+        self.buffers: Dict[int, ClientBuffer] = {
+            i: ClientBuffer() for i in range(n_partitions)}
+        self.max_buffer_bytes = max_buffer_bytes
+        self._bytes = 0
+        self._lock = threading.Condition()
+        self._failed: Optional[Exception] = None
+
+    # -- producer side --------------------------------------------------
+    def enqueue(self, partition: int, page: bytes) -> None:
+        with self._lock:
+            # backpressure: block the producing driver while full
+            # (OutputBufferMemoryManager role)
+            while (self._bytes + len(page) > self.max_buffer_bytes
+                   and not self._failed):
+                self._lock.wait(timeout=1.0)
+            if self._failed:
+                raise self._failed
+            if self.broadcast:
+                for buf in self.buffers.values():
+                    buf.pages.append(page)
+                    self._bytes += len(page)
+            else:
+                self.buffers[partition].pages.append(page)
+                self._bytes += len(page)
+            self._lock.notify_all()
+
+    def set_no_more_pages(self) -> None:
+        with self._lock:
+            for buf in self.buffers.values():
+                buf.no_more_pages = True
+            self._lock.notify_all()
+
+    def fail(self, error: Exception) -> None:
+        with self._lock:
+            self._failed = error
+            self._lock.notify_all()
+
+    # -- consumer side --------------------------------------------------
+    def get_pages(self, partition: int, token: int,
+                  max_bytes: int = 16 << 20,
+                  wait_s: float = 0.0) -> Tuple[List[bytes], int, bool]:
+        """Returns (pages from ``token``, next token, complete).  Acks (and
+        frees) everything below ``token``.  Blocks up to ``wait_s`` when
+        nothing is available yet (long-poll)."""
+        deadline = None
+        with self._lock:
+            if self._failed:
+                raise self._failed
+            buf = self.buffers[partition]
+            # ack: drop pages below token
+            if token > buf.base:
+                drop = min(token - buf.base, len(buf.pages))
+                for page in buf.pages[:drop]:
+                    self._bytes -= len(page)
+                buf.pages = buf.pages[drop:]
+                buf.base += drop
+                self._lock.notify_all()
+            while True:
+                start = token - buf.base
+                avail = buf.pages[start:] if 0 <= start <= len(buf.pages) \
+                    else []
+                out: List[bytes] = []
+                size = 0
+                for page in avail:
+                    if out and size + len(page) > max_bytes:
+                        break
+                    out.append(page)
+                    size += len(page)
+                complete = (buf.no_more_pages
+                            and token + len(out) >= buf.end_token)
+                if out or complete or wait_s <= 0:
+                    return out, token + len(out), complete
+                if deadline is None:
+                    deadline = time.monotonic() + wait_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return out, token, False
+                self._lock.wait(timeout=remaining)
+                if self._failed:
+                    raise self._failed

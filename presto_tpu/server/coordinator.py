@@ -1,0 +1,329 @@
+"""Coordinator: statement protocol, dispatch, scheduling, discovery.
+
+Mirrors the reference's coordinator control plane (SURVEY §2.5, §3.2):
+
+- **Statement protocol** (QueuedStatementResource.java:86-87 +
+  ExecutingStatementResource.java:85-86): POST /v1/statement submits SQL,
+  the client follows ``nextUri`` until FINISHED, receiving JSON rows.
+- **Dispatch/execution** (DispatchManager.java:59, SqlQueryExecution
+  .java:95): a per-query thread parses, plans, optimizes, fragments
+  (server.fragmenter), schedules stage tasks onto workers bottom-up, then
+  drains the root stage's output buffer into the client result queue.
+- **Scheduling** (SqlQueryScheduler.java:112): task counts are a pure
+  function of fragment partitioning — 'source'/'hash' stages get one task
+  per live worker, 'single' one task; buffer topology and exchange
+  locations are wired at task-create (HttpRemoteTask.java:100 role is
+  ``_create_remote_task``).
+- **Discovery + failure detection** (DiscoveryNodeManager.java:68,
+  HeartbeatFailureDetector.java:77): workers announce at
+  POST /v1/announcement; a heartbeat thread GETs /v1/info on every node
+  and excludes nodes from scheduling after consecutive failures.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import pickle
+import threading
+import time
+import traceback
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.config import DEFAULT, EngineConfig
+from presto_tpu.connectors.api import ConnectorRegistry
+from presto_tpu.serde import deserialize_batch, frame_size
+from presto_tpu.server.fragmenter import DistributedPlan, Fragmenter
+from presto_tpu.sql import tree as t
+from presto_tpu.sql.optimizer import optimize
+from presto_tpu.sql.parser import parse_statement
+from presto_tpu.sql.planner import Metadata, Planner
+
+
+class NodeManager:
+    """Live-node registry + heartbeat failure detector."""
+
+    def __init__(self, max_missed: int = 3, interval_s: float = 0.5):
+        self.nodes: Dict[str, str] = {}       # node_id -> uri
+        self.missed: Dict[str, int] = {}
+        self.max_missed = max_missed
+        self.interval_s = interval_s
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._heartbeat_loop,
+                                        daemon=True, name="failure-detector")
+        self._thread.start()
+
+    def announce(self, node_id: str, uri: str) -> None:
+        with self._lock:
+            self.nodes[node_id] = uri
+            self.missed[node_id] = 0
+
+    def alive_nodes(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return [(nid, uri) for nid, uri in sorted(self.nodes.items())
+                    if self.missed.get(nid, 0) < self.max_missed]
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            with self._lock:
+                targets = list(self.nodes.items())
+            for nid, uri in targets:
+                ok = False
+                try:
+                    with urllib.request.urlopen(f"{uri}/v1/info",
+                                                timeout=2) as resp:
+                        ok = resp.status == 200
+                except Exception:  # noqa: BLE001
+                    ok = False
+                with self._lock:
+                    self.missed[nid] = 0 if ok else \
+                        self.missed.get(nid, 0) + 1
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class QueryExecution:
+    """One query's lifecycle (QueryStateMachine + SqlQueryExecution)."""
+
+    def __init__(self, query_id: str, sql: str,
+                 coordinator: "CoordinatorServer"):
+        self.query_id = query_id
+        self.sql = sql
+        self.co = coordinator
+        self.state = "QUEUED"
+        self.error: Optional[str] = None
+        self.column_names: List[str] = []
+        self.column_types: List[T.Type] = []
+        self.result_rows: List[tuple] = []
+        self.rows_done = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"query-{query_id}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            self.state = "PLANNING"
+            stmt = parse_statement(self.sql)
+            if not isinstance(stmt, (t.Query, t.SetOperation)):
+                raise ValueError("distributed execution supports queries")
+            metadata = Metadata(self.co.registry, self.co.default_catalog)
+            logical = Planner(metadata).plan(stmt)
+            optimized = optimize(logical, metadata)
+            dplan = Fragmenter(metadata=metadata).fragment(optimized)
+            self.column_names = dplan.column_names
+            self.column_types = dplan.column_types
+
+            self.state = "SCHEDULING"
+            root_locations = self._schedule(dplan)
+
+            self.state = "RUNNING"
+            self._drain(root_locations)
+            self.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001 - query failure surface
+            self.error = f"{e}"
+            self.co.log(traceback.format_exc())
+            self.state = "FAILED"
+        finally:
+            self.rows_done.set()
+
+    # -- scheduling -----------------------------------------------------
+    def _task_count(self, partitioning: str, n_workers: int) -> int:
+        return 1 if partitioning == "single" else max(1, n_workers)
+
+    def _schedule(self, dplan: DistributedPlan) -> List[str]:
+        workers = self.co.nodes.alive_nodes()
+        if not workers:
+            raise RuntimeError("no workers available "
+                               "(ClusterSizeMonitor would block here)")
+        n_workers = len(workers)
+        counts = {f.fragment_id: self._task_count(f.partitioning, n_workers)
+                  for f in dplan.fragments}
+        consumers: Dict[int, int] = {}  # producer fid -> consumer fid
+        for f in dplan.fragments:
+            for fid in f.consumed_fragments:
+                consumers[fid] = f.fragment_id
+
+        # producers first (fragments list is already topological)
+        task_uris: Dict[int, List[str]] = {}
+        for frag in dplan.fragments:
+            n_tasks = counts[frag.fragment_id]
+            cons_fid = consumers.get(frag.fragment_id)
+            if cons_fid is None:
+                n_out = 1          # root: coordinator drains partition 0
+                broadcast = False
+            else:
+                n_out = counts[cons_fid]
+                broadcast = frag.output_partitioning[0] == "broadcast"
+            remote: Dict[int, List[str]] = {}
+            for fid in frag.consumed_fragments:
+                remote[fid] = task_uris[fid]
+            uris = []
+            for i in range(n_tasks):
+                _, wuri = workers[i % n_workers]
+                task_id = f"{self.query_id}.{frag.fragment_id}.{i}"
+                # each consumer task i polls ITS OWN partition i on every
+                # producer task; producer URIs carry a {part} placeholder
+                # the consumer's index resolves
+                self._create_remote_task(
+                    wuri, task_id, frag, (i, n_tasks), remote,
+                    n_out, broadcast, consumer_index=i)
+                uris.append(
+                    f"{wuri}/v1/task/{task_id}/results/{{part}}")
+            task_uris[frag.fragment_id] = uris
+        return [u.format(part=0)
+                for u in task_uris[dplan.root_fragment_id]]
+
+    def _create_remote_task(self, worker_uri: str, task_id: str, frag,
+                            scan_shard, remote, n_out, broadcast,
+                            consumer_index: int) -> None:
+        resolved = {fid: [u.format(part=consumer_index) for u in us]
+                    for fid, us in remote.items()}
+        body = pickle.dumps({
+            "fragment": frag,
+            "scan_shard": scan_shard,
+            "remote_sources": resolved,
+            "n_output_partitions": n_out,
+            "broadcast_output": broadcast,
+        })
+        req = urllib.request.Request(
+            f"{worker_uri}/v1/task/{task_id}", data=body, method="POST",
+            headers={"Content-Type": "application/x-pickle"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            info = json.loads(resp.read())
+            if info.get("state") == "FAILED":
+                raise RuntimeError(f"task create failed: {info}")
+
+    # -- result drain ---------------------------------------------------
+    def _drain(self, locations: List[str]) -> None:
+        for loc in locations:
+            token = 0
+            while True:
+                url = f"{loc}/{token}"
+                with urllib.request.urlopen(url, timeout=120) as resp:
+                    complete = resp.headers.get(
+                        "X-Presto-Buffer-Complete") == "true"
+                    token = int(resp.headers.get("X-Presto-Next-Token",
+                                                 token))
+                    body = resp.read()
+                off = 0
+                while off < len(body):
+                    size = frame_size(body, off)
+                    batch = deserialize_batch(body[off:off + size])
+                    self.result_rows.extend(batch.to_pylist())
+                    off += size
+                if complete:
+                    break
+
+    # -- client protocol ------------------------------------------------
+    def results_payload(self, base_uri: str) -> Dict:
+        out: Dict = {"id": self.query_id, "stats": {"state": self.state}}
+        if self.state == "FAILED":
+            out["error"] = {"message": self.error or "query failed"}
+            return out
+        if self.state != "FINISHED":
+            out["nextUri"] = f"{base_uri}/v1/statement/executing/" \
+                             f"{self.query_id}/0"
+            return out
+        out["columns"] = [
+            {"name": n, "type": typ.display()}
+            for n, typ in zip(self.column_names, self.column_types)]
+        out["data"] = [[_json_value(v) for v in row]
+                       for row in self.result_rows]
+        return out
+
+
+def _json_value(v):
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
+
+
+class CoordinatorServer:
+    def __init__(self, registry: ConnectorRegistry, default_catalog: str,
+                 config: EngineConfig = DEFAULT, port: int = 0,
+                 verbose: bool = False):
+        self.registry = registry
+        self.default_catalog = default_catalog
+        self.config = config
+        self.verbose = verbose
+        self.nodes = NodeManager()
+        self.queries: Dict[str, QueryExecution] = {}
+        co = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if parts == ["v1", "statement"]:
+                    n = int(self.headers.get("Content-Length", 0))
+                    sql = self.rfile.read(n).decode("utf-8")
+                    qid = uuid.uuid4().hex[:16]
+                    q = QueryExecution(qid, sql, co)
+                    co.queries[qid] = q
+                    self._json(200, {
+                        "id": qid,
+                        "nextUri": f"{co.uri}/v1/statement/executing/"
+                                   f"{qid}/0",
+                        "stats": {"state": q.state}})
+                    return
+                if parts == ["v1", "announcement"]:
+                    n = int(self.headers.get("Content-Length", 0))
+                    ann = json.loads(self.rfile.read(n))
+                    co.nodes.announce(ann["nodeId"], ann["uri"])
+                    self._json(200, {"ok": True})
+                    return
+                self._json(404, {"error": f"bad path {self.path}"})
+
+            def do_GET(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if parts[:3] == ["v1", "statement", "executing"] \
+                        and len(parts) == 5:
+                    q = co.queries.get(parts[3])
+                    if q is None:
+                        self._json(404, {"error": "no such query"})
+                        return
+                    # block briefly for long-poll semantics
+                    q.rows_done.wait(timeout=0.5)
+                    self._json(200, q.results_payload(co.uri))
+                    return
+                if parts == ["v1", "info"]:
+                    self._json(200, {"coordinator": True,
+                                     "nodes": co.nodes.alive_nodes()})
+                    return
+                self._json(404, {"error": f"bad path {self.path}"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="coordinator-http")
+        self._thread.start()
+
+    def log(self, msg: str) -> None:
+        if self.verbose:
+            print(msg)
+
+    def close(self) -> None:
+        self.nodes.close()
+        self._httpd.shutdown()
+        self._httpd.server_close()

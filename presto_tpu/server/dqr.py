@@ -1,0 +1,95 @@
+"""DistributedQueryRunner: a real multi-node cluster in one process.
+
+The reference's key test trick (presto-testing/.../DistributedQueryRunner
+.java:73,97-123): boot a real coordinator and N-1 workers in one JVM with
+real HTTP on ephemeral ports and real exchanges, giving multi-node
+behavior without a cluster.  Identical here: one CoordinatorServer + N
+WorkerServers on 127.0.0.1 ephemeral ports, workers announced to the
+coordinator's discovery, queries executed through the real client
+protocol with real serde'd pages on the exchange wire.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.client import StatementClient
+from presto_tpu.config import DEFAULT, EngineConfig
+from presto_tpu.connectors.api import ConnectorRegistry
+from presto_tpu.localrunner import QueryResult
+from presto_tpu.server.coordinator import CoordinatorServer
+from presto_tpu.server.worker import WorkerServer
+
+
+class DistributedQueryRunner:
+    def __init__(self, registry_factory: Callable[[], ConnectorRegistry],
+                 default_catalog: str, n_workers: int = 3,
+                 config: EngineConfig = DEFAULT, verbose: bool = False):
+        # each node builds its own registry, as each reference node loads
+        # its own connector instances from catalog config
+        self.coordinator = CoordinatorServer(
+            registry_factory(), default_catalog, config, verbose=verbose)
+        self.workers: List[WorkerServer] = []
+        for i in range(n_workers):
+            w = WorkerServer(registry_factory(), config,
+                             node_id=f"worker-{i}")
+            self.workers.append(w)
+            self._announce(w)
+        self.client = StatementClient(self.coordinator.uri)
+
+    def _announce(self, worker: WorkerServer) -> None:
+        import json
+        import urllib.request
+
+        body = json.dumps({"nodeId": worker.node_id,
+                           "uri": worker.uri}).encode()
+        req = urllib.request.Request(
+            f"{self.coordinator.uri}/v1/announcement", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+
+    @classmethod
+    def tpch(cls, scale: float = 0.01, n_workers: int = 3,
+             config: EngineConfig = DEFAULT) -> "DistributedQueryRunner":
+        def factory() -> ConnectorRegistry:
+            from presto_tpu.connectors.tpch import TpchConnector
+
+            reg = ConnectorRegistry()
+            reg.register("tpch", TpchConnector(scale=scale))
+            return reg
+
+        return cls(factory, "tpch", n_workers, config)
+
+    def execute(self, sql: str) -> QueryResult:
+        columns, data = self.client.execute(sql)
+        names = [c["name"] for c in columns]
+        types = [T.parse_type(c["type"]) for c in columns]
+        rows = [tuple(_from_json(v, typ) for v, typ in zip(row, types))
+                for row in data]
+        return QueryResult(names, types, rows)
+
+    def close(self) -> None:
+        for w in self.workers:
+            w.close()
+        self.coordinator.close()
+
+    def __enter__(self) -> "DistributedQueryRunner":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _from_json(v, typ: T.Type):
+    """Invert the client protocol's JSON value encoding."""
+    import datetime
+
+    if v is None:
+        return None
+    if typ.name == "date" and isinstance(v, str):
+        return datetime.date.fromisoformat(v)
+    if typ.name == "timestamp" and isinstance(v, str):
+        return datetime.datetime.fromisoformat(v)
+    return v

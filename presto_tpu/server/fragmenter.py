@@ -1,0 +1,215 @@
+"""Plan fragmentation: logical plan -> distributed PlanFragments.
+
+Combines the roles of AddExchanges (choosing the inter-node parallelism
+strategy per subtree, presto-main/.../optimizations/AddExchanges.java:114)
+and PlanFragmenter (cutting the plan at remote exchanges,
+presto-main/.../PlanFragmenter.java:88): the optimized single-node plan is
+walked bottom-up; aggregations are split into PARTIAL (in the scan
+fragment) -> hash exchange on the group keys -> FINAL, equi-joins become
+either co-hash-partitioned exchanges (P1/P8) or a broadcast of a small
+build side (P2), and everything above the topmost exchange runs in a
+SINGLE gather fragment.
+
+Partitioning vocabulary carried on each fragment mirrors
+SystemPartitioningHandle.java:49-63: 'source' (leaf scans, split-driven),
+'hash' (fixed hash on output channels), 'single' (one task).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.sql.plan import (
+    AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
+    OutputNode, PlanNode, ProjectNode, RemoteSourceNode, SemiJoinNode,
+    SortNode, TableScanNode, UnionNode, ValuesNode, WindowNode,
+)
+
+
+@dataclasses.dataclass
+class PlanFragment:
+    """One stage of the distributed plan (PlanFragment analogue).
+
+    ``partitioning``: how tasks of this fragment are placed —
+      'source' = one task per worker, driven by connector splits;
+      'hash'   = fixed task count, input hash-partitioned;
+      'single' = exactly one task (gather).
+    ``output_partitioning``: how this fragment's output is routed to the
+    consumer — ('hash', channels) / ('single', ()) / ('broadcast', ()).
+    """
+
+    fragment_id: int
+    root: PlanNode
+    partitioning: str
+    output_partitioning: Tuple[str, Tuple[int, ...]]
+    consumed_fragments: Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class DistributedPlan:
+    fragments: List[PlanFragment]          # topological: producers first
+    root_fragment_id: int
+    column_names: List[str]
+    column_types: List[T.Type]
+
+
+class Fragmenter:
+    """One instance per query."""
+
+    def __init__(self, broadcast_row_limit: int = 100_000,
+                 metadata=None):
+        self.broadcast_row_limit = broadcast_row_limit
+        self.metadata = metadata
+        self.fragments: List[PlanFragment] = []
+
+    def fragment(self, root: OutputNode) -> DistributedPlan:
+        node, child_frags = self._visit(root.source)
+        # everything left runs in the SINGLE gather fragment
+        fid = self._add(node, "single", ("single", ()), child_frags)
+        return DistributedPlan(self.fragments, fid,
+                               [n for n, _ in root.columns],
+                               [t for _, t in root.columns])
+
+    def _add(self, root: PlanNode, partitioning: str,
+             output_partitioning: Tuple[str, Tuple[int, ...]],
+             consumed: Sequence[int]) -> int:
+        fid = len(self.fragments)
+        self.fragments.append(PlanFragment(
+            fid, root, partitioning, output_partitioning, tuple(consumed)))
+        return fid
+
+    # ------------------------------------------------------------------
+    # Visitor: returns (node-for-current-fragment, consumed fragment ids).
+    # A returned RemoteSourceNode means the subtree was cut into its own
+    # fragment(s).
+    # ------------------------------------------------------------------
+    def _visit(self, node: PlanNode) -> Tuple[PlanNode, List[int]]:
+        if isinstance(node, AggregationNode):
+            return self._visit_aggregation(node)
+        if isinstance(node, JoinNode):
+            return self._visit_join(node)
+        if isinstance(node, SemiJoinNode):
+            return self._visit_semijoin(node)
+        if isinstance(node, (FilterNode, ProjectNode, LimitNode, SortNode,
+                             WindowNode, EnforceSingleRowNode, UnionNode)):
+            # stays in the consumer fragment; recurse into sources
+            new_sources = []
+            consumed: List[int] = []
+            for s in node.sources:
+                ns, c = self._visit(s)
+                new_sources.append(ns)
+                consumed += c
+            return _replace_sources(node, new_sources), consumed
+        # leaves (TableScan, Values) stay put
+        return node, []
+
+    def _source_fragment(self, node: PlanNode,
+                         consumed: Sequence[int],
+                         output: Tuple[str, Tuple[int, ...]]) -> int:
+        """Cut ``node`` into its own fragment.  Fragments containing a
+        table scan are 'source'-partitioned (split-driven); fragments fed
+        only by exchanges are 'hash'-partitioned."""
+        part = "source" if _has_scan(node) else "hash"
+        return self._add(node, part, output, consumed)
+
+    def _visit_aggregation(self, node: AggregationNode):
+        src, consumed = self._visit(node.source)
+        if any(a.distinct for a in node.aggregates):
+            # distinct aggs need every row of a group on one node; hash
+            # exchange on the group keys then single-step aggregate
+            if not node.group_channels:
+                return _replace_sources(node, [src]), consumed
+            fid = self._source_fragment(
+                src, consumed, ("hash", tuple(node.group_channels)))
+            remote = RemoteSourceNode((fid,), tuple(node.source.columns))
+            return _replace_sources(node, [remote]), [fid]
+
+        # PARTIAL in the producer fragment
+        ngroups = len(node.group_channels)
+        comp_cols: List[Tuple[str, T.Type]] = [
+            node.columns[i] for i in range(ngroups)]
+        ci = 0
+        for agg in node.aggregates:
+            for prim, ctype in agg.spec.components:
+                comp_cols.append((f"$comp{ci}", ctype))
+                ci += 1
+        partial = AggregationNode(src, node.group_channels, node.aggregates,
+                                  tuple(comp_cols), step="partial")
+        if ngroups:
+            out = ("hash", tuple(range(ngroups)))
+        else:
+            out = ("single", ())
+        fid = self._source_fragment(partial, consumed, out)
+        remote = RemoteSourceNode((fid,), tuple(comp_cols))
+        final = AggregationNode(remote, tuple(range(ngroups)),
+                                node.aggregates, node.columns, step="final")
+        return final, [fid]
+
+    def _estimate_rows(self, node: PlanNode) -> float:
+        try:
+            from presto_tpu.sql.optimizer import _estimate_rows
+
+            return _estimate_rows(node, self.metadata)
+        except Exception:
+            return float("inf")
+
+    def _visit_join(self, node: JoinNode):
+        if node.kind == "cross" or not node.left_keys:
+            # cross joins gather to the single fragment
+            left, lc = self._visit(node.left)
+            right, rc = self._visit(node.right)
+            return _replace_sources(node, [left, right]), lc + rc
+        left, lc = self._visit(node.left)
+        right, rc = self._visit(node.right)
+
+        if self._estimate_rows(node.right) <= self.broadcast_row_limit:
+            # P2: broadcast the small build side into every probe task;
+            # probe stays in ITS OWN fragment (no exchange for probe rows)
+            rfid = self._source_fragment(
+                right, rc, ("broadcast", ()))
+            remote_r = RemoteSourceNode((rfid,), tuple(node.right.columns))
+            return (_replace_sources(node, [left, remote_r]), lc + [rfid])
+
+        # P1/P8: co-hash-partition both sides on the join keys
+        lfid = self._source_fragment(
+            left, lc, ("hash", tuple(node.left_keys)))
+        rfid = self._source_fragment(
+            right, rc, ("hash", tuple(node.right_keys)))
+        remote_l = RemoteSourceNode((lfid,), tuple(node.left.columns))
+        remote_r = RemoteSourceNode((rfid,), tuple(node.right.columns))
+        return (_replace_sources(node, [remote_l, remote_r]),
+                [lfid, rfid])
+
+    def _visit_semijoin(self, node: SemiJoinNode):
+        src, sc = self._visit(node.source)
+        filt, fc = self._visit(node.filtering)
+        # filtering side is usually small: broadcast it
+        ffid = self._source_fragment(filt, fc, ("broadcast", ()))
+        remote_f = RemoteSourceNode((ffid,), tuple(node.filtering.columns))
+        return _replace_sources(node, [src, remote_f]), sc + [ffid]
+
+
+def _has_scan(node: PlanNode) -> bool:
+    if isinstance(node, TableScanNode):
+        return True
+    return any(_has_scan(s) for s in node.sources)
+
+
+def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
+    if not sources:
+        return node
+    fields: Dict[str, object] = {}
+    names = [f.name for f in dataclasses.fields(node)]
+    if "left" in names:
+        fields["left"] = sources[0]
+        fields["right"] = sources[1]
+    elif "filtering" in names:
+        fields["source"] = sources[0]
+        fields["filtering"] = sources[1]
+    elif "inputs" in names:
+        fields["inputs"] = tuple(sources)
+    elif "source" in names:
+        fields["source"] = sources[0]
+    return dataclasses.replace(node, **fields)

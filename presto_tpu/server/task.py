@@ -1,0 +1,113 @@
+"""Worker-side task: one fragment instance executing on one node.
+
+SqlTask/SqlTaskExecution role (presto-main/.../execution/SqlTask.java:67,
+SqlTaskExecution.java:82): a task receives a PlanFragment + its scan shard
++ upstream exchange locations + output buffer topology, lowers the
+fragment to pipelines (LocalExecutionPlanner role), and runs them on an
+executor thread, streaming output pages into its OutputBufferManager until
+drained by consumers.
+
+Task states mirror TaskState.java: RUNNING -> FINISHED | FAILED | CANCELED.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.config import DEFAULT, EngineConfig
+from presto_tpu.connectors.api import ConnectorRegistry
+from presto_tpu.exec.context import QueryContext, TaskContext
+from presto_tpu.exec.runner import execute_pipelines
+from presto_tpu.server.buffers import OutputBufferManager
+from presto_tpu.server.exchangeop import (
+    PartitionedOutputOperatorFactory, TaskOutputOperatorFactory,
+)
+from presto_tpu.server.fragmenter import PlanFragment
+from presto_tpu.sql.physical import PhysicalPlanner
+
+
+class SqlTask:
+    def __init__(self, task_id: str, fragment: PlanFragment,
+                 scan_shard: Tuple[int, int],
+                 remote_sources: Dict[int, List[str]],
+                 n_output_partitions: int, broadcast_output: bool,
+                 registry: ConnectorRegistry,
+                 config: EngineConfig = DEFAULT):
+        self.task_id = task_id
+        self.fragment = fragment
+        self.state = "RUNNING"
+        self.error: Optional[str] = None
+        self.buffers = OutputBufferManager(
+            n_output_partitions, broadcast=broadcast_output)
+        self._stats: Optional[TaskContext] = None
+
+        planner = PhysicalPlanner(registry, config,
+                                  scan_shard=scan_shard,
+                                  remote_sources=remote_sources)
+        kind, channels = fragment.output_partitioning
+        if kind == "hash" and n_output_partitions > 1:
+            sink = PartitionedOutputOperatorFactory(
+                self.buffers, channels, n_output_partitions)
+        else:  # 'single', 'broadcast', or 1-consumer hash
+            sink = TaskOutputOperatorFactory(self.buffers)
+        self._pipelines = planner.plan_fragment(fragment.root, sink)
+        self._thread = threading.Thread(
+            target=self._run, name=f"task-{task_id}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            self._stats = execute_pipelines(self._pipelines)
+            self.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001 - task failure surface
+            self.error = f"{e}\n{traceback.format_exc()}"
+            self.state = "FAILED"
+            self.buffers.fail(RuntimeError(f"task {self.task_id}: {e}"))
+
+    def info(self) -> Dict:
+        return {"taskId": self.task_id, "state": self.state,
+                "error": self.error}
+
+    def cancel(self) -> None:
+        if self.state == "RUNNING":
+            self.state = "CANCELED"
+            self.buffers.fail(RuntimeError("task canceled"))
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+
+class SqlTaskManager:
+    """Worker task registry (SqlTaskManager.java:84 role)."""
+
+    def __init__(self, registry: ConnectorRegistry,
+                 config: EngineConfig = DEFAULT):
+        self.registry = registry
+        self.config = config
+        self.tasks: Dict[str, SqlTask] = {}
+        self._lock = threading.Lock()
+
+    def create_task(self, task_id: str, fragment: PlanFragment,
+                    scan_shard: Tuple[int, int],
+                    remote_sources: Dict[int, List[str]],
+                    n_output_partitions: int,
+                    broadcast_output: bool) -> SqlTask:
+        with self._lock:
+            if task_id in self.tasks:
+                return self.tasks[task_id]
+            task = SqlTask(task_id, fragment, scan_shard, remote_sources,
+                           n_output_partitions, broadcast_output,
+                           self.registry, self.config)
+            self.tasks[task_id] = task
+            return task
+
+    def get(self, task_id: str) -> Optional[SqlTask]:
+        with self._lock:
+            return self.tasks.get(task_id)
+
+    def cancel_all(self) -> None:
+        with self._lock:
+            for task in self.tasks.values():
+                task.cancel()

@@ -1,0 +1,132 @@
+"""Worker HTTP server: the TaskResource surface.
+
+Routes mirror the reference's worker REST API
+(presto-main/.../server/TaskResource.java:83-84,121-124,240-244):
+
+    POST   /v1/task/{taskId}                      create/update task
+    GET    /v1/task/{taskId}                      task info/status
+    DELETE /v1/task/{taskId}                      cancel
+    GET    /v1/task/{taskId}/results/{buffer}/{token}   page fetch + ack
+    GET    /v1/info                               node info (heartbeat ping)
+
+Control bodies are pickled fragment descriptors (one trusted cluster, the
+in-process DistributedQueryRunner pattern); data responses are raw
+concatenated wire frames (presto_tpu.serde) with token bookkeeping in
+headers — the PRESTO_PAGES content-type role.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from presto_tpu.config import DEFAULT, EngineConfig
+from presto_tpu.connectors.api import ConnectorRegistry
+from presto_tpu.server.task import SqlTaskManager
+
+
+class WorkerServer:
+    def __init__(self, registry: ConnectorRegistry,
+                 config: EngineConfig = DEFAULT, port: int = 0,
+                 node_id: str = "worker"):
+        self.node_id = node_id
+        self.task_manager = SqlTaskManager(registry, config)
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["v1", "info"]:
+                    self._json(200, {"nodeId": worker.node_id,
+                                     "state": "ACTIVE"})
+                    return
+                if parts[:2] == ["v1", "task"] and len(parts) == 3:
+                    task = worker.task_manager.get(parts[2])
+                    if task is None:
+                        self._json(404, {"error": "no such task"})
+                        return
+                    self._json(200, task.info())
+                    return
+                if (parts[:2] == ["v1", "task"] and len(parts) == 6
+                        and parts[3] == "results"):
+                    self._results(parts[2], int(parts[4]), int(parts[5]))
+                    return
+                self._json(404, {"error": f"bad path {self.path}"})
+
+            def _results(self, task_id: str, buffer_id: int,
+                         token: int) -> None:
+                task = worker.task_manager.get(task_id)
+                if task is None:
+                    self._json(404, {"error": "no such task"})
+                    return
+                try:
+                    pages, next_token, complete = task.buffers.get_pages(
+                        buffer_id, token, wait_s=1.0)
+                except Exception as e:  # noqa: BLE001
+                    self._json(500, {"error": str(e)})
+                    return
+                body = b"".join(pages)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-presto-pages")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Presto-Next-Token", str(next_token))
+                self.send_header("X-Presto-Buffer-Complete",
+                                 "true" if complete else "false")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["v1", "task"] and len(parts) == 3:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = pickle.loads(self.rfile.read(n))
+                    task = worker.task_manager.create_task(
+                        task_id=parts[2],
+                        fragment=req["fragment"],
+                        scan_shard=tuple(req["scan_shard"]),
+                        remote_sources=req["remote_sources"],
+                        n_output_partitions=req["n_output_partitions"],
+                        broadcast_output=req["broadcast_output"])
+                    self._json(200, task.info())
+                    return
+                self._json(404, {"error": f"bad path {self.path}"})
+
+            def do_DELETE(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["v1", "task"] and len(parts) == 3:
+                    task = worker.task_manager.get(parts[2])
+                    if task is not None:
+                        task.cancel()
+                    self._json(200, {"canceled": True})
+                    return
+                self._json(404, {"error": f"bad path {self.path}"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"worker-http-{self.port}")
+        self._thread.start()
+
+    def close(self) -> None:
+        self.task_manager.cancel_all()
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -36,7 +36,8 @@ KEYWORDS = {
     "desc", "nulls", "first", "last", "distinct", "all", "union", "except",
     "intersect", "with", "explain", "analyze", "show", "tables", "columns",
     "substring", "for", "coalesce", "nullif", "year", "month", "day",
-    "hour", "minute", "second",
+    "hour", "minute", "second", "over", "partition", "rows", "range",
+    "unbounded", "preceding", "following", "current", "row",
 }
 
 _TWO_CHAR = ("<=", ">=", "<>", "!=", "||")

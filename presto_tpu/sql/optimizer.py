@@ -30,7 +30,7 @@ from presto_tpu.expr.ir import (
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanAggregate, PlanNode, ProjectNode, SemiJoinNode,
-    SortNode, TableScanNode, ValuesNode,
+    SortNode, TableScanNode, UnionNode, ValuesNode, WindowNode,
 )
 
 
@@ -102,6 +102,8 @@ def _replace_sources(node: PlanNode,
     if "filtering" in names:
         fields["source"] = sources[0]
         fields["filtering"] = sources[1]
+    if "inputs" in names:
+        fields["inputs"] = tuple(sources)
     return dataclasses.replace(node, **fields)
 
 
@@ -478,4 +480,34 @@ def _prune(node: PlanNode,
     if isinstance(node, OutputNode):
         src, m = _prune(node.source, needed)
         return dataclasses.replace(node, source=src), m
+    if isinstance(node, WindowNode):
+        # keep the full source schema (window output is source-prefix +
+        # function channels); prune only unused function channels
+        n_src = len(node.source.columns)
+        src, m = _prune(node.source, sorted(range(n_src)))
+        keep = [i for i in range(len(node.functions))
+                if (n_src + i) in needed]
+        funcs = tuple(node.functions[i] for i in keep)
+        cols = (tuple(src.columns)
+                + tuple(node.columns[n_src + i] for i in keep))
+        new_node = WindowNode(src, node.partition_channels,
+                              node.order_keys, funcs, cols)
+        mapping = {ch: ch for ch in range(n_src)}
+        for newpos, i in enumerate(keep):
+            mapping[n_src + i] = n_src + newpos
+        return new_node, {ch: mapping[ch] for ch in needed}
+    if isinstance(node, UnionNode):
+        pruned = []
+        for inp in node.inputs:
+            src, m = _prune(inp, list(needed))
+            # normalize each branch to exactly `needed` order
+            if [m[ch] for ch in needed] != list(range(len(needed))):
+                exprs = tuple(InputRef(m[ch], node.columns[ch][1])
+                              for ch in needed)
+                cols = tuple(node.columns[ch] for ch in needed)
+                src = ProjectNode(src, exprs, cols)
+            pruned.append(src)
+        cols = tuple(node.columns[ch] for ch in needed)
+        return (UnionNode(tuple(pruned), cols),
+                {ch: i for i, ch in enumerate(needed)})
     raise NotImplementedError(f"prune: {type(node).__name__}")

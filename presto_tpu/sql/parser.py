@@ -11,6 +11,7 @@ reference grammar: OR < AND < NOT < predicate < additive < multiplicative
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Optional, Tuple
 
 from presto_tpu.sql import tree as t
@@ -122,7 +123,7 @@ class _Parser:
         self.expect_eof()
         return q
 
-    def query(self) -> t.Query:
+    def query(self) -> t.Node:
         with_queries: List[Tuple[str, t.Query]] = []
         if self.accept_kw("with"):
             while True:
@@ -133,10 +134,67 @@ class _Parser:
                 self.expect_op(")")
                 if not self.accept_op(","):
                     break
-        body = self.query_body()
-        return t.Query(body.select, body.relations, body.where,
-                       body.group_by, body.having, body.order_by,
-                       body.limit, body.distinct, tuple(with_queries))
+        body = self.query_expr()
+        if isinstance(body, t.Query):
+            return t.Query(body.select, body.relations, body.where,
+                           body.group_by, body.having, body.order_by,
+                           body.limit, body.distinct, tuple(with_queries))
+        return t.SetOperation(body.op, body.all, body.left, body.right,
+                              body.order_by, body.limit, tuple(with_queries))
+
+    def query_expr(self) -> t.Node:
+        """query_term (UNION|EXCEPT [ALL] query_term)* [ORDER BY] [LIMIT];
+        INTERSECT binds tighter than UNION/EXCEPT (SqlBase.g4 precedence)."""
+        node = self.query_term()
+        while self.at_kw("union", "except"):
+            op = self.next().text
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            node = t.SetOperation(op, all_, node, self.query_term())
+        order_by, limit = self._order_limit()
+        if order_by or limit is not None:
+            if isinstance(node, t.SetOperation):
+                node = t.SetOperation(node.op, node.all, node.left,
+                                      node.right, order_by, limit)
+            else:
+                node = dataclasses.replace(node, order_by=order_by,
+                                           limit=limit)
+        return node
+
+    def query_term(self) -> t.Node:
+        node = self.query_primary()
+        while self.at_kw("intersect"):
+            self.next()
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            node = t.SetOperation("intersect", all_, node,
+                                  self.query_primary())
+        return node
+
+    def query_primary(self) -> t.Node:
+        if self.at_op("(") and self.peek(1).kind == "KEYWORD" \
+                and self.peek(1).text in ("select", "with", "("):
+            self.next()
+            q = self.query()
+            self.expect_op(")")
+            return q
+        return self.query_body()
+
+    def _order_limit(self):
+        order_by: List[t.SortItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.sort_item())
+            while self.accept_op(","):
+                order_by.append(self.sort_item())
+        limit = None
+        if self.accept_kw("limit"):
+            tok = self.next()
+            if tok.kind != "NUMBER":
+                raise SqlSyntaxError("expected LIMIT count", tok.line,
+                                     tok.col)
+            limit = int(tok.text)
+        return tuple(order_by), limit
 
     def query_body(self) -> t.Query:
         self.expect_kw("select")
@@ -162,24 +220,10 @@ class _Parser:
                 group_by.append(self.expression())
 
         having = self.expression() if self.accept_kw("having") else None
-
-        order_by: List[t.SortItem] = []
-        if self.accept_kw("order"):
-            self.expect_kw("by")
-            order_by.append(self.sort_item())
-            while self.accept_op(","):
-                order_by.append(self.sort_item())
-
-        limit = None
-        if self.accept_kw("limit"):
-            tok = self.next()
-            if tok.kind != "NUMBER":
-                raise SqlSyntaxError("expected LIMIT count", tok.line,
-                                     tok.col)
-            limit = int(tok.text)
+        # ORDER BY / LIMIT are parsed by query_expr so they attach to the
+        # whole set operation when UNION/INTERSECT/EXCEPT follows.
         return t.Query(tuple(select), tuple(relations), where,
-                       tuple(group_by), having, tuple(order_by), limit,
-                       distinct)
+                       tuple(group_by), having, (), None, distinct)
 
     def select_item(self) -> t.SelectItem:
         if self.at_op("*"):
@@ -511,17 +555,64 @@ class _Parser:
         self.expect_op("(")
         if self.accept_op("*"):
             self.expect_op(")")
-            return t.FunctionCall(name, (), is_star=True)
-        if self.at_op(")"):
+            call = t.FunctionCall(name, (), is_star=True)
+        elif self.at_op(")"):
             self.next()
-            return t.FunctionCall(name, ())
-        distinct = bool(self.accept_kw("distinct"))
-        self.accept_kw("all")
-        args = [self.expression()]
-        while self.accept_op(","):
-            args.append(self.expression())
+            call = t.FunctionCall(name, ())
+        else:
+            distinct = bool(self.accept_kw("distinct"))
+            self.accept_kw("all")
+            args = [self.expression()]
+            while self.accept_op(","):
+                args.append(self.expression())
+            self.expect_op(")")
+            call = t.FunctionCall(name, tuple(args), distinct)
+        if self.accept_kw("over"):
+            call = dataclasses.replace(call, window=self.window_spec())
+        return call
+
+    def window_spec(self) -> t.WindowSpec:
+        self.expect_op("(")
+        partition_by: List[t.Expression] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.expression())
+            while self.accept_op(","):
+                partition_by.append(self.expression())
+        order_by: List[t.SortItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.sort_item())
+            while self.accept_op(","):
+                order_by.append(self.sort_item())
+        frame = None
+        if self.at_kw("rows", "range"):
+            unit = self.next().text
+            if self.accept_kw("between"):
+                start = self.frame_bound()
+                self.expect_kw("and")
+                end = self.frame_bound()
+            else:
+                start = self.frame_bound()
+                end = t.FrameBound("current")
+            frame = t.WindowFrame(unit, start, end)
         self.expect_op(")")
-        return t.FunctionCall(name, tuple(args), distinct)
+        return t.WindowSpec(tuple(partition_by), tuple(order_by), frame)
+
+    def frame_bound(self) -> t.FrameBound:
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return t.FrameBound("unbounded_preceding")
+            self.expect_kw("following")
+            return t.FrameBound("unbounded_following")
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return t.FrameBound("current")
+        value = self.expression()
+        if self.accept_kw("preceding"):
+            return t.FrameBound("preceding", value)
+        self.expect_kw("following")
+        return t.FrameBound("following", value)
 
     def type_name(self) -> str:
         tok = self.next()

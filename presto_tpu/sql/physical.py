@@ -39,12 +39,16 @@ from presto_tpu.exec.operators import (
     OutputCollectorFactory, TableScanOperatorFactory, ValuesOperatorFactory,
 )
 from presto_tpu.exec.sortop import OrderByOperatorFactory, SortSpec
+from presto_tpu.exec.unionop import (
+    UnionBuffer, UnionSinkOperatorFactory, UnionSourceOperatorFactory,
+)
+from presto_tpu.exec.windowop import WindowOperatorFactory
 from presto_tpu.expr import build as B
 from presto_tpu.expr.ir import InputRef, RowExpression
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
-    OutputNode, PlanAggregate, PlanNode, ProjectNode, SemiJoinNode,
-    SortNode, TableScanNode, ValuesNode,
+    OutputNode, PlanAggregate, PlanNode, ProjectNode, RemoteSourceNode,
+    SemiJoinNode, SortNode, TableScanNode, UnionNode, ValuesNode, WindowNode,
 )
 
 
@@ -58,9 +62,17 @@ class PhysicalPlan:
 
 class PhysicalPlanner:
     def __init__(self, registry: ConnectorRegistry,
-                 config: EngineConfig = DEFAULT):
+                 config: EngineConfig = DEFAULT,
+                 scan_shard: Optional[Tuple[int, int]] = None,
+                 remote_sources: Optional[dict] = None):
+        """``scan_shard=(task_index, task_count)`` makes scans generate only
+        this task's deterministic share of splits (distributed source
+        stages, P5); ``remote_sources`` maps fragment id -> producer buffer
+        URLs for RemoteSourceNode lowering."""
         self.registry = registry
         self.config = config
+        self.scan_shard = scan_shard
+        self.remote_sources = remote_sources or {}
         self._done_pipelines: List[Pipeline] = []
         self._counter = 0
 
@@ -74,6 +86,16 @@ class PhysicalPlanner:
                             [n for n, _ in root.columns],
                             [t for _, t in root.columns])
 
+    def plan_fragment(self, root: PlanNode,
+                      sink_factory) -> List[Pipeline]:
+        """Lower a fragment root and terminate it with the given output
+        sink (PartitionedOutput/TaskOutput) — the worker-task entry."""
+        factories, splits = self._lower(root)
+        factories.append(sink_factory)
+        self._done_pipelines.append(
+            Pipeline(factories, splits, name="fragment"))
+        return self._done_pipelines
+
     # -- lowering -----------------------------------------------------------
     def _lower(self, node: PlanNode):
         """Returns (operator factory chain, splits) producing node's
@@ -82,10 +104,26 @@ class PhysicalPlanner:
         if isinstance(node, TableScanNode):
             conn = self.registry.get(node.catalog)
             handle = conn.get_table(node.table)
-            splits = conn.get_splits(handle, 1)
+            if self.scan_shard is None:
+                splits = conn.get_splits(handle, 1)
+            else:
+                # deterministic split-modulo placement: every task of a
+                # source stage generates the full split list and keeps its
+                # residue class (the SourcePartitionedScheduler role
+                # without central placement)
+                idx, count = self.scan_shard
+                all_splits = conn.get_splits(handle, max(count * 4, 4))
+                splits = all_splits[idx::count]
             return ([TableScanOperatorFactory(
                 conn, node.column_names,
                 batch_rows=self.config.scan_batch_rows)], splits)
+        if isinstance(node, RemoteSourceNode):
+            from presto_tpu.server.exchangeop import ExchangeOperatorFactory
+
+            locations: List[str] = []
+            for fid in node.fragment_ids:
+                locations.extend(self.remote_sources.get(fid, ()))
+            return ([ExchangeOperatorFactory(locations)], [])
         if isinstance(node, ValuesNode):
             from presto_tpu.batch import batch_from_pylist
 
@@ -106,6 +144,14 @@ class PhysicalPlanner:
             chain.append(OrderByOperatorFactory(specs))
             return chain, splits
         if isinstance(node, LimitNode):
+            if isinstance(node.source, SortNode):
+                # TopN fusion (TopNOperator.java:35 role): sort + limit
+                # becomes one truncated sort-permutation kernel
+                chain, splits = self._lower(node.source.source)
+                specs = [SortSpec(c, not asc, bool(nf))
+                         for c, asc, nf in node.source.sort_keys]
+                chain.append(OrderByOperatorFactory(specs, node.count))
+                return chain, splits
             chain, splits = self._lower(node.source)
             chain.append(LimitOperatorFactory(node.count))
             return chain, splits
@@ -113,6 +159,20 @@ class PhysicalPlanner:
             chain, splits = self._lower(node.source)
             chain.append(EnforceSingleRowOperatorFactory(node.types))
             return chain, splits
+        if isinstance(node, WindowNode):
+            chain, splits = self._lower(node.source)
+            chain.append(WindowOperatorFactory(
+                node.partition_channels, node.order_keys, node.functions))
+            return chain, splits
+        if isinstance(node, UnionNode):
+            buffer = UnionBuffer(len(node.inputs))
+            for inp in node.inputs:
+                in_chain, in_splits = self._lower(inp)
+                in_chain.append(UnionSinkOperatorFactory(buffer))
+                self._done_pipelines.append(
+                    Pipeline(in_chain, in_splits,
+                             name=self._name("union")))
+            return [UnionSourceOperatorFactory(buffer)], []
         raise NotImplementedError(
             f"physical lowering for {type(node).__name__}")
 
@@ -144,6 +204,8 @@ class PhysicalPlanner:
         return chain, splits
 
     def _lower_aggregation(self, node: AggregationNode):
+        if node.step == "final":
+            return self._lower_final_aggregation(node)
         chain, splits = self._lower(node.source)
         input_types = [t for _, t in node.source.columns]
 
@@ -192,6 +254,11 @@ class PhysicalPlanner:
             chain.append(GlobalAggregationOperatorFactory(
                 agg_channels, input_types))
 
+        if node.step == "partial":
+            # distributed PARTIAL: emit raw component columns (keys first);
+            # the FINAL stage merges them (HashAggregationOperator.Step:61)
+            return chain, splits
+
         # finalize projection: [keys..., finalized aggs...]
         key_types = [input_types[c] for c in node.group_channels]
         post_in = key_types + [a.out_type for a in agg_channels]
@@ -206,6 +273,50 @@ class PhysicalPlanner:
                        for i, e in enumerate(exprs))):
             chain.append(FilterProjectOperatorFactory(
                 None, exprs, post_in))
+        return chain, splits
+
+    # merge prim for each partial component prim (steps.py uses the same
+    # table for the SPMD in-program exchange variant)
+    _FINAL_PRIM = {"count": "sum", "sum": "sum", "min": "min", "max": "max"}
+
+    def _lower_final_aggregation(self, node: AggregationNode):
+        """FINAL step over a partial's output: [keys..., comp0, comp1, ...].
+        Re-aggregates each component with its merge primitive, then runs the
+        single-step finalize projection."""
+        chain, splits = self._lower(node.source)
+        input_types = [t for _, t in node.source.columns]
+        ngroups = len(node.group_channels)
+        agg_channels: List[AggChannel] = []
+        finalize_specs: List[Tuple[PlanAggregate, List[int]]] = []
+        comp_ch = ngroups
+        for agg in node.aggregates:
+            comp_channels: List[int] = []
+            for prim, ctype in agg.spec.components:
+                merge = self._FINAL_PRIM[prim if prim != "sumsq" else "sum"]
+                agg_channels.append(AggChannel(merge, comp_ch, ctype))
+                comp_channels.append(len(agg_channels) - 1)
+                comp_ch += 1
+            finalize_specs.append((agg, comp_channels))
+
+        if ngroups:
+            chain.append(HashAggregationOperatorFactory(
+                list(node.group_channels), agg_channels, input_types))
+        else:
+            chain.append(GlobalAggregationOperatorFactory(
+                agg_channels, input_types))
+
+        key_types = [input_types[c] for c in node.group_channels]
+        post_in = key_types + [a.out_type for a in agg_channels]
+        exprs: List[RowExpression] = [InputRef(i, t)
+                                      for i, t in enumerate(key_types)]
+        for agg, comps in finalize_specs:
+            base = [InputRef(ngroups + c, agg_channels[c].out_type)
+                    for c in comps]
+            exprs.append(_finalize(agg, base))
+        if (len(exprs) != len(post_in)
+                or any(not isinstance(e, InputRef) or e.index != i
+                       for i, e in enumerate(exprs))):
+            chain.append(FilterProjectOperatorFactory(None, exprs, post_in))
         return chain, splits
 
     def _lower_join(self, node: JoinNode):

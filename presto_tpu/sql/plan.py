@@ -146,6 +146,57 @@ class SemiJoinNode(PlanNode):
 
 
 @D(frozen=True)
+class PlanWindowFunction:
+    """One windowed function over a shared (partition, order, frame) spec.
+
+    ``name`` is the window/aggregate function; ``arg_channels`` index the
+    source's channels; frame fields are None for ranking functions (which
+    ignore frames).  ``offset``/``default_channel`` serve lag/lead/ntile/
+    nth_value's extra scalar arguments."""
+
+    name: str
+    arg_channels: Tuple[int, ...]
+    result_type: T.Type
+    frame_unit: str = "range"            # rows | range
+    frame_start: str = "unbounded_preceding"
+    frame_end: str = "current"
+    frame_start_offset: Optional[int] = None
+    frame_end_offset: Optional[int] = None
+    offset: Optional[int] = None         # lag/lead/nth_value k, ntile n
+    default_channel: Optional[int] = None  # lag/lead default value
+
+
+@D(frozen=True)
+class WindowNode(PlanNode):
+    """Window functions over a shared partition/order spec
+    (WindowNode.java analogue).  Output = source columns + one column per
+    function."""
+
+    source: PlanNode
+    partition_channels: Tuple[int, ...]
+    order_keys: Tuple[Tuple[int, bool, Optional[bool]], ...]
+    functions: Tuple[PlanWindowFunction, ...]
+    columns: Tuple[Column, ...]
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
+class UnionNode(PlanNode):
+    """UNION ALL of same-width inputs (UnionNode.java analogue); DISTINCT
+    and INTERSECT/EXCEPT are planned as aggregations/semijoins above this."""
+
+    inputs: Tuple[PlanNode, ...]
+    columns: Tuple[Column, ...]
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return self.inputs
+
+
+@D(frozen=True)
 class SortNode(PlanNode):
     source: PlanNode
     sort_keys: Tuple[Tuple[int, bool, Optional[bool]], ...]
@@ -188,6 +239,16 @@ class EnforceSingleRowNode(PlanNode):
     @property
     def sources(self):  # type: ignore[override]
         return (self.source,)
+
+
+@D(frozen=True)
+class RemoteSourceNode(PlanNode):
+    """Reads the output of other fragments over the exchange protocol
+    (RemoteSourceNode / ExchangeOperator.java:36 analogue).  Appears only
+    inside PlanFragments produced by the fragmenter."""
+
+    fragment_ids: Tuple[int, ...]
+    columns: Tuple[Column, ...]
 
 
 @D(frozen=True)

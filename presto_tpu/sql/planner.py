@@ -27,12 +27,13 @@ from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression, SpecialF
 from presto_tpu.sql import tree as t
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
-    OutputNode, PlanAggregate, PlanNode, ProjectNode, SemiJoinNode,
-    SortNode, TableScanNode, ValuesNode,
+    OutputNode, PlanAggregate, PlanNode, PlanWindowFunction, ProjectNode,
+    SemiJoinNode, SortNode, TableScanNode, UnionNode, ValuesNode, WindowNode,
 )
 
 AGG_NAMES = {"count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
-             "stddev_pop", "variance", "var_samp", "var_pop"}
+             "stddev_pop", "variance", "var_samp", "var_pop", "any_value",
+             "arbitrary", "bool_and", "bool_or", "every", "count_if"}
 
 
 class SqlAnalysisError(ValueError):
@@ -137,7 +138,8 @@ def _contains_subquery(expr: t.Node) -> bool:
 
 
 def _contains_aggregate(expr: t.Node) -> bool:
-    if isinstance(expr, t.FunctionCall) and expr.name in AGG_NAMES:
+    if (isinstance(expr, t.FunctionCall) and expr.name in AGG_NAMES
+            and expr.window is None):
         return True
     if isinstance(expr, (t.InSubquery, t.Exists, t.ScalarSubquery)):
         return False
@@ -198,11 +200,20 @@ class Translator:
     """AST expression -> RowExpression over a scope's channels."""
 
     def __init__(self, scope: Scope,
-                 grouped: Optional["GroupingContext"] = None):
+                 grouped: Optional["GroupingContext"] = None,
+                 windows: Optional[Dict[t.Expression, RowExpression]] = None):
         self.scope = scope
         self.grouped = grouped
+        self.windows = windows
 
     def translate(self, expr: t.Expression) -> RowExpression:
+        if self.windows is not None:
+            hit = self.windows.get(expr)
+            if hit is not None:
+                return hit
+        if isinstance(expr, t.FunctionCall) and expr.window is not None:
+            raise SqlAnalysisError(
+                f"window function {expr.name} in an unsupported position")
         if self.grouped is not None:
             hit = self.grouped.lookup(expr)
             if hit is not None:
@@ -309,9 +320,72 @@ class Translator:
             if e.name in AGG_NAMES:
                 raise SqlAnalysisError(
                     f"aggregate {e.name} used outside aggregation context")
-            return B.call(e.name, *[self.translate(a) for a in e.args])
+            return self._function_call(e)
         raise SqlAnalysisError(
             f"unsupported expression {type(e).__name__}")
+
+    _CONST_FNS = {"pi": 3.141592653589793, "e": 2.718281828459045,
+                  "nan": float("nan"), "infinity": float("inf")}
+
+    def _function_call(self, e: t.FunctionCall) -> RowExpression:
+        name = e.name.lower()
+        if name in self._CONST_FNS and not e.args:
+            return B.const(self._CONST_FNS[name], T.DOUBLE)
+        if name == "if" and len(e.args) in (2, 3):
+            cond = self.translate(e.args[0])
+            then = self.translate(e.args[1])
+            els = self.translate(e.args[2]) if len(e.args) == 3 else None
+            rtype = _common_type([then.type]
+                                 + ([els.type] if els is not None else []))
+            then = _coerce(then, rtype)
+            if els is not None:
+                els = _coerce(els, rtype)
+            return B.case_when([(cond, then)], els, rtype)
+        if name == "round" and len(e.args) == 2:
+            digits = self.translate(e.args[1])
+            if not isinstance(digits, Constant):
+                raise SqlAnalysisError("round(x, d) requires constant d")
+            return B.round_digits(self.translate(e.args[0]),
+                                  int(digits.value))
+        if name in ("date_trunc", "date_add", "date_diff") and e.args:
+            unit_rex = self.translate(e.args[0])
+            if not (isinstance(unit_rex, Constant)
+                    and isinstance(unit_rex.value, str)):
+                raise SqlAnalysisError(f"{name} unit must be a constant "
+                                       "string")
+            unit = unit_rex.value.lower()
+            if name == "date_trunc":
+                return B.call(f"date_trunc_{unit}",
+                              self.translate(e.args[1]))
+            if name == "date_diff":
+                return B.call(f"date_diff_{unit}",
+                              self.translate(e.args[1]),
+                              self.translate(e.args[2]))
+            # date_add(unit, value, x)
+            n = self.translate(e.args[1])
+            x = self.translate(e.args[2])
+            if unit == "day":
+                return B.call("add_days", x, n)
+            if unit == "week":
+                return B.call("add_days", x,
+                              B.call("multiply", n, B.const(7, T.INTEGER)))
+            if unit == "month":
+                return B.call("add_months", x, n)
+            if unit == "quarter":
+                return B.call("add_months", x,
+                              B.call("multiply", n, B.const(3, T.INTEGER)))
+            if unit == "year":
+                return B.call("add_months", x,
+                              B.call("multiply", n, B.const(12, T.INTEGER)))
+            if x.type.name == "timestamp":
+                scale = {"hour": 3_600_000_000, "minute": 60_000_000,
+                         "second": 1_000_000, "millisecond": 1_000}[unit]
+                return B.call("add", x, B.call(
+                    "multiply", B.cast(n, T.BIGINT),
+                    B.const(scale, T.BIGINT)))
+            raise SqlAnalysisError(f"date_add unit {unit!r} on "
+                                   f"{x.type.display()}")
+        return B.call(name, *[self.translate(a) for a in e.args])
 
     def _arithmetic(self, e: t.ArithmeticBinary) -> RowExpression:
         # date +/- interval folds into add_days/add_months with constant
@@ -432,14 +506,92 @@ class Planner:
         return OutputNode(rel.node, cols)
 
     # --- query -------------------------------------------------------------
-    def plan_query(self, q: t.Query, outer: Optional[Scope]) -> RelationPlan:
+    def plan_query(self, q: t.Node, outer: Optional[Scope]) -> RelationPlan:
         if q.with_queries:
             self.ctes.append(dict(q.with_queries))
         try:
+            if isinstance(q, t.SetOperation):
+                return self._plan_set_operation(q, outer)
             return self._plan_query_body(q, outer)
         finally:
             if q.with_queries:
                 self.ctes.pop()
+
+    def _plan_set_operation(self, q: t.SetOperation,
+                            outer: Optional[Scope]) -> RelationPlan:
+        """UNION [ALL] / INTERSECT / EXCEPT.  Branch outputs are coerced to
+        common types; DISTINCT semantics via aggregation over all channels;
+        INTERSECT/EXCEPT via (anti-)semijoin over distinct branches —
+        the same shapes the reference's SetOperationNodes lower to.
+        Like the reference (Presto 328), INTERSECT ALL / EXCEPT ALL are
+        not supported."""
+        left = self.plan_query(q.left, outer)
+        right = self.plan_query(q.right, outer)
+        ltypes = [f.type for f in left.scope.fields]
+        rtypes = [f.type for f in right.scope.fields]
+        if len(ltypes) != len(rtypes):
+            raise SqlAnalysisError(
+                f"{q.op} branches have {len(ltypes)} vs {len(rtypes)} "
+                "columns")
+        common = [_common_type([a, b]) for a, b in zip(ltypes, rtypes)]
+
+        def coerced(rel: RelationPlan) -> PlanNode:
+            node = rel.node
+            exprs = []
+            for i, typ in enumerate(common):
+                ref = B.ref(i, node.types[i])
+                exprs.append(_coerce(ref, typ))
+            if all(isinstance(e, InputRef) and e.type == common[i]
+                   for i, e in enumerate(exprs)):
+                return node
+            cols = tuple((left.scope.fields[i].name, typ)
+                         for i, typ in enumerate(common))
+            return ProjectNode(node, tuple(exprs), cols)
+
+        lnode, rnode = coerced(left), coerced(right)
+        out_cols = tuple((f.name, typ)
+                         for f, typ in zip(left.scope.fields, common))
+        fields = [Field(f.name, None, typ)
+                  for f, typ in zip(left.scope.fields, common)]
+        all_ch = tuple(range(len(common)))
+
+        if q.op == "union":
+            node: PlanNode = UnionNode((lnode, rnode), out_cols)
+            if not q.all:
+                node = AggregationNode(node, all_ch, (), out_cols)
+        elif q.op in ("intersect", "except"):
+            if q.all:
+                raise SqlAnalysisError(
+                    f"{q.op.upper()} ALL is not supported")
+            distinct_left = AggregationNode(lnode, all_ch, (), out_cols)
+            node = SemiJoinNode(distinct_left, rnode, all_ch, all_ch,
+                                negated=(q.op == "except"))
+        else:
+            raise SqlAnalysisError(f"unknown set operation {q.op}")
+
+        out = RelationPlan(node, Scope(fields, outer))
+        if q.order_by:
+            keys = []
+            for item in q.order_by:
+                ch = self._set_op_order_channel(item.expr, out.scope)
+                keys.append((ch, item.ascending, item.nulls_first))
+            out = RelationPlan(SortNode(out.node, tuple(keys)), out.scope)
+        if q.limit is not None:
+            out = RelationPlan(LimitNode(out.node, q.limit), out.scope)
+        return out
+
+    def _set_op_order_channel(self, e: t.Expression, scope: Scope) -> int:
+        if isinstance(e, t.NumberLiteral) and e.text.isdigit():
+            n = int(e.text)
+            if not (1 <= n <= len(scope.fields)):
+                raise SqlAnalysisError(f"ORDER BY position {n} out of range")
+            return n - 1
+        if isinstance(e, t.Identifier) and len(e.parts) == 1:
+            idx = scope.try_resolve(e.parts)
+            if idx is not None:
+                return idx
+        raise SqlAnalysisError(
+            "set-operation ORDER BY must reference an output column")
 
     def _plan_query_body(self, q: t.Query,
                          outer: Optional[Scope]) -> RelationPlan:
@@ -482,6 +634,17 @@ class Planner:
             tr = Translator(rel.scope)
             if q.having is not None:
                 raise SqlAnalysisError("HAVING without aggregation")
+
+        # Window functions (planned over the post-aggregation relation,
+        # LogicalPlanner window-after-aggregation ordering)
+        win_calls: List[t.FunctionCall] = []
+        for item in q.select:
+            _collect_windows(item.expr, win_calls)
+        for s in q.order_by:
+            _collect_windows(s.expr, win_calls)
+        if win_calls:
+            rel, win_map = self._plan_windows(rel, win_calls, grouping)
+            tr = Translator(rel.scope, grouping, win_map)
 
         # SELECT projection
         exprs: List[RowExpression] = []
@@ -968,13 +1131,189 @@ class Planner:
         # names stay synthetic
         return out, grouping
 
+    # --- window functions --------------------------------------------------
+    _RANKING = {"row_number", "rank", "dense_rank", "percent_rank",
+                "cume_dist", "ntile"}
+    _VALUE_FNS = {"lag", "lead", "first_value", "last_value", "nth_value"}
+    _WINDOW_AGGS = {"sum", "count", "avg", "min", "max"}
+
+    def _plan_windows(self, rel: RelationPlan,
+                      calls: List[t.FunctionCall],
+                      grouping: Optional[GroupingContext]):
+        """Plan WindowNodes (one per distinct partition/order spec) over
+        ``rel`` and return (new rel, {window-call AST -> channel ref}).
+        The source's channels are preserved as a prefix; each WindowNode
+        appends one channel per function."""
+        scope = rel.scope
+        tr = Translator(scope, grouping)
+        node = rel.node
+        n_src = len(node.columns)
+        pre_exprs: List[RowExpression] = [
+            B.ref(i, typ) for i, (_, typ) in enumerate(node.columns)]
+
+        def chan_of(rex: RowExpression) -> int:
+            if isinstance(rex, InputRef):
+                return rex.index
+            for i, e in enumerate(pre_exprs):
+                if e == rex:
+                    return i
+            pre_exprs.append(rex)
+            return len(pre_exprs) - 1
+
+        def const_int(e: t.Expression, what: str) -> int:
+            rex = tr.translate(e)
+            if not isinstance(rex, Constant) or not isinstance(
+                    rex.value, (int, float)):
+                raise SqlAnalysisError(f"{what} must be a constant")
+            return int(rex.value)
+
+        # resolve each call -> (spec key, PlanWindowFunction parts)
+        grouped_specs: Dict[Tuple, List[Tuple[t.FunctionCall, dict]]] = {}
+        for call in calls:
+            w = call.window
+            part_channels = tuple(chan_of(tr.translate(p))
+                                  for p in w.partition_by)
+            order_keys = tuple(
+                (chan_of(tr.translate(s.expr)), s.ascending, s.nulls_first)
+                for s in w.order_by)
+            fn = self._resolve_window_fn(call, tr, chan_of, const_int)
+            key = (part_channels, order_keys)
+            grouped_specs.setdefault(key, []).append((call, fn))
+
+        if len(pre_exprs) > n_src:
+            cols = tuple(node.columns) + tuple(
+                (f"$winarg{i}", e.type)
+                for i, e in enumerate(pre_exprs[n_src:]))
+            node = ProjectNode(node, tuple(pre_exprs), cols)
+
+        win_map: Dict[t.Expression, RowExpression] = {}
+        for (part_channels, order_keys), entries in grouped_specs.items():
+            base = len(node.columns)
+            funcs = tuple(PlanWindowFunction(**fn) for _, fn in entries)
+            cols = tuple(node.columns) + tuple(
+                (f"$win{base + i}", f.result_type)
+                for i, f in enumerate(funcs))
+            node = WindowNode(node, part_channels, order_keys, funcs, cols)
+            for i, (call, fn) in enumerate(entries):
+                win_map[call] = B.ref(base + i, fn["result_type"])
+
+        # scope keeps the original named fields; window channels are
+        # addressable only through win_map
+        new_scope = Scope(list(scope.fields), scope.parent)
+        return RelationPlan(node, new_scope), win_map
+
+    def _resolve_window_fn(self, call: t.FunctionCall, tr: Translator,
+                           chan_of, const_int) -> dict:
+        name = call.name
+        w = call.window
+        has_order = bool(w.order_by)
+        fn: dict = dict(name=name, arg_channels=(), result_type=T.BIGINT,
+                        frame_unit="range",
+                        frame_start="unbounded_preceding",
+                        frame_end="current" if has_order
+                        else "unbounded_following")
+        if name in self._RANKING:
+            if not has_order and name != "row_number":
+                raise SqlAnalysisError(f"{name} requires window ORDER BY")
+            if name in ("percent_rank", "cume_dist"):
+                fn["result_type"] = T.DOUBLE
+            if name == "ntile":
+                if len(call.args) != 1:
+                    raise SqlAnalysisError("ntile takes one argument")
+                fn["offset"] = const_int(call.args[0], "ntile bucket count")
+            return fn
+        if name in self._VALUE_FNS:
+            if not call.args:
+                raise SqlAnalysisError(f"{name} requires an argument")
+            arg = tr.translate(call.args[0])
+            fn["arg_channels"] = (chan_of(arg),)
+            fn["result_type"] = arg.type
+            if name in ("lag", "lead"):
+                fn["offset"] = (const_int(call.args[1], f"{name} offset")
+                                if len(call.args) > 1 else 1)
+                if len(call.args) > 2:
+                    dflt = _coerce(tr.translate(call.args[2]), arg.type)
+                    fn["default_channel"] = chan_of(dflt)
+            elif name == "nth_value":
+                if len(call.args) != 2:
+                    raise SqlAnalysisError("nth_value takes two arguments")
+                fn["offset"] = const_int(call.args[1], "nth_value position")
+            if w.frame is not None:
+                self._apply_frame(fn, w.frame, const_int)
+            return fn
+        if name in self._WINDOW_AGGS:
+            if call.is_star or not call.args:
+                if name != "count":
+                    raise SqlAnalysisError(f"{name} requires an argument")
+                fn["result_type"] = T.BIGINT
+            else:
+                arg = tr.translate(call.args[0])
+                fn["arg_channels"] = (chan_of(arg),)
+                if name == "count":
+                    fn["result_type"] = T.BIGINT
+                elif name in ("min", "max"):
+                    fn["result_type"] = arg.type
+                elif name == "sum":
+                    fn["result_type"] = (
+                        T.BIGINT if T.is_integral(arg.type)
+                        else arg.type)
+                else:  # avg
+                    fn["result_type"] = (
+                        arg.type if isinstance(arg.type, T.DecimalType)
+                        else T.DOUBLE)
+            if w.frame is not None:
+                self._apply_frame(fn, w.frame, const_int)
+            return fn
+        raise SqlAnalysisError(f"unknown window function {name}")
+
+    @staticmethod
+    def _apply_frame(fn: dict, frame: t.WindowFrame, const_int) -> None:
+        fn["frame_unit"] = frame.unit
+
+        def bound(b: t.FrameBound, which: str):
+            fn[f"frame_{which}"] = b.kind
+            if b.kind in ("preceding", "following"):
+                fn[f"frame_{which}_offset"] = const_int(
+                    b.value, "frame offset")
+
+        bound(frame.start, "start")
+        bound(frame.end, "end")
+        if frame.unit == "range" and (
+                fn["frame_start"] in ("preceding", "following")
+                or fn["frame_end"] in ("preceding", "following")):
+            raise SqlAnalysisError(
+                "RANGE frames with value offsets are not supported")
+
 
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
 
+def _collect_windows(e: t.Node, out: List[t.FunctionCall]):
+    """Collect windowed FunctionCalls (not descending into subqueries)."""
+    if isinstance(e, t.FunctionCall) and e.window is not None:
+        if e not in out:
+            out.append(e)
+        return
+    if isinstance(e, (t.InSubquery, t.Exists, t.ScalarSubquery)):
+        return
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, t.Node):
+            _collect_windows(v, out)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, t.Node):
+                    _collect_windows(item, out)
+                elif isinstance(item, tuple):
+                    for sub in item:
+                        if isinstance(sub, t.Node):
+                            _collect_windows(sub, out)
+
+
 def _collect_aggs(e: t.Node, out: List[t.FunctionCall]):
-    if isinstance(e, t.FunctionCall) and e.name in AGG_NAMES:
+    if (isinstance(e, t.FunctionCall) and e.name in AGG_NAMES
+            and e.window is None):
         if e not in out:
             out.append(e)
         return

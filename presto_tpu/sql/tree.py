@@ -80,11 +80,38 @@ class Parameter(Expression):
 # --- compound expressions --------------------------------------------------
 
 @D(frozen=True)
+class FrameBound(Node):
+    """One end of a window frame: kind in {unbounded_preceding, preceding,
+    current, following, unbounded_following}; value set for the bounded
+    kinds."""
+
+    kind: str
+    value: Optional["Expression"] = None
+
+
+@D(frozen=True)
+class WindowFrame(Node):
+    unit: str                        # rows | range
+    start: FrameBound
+    end: FrameBound
+
+
+@D(frozen=True)
+class WindowSpec(Node):
+    """OVER (...) clause (Window in SqlBase.g4)."""
+
+    partition_by: Tuple["Expression", ...] = ()
+    order_by: Tuple["SortItem", ...] = ()
+    frame: Optional[WindowFrame] = None
+
+
+@D(frozen=True)
 class FunctionCall(Expression):
     name: str
     args: Tuple[Expression, ...]
     distinct: bool = False           # count(DISTINCT x)
     is_star: bool = False            # count(*)
+    window: Optional[WindowSpec] = None  # fn(...) OVER (...)
 
 
 @D(frozen=True)
@@ -248,6 +275,21 @@ class Query(Node):
     order_by: Tuple[SortItem, ...] = ()
     limit: Optional[int] = None
     distinct: bool = False
+    with_queries: Tuple[Tuple[str, "Query"], ...] = ()
+
+
+@D(frozen=True)
+class SetOperation(Node):
+    """UNION / INTERSECT / EXCEPT over two query bodies.  ORDER BY and
+    LIMIT written after the last branch attach here (they apply to the
+    whole operation)."""
+
+    op: str                          # union | intersect | except
+    all: bool                        # UNION ALL vs UNION [DISTINCT]
+    left: Node                       # Query | SetOperation
+    right: Node
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
     with_queries: Tuple[Tuple[str, "Query"], ...] = ()
 
 
