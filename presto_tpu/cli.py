@@ -1,0 +1,110 @@
+"""Interactive SQL REPL (the presto-cli role).
+
+Two modes, mirroring how the reference CLI targets a server while tests
+embed LocalQueryRunner:
+
+    python -m presto_tpu.cli --server http://host:port     # client mode
+    python -m presto_tpu.cli --catalog tpch --scale 0.01   # embedded
+
+Multi-line statements end with ';'.  Commands: \\q quit, \\timing toggle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional, Sequence, Tuple
+
+
+def format_table(names: Sequence[str], rows: Sequence[Tuple]) -> str:
+    cells = [[("NULL" if v is None else str(v)) for v in row]
+             for row in rows]
+    widths = [len(n) for n in names]
+    for row in cells:
+        for i, c in enumerate(row):
+            widths[i] = max(widths[i], len(c))
+    sep = "-+-".join("-" * w for w in widths)
+    out = [" | ".join(n.ljust(w) for n, w in zip(names, widths)), sep]
+    for row in cells:
+        out.append(" | ".join(c.ljust(w) for c, w in zip(row, widths)))
+    out.append(f"({len(rows)} row{'s' if len(rows) != 1 else ''})")
+    return "\n".join(out)
+
+
+class _EmbeddedBackend:
+    def __init__(self, catalog: str, scale: float):
+        from presto_tpu.localrunner import LocalQueryRunner
+
+        if catalog != "tpch":
+            raise SystemExit("embedded mode supports --catalog tpch")
+        self.runner = LocalQueryRunner.tpch(scale=scale)
+
+    def execute(self, sql: str):
+        res = self.runner.execute(sql)
+        return res.column_names, res.rows
+
+
+class _ClientBackend:
+    def __init__(self, server: str):
+        from presto_tpu.client import StatementClient
+
+        self.client = StatementClient(server)
+
+    def execute(self, sql: str):
+        columns, data = self.client.execute(sql)
+        return [c["name"] for c in columns], [tuple(r) for r in data]
+
+
+def repl(backend, instream=sys.stdin, out=sys.stdout) -> None:
+    timing = True
+    buffer: List[str] = []
+    interactive = instream.isatty()
+    if interactive:
+        out.write("presto-tpu> ")
+        out.flush()
+    for line in instream:
+        stripped = line.strip()
+        if not buffer and stripped in (r"\q", "quit", "exit"):
+            return
+        if not buffer and stripped == r"\timing":
+            timing = not timing
+            out.write(f"timing {'on' if timing else 'off'}\n")
+        elif stripped:
+            buffer.append(line)
+        if buffer and stripped.endswith(";"):
+            sql = "".join(buffer)
+            buffer = []
+            t0 = time.time()
+            try:
+                names, rows = backend.execute(sql)
+                out.write(format_table(names, rows) + "\n")
+                if timing:
+                    out.write(f"[{time.time() - t0:.2f}s]\n")
+            except Exception as e:  # noqa: BLE001 - REPL survives errors
+                out.write(f"error: {e}\n")
+        if interactive:
+            out.write("presto-tpu> " if not buffer else "        -> ")
+            out.flush()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="presto-tpu-cli")
+    p.add_argument("--server", help="coordinator URI (client mode)")
+    p.add_argument("--catalog", default="tpch", help="embedded catalog")
+    p.add_argument("--scale", type=float, default=0.01,
+                   help="embedded tpch scale factor")
+    p.add_argument("--execute", "-e", help="run one statement and exit")
+    args = p.parse_args(argv)
+
+    backend = (_ClientBackend(args.server) if args.server
+               else _EmbeddedBackend(args.catalog, args.scale))
+    if args.execute:
+        names, rows = backend.execute(args.execute)
+        print(format_table(names, rows))
+        return
+    repl(backend)
+
+
+if __name__ == "__main__":
+    main()

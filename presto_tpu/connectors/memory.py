@@ -1,0 +1,181 @@
+"""In-memory writable connector (the presto-memory analogue).
+
+The reference's memory connector stores inserted pages on-heap per table
+and serves them back for scans (presto-memory, 2,899 LoC; used across the
+test suite as the writable fixture).  Here tables hold host-side Batches;
+CREATE TABLE / INSERT / CTAS land through the PageSink API, scans serve
+the stored batches split by batch index.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from presto_tpu.batch import Batch, empty_batch
+from presto_tpu.connectors.api import (
+    ColumnMetadata, Connector, PageSink, PageSource, Split, TableHandle,
+    TableSchema, TableStatistics,
+)
+
+
+class _MemPageSource(PageSource):
+    def __init__(self, batches: List[Batch], columns: Sequence[str],
+                 schema: TableSchema):
+        self.batches = batches
+        self.channels = [schema.column_index(c) for c in columns]
+
+    def __iter__(self):
+        for b in self.batches:
+            yield b.select_channels(self.channels)
+
+
+class _MemPageSink(PageSink):
+    def __init__(self, table: "_MemTable"):
+        self.table = table
+        self.pending: List[Batch] = []
+
+    def append(self, batch: Batch) -> None:
+        self.pending.append(batch.compact().to_numpy())
+
+    def finish(self) -> int:
+        rows = sum(b.num_rows for b in self.pending)
+        self.table.append_all(self.pending)
+        self.pending = []
+        return rows
+
+
+class _MemTable:
+    def __init__(self, schema: TableSchema):
+        self.schema = schema
+        self.batches: List[Batch] = []
+        self._lock = threading.Lock()
+
+    def append_all(self, batches: List[Batch]) -> None:
+        with self._lock:
+            self.batches.extend(batches)
+
+    @property
+    def row_count(self) -> int:
+        with self._lock:
+            return sum(b.num_rows for b in self.batches)
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self):
+        self.tables: Dict[str, _MemTable] = {}
+        self._lock = threading.Lock()
+
+    # -- metadata -------------------------------------------------------
+    def list_tables(self) -> List[str]:
+        with self._lock:
+            return sorted(self.tables)
+
+    def get_table(self, table: str) -> Optional[TableHandle]:
+        with self._lock:
+            if table not in self.tables:
+                raise KeyError(f"memory table not found: {table}")
+        return TableHandle("memory", table)
+
+    def table_schema(self, handle: TableHandle) -> TableSchema:
+        return self.tables[handle.table].schema
+
+    def table_statistics(self, handle: TableHandle
+                         ) -> Optional[TableStatistics]:
+        return TableStatistics(row_count=self.tables[handle.table].row_count)
+
+    # -- reads ----------------------------------------------------------
+    def get_splits(self, handle: TableHandle,
+                   desired_splits: int) -> List[Split]:
+        tbl = self.tables[handle.table]
+        n = max(1, len(tbl.batches))
+        per = -(-n // max(1, desired_splits))
+        return [Split(handle, (lo, min(lo + per, n)))
+                for lo in range(0, n, per)] or [Split(handle, (0, 0))]
+
+    def page_source(self, split: Split, columns: Sequence[str],
+                    batch_rows: int = 65536) -> PageSource:
+        tbl = self.tables[split.handle.table]
+        lo, hi = split.info
+        return _MemPageSource(tbl.batches[lo:hi], columns, tbl.schema)
+
+    # -- writes ---------------------------------------------------------
+    def create_table(self, name: str, schema: TableSchema) -> TableHandle:
+        with self._lock:
+            if name in self.tables:
+                raise ValueError(f"table already exists: {name}")
+            self.tables[name] = _MemTable(schema)
+        return TableHandle("memory", name)
+
+    def drop_table(self, name: str) -> None:
+        with self._lock:
+            if name not in self.tables:
+                raise KeyError(f"memory table not found: {name}")
+            del self.tables[name]
+
+    def page_sink(self, handle: TableHandle) -> PageSink:
+        return _MemPageSink(self.tables[handle.table])
+
+
+class BlackHoleConnector(Connector):
+    """Write sink that discards everything (presto-blackhole role: write
+    benchmarking and DML plumbing tests).  Scans return zero rows."""
+
+    name = "blackhole"
+
+    def __init__(self):
+        self.schemas: Dict[str, TableSchema] = {}
+        self.rows_swallowed: Dict[str, int] = {}
+
+    def list_tables(self) -> List[str]:
+        return sorted(self.schemas)
+
+    def get_table(self, table: str) -> Optional[TableHandle]:
+        if table not in self.schemas:
+            raise KeyError(f"blackhole table not found: {table}")
+        return TableHandle("blackhole", table)
+
+    def table_schema(self, handle: TableHandle) -> TableSchema:
+        return self.schemas[handle.table]
+
+    def get_splits(self, handle: TableHandle,
+                   desired_splits: int) -> List[Split]:
+        return [Split(handle, None)]
+
+    def page_source(self, split: Split, columns: Sequence[str],
+                    batch_rows: int = 65536) -> PageSource:
+        schema = self.schemas[split.handle.table]
+        types = [schema.column_type(c) for c in columns]
+
+        class _Empty(PageSource):
+            def __iter__(self):
+                yield empty_batch(types)
+
+        return _Empty()
+
+    def create_table(self, name: str, schema: TableSchema) -> TableHandle:
+        self.schemas[name] = schema
+        self.rows_swallowed[name] = 0
+        return TableHandle("blackhole", name)
+
+    def drop_table(self, name: str) -> None:
+        del self.schemas[name]
+
+    def page_sink(self, handle: TableHandle) -> PageSink:
+        connector = self
+        table = handle.table
+
+        class _Sink(PageSink):
+            def __init__(self):
+                self.count = 0
+
+            def append(self, batch: Batch) -> None:
+                self.count += batch.num_rows
+
+            def finish(self) -> int:
+                connector.rows_swallowed[table] += self.count
+                return self.count
+
+        return _Sink()

@@ -239,6 +239,56 @@ class LimitOperatorFactory(OperatorFactory):
         return LimitOperator(ctx, self.limit)
 
 
+class TableWriterOperator(Operator):
+    """Write path terminal: streams batches into a connector PageSink and
+    emits the committed row count at finish (the TableWriterOperator +
+    TableFinishOperator pair, presto-main/.../operator/TableWriter
+    Operator.java:58 / TableFinishOperator.java:46, fused — the engine's
+    per-query writes are single-commit)."""
+
+    def __init__(self, ctx: OperatorContext, sink):
+        super().__init__(ctx)
+        self.sink = sink
+        self._rows: Optional[int] = None
+        self._emitted = False
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_rows += batch.num_rows
+        self.sink.append(batch)
+
+    def finish(self) -> None:
+        if not self._finishing:
+            super().finish()
+            self._rows = self.sink.finish()
+
+    def get_output(self) -> Optional[Batch]:
+        if self._rows is None or self._emitted:
+            return None
+        self._emitted = True
+        from presto_tpu.batch import batch_from_pylist
+
+        return batch_from_pylist([T.BIGINT], [(self._rows,)])
+
+    def is_finished(self) -> bool:
+        # terminal operator: the driver never pulls it, so emission of the
+        # row-count batch is best-effort (read via rows_written instead)
+        return self._finishing
+
+    @property
+    def rows_written(self) -> Optional[int]:
+        return self._rows
+
+
+class TableWriterOperatorFactory(OperatorFactory):
+    def __init__(self, sink):
+        self.sink = sink
+        self.op: Optional[TableWriterOperator] = None
+
+    def create(self, ctx: OperatorContext) -> TableWriterOperator:
+        self.op = TableWriterOperator(ctx, self.sink)
+        return self.op
+
+
 class OutputCollector(Operator):
     """Terminal sink gathering result batches host-side
     (TaskOutputOperator / test MaterializedResult role)."""

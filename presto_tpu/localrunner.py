@@ -44,10 +44,22 @@ class LocalQueryRunner:
     @classmethod
     def tpch(cls, scale: float = 0.01,
              config: EngineConfig = DEFAULT) -> "LocalQueryRunner":
+        from presto_tpu.connectors.memory import (
+            BlackHoleConnector, MemoryConnector,
+        )
+        from presto_tpu.connectors.system import (
+            InformationSchemaConnector, SystemConnector,
+        )
         from presto_tpu.connectors.tpch import TpchConnector
 
         reg = ConnectorRegistry()
         reg.register("tpch", TpchConnector(scale=scale))
+        reg.register("memory", MemoryConnector())
+        reg.register("blackhole", BlackHoleConnector())
+        reg.register("system", SystemConnector(
+            nodes_fn=lambda: [("local", "local://", "dev", True,
+                               "ACTIVE")]))
+        reg.register("information_schema", InformationSchemaConnector(reg))
         return cls(reg, "tpch", config)
 
     def register(self, catalog: str, connector: Connector) -> None:
@@ -70,9 +82,102 @@ class LocalQueryRunner:
                 ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
                 [(n, schema.column_type(n).display())
                  for n in schema.column_names()])
+        if isinstance(stmt, t.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, t.CreateTableAs):
+            return self._create_table_as(stmt)
+        if isinstance(stmt, t.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, t.DropTable):
+            catalog, name, conn, _ = self.metadata.resolve_table(stmt.table)
+            conn.drop_table(name)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
         if not isinstance(stmt, (t.Query, t.SetOperation)):
             raise ValueError(f"unsupported statement {type(stmt).__name__}")
         return self._execute_query(stmt)
+
+    # --- DML (TableWriter path, SURVEY §2.6 write operators) ---------------
+    def _resolve_write_target(self, table):
+        """catalog + bare table name for CREATE/INSERT targets."""
+        parts = tuple(table)
+        if len(parts) == 1:
+            return self.metadata.default_catalog, parts[0]
+        if len(parts) == 2:
+            return parts[0], parts[1]
+        raise ValueError(f"bad table name {'.'.join(parts)}")
+
+    def _create_table(self, stmt: t.CreateTable) -> QueryResult:
+        from presto_tpu.connectors.api import ColumnMetadata, TableSchema
+
+        catalog, name = self._resolve_write_target(stmt.table)
+        conn = self.registry.get(catalog)
+        schema = TableSchema(name, tuple(
+            ColumnMetadata(cn, T.parse_type(ct))
+            for cn, ct in stmt.columns))
+        conn.create_table(name, schema)
+        return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+
+    def _create_table_as(self, stmt: t.CreateTableAs) -> QueryResult:
+        from presto_tpu.connectors.api import ColumnMetadata, TableSchema
+
+        logical = Planner(self.metadata).plan(stmt.query)
+        catalog, name = self._resolve_write_target(stmt.table)
+        conn = self.registry.get(catalog)
+        schema = TableSchema(name, tuple(
+            ColumnMetadata(cn, typ) for cn, typ in logical.columns))
+        handle = conn.create_table(name, schema)
+        return self._write(logical, conn, handle)
+
+    def _insert(self, stmt: t.Insert) -> QueryResult:
+        from presto_tpu.expr import build as B
+        from presto_tpu.expr.ir import InputRef
+        from presto_tpu.sql.plan import OutputNode, ProjectNode
+
+        catalog, name = self._resolve_write_target(stmt.table)
+        conn = self.registry.get(catalog)
+        handle = conn.get_table(name)
+        schema = conn.table_schema(handle)
+
+        if isinstance(stmt.source, t.InlineValues):
+            query: t.Node = t.Query(
+                (t.SelectItem(t.Star()),), (stmt.source,))
+        else:
+            query = stmt.source
+        logical = Planner(self.metadata).plan(query)
+
+        src_cols = stmt.columns or tuple(schema.column_names())
+        if len(logical.columns) != len(src_cols):
+            raise ValueError(
+                f"INSERT has {len(logical.columns)} columns, expected "
+                f"{len(src_cols)}")
+        # align + coerce to the table's column order and types; unnamed
+        # target columns get NULL
+        by_name = dict(zip(src_cols, range(len(src_cols))))
+        exprs = []
+        for cn in schema.column_names():
+            typ = schema.column_type(cn)
+            if cn in by_name:
+                i = by_name[cn]
+                ref = B.ref(i, logical.columns[i][1])
+                exprs.append(ref if ref.type == typ else B.cast(ref, typ))
+            else:
+                exprs.append(B.null(typ))
+        cols = tuple((cn, schema.column_type(cn))
+                     for cn in schema.column_names())
+        project = ProjectNode(logical.source, tuple(exprs), cols)
+        logical = OutputNode(project, cols)
+        return self._write(logical, conn, handle)
+
+    def _write(self, logical, conn, handle) -> QueryResult:
+        from presto_tpu.exec.operators import TableWriterOperatorFactory
+
+        optimized = optimize(logical, self.metadata)
+        planner = PhysicalPlanner(self.registry, self.config)
+        writer = TableWriterOperatorFactory(conn.page_sink(handle))
+        pipelines = planner.plan_fragment(optimized.source, writer)
+        execute_pipelines(pipelines, self.config)
+        return QueryResult(["rows"], [T.BIGINT],
+                           [(writer.op.rows_written,)])
 
     def explain(self, sql: str) -> str:
         stmt = parse_statement(sql)

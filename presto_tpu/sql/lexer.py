@@ -37,7 +37,8 @@ KEYWORDS = {
     "intersect", "with", "explain", "analyze", "show", "tables", "columns",
     "substring", "for", "coalesce", "nullif", "year", "month", "day",
     "hour", "minute", "second", "over", "partition", "rows", "range",
-    "unbounded", "preceding", "following", "current", "row",
+    "unbounded", "preceding", "following", "current", "row", "create",
+    "table", "insert", "into", "drop", "values",
 }
 
 _TWO_CHAR = ("<=", ">=", "<>", "!=", "||")

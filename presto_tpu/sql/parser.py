@@ -108,6 +108,47 @@ class _Parser:
             analyze = bool(self.accept_kw("analyze"))
             inner = self.parse_statement()
             return t.Explain(inner, analyze)
+        if self.accept_kw("create"):
+            self.expect_kw("table")
+            name = self.qualified_name()
+            if self.accept_kw("as"):
+                node: t.Node = t.CreateTableAs(name, self.query())
+            else:
+                self.expect_op("(")
+                cols = [(self.identifier(), self.type_name())]
+                while self.accept_op(","):
+                    cols.append((self.identifier(), self.type_name()))
+                self.expect_op(")")
+                node = t.CreateTable(name, tuple(cols))
+            self.accept_op(";")
+            self.expect_eof()
+            return node
+        if self.accept_kw("insert"):
+            self.expect_kw("into")
+            name = self.qualified_name()
+            cols: Tuple[str, ...] = ()
+            if self.at_op("(") and not (
+                    self.peek(1).kind == "KEYWORD"
+                    and self.peek(1).text in ("select", "with", "values")):
+                self.next()
+                names = [self.identifier()]
+                while self.accept_op(","):
+                    names.append(self.identifier())
+                self.expect_op(")")
+                cols = tuple(names)
+            if self.at_kw("values"):
+                source: t.Node = self.inline_values()
+            else:
+                source = self.query()
+            self.accept_op(";")
+            self.expect_eof()
+            return t.Insert(name, cols, source)
+        if self.accept_kw("drop"):
+            self.expect_kw("table")
+            name = self.qualified_name()
+            self.accept_op(";")
+            self.expect_eof()
+            return t.DropTable(name)
         if self.accept_kw("show"):
             if self.accept_kw("tables"):
                 node: t.Node = t.ShowTables()
@@ -287,8 +328,31 @@ class _Parser:
             on = self.expression()
             rel = t.Join(kind, rel, right, on)
 
+    def inline_values(self) -> t.InlineValues:
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.expression()]
+            while self.accept_op(","):
+                row.append(self.expression())
+            self.expect_op(")")
+            rows.append(tuple(row))
+            if not self.accept_op(","):
+                break
+        return t.InlineValues(tuple(rows))
+
     def relation_primary(self) -> t.Relation:
+        if self.at_kw("values"):
+            iv = self.inline_values()
+            alias, col_aliases = self._relation_alias()
+            return t.InlineValues(iv.rows, alias, col_aliases)
         if self.accept_op("("):
+            if self.at_kw("values"):
+                iv = self.inline_values()
+                self.expect_op(")")
+                alias, col_aliases = self._relation_alias()
+                return t.InlineValues(iv.rows, alias, col_aliases)
             if self.at_kw("select", "with"):
                 q = self.query()
                 self.expect_op(")")

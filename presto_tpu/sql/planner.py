@@ -678,13 +678,46 @@ class Planner:
                                 tuple(range(len(cols))), (), cols),
                 out.scope)
 
-        # ORDER BY over the output scope (alias / ordinal / select-expr)
+        # ORDER BY over the output scope (alias / ordinal / select-expr);
+        # sort keys not in the select list become hidden channels,
+        # projected away after the sort (the reference's hidden-symbol
+        # ordering scheme in QueryPlanner)
         if q.order_by:
             keys = []
+            hidden_exprs: List[RowExpression] = []
+            n_visible = len(out.node.columns)
             for item in q.order_by:
-                ch = self._order_channel(item.expr, q, item_asts, out.scope)
+                try:
+                    ch = self._order_channel(item.expr, q, item_asts,
+                                             out.scope)
+                except SqlAnalysisError:
+                    if q.distinct:
+                        raise  # DISTINCT output hides source columns
+                    rex = tr.translate(item.expr)
+                    hidden_exprs.append(rex)
+                    ch = n_visible + len(hidden_exprs) - 1
                 keys.append((ch, item.ascending, item.nulls_first))
-            out = RelationPlan(SortNode(out.node, tuple(keys)), out.scope)
+            sort_src = out.node
+            if hidden_exprs:
+                # re-project visible + hidden channels from the
+                # pre-projection relation (out.node is the visible
+                # ProjectNode over rel when there is no DISTINCT)
+                cols = tuple(out.node.columns) + tuple(
+                    (f"$sort{i}", e.type)
+                    for i, e in enumerate(hidden_exprs))
+                sort_src = ProjectNode(rel.node,
+                                       tuple(list(exprs) + hidden_exprs),
+                                       cols)
+            sorted_node = SortNode(sort_src, tuple(keys))
+            if hidden_exprs:
+                trim = ProjectNode(
+                    sorted_node,
+                    tuple(InputRef(i, typ) for i, (_, typ)
+                          in enumerate(out.node.columns)),
+                    tuple(out.node.columns))
+                out = RelationPlan(trim, out.scope)
+            else:
+                out = RelationPlan(sorted_node, out.scope)
         if q.limit is not None:
             out = RelationPlan(LimitNode(out.node, q.limit), out.scope)
         return out
@@ -710,6 +743,8 @@ class Planner:
     # --- relations ---------------------------------------------------------
     def plan_relation(self, r: t.Relation,
                       outer: Optional[Scope]) -> RelationPlan:
+        if isinstance(r, t.InlineValues):
+            return self._plan_inline_values(r, outer)
         if isinstance(r, t.Table):
             return self._plan_table(r, outer)
         if isinstance(r, t.SubqueryRelation):
@@ -1079,6 +1114,49 @@ class Planner:
                            _and_all([tr.translate(c) for c in local])),
                 sub.scope)
         return sub, corr_eq, corr_other
+
+    def _plan_inline_values(self, r: t.InlineValues,
+                            outer: Optional[Scope]) -> RelationPlan:
+        """VALUES rows -> ValuesNode (constant folding at plan time; the
+        reference's Values/ValuesOperator path)."""
+        tr = Translator(Scope([], outer))
+        if not r.rows:
+            raise SqlAnalysisError("VALUES requires at least one row")
+        width = len(r.rows[0])
+        consts: List[List[Constant]] = []
+        for row in r.rows:
+            if len(row) != width:
+                raise SqlAnalysisError("VALUES rows differ in width")
+            out_row = []
+            for e in row:
+                rex = tr.translate(e)
+                if not isinstance(rex, Constant):
+                    raise SqlAnalysisError(
+                        "VALUES entries must be constant expressions")
+                out_row.append(rex)
+            consts.append(out_row)
+        cols = []
+        for j in range(width):
+            ctype = _common_type([consts[i][j].type
+                                  for i in range(len(consts))])
+            name = (r.column_aliases[j] if j < len(r.column_aliases)
+                    else f"_col{j}")
+            cols.append((name, ctype))
+        py_rows = []
+        for row in consts:
+            out_row = []
+            for c, (_, ctype) in zip(row, cols):
+                v = c.value
+                if v is not None and not c.type.is_dictionary:
+                    v = c.type.to_python(v)
+                if v is not None and ctype.name in ("double", "real") \
+                        and not isinstance(v, float):
+                    v = float(v)
+                out_row.append(v)
+            py_rows.append(tuple(out_row))
+        node = ValuesNode(tuple(cols), tuple(py_rows))
+        fields = [Field(n, r.alias, typ) for n, typ in cols]
+        return RelationPlan(node, Scope(fields, outer))
 
     # --- aggregation -------------------------------------------------------
     def _plan_aggregation(self, rel: RelationPlan, q: t.Query):

@@ -294,6 +294,39 @@ class SetOperation(Node):
 
 
 @D(frozen=True)
+class InlineValues(Relation):
+    """VALUES (e, ...), (e, ...) — in FROM position or as INSERT source."""
+
+    rows: Tuple[Tuple["Expression", ...], ...]
+    alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
+
+
+@D(frozen=True)
+class CreateTable(Node):
+    table: Tuple[str, ...]
+    columns: Tuple[Tuple[str, str], ...]   # (name, type string)
+
+
+@D(frozen=True)
+class CreateTableAs(Node):
+    table: Tuple[str, ...]
+    query: Node
+
+
+@D(frozen=True)
+class Insert(Node):
+    table: Tuple[str, ...]
+    columns: Tuple[str, ...]               # () = positional
+    source: Node                           # Query | SetOperation | InlineValues
+
+
+@D(frozen=True)
+class DropTable(Node):
+    table: Tuple[str, ...]
+
+
+@D(frozen=True)
 class Explain(Node):
     statement: Node
     analyze: bool = False
