@@ -56,6 +56,11 @@ class EngineConfig:
     # Spill directory (host-RAM/disk tier below HBM).
     spill_path: str = os.environ.get("PRESTO_TPU_SPILL", "/tmp/presto_tpu_spill")
     spill_enabled: bool = True
+    # Accumulated-input bytes above which an accumulating operator sheds
+    # state to the spill tier (the revocable-memory trigger, SURVEY §2.9).
+    spill_threshold_bytes: int = 1 << 30
+    # Hash-partition fan-out for partitioned spill (peak memory ~ 1/K).
+    spill_partitions: int = 8
 
 
 DEFAULT = EngineConfig()

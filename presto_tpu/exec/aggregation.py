@@ -58,20 +58,58 @@ class HashAggregationOperator(Operator):
         self.aggs = list(aggs)
         self.input_types = list(input_types)
         self._batches: List[Batch] = []
-        self._output: Optional[Batch] = None
+        self._outputs: List[Batch] = []
         self._done = False
+        self._spiller = None
+        self._accumulated_bytes = 0
 
     def add_input(self, batch: Batch) -> None:
         self._batches.append(batch)
         self.ctx.stats.input_batches += 1
         self.ctx.stats.input_rows += batch.num_rows
         self.ctx.memory.reserve(batch.size_bytes)
+        self._accumulated_bytes += batch.size_bytes
+        cfg = self.ctx.config
+        if (cfg.spill_enabled and self.group_channels
+                and self._accumulated_bytes > cfg.spill_threshold_bytes):
+            self._spill_accumulated()
+
+    def _spill_accumulated(self) -> None:
+        """Revoke: hash-partition accumulated rows to the spill tier
+        (SpillableHashAggregationBuilder role); each group lands wholly in
+        one partition, so finish aggregates partition-by-partition."""
+        from presto_tpu.exec.spill import PartitioningSpiller
+
+        cfg = self.ctx.config
+        if self._spiller is None:
+            self._spiller = PartitioningSpiller(
+                cfg.spill_path, cfg.spill_partitions, self.group_channels,
+                tag=f"agg-{self.ctx.name}")
+        for b in self._batches:
+            self._spiller.spill(b.to_numpy())
+        self._batches = []
+        self._accumulated_bytes = 0
+        self.ctx.memory.free()
 
     def finish(self) -> None:
         if self._finishing:
             return
         super().finish()
-        self._output = self._compute()
+        if self._spiller is not None:
+            self._spill_accumulated()
+            for p in range(self.ctx.config.spill_partitions):
+                part = list(self._spiller.partition(p))
+                if not part:
+                    continue
+                out = self._compute_batches(part)
+                if out is not None:
+                    self._outputs.append(out)
+            self._spiller.close()
+            self._spiller = None
+        else:
+            out = self._compute_batches(self._batches)
+            if out is not None:
+                self._outputs.append(out)
         self._batches = []
         self.ctx.memory.free()
 
@@ -142,14 +180,13 @@ class HashAggregationOperator(Operator):
         self.ctx.stats.output_rows += num_groups
         return Batch(tuple(cols), num_groups)
 
-    def _compute(self) -> Optional[Batch]:
+    def _compute_batches(self, batches: List[Batch]) -> Optional[Batch]:
         import jax
         import jax.numpy as jnp
 
         from presto_tpu.ops.groupby import grouped_aggregate
 
-        data = device_concat(self._batches,
-                             self.ctx.config.min_batch_capacity)
+        data = device_concat(batches, self.ctx.config.min_batch_capacity)
         if data is None:
             return None  # grouped aggregation of zero rows -> zero rows
         doms = self._direct_domains(data)
@@ -201,13 +238,13 @@ class HashAggregationOperator(Operator):
         return out
 
     def get_output(self) -> Optional[Batch]:
-        out, self._output = self._output, None
-        if out is not None:
-            self._done = True
-        return out
+        if not self._outputs:
+            return None
+        self._done = True
+        return self._outputs.pop(0)
 
     def is_finished(self) -> bool:
-        return self._finishing and self._output is None
+        return self._finishing and not self._outputs
 
 
 class HashAggregationOperatorFactory(OperatorFactory):

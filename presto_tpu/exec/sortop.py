@@ -31,26 +31,29 @@ class OrderByOperator(Operator):
         self.specs = list(specs)
         self.limit = limit
         self._batches: List[Batch] = []
-        self._output: Optional[Batch] = None
+        self._outputs: List[Batch] = []
+        self._runs = []            # spilled sorted runs (FileSpiller each)
+        self._accumulated_bytes = 0
 
     def add_input(self, batch: Batch) -> None:
         self._batches.append(batch)
         self.ctx.stats.input_rows += batch.num_rows
         self.ctx.memory.reserve(batch.size_bytes)
+        self._accumulated_bytes += batch.size_bytes
+        cfg = self.ctx.config
+        if (cfg.spill_enabled
+                and self._accumulated_bytes > cfg.spill_threshold_bytes):
+            self._spill_run()
 
-    def finish(self) -> None:
-        if self._finishing:
-            return
-        super().finish()
+    def _sort_batches(self, batches: List[Batch]) -> Optional[Batch]:
+        """Device sort of the concatenated batches (one run)."""
         import jax.numpy as jnp
 
         from presto_tpu.ops.sort import sort_permutation
 
-        data = device_concat(self._batches, self.ctx.config.min_batch_capacity)
-        self._batches = []
-        self.ctx.memory.free()
+        data = device_concat(batches, self.ctx.config.min_batch_capacity)
         if data is None:
-            return
+            return None
         keys = []
         for s in self.specs:
             c = data.columns[s.channel]
@@ -65,21 +68,165 @@ class OrderByOperator(Operator):
                 keys.append((c.values, c.valid, c.type, s.descending,
                              s.nulls_first))
         perm = sort_permutation(keys, jnp.asarray(data.num_rows))
-        n = data.num_rows if self.limit is None else min(self.limit,
-                                                         data.num_rows)
         cols = tuple(
             Column(c.type, c.values[perm],
                    None if c.valid is None else c.valid[perm], c.dictionary)
             for c in data.columns)
-        self._output = Batch(cols, n)
-        self.ctx.stats.output_rows += n
+        return Batch(cols, data.num_rows)
+
+    def _spill_run(self) -> None:
+        """External sort: sort the accumulated chunk on device, spill it as
+        one sorted run (OrderByOperator's revocable path; runs are merged
+        at finish like the reference's MergeSortedPages)."""
+        from presto_tpu.exec.spill import FileSpiller
+
+        run = self._sort_batches(self._batches)
+        self._batches = []
+        self._accumulated_bytes = 0
+        self.ctx.memory.free()
+        if run is None:
+            return
+        import numpy as np
+
+        spiller = FileSpiller(self.ctx.config.spill_path,
+                              tag=f"sort-{self.ctx.name}")
+        step = max(1, self.ctx.config.scan_batch_rows)
+        run = run.compact().to_numpy()
+        for lo in range(0, run.num_rows, step):
+            hi = min(lo + step, run.num_rows)
+            spiller.spill(run.take(np.arange(lo, hi)))
+        self._runs.append(spiller)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        if not self._runs:
+            out = self._sort_batches(self._batches)
+            self._batches = []
+            self.ctx.memory.free()
+            if out is not None:
+                n = out.num_rows if self.limit is None else min(
+                    self.limit, out.num_rows)
+                self._outputs.append(out.head(n))
+                self.ctx.stats.output_rows += n
+            return
+        if self._batches:
+            self._spill_run()
+        self._merge_runs()
+
+    def _merge_runs(self) -> None:
+        """K-way merge of spilled sorted runs (MergeOperator.java:45 logic,
+        host-side; output batches stream out bounded)."""
+        import heapq
+
+        import numpy as np
+
+        from presto_tpu.batch import concat_batches
+        from presto_tpu.ops.keys import to_sortable_i64
+
+        def run_iter(spiller):
+            for batch in spiller.read_all():
+                yield batch.to_numpy()
+
+        def batch_words(batch: Batch) -> List[np.ndarray]:
+            words = []
+            for s in self.specs:
+                c = batch.columns[s.channel]
+                if c.type.is_dictionary:
+                    ranks = c.dictionary.sort_ranks()
+                    vals = np.asarray(ranks)[np.asarray(c.values)]
+                    w = to_sortable_i64(np, vals, T.INTEGER)
+                else:
+                    w = to_sortable_i64(np, np.asarray(c.values), c.type)
+                if s.descending:
+                    w = ~w
+                if c.valid is not None:
+                    null_word = np.where(
+                        np.asarray(c.valid),
+                        np.int8(1 if s.nulls_first else 0),
+                        np.int8(0 if s.nulls_first else 1))
+                    w = np.where(np.asarray(c.valid), w, np.int64(0))
+                    words.append(null_word)
+                    words.append(w)
+                else:
+                    words.append(w)
+            return words
+
+        iters = [run_iter(s) for s in self._runs]
+        states = []  # per run: [batch, words, pos]
+        heap = []
+        for ri, it in enumerate(iters):
+            batch = next(it, None)
+            if batch is None:
+                states.append(None)
+                continue
+            words = batch_words(batch)
+            states.append([batch, words, 0])
+            heap.append((tuple(w[0] for w in words), ri))
+        heapq.heapify(heap)
+
+        emitted = 0
+        limit = self.limit
+        # ordered emission: accumulate (batch, idx) picks in order, flush
+        # as a Batch whenever the output step fills
+        order: List[tuple] = []  # (batch, row_idx)
+        step = max(1, self.ctx.config.scan_batch_rows)
+
+        def flush():
+            nonlocal order, emitted
+            if not order:
+                return
+            groups: List[Batch] = []
+            i = 0
+            while i < len(order):
+                batch = order[i][0]
+                idxs = []
+                while i < len(order) and order[i][0] is batch:
+                    idxs.append(order[i][1])
+                    i += 1
+                groups.append(batch.take(np.asarray(idxs, np.int64)))
+            merged = concat_batches(groups) if len(groups) > 1 else groups[0]
+            if limit is not None and emitted + merged.num_rows > limit:
+                merged = merged.head(limit - emitted)
+            self._outputs.append(merged)
+            self.ctx.stats.output_rows += merged.num_rows
+            emitted += merged.num_rows
+            order = []
+
+        while heap:
+            if limit is not None and emitted + len(order) >= limit:
+                break
+            _, ri = heapq.heappop(heap)
+            batch, words, pos = states[ri]
+            order.append((batch, pos))
+            pos += 1
+            if pos >= batch.num_rows:
+                nxt = next(iters[ri], None)
+                if nxt is None:
+                    states[ri] = None
+                else:
+                    w = batch_words(nxt)
+                    states[ri] = [nxt, w, 0]
+                    heapq.heappush(heap, (tuple(x[0] for x in w), ri))
+            else:
+                states[ri][2] = pos
+                heapq.heappush(heap,
+                               (tuple(w[pos] for w in words), ri))
+            if len(order) >= step:
+                flush()
+        flush()
+        for s in self._runs:
+            s.close()
+        self._runs = []
 
     def get_output(self) -> Optional[Batch]:
-        out, self._output = self._output, None
-        return out
+        if not self._outputs:
+            return None
+        return self._outputs.pop(0)
 
     def is_finished(self) -> bool:
-        return self._finishing and self._output is None
+        return self._finishing and not self._outputs
 
 
 class OrderByOperatorFactory(OperatorFactory):

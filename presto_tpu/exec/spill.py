@@ -1,0 +1,127 @@
+"""Spill tier: HBM -> host-RAM/disk, below the memory contexts.
+
+The reference's revocable-memory protocol (MemoryRevokingScheduler
+triggering Operator.startMemoryRevoke, presto-main/.../execution/
+MemoryRevokingScheduler.java:46, Driver.java:478-488) lets accumulating
+operators shed state to disk: FileSingleStreamSpiller streams serialized
+pages to a temp file, GenericPartitioningSpiller hash-partitions rows
+across spill files so each partition can be processed alone
+(presto-main/.../spiller/, SURVEY §2.9).
+
+Same architecture here, with the native LZ4 serde as the file format:
+
+- ``FileSpiller``        — one append-only spill file of wire frames
+- ``PartitioningSpiller``— K FileSpillers + the device hash kernel
+                           routing each batch's rows to partitions
+
+Operators spill when their accumulated bytes cross
+``EngineConfig.spill_threshold_bytes`` (the self-triggered equivalent of
+the revoking scheduler; a single-process engine needs no cross-thread
+revoke rendezvous) and re-read partition-by-partition at finish, bounding
+peak HBM by 1/K of the input (P10 in SURVEY §2.13).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu.batch import Batch
+from presto_tpu.serde import deserialize_batch, frame_size, serialize_batch
+
+_counter = 0
+_counter_lock = threading.Lock()
+
+
+def _next_id() -> int:
+    global _counter
+    with _counter_lock:
+        _counter += 1
+        return _counter
+
+
+class FileSpiller:
+    """Append-only spill file of LZ4 wire frames
+    (FileSingleStreamSpiller role)."""
+
+    def __init__(self, spill_dir: str, tag: str = "spill"):
+        os.makedirs(spill_dir, exist_ok=True)
+        fd, self.path = tempfile.mkstemp(
+            prefix=f"{tag}-{_next_id()}-", suffix=".bin", dir=spill_dir)
+        self._file = os.fdopen(fd, "wb")
+        self.bytes_written = 0
+        self.rows_written = 0
+        self._closed = False
+
+    def spill(self, batch: Batch) -> None:
+        frame = serialize_batch(batch)
+        self._file.write(frame)
+        self.bytes_written += len(frame)
+        self.rows_written += batch.num_rows
+
+    def read_all(self) -> Iterator[Batch]:
+        """Finish writing and stream the spilled batches back."""
+        if not self._closed:
+            self._file.flush()
+            self._file.close()
+            self._closed = True
+        if self.bytes_written == 0:
+            return
+        with open(self.path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off < len(data):
+            size = frame_size(data, off)
+            yield deserialize_batch(data[off:off + size])
+            off += size
+
+    def close(self) -> None:
+        if not self._closed:
+            self._file.close()
+            self._closed = True
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class PartitioningSpiller:
+    """Hash-partitioned spill (GenericPartitioningSpiller role): rows are
+    routed by the device hash of ``channels`` so that any one partition
+    contains complete key groups."""
+
+    def __init__(self, spill_dir: str, n_partitions: int,
+                 channels: Sequence[int], tag: str = "pspill"):
+        self.n = n_partitions
+        self.channels = list(channels)
+        self.spillers = [FileSpiller(spill_dir, f"{tag}-p{i}")
+                         for i in range(n_partitions)]
+
+    def spill(self, batch: Batch) -> None:
+        import jax.numpy as jnp
+
+        from presto_tpu.ops.hashing import partition_of, row_hash
+
+        batch = batch.compact()
+        key_cols = [(batch.columns[c].values, batch.columns[c].valid,
+                     batch.columns[c].type) for c in self.channels]
+        parts = np.asarray(partition_of(row_hash(key_cols), self.n))
+        for p in range(self.n):
+            idx = np.nonzero(parts == p)[0]
+            if idx.size:
+                self.spillers[p].spill(batch.take(jnp.asarray(idx)))
+
+    def partition(self, i: int) -> Iterator[Batch]:
+        return self.spillers[i].read_all()
+
+    @property
+    def bytes_written(self) -> int:
+        return sum(s.bytes_written for s in self.spillers)
+
+    def close(self) -> None:
+        for s in self.spillers:
+            s.close()

@@ -69,7 +69,8 @@ class LocalQueryRunner:
     def execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
         if isinstance(stmt, t.Explain):
-            text = self.explain_text(stmt.statement)
+            text = (self.explain_analyze_text(stmt.statement)
+                    if stmt.analyze else self.explain_text(stmt.statement))
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.splitlines()])
         if isinstance(stmt, t.ShowTables):
@@ -191,6 +192,28 @@ class LocalQueryRunner:
         logical = Planner(self.metadata).plan(stmt)
         optimized = optimize(logical, self.metadata)
         return format_plan(optimized)
+
+    def explain_analyze_text(self, stmt: t.Node) -> str:
+        """EXPLAIN ANALYZE: run the query, render the plan plus the
+        per-operator wall/row rollup the Driver recorded
+        (ExplainAnalyzeOperator.java:34 + planPrinter role)."""
+        if not isinstance(stmt, (t.Query, t.SetOperation)):
+            raise ValueError("EXPLAIN ANALYZE requires a query")
+        logical = Planner(self.metadata).plan(stmt)
+        optimized = optimize(logical, self.metadata)
+        phys = PhysicalPlanner(self.registry, self.config).plan(optimized)
+        task = execute_pipelines(phys.pipelines, self.config)
+        lines = [format_plan(optimized).rstrip(), "", "Operator stats:"]
+        header = (f"{'operator':<40} {'in rows':>10} {'out rows':>10} "
+                  f"{'wall ms':>9} {'finish ms':>9}")
+        lines += [header, "-" * len(header)]
+        for s in task.operator_stats:
+            lines.append(
+                f"{s.operator:<40} {s.input_rows:>10} {s.output_rows:>10} "
+                f"{s.wall_ns / 1e6:>9.1f} {s.finish_wall_ns / 1e6:>9.1f}")
+        lines.append(
+            f"peak memory: {task.memory.peak / (1 << 20):.1f} MiB")
+        return "\n".join(lines)
 
     def _execute_query(self, q: t.Node) -> QueryResult:
         logical = Planner(self.metadata).plan(q)
