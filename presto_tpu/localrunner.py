@@ -36,14 +36,23 @@ class QueryResult:
 
 class LocalQueryRunner:
     def __init__(self, registry: ConnectorRegistry, default_catalog: str,
-                 config: EngineConfig = DEFAULT):
+                 config: EngineConfig = DEFAULT, session=None,
+                 access_control=None):
+        from presto_tpu.session import (
+            AllowAllAccessControl, Session, TransactionManager,
+        )
+
         self.registry = registry
         self.metadata = Metadata(registry, default_catalog)
         self.config = config
+        self.session = session or Session(catalog=default_catalog)
+        self.access_control = access_control or AllowAllAccessControl()
+        self.transaction_manager = TransactionManager()
 
     @classmethod
     def tpch(cls, scale: float = 0.01,
-             config: EngineConfig = DEFAULT) -> "LocalQueryRunner":
+             config: EngineConfig = DEFAULT, session=None,
+             access_control=None) -> "LocalQueryRunner":
         from presto_tpu.connectors.memory import (
             BlackHoleConnector, MemoryConnector,
         )
@@ -60,7 +69,8 @@ class LocalQueryRunner:
             nodes_fn=lambda: [("local", "local://", "dev", True,
                                "ACTIVE")]))
         reg.register("information_schema", InformationSchemaConnector(reg))
-        return cls(reg, "tpch", config)
+        return cls(reg, "tpch", config, session=session,
+                   access_control=access_control)
 
     def register(self, catalog: str, connector: Connector) -> None:
         self.registry.register(catalog, connector)
@@ -83,6 +93,17 @@ class LocalQueryRunner:
                 ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
                 [(n, schema.column_type(n).display())
                  for n in schema.column_names()])
+        if isinstance(stmt, t.SetSession):
+            self.session.set_property(stmt.name, stmt.value)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, t.ResetSession):
+            self.session.reset_property(stmt.name)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, t.ShowSession):
+            return QueryResult(
+                ["Name", "Value", "Default"],
+                [T.VARCHAR, T.VARCHAR, T.VARCHAR],
+                self.session.show_properties(self.config))
         if isinstance(stmt, t.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, t.CreateTableAs):
@@ -91,6 +112,8 @@ class LocalQueryRunner:
             return self._insert(stmt)
         if isinstance(stmt, t.DropTable):
             catalog, name, conn, _ = self.metadata.resolve_table(stmt.table)
+            self.access_control.check_can_drop_table(
+                self.session.user, catalog, name)
             conn.drop_table(name)
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
         if not isinstance(stmt, (t.Query, t.SetOperation)):
@@ -111,6 +134,8 @@ class LocalQueryRunner:
         from presto_tpu.connectors.api import ColumnMetadata, TableSchema
 
         catalog, name = self._resolve_write_target(stmt.table)
+        self.access_control.check_can_create_table(
+            self.session.user, catalog, name)
         conn = self.registry.get(catalog)
         schema = TableSchema(name, tuple(
             ColumnMetadata(cn, T.parse_type(ct))
@@ -123,6 +148,8 @@ class LocalQueryRunner:
 
         logical = Planner(self.metadata).plan(stmt.query)
         catalog, name = self._resolve_write_target(stmt.table)
+        self.access_control.check_can_create_table(
+            self.session.user, catalog, name)
         conn = self.registry.get(catalog)
         schema = TableSchema(name, tuple(
             ColumnMetadata(cn, typ) for cn, typ in logical.columns))
@@ -135,6 +162,8 @@ class LocalQueryRunner:
         from presto_tpu.sql.plan import OutputNode, ProjectNode
 
         catalog, name = self._resolve_write_target(stmt.table)
+        self.access_control.check_can_insert(
+            self.session.user, catalog, name)
         conn = self.registry.get(catalog)
         handle = conn.get_table(name)
         schema = conn.table_schema(handle)
@@ -172,11 +201,21 @@ class LocalQueryRunner:
     def _write(self, logical, conn, handle) -> QueryResult:
         from presto_tpu.exec.operators import TableWriterOperatorFactory
 
+        cfg = self.session.effective_config(self.config)
         optimized = optimize(logical, self.metadata)
-        planner = PhysicalPlanner(self.registry, self.config)
+        self._check_scans(optimized)
+        planner = PhysicalPlanner(self.registry, cfg)
         writer = TableWriterOperatorFactory(conn.page_sink(handle))
         pipelines = planner.plan_fragment(optimized.source, writer)
-        execute_pipelines(pipelines, self.config)
+        # per-query auto-commit transaction: the PageSink's finish IS the
+        # commit point; failures before it leave the table untouched
+        txn = self.transaction_manager.begin()
+        try:
+            execute_pipelines(pipelines, cfg)
+        except Exception:
+            self.transaction_manager.abort(txn)
+            raise
+        self.transaction_manager.commit(txn)
         return QueryResult(["rows"], [T.BIGINT],
                            [(writer.op.rows_written,)])
 
@@ -215,10 +254,21 @@ class LocalQueryRunner:
             f"peak memory: {task.memory.peak / (1 << 20):.1f} MiB")
         return "\n".join(lines)
 
+    def _check_scans(self, node) -> None:
+        from presto_tpu.sql.plan import TableScanNode
+
+        if isinstance(node, TableScanNode):
+            self.access_control.check_can_select(
+                self.session.user, node.catalog, node.table)
+        for s in node.sources:
+            self._check_scans(s)
+
     def _execute_query(self, q: t.Node) -> QueryResult:
+        cfg = self.session.effective_config(self.config)
         logical = Planner(self.metadata).plan(q)
         optimized = optimize(logical, self.metadata)
-        phys = PhysicalPlanner(self.registry, self.config).plan(optimized)
-        execute_pipelines(phys.pipelines, self.config)
+        self._check_scans(optimized)
+        phys = PhysicalPlanner(self.registry, cfg).plan(optimized)
+        execute_pipelines(phys.pipelines, cfg)
         return QueryResult(phys.column_names, phys.column_types,
                            phys.collector.rows())

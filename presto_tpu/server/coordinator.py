@@ -92,10 +92,11 @@ class QueryExecution:
     """One query's lifecycle (QueryStateMachine + SqlQueryExecution)."""
 
     def __init__(self, query_id: str, sql: str,
-                 coordinator: "CoordinatorServer"):
+                 coordinator: "CoordinatorServer", user: str = "user"):
         self.query_id = query_id
         self.sql = sql
         self.co = coordinator
+        self.user = user
         self.state = "QUEUED"
         self.error: Optional[str] = None
         self.column_names: List[str] = []
@@ -107,6 +108,23 @@ class QueryExecution:
         self._thread.start()
 
     def _run(self) -> None:
+        from presto_tpu.session import Session
+
+        group = self.co.resource_groups.group_for(
+            Session(user=self.user, catalog=self.co.default_catalog))
+        try:
+            group.acquire(timeout_s=300)
+        except Exception as e:  # noqa: BLE001 - admission rejection
+            self.error = str(e)
+            self.state = "FAILED"
+            self.rows_done.set()
+            return
+        try:
+            self._run_admitted()
+        finally:
+            group.release()
+
+    def _run_admitted(self) -> None:
         try:
             self.state = "PLANNING"
             stmt = parse_statement(self.sql)
@@ -250,12 +268,15 @@ class CoordinatorServer:
     def __init__(self, registry: ConnectorRegistry, default_catalog: str,
                  config: EngineConfig = DEFAULT, port: int = 0,
                  verbose: bool = False):
+        from presto_tpu.session import ResourceGroupManager
+
         self.registry = registry
         self.default_catalog = default_catalog
         self.config = config
         self.verbose = verbose
         self.nodes = NodeManager()
         self.queries: Dict[str, QueryExecution] = {}
+        self.resource_groups = ResourceGroupManager()
         co = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -277,8 +298,9 @@ class CoordinatorServer:
                 if parts == ["v1", "statement"]:
                     n = int(self.headers.get("Content-Length", 0))
                     sql = self.rfile.read(n).decode("utf-8")
+                    user = self.headers.get("X-Presto-User", "user")
                     qid = uuid.uuid4().hex[:16]
-                    q = QueryExecution(qid, sql, co)
+                    q = QueryExecution(qid, sql, co, user=user)
                     co.queries[qid] = q
                     self._json(200, {
                         "id": qid,

@@ -1,0 +1,323 @@
+"""Sessions, session properties, access control, transactions.
+
+The reference splits these across three subsystems that all hang off the
+per-query ``Session``:
+
+- **Session properties** (presto-main/.../SystemSessionProperties.java:51,
+  147 properties): per-query overrides of engine behavior, set via
+  ``SET SESSION k = v``, typed and validated against a registry.
+- **Access control** (presto-main/.../security/, presto-spi security SPI;
+  file-based impl in presto-plugin-toolkit): table-level permission
+  checks made at analysis time with the session identity.
+- **Transactions** (presto-main/.../transaction/TransactionManager
+  .java:28): one transaction per query (auto-commit), carrying connector
+  transaction handles.
+
+Here ``Session`` carries identity + catalog + property overrides and can
+materialize an effective ``EngineConfig``; ``AccessControl`` has allow-all
+and rule-based implementations; ``TransactionManager`` issues per-query
+transaction contexts with commit/abort callbacks into connectors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from presto_tpu.config import DEFAULT, EngineConfig
+
+# ---------------------------------------------------------------------------
+# session properties
+# ---------------------------------------------------------------------------
+
+# property name -> (config field, parser); the SystemSessionProperties
+# registry: every entry is typed and validated on SET
+SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
+    "spill_enabled": ("spill_enabled",
+                      lambda v: v.lower() in ("true", "1", "on")),
+    "spill_threshold_bytes": ("spill_threshold_bytes", int),
+    "spill_partitions": ("spill_partitions", int),
+    "scan_batch_rows": ("scan_batch_rows", int),
+    "min_batch_capacity": ("min_batch_capacity", int),
+    "task_concurrency": ("task_concurrency", int),
+    "join_expansion_factor": ("join_expansion_factor", int),
+    "direct_groupby_max_domain": ("direct_groupby_max_domain", int),
+}
+
+
+class SessionError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class Session:
+    """Per-connection context (Session.java role)."""
+
+    user: str = "user"
+    catalog: str = "tpch"
+    properties: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def set_property(self, name: str, value: str) -> None:
+        name = name.lower()
+        if name not in SESSION_PROPERTIES:
+            raise SessionError(f"unknown session property {name!r}")
+        _, parse = SESSION_PROPERTIES[name]
+        try:
+            self.properties[name] = parse(value)
+        except (ValueError, TypeError) as e:
+            raise SessionError(
+                f"bad value for session property {name!r}: {value!r}"
+            ) from e
+
+    def reset_property(self, name: str) -> None:
+        self.properties.pop(name.lower(), None)
+
+    def effective_config(self, base: EngineConfig = DEFAULT) -> EngineConfig:
+        if not self.properties:
+            return base
+        fields = {SESSION_PROPERTIES[k][0]: v
+                  for k, v in self.properties.items()}
+        return dataclasses.replace(base, **fields)
+
+    def show_properties(self, base: EngineConfig = DEFAULT
+                        ) -> List[Tuple[str, str, str]]:
+        """(name, value, default) rows for SHOW SESSION."""
+        out = []
+        for name, (field, _) in sorted(SESSION_PROPERTIES.items()):
+            default = getattr(base, field)
+            value = self.properties.get(name, default)
+            out.append((name, str(value), str(default)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# access control
+# ---------------------------------------------------------------------------
+
+class AccessDeniedError(PermissionError):
+    pass
+
+
+class AccessControl:
+    """SystemAccessControl SPI surface used by the engine."""
+
+    def check_can_select(self, user: str, catalog: str, table: str) -> None:
+        raise NotImplementedError
+
+    def check_can_insert(self, user: str, catalog: str, table: str) -> None:
+        raise NotImplementedError
+
+    def check_can_create_table(self, user: str, catalog: str,
+                               table: str) -> None:
+        raise NotImplementedError
+
+    def check_can_drop_table(self, user: str, catalog: str,
+                             table: str) -> None:
+        raise NotImplementedError
+
+
+class AllowAllAccessControl(AccessControl):
+    def check_can_select(self, user, catalog, table):
+        pass
+
+    def check_can_insert(self, user, catalog, table):
+        pass
+
+    def check_can_create_table(self, user, catalog, table):
+        pass
+
+    def check_can_drop_table(self, user, catalog, table):
+        pass
+
+
+class RuleBasedAccessControl(AccessControl):
+    """The file-based access control model (presto-plugin-toolkit's
+    FileBasedSystemAccessControl): ordered rules of
+    {user, catalog, table, privileges}; first match wins, no match denies.
+    Patterns are '*'-wildcards."""
+
+    def __init__(self, rules: List[Dict[str, Any]]):
+        self.rules = rules
+
+    @staticmethod
+    def _match(pattern: str, value: str) -> bool:
+        import fnmatch
+
+        return fnmatch.fnmatch(value, pattern)
+
+    def _check(self, user: str, catalog: str, table: str,
+               privilege: str) -> None:
+        for rule in self.rules:
+            if not self._match(rule.get("user", "*"), user):
+                continue
+            if not self._match(rule.get("catalog", "*"), catalog):
+                continue
+            if not self._match(rule.get("table", "*"), table):
+                continue
+            if privilege in rule.get("privileges", ()):
+                return
+            break  # first matching rule decides
+        raise AccessDeniedError(
+            f"Access denied: {user} cannot {privilege} "
+            f"{catalog}.{table}")
+
+    def check_can_select(self, user, catalog, table):
+        self._check(user, catalog, table, "select")
+
+    def check_can_insert(self, user, catalog, table):
+        self._check(user, catalog, table, "insert")
+
+    def check_can_create_table(self, user, catalog, table):
+        self._check(user, catalog, table, "create")
+
+    def check_can_drop_table(self, user, catalog, table):
+        self._check(user, catalog, table, "drop")
+
+
+# ---------------------------------------------------------------------------
+# transactions
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TransactionInfo:
+    transaction_id: str
+    auto_commit: bool = True
+    # connector-side commit/abort callbacks registered during execution
+    commit_actions: List[Callable[[], None]] = dataclasses.field(
+        default_factory=list)
+    abort_actions: List[Callable[[], None]] = dataclasses.field(
+        default_factory=list)
+    state: str = "ACTIVE"          # ACTIVE | COMMITTED | ABORTED
+
+
+class TransactionManager:
+    """Per-query auto-commit transactions (TransactionManager.java:28).
+    The engine's writes are single-commit PageSink finishes; the manager
+    sequences those commits and exposes abort for failure paths."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.transactions: Dict[str, TransactionInfo] = {}
+
+    def begin(self, auto_commit: bool = True) -> TransactionInfo:
+        txn = TransactionInfo(uuid.uuid4().hex[:16], auto_commit)
+        with self._lock:
+            self.transactions[txn.transaction_id] = txn
+        return txn
+
+    def commit(self, txn: TransactionInfo) -> None:
+        if txn.state != "ACTIVE":
+            raise RuntimeError(f"transaction is {txn.state}")
+        for action in txn.commit_actions:
+            action()
+        txn.state = "COMMITTED"
+        self._forget(txn)
+
+    def abort(self, txn: TransactionInfo) -> None:
+        if txn.state != "ACTIVE":
+            return
+        for action in txn.abort_actions:
+            try:
+                action()
+            except Exception:  # noqa: BLE001 - abort is best-effort
+                pass
+        txn.state = "ABORTED"
+        self._forget(txn)
+
+    def _forget(self, txn: TransactionInfo) -> None:
+        with self._lock:
+            self.transactions.pop(txn.transaction_id, None)
+
+
+# ---------------------------------------------------------------------------
+# resource groups
+# ---------------------------------------------------------------------------
+
+class QueryQueueFullError(RuntimeError):
+    pass
+
+
+class ResourceGroup:
+    """One node of the admission-control tree
+    (InternalResourceGroup.java:77): bounded running + queued queries,
+    FIFO release.  ``hard_concurrency_limit`` / ``max_queued`` follow the
+    reference's property names."""
+
+    def __init__(self, name: str, hard_concurrency_limit: int = 16,
+                 max_queued: int = 64,
+                 parent: Optional["ResourceGroup"] = None):
+        self.name = name
+        self.hard_concurrency_limit = hard_concurrency_limit
+        self.max_queued = max_queued
+        self.parent = parent
+        self.running = 0
+        self.queued = 0
+        self._cond = threading.Condition(
+            parent._cond if parent is not None else threading.Lock())
+
+    def _can_run_locked(self) -> bool:
+        node: Optional[ResourceGroup] = self
+        while node is not None:
+            if node.running >= node.hard_concurrency_limit:
+                return False
+            node = node.parent
+        return True
+
+    def acquire(self, timeout_s: Optional[float] = None) -> None:
+        """Block until a run slot frees; raise when the queue is full."""
+        with self._cond:
+            if self._can_run_locked():
+                self._grab_locked()
+                return
+            if self.queued >= self.max_queued:
+                raise QueryQueueFullError(
+                    f"Too many queued queries for {self.name!r}")
+            self.queued += 1
+            try:
+                ok = self._cond.wait_for(self._can_run_locked,
+                                         timeout=timeout_s)
+                if not ok:
+                    raise QueryQueueFullError(
+                        f"queue wait timed out for {self.name!r}")
+                self._grab_locked()
+            finally:
+                self.queued -= 1
+
+    def _grab_locked(self) -> None:
+        node: Optional[ResourceGroup] = self
+        while node is not None:
+            node.running += 1
+            node = node.parent
+
+    def release(self) -> None:
+        with self._cond:
+            node: Optional[ResourceGroup] = self
+            while node is not None:
+                node.running -= 1
+                node = node.parent
+            self._cond.notify_all()
+
+
+class ResourceGroupManager:
+    """Selects the group for a session (the rule-based selector role:
+    per-user groups under a root)."""
+
+    def __init__(self, hard_concurrency_limit: int = 16,
+                 max_queued: int = 64, per_user_limit: int = 8):
+        self.root = ResourceGroup("global", hard_concurrency_limit,
+                                  max_queued)
+        self.per_user_limit = per_user_limit
+        self._groups: Dict[str, ResourceGroup] = {}
+        self._lock = threading.Lock()
+
+    def group_for(self, session: Session) -> ResourceGroup:
+        with self._lock:
+            g = self._groups.get(session.user)
+            if g is None:
+                g = ResourceGroup(f"global.{session.user}",
+                                  self.per_user_limit,
+                                  self.root.max_queued, parent=self.root)
+                self._groups[session.user] = g
+            return g

@@ -38,7 +38,7 @@ KEYWORDS = {
     "substring", "for", "coalesce", "nullif", "year", "month", "day",
     "hour", "minute", "second", "over", "partition", "rows", "range",
     "unbounded", "preceding", "following", "current", "row", "create",
-    "table", "insert", "into", "drop", "values",
+    "table", "insert", "into", "drop", "values", "set", "reset", "session",
 }
 
 _TWO_CHAR = ("<=", ">=", "<>", "!=", "||")

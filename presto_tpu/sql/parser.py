@@ -149,9 +149,28 @@ class _Parser:
             self.accept_op(";")
             self.expect_eof()
             return t.DropTable(name)
+        if self.accept_kw("set"):
+            self.expect_kw("session")
+            name = ".".join(self.qualified_name())
+            self.expect_op("=")
+            tok = self.next()
+            if tok.kind not in ("STRING", "NUMBER", "KEYWORD", "IDENT"):
+                raise SqlSyntaxError("expected session property value",
+                                     tok.line, tok.col)
+            self.accept_op(";")
+            self.expect_eof()
+            return t.SetSession(name, tok.text)
+        if self.accept_kw("reset"):
+            self.expect_kw("session")
+            name = ".".join(self.qualified_name())
+            self.accept_op(";")
+            self.expect_eof()
+            return t.ResetSession(name)
         if self.accept_kw("show"):
             if self.accept_kw("tables"):
                 node: t.Node = t.ShowTables()
+            elif self.accept_kw("session"):
+                node = t.ShowSession()
             else:
                 self.expect_kw("columns")
                 self.expect_kw("from")

@@ -333,6 +333,22 @@ class Explain(Node):
 
 
 @D(frozen=True)
+class SetSession(Node):
+    name: str
+    value: str                       # literal text
+
+
+@D(frozen=True)
+class ResetSession(Node):
+    name: str
+
+
+@D(frozen=True)
+class ShowSession(Node):
+    pass
+
+
+@D(frozen=True)
 class ShowTables(Node):
     pass
 

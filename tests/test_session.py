@@ -1,0 +1,146 @@
+"""Session properties, access control, transactions, resource groups.
+
+Reference analogues: SystemSessionProperties + SET SESSION, the security
+SPI with file-based rules, TransactionManager, InternalResourceGroup
+(SURVEY §2.12, §5.6)."""
+
+import threading
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+from presto_tpu.session import (
+    AccessDeniedError, QueryQueueFullError, ResourceGroupManager,
+    RuleBasedAccessControl, Session, SessionError, TransactionManager,
+)
+
+
+class TestSessionProperties:
+    def test_set_show_reset(self):
+        r = LocalQueryRunner.tpch(scale=0.001)
+        r.execute("set session spill_enabled = false")
+        rows = dict((n, v) for n, v, _ in
+                    r.execute("show session").rows)
+        assert rows["spill_enabled"] == "False"
+        r.execute("reset session spill_enabled")
+        rows = dict((n, v) for n, v, _ in
+                    r.execute("show session").rows)
+        assert rows["spill_enabled"] == "True"
+
+    def test_property_affects_execution(self):
+        r = LocalQueryRunner.tpch(scale=0.001)
+        r.execute("set session scan_batch_rows = 128")
+        assert r.session.effective_config(r.config).scan_batch_rows == 128
+        # still executes correctly with tiny batches
+        assert r.execute("select count(*) from nation").rows == [(25,)]
+
+    def test_unknown_property_rejected(self):
+        s = Session()
+        with pytest.raises(SessionError):
+            s.set_property("no_such_prop", "1")
+
+    def test_bad_value_rejected(self):
+        s = Session()
+        with pytest.raises(SessionError):
+            s.set_property("spill_partitions", "banana")
+
+
+class TestAccessControl:
+    def _runner(self, user: str):
+        rules = [
+            {"user": "admin", "privileges": ["select", "insert", "create",
+                                             "drop"]},
+            {"user": "reader", "catalog": "tpch",
+             "privileges": ["select"]},
+        ]
+        return LocalQueryRunner.tpch(
+            scale=0.001, session=Session(user=user, catalog="tpch"),
+            access_control=RuleBasedAccessControl(rules))
+
+    def test_admin_can_do_everything(self):
+        r = self._runner("admin")
+        r.execute("select count(*) from nation")
+        r.execute("create table memory.t (a bigint)")
+        r.execute("insert into memory.t values (1)")
+        r.execute("drop table memory.t")
+
+    def test_reader_can_only_select_tpch(self):
+        r = self._runner("reader")
+        assert r.execute("select count(*) from nation").rows == [(25,)]
+        with pytest.raises(AccessDeniedError):
+            r.execute("create table memory.t (a bigint)")
+
+    def test_stranger_denied(self):
+        r = self._runner("stranger")
+        with pytest.raises(AccessDeniedError):
+            r.execute("select count(*) from nation")
+
+
+class TestTransactions:
+    def test_commit_and_abort_flow(self):
+        tm = TransactionManager()
+        events = []
+        txn = tm.begin()
+        txn.commit_actions.append(lambda: events.append("commit"))
+        tm.commit(txn)
+        assert events == ["commit"]
+        assert txn.state == "COMMITTED"
+
+        txn2 = tm.begin()
+        txn2.abort_actions.append(lambda: events.append("abort"))
+        tm.abort(txn2)
+        assert events == ["commit", "abort"]
+        assert not tm.transactions
+
+    def test_failed_insert_aborts(self):
+        r = LocalQueryRunner.tpch(scale=0.001)
+        r.execute("create table memory.t (a bigint)")
+        with pytest.raises(Exception):
+            r.execute("insert into memory.t "
+                      "select no_col from nation")
+        # nothing half-written
+        assert r.execute("select count(*) from memory.t").rows == [(0,)]
+
+
+class TestResourceGroups:
+    def test_concurrency_limit_queues(self):
+        mgr = ResourceGroupManager(hard_concurrency_limit=2,
+                                   per_user_limit=2)
+        g = mgr.group_for(Session(user="u"))
+        g.acquire()
+        g.acquire()
+        started = threading.Event()
+        acquired = threading.Event()
+
+        def waiter():
+            started.set()
+            g.acquire(timeout_s=10)
+            acquired.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        started.wait(1)
+        assert not acquired.wait(0.3)  # blocked at the limit
+        g.release()
+        assert acquired.wait(5)
+        g.release()
+        g.release()
+
+    def test_queue_full_rejects(self):
+        mgr = ResourceGroupManager(hard_concurrency_limit=1,
+                                   per_user_limit=1, max_queued=0)
+        g = mgr.group_for(Session(user="u"))
+        g.acquire()
+        with pytest.raises(QueryQueueFullError):
+            g.acquire(timeout_s=0.1)
+        g.release()
+
+    def test_per_user_isolation(self):
+        mgr = ResourceGroupManager(hard_concurrency_limit=10,
+                                   per_user_limit=1)
+        ga = mgr.group_for(Session(user="a"))
+        gb = mgr.group_for(Session(user="b"))
+        ga.acquire()
+        gb.acquire()  # b unaffected by a's per-user limit
+        ga.release()
+        gb.release()
