@@ -61,8 +61,11 @@ class LocalQueryRunner:
         )
         from presto_tpu.connectors.tpch import TpchConnector
 
+        from presto_tpu.connectors.tpcds import TpcdsConnector
+
         reg = ConnectorRegistry()
         reg.register("tpch", TpchConnector(scale=scale))
+        reg.register("tpcds", TpcdsConnector(scale=scale))
         reg.register("memory", MemoryConnector())
         reg.register("blackhole", BlackHoleConnector())
         reg.register("system", SystemConnector(

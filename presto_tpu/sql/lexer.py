@@ -39,6 +39,7 @@ KEYWORDS = {
     "hour", "minute", "second", "over", "partition", "rows", "range",
     "unbounded", "preceding", "following", "current", "row", "create",
     "table", "insert", "into", "drop", "values", "set", "reset", "session",
+    "grouping", "sets", "rollup", "cube",
 }
 
 _TWO_CHAR = ("<=", ">=", "<>", "!=", "||")

@@ -35,7 +35,8 @@ from presto_tpu.sql.plan import (
 
 
 def optimize(plan: OutputNode, metadata=None) -> OutputNode:
-    node = _rewrite_bottom_up(plan, metadata)
+    node = push_filters_down(plan)
+    node = _rewrite_bottom_up(node, metadata)
     node = prune_columns(node)
     return node
 
@@ -77,11 +78,105 @@ def and_all(exprs: Sequence[RowExpression]) -> RowExpression:
 # join extraction
 # ---------------------------------------------------------------------------
 
+def substitute(expr: RowExpression,
+               exprs: Sequence[RowExpression]) -> RowExpression:
+    """Replace InputRef(i) with exprs[i] (pushdown through a projection)."""
+    if isinstance(expr, InputRef):
+        return exprs[expr.index]
+    if isinstance(expr, Call):
+        return dataclasses.replace(
+            expr, args=tuple(substitute(a, exprs) for a in expr.args))
+    if isinstance(expr, SpecialForm):
+        return dataclasses.replace(
+            expr, args=tuple(substitute(a, exprs) for a in expr.args))
+    return expr
+
+
+def _push_filter(node: FilterNode) -> PlanNode:
+    """One predicate-pushdown step (PredicatePushDown.java role): move
+    eligible conjuncts below Filter/Project/outer-Join/SemiJoin/Union.
+    Returns ``node`` unchanged when nothing can move."""
+    src = node.source
+    conjuncts = split_and(node.predicate)
+    if isinstance(src, FilterNode):
+        return FilterNode(src.source,
+                          and_all(conjuncts + split_and(src.predicate)))
+    if isinstance(src, ProjectNode):
+        # substitution is safe: projections are pure expressions
+        below = [substitute(c, src.expressions) for c in conjuncts]
+        return ProjectNode(FilterNode(src.source, and_all(below)),
+                           src.expressions, src.columns)
+    if isinstance(src, JoinNode) and src.kind in ("left",):
+        nleft = len(src.left.columns)
+        pushable = [c for c in conjuncts
+                    if all(ch < nleft for ch in input_channels(c))]
+        rest = [c for c in conjuncts if c not in pushable]
+        if pushable:
+            new_left = FilterNode(src.left, and_all(pushable))
+            new_join = dataclasses.replace(src, left=new_left)
+            return (FilterNode(new_join, and_all(rest)) if rest
+                    else new_join)
+    if isinstance(src, SemiJoinNode):
+        nsrc = len(src.source.columns)
+        pushable = [c for c in conjuncts
+                    if all(ch < nsrc for ch in input_channels(c))]
+        rest = [c for c in conjuncts if c not in pushable]
+        if pushable:
+            new_inner = FilterNode(src.source, and_all(pushable))
+            new_semi = dataclasses.replace(src, source=new_inner)
+            return (FilterNode(new_semi, and_all(rest)) if rest
+                    else new_semi)
+    if isinstance(src, UnionNode):
+        return UnionNode(tuple(
+            FilterNode(inp, node.predicate) for inp in src.inputs),
+            src.columns)
+    return node
+
+
+def push_filters_down(node: PlanNode) -> PlanNode:
+    """Top-down predicate pushdown to fixpoint: conjuncts only ever move
+    downward, so one sweep terminates."""
+    while isinstance(node, FilterNode):
+        pushed = _push_filter(node)
+        if pushed is node:
+            break
+        node = pushed
+    return _replace_sources(node,
+                            [push_filters_down(s) for s in node.sources])
+
+
+def _cross_chain(leaves: List[PlanNode]) -> PlanNode:
+    cur = leaves[0]
+    for leaf in leaves[1:]:
+        cur = JoinNode("cross", cur, leaf, (), (),
+                       tuple(cur.columns) + tuple(leaf.columns))
+    return cur
+
+
 def _rewrite_bottom_up(node: PlanNode, metadata) -> PlanNode:
+    # Filter-over-join-chain (and bare chains): flatten BEFORE recursing
+    # so WHERE conjuncts and ON keys place together during join
+    # reordering (ReorderJoins + PredicatePushDown interplay); recursion
+    # descends into the chain's leaves only, so extraction runs once.
+    chain = None
+    extra: List[RowExpression] = []
+    if isinstance(node, FilterNode) and _is_join_chain(node.source):
+        chain, extra = node.source, split_and(node.predicate)
+    elif _is_join_chain(node) and _chain_size(node) > 2:
+        chain = node
+    if chain is not None:
+        tree, conjs = _flatten_joins(chain)
+        leaves = [_rewrite_bottom_up(l, metadata)
+                  for l in _cross_leaves(tree)]
+        tree = _cross_chain(leaves)
+        conjs = conjs + extra
+        if conjs:
+            return extract_joins(FilterNode(tree, and_all(conjs)),
+                                 metadata)
+        return tree
+
     node = _replace_sources(
         node, [_rewrite_bottom_up(s, metadata) for s in node.sources])
-    if isinstance(node, FilterNode) and _is_cross_tree(node.source):
-        return extract_joins(node, metadata)
     if isinstance(node, AggregationNode) and any(
             a.distinct for a in node.aggregates):
         return rewrite_distinct_aggregates(node)
@@ -110,6 +205,42 @@ def _replace_sources(node: PlanNode,
 def _is_cross_tree(node: PlanNode) -> bool:
     return (isinstance(node, JoinNode) and node.kind == "cross"
             and not node.left_keys)
+
+
+def _is_join_chain(node: PlanNode) -> bool:
+    """A tree of cross/inner joins (flattenable for reorder+pushdown)."""
+    return isinstance(node, JoinNode) and node.kind in ("cross", "inner")
+
+
+def _chain_size(node: PlanNode) -> int:
+    if _is_join_chain(node):
+        return _chain_size(node.left) + _chain_size(node.right)  # type: ignore[attr-defined]
+    return 1
+
+
+def _flatten_joins(node: PlanNode) -> Tuple[PlanNode, List[RowExpression]]:
+    """Inner/cross join tree -> (pure cross tree, conjuncts) in the tree's
+    own output channel space; join keys become equality conjuncts and
+    residuals are re-split.  Channel layout is preserved because inner and
+    cross joins both concatenate left+right columns."""
+    if not _is_join_chain(node):
+        return node, []
+    assert isinstance(node, JoinNode)
+    lt, lc = _flatten_joins(node.left)
+    rt, rc = _flatten_joins(node.right)
+    nleft = len(node.left.columns)
+    conjs = list(lc)
+    for c in rc:
+        conjs.append(remap(c, {ch: ch + nleft
+                               for ch in input_channels(c)}))
+    for lk, rk in zip(node.left_keys, node.right_keys):
+        conjs.append(B.comparison(
+            "=", InputRef(lk, node.left.columns[lk][1]),
+            InputRef(nleft + rk, node.right.columns[rk][1])))
+    if node.residual is not None:
+        conjs.extend(split_and(node.residual))
+    tree = JoinNode("cross", lt, rt, (), (), node.columns)
+    return tree, conjs
 
 
 def _cross_leaves(node: PlanNode) -> List[PlanNode]:
@@ -235,13 +366,19 @@ def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
     pending_residual = list(residual)
 
     def connected() -> Optional[int]:
+        # among relations connected to the joined prefix, take the
+        # smallest estimate first (build small hash tables early, the
+        # DetermineJoinDistributionType/ReorderJoins cost intuition)
+        candidates = set()
         for i, (la, _, lb, _) in enumerate(edges):
             if used_edges[i]:
                 continue
             if la in joined and lb in remaining:
-                return lb
+                candidates.add(lb)
             if lb in joined and la in remaining:
-                return la
+                candidates.add(la)
+        if candidates:
+            return min(candidates, key=lambda i: sizes[i])
         return next(iter(remaining)) if remaining else None
 
     while remaining:
@@ -324,10 +461,15 @@ def _ref_at(node: PlanNode, ch: int) -> InputRef:
 
 def rewrite_distinct_aggregates(node: AggregationNode) -> PlanNode:
     """Aggregate(keys, [agg(distinct x)]) ->
-    Aggregate(keys, [agg(x)]) over Aggregate(keys + x, [])."""
+    Aggregate(keys, [agg(x)]) over Aggregate(keys + x, []).
+
+    Mixed DISTINCT + plain aggregates split into two aggregations over the
+    same source joined back on the group keys (the role the reference's
+    MarkDistinct rewrite plays; NULL group keys pair as in the all-plain
+    path because both sides derive them identically — except that a join
+    on NULL keys drops them, an accepted divergence noted here)."""
     if not all(a.distinct for a in node.aggregates):
-        raise NotImplementedError(
-            "mixed DISTINCT and plain aggregates are not supported yet")
+        return _rewrite_mixed_distinct(node)
     in_channels = sorted({a.channel for a in node.aggregates
                           if a.channel is not None})
     inner_keys = tuple(node.group_channels) + tuple(in_channels)
@@ -348,6 +490,42 @@ def rewrite_distinct_aggregates(node: AggregationNode) -> PlanNode:
     return AggregationNode(inner,
                            tuple(range(len(node.group_channels))),
                            tuple(aggs), node.columns)
+
+
+def _rewrite_mixed_distinct(node: AggregationNode) -> PlanNode:
+    """Split mixed aggregates into a distinct-only and a plain-only
+    aggregation over the same source, joined on the group keys (cross
+    join of the two single rows in the global case)."""
+    ngroups = len(node.group_channels)
+    d_idx = [i for i, a in enumerate(node.aggregates) if a.distinct]
+    p_idx = [i for i, a in enumerate(node.aggregates) if not a.distinct]
+    key_cols = tuple(node.columns[:ngroups])
+
+    def agg_node(indices: List[int]) -> AggregationNode:
+        aggs = tuple(node.aggregates[i] for i in indices)
+        cols = key_cols + tuple(node.columns[ngroups + i] for i in indices)
+        return AggregationNode(node.source, node.group_channels, aggs,
+                               cols)
+
+    left = rewrite_distinct_aggregates(agg_node(d_idx))
+    right = agg_node(p_idx)
+    nleft = len(left.columns)
+    out_cols = tuple(left.columns) + tuple(right.columns)
+    if ngroups:
+        keys = tuple(range(ngroups))
+        joined: PlanNode = JoinNode("inner", left, right, keys, keys,
+                                    out_cols)
+    else:
+        joined = JoinNode("cross", left, right, (), (), out_cols)
+    # restore the original column order: keys, then aggregates interleaved
+    exprs: List[RowExpression] = [
+        InputRef(i, t) for i, (_, t) in enumerate(key_cols)]
+    d_pos = {i: ngroups + k for k, i in enumerate(d_idx)}
+    p_pos = {i: nleft + ngroups + k for k, i in enumerate(p_idx)}
+    for i in range(len(node.aggregates)):
+        src_ch = d_pos.get(i, p_pos.get(i))
+        exprs.append(InputRef(src_ch, out_cols[src_ch][1]))
+    return ProjectNode(joined, tuple(exprs), node.columns)
 
 
 # ---------------------------------------------------------------------------

@@ -196,9 +196,8 @@ class _Parser:
                     break
         body = self.query_expr()
         if isinstance(body, t.Query):
-            return t.Query(body.select, body.relations, body.where,
-                           body.group_by, body.having, body.order_by,
-                           body.limit, body.distinct, tuple(with_queries))
+            return dataclasses.replace(
+                body, with_queries=tuple(with_queries))
         return t.SetOperation(body.op, body.all, body.left, body.right,
                               body.order_by, body.limit, tuple(with_queries))
 
@@ -273,17 +272,76 @@ class _Parser:
         where = self.expression() if self.accept_kw("where") else None
 
         group_by: List[t.Expression] = []
+        grouping_sets = None
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.expression())
-            while self.accept_op(","):
+            if self.at_kw("grouping", "rollup", "cube"):
+                group_by, grouping_sets = self.grouping_element()
+            else:
                 group_by.append(self.expression())
+                while self.accept_op(","):
+                    group_by.append(self.expression())
 
         having = self.expression() if self.accept_kw("having") else None
         # ORDER BY / LIMIT are parsed by query_expr so they attach to the
         # whole set operation when UNION/INTERSECT/EXCEPT follows.
         return t.Query(tuple(select), tuple(relations), where,
-                       tuple(group_by), having, (), None, distinct)
+                       tuple(group_by), having, (), None, distinct,
+                       grouping_sets=grouping_sets)
+
+    def grouping_element(self):
+        """ROLLUP(a, b) / CUBE(a, b) / GROUPING SETS ((a,b),(a),()) ->
+        (column list, index subsets)."""
+        columns: List[t.Expression] = []
+
+        def col_index(e: t.Expression) -> int:
+            for i, c in enumerate(columns):
+                if c == e:
+                    return i
+            columns.append(e)
+            return len(columns) - 1
+
+        if self.accept_kw("rollup"):
+            self.expect_op("(")
+            exprs = [self.expression()]
+            while self.accept_op(","):
+                exprs.append(self.expression())
+            self.expect_op(")")
+            idxs = [col_index(e) for e in exprs]
+            sets = [tuple(idxs[:k]) for k in range(len(idxs), -1, -1)]
+            return columns, tuple(sets)
+        if self.accept_kw("cube"):
+            self.expect_op("(")
+            exprs = [self.expression()]
+            while self.accept_op(","):
+                exprs.append(self.expression())
+            self.expect_op(")")
+            idxs = [col_index(e) for e in exprs]
+            sets = []
+            for mask in range(1 << len(idxs), -1, -1):
+                if mask < (1 << len(idxs)):
+                    sets.append(tuple(i for b, i in enumerate(idxs)
+                                      if mask & (1 << b)))
+            return columns, tuple(sets)
+        self.expect_kw("grouping")
+        self.expect_kw("sets")
+        self.expect_op("(")
+        sets = []
+        while True:
+            if self.accept_op("("):
+                subset = []
+                if not self.at_op(")"):
+                    subset.append(col_index(self.expression()))
+                    while self.accept_op(","):
+                        subset.append(col_index(self.expression()))
+                self.expect_op(")")
+                sets.append(tuple(subset))
+            else:
+                sets.append((col_index(self.expression()),))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return columns, tuple(sets)
 
     def select_item(self) -> t.SelectItem:
         if self.at_op("*"):

@@ -614,7 +614,10 @@ class Planner:
                     or (q.having is not None
                         and _contains_aggregate(q.having)))
         if has_aggs:
-            rel, grouping = self._plan_aggregation(rel, q)
+            if q.grouping_sets is not None:
+                rel, grouping = self._plan_grouping_sets(rel, q)
+            else:
+                rel, grouping = self._plan_aggregation(rel, q)
             # HAVING: plain conjuncts filter; subquery conjuncts transform
             plain_h: List[t.Expression] = []
             for c in split_conjuncts(q.having):
@@ -1208,6 +1211,67 @@ class Planner:
         # grouped translator resolves via GroupingContext.lookup, so scope
         # names stay synthetic
         return out, grouping
+
+    def _plan_grouping_sets(self, rel: RelationPlan, q: t.Query):
+        """GROUPING SETS / ROLLUP / CUBE: one aggregation per set over the
+        shared pre-projection, each projected onto the full key schema
+        (absent keys as NULL), unioned — the GroupIdOperator role
+        (presto-main/.../operator/GroupIdOperator.java:32) expressed as a
+        union of grouped aggregations."""
+        scope = rel.scope
+        tr = Translator(scope)
+        group_asts = list(q.group_by)
+        group_rex = [tr.translate(g) for g in group_asts]
+
+        agg_asts: List[t.FunctionCall] = []
+        for item in q.select:
+            _collect_aggs(item.expr, agg_asts)
+        if q.having is not None:
+            _collect_aggs(q.having, agg_asts)
+        for s in q.order_by:
+            _collect_aggs(s.expr, agg_asts)
+
+        pre_exprs: List[RowExpression] = list(group_rex)
+        aggs: List[PlanAggregate] = []
+        for a in agg_asts:
+            if a.is_star or not a.args:
+                spec = resolve_aggregate("count", None)
+                aggs.append(PlanAggregate(spec, None, a.distinct))
+                continue
+            arg = tr.translate(a.args[0])
+            spec = resolve_aggregate(a.name, arg.type)
+            aggs.append(PlanAggregate(spec, len(pre_exprs), a.distinct))
+            pre_exprs.append(arg)
+        pre_cols = tuple((f"c{i}", x.type) for i, x in enumerate(pre_exprs))
+        pre = ProjectNode(rel.node, tuple(pre_exprs), pre_cols)
+
+        key_types = [x.type for x in group_rex]
+        out_cols = (tuple((f"g{i}", typ)
+                          for i, typ in enumerate(key_types))
+                    + tuple((f"agg{i}", a.spec.result_type)
+                            for i, a in enumerate(aggs)))
+        branches: List[PlanNode] = []
+        for subset in q.grouping_sets:
+            branch_cols = (tuple((f"g{i}", key_types[i]) for i in subset)
+                           + tuple((f"agg{i}", a.spec.result_type)
+                                   for i, a in enumerate(aggs)))
+            agg_node = AggregationNode(pre, tuple(subset), tuple(aggs),
+                                       branch_cols)
+            pos = {ch: k for k, ch in enumerate(subset)}
+            exprs: List[RowExpression] = []
+            for i, typ in enumerate(key_types):
+                if i in pos:
+                    exprs.append(B.ref(pos[i], typ))
+                else:
+                    exprs.append(B.null(typ))
+            for j, a in enumerate(aggs):
+                exprs.append(B.ref(len(subset) + j, a.spec.result_type))
+            branches.append(ProjectNode(agg_node, tuple(exprs), out_cols))
+        node: PlanNode = (branches[0] if len(branches) == 1
+                          else UnionNode(tuple(branches), out_cols))
+        out_fields = [Field(n, None, typ) for n, typ in out_cols]
+        grouping = GroupingContext(group_asts, agg_asts, out_fields)
+        return RelationPlan(node, Scope(out_fields, scope.parent)), grouping
 
     # --- window functions --------------------------------------------------
     _RANKING = {"row_number", "rank", "dense_rank", "percent_rank",

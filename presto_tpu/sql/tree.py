@@ -276,6 +276,10 @@ class Query(Node):
     limit: Optional[int] = None
     distinct: bool = False
     with_queries: Tuple[Tuple[str, "Query"], ...] = ()
+    # GROUPING SETS / ROLLUP / CUBE: when set, ``group_by`` holds the
+    # full (deduplicated) grouping column list and each entry here is the
+    # subset of indices into it that one grouping set keeps
+    grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
 
 
 @D(frozen=True)

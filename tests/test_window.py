@@ -263,3 +263,46 @@ class TestSetOperations:
         rows = fetch(runner, """
             select 1 x union select 2 intersect select 2""")
         assert sorted(r[0] for r in rows) == [1, 2]
+
+
+class TestGroupingSets:
+    """ROLLUP / CUBE / GROUPING SETS (GroupIdOperator role)."""
+
+    def test_rollup(self, runner):
+        rows = fetch(runner, """
+            select n_regionkey, n_nationkey, count(*) c from nation
+            where n_regionkey < 2
+            group by rollup (n_regionkey, n_nationkey) order by 1, 2""")
+        per_nation = [r for r in rows if r[1] is not None]
+        subtotals = [r for r in rows if r[1] is None and r[0] is not None]
+        grand = [r for r in rows if r[0] is None and r[1] is None]
+        assert len(per_nation) == 10 and all(r[2] == 1 for r in per_nation)
+        assert sorted(subtotals) == [(0, None, 5), (1, None, 5)]
+        assert grand == [(None, None, 10)]
+
+    def test_cube(self, runner):
+        rows = fetch(runner, """
+            select n_regionkey, count(*) from nation
+            group by cube (n_regionkey) order by 1""")
+        assert rows[-1] == (None, 25)
+        assert len(rows) == 6
+
+    def test_grouping_sets_explicit(self, runner):
+        rows = fetch(runner, """
+            select r_regionkey, r_name, count(*) from region
+            group by grouping sets ((r_regionkey), (r_name), ())""")
+        by_key = [r for r in rows if r[0] is not None]
+        by_name = [r for r in rows if r[1] is not None]
+        total = [r for r in rows if r[0] is None and r[1] is None]
+        assert len(by_key) == 5 and len(by_name) == 5
+        assert total == [(None, None, 5)]
+
+    def test_rollup_with_aggregates(self, runner):
+        rows = fetch(runner, """
+            select l_returnflag, sum(l_quantity) q, count(*) c
+            from lineitem group by rollup (l_returnflag) order by 1""")
+        detail = [r for r in rows if r[0] is not None]
+        grand = [r for r in rows if r[0] is None]
+        assert len(grand) == 1
+        assert abs(grand[0][1] - sum(r[1] for r in detail)) < 1e-6
+        assert grand[0][2] == sum(r[2] for r in detail)

@@ -1,0 +1,124 @@
+"""TPC-DS-derived benchmark queries over the tpcds connector.
+
+Adapted from the public TPC-DS query set the same way the reference ships
+them as benchto resources (presto-benchto-benchmarks/.../sql/presto/tpcds/):
+standard parameter substitutions, and date arithmetic written with
+date_diff where the engine lacks interval-on-date addition.  Q72/Q95 are
+the BASELINE.md pinned configs.
+"""
+
+QUERIES = {
+    # star join: brand revenue for a manufacturer, November
+    3: """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+from tpcds.date_dim, tpcds.store_sales, tpcds.item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manufact_id = 436 and d_moy = 12
+group by d_year, i_brand_id, i_brand
+order by d_year, sum_agg desc, brand_id
+limit 100
+""",
+    # demographics + promotion channels
+    7: """
+select i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_sales_price) agg4
+from tpcds.store_sales, tpcds.customer_demographics, tpcds.date_dim,
+     tpcds.item, tpcds.promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id order by i_item_id limit 100
+""",
+    # brand revenue by manager in a month window
+    19: """
+select i_brand_id brand_id, i_brand brand, i_manufact_id,
+       sum(ss_ext_sales_price) ext_price
+from tpcds.date_dim, tpcds.store_sales, tpcds.item, tpcds.customer,
+     tpcds.customer_address, tpcds.store
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 7 and d_moy = 11 and d_year = 1999
+  and ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk and ss_store_sk = s_store_sk
+group by i_brand_id, i_brand, i_manufact_id
+order by ext_price desc, brand_id limit 100
+""",
+    42: """
+select d_year, i_category_id, i_category, sum(ss_ext_sales_price) s
+from tpcds.date_dim, tpcds.store_sales, tpcds.item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+group by d_year, i_category_id, i_category
+order by s desc, d_year, i_category_id, i_category
+limit 100
+""",
+    52: """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from tpcds.date_dim, tpcds.store_sales, tpcds.item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+group by d_year, i_brand_id, i_brand
+order by d_year, ext_price desc, brand_id limit 100
+""",
+    55: """
+select i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from tpcds.date_dim, tpcds.store_sales, tpcds.item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 28 and d_moy = 11 and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, brand_id limit 100
+""",
+    # BASELINE config: skewed multi-join (inventory shortfall vs promo)
+    72: """
+select i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(case when p_promo_sk is null then 1 else 0 end) no_promo,
+       sum(case when p_promo_sk is not null then 1 else 0 end) promo,
+       count(*) total_cnt
+from tpcds.catalog_sales
+join tpcds.inventory on cs_item_sk = inv_item_sk
+join tpcds.warehouse on w_warehouse_sk = inv_warehouse_sk
+join tpcds.item on i_item_sk = cs_item_sk
+join tpcds.customer_demographics on cs_bill_cdemo_sk = cd_demo_sk
+join tpcds.household_demographics on cs_bill_hdemo_sk = hd_demo_sk
+join tpcds.date_dim d1 on cs_sold_date_sk = d1.d_date_sk
+join tpcds.date_dim d2 on inv_date_sk = d2.d_date_sk
+join tpcds.date_dim d3 on cs_ship_date_sk = d3.d_date_sk
+left join tpcds.promotion on cs_promo_sk = p_promo_sk
+left join tpcds.catalog_returns on cr_item_sk = cs_item_sk
+    and cr_order_number = cs_order_number
+where d1.d_week_seq = d2.d_week_seq
+  and inv_quantity_on_hand < cs_quantity
+  and date_diff('day', d1.d_date, d3.d_date) > 5
+  and hd_buy_potential = '>10000'
+  and d1.d_year = 1999
+  and cd_marital_status = 'D'
+group by i_item_desc, w_warehouse_name, d1.d_week_seq
+order by total_cnt desc, i_item_desc, w_warehouse_name, d1.d_week_seq
+limit 100
+""",
+    # BASELINE config: multi-warehouse returned web orders
+    95: """
+with ws_wh as (
+    select ws1.ws_order_number wow
+    from tpcds.web_sales ws1, tpcds.web_sales ws2
+    where ws1.ws_order_number = ws2.ws_order_number
+      and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+select count(distinct ws_order_number) order_count,
+       sum(ws_ext_ship_cost) total_shipping_cost,
+       sum(ws_net_profit) total_net_profit
+from tpcds.web_sales ws1, tpcds.date_dim, tpcds.customer_address,
+     tpcds.web_site
+where d_date between date '1999-02-01' and date '1999-04-02'
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_ship_addr_sk = ca_address_sk and ca_state = 'IL'
+  and ws1.ws_web_site_sk = web_site_sk and web_company_name = 'pri'
+  and ws1.ws_order_number in (select wow from ws_wh)
+  and ws1.ws_order_number in (select wr_order_number
+                              from tpcds.web_returns)
+""",
+}
