@@ -1,0 +1,101 @@
+"""Benchmark driver: suite-based macro benchmarks with warmup + stats.
+
+Role model: presto-benchmark-driver (CLI suite runner, suite/query regex
+selection) and presto-benchmark's AbstractBenchmark reporting
+(rows/s + bytes/s per iteration, presto-benchmark/.../AbstractBenchmark
+.java:76-100).  Suites here are named query dicts (the TPC-H and TPC-DS
+files under tests/); results report wall-clock percentiles and output
+rows/s per query.
+
+    python -m presto_tpu.benchmark_driver --suite tpch --query 'q(1|6)' \
+        --scale 0.01 --runs 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import re
+import statistics
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class BenchResult:
+    name: str
+    runs: List[float]
+    rows: int
+
+    @property
+    def median_s(self) -> float:
+        return statistics.median(self.runs)
+
+    @property
+    def rows_per_s(self) -> float:
+        return self.rows / self.median_s if self.median_s > 0 else 0.0
+
+    def line(self) -> str:
+        lo, hi = min(self.runs), max(self.runs)
+        return (f"{self.name:<10} median {self.median_s:7.3f}s "
+                f"[{lo:.3f}, {hi:.3f}] rows={self.rows} "
+                f"({self.rows_per_s:,.0f} rows/s)")
+
+
+def load_suite(suite: str) -> Dict[str, str]:
+    if suite == "tpch":
+        from tests.tpch_queries import QUERIES
+
+        return {f"q{k}": v for k, v in QUERIES.items()}
+    if suite == "tpcds":
+        from tests.tpcds_queries import QUERIES as DS
+
+        return {f"q{k}": v for k, v in DS.items()}
+    raise SystemExit(f"unknown suite {suite!r} (tpch | tpcds)")
+
+
+def run_suite(runner, queries: Dict[str, str], runs: int = 3,
+              warmup: int = 1) -> List[BenchResult]:
+    out = []
+    for name, sql in queries.items():
+        for _ in range(warmup):
+            rows = len(runner.execute(sql).rows)
+        walls = []
+        for _ in range(runs):
+            t0 = time.monotonic()
+            rows = len(runner.execute(sql).rows)
+            walls.append(time.monotonic() - t0)
+        out.append(BenchResult(name, walls, rows))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="presto-tpu-benchmark-driver")
+    p.add_argument("--suite", default="tpch")
+    p.add_argument("--query", default=".*", help="query name regex")
+    p.add_argument("--scale", type=float, default=0.01)
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    from presto_tpu.localrunner import LocalQueryRunner
+
+    runner = LocalQueryRunner.tpch(scale=args.scale)
+    pat = re.compile(args.query)
+    queries = {n: q for n, q in load_suite(args.suite).items()
+               if pat.fullmatch(n) or pat.search(n)}
+    results = run_suite(runner, queries, args.runs, args.warmup)
+    if args.json:
+        print(json.dumps([
+            {"name": r.name, "median_s": r.median_s, "rows": r.rows,
+             "rows_per_s": r.rows_per_s, "runs": r.runs}
+            for r in results]))
+    else:
+        for r in results:
+            print(r.line())
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,9 @@ class EngineConfig:
     spill_threshold_bytes: int = 1 << 30
     # Hash-partition fan-out for partitioned spill (peak memory ~ 1/K).
     spill_partitions: int = 8
+    # Build-side key domains prune probe rows before the join kernel
+    # (DynamicFilterSourceOperator role, SURVEY §2.6).
+    dynamic_filtering_enabled: bool = True
 
 
 DEFAULT = EngineConfig()

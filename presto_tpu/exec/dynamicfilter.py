@@ -1,0 +1,135 @@
+"""Dynamic filtering: build-side key domains prune the probe early.
+
+Reference model: DynamicFilterSourceOperator collects build-side join-key
+values into runtime filters that LocalDynamicFilter applies on the probe
+scan (presto-main/.../operator/DynamicFilterSourceOperator.java:46,
+sql/planner/LocalDynamicFilter.java:45, sql/DynamicFilters.java).
+
+Here the build side always completes before the probe pipeline starts
+(the single-process rendezvous), so the filter is synchronously ready:
+``HashBuildOperator`` fills a ``DynamicFilter`` with per-key min/max and —
+for small builds — the exact distinct key set, and a
+``DynamicFilterOperator`` inserted before the probe's LookupJoin drops
+non-matching rows with one vectorized mask+gather instead of letting them
+reach the join kernel.  (The reference pushes to the scan itself; applying
+at the probe-join input is the same work saved for every operator above
+this point — channel provenance to the scan is a later refinement.)
+
+Dictionary-coded keys are skipped: probe and build dictionaries intern
+independently, so code-domain comparisons would be meaningless.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import Operator, OperatorFactory
+
+# exact-set filtering only below this many distinct build keys
+MAX_DISTINCT_SET = 4096
+
+
+class DynamicFilter:
+    """Per-join runtime filter, one entry per equi-key channel."""
+
+    def __init__(self, n_keys: int):
+        self.ready = False
+        self.mins: List[Optional[np.ndarray]] = [None] * n_keys
+        self.maxs: List[Optional[np.ndarray]] = [None] * n_keys
+        self.sets: List[Optional[np.ndarray]] = [None] * n_keys
+        self.build_empty = False
+
+    def fill_from_build(self, data: Optional[Batch],
+                        key_channels: Sequence[int]) -> None:
+        if data is None or data.num_rows == 0:
+            self.build_empty = True
+            self.ready = True
+            return
+        for i, ch in enumerate(key_channels):
+            col = data.columns[ch]
+            if col.type.is_dictionary or col.type.name == "boolean":
+                continue  # incomparable domains / trivial
+            vals = np.asarray(col.values)[:data.num_rows]
+            if col.valid is not None:
+                vals = vals[np.asarray(col.valid)[:data.num_rows]]
+            if vals.size == 0:
+                self.build_empty = True
+                continue
+            self.mins[i] = vals.min()
+            self.maxs[i] = vals.max()
+            uniq = np.unique(vals)
+            if uniq.size <= MAX_DISTINCT_SET:
+                self.sets[i] = uniq
+        self.ready = True
+
+
+class DynamicFilterOperator(Operator):
+    def __init__(self, ctx: OperatorContext, dyn: DynamicFilter,
+                 key_channels: Sequence[int]):
+        super().__init__(ctx)
+        self.dyn = dyn
+        self.key_channels = list(key_channels)
+        self._pending: Optional[Batch] = None
+
+    def needs_input(self) -> bool:
+        return not self._finishing and self._pending is None
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_rows += batch.num_rows
+        if not self.dyn.ready:
+            self._pending = batch  # no filter info: pass through
+            return
+        if self.dyn.build_empty:
+            return  # inner join against empty build: nothing survives
+        import jax.numpy as jnp
+
+        mask = None
+        for i, ch in enumerate(self.key_channels):
+            if self.dyn.mins[i] is None:
+                continue
+            col = batch.columns[ch]
+            v = col.values
+            m = (v >= jnp.asarray(self.dyn.mins[i], v.dtype)) & \
+                (v <= jnp.asarray(self.dyn.maxs[i], v.dtype))
+            if self.dyn.sets[i] is not None:
+                table = jnp.asarray(self.dyn.sets[i].astype(
+                    np.asarray(v).dtype))
+                idx = jnp.clip(jnp.searchsorted(table, v), 0,
+                               table.shape[0] - 1)
+                m = m & (table[idx] == v)
+            if col.valid is not None:
+                m = m & col.valid
+            mask = m if mask is None else (mask & m)
+        if mask is None:
+            self._pending = batch
+            return
+        live = jnp.arange(batch.capacity) < batch.num_rows
+        keep = jnp.nonzero(mask & live)[0]
+        n_keep = int(keep.shape[0])
+        if n_keep == batch.num_rows:
+            self._pending = batch
+        elif n_keep > 0:
+            self._pending = batch.take(keep)
+        # else: fully pruned, emit nothing
+        self.ctx.stats.output_rows += n_keep
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+class DynamicFilterOperatorFactory(OperatorFactory):
+    def __init__(self, dyn: DynamicFilter, key_channels: Sequence[int]):
+        self.dyn = dyn
+        self.key_channels = list(key_channels)
+
+    def create(self, ctx: OperatorContext) -> DynamicFilterOperator:
+        return DynamicFilterOperator(ctx, self.dyn, self.key_channels)

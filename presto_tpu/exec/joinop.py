@@ -96,6 +96,10 @@ class HashBuildOperator(Operator):
         from presto_tpu.ops import join as J
 
         data = device_concat(self._batches, self.ctx.config.min_batch_capacity)
+        if self.f.dynamic_filter is not None:
+            self.f.dynamic_filter.fill_from_build(
+                None if data is None else data.to_numpy(),
+                self.f.key_channels)
         if data is None:
             # empty build side: synthesize a 0-row padded batch
             from presto_tpu.batch import empty_batch
@@ -159,10 +163,11 @@ class HashBuildOperator(Operator):
 
 class HashBuildOperatorFactory(OperatorFactory):
     def __init__(self, key_channels: Sequence[int],
-                 input_types: Sequence[T.Type]):
+                 input_types: Sequence[T.Type], dynamic_filter=None):
         self.key_channels = list(key_channels)
         self.input_types = list(input_types)
         self.lookup = LookupSourceFactory()
+        self.dynamic_filter = dynamic_filter
 
     def create(self, ctx: OperatorContext) -> HashBuildOperator:
         return HashBuildOperator(ctx, self)

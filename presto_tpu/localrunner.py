@@ -45,9 +45,14 @@ class LocalQueryRunner:
         self.registry = registry
         self.metadata = Metadata(registry, default_catalog)
         self.config = config
+        from presto_tpu.events import EventBus
+
         self.session = session or Session(catalog=default_catalog)
         self.access_control = access_control or AllowAllAccessControl()
         self.transaction_manager = TransactionManager()
+        self.event_bus = EventBus()
+        self._last_task = None
+        self._query_seq = 0
 
     @classmethod
     def tpch(cls, scale: float = 0.01,
@@ -80,6 +85,31 @@ class LocalQueryRunner:
 
     # --- statements --------------------------------------------------------
     def execute(self, sql: str) -> QueryResult:
+        from presto_tpu import events as ev
+
+        self._query_seq += 1
+        qid = f"local-{self._query_seq}"
+        created = ev.now()
+        self.event_bus.query_created(ev.QueryCreatedEvent(
+            qid, self.session.user, sql, created))
+        self._last_task = None
+        try:
+            result = self._execute_statement(sql)
+        except Exception as e:
+            self.event_bus.query_completed(ev.QueryCompletedEvent(
+                qid, self.session.user, sql, "FAILED", str(e), created,
+                ev.now(), 0, 0, []))
+            raise
+        task = self._last_task
+        self.event_bus.query_completed(ev.QueryCompletedEvent(
+            qid, self.session.user, sql, "FINISHED", None, created,
+            ev.now(), len(result.rows),
+            task.memory.peak if task is not None else 0,
+            [s.as_dict() for s in task.operator_stats]
+            if task is not None else []))
+        return result
+
+    def _execute_statement(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
         if isinstance(stmt, t.Explain):
             text = (self.explain_analyze_text(stmt.statement)
@@ -272,6 +302,6 @@ class LocalQueryRunner:
         optimized = optimize(logical, self.metadata)
         self._check_scans(optimized)
         phys = PhysicalPlanner(self.registry, cfg).plan(optimized)
-        execute_pipelines(phys.pipelines, cfg)
+        self._last_task = execute_pipelines(phys.pipelines, cfg)
         return QueryResult(phys.column_names, phys.column_types,
                            phys.collector.rows())

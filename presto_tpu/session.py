@@ -44,6 +44,9 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "task_concurrency": ("task_concurrency", int),
     "join_expansion_factor": ("join_expansion_factor", int),
     "direct_groupby_max_domain": ("direct_groupby_max_domain", int),
+    "dynamic_filtering_enabled": ("dynamic_filtering_enabled",
+                                  lambda v: v.lower() in ("true", "1",
+                                                          "on")),
 }
 
 

@@ -332,14 +332,27 @@ class PhysicalPlanner:
             chain.append(NestedLoopJoinOperatorFactory(build))
             return chain, splits
         if node.kind in ("inner", "left"):
+            dyn = None
+            if node.kind == "inner" and self.config.dynamic_filtering_enabled:
+                from presto_tpu.exec.dynamicfilter import DynamicFilter
+
+                dyn = DynamicFilter(len(node.right_keys))
             build_chain, build_splits = self._lower(node.right)
             build = HashBuildOperatorFactory(
-                list(node.right_keys), [t for _, t in node.right.columns])
+                list(node.right_keys), [t for _, t in node.right.columns],
+                dynamic_filter=dyn)
             build_chain.append(build)
             self._done_pipelines.append(
                 Pipeline(build_chain, build_splits,
                          name=self._name("build")))
             chain, splits = self._lower(node.left)
+            if dyn is not None:
+                from presto_tpu.exec.dynamicfilter import (
+                    DynamicFilterOperatorFactory,
+                )
+
+                chain.append(DynamicFilterOperatorFactory(
+                    dyn, list(node.left_keys)))
             chain.append(LookupJoinOperatorFactory(
                 build, list(node.left_keys),
                 [t for _, t in node.left.columns],

@@ -1,0 +1,109 @@
+"""Verifier: replay queries against two engines, diff checksummed results.
+
+Role model: presto-verifier (4,303 LoC — replays production query pairs
+against a test and a control cluster and compares checksummed results,
+presto-verifier/.../PrestoVerifier.java, QueryRewriter.java).  Here the
+two sides are any objects with ``execute(sql) -> QueryResult`` — e.g. a
+LocalQueryRunner control vs a DistributedQueryRunner test, or two
+configs/sessions of the same runner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+import time
+from typing import Any, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class VerificationResult:
+    query: str
+    status: str                    # MATCH | MISMATCH | TEST_FAILED | ...
+    detail: str = ""
+    control_wall_s: float = 0.0
+    test_wall_s: float = 0.0
+    control_checksum: str = ""
+    test_checksum: str = ""
+
+
+def _canonical_rows(rows: Sequence[tuple], float_digits: int = 6
+                    ) -> List[tuple]:
+    out = []
+    for row in rows:
+        canon = []
+        for v in row:
+            if isinstance(v, float):
+                if math.isnan(v):
+                    canon.append("NaN")
+                else:
+                    canon.append(round(v, float_digits))
+            else:
+                canon.append(v)
+        out.append(tuple(canon))
+    out.sort(key=repr)
+    return out
+
+
+def _checksum(rows: Sequence[tuple]) -> str:
+    h = hashlib.sha256()
+    for row in _canonical_rows(rows):
+        h.update(repr(row).encode())
+    return h.hexdigest()[:16]
+
+
+class Verifier:
+    def __init__(self, control: Any, test: Any, float_digits: int = 6):
+        self.control = control
+        self.test = test
+        self.float_digits = float_digits
+
+    def verify_query(self, sql: str) -> VerificationResult:
+        t0 = time.monotonic()
+        try:
+            control = self.control.execute(sql)
+        except Exception as e:  # noqa: BLE001
+            return VerificationResult(sql, "CONTROL_FAILED", str(e))
+        t1 = time.monotonic()
+        try:
+            test = self.test.execute(sql)
+        except Exception as e:  # noqa: BLE001
+            return VerificationResult(sql, "TEST_FAILED", str(e),
+                                      control_wall_s=t1 - t0)
+        t2 = time.monotonic()
+        c_rows = _canonical_rows(control.rows, self.float_digits)
+        t_rows = _canonical_rows(test.rows, self.float_digits)
+        cc, tc = _checksum(control.rows), _checksum(test.rows)
+        if c_rows == t_rows:
+            status, detail = "MATCH", ""
+        elif len(c_rows) != len(t_rows):
+            status = "MISMATCH"
+            detail = f"row counts differ: {len(c_rows)} vs {len(t_rows)}"
+        else:
+            diff = next(i for i, (a, b) in enumerate(zip(c_rows, t_rows))
+                        if a != b)
+            status = "MISMATCH"
+            detail = (f"first differing row {diff}: "
+                      f"{c_rows[diff]} vs {t_rows[diff]}")
+        return VerificationResult(sql, status, detail,
+                                  control_wall_s=t1 - t0,
+                                  test_wall_s=t2 - t1,
+                                  control_checksum=cc, test_checksum=tc)
+
+    def verify(self, queries: Sequence[str]) -> List[VerificationResult]:
+        return [self.verify_query(q) for q in queries]
+
+    @staticmethod
+    def summarize(results: Sequence[VerificationResult]) -> str:
+        by_status: dict = {}
+        for r in results:
+            by_status.setdefault(r.status, []).append(r)
+        lines = [f"{len(results)} queries: "
+                 + ", ".join(f"{k}={len(v)}"
+                             for k, v in sorted(by_status.items()))]
+        for r in results:
+            if r.status != "MATCH":
+                head = " ".join(r.query.split())[:80]
+                lines.append(f"  {r.status}: {head}\n    {r.detail}")
+        return "\n".join(lines)

@@ -1,0 +1,52 @@
+"""EventListener SPI / QueryMonitor (SURVEY §5.5)."""
+
+from presto_tpu.events import EventListener
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+class Recorder(EventListener):
+    def __init__(self):
+        self.created = []
+        self.completed = []
+
+    def query_created(self, e):
+        self.created.append(e)
+
+    def query_completed(self, e):
+        self.completed.append(e)
+
+
+def test_events_fire_on_success():
+    r = LocalQueryRunner.tpch(scale=0.001)
+    rec = Recorder()
+    r.event_bus.register(rec)
+    r.execute("select count(*) from nation")
+    assert len(rec.created) == 1 and len(rec.completed) == 1
+    done = rec.completed[0]
+    assert done.state == "FINISHED"
+    assert done.output_rows == 1
+    assert done.wall_s >= 0
+    assert any(s["operator"].endswith("OutputCollector")
+               for s in done.operator_stats)
+
+
+def test_events_fire_on_failure():
+    r = LocalQueryRunner.tpch(scale=0.001)
+    rec = Recorder()
+    r.event_bus.register(rec)
+    try:
+        r.execute("select no_col from nation")
+    except Exception:
+        pass
+    assert rec.completed[0].state == "FAILED"
+    assert rec.completed[0].error
+
+
+def test_broken_listener_never_fails_query():
+    class Broken(EventListener):
+        def query_created(self, e):
+            raise RuntimeError("observer bug")
+
+    r = LocalQueryRunner.tpch(scale=0.001)
+    r.event_bus.register(Broken())
+    assert r.execute("select 1").rows == [(1,)]

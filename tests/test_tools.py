@@ -1,0 +1,78 @@
+"""Verifier + benchmark-driver tools (presto-verifier /
+presto-benchmark-driver roles)."""
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+from presto_tpu.verifier import Verifier
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.001)
+
+
+class TestVerifier:
+    def test_match(self, runner):
+        other = LocalQueryRunner.tpch(scale=0.001)
+        v = Verifier(control=runner, test=other)
+        results = v.verify([
+            "select count(*) from nation",
+            "select r_name, count(*) from region, nation "
+            "where r_regionkey = n_regionkey group by r_name",
+        ])
+        assert all(r.status == "MATCH" for r in results)
+        assert "MATCH=2" in Verifier.summarize(results)
+
+    def test_mismatch_detected(self, runner):
+        class Wrong:
+            def execute(self, sql):
+                res = runner.execute(sql)
+                import dataclasses as d
+
+                return d.replace(res, rows=res.rows[:-1])
+
+        v = Verifier(control=runner, test=Wrong())
+        (r,) = v.verify(["select n_name from nation"])
+        assert r.status == "MISMATCH"
+        assert "row counts differ" in r.detail
+
+    def test_failure_classified(self, runner):
+        class Broken:
+            def execute(self, sql):
+                raise RuntimeError("boom")
+
+        (r,) = Verifier(runner, Broken()).verify(["select 1"])
+        assert r.status == "TEST_FAILED"
+
+    def test_float_tolerance(self, runner):
+        class Jittered:
+            def execute(self, sql):
+                res = runner.execute(sql)
+                import dataclasses as d
+
+                rows = [tuple(v + 1e-11 if isinstance(v, float) else v
+                              for v in row) for row in res.rows]
+                return d.replace(res, rows=rows)
+
+        v = Verifier(runner, Jittered())
+        (r,) = v.verify(["select sum(l_quantity) from lineitem"])
+        assert r.status == "MATCH"
+
+
+class TestBenchmarkDriver:
+    def test_run_suite(self, runner):
+        from presto_tpu.benchmark_driver import load_suite, run_suite
+
+        queries = {k: v for k, v in load_suite("tpch").items()
+                   if k in ("q1", "q6")}
+        results = run_suite(runner, queries, runs=1, warmup=0)
+        assert [r.name for r in results] == ["q1", "q6"]
+        assert all(r.median_s > 0 for r in results)
+        assert results[0].rows == 4  # Q1 groups
+
+    def test_suite_loading(self):
+        from presto_tpu.benchmark_driver import load_suite
+
+        assert len(load_suite("tpch")) == 22
+        assert "q72" in load_suite("tpcds")
