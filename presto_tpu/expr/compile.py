@@ -217,14 +217,21 @@ class ExprCompiler:
             ok_np = np.ones(len(per_entry), dtype=bool)
             ok_np[null_codes] = False
         if rt.is_dictionary:
-            out_dict = Dictionary([v if v is not None else ""
-                                   for v in per_entry])
+            # intern (dedupe) results: equal strings MUST share a code —
+            # group-by/join/compare on dictionary columns operate on codes
+            # (e.g. substr over a per-row-distinct phone column yields few
+            # distinct country codes from many entries)
+            out_dict = Dictionary()
+            remap_np = np.empty(max(len(per_entry), 1), np.int32)
+            for i, v in enumerate(per_entry):
+                remap_np[i] = out_dict.intern(v if v is not None else "")
 
             def run(cols, n, xp):
                 codes, valid = src.run(cols, n, xp)
                 if ok_np is not None:
                     ok = xp.take(xp.asarray(ok_np), codes, axis=0)
                     valid = ok if valid is None else (valid & ok)
+                codes = xp.take(xp.asarray(remap_np), codes, axis=0)
                 return codes, valid
 
             return CompiledExpr(rt, run, dictionary=out_dict)

@@ -332,6 +332,26 @@ class CoordinatorServer:
                     self._json(200, {"coordinator": True,
                                      "nodes": co.nodes.alive_nodes()})
                     return
+                # QueryResource observability (SURVEY §5.5):
+                if parts == ["v1", "query"]:
+                    self._json(200, [
+                        {"queryId": q.query_id, "state": q.state,
+                         "user": q.user,
+                         "query": q.sql[:200]}
+                        for q in co.queries.values()])
+                    return
+                if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                    q = co.queries.get(parts[2])
+                    if q is None:
+                        self._json(404, {"error": "no such query"})
+                        return
+                    self._json(200, {
+                        "queryId": q.query_id, "state": q.state,
+                        "user": q.user, "query": q.sql,
+                        "error": q.error,
+                        "columns": q.column_names,
+                        "outputRows": len(q.result_rows)})
+                    return
                 self._json(404, {"error": f"bad path {self.path}"})
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)

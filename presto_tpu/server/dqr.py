@@ -30,9 +30,45 @@ class DistributedQueryRunner:
         # its own connector instances from catalog config
         self.coordinator = CoordinatorServer(
             registry_factory(), default_catalog, config, verbose=verbose)
+
+        def cluster_registry() -> ConnectorRegistry:
+            # system.runtime.* backed by live coordinator state, fetched
+            # over HTTP at scan time (the GlobalSystemConnector role)
+            reg = registry_factory()
+            from presto_tpu.connectors.system import SystemConnector
+
+            co_uri = self.coordinator.uri
+
+            def fetch(path):
+                import json
+                import urllib.request
+
+                with urllib.request.urlopen(f"{co_uri}{path}",
+                                            timeout=10) as resp:
+                    return json.loads(resp.read())
+
+            def nodes_fn():
+                info = fetch("/v1/info")
+                return [(nid, uri, "dev", False, "ACTIVE")
+                        for nid, uri in info.get("nodes", [])]
+
+            def queries_fn():
+                return [(q["queryId"], q["state"], q["query"])
+                        for q in fetch("/v1/query")]
+
+            reg.register("system", SystemConnector(
+                nodes_fn=nodes_fn, queries_fn=queries_fn))
+            return reg
+
+        # the coordinator needs the system schemas for planning (data is
+        # served by worker-side scans)
+        from presto_tpu.connectors.system import SystemConnector
+
+        self.coordinator.registry.register("system", SystemConnector())
+
         self.workers: List[WorkerServer] = []
         for i in range(n_workers):
-            w = WorkerServer(registry_factory(), config,
+            w = WorkerServer(cluster_registry(), config,
                              node_id=f"worker-{i}")
             self.workers.append(w)
             self._announce(w)

@@ -134,3 +134,35 @@ def test_failure_detector_excludes_dead_worker():
         assert res.rows == [(25,)]
     finally:
         dqr.close()
+
+
+def test_query_resource_observability(cluster):
+    """GET /v1/query lists queries with state (QueryResource role)."""
+    import json
+    import urllib.request
+
+    cluster.execute("select 42")
+    with urllib.request.urlopen(
+            f"{cluster.coordinator.uri}/v1/query", timeout=10) as resp:
+        queries = json.loads(resp.read())
+    assert queries and all("state" in q for q in queries)
+    done = [q for q in queries if q["state"] == "FINISHED"]
+    assert done
+    qid = done[0]["queryId"]
+    with urllib.request.urlopen(
+            f"{cluster.coordinator.uri}/v1/query/{qid}",
+            timeout=10) as resp:
+        detail = json.loads(resp.read())
+    assert detail["queryId"] == qid
+    assert "outputRows" in detail
+
+
+def test_system_runtime_tables_live(cluster):
+    """system.runtime over live cluster state (GlobalSystemConnector)."""
+    rows = cluster.execute(
+        "select node_id, state from system.nodes order by 1").rows
+    assert len(rows) == 3
+    assert all(state == "ACTIVE" for _, state in rows)
+    rows = cluster.execute(
+        "select count(*) from system.queries").rows
+    assert rows[0][0] >= 1  # at least this query's predecessors
