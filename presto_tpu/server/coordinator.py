@@ -264,6 +264,41 @@ def _json_value(v):
     return str(v)
 
 
+# Minimal cluster/query status page (the reference ships a static SPA at
+# presto-main/src/main/resources/webapp — query list/details views; this
+# is the same role at observability-dashboard fidelity).
+_UI_HTML = """<!doctype html>
+<html><head><title>tpu-sql</title><style>
+body { font-family: monospace; margin: 2em; background: #111; color: #eee }
+h1 { color: #7fd4ff } table { border-collapse: collapse; margin: 1em 0 }
+td, th { border: 1px solid #444; padding: 4px 10px; text-align: left }
+th { background: #222 } .FINISHED { color: #7fff7f }
+.FAILED { color: #ff7f7f } .RUNNING, .PLANNING { color: #ffff7f }
+</style></head><body>
+<h1>tpu-sql cluster</h1>
+<h2>Nodes</h2><table id="nodes"><tr><th>node</th><th>uri</th></tr></table>
+<h2>Queries</h2><table id="queries">
+<tr><th>id</th><th>user</th><th>state</th><th>query</th></tr></table>
+<script>
+async function refresh() {
+  const info = await (await fetch('/v1/info')).json();
+  const nodes = document.getElementById('nodes');
+  nodes.innerHTML = '<tr><th>node</th><th>uri</th></tr>' +
+    info.nodes.map(n => `<tr><td>${n[0]}</td><td>${n[1]}</td></tr>`)
+        .join('');
+  const qs = await (await fetch('/v1/query')).json();
+  const table = document.getElementById('queries');
+  table.innerHTML =
+    '<tr><th>id</th><th>user</th><th>state</th><th>query</th></tr>' +
+    qs.map(q => `<tr><td>${q.queryId}</td><td>${q.user}</td>` +
+      `<td class="${q.state}">${q.state}</td><td>${q.query}</td></tr>`)
+      .join('');
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
 class CoordinatorServer:
     def __init__(self, registry: ConnectorRegistry, default_catalog: str,
                  config: EngineConfig = DEFAULT, port: int = 0,
@@ -331,6 +366,15 @@ class CoordinatorServer:
                 if parts == ["v1", "info"]:
                     self._json(200, {"coordinator": True,
                                      "nodes": co.nodes.alive_nodes()})
+                    return
+                if parts == ["ui"] or parts == [""]:
+                    body = _UI_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 # QueryResource observability (SURVEY §5.5):
                 if parts == ["v1", "query"]:

@@ -166,3 +166,14 @@ def test_system_runtime_tables_live(cluster):
     rows = cluster.execute(
         "select count(*) from system.queries").rows
     assert rows[0][0] >= 1  # at least this query's predecessors
+
+
+def test_web_ui_served(cluster):
+    """Coordinator serves the status page (webapp role)."""
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"{cluster.coordinator.uri}/ui", timeout=10) as resp:
+        body = resp.read().decode()
+    assert resp.status == 200
+    assert "tpu-sql cluster" in body and "/v1/query" in body
