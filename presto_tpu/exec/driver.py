@@ -57,10 +57,20 @@ class Driver:
         return ops[-1].is_finished()
 
     def run_to_completion(self, max_iterations: int = 10_000_000) -> None:
-        for _ in range(max_iterations):
-            if self.process():
-                return
-        raise RuntimeError("driver did not converge (operator protocol bug)")
+        # Mirror Driver.close(): operators always release their resources
+        # (memory reservations, exchange fetcher threads), success or not.
+        try:
+            for _ in range(max_iterations):
+                if self.process():
+                    return
+            raise RuntimeError(
+                "driver did not converge (operator protocol bug)")
+        finally:
+            for op in self.operators:
+                try:
+                    op.close()
+                except Exception:  # noqa: BLE001 - close is best-effort
+                    pass
 
 
 class Pipeline:

@@ -129,28 +129,57 @@ class OrderByOperator(Operator):
             for batch in spiller.read_all():
                 yield batch.to_numpy()
 
+        class _Rev:
+            """Reverse-comparing wrapper for descending string keys."""
+
+            __slots__ = ("v",)
+
+            def __init__(self, v):
+                self.v = v
+
+            def __lt__(self, other):
+                return other.v < self.v
+
+            def __eq__(self, other):
+                return self.v == other.v
+
         def batch_words(batch: Batch) -> List[np.ndarray]:
             words = []
             for s in self.specs:
                 c = batch.columns[s.channel]
                 if c.type.is_dictionary:
-                    ranks = c.dictionary.sort_ranks()
-                    vals = np.asarray(ranks)[np.asarray(c.values)]
-                    w = to_sortable_i64(np, vals, T.INTEGER)
+                    # Compare actual string values, not per-batch ranks:
+                    # each spilled run re-codes into its own dictionary
+                    # (concat_batches / per-shard scans), so equal codes or
+                    # ranks from different runs denote different strings.
+                    # The reference's MergeSortedPages likewise compares
+                    # real values.
+                    dic = np.asarray(c.dictionary.values, dtype=object)
+                    w = dic[np.asarray(c.values)]
+                    if s.descending:
+                        w = np.array([_Rev(v) for v in w], dtype=object)
                 else:
                     w = to_sortable_i64(np, np.asarray(c.values), c.type)
-                if s.descending:
-                    w = ~w
+                    if s.descending:
+                        w = ~w
+                # Always emit the null word so key tuples stay structurally
+                # comparable across runs (one run may have nulls in this
+                # column while another does not).
                 if c.valid is not None:
+                    valid = np.asarray(c.valid)
                     null_word = np.where(
-                        np.asarray(c.valid),
+                        valid,
                         np.int8(1 if s.nulls_first else 0),
                         np.int8(0 if s.nulls_first else 1))
-                    w = np.where(np.asarray(c.valid), w, np.int64(0))
-                    words.append(null_word)
-                    words.append(w)
+                    if w.dtype == object:
+                        w = np.where(valid, w, "")
+                    else:
+                        w = np.where(valid, w, np.int64(0))
                 else:
-                    words.append(w)
+                    null_word = np.full(batch.num_rows,
+                                        1 if s.nulls_first else 0, np.int8)
+                words.append(null_word)
+                words.append(w)
             return words
 
         iters = [run_iter(s) for s in self._runs]

@@ -137,23 +137,33 @@ def _py_lz4_decompress(data: bytes, decompressed_size: int) -> bytes:
     """Pure-Python LZ4 block decoder (fallback for compiler-less hosts)."""
     out = bytearray()
     i, n = 0, len(data)
+
+    def byte_at(idx: int) -> int:
+        # bounds-check every read so truncated frames raise the serde
+        # contract's RuntimeError, not IndexError
+        if idx >= n:
+            raise RuntimeError("malformed lz4 block: truncated")
+        return data[idx]
+
     while i < n:
         token = data[i]
         i += 1
         lit_len = token >> 4
         if lit_len == 15:
             while True:
-                b = data[i]
+                b = byte_at(i)
                 i += 1
                 lit_len += b
                 if b != 255:
                     break
-        out += data[i:i + lit_len]
         if i + lit_len > n:
             raise RuntimeError("malformed lz4 block: literal overrun")
+        out += data[i:i + lit_len]
         i += lit_len
         if i >= n:
             break
+        if i + 2 > n:
+            raise RuntimeError("malformed lz4 block: truncated match")
         offset = data[i] | (data[i + 1] << 8)
         i += 2
         if offset == 0 or offset > len(out):
@@ -161,7 +171,7 @@ def _py_lz4_decompress(data: bytes, decompressed_size: int) -> bytes:
         match_len = token & 0x0F
         if match_len == 15:
             while True:
-                b = data[i]
+                b = byte_at(i)
                 i += 1
                 match_len += b
                 if b != 255:

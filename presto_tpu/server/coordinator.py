@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import datetime
 import json
-import pickle
 import threading
 import time
 import traceback
@@ -200,18 +199,24 @@ class QueryExecution:
     def _create_remote_task(self, worker_uri: str, task_id: str, frag,
                             scan_shard, remote, n_out, broadcast,
                             consumer_index: int) -> None:
+        from presto_tpu.sql.planserde import fragment_to_json
+
         resolved = {fid: [u.format(part=consumer_index) for u in us]
                     for fid, us in remote.items()}
-        body = pickle.dumps({
-            "fragment": frag,
-            "scan_shard": scan_shard,
-            "remote_sources": resolved,
+        # JSON task update (the reference's TaskUpdateRequest is JSON,
+        # presto-main/.../server/TaskUpdateRequest.java) — never a pickled
+        # object: the worker must not execute untrusted request bodies.
+        body = json.dumps({
+            "fragment": fragment_to_json(frag),
+            "scan_shard": list(scan_shard),
+            "remote_sources": {str(fid): us
+                               for fid, us in resolved.items()},
             "n_output_partitions": n_out,
             "broadcast_output": broadcast,
-        })
+        }).encode("utf-8")
         req = urllib.request.Request(
             f"{worker_uri}/v1/task/{task_id}", data=body, method="POST",
-            headers={"Content-Type": "application/x-pickle"})
+            headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=30) as resp:
             info = json.loads(resp.read())
             if info.get("state") == "FAILED":
@@ -280,19 +285,38 @@ th { background: #222 } .FINISHED { color: #7fff7f }
 <h2>Queries</h2><table id="queries">
 <tr><th>id</th><th>user</th><th>state</th><th>query</th></tr></table>
 <script>
+// Cells are populated via textContent, never innerHTML: query SQL, the
+// X-Presto-User header, and announced node ids/URIs are all untrusted.
+const STATES = ['FINISHED', 'FAILED', 'RUNNING', 'PLANNING'];
+function header(table, names) {
+  table.textContent = '';
+  const tr = document.createElement('tr');
+  for (const n of names) {
+    const th = document.createElement('th');
+    th.textContent = n;
+    tr.appendChild(th);
+  }
+  table.appendChild(tr);
+}
+function row(table, cells, stateCol) {
+  const tr = document.createElement('tr');
+  cells.forEach((c, i) => {
+    const td = document.createElement('td');
+    td.textContent = c === null || c === undefined ? '' : String(c);
+    if (i === stateCol && STATES.includes(c)) td.className = c;
+    tr.appendChild(td);
+  });
+  table.appendChild(tr);
+}
 async function refresh() {
   const info = await (await fetch('/v1/info')).json();
   const nodes = document.getElementById('nodes');
-  nodes.innerHTML = '<tr><th>node</th><th>uri</th></tr>' +
-    info.nodes.map(n => `<tr><td>${n[0]}</td><td>${n[1]}</td></tr>`)
-        .join('');
+  header(nodes, ['node', 'uri']);
+  for (const n of info.nodes) row(nodes, [n[0], n[1]]);
   const qs = await (await fetch('/v1/query')).json();
   const table = document.getElementById('queries');
-  table.innerHTML =
-    '<tr><th>id</th><th>user</th><th>state</th><th>query</th></tr>' +
-    qs.map(q => `<tr><td>${q.queryId}</td><td>${q.user}</td>` +
-      `<td class="${q.state}">${q.state}</td><td>${q.query}</td></tr>`)
-      .join('');
+  header(table, ['id', 'user', 'state', 'query']);
+  for (const q of qs) row(table, [q.queryId, q.user, q.state, q.query], 2);
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>

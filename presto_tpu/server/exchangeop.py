@@ -151,11 +151,22 @@ class HttpPageClient(threading.Thread):
 
 
 class ExchangeClient:
-    """Merges pages from N producer buffers (ExchangeClient.java:55)."""
+    """Merges pages from N producer buffers (ExchangeClient.java:55).
 
-    def __init__(self, locations: Sequence[str]):
+    Buffering is bounded (the reference's maxBufferedBytes): when the
+    consumer falls behind, ``on_page`` blocks the fetching thread, which
+    delays its next token-advancing GET — so backpressure propagates to
+    the producer's output buffer instead of growing this list unboundedly.
+    """
+
+    def __init__(self, locations: Sequence[str],
+                 max_buffered_bytes: int = 64 << 20):
         self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
         self._pages: List[bytes] = []
+        self._buffered_bytes = 0
+        self._max_buffered_bytes = max(1, max_buffered_bytes)
+        self._closed = False
         self._error: Optional[Exception] = None
         self._clients = [HttpPageClient(loc, self) for loc in locations]
         self._remaining = len(self._clients)
@@ -164,16 +175,31 @@ class ExchangeClient:
 
     def on_page(self, page: bytes) -> None:
         with self._lock:
+            while (self._buffered_bytes >= self._max_buffered_bytes
+                   and not self._closed and self._error is None):
+                self._drained.wait(timeout=1.0)
+            if self._closed or self._error is not None:
+                return
             self._pages.append(page)
+            self._buffered_bytes += len(page)
 
     def on_error(self, e: Exception) -> None:
         with self._lock:
             self._error = e
             self._remaining = 0
+            self._drained.notify_all()
 
     def on_client_finished(self) -> None:
         with self._lock:
             self._remaining -= 1
+
+    def close(self) -> None:
+        """Stop accepting pages and unblock fetcher threads."""
+        with self._lock:
+            self._closed = True
+            self._pages = []
+            self._buffered_bytes = 0
+            self._drained.notify_all()
 
     def poll_page(self) -> Optional[bytes]:
         with self._lock:
@@ -181,7 +207,10 @@ class ExchangeClient:
                 raise RuntimeError(
                     f"exchange failed: {self._error}") from self._error
             if self._pages:
-                return self._pages.pop(0)
+                page = self._pages.pop(0)
+                self._buffered_bytes -= len(page)
+                self._drained.notify_all()
+                return page
             return None
 
     @property
@@ -219,6 +248,11 @@ class ExchangeOperator(Operator):
 
     def is_finished(self) -> bool:
         return self.client.finished
+
+    def close(self) -> None:
+        # unblock any fetcher thread parked on the buffer cap
+        self.client.close()
+        super().close()
 
 
 class ExchangeOperatorFactory(OperatorFactory):

@@ -9,7 +9,7 @@ Routes mirror the reference's worker REST API
     GET    /v1/task/{taskId}/results/{buffer}/{token}   page fetch + ack
     GET    /v1/info                               node info (heartbeat ping)
 
-Control bodies are pickled fragment descriptors (one trusted cluster, the
+Control bodies are JSON fragment descriptors (TaskUpdateRequest-style; the
 in-process DistributedQueryRunner pattern); data responses are raw
 concatenated wire frames (presto_tpu.serde) with token bookkeeping in
 headers — the PRESTO_PAGES content-type role.
@@ -18,7 +18,6 @@ headers — the PRESTO_PAGES content-type role.
 from __future__ import annotations
 
 import json
-import pickle
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -95,15 +94,30 @@ class WorkerServer:
             def do_POST(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
+                    from presto_tpu.sql.planserde import (
+                        PlanSerdeError, fragment_from_json,
+                    )
+
                     n = int(self.headers.get("Content-Length", 0))
-                    req = pickle.loads(self.rfile.read(n))
+                    try:
+                        req = json.loads(self.rfile.read(n))
+                        fragment = fragment_from_json(req["fragment"])
+                        scan_shard = tuple(req["scan_shard"])
+                        remote_sources = {int(fid): us for fid, us in
+                                          req["remote_sources"].items()}
+                        n_out = int(req["n_output_partitions"])
+                        broadcast = bool(req["broadcast_output"])
+                    except (PlanSerdeError, KeyError, TypeError,
+                            AttributeError, ValueError) as e:
+                        self._json(400, {"error": f"bad task update: {e}"})
+                        return
                     task = worker.task_manager.create_task(
                         task_id=parts[2],
-                        fragment=req["fragment"],
-                        scan_shard=tuple(req["scan_shard"]),
-                        remote_sources=req["remote_sources"],
-                        n_output_partitions=req["n_output_partitions"],
-                        broadcast_output=req["broadcast_output"])
+                        fragment=fragment,
+                        scan_shard=scan_shard,
+                        remote_sources=remote_sources,
+                        n_output_partitions=n_out,
+                        broadcast_output=broadcast)
                     self._json(200, task.info())
                     return
                 self._json(404, {"error": f"bad path {self.path}"})

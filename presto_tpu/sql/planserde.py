@@ -1,0 +1,312 @@
+"""JSON serde for plan fragments (the task-create wire format).
+
+The reference ships plan fragments to workers as JSON inside
+TaskUpdateRequest (presto-main/.../server/TaskUpdateRequest.java, posted by
+HttpRemoteTask.java:100 and decoded by TaskResource.java:121) — never as
+serialized Java objects.  This module is the same contract for our plan IR:
+a self-describing JSON tree, decoded by re-resolving function bindings
+against the registry (expr/functions.py), so nothing executable ever rides
+the wire and task create is safe against untrusted bodies.
+
+Types are encoded by their canonical display form and decoded with
+``types.parse_type``; Constants are already in storage domain (ints,
+floats, strings, bools, None), which is exactly JSON's value space.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from presto_tpu import types as T
+from presto_tpu.expr import functions as F
+from presto_tpu.expr.functions import AggSpec
+from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression, SpecialForm
+from presto_tpu.server.fragmenter import PlanFragment
+from presto_tpu.sql.plan import (
+    AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
+    OutputNode, PlanAggregate, PlanNode, PlanWindowFunction, ProjectNode,
+    RemoteSourceNode, SemiJoinNode, SortNode, TableScanNode, UnionNode,
+    ValuesNode, WindowNode,
+)
+
+
+class PlanSerdeError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Types and columns
+# --------------------------------------------------------------------------
+
+def _ty(t: T.Type) -> str:
+    return t.display()
+
+
+def _unty(s: str) -> T.Type:
+    return T.parse_type(s)
+
+
+def _cols(cols) -> List[List[str]]:
+    return [[n, _ty(t)] for n, t in cols]
+
+
+def _uncols(cols):
+    return tuple((n, _unty(t)) for n, t in cols)
+
+
+# --------------------------------------------------------------------------
+# Expressions
+# --------------------------------------------------------------------------
+
+_JSON_SCALARS = (bool, int, float, str, type(None))
+
+
+def expr_to_json(e: RowExpression) -> Dict[str, Any]:
+    if isinstance(e, InputRef):
+        return {"k": "ref", "i": e.index, "t": _ty(e.type)}
+    if isinstance(e, Constant):
+        if not isinstance(e.value, _JSON_SCALARS):
+            raise PlanSerdeError(
+                f"non-JSON constant {e.value!r} of type {e.type.display()}")
+        return {"k": "const", "v": e.value, "t": _ty(e.type)}
+    if isinstance(e, Call):
+        out = {"k": "call", "name": e.name,
+               "args": [expr_to_json(a) for a in e.args], "t": _ty(e.type)}
+        # round() bakes the digit count into the bound impl (build.py
+        # round_digits); recover it from the resolution key for rebinding.
+        if e.name == "round" and getattr(e.fn, "re_key", None):
+            out["digits"] = e.fn.re_key[2]
+        return out
+    if isinstance(e, SpecialForm):
+        return {"k": "form", "form": e.form,
+                "args": [expr_to_json(a) for a in e.args], "t": _ty(e.type)}
+    raise PlanSerdeError(f"unknown expression {type(e).__name__}")
+
+
+def expr_from_json(d: Dict[str, Any]) -> RowExpression:
+    k = d["k"]
+    t = _unty(d["t"])
+    if k == "ref":
+        return InputRef(int(d["i"]), t)
+    if k == "const":
+        v = d["v"]
+        if not isinstance(v, _JSON_SCALARS):
+            raise PlanSerdeError(f"bad constant {v!r}")
+        return Constant(v, t)
+    if k == "call":
+        args = tuple(expr_from_json(a) for a in d["args"])
+        name = d["name"]
+        if name == "cast":
+            fn = F.resolve_cast(args[0].type, t)
+        elif name == "round":
+            fn = F.resolve_round(args[0].type, int(d.get("digits", 0)))
+        else:
+            fn = F.resolve_scalar(name, [a.type for a in args])
+        return Call(name, args, t, fn)
+    if k == "form":
+        return SpecialForm(str(d["form"]),
+                           tuple(expr_from_json(a) for a in d["args"]), t)
+    raise PlanSerdeError(f"unknown expression kind {k!r}")
+
+
+# --------------------------------------------------------------------------
+# Aggregates / window functions
+# --------------------------------------------------------------------------
+
+def _agg_to_json(a: PlanAggregate) -> Dict[str, Any]:
+    s = a.spec
+    return {"spec": {"name": s.name,
+                     "arg_type": None if s.arg_type is None else _ty(s.arg_type),
+                     "result_type": _ty(s.result_type),
+                     "components": [[p, _ty(ct)] for p, ct in s.components],
+                     "finalize": s.finalize},
+            "channel": a.channel, "distinct": a.distinct,
+            "output_name": a.output_name}
+
+
+def _agg_from_json(d: Dict[str, Any]) -> PlanAggregate:
+    s = d["spec"]
+    spec = AggSpec(
+        s["name"],
+        None if s["arg_type"] is None else _unty(s["arg_type"]),
+        _unty(s["result_type"]),
+        [(p, _unty(ct)) for p, ct in s["components"]],
+        s.get("finalize", "identity"))
+    return PlanAggregate(spec, d["channel"], d.get("distinct", False),
+                         d.get("output_name", ""))
+
+
+def _winfn_to_json(f: PlanWindowFunction) -> Dict[str, Any]:
+    return {"name": f.name, "arg_channels": list(f.arg_channels),
+            "result_type": _ty(f.result_type), "frame_unit": f.frame_unit,
+            "frame_start": f.frame_start, "frame_end": f.frame_end,
+            "frame_start_offset": f.frame_start_offset,
+            "frame_end_offset": f.frame_end_offset, "offset": f.offset,
+            "default_channel": f.default_channel}
+
+
+def _winfn_from_json(d: Dict[str, Any]) -> PlanWindowFunction:
+    return PlanWindowFunction(
+        d["name"], tuple(d["arg_channels"]), _unty(d["result_type"]),
+        d.get("frame_unit", "range"),
+        d.get("frame_start", "unbounded_preceding"),
+        d.get("frame_end", "current"), d.get("frame_start_offset"),
+        d.get("frame_end_offset"), d.get("offset"), d.get("default_channel"))
+
+
+# --------------------------------------------------------------------------
+# Plan nodes
+# --------------------------------------------------------------------------
+
+def _keys3(keys):
+    # (channel, ascending, nulls_first) triples
+    return [[c, a, nf] for c, a, nf in keys]
+
+
+def _unkeys3(keys):
+    return tuple((int(c), bool(a), nf) for c, a, nf in keys)
+
+
+def node_to_json(n: PlanNode) -> Dict[str, Any]:
+    if isinstance(n, TableScanNode):
+        return {"k": "scan", "catalog": n.catalog, "table": n.table,
+                "column_names": list(n.column_names),
+                "columns": _cols(n.columns)}
+    if isinstance(n, ValuesNode):
+        for row in n.rows:
+            for v in row:
+                if not isinstance(v, _JSON_SCALARS):
+                    raise PlanSerdeError(f"non-JSON values literal {v!r}")
+        return {"k": "values", "columns": _cols(n.columns),
+                "rows": [list(r) for r in n.rows]}
+    if isinstance(n, FilterNode):
+        return {"k": "filter", "source": node_to_json(n.source),
+                "predicate": expr_to_json(n.predicate)}
+    if isinstance(n, ProjectNode):
+        return {"k": "project", "source": node_to_json(n.source),
+                "expressions": [expr_to_json(e) for e in n.expressions],
+                "columns": _cols(n.columns)}
+    if isinstance(n, AggregationNode):
+        return {"k": "agg", "source": node_to_json(n.source),
+                "group_channels": list(n.group_channels),
+                "aggregates": [_agg_to_json(a) for a in n.aggregates],
+                "columns": _cols(n.columns), "step": n.step}
+    if isinstance(n, JoinNode):
+        return {"k": "join", "kind": n.kind,
+                "left": node_to_json(n.left), "right": node_to_json(n.right),
+                "left_keys": list(n.left_keys),
+                "right_keys": list(n.right_keys),
+                "columns": _cols(n.columns),
+                "residual": None if n.residual is None
+                else expr_to_json(n.residual)}
+    if isinstance(n, SemiJoinNode):
+        return {"k": "semijoin", "source": node_to_json(n.source),
+                "filtering": node_to_json(n.filtering),
+                "source_keys": list(n.source_keys),
+                "filtering_keys": list(n.filtering_keys),
+                "negated": n.negated,
+                "residual": None if n.residual is None
+                else expr_to_json(n.residual)}
+    if isinstance(n, WindowNode):
+        return {"k": "window", "source": node_to_json(n.source),
+                "partition_channels": list(n.partition_channels),
+                "order_keys": _keys3(n.order_keys),
+                "functions": [_winfn_to_json(f) for f in n.functions],
+                "columns": _cols(n.columns)}
+    if isinstance(n, UnionNode):
+        return {"k": "union",
+                "inputs": [node_to_json(i) for i in n.inputs],
+                "columns": _cols(n.columns)}
+    if isinstance(n, SortNode):
+        return {"k": "sort", "source": node_to_json(n.source),
+                "sort_keys": _keys3(n.sort_keys)}
+    if isinstance(n, LimitNode):
+        return {"k": "limit", "source": node_to_json(n.source),
+                "count": n.count}
+    if isinstance(n, EnforceSingleRowNode):
+        return {"k": "single_row", "source": node_to_json(n.source)}
+    if isinstance(n, RemoteSourceNode):
+        return {"k": "remote", "fragment_ids": list(n.fragment_ids),
+                "columns": _cols(n.columns)}
+    if isinstance(n, OutputNode):
+        return {"k": "output", "source": node_to_json(n.source),
+                "columns": _cols(n.columns)}
+    raise PlanSerdeError(f"unknown plan node {type(n).__name__}")
+
+
+def node_from_json(d: Dict[str, Any]) -> PlanNode:
+    k = d["k"]
+    if k == "scan":
+        return TableScanNode(d["catalog"], d["table"],
+                             tuple(d["column_names"]), _uncols(d["columns"]))
+    if k == "values":
+        return ValuesNode(_uncols(d["columns"]),
+                          tuple(tuple(r) for r in d["rows"]))
+    if k == "filter":
+        return FilterNode(node_from_json(d["source"]),
+                          expr_from_json(d["predicate"]))
+    if k == "project":
+        return ProjectNode(node_from_json(d["source"]),
+                           tuple(expr_from_json(e) for e in d["expressions"]),
+                           _uncols(d["columns"]))
+    if k == "agg":
+        return AggregationNode(node_from_json(d["source"]),
+                               tuple(d["group_channels"]),
+                               tuple(_agg_from_json(a)
+                                     for a in d["aggregates"]),
+                               _uncols(d["columns"]), d.get("step", "single"))
+    if k == "join":
+        return JoinNode(d["kind"], node_from_json(d["left"]),
+                        node_from_json(d["right"]), tuple(d["left_keys"]),
+                        tuple(d["right_keys"]), _uncols(d["columns"]),
+                        None if d.get("residual") is None
+                        else expr_from_json(d["residual"]))
+    if k == "semijoin":
+        return SemiJoinNode(node_from_json(d["source"]),
+                            node_from_json(d["filtering"]),
+                            tuple(d["source_keys"]),
+                            tuple(d["filtering_keys"]),
+                            d.get("negated", False),
+                            None if d.get("residual") is None
+                            else expr_from_json(d["residual"]))
+    if k == "window":
+        return WindowNode(node_from_json(d["source"]),
+                          tuple(d["partition_channels"]),
+                          _unkeys3(d["order_keys"]),
+                          tuple(_winfn_from_json(f) for f in d["functions"]),
+                          _uncols(d["columns"]))
+    if k == "union":
+        return UnionNode(tuple(node_from_json(i) for i in d["inputs"]),
+                         _uncols(d["columns"]))
+    if k == "sort":
+        return SortNode(node_from_json(d["source"]),
+                        _unkeys3(d["sort_keys"]))
+    if k == "limit":
+        return LimitNode(node_from_json(d["source"]), int(d["count"]))
+    if k == "single_row":
+        return EnforceSingleRowNode(node_from_json(d["source"]))
+    if k == "remote":
+        return RemoteSourceNode(tuple(d["fragment_ids"]),
+                                _uncols(d["columns"]))
+    if k == "output":
+        return OutputNode(node_from_json(d["source"]), _uncols(d["columns"]))
+    raise PlanSerdeError(f"unknown plan node kind {k!r}")
+
+
+# --------------------------------------------------------------------------
+# Fragments
+# --------------------------------------------------------------------------
+
+def fragment_to_json(f: PlanFragment) -> Dict[str, Any]:
+    kind, channels = f.output_partitioning
+    return {"fragment_id": f.fragment_id, "root": node_to_json(f.root),
+            "partitioning": f.partitioning,
+            "output_partitioning": [kind, list(channels)],
+            "consumed_fragments": list(f.consumed_fragments)}
+
+
+def fragment_from_json(d: Dict[str, Any]) -> PlanFragment:
+    kind, channels = d["output_partitioning"]
+    return PlanFragment(int(d["fragment_id"]), node_from_json(d["root"]),
+                        str(d["partitioning"]), (str(kind), tuple(channels)),
+                        tuple(d["consumed_fragments"]))

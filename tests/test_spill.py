@@ -94,6 +94,62 @@ class TestSpilledQueries:
         want = mem_runner.execute(sql).rows
         assert got == want  # exact ordered comparison
 
+    def test_spilled_order_by_varchar_matches(self, spill_runner,
+                                              mem_runner):
+        # Each spilled run re-codes its varchar keys into its own
+        # dictionary (different first-seen order per run), so the k-way
+        # merge must compare actual string values, not codes or ranks.
+        sql = ("select l_comment, l_orderkey from lineitem "
+               "where l_suppkey < 30 "
+               "order by l_comment, l_orderkey")
+        got = spill_runner.execute(sql).rows
+        want = mem_runner.execute(sql).rows
+        assert got == want  # exact ordered comparison
+
+    def test_spilled_order_by_varchar_desc_nulls(self, spill_runner,
+                                                 mem_runner):
+        sql = ("select l_shipinstruct, l_comment, l_orderkey from lineitem "
+               "where l_suppkey < 30 "
+               "order by l_comment desc, l_orderkey")
+        got = spill_runner.execute(sql).rows
+        want = mem_runner.execute(sql).rows
+        assert got == want
+
+    @pytest.mark.parametrize("descending", [False, True])
+    def test_merge_compares_values_across_run_dictionaries(
+            self, tmp_path, descending):
+        # Each spilled run carries its OWN dictionary (batch_from_pylist
+        # interns in first-seen order), so codes/ranks are not comparable
+        # across runs: the k-way merge must compare decoded string values.
+        import dataclasses as dc
+
+        from presto_tpu import types as T
+        from presto_tpu.batch import batch_from_pylist
+        from presto_tpu.exec.context import (
+            OperatorContext, QueryContext, TaskContext,
+        )
+        from presto_tpu.exec.sortop import OrderByOperator, SortSpec
+
+        cfg = dc.replace(DEFAULT, spill_threshold_bytes=1,
+                         spill_path=str(tmp_path))
+        ctx = OperatorContext(TaskContext(QueryContext(cfg)), "sort")
+        op = OrderByOperator(ctx, [SortSpec(0, descending=descending)])
+        # run 1 dictionary: banana=0, apple=1; run 2: zebra=0, cherry=1.
+        # Rank-based merge would interleave per-run ranks (apple~cherry,
+        # banana~zebra); value-based merge restores global order.
+        runs = [[("banana",), ("apple",), (None,)],
+                [("zebra",), ("cherry",)]]
+        for rows in runs:
+            op.add_input(batch_from_pylist([T.VARCHAR], rows))
+        assert len(op._runs) == 2  # every batch became its own spilled run
+        op.finish()
+        got = []
+        while (b := op.get_output()) is not None:
+            got += [r[0] for r in b.to_pylist()]
+        want = ["apple", "banana", "cherry", "zebra"]
+        want = (want[::-1] if descending else want) + [None]  # nulls last
+        assert got == want
+
     def test_spilled_topn_matches(self, spill_runner, mem_runner):
         sql = ("select l_orderkey, l_extendedprice from lineitem "
                "order by l_extendedprice desc, l_orderkey limit 25")
