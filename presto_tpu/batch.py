@@ -98,41 +98,137 @@ class Dictionary:
 
 @dataclasses.dataclass(frozen=True)
 class Column:
-    """One channel of a Batch: values + optional validity (+ dictionary)."""
+    """One channel of a Batch: values + optional validity (+ dictionary).
+
+    Nested columns (ARRAY/MAP/ROW, the reference's ArrayBlock/MapBlock/
+    RowBlock) carry flattened ``children``: for ARRAY, ``values`` holds
+    per-row element counts (int32 lengths; offsets are their cumsum) and
+    ``children=(elements,)``; for MAP the same with ``children=(keys,
+    values)``; for ROW ``values`` is a placeholder and children are
+    row-aligned field columns.  Lengths-not-offsets keeps every flat-column
+    invariant (shape [n], gather-based take, zero-padding) intact.
+    """
 
     type: T.Type
     values: Array
     valid: Optional[Array] = None  # bool array; None == all valid
     dictionary: Optional[Dictionary] = None
+    children: Tuple["Column", ...] = ()
 
     def __post_init__(self):
         if self.type.is_dictionary and self.dictionary is None:
             raise ValueError(f"{self.type} column requires a dictionary")
+        if self.type.is_nested and not self.children:
+            raise ValueError(f"{self.type} column requires children")
 
     @property
     def may_have_nulls(self) -> bool:
         return self.valid is not None
 
+    @property
+    def has_offsets(self) -> bool:
+        """ARRAY/MAP: values are element counts into flattened children."""
+        return isinstance(self.type, (T.ArrayType, T.MapType))
+
+    def offsets(self) -> np.ndarray:
+        lengths = np.asarray(self.values)
+        return np.concatenate([np.zeros(1, np.int64),
+                               np.cumsum(lengths, dtype=np.int64)])
+
     def with_values(self, values: Array, valid: Optional[Array] = _UNSET) -> "Column":
         return Column(self.type, values,
-                      self.valid if valid is _UNSET else valid, self.dictionary)
+                      self.valid if valid is _UNSET else valid,
+                      self.dictionary, self.children)
 
     def take(self, indices: Array) -> "Column":
+        if self.has_offsets:
+            indices = np.asarray(indices)
+            lengths = np.asarray(self.values)
+            offsets = self.offsets()
+            new_lengths = lengths[indices]
+            child_idx = _range_gather_indices(offsets[indices], new_lengths)
+            kids = tuple(c.take(child_idx) for c in self.children)
+            valid = None if self.valid is None \
+                else np.asarray(self.valid)[indices]
+            return Column(self.type, new_lengths.astype(np.int32), valid,
+                          None, kids)
+        if isinstance(self.type, T.RowType):
+            indices = np.asarray(indices)
+            kids = tuple(c.take(indices) for c in self.children)
+            valid = None if self.valid is None \
+                else np.asarray(self.valid)[indices]
+            return Column(self.type, np.asarray(self.values)[indices],
+                          valid, None, kids)
         xp = _xp(self.values)
         values = xp.take(self.values, indices, axis=0)
         valid = None if self.valid is None else xp.take(self.valid, indices, axis=0)
         return Column(self.type, values, valid, self.dictionary)
 
+    def head(self, n: int) -> "Column":
+        """First n rows (child columns truncated to match)."""
+        if self.has_offsets:
+            lengths = np.asarray(self.values)[:n]
+            total = int(lengths.sum())
+            kids = tuple(c.head(total) for c in self.children)
+            valid = None if self.valid is None \
+                else np.asarray(self.valid)[:n]
+            return Column(self.type, lengths, valid, None, kids)
+        kids = tuple(c.head(n) for c in self.children)
+        return Column(self.type, self.values[:n],
+                      None if self.valid is None else self.valid[:n],
+                      self.dictionary, kids)
+
+    def pad(self, capacity: int) -> "Column":
+        """Pad to ``capacity`` rows (zero fill => empty arrays, invalid)."""
+        n = int(self.values.shape[0])
+        if n >= capacity:
+            return self
+        extra = capacity - n
+        if self.has_offsets:
+            lengths = np.concatenate(
+                [np.asarray(self.values), np.zeros(extra, np.int32)])
+            valid = self.valid
+            if valid is not None:
+                valid = np.concatenate([np.asarray(valid),
+                                        np.zeros(extra, bool)])
+            return Column(self.type, lengths, valid, None, self.children)
+        xp = _xp(self.values)
+        values = xp.concatenate(
+            [self.values,
+             xp.zeros((extra,) + self.values.shape[1:], self.values.dtype)])
+        valid = self.valid
+        if valid is not None:
+            valid = xp.concatenate([valid, xp.zeros((extra,), bool)])
+        kids = tuple(c.pad(capacity) for c in self.children)
+        return Column(self.type, values, valid, self.dictionary, kids)
+
     def to_numpy(self) -> "Column":
         valid = None if self.valid is None else np.asarray(self.valid)
-        return Column(self.type, np.asarray(self.values), valid, self.dictionary)
+        kids = tuple(c.to_numpy() for c in self.children)
+        return Column(self.type, np.asarray(self.values), valid,
+                      self.dictionary, kids)
 
     def to_pylist(self, num_rows: int) -> List[Any]:
         col = self.to_numpy()
         vals = col.values[:num_rows]
         valid = None if col.valid is None else col.valid[:num_rows]
-        if self.type.is_dictionary:
-            out: List[Any] = [
+        if self.has_offsets:
+            offsets = col.offsets()
+            total = int(offsets[num_rows])
+            kid_lists = [c.to_pylist(total) for c in col.children]
+            out: List[Any] = []
+            for i in range(num_rows):
+                lo, hi = int(offsets[i]), int(offsets[i + 1])
+                if isinstance(self.type, T.MapType):
+                    out.append(dict(zip(kid_lists[0][lo:hi],
+                                        kid_lists[1][lo:hi])))
+                else:
+                    out.append(kid_lists[0][lo:hi])
+        elif isinstance(self.type, T.RowType):
+            kid_lists = [c.to_pylist(num_rows) for c in col.children]
+            out = [tuple(k[i] for k in kid_lists) for i in range(num_rows)]
+        elif self.type.is_dictionary:
+            out = [
                 self.dictionary.values[int(c)] if 0 <= int(c) < len(self.dictionary)
                 else None
                 for c in vals
@@ -142,6 +238,19 @@ class Column:
         if valid is not None:
             out = [v if ok else None for v, ok in zip(out, valid)]
         return out
+
+
+def _range_gather_indices(starts: np.ndarray,
+                          lengths: np.ndarray) -> np.ndarray:
+    """Concatenate [starts[i], starts[i]+lengths[i]) ranges, vectorized."""
+    lengths = np.asarray(lengths, np.int64)
+    total = int(lengths.sum())
+    if total == 0:
+        return np.zeros(0, np.int64)
+    ends = np.cumsum(lengths)
+    begins = ends - lengths
+    ramp = np.arange(total, dtype=np.int64) - np.repeat(begins, lengths)
+    return np.repeat(np.asarray(starts, np.int64), lengths) + ramp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,26 +293,14 @@ class Batch:
 
     def head(self, n: int) -> "Batch":
         n = min(n, self.num_rows)
-        return Batch(tuple(
-            Column(c.type, c.values[:n],
-                   None if c.valid is None else c.valid[:n], c.dictionary)
-            for c in self.columns), n)
+        return Batch(tuple(c.head(n) for c in self.columns), n)
 
     def pad_rows(self, capacity: int) -> "Batch":
         """Pad every column to ``capacity`` rows (zero fill, invalid)."""
         if self.capacity >= capacity:
             return self
-        pad = capacity - self.capacity
-        cols = []
-        for c in self.columns:
-            xp = _xp(c.values)
-            values = xp.concatenate(
-                [c.values, xp.zeros((pad,) + c.values.shape[1:], c.values.dtype)])
-            valid = c.valid
-            if valid is not None:
-                valid = xp.concatenate([valid, xp.zeros((pad,), bool)])
-            cols.append(Column(c.type, values, valid, c.dictionary))
-        return Batch(tuple(cols), self.num_rows)
+        return Batch(tuple(c.pad(capacity) for c in self.columns),
+                     self.num_rows)
 
     def compact(self) -> "Batch":
         """Drop padding (host copy if padded)."""
@@ -219,6 +316,11 @@ class Batch:
 
         cols = []
         for c in self.columns:
+            if c.children:
+                # nested columns stay host-side (offsets bookkeeping);
+                # device compute operates on their flattened children
+                cols.append(c.to_numpy())
+                continue
             values = jax.device_put(c.values)
             valid = None if c.valid is None else jax.device_put(c.valid)
             cols.append(Column(c.type, values, valid, c.dictionary))
@@ -231,12 +333,15 @@ class Batch:
 
     @property
     def size_bytes(self) -> int:
-        total = 0
-        for c in self.columns:
-            total += int(np.prod(c.values.shape)) * c.values.dtype.itemsize
+        def col_bytes(c: Column) -> int:
+            total = int(np.prod(c.values.shape)) * c.values.dtype.itemsize
             if c.valid is not None:
                 total += int(np.prod(c.valid.shape))
-        return total
+            for kid in c.children:
+                total += col_bytes(kid)
+            return total
+
+        return sum(col_bytes(c) for c in self.columns)
 
     def __repr__(self) -> str:  # pragma: no cover
         ts = ", ".join(c.type.display() for c in self.columns)
@@ -258,12 +363,36 @@ def _xp(arr):
 
 def column_from_pylist(typ: T.Type, values: Sequence[Any],
                        dictionary: Optional[Dictionary] = None) -> Column:
-    """Build a Column from Python values (None == NULL)."""
+    """Build a Column from Python values (None == NULL).
+
+    Nested values: ARRAY from lists/tuples, MAP from dicts, ROW from
+    tuples (ArrayBlockBuilder/MapBlockBuilder/RowBlockBuilder analogue).
+    """
     n = len(values)
     has_null = any(v is None for v in values)
     valid = None
     if has_null:
         valid = np.fromiter((v is not None for v in values), dtype=bool, count=n)
+    if isinstance(typ, T.ArrayType):
+        lengths = np.fromiter((0 if v is None else len(v) for v in values),
+                              dtype=np.int32, count=n)
+        flat = [e for v in values if v is not None for e in v]
+        return Column(typ, lengths, valid, None,
+                      (column_from_pylist(typ.element, flat),))
+    if isinstance(typ, T.MapType):
+        lengths = np.fromiter((0 if v is None else len(v) for v in values),
+                              dtype=np.int32, count=n)
+        keys = [k for v in values if v is not None for k in v.keys()]
+        vals = [x for v in values if v is not None for x in v.values()]
+        return Column(typ, lengths, valid, None,
+                      (column_from_pylist(typ.key, keys),
+                       column_from_pylist(typ.value, vals)))
+    if isinstance(typ, T.RowType):
+        kids = []
+        for fi, ft in enumerate(typ.field_types):
+            kids.append(column_from_pylist(
+                ft, [None if v is None else v[fi] for v in values]))
+        return Column(typ, np.zeros(n, np.int8), valid, None, tuple(kids))
     if typ.is_dictionary:
         dictionary = dictionary or Dictionary()
         codes = np.fromiter(
@@ -286,6 +415,47 @@ def batch_from_pylist(schema: Sequence[T.Type],
     return Batch(tuple(cols), len(rows))
 
 
+def _concat_columns(cols: Sequence[Column],
+                    row_counts: Sequence[int]) -> Column:
+    """Concatenate row-count-exact numpy columns of one channel."""
+    typ = cols[0].type
+    if any(c.valid is not None for c in cols):
+        valid = np.concatenate([
+            np.asarray(c.valid)[:n] if c.valid is not None
+            else np.ones(n, bool)
+            for c, n in zip(cols, row_counts)])
+    else:
+        valid = None
+    if isinstance(typ, (T.ArrayType, T.MapType)):
+        lengths = np.concatenate(
+            [np.asarray(c.values)[:n] for c, n in zip(cols, row_counts)])
+        kid_counts = [int(np.asarray(c.values)[:n].sum())
+                      for c, n in zip(cols, row_counts)]
+        kids = tuple(
+            _concat_columns([c.children[ki] for c in cols], kid_counts)
+            for ki in range(len(cols[0].children)))
+        return Column(typ, lengths.astype(np.int32), valid, None, kids)
+    if isinstance(typ, T.RowType):
+        kids = tuple(
+            _concat_columns([c.children[ki] for c in cols], row_counts)
+            for ki in range(len(cols[0].children)))
+        values = np.concatenate(
+            [np.asarray(c.values)[:n] for c, n in zip(cols, row_counts)])
+        return Column(typ, values, valid, None, kids)
+    if typ.is_dictionary:
+        target = Dictionary()
+        parts = []
+        for c, n in zip(cols, row_counts):
+            remap = c.dictionary.remap_into(target)
+            codes = np.asarray(c.values)[:n]
+            parts.append(remap[codes] if len(remap) else codes)
+        values = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+        return Column(typ, values, valid, target)
+    values = np.concatenate(
+        [np.asarray(c.values)[:n] for c, n in zip(cols, row_counts)])
+    return Column(typ, values, valid)
+
+
 def concat_batches(batches: Sequence[Batch]) -> Batch:
     """Concatenate compacted batches (dictionary columns are re-coded into a
     shared dictionary — the DictionaryBlock 'compact' analogue)."""
@@ -293,36 +463,26 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
     if not batches:
         raise ValueError("concat of zero rows needs a schema; use empty_batch")
     first = batches[0]
-    out_cols = []
-    for ci in range(first.num_columns):
-        cols = [b.columns[ci] for b in batches]
-        typ = cols[0].type
-        if typ.is_dictionary:
-            target = Dictionary()
-            parts = []
-            for c in cols:
-                remap = c.dictionary.remap_into(target)
-                parts.append(remap[np.asarray(c.values)]
-                             if len(remap) else np.asarray(c.values))
-            values = np.concatenate(parts) if parts else np.zeros(0, np.int32)
-            dictionary = target
-        else:
-            values = np.concatenate([np.asarray(c.values) for c in cols])
-            dictionary = None
-        if any(c.valid is not None for c in cols):
-            valid = np.concatenate([
-                np.asarray(c.valid) if c.valid is not None
-                else np.ones(b.num_rows, bool)
-                for c, b in zip(cols, batches)])
-        else:
-            valid = None
-        out_cols.append(Column(typ, values, valid, dictionary))
-    return Batch(tuple(out_cols), sum(b.num_rows for b in batches))
+    counts = [b.num_rows for b in batches]
+    out_cols = [
+        _concat_columns([b.columns[ci] for b in batches], counts)
+        for ci in range(first.num_columns)]
+    return Batch(tuple(out_cols), sum(counts))
+
+
+def empty_column(typ: T.Type) -> Column:
+    if isinstance(typ, T.ArrayType):
+        return Column(typ, np.zeros(0, np.int32), None, None,
+                      (empty_column(typ.element),))
+    if isinstance(typ, T.MapType):
+        return Column(typ, np.zeros(0, np.int32), None, None,
+                      (empty_column(typ.key), empty_column(typ.value)))
+    if isinstance(typ, T.RowType):
+        return Column(typ, np.zeros(0, np.int8), None, None,
+                      tuple(empty_column(ft) for ft in typ.field_types))
+    dictionary = Dictionary() if typ.is_dictionary else None
+    return Column(typ, np.zeros(0, typ.np_dtype), None, dictionary)
 
 
 def empty_batch(schema: Sequence[T.Type]) -> Batch:
-    cols = []
-    for typ in schema:
-        dictionary = Dictionary() if typ.is_dictionary else None
-        cols.append(Column(typ, np.zeros(0, typ.np_dtype), None, dictionary))
-    return Batch(tuple(cols), 0)
+    return Batch(tuple(empty_column(typ) for typ in schema), 0)

@@ -50,6 +50,125 @@ def _minmax_dict_input(a: "AggChannel", col):
     return vals, post
 
 
+_HOST_PRIMS = ("collect", "collect_merge", "hll", "hll_merge")
+
+
+def _has_collect(aggs: Sequence[AggChannel]) -> bool:
+    return any(a.prim in _HOST_PRIMS for a in aggs)
+
+
+def host_aggregate(batches: List[Batch], group_channels: Sequence[int],
+                   aggs: Sequence[AggChannel],
+                   global_row: bool) -> Optional[Batch]:
+    """Host-side aggregation used when a collect-style aggregate
+    (array_agg/map_agg/min_by, AccumulatorCompiler's object-state
+    accumulators in the reference) is present: device reductions cannot
+    produce variable-length results.
+
+    At the FINAL distributed step, collect inputs are the partial step's
+    arrays and are flattened (the @CombineFunction merge role).
+    """
+    import numpy as np
+
+    from presto_tpu.batch import (
+        Batch, Column, column_from_pylist, concat_batches,
+    )
+
+    live = [b.compact().to_numpy() for b in batches if b.num_rows > 0]
+    if not live:
+        if not global_row:
+            return None
+        rows: List[tuple] = []
+        data = None
+        n = 0
+    else:
+        data = concat_batches(live) if len(live) > 1 else live[0]
+        n = data.num_rows
+    key_lists = [data.columns[c].to_pylist(n) for c in group_channels] \
+        if data is not None else [[] for _ in group_channels]
+    group_ids: dict = {}
+    order: List[tuple] = []
+    gids = np.zeros(n, np.int64)
+    for i in range(n):
+        k = tuple(kl[i] for kl in key_lists)
+        gid = group_ids.get(k)
+        if gid is None:
+            gid = group_ids[k] = len(order)
+            order.append(k)
+        gids[i] = gid
+    if global_row and not order:
+        order.append(())
+    ng = len(order)
+    cols: List[Column] = []
+    for j, c in enumerate(group_channels):
+        src = None if data is None else data.columns[c]
+        vals = [k[j] for k in order]
+        cols.append(column_from_pylist(src.type, vals))
+    for a in aggs:
+        in_list = None
+        if a.channel is not None and data is not None:
+            in_list = data.columns[a.channel].to_pylist(n)
+        if a.prim == "count":
+            out = [0] * ng
+            for i in range(n):
+                if in_list is None or in_list[i] is not None:
+                    out[int(gids[i])] += 1
+            cols.append(column_from_pylist(a.out_type, out))
+            continue
+        if a.prim in ("collect", "collect_merge"):
+            # the FINAL step's inputs are the partial step's arrays; the
+            # prim says which step this is (type equality is ambiguous,
+            # e.g. array_agg over varbinary-typed inputs)
+            flatten = a.prim == "collect_merge"
+            acc: List[Optional[list]] = [[] for _ in range(ng)]
+            for i in range(n):
+                v = in_list[i]
+                if flatten:
+                    if v is not None:
+                        acc[int(gids[i])].extend(v)
+                else:
+                    acc[int(gids[i])].append(v)
+            if n == 0 and global_row:
+                acc = [None]       # array_agg over no rows is NULL
+            cols.append(column_from_pylist(a.out_type, acc))
+            continue
+        if a.prim in ("hll", "hll_merge"):
+            from presto_tpu.sketch import HyperLogLog
+
+            merge = a.prim == "hll_merge"
+            sketches = [HyperLogLog() for _ in range(ng)]
+            for i in range(n):
+                v = in_list[i]
+                if v is None:
+                    continue
+                g = int(gids[i])
+                if merge:
+                    sketches[g].merge(HyperLogLog.deserialize(v))
+                else:
+                    sketches[g].add_value(v)
+            cols.append(column_from_pylist(
+                a.out_type, [s.serialize() for s in sketches]))
+            continue
+        # sum / min / max over non-null values
+        out2: List[Optional[object]] = [None] * ng
+        for i in range(n):
+            v = in_list[i] if in_list is not None else None
+            if v is None:
+                continue
+            g = int(gids[i])
+            cur = out2[g]
+            if cur is None:
+                out2[g] = v
+            elif a.prim == "sum":
+                out2[g] = cur + v
+            elif a.prim == "min":
+                out2[g] = min(cur, v)
+            elif a.prim == "max":
+                out2[g] = max(cur, v)
+        cols.append(column_from_pylist(a.out_type, out2))
+    return Batch(tuple(cols), ng)
+
+
 class HashAggregationOperator(Operator):
     def __init__(self, ctx: OperatorContext, group_channels: Sequence[int],
                  aggs: Sequence[AggChannel], input_types: Sequence[T.Type]):
@@ -186,6 +305,13 @@ class HashAggregationOperator(Operator):
 
         from presto_tpu.ops.groupby import grouped_aggregate
 
+        if _has_collect(self.aggs):
+            out = host_aggregate(batches, self.group_channels, self.aggs,
+                                 global_row=False)
+            if out is not None:
+                self.ctx.stats.output_rows += out.num_rows
+            return out
+
         data = device_concat(batches, self.ctx.config.min_batch_capacity)
         if data is None:
             return None  # grouped aggregation of zero rows -> zero rows
@@ -281,6 +407,12 @@ class GlobalAggregationOperator(Operator):
         import numpy as np
 
         from presto_tpu.ops.groupby import global_aggregate
+
+        if _has_collect(self.aggs):
+            self._output = host_aggregate(self._batches, [], self.aggs,
+                                          global_row=True)
+            self._batches = []
+            return
 
         data = device_concat(self._batches,
                              self.ctx.config.min_batch_capacity)

@@ -127,6 +127,13 @@ class FilterProjectOperator(Operator):
         self.input_types = list(input_types)
         self._pending: Optional[Batch] = None
         self._kernels: Dict[tuple, object] = {}
+        from presto_tpu.expr.compile import needs_host_path
+
+        # expressions are fixed for the operator's lifetime: decide the
+        # host-vs-jit route once, and cache host compilations like kernels
+        self._host_exprs = needs_host_path(
+            [self.filter_expr] + self.projections)
+        self._host_compiled: Dict[tuple, object] = {}
 
     def needs_input(self) -> bool:
         return self._pending is None and not self._finishing
@@ -172,17 +179,58 @@ class FilterProjectOperator(Operator):
         self._kernels[key] = entry
         return entry
 
+    def _host_output(self, batch: Batch) -> Optional[Batch]:
+        """Un-jitted path for nested-typed expressions (host Columns)."""
+        import numpy as np
+
+        from presto_tpu.expr.compile import (
+            ExprCompiler, batch_pairs, result_column,
+        )
+
+        batch = batch.compact().to_numpy()
+        # cache per dictionary binding (same policy as the jit kernels);
+        # dictionaries are append-only so the binding stays valid and
+        # per-call-site output dictionaries keep stable codes
+        key = tuple(id(c.dictionary) for c in batch.columns)
+        hit = self._host_compiled.get(key)
+        if hit is None:
+            compiler = ExprCompiler({i: c.dictionary
+                                     for i, c in enumerate(batch.columns)
+                                     if c.dictionary is not None})
+            cfilter = (compiler.compile(self.filter_expr)
+                       if self.filter_expr is not None else None)
+            cprojs = [compiler.compile(p) for p in self.projections]
+            hit = self._host_compiled[key] = (cfilter, cprojs)
+        cfilter, cprojs = hit
+        n = batch.num_rows
+        if cfilter is not None:
+            mask, mvalid = cfilter.run(batch_pairs(batch), n, np)
+            keep = np.asarray(mask, bool)
+            if mvalid is not None:
+                keep = keep & np.asarray(mvalid)
+            batch = batch.take(np.nonzero(keep[:n])[0])
+            n = batch.num_rows
+        pairs = batch_pairs(batch)
+        cols = tuple(
+            result_column(p, *p.run(pairs, n, np)) for p in cprojs)
+        return Batch(cols, n)
+
     def get_output(self) -> Optional[Batch]:
         if self._pending is None:
             return None
         batch, self._pending = self._pending, None
-        jitted, cprojs = self._kernel_for(batch)
-        outs, count = jitted(tuple(column_pairs(batch)), batch.num_rows)
-        n = int(count)
-        cols = tuple(
-            Column(p.type, v, valid, p.dictionary)
-            for p, (v, valid) in zip(cprojs, outs))
-        out = Batch(cols, n)
+        if (self._host_exprs
+                or any(c.type.is_nested for c in batch.columns)):
+            out = self._host_output(batch)
+            n = out.num_rows
+        else:
+            jitted, cprojs = self._kernel_for(batch)
+            outs, count = jitted(tuple(column_pairs(batch)), batch.num_rows)
+            n = int(count)
+            cols = tuple(
+                Column(p.type, v, valid, p.dictionary)
+                for p, (v, valid) in zip(cprojs, outs))
+            out = Batch(cols, n)
         self.ctx.stats.output_batches += 1
         self.ctx.stats.output_rows += n
         if n == 0:

@@ -48,6 +48,7 @@ class OrderByOperator(Operator):
     def _sort_batches(self, batches: List[Batch]) -> Optional[Batch]:
         """Device sort of the concatenated batches (one run)."""
         import jax.numpy as jnp
+        import numpy as np
 
         from presto_tpu.ops.sort import sort_permutation
 
@@ -68,11 +69,16 @@ class OrderByOperator(Operator):
                 keys.append((c.values, c.valid, c.type, s.descending,
                              s.nulls_first))
         perm = sort_permutation(keys, jnp.asarray(data.num_rows))
-        cols = tuple(
-            Column(c.type, c.values[perm],
-                   None if c.valid is None else c.valid[perm], c.dictionary)
-            for c in data.columns)
-        return Batch(cols, data.num_rows)
+        cols = []
+        for c in data.columns:
+            if c.children:       # nested columns gather host-side
+                cols.append(c.to_numpy().take(np.asarray(perm)))
+            else:
+                cols.append(Column(
+                    c.type, c.values[perm],
+                    None if c.valid is None else c.valid[perm],
+                    c.dictionary))
+        return Batch(tuple(cols), data.num_rows)
 
     def _spill_run(self) -> None:
         """External sort: sort the accumulated chunk on device, spill it as

@@ -1,0 +1,152 @@
+"""UNNEST operator (UnnestOperator.java:39).
+
+Expands ARRAY/MAP columns into rows: each input row emits
+max(cardinalities) output rows; replicated channels repeat per element,
+shorter arrays null-pad, maps expand to (key, value), arrays of ROW expand
+one output column per field, and WITH ORDINALITY appends the 1-based
+position.  All offset arithmetic is vectorized host-side; the expansion
+itself is gathers — the same shape the device join-expansion kernels use.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import Operator, OperatorFactory
+
+
+def _unnest_outputs(col_type: T.Type) -> List[T.Type]:
+    """Output column types for one unnested channel."""
+    if isinstance(col_type, T.MapType):
+        return [col_type.key, col_type.value]
+    if isinstance(col_type, T.ArrayType):
+        if isinstance(col_type.element, T.RowType):
+            return list(col_type.element.field_types)
+        return [col_type.element]
+    raise ValueError(f"cannot unnest {col_type.display()}")
+
+
+class UnnestOperator(Operator):
+    def __init__(self, ctx: OperatorContext,
+                 replicate_channels: Sequence[int],
+                 unnest_channels: Sequence[int], ordinality: bool,
+                 outer: bool = False):
+        super().__init__(ctx)
+        self.replicate_channels = list(replicate_channels)
+        self.unnest_channels = list(unnest_channels)
+        self.ordinality = ordinality
+        self.outer = outer
+        self._pending: Optional[Batch] = None
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._pending = batch
+        self.ctx.stats.input_rows += batch.num_rows
+
+    def get_output(self) -> Optional[Batch]:
+        if self._pending is None:
+            return None
+        batch, self._pending = self._pending, None
+        batch = batch.compact().to_numpy()
+        n = batch.num_rows
+
+        ucols = [batch.columns[c] for c in self.unnest_channels]
+        lens = []
+        for c in ucols:
+            ln = np.asarray(c.values, np.int64).copy()
+            if c.valid is not None:            # NULL container => 0 rows
+                ln[~np.asarray(c.valid)] = 0
+            lens.append(ln)
+        maxlen = lens[0]
+        for ln in lens[1:]:
+            maxlen = np.maximum(maxlen, ln)
+        # LEFT JOIN UNNEST keeps empty/NULL-container rows as one
+        # all-NULL-unnest-columns row
+        efflen = np.maximum(maxlen, 1) if self.outer else maxlen
+        total = int(efflen.sum())
+        row_of = np.repeat(np.arange(n, dtype=np.int64), efflen)
+        ends = np.cumsum(efflen)
+        within = np.arange(total, dtype=np.int64) - \
+            np.repeat(ends - efflen, efflen)
+
+        out_cols: List[Column] = []
+        for ch in self.replicate_channels:
+            out_cols.append(batch.columns[ch].take(row_of))
+        for c, ln in zip(ucols, lens):
+            offsets = np.concatenate(
+                [np.zeros(1, np.int64),
+                 np.cumsum(np.asarray(c.values, np.int64))])
+            present = within < ln[row_of]
+            idx = offsets[row_of] + np.minimum(within, np.maximum(
+                ln[row_of] - 1, 0))
+            # rows whose array here is shorter (even empty) gather a safe
+            # slot; `present` masks them to NULL
+            idx = np.clip(idx, 0,
+                          max(int(offsets[-1]) - 1, 0))
+            kids = c.children
+            for kid in kids:
+                expanded = self._expand_kid(kid, idx, present, total)
+                out_cols.extend(expanded)
+        if self.ordinality:
+            ord_valid = None
+            if self.outer:
+                present_any = within < maxlen[row_of]
+                if not present_any.all():
+                    ord_valid = present_any
+            out_cols.append(Column(T.BIGINT, within + 1, ord_valid))
+        out = Batch(tuple(out_cols), total)
+        self.ctx.stats.output_rows += total
+        return out if total else None
+
+    def _expand_kid(self, kid: Column, idx: np.ndarray,
+                    present: np.ndarray, total: int) -> List[Column]:
+        if kid.values.shape[0] == 0:
+            from presto_tpu.batch import empty_column
+
+            base = empty_column(kid.type).pad(total)
+            cols = [Column(base.type, base.values, np.zeros(total, bool),
+                           base.dictionary, base.children)]
+        else:
+            taken = kid.take(idx)
+            valid = present if taken.valid is None \
+                else present & np.asarray(taken.valid)
+            cols = [Column(taken.type, taken.values, valid,
+                           taken.dictionary, taken.children)]
+        if isinstance(kid.type, T.RowType):
+            # array(row(...)) expands one column per field
+            row_col = cols[0]
+            out = []
+            for f in row_col.children:
+                fv = None if f.valid is None else np.asarray(f.valid)
+                rv = row_col.valid
+                valid = fv if rv is None else (
+                    rv if fv is None else fv & rv)
+                out.append(Column(f.type, f.values, valid, f.dictionary,
+                                  f.children))
+            return out
+        return cols
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+class UnnestOperatorFactory(OperatorFactory):
+    def __init__(self, replicate_channels: Sequence[int],
+                 unnest_channels: Sequence[int], ordinality: bool,
+                 outer: bool = False):
+        self.replicate_channels = list(replicate_channels)
+        self.unnest_channels = list(unnest_channels)
+        self.ordinality = ordinality
+        self.outer = outer
+
+    def create(self, ctx: OperatorContext) -> UnnestOperator:
+        return UnnestOperator(ctx, self.replicate_channels,
+                              self.unnest_channels, self.ordinality,
+                              self.outer)

@@ -1,0 +1,956 @@
+"""Array/map/row function implementations (host-side).
+
+The reference's array/map/lambda library lives in
+presto-main/.../operator/scalar/ (ArrayTransformFunction, MapKeys,
+ArrayDistinctFunction, ...).  Nested values here are host Columns
+(lengths + flattened children, batch.py); functions manipulate offsets
+host-side with vectorized numpy, and lambda bodies evaluate over the
+*flattened* child arrays — so ``transform(arr, x -> f(x))`` is one
+elementwise pass over the flat element vector (the TPU-friendly shape:
+no per-row loops; ragged structure only touches offset arithmetic).
+
+Calling convention (compile.py ``kind == "nested"``):
+``impl(args, valids, n, xp) -> (values, valid|None)`` where each arg is
+
+- a host Column for nested- and string-typed inputs (string Columns carry
+  their Dictionary; code comparisons always decode),
+- a Python scalar for compile-time constants,
+- a numpy array otherwise,
+
+and nested/string results are returned as Columns (string results intern
+into a per-call-site append-only Dictionary so codes stay stable across
+batches).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import (
+    Column, Dictionary, _range_gather_indices, column_from_pylist,
+    _concat_columns,
+)
+
+Pair = Tuple[Any, Optional[np.ndarray]]
+
+
+def _lengths(col: Column) -> np.ndarray:
+    return np.asarray(col.values, np.int64)
+
+
+def _offsets(col: Column) -> np.ndarray:
+    return np.concatenate([np.zeros(1, np.int64),
+                           np.cumsum(_lengths(col), dtype=np.int64)])
+
+
+def _rebuild(typ: T.Type, lengths: np.ndarray, kids: List[Column]) -> Column:
+    return Column(typ, np.asarray(lengths, np.int32), None, None, tuple(kids))
+
+
+def _row_ids(lengths: np.ndarray) -> np.ndarray:
+    """Flat-element -> parent-row index."""
+    return np.repeat(np.arange(lengths.shape[0], dtype=np.int64), lengths)
+
+
+def _and_all(*valids) -> Optional[np.ndarray]:
+    out = None
+    for v in valids:
+        if v is not None:
+            out = v if out is None else out & v
+    return out
+
+
+def _decoded(col: Column) -> np.ndarray:
+    """Column values in comparable form (strings decoded to objects)."""
+    kv = np.asarray(col.values)
+    if col.type.is_dictionary:
+        if len(col.dictionary) == 0:
+            return np.zeros(kv.shape[0], object)
+        return np.asarray(col.dictionary.values, dtype=object)[kv]
+    return kv
+
+
+def _needle_values(needle, n: int):
+    """Per-row comparable values for the searched element."""
+    if isinstance(needle, Column):
+        return _decoded(needle)
+    if isinstance(needle, np.ndarray):
+        return needle
+    return np.broadcast_to(np.asarray(needle, dtype=object if
+                                      isinstance(needle, str) else None), (n,))
+
+
+def _compare_values(kid: Column, needle, n: int,
+                    row_of: np.ndarray) -> np.ndarray:
+    """elementwise kid[i] == needle[row_of[i]] (NULL compares unequal)."""
+    kv = _decoded(kid)
+    nv = _needle_values(needle, n)
+    eq = kv == nv[row_of]
+    if kid.valid is not None:
+        eq = eq & np.asarray(kid.valid)
+    return eq
+
+
+def _take_kid(kid: Column, idx: np.ndarray) -> Column:
+    if idx.shape[0] == 0:
+        return kid.head(0)
+    return kid.take(idx)
+
+
+def _kid_result(kid: Column, n: int) -> Any:
+    """A child column as a nested-call result value."""
+    if kid.type.is_nested or kid.type.is_dictionary:
+        return kid
+    return kid.values
+
+
+# ---------------------------------------------------------------------------
+# basics
+# ---------------------------------------------------------------------------
+
+def cardinality(args, valids, n, xp) -> Pair:
+    (col,) = args
+    return _lengths(col).astype(np.int64), _and_all(*valids)
+
+
+def array_subscript(args, valids, n, xp) -> Pair:
+    """arr[i] / element_at(arr, i): 1-based; negative = from end;
+    out-of-range yields NULL (element_at semantics)."""
+    col, idx = args
+    lengths = _lengths(col)
+    offsets = _offsets(col)
+    idx = np.broadcast_to(np.asarray(idx, np.int64), (n,))
+    pos = np.where(idx < 0, lengths + idx, idx - 1)  # 0-based
+    ok = (pos >= 0) & (pos < lengths)
+    safe = np.where(ok, offsets[:-1] + np.clip(pos, 0, None), 0)
+    kid = col.children[0]
+    if kid.values.shape[0] == 0:
+        from presto_tpu.batch import empty_column
+
+        return _kid_result(empty_column(kid.type).pad(n), n), \
+            np.zeros(n, bool)
+    taken = kid.take(np.clip(safe, 0, kid.values.shape[0] - 1))
+    if taken.valid is not None:
+        ok = ok & np.asarray(taken.valid)
+    valid = _and_all(ok, *valids)
+    return _kid_result(taken.with_values(taken.values, None), n), valid
+
+
+def map_subscript(args, valids, n, xp) -> Pair:
+    """m[k] / element_at(m, k): NULL when the key is absent."""
+    col, key = args
+    lengths = _lengths(col)
+    row_of = _row_ids(lengths)
+    eq = _compare_values(col.children[0], key, n, row_of)
+    hit_rows = row_of[eq]
+    hit_pos = np.nonzero(eq)[0]
+    sel = np.zeros(n, np.int64)
+    found = np.zeros(n, bool)
+    sel[hit_rows] = hit_pos      # duplicate keys: last wins
+    found[hit_rows] = True
+    vcol = col.children[1]
+    if vcol.values.shape[0] == 0:
+        from presto_tpu.batch import empty_column
+
+        return _kid_result(empty_column(vcol.type).pad(n), n), \
+            np.zeros(n, bool)
+    taken = vcol.take(np.clip(sel, 0, vcol.values.shape[0] - 1))
+    if taken.valid is not None:
+        found = found & np.asarray(taken.valid)
+    valid = _and_all(found, *valids)
+    return _kid_result(taken.with_values(taken.values, None), n), valid
+
+
+def contains(args, valids, n, xp) -> Pair:
+    col, needle = args
+    row_of = _row_ids(_lengths(col))
+    eq = _compare_values(col.children[0], needle, n, row_of)
+    out = np.zeros(n, bool)
+    np.logical_or.at(out, row_of, eq)
+    return out, _and_all(*valids)
+
+
+def array_position(args, valids, n, xp) -> Pair:
+    col, needle = args
+    lengths = _lengths(col)
+    offsets = _offsets(col)
+    row_of = _row_ids(lengths)
+    eq = _compare_values(col.children[0], needle, n, row_of)
+    out = np.zeros(n, np.int64)
+    idx = np.nonzero(eq)[0][::-1]          # reverse so first match wins
+    rows = row_of[idx]
+    out[rows] = idx - offsets[rows] + 1    # 1-based; 0 when absent
+    return out, _and_all(*valids)
+
+
+def _minmax(col: Column, mode: str, n: int) -> Pair:
+    lengths = _lengths(col)
+    row_of = _row_ids(lengths)
+    kid = col.children[0]
+    kv = np.asarray(kid.values)
+    if kid.type.is_dictionary and len(kid.dictionary):
+        keyv = kid.dictionary.sort_ranks()[kv]
+    else:
+        keyv = kv
+    live = np.ones(kv.shape[0], bool) if kid.valid is None \
+        else np.asarray(kid.valid)
+    # a NULL element makes the result NULL (Presto array_min/max)
+    has_null_elem = np.zeros(n, bool)
+    np.logical_or.at(has_null_elem, row_of, ~live)
+    nonempty = lengths > 0
+    if kv.shape[0] == 0:
+        from presto_tpu.batch import empty_column
+
+        return _kid_result(empty_column(kid.type).pad(n), n), \
+            np.zeros(n, bool)
+    order = np.argsort(keyv, kind="stable")
+    if mode == "max":
+        order = order[::-1]
+    best = np.zeros(n, np.int64)
+    best[row_of[order[::-1]]] = order[::-1]   # best element wins last write
+    taken = kid.take(best)
+    valid = nonempty & ~has_null_elem
+    return _kid_result(taken.with_values(taken.values, None), n), valid
+
+
+def array_min(args, valids, n, xp) -> Pair:
+    out, valid = _minmax(args[0], "min", n)
+    return out, _and_all(valid, *valids)
+
+
+def array_max(args, valids, n, xp) -> Pair:
+    out, valid = _minmax(args[0], "max", n)
+    return out, _and_all(valid, *valids)
+
+
+# ---------------------------------------------------------------------------
+# restructuring
+# ---------------------------------------------------------------------------
+
+def array_concat(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        cols = list(args)
+        lengths = sum(_lengths(c) for c in cols)
+        kids, order_rows = [], []
+        for c in cols:
+            ln = _lengths(c)
+            idx = _range_gather_indices(_offsets(c)[:-1], ln)
+            kids.append(_take_kid(c.children[0], idx))
+            order_rows.append(np.repeat(np.arange(n), ln))
+        flat = _concat_columns(kids, [k.values.shape[0] for k in kids]) \
+            if len(kids) > 1 else kids[0]
+        rows_cat = np.concatenate(order_rows)
+        # stable sort by row groups each row's elements, inputs in arg order
+        flat = _take_kid(flat, np.argsort(rows_cat, kind="stable"))
+        return _rebuild(typ, lengths, [flat]), _and_all(*valids)
+
+    return impl
+
+
+def flatten(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        inner = col.children[0]            # array(E) column, flattened
+        outer_lengths = _lengths(col)
+        inner_lengths = _lengths(inner)
+        row_of_inner = _row_ids(outer_lengths)
+        out_lengths = np.zeros(n, np.int64)
+        np.add.at(out_lengths, row_of_inner, inner_lengths)
+        # elements are already stored in row-major order
+        return _rebuild(typ, out_lengths, [inner.children[0]]), \
+            _and_all(*valids)
+
+    return impl
+
+
+def array_reverse(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        lengths = _lengths(col)
+        offsets = _offsets(col)
+        total = int(offsets[-1])
+        ramp = np.arange(total, dtype=np.int64)
+        row_of = _row_ids(lengths)
+        within = ramp - offsets[row_of]
+        rev_idx = offsets[row_of] + (lengths[row_of] - 1 - within)
+        kid = _take_kid(col.children[0], rev_idx)
+        return _rebuild(typ, lengths, [kid]), _and_all(*valids)
+
+    return impl
+
+
+def array_distinct(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        lengths = _lengths(col)
+        row_of = _row_ids(lengths)
+        kid = col.children[0]
+        vals = _decoded(kid)
+        live = np.ones(vals.shape[0], bool) if kid.valid is None \
+            else np.asarray(kid.valid)
+        seen = set()
+        keep = np.ones(vals.shape[0], bool)
+        for i in range(vals.shape[0]):
+            key = (int(row_of[i]), vals[i] if live[i] else None,
+                   bool(live[i]))
+            if key in seen:
+                keep[i] = False
+            else:
+                seen.add(key)
+        new_lengths = np.zeros(n, np.int64)
+        np.add.at(new_lengths, row_of[keep], 1)
+        kid2 = _take_kid(kid, np.nonzero(keep)[0])
+        return _rebuild(typ, new_lengths, [kid2]), _and_all(*valids)
+
+    return impl
+
+
+def array_sort(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        lengths = _lengths(col)
+        row_of = _row_ids(lengths)
+        kid = col.children[0]
+        kv = np.asarray(kid.values)
+        if kid.type.is_dictionary and len(kid.dictionary):
+            keyv = kid.dictionary.sort_ranks()[kv]
+        else:
+            keyv = kv
+        live = np.ones(kv.shape[0], bool) if kid.valid is None \
+            else np.asarray(kid.valid)
+        # NULLS LAST within each row (Presto array_sort)
+        order = np.lexsort((keyv, ~live, row_of))
+        return _rebuild(typ, lengths, [_take_kid(kid, order)]), \
+            _and_all(*valids)
+
+    return impl
+
+
+def slice_fn(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        col, start, length = args
+        lengths = _lengths(col)
+        offsets = _offsets(col)
+        start = np.broadcast_to(np.asarray(start, np.int64), (n,))
+        length = np.clip(
+            np.broadcast_to(np.asarray(length, np.int64), (n,)), 0, None)
+        begin0 = np.where(start > 0, start - 1, lengths + start)  # 1-based
+        begin0 = np.clip(begin0, 0, lengths)
+        count = np.clip(length, 0, lengths - begin0)
+        idx = _range_gather_indices(offsets[:-1] + begin0, count)
+        kid = _take_kid(col.children[0], idx)
+        return _rebuild(typ, count, [kid]), _and_all(*valids)
+
+    return impl
+
+
+def array_remove(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        col, needle = args
+        lengths = _lengths(col)
+        row_of = _row_ids(lengths)
+        eq = _compare_values(col.children[0], needle, n, row_of)
+        keep = ~eq
+        new_lengths = np.zeros(n, np.int64)
+        np.add.at(new_lengths, row_of[keep], 1)
+        kid = _take_kid(col.children[0], np.nonzero(keep)[0])
+        return _rebuild(typ, new_lengths, [kid]), _and_all(*valids)
+
+    return impl
+
+
+def set_op(typ: T.Type, mode: str):
+    """array_intersect / array_union / array_except (distinct results)."""
+
+    def impl(args, valids, n, xp) -> Pair:
+        per_row: List[List[set]] = []
+        for col in args:
+            acc = [set() for _ in range(n)]
+            lengths = _lengths(col)
+            row_of = _row_ids(lengths)
+            vals = col.children[0].to_pylist(int(lengths.sum()))
+            for i, v in zip(row_of, vals):
+                acc[i].add(v)
+            per_row.append(acc)
+        a_rows, b_rows = per_row
+        out: List[Any] = []
+        for i in range(n):
+            if mode == "intersect":
+                s = a_rows[i] & b_rows[i]
+            elif mode == "union":
+                s = a_rows[i] | b_rows[i]
+            else:
+                s = a_rows[i] - b_rows[i]
+            out.append(sorted(s, key=lambda v: (v is None, str(v))))
+        col = column_from_pylist(typ, out)
+        return Column(typ, col.values, None, None, col.children), \
+            _and_all(*valids)
+
+    return impl
+
+
+def arrays_overlap():
+    def impl(args, valids, n, xp) -> Pair:
+        a, b = args
+        out = np.zeros(n, bool)
+        sets_a = [set() for _ in range(n)]
+        la = _lengths(a)
+        vals_a = a.children[0].to_pylist(int(la.sum()))
+        for i, v in zip(_row_ids(la), vals_a):
+            if v is not None:
+                sets_a[i].add(v)
+        lb = _lengths(b)
+        vals_b = b.children[0].to_pylist(int(lb.sum()))
+        for i, v in zip(_row_ids(lb), vals_b):
+            if v is not None and v in sets_a[i]:
+                out[i] = True
+        return out, _and_all(*valids)
+
+    return impl
+
+
+def repeat_fn(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        elem, count = args
+        count = np.clip(
+            np.broadcast_to(np.asarray(count, np.int64), (n,)), 0, None)
+        idx = np.repeat(np.arange(n, dtype=np.int64), count)
+        elem_valid = valids[0]
+        if isinstance(elem, Column):
+            kid = _take_kid(elem, idx)
+            if elem_valid is not None:
+                ev = np.asarray(elem_valid)[idx]
+                kid = kid.with_values(
+                    kid.values,
+                    ev if kid.valid is None else np.asarray(kid.valid) & ev)
+        else:
+            ev = np.broadcast_to(np.asarray(elem), (n,))
+            kid_valid = None if elem_valid is None \
+                else np.asarray(elem_valid)[idx]
+            if typ.element.is_dictionary:
+                d = Dictionary()
+                codes = np.asarray(
+                    [d.intern(str(v)) for v in ev[idx]], np.int32) \
+                    if idx.shape[0] else np.zeros(0, np.int32)
+                kid = Column(typ.element, codes, kid_valid, d)
+            else:
+                kid = Column(typ.element,
+                             np.asarray(ev[idx], typ.element.np_dtype),
+                             kid_valid)
+        return _rebuild(typ, count, [kid]), valids[1]
+
+    return impl
+
+
+def sequence_fn(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        start = np.broadcast_to(np.asarray(args[0], np.int64), (n,))
+        stop = np.broadcast_to(np.asarray(args[1], np.int64), (n,))
+        if len(args) > 2:
+            step = np.broadcast_to(np.asarray(args[2], np.int64), (n,))
+        else:
+            step = np.where(stop >= start, 1, -1).astype(np.int64)
+        count = np.maximum((stop - start) // step + 1, 0)
+        total = int(count.sum())
+        flat_row = np.repeat(np.arange(n, dtype=np.int64), count)
+        ends = np.cumsum(count)
+        within = np.arange(total, dtype=np.int64) - \
+            np.repeat(ends - count, count)
+        flat = start[flat_row] + within * step[flat_row]
+        return _rebuild(typ, count, [Column(T.BIGINT, flat)]), \
+            _and_all(*valids)
+
+    return impl
+
+
+# ---------------------------------------------------------------------------
+# strings <-> arrays
+# ---------------------------------------------------------------------------
+
+def array_join():
+    out_dict = Dictionary()  # per call site; append-only => stable codes
+
+    def impl(args, valids, n, xp) -> Pair:
+        col = args[0]
+        delim = args[1] if isinstance(args[1], str) else ""
+        null_repl = args[2] if len(args) > 2 else None
+        lengths = _lengths(col)
+        row_of = _row_ids(lengths)
+        vals = col.children[0].to_pylist(int(lengths.sum()))
+        parts: List[List[str]] = [[] for _ in range(n)]
+        for i, v in zip(row_of, vals):
+            if v is None:
+                if null_repl is not None:
+                    parts[i].append(str(null_repl))
+            else:
+                parts[i].append(str(v))
+        codes = np.asarray([out_dict.intern(delim.join(p)) for p in parts],
+                           np.int32)
+        return Column(T.VARCHAR, codes, None, out_dict), _and_all(*valids)
+
+    return impl
+
+
+def split_fn(typ: T.Type):
+    """split(string, delim [, limit]) -> array(varchar)."""
+
+    def impl(args, valids, n, xp) -> Pair:
+        src = args[0]
+        delim = args[1]
+        limit = None if len(args) < 3 else int(np.asarray(args[2]).flat[0])
+
+        def split_one(s: str) -> List[str]:
+            return s.split(delim) if limit is None \
+                else s.split(delim, limit - 1)
+
+        if isinstance(src, str):       # constant input
+            lists = [split_one(src)] * n
+        else:
+            per_entry = {}
+            codes = np.asarray(src.values)
+            dvals = src.dictionary.values
+            lists = []
+            for c in codes:
+                c = int(c)
+                if c not in per_entry:
+                    per_entry[c] = split_one(dvals[c]) \
+                        if 0 <= c < len(dvals) else []
+                lists.append(per_entry[c])
+        col = column_from_pylist(typ, lists)
+        return Column(typ, col.values, None, None, col.children), \
+            _and_all(*valids)
+
+    return impl
+
+
+# ---------------------------------------------------------------------------
+# maps
+# ---------------------------------------------------------------------------
+
+def map_from_arrays(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        kcol, vcol = args
+        klen = _lengths(kcol)
+        vlen = _lengths(vcol)
+        ok = klen == vlen       # mismatched lengths -> NULL map
+        return _rebuild(typ, np.where(ok, klen, 0),
+                        [kcol.children[0], vcol.children[0]]), \
+            _and_all(ok, *valids)
+
+    return impl
+
+
+def map_keys(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        return _rebuild(typ, _lengths(col), [col.children[0]]), \
+            _and_all(*valids)
+
+    return impl
+
+
+def map_values(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        return _rebuild(typ, _lengths(col), [col.children[1]]), \
+            _and_all(*valids)
+
+    return impl
+
+
+def map_concat(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        rows: List[dict] = [{} for _ in range(n)]
+        for col in args:           # later maps win on key collisions
+            lengths = _lengths(col)
+            row_of = _row_ids(lengths)
+            total = int(lengths.sum())
+            ks = col.children[0].to_pylist(total)
+            vs = col.children[1].to_pylist(total)
+            for i, k, v in zip(row_of, ks, vs):
+                rows[i][k] = v
+        col = column_from_pylist(typ, rows)
+        return Column(typ, col.values, None, None, col.children), \
+            _and_all(*valids)
+
+    return impl
+
+
+def map_from_entries(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args                   # array(row(k, v))
+        row_kid = col.children[0]
+        k, v = row_kid.children
+        return _rebuild(typ, _lengths(col), [k, v]), _and_all(*valids)
+
+    return impl
+
+
+def rows_extreme_by(mode: str):
+    """x at the min/max y over array(row(x, y)) (min_by/max_by finalize)."""
+
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        lengths = _lengths(col)
+        row_of = _row_ids(lengths)
+        xcol, ycol = col.children[0].children
+        yv = np.asarray(ycol.values)
+        if ycol.type.is_dictionary and len(ycol.dictionary):
+            keyv = ycol.dictionary.sort_ranks()[yv]
+        else:
+            keyv = yv
+        live = np.ones(yv.shape[0], bool) if ycol.valid is None \
+            else np.asarray(ycol.valid)
+        if yv.shape[0] == 0:
+            from presto_tpu.batch import empty_column
+
+            return _kid_result(empty_column(xcol.type).pad(n), n), \
+                np.zeros(n, bool)
+        order = np.argsort(keyv, kind="stable")
+        if mode == "max_by":
+            order = order[::-1]
+        order = order[live[order]]      # null y never wins
+        best = np.zeros(n, np.int64)
+        seen = np.zeros(n, bool)
+        best[row_of[order[::-1]]] = order[::-1]
+        seen[row_of[order]] = True
+        taken = xcol.take(best)
+        valid = seen
+        if taken.valid is not None:
+            valid = valid & np.asarray(taken.valid)
+        return _kid_result(taken.with_values(taken.values, None), n), \
+            _and_all(valid, *valids)
+
+    return impl
+
+
+def array_percentile(p: float):
+    """Exact percentile of collected values (approx_percentile finalize;
+    exact beats the reference's qdigest error bound)."""
+
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        lengths = _lengths(col)
+        offsets = _offsets(col)
+        kid = col.children[0]
+        kv = np.asarray(kid.values)
+        live = np.ones(kv.shape[0], bool) if kid.valid is None \
+            else np.asarray(kid.valid)
+        out = np.zeros(n, kid.type.np_dtype)
+        ok = np.zeros(n, bool)
+        for i in range(n):
+            lo, hi = int(offsets[i]), int(offsets[i + 1])
+            vals = kv[lo:hi][live[lo:hi]]
+            if vals.shape[0] == 0:
+                continue
+            vals = np.sort(vals)
+            idx = min(int(np.ceil(p * vals.shape[0])) - 1,
+                      vals.shape[0] - 1)
+            out[i] = vals[max(idx, 0)]
+            ok[i] = True
+        return out, _and_all(ok, *valids)
+
+    return impl
+
+
+def rows_statistic(stat: str):
+    """corr / covar_samp / covar_pop / regr_slope / regr_intercept over
+    collected array(row(y, x)) pairs (AggregationUtils formulas in the
+    reference's DoubleCovarianceAggregation / DoubleRegressionAggregation).
+    """
+
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        lengths = _lengths(col)
+        offsets = _offsets(col)
+        ycol, xcol = col.children[0].children
+        yv = np.asarray(ycol.values, np.float64)
+        xv = np.asarray(xcol.values, np.float64)
+        live = np.ones(yv.shape[0], bool)
+        if ycol.valid is not None:
+            live &= np.asarray(ycol.valid)
+        if xcol.valid is not None:
+            live &= np.asarray(xcol.valid)
+        out = np.zeros(n, np.float64)
+        ok = np.zeros(n, bool)
+        for i in range(n):
+            lo, hi = int(offsets[i]), int(offsets[i + 1])
+            m = live[lo:hi]
+            y = yv[lo:hi][m]
+            x = xv[lo:hi][m]
+            cnt = x.shape[0]
+            if cnt == 0:
+                continue
+            mx, my = x.mean(), y.mean()
+            cxy = ((x - mx) * (y - my)).sum()
+            cxx = ((x - mx) ** 2).sum()
+            cyy = ((y - my) ** 2).sum()
+            if stat == "covar_pop":
+                out[i] = cxy / cnt
+                ok[i] = True
+            elif stat == "covar_samp":
+                if cnt > 1:
+                    out[i] = cxy / (cnt - 1)
+                    ok[i] = True
+            elif stat == "corr":
+                if cxx > 0 and cyy > 0:
+                    out[i] = cxy / np.sqrt(cxx * cyy)
+                    ok[i] = True
+            elif stat == "regr_slope":
+                if cxx > 0:
+                    out[i] = cxy / cxx
+                    ok[i] = True
+            elif stat == "regr_intercept":
+                if cxx > 0:
+                    out[i] = my - (cxy / cxx) * mx
+                    ok[i] = True
+        return out, _and_all(ok, *valids)
+
+    return impl
+
+
+# ---------------------------------------------------------------------------
+# rows
+# ---------------------------------------------------------------------------
+
+def row_field(field_index: int):
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        kid = col.children[field_index]
+        kid_valid = None if kid.valid is None else np.asarray(kid.valid)
+        valid = _and_all(kid_valid, *valids)
+        return _kid_result(kid.with_values(kid.values, None), n), valid
+
+    return impl
+
+
+def array_constructor(typ: T.Type, k: int):
+    """ARRAY[e1, ..., ek]: k element expressions -> length-k rows.
+
+    NULL elements stay as null entries; the array itself is never NULL.
+    """
+
+    def impl(args, valids, n, xp) -> Pair:
+        if k == 0:
+            from presto_tpu.batch import empty_column
+
+            return _rebuild(typ, np.zeros(n, np.int64),
+                            [empty_column(typ.element)]), None
+        kids = []
+        for v, vv in zip(args, valids):
+            if isinstance(v, Column):
+                kid = Column(typ.element, v.values, vv, v.dictionary,
+                             v.children)
+            elif isinstance(v, str):
+                kid = Column(typ.element, np.zeros(n, np.int32), vv,
+                             Dictionary([v]))
+            else:
+                vals = np.broadcast_to(
+                    np.asarray(v), (n,)).astype(typ.element.np_dtype)
+                kid = Column(typ.element, vals, vv)
+            kids.append(kid)
+        flat = _concat_columns(kids, [n] * k) if k > 1 else kids[0]
+        # concat layout is [j*n + i]; rows need [i*k + j]
+        g = (np.arange(k)[None, :] * n
+             + np.arange(n)[:, None]).ravel().astype(np.int64)
+        flat = _take_kid(flat, g)
+        return _rebuild(typ, np.full(n, k, np.int64), [flat]), None
+
+    return impl
+
+
+def row_constructor(typ: T.Type):
+    def impl(args, valids, n, xp) -> Pair:
+        kids = []
+        for ft, v, vv in zip(typ.field_types, args, valids):
+            if isinstance(v, Column):
+                kid = Column(ft, v.values, vv, v.dictionary, v.children)
+            elif isinstance(v, str):
+                d = Dictionary([v])
+                kid = Column(ft, np.zeros(n, np.int32), vv, d)
+            else:
+                vals = np.broadcast_to(
+                    np.asarray(v, ft.np_dtype), (n,)).copy()
+                kid = Column(ft, vals, vv)
+            kids.append(kid)
+        return Column(typ, np.zeros(n, np.int8), None, None, tuple(kids)), \
+            None
+
+    return impl
+
+
+# ---------------------------------------------------------------------------
+# lambdas (ArrayTransformFunction / ArrayFilterFunction / ReduceFunction /
+# MapFilter / TransformValues analogues).  ``body`` is a runtime evaluator
+# built by compile.py: body(pairs, n_elems) -> (values, valid) over the
+# FLATTENED element domain, with outer captures repeated per element.
+# ---------------------------------------------------------------------------
+
+def transform(typ: T.Type):
+    def impl(args, valids, n, xp, lambdas=None) -> Pair:
+        col = args[0]
+        body = lambdas[0]
+        lengths = _lengths(col)
+        kid = col.children[0]
+        total = int(lengths.sum())
+        out_vals, out_valid = body([kid], _row_ids(lengths), total)
+        new_kid = _kid_from_value(typ.element, out_vals, out_valid)
+        return _rebuild(typ, lengths, [new_kid]), _and_all(*valids)
+
+    return impl
+
+
+def filter_fn(typ: T.Type):
+    def impl(args, valids, n, xp, lambdas=None) -> Pair:
+        col = args[0]
+        body = lambdas[0]
+        lengths = _lengths(col)
+        kid = col.children[0]
+        total = int(lengths.sum())
+        row_of = _row_ids(lengths)
+        keep_vals, keep_valid = body([kid], row_of, total)
+        keep = np.asarray(keep_vals, bool)
+        if keep_valid is not None:               # NULL predicate drops
+            keep = keep & np.asarray(keep_valid)
+        new_lengths = np.zeros(n, np.int64)
+        np.add.at(new_lengths, row_of[keep], 1)
+        kid2 = _take_kid(kid, np.nonzero(keep)[0])
+        return _rebuild(typ, new_lengths, [kid2]), _and_all(*valids)
+
+    return impl
+
+
+def map_filter(typ: T.Type):
+    def impl(args, valids, n, xp, lambdas=None) -> Pair:
+        col = args[0]
+        body = lambdas[0]
+        lengths = _lengths(col)
+        kcol, vcol = col.children
+        total = int(lengths.sum())
+        row_of = _row_ids(lengths)
+        keep_vals, keep_valid = body([kcol, vcol], row_of, total)
+        keep = np.asarray(keep_vals, bool)
+        if keep_valid is not None:
+            keep = keep & np.asarray(keep_valid)
+        new_lengths = np.zeros(n, np.int64)
+        np.add.at(new_lengths, row_of[keep], 1)
+        idx = np.nonzero(keep)[0]
+        return _rebuild(typ, new_lengths,
+                        [_take_kid(kcol, idx), _take_kid(vcol, idx)]), \
+            _and_all(*valids)
+
+    return impl
+
+
+def transform_values(typ: T.Type):
+    def impl(args, valids, n, xp, lambdas=None) -> Pair:
+        col = args[0]
+        body = lambdas[0]
+        lengths = _lengths(col)
+        kcol, vcol = col.children
+        total = int(lengths.sum())
+        out_vals, out_valid = body([kcol, vcol], _row_ids(lengths), total)
+        new_v = _kid_from_value(typ.value, out_vals, out_valid)
+        return _rebuild(typ, lengths, [kcol, new_v]), _and_all(*valids)
+
+    return impl
+
+
+def transform_keys(typ: T.Type):
+    def impl(args, valids, n, xp, lambdas=None) -> Pair:
+        col = args[0]
+        body = lambdas[0]
+        lengths = _lengths(col)
+        kcol, vcol = col.children
+        total = int(lengths.sum())
+        out_vals, out_valid = body([kcol, vcol], _row_ids(lengths), total)
+        new_k = _kid_from_value(typ.key, out_vals, out_valid)
+        return _rebuild(typ, lengths, [new_k, vcol]), _and_all(*valids)
+
+    return impl
+
+
+def reduce_fn(result_type: T.Type):
+    """reduce(array, init, (state, x) -> ..., state -> ...).
+
+    The combine lambda folds sequentially *within* a row but all rows fold
+    in lockstep: iteration k combines every row's state with its k-th
+    element at once — max(lengths) vectorized passes instead of
+    total-elements scalar steps.
+    """
+
+    def impl(args, valids, n, xp, lambdas=None) -> Pair:
+        col, init = args[0], args[1]
+        combine, finish = lambdas
+        lengths = _lengths(col)
+        offsets = _offsets(col)
+        kid = col.children[0]
+        if isinstance(init, Column):
+            raise NotImplementedError("nested reduce state")
+        state = np.broadcast_to(np.asarray(init), (n,)).copy()
+        state_valid = None if valids[1] is None else valids[1].copy()
+        kmax = int(lengths.max()) if n else 0
+        for k in range(kmax):
+            rows = np.nonzero(lengths > k)[0]
+            elem_idx = offsets[rows] + k
+            elem = kid.take(elem_idx)
+            state_col = Column(T.DOUBLE if state.dtype.kind == "f"
+                               else T.BIGINT, state[rows],
+                               None if state_valid is None
+                               else state_valid[rows])
+            out_vals, out_valid = combine([state_col, elem],
+                                          rows, rows.shape[0])
+            state[rows] = np.asarray(out_vals)
+            if out_valid is not None:
+                if state_valid is None:
+                    state_valid = np.ones(n, bool)
+                state_valid[rows] = np.asarray(out_valid)
+        final_col = Column(T.DOUBLE if state.dtype.kind == "f"
+                           else T.BIGINT, state,
+                           None if state_valid is None else state_valid)
+        out_vals, out_valid = finish([final_col],
+                                     np.arange(n, dtype=np.int64), n)
+        return out_vals, _and_all(out_valid, valids[0])
+
+    return impl
+
+
+def any_all_none_match(mode: str):
+    def impl(args, valids, n, xp, lambdas=None) -> Pair:
+        col = args[0]
+        body = lambdas[0]
+        lengths = _lengths(col)
+        kid = col.children[0]
+        total = int(lengths.sum())
+        row_of = _row_ids(lengths)
+        mvals, mvalid = body([kid], row_of, total)
+        m = np.asarray(mvals, bool)
+        if mvalid is not None:
+            m = m & np.asarray(mvalid)
+        hit = np.zeros(n, bool)
+        np.logical_or.at(hit, row_of, m)
+        if mode == "any":
+            out = hit
+        elif mode == "all":
+            miss = np.zeros(n, bool)
+            np.logical_or.at(miss, row_of, ~m)
+            out = ~miss
+        else:
+            out = ~hit
+        return out, _and_all(*valids)
+
+    return impl
+
+
+def _kid_from_value(typ: T.Type, values, valid) -> Column:
+    if isinstance(values, Column):
+        return Column(typ, values.values, valid, values.dictionary,
+                      values.children)
+    if typ.is_dictionary:
+        # lambda over strings produced raw codes + dictionary is carried on
+        # the Column; a bare code array cannot appear here
+        raise NotImplementedError("string lambda results need a dictionary")
+    return Column(typ, np.asarray(values), valid)

@@ -32,7 +32,9 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu.batch import Batch, Column, Dictionary
 from presto_tpu.expr import functions as F
-from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression, SpecialForm
+from presto_tpu.expr.ir import (
+    Call, Constant, InputRef, LambdaExpr, RowExpression, SpecialForm, VarRef,
+)
 
 Pair = Tuple[Any, Optional[Any]]  # (values, valid|None)
 
@@ -67,14 +69,23 @@ def _filled(xp, values, valid, fill):
 
 
 class ExprCompiler:
-    def __init__(self, dictionaries: Dict[int, Dictionary]):
+    def __init__(self, dictionaries: Dict[int, Dictionary],
+                 vars: Optional[Dict[str, CompiledExpr]] = None):
         self.dicts = dictionaries
+        self.vars = vars or {}
 
     def compile(self, expr: RowExpression) -> CompiledExpr:
         if isinstance(expr, InputRef):
             return self._input(expr)
         if isinstance(expr, Constant):
             return self._constant(expr)
+        if isinstance(expr, VarRef):
+            bound = self.vars.get(expr.name)
+            if bound is None:
+                raise ValueError(f"unbound lambda variable {expr.name}")
+            return bound
+        if isinstance(expr, LambdaExpr):
+            raise ValueError("lambda outside an array/map function call")
         if isinstance(expr, Call):
             return self._call(expr)
         if isinstance(expr, SpecialForm):
@@ -96,6 +107,14 @@ class ExprCompiler:
     def _constant(self, expr: Constant) -> CompiledExpr:
         t = expr.type
         if expr.value is None:
+            if t.is_nested:
+                def run(cols, n, xp):
+                    from presto_tpu.batch import empty_column
+
+                    nn = _rowcount(cols, n, xp)
+                    return empty_column(t).pad(nn), np.zeros(nn, bool)
+
+                return CompiledExpr(t, run)
             dt = t.np_dtype
 
             def run(cols, n, xp):
@@ -125,6 +144,8 @@ class ExprCompiler:
         fn: F.Scalar = expr.fn
         if fn is None:
             raise ValueError(f"unresolved call {expr.name}")
+        if fn.kind == "nested":  # before arg compile: lambdas aren't exprs
+            return self._nested_call(expr, fn)
         cargs = [self.compile(a) for a in expr.args]
         if fn.null_mode == "is_null":
             (a,) = cargs
@@ -132,7 +153,7 @@ class ExprCompiler:
             def run(cols, n, xp):
                 v, valid = a.run(cols, n, xp)
                 if valid is None:
-                    return xp.zeros(v.shape[0], bool), None
+                    return xp.zeros(_value_len(v), bool), None
                 return ~valid, None
 
             return CompiledExpr(T.BOOLEAN, run)
@@ -142,10 +163,23 @@ class ExprCompiler:
             def run(cols, n, xp):
                 v, valid = a.run(cols, n, xp)
                 if valid is None:
-                    return xp.ones(v.shape[0], bool), None
+                    return xp.ones(_value_len(v), bool), None
                 return valid, None
 
             return CompiledExpr(T.BOOLEAN, run)
+        if fn.null_mode == "hash64":
+            types = [a.type for a in expr.args]
+
+            def run(cols, n, xp):
+                from presto_tpu.ops.hashing import row_hash
+
+                triples = []
+                for c, ty in zip(cargs, types):
+                    v, valid = c.run(cols, n, xp)
+                    triples.append((v, valid, ty))
+                return row_hash(triples).astype("int64"), None
+
+            return CompiledExpr(T.BIGINT, run)
         if fn.kind == "string":
             return self._string_call(expr, fn, cargs)
         impl = fn.impl
@@ -174,6 +208,50 @@ class ExprCompiler:
 
         return CompiledExpr(fn.result_type, run)
 
+    def _nested_call(self, expr: Call, fn: F.Scalar) -> CompiledExpr:
+        """Array/map/row functions: host-side over offsets + flat children.
+
+        Lambda arguments become runtime body evaluators over the flattened
+        element domain; outer captures are repeated per element (the
+        ArrayTransformFunction shape, presto-main/.../operator/scalar/).
+        """
+        value_nodes = [a for a in expr.args
+                       if not isinstance(a, LambdaExpr)]
+        lambda_nodes = [a for a in expr.args if isinstance(a, LambdaExpr)]
+        cvals = [self.compile(a) for a in value_nodes]
+        impl = fn.impl
+        rt = fn.result_type
+        out_dict = getattr(fn, "out_dictionary", None)
+        compiler = self
+
+        def as_arg(c: CompiledExpr, v):
+            if isinstance(v, Column):
+                return v
+            if c.const_str is not None:
+                return c.const_str
+            if c.type.is_dictionary:
+                return Column(c.type, np.asarray(v), None, c.dictionary)
+            return np.asarray(v)
+
+        def run(cols, n, xp):
+            # nested evaluation is host-side by design (strings/offsets);
+            # heavy flat-child math still vectorizes through numpy/XLA-cpu
+            host_cols = [(_host_value(v), None if valid is None
+                          else np.asarray(valid)) for v, valid in cols]
+            args, valids = [], []
+            for c in cvals:
+                v, valid = c.run(host_cols, n, np)
+                args.append(as_arg(c, v))
+                valids.append(valid)
+            if lambda_nodes:
+                lambdas = [
+                    _LambdaEvaluator(lam, compiler, host_cols, n)
+                    for lam in lambda_nodes]
+                return impl(args, valids, n, np, lambdas=lambdas)
+            return impl(args, valids, n, np)
+
+        return CompiledExpr(rt, run, dictionary=out_dict)
+
     def _string_call(self, expr: Call, fn: F.Scalar,
                      cargs: List[CompiledExpr]) -> CompiledExpr:
         """Host-side per-dictionary-entry evaluation, device gather."""
@@ -187,16 +265,17 @@ class ExprCompiler:
             elif isinstance(node, Constant):
                 const_vals.append(node.value)
             elif ca.type.is_dictionary:
-                if dict_arg_idx is not None:
-                    raise NotImplementedError(
-                        "string functions over multiple string columns are "
-                        "not yet supported on device")
+                if dict_arg_idx is not None or ca.dictionary is None:
+                    # several string columns, or a runtime-built dictionary
+                    # (cast-to-varchar / array_join): evaluate row-wise on
+                    # the host instead of per-dictionary-entry
+                    return self._string_host_call(fn, cargs)
                 dict_arg_idx = i
                 const_vals.append(None)
             else:
-                raise NotImplementedError(
-                    f"string function {fn.name} with non-constant non-string "
-                    "argument")
+                # non-constant non-string argument (e.g. strpos(s, col)):
+                # host row-wise fallback
+                return self._string_host_call(fn, cargs)
         if dict_arg_idx is None:
             # all-constant: fold at compile time
             result = fn.impl(*const_vals)
@@ -249,6 +328,62 @@ class ExprCompiler:
             return out, valid
 
         return CompiledExpr(rt, run)
+
+    def _string_host_call(self, fn: F.Scalar,
+                          cargs: List[CompiledExpr]) -> CompiledExpr:
+        """Row-wise host evaluation of a string function (used when the
+        per-dictionary-entry binding can't apply: several string columns,
+        runtime dictionaries, or non-constant non-string arguments).
+        Results intern into a per-call-site append-only dictionary."""
+        rt = fn.result_type
+        impl = fn.impl
+        out_dict = Dictionary() if rt.is_dictionary else None
+
+        def decode(c: CompiledExpr, v, valid, n):
+            if isinstance(v, Column):
+                return v.to_pylist(n) if v.type.is_dictionary \
+                    or v.type.is_nested else list(np.asarray(v.values)[:n])
+            if c.const_str is not None:
+                return [c.const_str] * n
+            v = np.asarray(v)
+            if c.type.is_dictionary:
+                d = c.dictionary
+                return [d.values[int(x)] if 0 <= int(x) < len(d) else None
+                        for x in v[:n]]
+            return [c.type.to_python(x) for x in v[:n]]
+
+        def run(cols, n, xp):
+            host_cols = [(_host_value(v), None if valid is None
+                          else np.asarray(valid)) for v, valid in cols]
+            nn = _rowcount(host_cols, n, np)
+            arg_lists = []
+            valid_all = None
+            for c in cargs:
+                v, valid = c.run(host_cols, nn, np)
+                arg_lists.append(decode(c, v, valid, nn))
+                valid_all = _and_valid(np, valid_all,
+                                       None if valid is None
+                                       else np.asarray(valid))
+            live = np.ones(nn, bool) if valid_all is None else valid_all
+            ok = live.copy()
+            if out_dict is not None:
+                out = np.zeros(nn, np.int32)
+            else:
+                out = np.zeros(nn, rt.np_dtype)
+            for i in range(nn):
+                if not live[i]:
+                    continue
+                res = impl(*(al[i] for al in arg_lists))
+                if res is None:
+                    ok[i] = False
+                elif out_dict is not None:
+                    out[i] = out_dict.intern(res)
+                else:
+                    out[i] = res
+            valid = None if bool(ok.all()) else ok
+            return out, valid
+
+        return CompiledExpr(rt, run, dictionary=out_dict)
 
     # -- special forms ---------------------------------------------------
     def _special(self, expr: SpecialForm) -> CompiledExpr:
@@ -380,10 +515,69 @@ class ExprCompiler:
         return CompiledExpr(T.BOOLEAN, run)
 
 
+def _value_len(v) -> int:
+    return v.values.shape[0] if isinstance(v, Column) else v.shape[0]
+
+
 def _rowcount(cols, n, xp):
     for v, _ in cols:
-        return v.shape[0]
+        return _value_len(v)
     return n
+
+
+def _host_value(v):
+    if isinstance(v, Column):
+        return v.to_numpy()
+    return np.asarray(v)
+
+
+class _LambdaEvaluator:
+    """Runtime evaluator for a lambda body over flattened elements.
+
+    ``__call__(child_cols, row_of, total)``: child_cols are the parameter
+    bindings (host Columns aligned to the flat element domain), row_of maps
+    each element to its parent row (for repeating outer captures), total is
+    the element count.  Returns the body's (values, valid).
+    """
+
+    def __init__(self, lam: LambdaExpr, outer: "ExprCompiler",
+                 outer_cols, n: int):
+        self.lam = lam
+        self.outer = outer
+        self.outer_cols = outer_cols
+        self.n = n
+
+    def __call__(self, child_cols, row_of, total):
+        lam = self.lam
+        vars: Dict[str, CompiledExpr] = dict(self.outer.vars)
+        for name, ptyp, ccol in zip(lam.params, lam.param_types, child_cols):
+            pair = _child_pair(ccol)
+            d = ccol.dictionary if ptyp.is_dictionary else None
+
+            def make_run(p):
+                return lambda cols, n, xp: p
+
+            vars[name] = CompiledExpr(ptyp, make_run(pair), dictionary=d)
+        # outer captures: repeat per element
+        expanded = []
+        for v, valid in self.outer_cols:
+            if isinstance(v, Column):
+                ev = v.take(row_of)
+            else:
+                ev = np.asarray(v)[row_of]
+            evalid = None if valid is None else np.asarray(valid)[row_of]
+            expanded.append((ev, evalid))
+        sub = ExprCompiler(self.outer.dicts, vars=vars)
+        compiled = sub.compile(lam.body)
+        return compiled.run(expanded, total, np)
+
+
+def _child_pair(ccol: Column):
+    """A child Column as a (values, valid) pair for the body compiler."""
+    valid = None if ccol.valid is None else np.asarray(ccol.valid)
+    if ccol.type.is_nested:
+        return (ccol.with_values(ccol.values, None), valid)
+    return (np.asarray(ccol.values), valid)
 
 
 # ---------------------------------------------------------------------------
@@ -401,9 +595,59 @@ def batch_dictionaries(batch: Batch) -> Dict[int, Dictionary]:
             if c.dictionary is not None}
 
 
+def needs_host_path(exprs: Sequence[RowExpression]) -> bool:
+    """True when any expression touches nested types: those evaluate
+    host-side (offset bookkeeping + flat-child math), so the enclosing
+    operator must not jit-trace the column arrays."""
+    from presto_tpu.expr.ir import walk
+
+    for expr in exprs:
+        if expr is None:
+            continue
+        for e in walk(expr):
+            ty = getattr(e, "type", None)
+            if ty is not None and T.is_nested(ty):
+                return True
+            fn = getattr(e, "fn", None)
+            if fn is None:
+                continue
+            if getattr(fn, "kind", None) == "nested":
+                return True
+            if getattr(fn, "kind", None) == "string":
+                # row-wise host fallback cases (see _string_call)
+                str_cols = sum(
+                    1 for a in e.args
+                    if a.type.is_dictionary and not isinstance(a, Constant))
+                other_nonconst = any(
+                    not a.type.is_dictionary and not isinstance(a, Constant)
+                    for a in e.args)
+                if str_cols > 1 or other_nonconst:
+                    return True
+    return False
+
+
+def batch_pairs(batch: Batch) -> List[Pair]:
+    """Input-channel pairs for compiled expressions (nested as Columns)."""
+    cols: List[Pair] = []
+    for c in batch.columns:
+        if c.type.is_nested:
+            nc = c.to_numpy()
+            cols.append((Column(nc.type, nc.values, None, nc.dictionary,
+                                nc.children), nc.valid))
+        else:
+            cols.append((c.values, c.valid))
+    return cols
+
+
+def result_column(compiled: CompiledExpr, values, valid) -> Column:
+    if isinstance(values, Column):
+        return Column(values.type, values.values, valid,
+                      values.dictionary, values.children)
+    return Column(compiled.type, values, valid, compiled.dictionary)
+
+
 def evaluate(expr: RowExpression, batch: Batch, xp=np) -> Column:
     """Interpret one expression over a Batch (the oracle path)."""
     compiled = compile_expr(expr, batch_dictionaries(batch))
-    cols = [(c.values, c.valid) for c in batch.columns]
-    values, valid = compiled.run(cols, batch.num_rows, xp)
-    return Column(compiled.type, values, valid, compiled.dictionary)
+    values, valid = compiled.run(batch_pairs(batch), batch.num_rows, xp)
+    return result_column(compiled, values, valid)

@@ -83,11 +83,42 @@ class SpecialForm(RowExpression):
         return f"{self.form}({', '.join(map(str, self.args))})"
 
 
+@dataclasses.dataclass(frozen=True)
+class VarRef(RowExpression):
+    """Reference to a lambda parameter (VariableReferenceExpression)."""
+
+    name: str
+    type: T.Type
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class LambdaExpr(RowExpression):
+    """``(x, y) -> body`` (LambdaDefinitionExpression).
+
+    ``type`` is the body's result type; evaluation happens over the
+    flattened element domain of the enclosing array/map function.
+    """
+
+    params: Tuple[str, ...]
+    param_types: Tuple[T.Type, ...]
+    body: RowExpression
+    type: T.Type
+
+    def __str__(self):
+        return f"({', '.join(self.params)}) -> {self.body}"
+
+
 def walk(expr: RowExpression):
     """Pre-order traversal."""
     yield expr
     for a in getattr(expr, "args", ()):  # type: ignore[attr-defined]
         yield from walk(a)
+    body = getattr(expr, "body", None)
+    if body is not None:
+        yield from walk(body)
 
 
 def max_input_channel(expr: RowExpression) -> int:

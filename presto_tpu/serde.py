@@ -45,27 +45,45 @@ FLAG_LZ4 = 1
 _HEADER = struct.Struct("<4sBBIQQQQ")
 
 
+def _encode_column(parts: List[bytes], col: Column, num_rows: int,
+                   with_type: bool) -> None:
+    if with_type:
+        type_str = col.type.display().encode("utf-8")
+        parts.append(struct.pack("<H", len(type_str)))
+        parts.append(type_str)
+    parts.append(struct.pack(
+        "<BB", col.valid is not None, col.dictionary is not None))
+    if isinstance(col.type, T.RowType):
+        # placeholder values are not written; children are row-aligned
+        if col.valid is not None:
+            parts.append(np.ascontiguousarray(
+                col.valid[:num_rows]).astype(np.uint8).tobytes())
+        for kid in col.children:
+            _encode_column(parts, kid, num_rows, with_type=False)
+        return
+    values = np.ascontiguousarray(col.values[:num_rows])
+    parts.append(values.tobytes())
+    if col.valid is not None:
+        parts.append(np.ascontiguousarray(
+            col.valid[:num_rows]).astype(np.uint8).tobytes())
+    if col.dictionary is not None:
+        entries = col.dictionary.values
+        parts.append(struct.pack("<I", len(entries)))
+        for v in entries:
+            b = v.encode("utf-8")
+            parts.append(struct.pack("<I", len(b)))
+            parts.append(b)
+    if col.children:  # ARRAY/MAP: children sized by the lengths just written
+        total = int(np.asarray(values, np.int64).sum())
+        for kid in col.children:
+            _encode_column(parts, kid, total, with_type=False)
+
+
 def _encode_payload(batch: Batch) -> bytes:
     batch = batch.compact().to_numpy()
     parts: List[bytes] = []
     for col in batch.columns:
-        type_str = col.type.display().encode("utf-8")
-        parts.append(struct.pack("<H", len(type_str)))
-        parts.append(type_str)
-        parts.append(struct.pack(
-            "<BB", col.valid is not None, col.dictionary is not None))
-        values = np.ascontiguousarray(col.values[:batch.num_rows])
-        parts.append(values.tobytes())
-        if col.valid is not None:
-            parts.append(np.ascontiguousarray(
-                col.valid[:batch.num_rows]).astype(np.uint8).tobytes())
-        if col.dictionary is not None:
-            entries = col.dictionary.values
-            parts.append(struct.pack("<I", len(entries)))
-            for v in entries:
-                b = v.encode("utf-8")
-                parts.append(struct.pack("<I", len(b)))
-                parts.append(b)
+        _encode_column(parts, col, batch.num_rows, with_type=True)
     return b"".join(parts)
 
 
@@ -121,6 +139,58 @@ def deserialize_batch(data: bytes) -> Batch:
         raise SerdeError(f"malformed page payload: {e}") from e
 
 
+def _decode_column(payload: bytes, off: int, typ: T.Type,
+                   num_rows: int):
+    has_valid, has_dict = struct.unpack_from("<BB", payload, off)
+    off += 2
+    if isinstance(typ, T.RowType):
+        valid: Optional[np.ndarray] = None
+        if has_valid:
+            valid = np.frombuffer(payload, dtype=np.uint8, count=num_rows,
+                                  offset=off).astype(bool)
+            off += num_rows
+        kids = []
+        for ft in typ.field_types:
+            kid, off = _decode_column(payload, off, ft, num_rows)
+            kids.append(kid)
+        return Column(typ, np.zeros(num_rows, np.int8), valid, None,
+                      tuple(kids)), off
+    itemsize = np.dtype(typ.np_dtype).itemsize
+    values = np.frombuffer(
+        payload, dtype=typ.np_dtype, count=num_rows, offset=off).copy()
+    off += num_rows * itemsize
+    valid = None
+    if has_valid:
+        valid = np.frombuffer(
+            payload, dtype=np.uint8, count=num_rows,
+            offset=off).astype(bool)
+        off += num_rows
+    dictionary: Optional[Dictionary] = None
+    if has_dict:
+        (count,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        entries = []
+        for _ in range(count):
+            (blen,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            entries.append(payload[off:off + blen].decode("utf-8"))
+            off += blen
+        dictionary = Dictionary(entries)
+    if isinstance(typ, (T.ArrayType, T.MapType)):
+        lengths = np.asarray(values, np.int64)
+        if (lengths < 0).any():
+            raise SerdeError("negative nested length")
+        total = int(lengths.sum())
+        kid_types = (typ.element,) if isinstance(typ, T.ArrayType) \
+            else (typ.key, typ.value)
+        kids = []
+        for kt in kid_types:
+            kid, off = _decode_column(payload, off, kt, total)
+            kids.append(kid)
+        return Column(typ, values, valid, None, tuple(kids)), off
+    return Column(typ, values, valid, dictionary), off
+
+
 def _decode_payload(payload: bytes, num_columns: int, num_rows: int) -> Batch:
     off = 0
     cols: List[Column] = []
@@ -129,30 +199,8 @@ def _decode_payload(payload: bytes, num_columns: int, num_rows: int) -> Batch:
         off += 2
         typ = T.parse_type(payload[off:off + type_len].decode("utf-8"))
         off += type_len
-        has_valid, has_dict = struct.unpack_from("<BB", payload, off)
-        off += 2
-        itemsize = np.dtype(typ.np_dtype).itemsize
-        values = np.frombuffer(
-            payload, dtype=typ.np_dtype, count=num_rows, offset=off).copy()
-        off += num_rows * itemsize
-        valid: Optional[np.ndarray] = None
-        if has_valid:
-            valid = np.frombuffer(
-                payload, dtype=np.uint8, count=num_rows,
-                offset=off).astype(bool)
-            off += num_rows
-        dictionary: Optional[Dictionary] = None
-        if has_dict:
-            (count,) = struct.unpack_from("<I", payload, off)
-            off += 4
-            entries = []
-            for _ in range(count):
-                (blen,) = struct.unpack_from("<I", payload, off)
-                off += 4
-                entries.append(payload[off:off + blen].decode("utf-8"))
-                off += blen
-            dictionary = Dictionary(entries)
-        cols.append(Column(typ, values, valid, dictionary))
+        col, off = _decode_column(payload, off, typ, num_rows)
+        cols.append(col)
     return Batch(tuple(cols), num_rows)
 
 

@@ -24,7 +24,7 @@ from presto_tpu import types as T
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanNode, ProjectNode, RemoteSourceNode, SemiJoinNode,
-    SortNode, TableScanNode, UnionNode, ValuesNode, WindowNode,
+    SortNode, TableScanNode, UnionNode, UnnestNode, ValuesNode, WindowNode,
 )
 
 
@@ -93,7 +93,8 @@ class Fragmenter:
         if isinstance(node, SemiJoinNode):
             return self._visit_semijoin(node)
         if isinstance(node, (FilterNode, ProjectNode, LimitNode, SortNode,
-                             WindowNode, EnforceSingleRowNode, UnionNode)):
+                             WindowNode, EnforceSingleRowNode, UnionNode,
+                             UnnestNode)):
             # stays in the consumer fragment; recurse into sources
             new_sources = []
             consumed: List[int] = []

@@ -1,0 +1,88 @@
+"""Mergeable sketches: HyperLogLog for approx_distinct.
+
+The reference's approx_distinct rides airlift-stats HyperLogLog
+(presto-main/.../operator/aggregation/ApproximateCountDistinctAggregation
+.java, presto-spi HLL state).  This is a dense HLL with 2^11 registers
+(standard error ~2.3%, matching the reference's default 2.3% at its
+default bucket count); sketches serialize to latin-1 strings so they ride
+the varbinary dictionary representation through partial/final exchanges.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+P_BITS = 11
+M = 1 << P_BITS                     # registers
+_ALPHA = 0.7213 / (1 + 1.079 / M)   # standard HLL bias constant
+
+
+def _hash64(value) -> int:
+    from presto_tpu import native
+
+    if value is None:
+        return 0
+    if isinstance(value, bool):
+        data = b"\x01" if value else b"\x00"
+    elif isinstance(value, int):
+        data = value.to_bytes(8, "little", signed=True)
+    elif isinstance(value, float):
+        data = np.float64(value).tobytes()
+    elif isinstance(value, str):
+        data = value.encode("utf-8")
+    else:
+        data = repr(value).encode("utf-8")
+    return native.xxh64(data)
+
+
+class HyperLogLog:
+    __slots__ = ("registers",)
+
+    def __init__(self, registers: Optional[np.ndarray] = None):
+        self.registers = (np.zeros(M, np.uint8) if registers is None
+                          else registers)
+
+    def add_value(self, value) -> None:
+        h = _hash64(value)
+        idx = h & (M - 1)
+        rest = h >> P_BITS
+        # rank = leading-zero count + 1 over the remaining 53 bits
+        rank = 1
+        while rest & 1 == 0 and rank <= 64 - P_BITS:
+            rank += 1
+            rest >>= 1
+        if rank > self.registers[idx]:
+            self.registers[idx] = rank
+
+    def add_many(self, values: Iterable) -> None:
+        for v in values:
+            if v is not None:
+                self.add_value(v)
+
+    def merge(self, other: "HyperLogLog") -> None:
+        np.maximum(self.registers, other.registers, out=self.registers)
+
+    def cardinality(self) -> int:
+        regs = self.registers.astype(np.float64)
+        est = _ALPHA * M * M / np.sum(np.exp2(-regs))
+        zeros = int((self.registers == 0).sum())
+        if est <= 2.5 * M and zeros:
+            est = M * np.log(M / zeros)      # linear counting range
+        return int(round(est))
+
+    # -- serde (latin-1 string payload; rides the varbinary dictionary) ---
+    def serialize(self) -> str:
+        return self.registers.tobytes().decode("latin-1")
+
+    @classmethod
+    def deserialize(cls, payload: str) -> "HyperLogLog":
+        raw = payload.encode("latin-1")
+        if len(raw) != M:
+            return cls()                      # unknown/corrupt -> empty
+        return cls(np.frombuffer(raw, np.uint8).copy())
+
+
+def hll_cardinality(payload: str) -> int:
+    return HyperLogLog.deserialize(payload).cardinality()

@@ -39,10 +39,10 @@ KEYWORDS = {
     "hour", "minute", "second", "over", "partition", "rows", "range",
     "unbounded", "preceding", "following", "current", "row", "create",
     "table", "insert", "into", "drop", "values", "set", "reset", "session",
-    "grouping", "sets", "rollup", "cube",
+    "grouping", "sets", "rollup", "cube", "array", "unnest", "ordinality",
 }
 
-_TWO_CHAR = ("<=", ">=", "<>", "!=", "||")
+_TWO_CHAR = ("<=", ">=", "<>", "!=", "||", "->")
 _ONE_CHAR = "+-*/%(),.;<>=[]"
 
 

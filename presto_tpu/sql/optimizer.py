@@ -30,7 +30,7 @@ from presto_tpu.expr.ir import (
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanAggregate, PlanNode, ProjectNode, SemiJoinNode,
-    SortNode, TableScanNode, UnionNode, ValuesNode, WindowNode,
+    SortNode, TableScanNode, UnionNode, UnnestNode, ValuesNode, WindowNode,
 )
 
 
@@ -674,6 +674,15 @@ def _prune(node: PlanNode,
         for newpos, i in enumerate(keep):
             mapping[n_src + i] = n_src + newpos
         return new_node, {ch: mapping[ch] for ch in needed}
+    if isinstance(node, UnnestNode):
+        # no pruning through unnest: its output layout is positional
+        src, m = _prune(node.source,
+                        sorted(range(len(node.source.columns))))
+        new_node = dataclasses.replace(
+            node, source=src,
+            replicate_channels=tuple(m[c] for c in node.replicate_channels),
+            unnest_channels=tuple(m[c] for c in node.unnest_channels))
+        return new_node, {ch: ch for ch in needed}
     if isinstance(node, UnionNode):
         pruned = []
         for inp in node.inputs:

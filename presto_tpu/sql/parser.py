@@ -420,6 +420,19 @@ class _Parser:
         return t.InlineValues(tuple(rows))
 
     def relation_primary(self) -> t.Relation:
+        if self.at_kw("unnest"):
+            self.next()
+            self.expect_op("(")
+            args = [self.expression()]
+            while self.accept_op(","):
+                args.append(self.expression())
+            self.expect_op(")")
+            ordinality = False
+            if self.accept_kw("with"):
+                self.expect_kw("ordinality")
+                ordinality = True
+            alias, col_aliases = self._relation_alias()
+            return t.Unnest(tuple(args), ordinality, alias, col_aliases)
         if self.at_kw("values"):
             iv = self.inline_values()
             alias, col_aliases = self._relation_alias()
@@ -559,6 +572,24 @@ class _Parser:
         return self.primary()
 
     def primary(self) -> t.Expression:
+        e = self._primary_base()
+        while True:
+            if self.at_op("["):
+                self.next()
+                idx = self.expression()
+                self.expect_op("]")
+                e = t.Subscript(e, idx)
+                continue
+            # field deref on a computed base (identifiers consume dots in
+            # qualified_name; row fields there resolve during analysis)
+            if (self.at_op(".") and not isinstance(e, t.Identifier)
+                    and self.peek(1).kind in ("IDENT", "QIDENT")):
+                self.next()
+                e = t.Deref(e, self.identifier())
+                continue
+            return e
+
+    def _primary_base(self) -> t.Expression:
         tok = self.peek()
         if tok.kind == "NUMBER":
             self.next()
@@ -590,6 +621,20 @@ class _Parser:
         if word == "null":
             self.next()
             return t.NullLiteral()
+        if word == "array":
+            self.next()
+            self.expect_op("[")
+            items: List[t.Expression] = []
+            if not self.at_op("]"):
+                items.append(self.expression())
+                while self.accept_op(","):
+                    items.append(self.expression())
+            self.expect_op("]")
+            return t.ArrayConstructor(tuple(items))
+        if word == "row" and self.peek(1).kind == "OP" \
+                and self.peek(1).text == "(":
+            self.next()
+            return self.function_call("row")
         if word in ("true", "false"):
             self.next()
             return t.BooleanLiteral(word == "true")
@@ -703,14 +748,46 @@ class _Parser:
         else:
             distinct = bool(self.accept_kw("distinct"))
             self.accept_kw("all")
-            args = [self.expression()]
+            args = [self._call_arg()]
             while self.accept_op(","):
-                args.append(self.expression())
+                args.append(self._call_arg())
             self.expect_op(")")
             call = t.FunctionCall(name, tuple(args), distinct)
         if self.accept_kw("over"):
             call = dataclasses.replace(call, window=self.window_spec())
         return call
+
+    def _call_arg(self) -> t.Expression:
+        """A function argument: lambda (``x -> e`` / ``(x, y) -> e``) or
+        a plain expression."""
+        if (self.peek().kind in ("IDENT", "QIDENT")
+                and self.peek(1).kind == "OP" and self.peek(1).text == "->"):
+            param = self.identifier()
+            self.next()  # ->
+            return t.Lambda((param,), self.expression())
+        if self.at_op("("):
+            # lookahead: "(" ident ("," ident)* ")" "->"
+            i = 1
+            params = []
+            while self.peek(i).kind in ("IDENT", "QIDENT"):
+                params.append(self.peek(i).text)
+                if self.peek(i + 1).kind == "OP" \
+                        and self.peek(i + 1).text == ",":
+                    i += 2
+                    continue
+                break
+            if (params and self.peek(i + 1).kind == "OP"
+                    and self.peek(i + 1).text == ")"
+                    and self.peek(i + 2).kind == "OP"
+                    and self.peek(i + 2).text == "->"):
+                self.next()  # (
+                names = [self.identifier()]
+                while self.accept_op(","):
+                    names.append(self.identifier())
+                self.expect_op(")")
+                self.expect_op("->")
+                return t.Lambda(tuple(names), self.expression())
+        return self.expression()
 
     def window_spec(self) -> t.WindowSpec:
         self.expect_op("(")
@@ -762,6 +839,19 @@ class _Parser:
         name = tok.text
         if name == "double" and self.peek().text == "precision":
             self.next()
+        if name in ("array", "map", "row") and self.at_op("("):
+            self.next()
+            parts = []
+            while not self.at_op(")"):
+                if name == "row":
+                    fname = self.identifier()
+                    parts.append(f"{fname} {self.type_name()}")
+                else:
+                    parts.append(self.type_name())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return f"{name}({','.join(parts)})"
         if self.at_op("("):
             self.next()
             params = [self.next().text]

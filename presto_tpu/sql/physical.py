@@ -48,7 +48,8 @@ from presto_tpu.expr.ir import InputRef, RowExpression
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanAggregate, PlanNode, ProjectNode, RemoteSourceNode,
-    SemiJoinNode, SortNode, TableScanNode, UnionNode, ValuesNode, WindowNode,
+    SemiJoinNode, SortNode, TableScanNode, UnionNode, UnnestNode, ValuesNode,
+    WindowNode,
 )
 
 
@@ -164,6 +165,14 @@ class PhysicalPlanner:
             chain.append(WindowOperatorFactory(
                 node.partition_channels, node.order_keys, node.functions))
             return chain, splits
+        if isinstance(node, UnnestNode):
+            from presto_tpu.exec.unnestop import UnnestOperatorFactory
+
+            chain, splits = self._lower(node.source)
+            chain.append(UnnestOperatorFactory(
+                node.replicate_channels, node.unnest_channels,
+                node.ordinality, node.outer))
+            return chain, splits
         if isinstance(node, UnionNode):
             buffer = UnionBuffer(len(node.inputs))
             for inp in node.inputs:
@@ -234,6 +243,19 @@ class PhysicalPlanner:
                     else:
                         ch = agg.channel
                     agg_channels.append(AggChannel(prim, ch, ctype))
+                elif prim in ("collect", "hll"):
+                    agg_channels.append(
+                        AggChannel(prim, agg.channel, ctype))
+                elif prim == "sumln":
+                    ln = B.call("ln", _coerce_to(in_ref, T.DOUBLE))
+                    pre_exprs.append(ln)
+                    agg_channels.append(
+                        AggChannel("sum", len(pre_exprs) - 1, ctype))
+                elif prim == "sumhash":
+                    h = B.call("hash64", in_ref)
+                    pre_exprs.append(h)
+                    agg_channels.append(
+                        AggChannel("sum", len(pre_exprs) - 1, ctype))
                 else:
                     raise NotImplementedError(f"agg component {prim}")
                 comp_channels.append(len(agg_channels) - 1)
@@ -277,7 +299,10 @@ class PhysicalPlanner:
 
     # merge prim for each partial component prim (steps.py uses the same
     # table for the SPMD in-program exchange variant)
-    _FINAL_PRIM = {"count": "sum", "sum": "sum", "min": "min", "max": "max"}
+    _FINAL_PRIM = {"count": "sum", "sum": "sum", "min": "min", "max": "max",
+                   "collect": "collect_merge",  # partial arrays flatten
+                   "sumln": "sum", "sumhash": "sum",
+                   "hll": "hll_merge"}          # partial sketches max-merge
 
     def _lower_final_aggregation(self, node: AggregationNode):
         """FINAL step over a partial's output: [keys..., comp0, comp1, ...].
@@ -411,6 +436,26 @@ def _finalize(agg: PlanAggregate, comps: List[RowExpression]
             return B.call("divide", _coerce_to(s, T.DOUBLE),
                           B.cast(c, T.DOUBLE))
         return B.call("divide", s, c)
+    if fin == "map_agg":
+        return B.call("map_from_entries", comps[0])
+    if fin in ("min_by", "max_by"):
+        return B.call(f"$rows_{fin}", comps[0])
+    if fin == "approx_distinct":
+        return B.call("$hll_cardinality", comps[0])
+    if fin.startswith("approx_percentile:"):
+        from presto_tpu.expr import functions as F
+
+        p = float(fin.split(":", 1)[1])
+        fn = F.resolve_array_percentile(comps[0].type, p)
+        from presto_tpu.expr.ir import Call
+
+        return Call("$array_percentile", (comps[0],), fn.result_type, fn)
+    if fin in ("corr", "covar_samp", "covar_pop", "regr_slope",
+               "regr_intercept"):
+        return B.call(f"$rows_{fin}", comps[0])
+    if fin == "geometric_mean":
+        s, n = comps
+        return B.call("exp", B.call("divide", s, B.cast(n, T.DOUBLE)))
     if fin in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
         s, sq, n = comps
         nd = B.cast(n, T.DOUBLE)

@@ -184,6 +184,31 @@ class WindowNode(PlanNode):
 
 
 @D(frozen=True)
+class UnnestNode(PlanNode):
+    """UNNEST over source rows (UnnestNode.java / UnnestOperator.java:39).
+
+    ``replicate_channels`` pass through repeated per element;
+    ``unnest_channels`` are ARRAY/MAP columns expanded positionally (zip to
+    the longest, null-padding shorter ones); ``ordinality`` appends the
+    1-based element index.  ``columns`` = replicated + per-unnest outputs
+    (map -> key,value; array(row) -> one column per field) + ordinality.
+    """
+
+    source: PlanNode
+    replicate_channels: Tuple[int, ...]
+    unnest_channels: Tuple[int, ...]
+    ordinality: bool
+    columns: Tuple[Column, ...]
+    # LEFT JOIN UNNEST: rows with empty/NULL containers still emit one
+    # output row with NULL unnest columns
+    outer: bool = False
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
 class UnionNode(PlanNode):
     """UNION ALL of same-width inputs (UnionNode.java analogue); DISTINCT
     and INTERSECT/EXCEPT are planned as aggregations/semijoins above this."""

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from presto_tpu import types as T
 from presto_tpu.connectors.api import Connector, ConnectorRegistry
 from presto_tpu.expr import build as B
+from presto_tpu.expr import functions as F
 from presto_tpu.expr.functions import (
     FunctionError, resolve_aggregate, resolve_scalar,
 )
@@ -33,7 +34,10 @@ from presto_tpu.sql.plan import (
 
 AGG_NAMES = {"count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
              "stddev_pop", "variance", "var_samp", "var_pop", "any_value",
-             "arbitrary", "bool_and", "bool_or", "every", "count_if"}
+             "arbitrary", "bool_and", "bool_or", "every", "count_if",
+             "array_agg", "map_agg", "min_by", "max_by", "approx_distinct",
+             "approx_percentile", "corr", "covar_samp", "covar_pop",
+             "regr_slope", "regr_intercept", "geometric_mean", "checksum"}
 
 
 class SqlAnalysisError(ValueError):
@@ -205,6 +209,7 @@ class Translator:
         self.scope = scope
         self.grouped = grouped
         self.windows = windows
+        self.lambda_env: Dict[str, T.Type] = {}  # lambda params in scope
 
     def translate(self, expr: t.Expression) -> RowExpression:
         if self.windows is not None:
@@ -225,8 +230,17 @@ class Translator:
 
     def _translate(self, e: t.Expression) -> RowExpression:
         if isinstance(e, t.Identifier):
+            if len(e.parts) == 1 and e.parts[0] in self.lambda_env:
+                from presto_tpu.expr.ir import VarRef
+
+                return VarRef(e.parts[0], self.lambda_env[e.parts[0]])
             idx = self.scope.try_resolve(e.parts)
             if idx is None:
+                # row-field access spelled as a qualified name: resolve the
+                # longest prefix as a column, the rest as ROW fields
+                rf = self._try_row_fields(e.parts)
+                if rf is not None:
+                    return rf
                 if self.grouped is not None:
                     raise SqlAnalysisError(
                         f"column {e} must appear in GROUP BY or inside an "
@@ -321,14 +335,85 @@ class Translator:
                 raise SqlAnalysisError(
                     f"aggregate {e.name} used outside aggregation context")
             return self._function_call(e)
+        if isinstance(e, t.ArrayConstructor):
+            items = [self.translate(i) for i in e.items]
+            et = _common_type([i.type for i in items]) if items else T.UNKNOWN
+            items = [_coerce(i, et) for i in items]
+            rt = T.ArrayType("array", element=et)
+            fn = F.resolve_array_constructor(rt, len(items))
+            return Call("$array", tuple(items), rt, fn)
+        if isinstance(e, t.Subscript):
+            base = self.translate(e.base)
+            if isinstance(base.type, T.RowType):
+                idx = self.translate(e.index)
+                if not isinstance(idx, Constant):
+                    raise SqlAnalysisError("row subscript must be constant")
+                i = int(idx.value) - 1
+                fn = F.resolve_row_field_index(base.type, i)
+                return Call("row_field", (base,), fn.result_type, fn)
+            idx = self.translate(e.index)
+            if isinstance(base.type, T.MapType):
+                idx = _coerce(idx, base.type.key)
+            fn = F.resolve_scalar("subscript", [base.type, idx.type])
+            return Call("subscript", (base, idx), fn.result_type, fn)
+        if isinstance(e, t.Deref):
+            base = self.translate(e.base)
+            if not isinstance(base.type, T.RowType):
+                raise SqlAnalysisError(
+                    f"cannot dereference {base.type.display()}")
+            fn, _ = F.resolve_row_field(base.type, e.field)
+            return Call("row_field", (base,), fn.result_type, fn)
+        if isinstance(e, t.Lambda):
+            raise SqlAnalysisError(
+                "lambda expression outside a higher-order function")
         raise SqlAnalysisError(
             f"unsupported expression {type(e).__name__}")
+
+    def _try_row_fields(self, parts) -> Optional[RowExpression]:
+        for k in range(len(parts) - 1, 0, -1):
+            idx = self.scope.try_resolve(parts[:k])
+            if idx is None:
+                continue
+            expr: RowExpression = B.ref(idx, self.scope.fields[idx].type)
+            ok = True
+            for field in parts[k:]:
+                if not isinstance(expr.type, T.RowType):
+                    ok = False
+                    break
+                try:
+                    fn, _ = F.resolve_row_field(expr.type, field)
+                except F.FunctionError:
+                    ok = False
+                    break
+                expr = Call("row_field", (expr,), fn.result_type, fn)
+            if ok:
+                return expr
+        return None
+
+    def _translate_lambda(self, lam: t.Lambda,
+                          param_types: List[T.Type]):
+        from presto_tpu.expr.ir import LambdaExpr
+
+        if len(lam.params) != len(param_types):
+            raise SqlAnalysisError(
+                f"lambda takes {len(lam.params)} parameters, expected "
+                f"{len(param_types)}")
+        saved = dict(self.lambda_env)
+        self.lambda_env.update(zip(lam.params, param_types))
+        try:
+            body = self.translate(lam.body)
+        finally:
+            self.lambda_env = saved
+        return LambdaExpr(tuple(lam.params), tuple(param_types), body,
+                          body.type)
 
     _CONST_FNS = {"pi": 3.141592653589793, "e": 2.718281828459045,
                   "nan": float("nan"), "infinity": float("inf")}
 
     def _function_call(self, e: t.FunctionCall) -> RowExpression:
         name = e.name.lower()
+        if any(isinstance(a, t.Lambda) for a in e.args):
+            return self._higher_order_call(name, e)
         if name in self._CONST_FNS and not e.args:
             return B.const(self._CONST_FNS[name], T.DOUBLE)
         if name == "if" and len(e.args) in (2, 3):
@@ -347,6 +432,17 @@ class Translator:
                 raise SqlAnalysisError("round(x, d) requires constant d")
             return B.round_digits(self.translate(e.args[0]),
                                   int(digits.value))
+        if name in ("date_format", "format_datetime") and len(e.args) == 2:
+            x = self.translate(e.args[0])
+            fmt = self.translate(e.args[1])
+            if not (isinstance(fmt, Constant)
+                    and isinstance(fmt.value, str)):
+                raise SqlAnalysisError(
+                    f"{name} format must be a constant string")
+            resolver = (F.resolve_date_format if name == "date_format"
+                        else F.resolve_format_datetime)
+            fn = resolver(x.type, fmt.value)
+            return Call(name, (x, fmt), fn.result_type, fn)
         if name in ("date_trunc", "date_add", "date_diff") and e.args:
             unit_rex = self.translate(e.args[0])
             if not (isinstance(unit_rex, Constant)
@@ -386,6 +482,45 @@ class Translator:
             raise SqlAnalysisError(f"date_add unit {unit!r} on "
                                    f"{x.type.display()}")
         return B.call(name, *[self.translate(a) for a in e.args])
+
+    def _higher_order_call(self, name: str,
+                           e: t.FunctionCall) -> RowExpression:
+        """Lambda-taking array/map functions (the reference's
+        LambdaDefinitionExpression call sites)."""
+        from presto_tpu.expr.ir import LambdaExpr
+
+        first = self.translate(e.args[0])
+        ft = first.type
+        if name in ("transform", "filter", "any_match", "all_match",
+                    "none_match"):
+            if not isinstance(ft, T.ArrayType):
+                raise SqlAnalysisError(f"{name} expects an array")
+            lam = self._translate_lambda(e.args[1], [ft.element])
+            fn = resolve_scalar(name, [ft, lam.type])
+            return Call(name, (first, lam), fn.result_type, fn)
+        if name in ("map_filter", "transform_values", "transform_keys"):
+            if not isinstance(ft, T.MapType):
+                raise SqlAnalysisError(f"{name} expects a map")
+            lam = self._translate_lambda(e.args[1], [ft.key, ft.value])
+            fn = resolve_scalar(name, [ft, lam.type])
+            return Call(name, (first, lam), fn.result_type, fn)
+        if name == "reduce":
+            if not isinstance(ft, T.ArrayType) or len(e.args) != 4:
+                raise SqlAnalysisError(
+                    "reduce(array, init, (s, x) -> ..., s -> ...)")
+            init = self.translate(e.args[1])
+            state_t = init.type
+            comb = self._translate_lambda(e.args[2], [state_t, ft.element])
+            if comb.type != state_t:
+                body = _coerce(comb.body, state_t)
+                comb = LambdaExpr(comb.params, comb.param_types, body,
+                                  state_t)
+            fin = self._translate_lambda(e.args[3], [state_t])
+            fn = resolve_scalar(
+                "reduce", [ft, state_t, comb.type, fin.type])
+            return Call("reduce", (first, init, comb, fin),
+                        fn.result_type, fn)
+        raise SqlAnalysisError(f"{name} does not take a lambda")
 
     def _arithmetic(self, e: t.ArithmeticBinary) -> RowExpression:
         # date +/- interval folds into add_days/add_months with constant
@@ -446,8 +581,11 @@ def _common_type(types: List[T.Type]) -> T.Type:
             out = T.DOUBLE if (x.name in _NUM_ORDER
                                or out.name in _NUM_ORDER) else out
         else:
-            raise SqlAnalysisError(
-                f"mismatched types {out.display()} vs {x.display()}")
+            cs = T.common_super_type(out, x)
+            if cs is None:
+                raise SqlAnalysisError(
+                    f"mismatched types {out.display()} vs {x.display()}")
+            out = cs
     return out
 
 
@@ -760,7 +898,59 @@ class Planner:
             return RelationPlan(sub.node, Scope(fields, outer))
         if isinstance(r, t.Join):
             return self._plan_join(r, outer)
+        if isinstance(r, t.Unnest):
+            return self._plan_unnest(r, None, outer)
         raise SqlAnalysisError(f"unsupported relation {type(r).__name__}")
+
+    def _plan_unnest(self, u: t.Unnest, left: Optional[RelationPlan],
+                     outer: Optional[Scope],
+                     preserve_outer: bool = False) -> RelationPlan:
+        """UNNEST as a relation (standalone or CROSS JOIN UNNEST(...))."""
+        from presto_tpu.exec.unnestop import _unnest_outputs
+        from presto_tpu.sql.plan import UnnestNode
+
+        if left is None:
+            dummy_cols = (("$unnest_row", T.BIGINT),)
+            base = RelationPlan(ValuesNode(dummy_cols, ((0,),)),
+                                Scope([Field("$unnest_row", None, T.BIGINT)],
+                                      outer))
+        else:
+            base = left
+        tr = Translator(base.scope)
+        args = [tr.translate(a) for a in u.args]
+        for a in args:
+            if not isinstance(a.type, (T.ArrayType, T.MapType)):
+                raise SqlAnalysisError(
+                    f"cannot unnest {a.type.display()}")
+        nbase = len(base.node.columns)
+        proj_exprs = tuple(
+            [B.ref(i, ty) for i, (_, ty) in enumerate(base.node.columns)]
+            + args)
+        proj_cols = tuple(base.node.columns) + tuple(
+            (f"$unnest{j}", a.type) for j, a in enumerate(args))
+        proj = ProjectNode(base.node, proj_exprs, proj_cols)
+
+        replicate = tuple(range(nbase)) if left is not None else ()
+        unnest_channels = tuple(nbase + j for j in range(len(args)))
+        out_cols: List[Tuple[str, T.Type]] = \
+            [base.node.columns[i] for i in replicate]
+        new_fields: List[Field] = [base.scope.fields[i] for i in replicate]
+        produced = []
+        for a in args:
+            produced.extend(_unnest_outputs(a.type))
+        names = list(u.column_aliases)
+        for k, ty in enumerate(produced):
+            name = names[k] if k < len(names) else f"col{k}"
+            out_cols.append((name, ty))
+            new_fields.append(Field(name, u.alias, ty))
+        if u.ordinality:
+            k = len(produced)
+            name = names[k] if k < len(names) else "ordinality"
+            out_cols.append((name, T.BIGINT))
+            new_fields.append(Field(name, u.alias, T.BIGINT))
+        node = UnnestNode(proj, replicate, unnest_channels, u.ordinality,
+                          tuple(out_cols), outer=preserve_outer)
+        return RelationPlan(node, Scope(new_fields, outer))
 
     def _plan_table(self, r: t.Table,
                     outer: Optional[Scope]) -> RelationPlan:
@@ -792,6 +982,11 @@ class Planner:
     def _plan_join(self, r: t.Join,
                    outer: Optional[Scope]) -> RelationPlan:
         left = self.plan_relation(r.left, outer)
+        if isinstance(r.right, t.Unnest):
+            if r.kind not in ("cross", "inner", "left"):
+                raise SqlAnalysisError(f"{r.kind} join with UNNEST")
+            return self._plan_unnest(r.right, left, outer,
+                                     preserve_outer=(r.kind == "left"))
         right = self.plan_relation(r.right, outer)
         combined = RelationPlan(
             None,  # type: ignore[arg-type]
@@ -1118,6 +1313,15 @@ class Planner:
                 sub.scope)
         return sub, corr_eq, corr_other
 
+    class _FoldedValue:
+        """Plan-time-folded VALUES entry (Python-domain value + type)."""
+
+        __slots__ = ("type", "value")
+
+        def __init__(self, typ: T.Type, value):
+            self.type = typ
+            self.value = value
+
     def _plan_inline_values(self, r: t.InlineValues,
                             outer: Optional[Scope]) -> RelationPlan:
         """VALUES rows -> ValuesNode (constant folding at plan time; the
@@ -1134,8 +1338,19 @@ class Planner:
             for e in row:
                 rex = tr.translate(e)
                 if not isinstance(rex, Constant):
-                    raise SqlAnalysisError(
-                        "VALUES entries must be constant expressions")
+                    # fold input-free expressions (ARRAY[..], row(..),
+                    # map(..), date arithmetic) at plan time
+                    from presto_tpu.expr.ir import input_channels
+
+                    if input_channels(rex):
+                        raise SqlAnalysisError(
+                            "VALUES entries must be constant expressions")
+                    from presto_tpu.batch import Batch as _B
+                    from presto_tpu.expr.compile import evaluate
+
+                    col = evaluate(rex, _B((), 1))
+                    out_row.append(self._FoldedValue(rex.type, col.to_pylist(1)[0]))
+                    continue
                 out_row.append(rex)
             consts.append(out_row)
         cols = []
@@ -1150,6 +1365,9 @@ class Planner:
             out_row = []
             for c, (_, ctype) in zip(row, cols):
                 v = c.value
+                if isinstance(c, self._FoldedValue):  # Python-domain value
+                    out_row.append(v)
+                    continue
                 if v is not None and not c.type.is_dictionary:
                     v = c.type.to_python(v)
                 if v is not None and ctype.name in ("double", "real") \
@@ -1190,8 +1408,9 @@ class Planner:
                 spec = resolve_aggregate("count", None)
                 aggs.append(PlanAggregate(spec, None, a.distinct))
                 continue
-            arg = tr.translate(a.args[0])
+            arg = _agg_input(tr, a)
             spec = resolve_aggregate(a.name, arg.type)
+            _patch_agg_spec(tr, a, spec)
             aggs.append(PlanAggregate(spec, len(pre_exprs), a.distinct))
             pre_exprs.append(arg)
         if not pre_exprs:  # bare count(*): keep one channel for row counts
@@ -1238,8 +1457,9 @@ class Planner:
                 spec = resolve_aggregate("count", None)
                 aggs.append(PlanAggregate(spec, None, a.distinct))
                 continue
-            arg = tr.translate(a.args[0])
+            arg = _agg_input(tr, a)
             spec = resolve_aggregate(a.name, arg.type)
+            _patch_agg_spec(tr, a, spec)
             aggs.append(PlanAggregate(spec, len(pre_exprs), a.distinct))
             pre_exprs.append(arg)
         pre_cols = tuple((f"c{i}", x.type) for i, x in enumerate(pre_exprs))
@@ -1451,6 +1671,35 @@ def _collect_windows(e: t.Node, out: List[t.FunctionCall]):
                     for sub in item:
                         if isinstance(sub, t.Node):
                             _collect_windows(sub, out)
+
+
+_TWO_ARG_AGGS = {"map_agg", "min_by", "max_by", "corr", "covar_samp",
+                 "covar_pop", "regr_slope", "regr_intercept"}
+
+
+def _agg_input(tr: Translator, a: t.FunctionCall) -> RowExpression:
+    """Aggregate input expression; two-argument aggregates pack their
+    arguments into a row(...) channel (the planner-side analogue of the
+    reference's multi-channel accumulator inputs)."""
+    if a.name.lower() in _TWO_ARG_AGGS:
+        if len(a.args) != 2:
+            raise SqlAnalysisError(f"{a.name} takes two arguments")
+        k = tr.translate(a.args[0])
+        v = tr.translate(a.args[1])
+        fn = F.resolve_row_constructor([k.type, v.type])
+        return Call("row", (k, v), fn.result_type, fn)
+    return tr.translate(a.args[0])
+
+
+def _patch_agg_spec(tr: Translator, a: t.FunctionCall, spec) -> None:
+    """Constant-parameter aggregates: bake the parameter into finalize
+    (approx_percentile's percentile argument)."""
+    if a.name.lower() == "approx_percentile" and len(a.args) == 2:
+        p = tr.translate(a.args[1])
+        if not isinstance(p, Constant) or p.value is None:
+            raise SqlAnalysisError(
+                "approx_percentile(x, p) requires constant p")
+        spec.finalize = f"approx_percentile:{float(p.value)}"
 
 
 def _collect_aggs(e: t.Node, out: List[t.FunctionCall]):

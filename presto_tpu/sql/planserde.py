@@ -20,13 +20,15 @@ from typing import Any, Dict, List
 from presto_tpu import types as T
 from presto_tpu.expr import functions as F
 from presto_tpu.expr.functions import AggSpec
-from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression, SpecialForm
+from presto_tpu.expr.ir import (
+    Call, Constant, InputRef, LambdaExpr, RowExpression, SpecialForm, VarRef,
+)
 from presto_tpu.server.fragmenter import PlanFragment
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanAggregate, PlanNode, PlanWindowFunction, ProjectNode,
     RemoteSourceNode, SemiJoinNode, SortNode, TableScanNode, UnionNode,
-    ValuesNode, WindowNode,
+    UnnestNode, ValuesNode, WindowNode,
 )
 
 
@@ -76,10 +78,21 @@ def expr_to_json(e: RowExpression) -> Dict[str, Any]:
         # round_digits); recover it from the resolution key for rebinding.
         if e.name == "round" and getattr(e.fn, "re_key", None):
             out["digits"] = e.fn.re_key[2]
+        if e.name == "row_field" and getattr(e.fn, "re_key", None):
+            out["field"] = e.fn.re_key[2]
+        if e.name in ("date_format", "format_datetime") \
+                and getattr(e.fn, "re_key", None):
+            out["fmt"] = e.fn.re_key[2]
         return out
     if isinstance(e, SpecialForm):
         return {"k": "form", "form": e.form,
                 "args": [expr_to_json(a) for a in e.args], "t": _ty(e.type)}
+    if isinstance(e, VarRef):
+        return {"k": "var", "name": e.name, "t": _ty(e.type)}
+    if isinstance(e, LambdaExpr):
+        return {"k": "lambda", "params": list(e.params),
+                "ptypes": [_ty(p) for p in e.param_types],
+                "body": expr_to_json(e.body), "t": _ty(e.type)}
     raise PlanSerdeError(f"unknown expression {type(e).__name__}")
 
 
@@ -100,12 +113,26 @@ def expr_from_json(d: Dict[str, Any]) -> RowExpression:
             fn = F.resolve_cast(args[0].type, t)
         elif name == "round":
             fn = F.resolve_round(args[0].type, int(d.get("digits", 0)))
+        elif name == "row_field":
+            fn = F.resolve_row_field_index(args[0].type, int(d["field"]))
+        elif name == "$array":
+            fn = F.resolve_array_constructor(t, len(args))
+        elif name == "date_format":
+            fn = F.resolve_date_format(args[0].type, str(d["fmt"]))
+        elif name == "format_datetime":
+            fn = F.resolve_format_datetime(args[0].type, str(d["fmt"]))
         else:
             fn = F.resolve_scalar(name, [a.type for a in args])
         return Call(name, args, t, fn)
     if k == "form":
         return SpecialForm(str(d["form"]),
                            tuple(expr_from_json(a) for a in d["args"]), t)
+    if k == "var":
+        return VarRef(str(d["name"]), t)
+    if k == "lambda":
+        return LambdaExpr(tuple(d["params"]),
+                          tuple(_unty(p) for p in d["ptypes"]),
+                          expr_from_json(d["body"]), t)
     raise PlanSerdeError(f"unknown expression kind {k!r}")
 
 
@@ -225,6 +252,12 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "count": n.count}
     if isinstance(n, EnforceSingleRowNode):
         return {"k": "single_row", "source": node_to_json(n.source)}
+    if isinstance(n, UnnestNode):
+        return {"k": "unnest", "source": node_to_json(n.source),
+                "replicate_channels": list(n.replicate_channels),
+                "unnest_channels": list(n.unnest_channels),
+                "ordinality": n.ordinality, "outer": n.outer,
+                "columns": _cols(n.columns)}
     if isinstance(n, RemoteSourceNode):
         return {"k": "remote", "fragment_ids": list(n.fragment_ids),
                 "columns": _cols(n.columns)}
@@ -285,6 +318,12 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
         return LimitNode(node_from_json(d["source"]), int(d["count"]))
     if k == "single_row":
         return EnforceSingleRowNode(node_from_json(d["source"]))
+    if k == "unnest":
+        return UnnestNode(node_from_json(d["source"]),
+                          tuple(d["replicate_channels"]),
+                          tuple(d["unnest_channels"]),
+                          bool(d["ordinality"]), _uncols(d["columns"]),
+                          outer=bool(d.get("outer", False)))
     if k == "remote":
         return RemoteSourceNode(tuple(d["fragment_ids"]),
                                 _uncols(d["columns"]))

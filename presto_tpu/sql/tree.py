@@ -121,6 +121,31 @@ class Cast(Expression):
 
 
 @D(frozen=True)
+class ArrayConstructor(Expression):
+    items: Tuple[Expression, ...]
+
+
+@D(frozen=True)
+class Subscript(Expression):
+    base: Expression
+    index: Expression
+
+
+@D(frozen=True)
+class Lambda(Expression):
+    params: Tuple[str, ...]
+    body: Expression
+
+
+@D(frozen=True)
+class Deref(Expression):
+    """Row-field access on a non-identifier base: ``expr.field``."""
+
+    base: Expression
+    field: str
+
+
+@D(frozen=True)
 class Extract(Expression):
     field: str                       # year|month|day|...
     expr: Expression
@@ -248,6 +273,16 @@ class Join(Relation):
     left: Relation
     right: Relation
     on: Optional[Expression] = None
+
+
+@D(frozen=True)
+class Unnest(Relation):
+    """UNNEST(a1, a2, ...) [WITH ORDINALITY] [alias(col, ...)]."""
+
+    args: Tuple[Expression, ...]
+    ordinality: bool = False
+    alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
 
 
 # --- query -----------------------------------------------------------------

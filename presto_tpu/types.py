@@ -28,7 +28,7 @@ optional validity mask (batch.py), mirroring Block.isNull
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -37,6 +37,7 @@ __all__ = [
     "DOUBLE", "DATE", "TIMESTAMP", "UNKNOWN", "DecimalType", "VarcharType",
     "CharType", "VarbinaryType", "VARCHAR", "VARBINARY", "parse_type",
     "common_super_type", "is_numeric", "is_integral", "is_string",
+    "ArrayType", "MapType", "RowType", "NestedType", "is_nested",
 ]
 
 
@@ -66,6 +67,10 @@ class Type:
     @property
     def is_comparable(self) -> bool:
         return True
+
+    @property
+    def is_nested(self) -> bool:
+        return False
 
     def display(self) -> str:
         return self.name
@@ -231,6 +236,90 @@ class UnknownType(Type):
         return np.dtype("int8")
 
 
+@dataclasses.dataclass(frozen=True)
+class NestedType(Type):
+    """Base for container types (ARRAY/MAP/ROW).
+
+    The reference's nested blocks (ArrayBlock/MapBlock/RowBlock,
+    presto-spi/.../block/) store flattened child blocks plus per-row
+    offsets.  Here the column's ``values`` array holds int32 offsets
+    (length n+1) into flattened child columns (batch.py Column.children);
+    the flattened children are ordinary columns, so device compute (lambda
+    transforms, UNNEST projections) runs on the flat child arrays while
+    offsets stay host-side.
+    """
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype("int32")  # offsets
+
+    @property
+    def is_nested(self) -> bool:
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayType(NestedType):
+    element: "Type" = None  # type: ignore[assignment]
+
+    def display(self) -> str:
+        return f"array({self.element.display()})"
+
+    @property
+    def is_orderable(self) -> bool:
+        return self.element.is_orderable
+
+    @property
+    def is_comparable(self) -> bool:
+        return self.element.is_comparable
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(NestedType):
+    key: "Type" = None    # type: ignore[assignment]
+    value: "Type" = None  # type: ignore[assignment]
+
+    def display(self) -> str:
+        return f"map({self.key.display()},{self.value.display()})"
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    @property
+    def is_comparable(self) -> bool:
+        return self.key.is_comparable and self.value.is_comparable
+
+
+@dataclasses.dataclass(frozen=True)
+class RowType(NestedType):
+    """ROW(name type, ...); anonymous fields get field0, field1, ...
+
+    Unlike ARRAY/MAP there are no offsets: children are row-aligned, and
+    ``values`` is a placeholder.
+    """
+
+    field_names: Tuple[str, ...] = ()
+    field_types: Tuple["Type", ...] = ()
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype("int8")  # placeholder values
+
+    def display(self) -> str:
+        inner = ", ".join(f"{n} {t.display()}"
+                          for n, t in zip(self.field_names, self.field_types))
+        return f"row({inner})"
+
+    @property
+    def is_orderable(self) -> bool:
+        return all(t.is_orderable for t in self.field_types)
+
+    @property
+    def is_comparable(self) -> bool:
+        return all(t.is_comparable for t in self.field_types)
+
+
 BOOLEAN = BooleanType("boolean", "bool_")
 TINYINT = _Integer("tinyint", "int8")
 SMALLINT = _Integer("smallint", "int16")
@@ -257,6 +346,10 @@ def is_numeric(t: Type) -> bool:
 
 def is_string(t: Type) -> bool:
     return isinstance(t, (VarcharType, CharType))
+
+
+def is_nested(t: Type) -> bool:
+    return isinstance(t, NestedType)
 
 
 def _integral_as_decimal(t: Type) -> DecimalType:
@@ -296,11 +389,45 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         return VarcharType("varchar", length=max(la, lb))
     if {a.name, b.name} == {"date", "timestamp"}:
         return TIMESTAMP
+    if isinstance(a, ArrayType) and isinstance(b, ArrayType):
+        e = common_super_type(a.element, b.element)
+        return None if e is None else ArrayType("array", element=e)
+    if isinstance(a, MapType) and isinstance(b, MapType):
+        k = common_super_type(a.key, b.key)
+        v = common_super_type(a.value, b.value)
+        if k is None or v is None:
+            return None
+        return MapType("map", key=k, value=v)
+    if isinstance(a, RowType) and isinstance(b, RowType):
+        if len(a.field_types) != len(b.field_types):
+            return None
+        fts = [common_super_type(x, y)
+               for x, y in zip(a.field_types, b.field_types)]
+        if any(t is None for t in fts):
+            return None
+        return RowType("row", field_names=a.field_names,
+                       field_types=tuple(fts))
     return None
 
 
+def _split_top_level(s: str) -> list:
+    """Split on commas not inside parens."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    parts.append(s[start:])
+    return [p.strip() for p in parts if p.strip()]
+
+
 def parse_type(text: str) -> Type:
-    """Parse a type name as it appears in SQL (``decimal(15,2)`` etc.)."""
+    """Parse a type name as it appears in SQL (``decimal(15,2)``,
+    ``array(bigint)``, ``map(varchar, bigint)``, ``row(a bigint)``...)."""
     s = text.strip().lower()
     simple = {
         "boolean": BOOLEAN, "tinyint": TINYINT, "smallint": SMALLINT,
@@ -321,4 +448,28 @@ def parse_type(text: str) -> Type:
     if s.startswith("char"):
         inner = s[s.index("(") + 1 : s.rindex(")")] if "(" in s else "1"
         return CharType("char", length=int(inner))
+    if s.startswith("array<"):
+        return ArrayType("array", element=parse_type(s[6:s.rindex(">")]))
+    if s.startswith("array") and "(" in s:
+        inner = s[s.index("(") + 1 : s.rindex(")")]
+        return ArrayType("array", element=parse_type(inner))
+    if s.startswith("map") and "(" in s:
+        inner = s[s.index("(") + 1 : s.rindex(")")]
+        k, v = _split_top_level(inner)
+        return MapType("map", key=parse_type(k), value=parse_type(v))
+    if s.startswith("row") and "(" in s:
+        inner = s[s.index("(") + 1 : s.rindex(")")]
+        names, fts = [], []
+        for i, part in enumerate(_split_top_level(inner)):
+            # "name type" or bare "type"
+            first, _, rest = part.partition(" ")
+            try:
+                t = parse_type(part)
+                names.append(f"field{i}")
+            except ValueError:
+                t = parse_type(rest)
+                names.append(first)
+            fts.append(t)
+        return RowType("row", field_names=tuple(names),
+                       field_types=tuple(fts))
     raise ValueError(f"unknown type: {text!r}")

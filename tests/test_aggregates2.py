@@ -1,0 +1,168 @@
+"""Aggregate breadth: approx_distinct (HLL), approx_percentile,
+corr/covar/regr, geometric_mean, checksum — single-node and the
+partial/final merge path.
+
+Reference models: ApproximateCountDistinctAggregation (HLL state),
+ApproximateDoublePercentileAggregations, DoubleCovariance/
+DoubleRegressionAggregation, GeometricMeanAggregations,
+ChecksumAggregationFunction (presto-main/.../operator/aggregation/)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+def q1(runner, sql):
+    rows = runner.execute(sql).rows
+    assert len(rows) == 1
+    return rows[0]
+
+
+class TestHll:
+    def test_sketch_accuracy(self):
+        from presto_tpu.sketch import HyperLogLog
+
+        h = HyperLogLog()
+        h.add_many(range(50_000))
+        est = h.cardinality()
+        assert abs(est - 50_000) / 50_000 < 0.05
+
+    def test_sketch_merge_equals_union(self):
+        from presto_tpu.sketch import HyperLogLog
+
+        a, b, u = HyperLogLog(), HyperLogLog(), HyperLogLog()
+        a.add_many(range(0, 6000))
+        b.add_many(range(3000, 9000))
+        u.add_many(range(0, 9000))
+        a.merge(HyperLogLog.deserialize(b.serialize()))
+        assert a.cardinality() == u.cardinality()
+
+    def test_sql_accuracy(self, runner):
+        ad, ex = q1(runner, "select approx_distinct(l_orderkey), "
+                            "count(distinct l_orderkey) from lineitem")
+        assert abs(ad - ex) / ex < 0.05
+
+    def test_grouped(self, runner):
+        rows = runner.execute(
+            "select l_returnflag, approx_distinct(l_suppkey), "
+            "count(distinct l_suppkey) from lineitem "
+            "group by l_returnflag").rows
+        for _, ad, ex in rows:
+            assert abs(ad - ex) / ex < 0.1
+
+    def test_strings(self, runner):
+        ad, ex = q1(runner, "select approx_distinct(o_orderpriority), "
+                            "count(distinct o_orderpriority) from orders")
+        assert ad == ex  # tiny cardinality: exact in linear-counting range
+
+    def test_varbinary_input_not_mistaken_for_merge(self, runner):
+        # input type == sketch state type (varbinary): must still
+        # ACCUMULATE, not merge raw values as sketches
+        (ad,) = q1(runner, "select approx_distinct(to_utf8("
+                           "o_orderpriority)) from orders")
+        assert ad == 5
+
+
+class TestPercentile:
+    def test_median_matches_exact(self, runner):
+        (p50,) = q1(runner,
+                    "select approx_percentile(l_quantity, 0.5) "
+                    "from lineitem")
+        # exact nearest-rank median from the oracle
+        from presto_tpu.connectors.tpch import TpchConnector
+
+        conn = TpchConnector(scale=0.01)
+        h = conn.get_table("lineitem")
+        s = conn.get_splits(h, 1)[0]
+        b = next(iter(conn.page_source(s, ["l_quantity"], 1 << 22)))
+        vals = np.sort(np.asarray(b.columns[0].values)[:b.num_rows])
+        exact = vals[int(np.ceil(0.5 * len(vals))) - 1]
+        assert p50 == exact
+
+    def test_two_percentiles(self, runner):
+        p50, p90 = q1(runner, "select approx_percentile(l_quantity, 0.5), "
+                              "approx_percentile(l_quantity, 0.9) "
+                              "from lineitem")
+        assert p50 < p90
+
+
+class TestStatistics:
+    def test_corr_matches_numpy(self, runner):
+        from presto_tpu.connectors.tpch import TpchConnector
+
+        conn = TpchConnector(scale=0.01)
+        h = conn.get_table("lineitem")
+        s = conn.get_splits(h, 1)[0]
+        b = next(iter(conn.page_source(
+            s, ["l_quantity", "l_extendedprice"], 1 << 22)))
+        x = np.asarray(b.columns[0].values)[:b.num_rows].astype(float)
+        y = np.asarray(b.columns[1].values)[:b.num_rows].astype(float)
+        (got,) = q1(runner, "select corr(l_quantity, l_extendedprice) "
+                            "from lineitem")
+        assert abs(got - np.corrcoef(x, y)[0, 1]) < 1e-9
+
+    def test_covar(self, runner):
+        cs, cp = q1(runner,
+                    "select covar_samp(x, y), covar_pop(x, y) from "
+                    "(values (1.0,2.0),(2.0,4.0),(3.0,5.0)) t(x,y)")
+        x = np.array([1.0, 2.0, 3.0])
+        y = np.array([2.0, 4.0, 5.0])
+        assert abs(cs - np.cov(x, y, ddof=1)[0, 1]) < 1e-12
+        assert abs(cp - np.cov(x, y, ddof=0)[0, 1]) < 1e-12
+
+    def test_regression(self, runner):
+        slope, icept = q1(
+            runner, "select regr_slope(y, x), regr_intercept(y, x) from "
+                    "(values (1.0,10.0),(2.0,20.0),(3.0,30.0)) t(x,y)")
+        assert abs(slope - 10.0) < 1e-12 and abs(icept) < 1e-9
+
+    def test_geometric_mean(self, runner):
+        (gm,) = q1(runner, "select geometric_mean(x) from "
+                           "(values (1.0),(4.0),(16.0)) t(x)")
+        assert abs(gm - 4.0) < 1e-9
+
+    def test_checksum_order_independent(self, runner):
+        a = q1(runner, "select checksum(x) from (values (1),(2),(3)) t(x)")
+        b = q1(runner, "select checksum(x) from (values (3),(1),(2)) t(x)")
+        c = q1(runner, "select checksum(x) from (values (3),(1),(5)) t(x)")
+        assert a == b and a != c and a[0] != 0
+
+
+class TestDistributedMerge:
+    """Partial -> exchange -> final merge for sketch/collect aggregates."""
+
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        from presto_tpu.server.dqr import DistributedQueryRunner
+
+        dqr = DistributedQueryRunner.tpch(scale=0.01, n_workers=3)
+        yield dqr
+        dqr.close()
+
+    def test_approx_distinct_merge(self, cluster, runner):
+        sql = "select approx_distinct(l_orderkey) from lineitem"
+        assert cluster.execute(sql).rows == runner.execute(sql).rows
+
+    def test_percentile_merge(self, cluster, runner):
+        sql = "select approx_percentile(l_quantity, 0.5) from lineitem"
+        assert cluster.execute(sql).rows == runner.execute(sql).rows
+
+    def test_corr_merge(self, cluster, runner):
+        sql = "select corr(l_quantity, l_extendedprice) from lineitem"
+        (d,), (l,) = cluster.execute(sql).rows[0], runner.execute(sql).rows[0]
+        assert abs(d - l) < 1e-9
+
+    def test_array_agg_merge(self, cluster, runner):
+        sql = ("select o_orderpriority, array_agg(o_orderkey) from orders "
+               "group by o_orderpriority")
+        d = {k: sorted(v) for k, v in cluster.execute(sql).rows}
+        l = {k: sorted(v) for k, v in runner.execute(sql).rows}
+        assert d == l

@@ -1,0 +1,113 @@
+"""Scalar function breadth: cast-to-varchar, date formatting, JSON,
+binary/hash, URL, and multi-string-column host evaluation.
+
+Reference models: presto-main/.../operator/scalar/ (JsonFunctions,
+VarbinaryFunctions, UrlFunctions, DateTimeFunctions.formatDatetime /
+dateFormat) and the cast framework in type/*Operators.java."""
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+def q1(runner, sql):
+    rows = runner.execute(sql).rows
+    assert len(rows) == 1
+    return rows[0]
+
+
+CASES = [
+    # casts to varchar
+    ("select cast(42 as varchar)", ("42",)),
+    ("select cast(-7 as varchar)", ("-7",)),
+    ("select cast(1.5 as varchar)", ("1.5",)),
+    ("select cast(true as varchar), cast(false as varchar)",
+     ("true", "false")),
+    ("select cast(date '2020-03-05' as varchar)", ("2020-03-05",)),
+    ("select cast(cast(1.25 as decimal(5,2)) as varchar)", ("1.25",)),
+    ("select cast(cast(null as bigint) as varchar)", (None,)),
+    ("select cast(array[1,2] as array(double))", ([1.0, 2.0],)),
+    # date formatting
+    ("select date_format(timestamp '2020-03-05 14:30:45', "
+     "'%Y/%m/%d %H:%i:%s')", ("2020/03/05 14:30:45",)),
+    ("select format_datetime(timestamp '2020-03-05 14:30:45', "
+     "'yyyy-MM-dd HH:mm')", ("2020-03-05 14:30",)),
+    # json
+    ('select json_extract_scalar(\'{"a": {"b": 7}}\', \'$.a.b\')', ("7",)),
+    ('select json_extract(\'{"a": [1, 2]}\', \'$.a\')', ("[1,2]",)),
+    ("select json_array_length('[1,2,3]')", (3,)),
+    ("select json_array_get('[10,20,30]', 1)", ("20",)),
+    ("select json_array_get('[10,20,30]', -1)", ("30",)),
+    ('select json_extract_scalar(\'{"a": 1}\', \'$.missing\')', (None,)),
+    ("select json_array_length('not json')", (None,)),
+    ('select json_size(\'{"a": {"b": 1, "c": 2}}\', \'$.a\')', (2,)),
+    # binary / hashing (known digests)
+    ("select to_hex(md5(to_utf8('abc')))",
+     ("900150983CD24FB0D6963F7D28E17F72",)),
+    ("select to_hex(sha256(to_utf8('abc')))",
+     ("BA7816BF8F01CFEA414140DE5DAE2223B00361A396177A9CB410FF61F20015AD",)),
+    ("select crc32(to_utf8('abc'))", (891568578,)),
+    ("select to_base64(to_utf8('hi')), from_utf8(from_base64('aGk='))",
+     ("aGk=", "hi")),
+    ("select to_hex(from_hex('DEADBEEF'))", ("DEADBEEF",)),
+    # url
+    ("select url_extract_host('https://x.io:8080/p?q=1')", ("x.io",)),
+    ("select url_extract_port('https://x.io:8080/p')", (8080,)),
+    ("select url_extract_protocol('https://x.io/p')", ("https",)),
+    ("select url_extract_path('https://x.io/a/b?q=1')", ("/a/b",)),
+    ("select url_extract_query('https://x.io/p?q=1&r=2')", ("q=1&r=2",)),
+    ("select url_extract_parameter('http://a/b?k=v&x=2', 'x')", ("2",)),
+    ("select url_encode('a b'), url_decode('a%20b')", ("a%20b", "a b")),
+]
+
+
+@pytest.mark.parametrize("sql,expected", CASES,
+                         ids=[c[0][:60] for c in CASES])
+def test_scalar(runner, sql, expected):
+    assert q1(runner, sql) == expected
+
+
+def test_cast_varchar_over_column(runner):
+    rows = runner.execute(
+        "select cast(o_orderkey as varchar) from orders "
+        "where o_orderkey <= 3 order by o_orderkey").rows
+    assert rows == [("1",), ("2",), ("3",)]
+
+
+def test_multi_string_column_concat(runner):
+    rows = runner.execute(
+        "select concat(o_orderpriority, '/', o_orderstatus) "
+        "from orders where o_orderkey = 1").rows
+    (v,) = rows[0]
+    assert "/" in v and v.endswith(("F", "O", "P"))
+
+
+def test_multi_string_column_matches_oracle(runner):
+    # concat of two columns must equal python-side concat row by row
+    rows = runner.execute(
+        "select o_orderpriority, o_orderstatus, "
+        "concat(o_orderpriority, o_orderstatus) from orders "
+        "where o_orderkey < 50").rows
+    for a, b, c in rows:
+        assert c == a + b
+
+
+def test_string_fn_with_column_arg(runner):
+    rows = runner.execute(
+        "select substr(o_orderpriority, 1, o_orderkey) from orders "
+        "where o_orderkey <= 2 order by o_orderkey").rows
+    assert rows[0] == ("3",) or len(rows[0][0]) == 1
+    assert len(rows[1][0]) == 2
+
+
+def test_date_format_grouping(runner):
+    sql = ("select date_format(cast(o_orderdate as timestamp), '%Y-%m') "
+           "as ym, count(*) from orders group by 1 order by 1 limit 3")
+    rows = runner.execute(sql).rows
+    assert all(len(ym) == 7 and ym[4] == "-" for ym, _ in rows)
+    assert sorted(rows) == rows

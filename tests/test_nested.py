@@ -1,0 +1,258 @@
+"""Array/map/row types: batch layer, serde, SQL functions, lambdas,
+UNNEST, collect aggregates.
+
+Reference models: nested blocks (presto-spi/.../block/ArrayBlock.java,
+MapBlock.java, RowBlock.java), the array/map/lambda scalar library
+(presto-main/.../operator/scalar/), UnnestOperator.java:39, and the
+array_agg/map_agg/min_by accumulators."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.batch import batch_from_pylist, concat_batches
+from presto_tpu.localrunner import LocalQueryRunner
+from presto_tpu.serde import deserialize_batch, serialize_batch
+
+AB = T.ArrayType("array", element=T.BIGINT)
+AS = T.ArrayType("array", element=T.VARCHAR)
+MV = T.MapType("map", key=T.VARCHAR, value=T.BIGINT)
+RW = T.RowType("row", field_names=("a", "b"),
+               field_types=(T.BIGINT, T.VARCHAR))
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+def q1(runner, sql):
+    rows = runner.execute(sql).rows
+    assert len(rows) == 1
+    return rows[0]
+
+
+class TestNestedBatch:
+    ROWS = [
+        ([1, 2, 3], {"x": 1}, (10, "p")),
+        ([], {"y": 2, "z": 3}, (20, "q")),
+        (None, None, None),
+        ([7], {}, (30, "r")),
+    ]
+
+    def test_roundtrip_take_head_pad(self):
+        b = batch_from_pylist([AB, MV, RW], self.ROWS)
+        assert b.to_pylist() == self.ROWS
+        assert b.take(np.array([3, 0])).to_pylist() == [self.ROWS[3],
+                                                        self.ROWS[0]]
+        assert b.head(2).to_pylist() == self.ROWS[:2]
+        assert b.pad_rows(16).compact().to_pylist() == self.ROWS
+
+    def test_concat(self):
+        b = batch_from_pylist([AB, MV, RW], self.ROWS)
+        c = concat_batches([b, b.head(1)])
+        assert c.to_pylist() == self.ROWS + self.ROWS[:1]
+
+    def test_serde_roundtrip(self):
+        nested = T.ArrayType("array", element=AB)
+        rows = [([["a"]], [[1, 2], [3]]), (None, []), ([[], ["b", "c"]],
+                                                       [[4]])]
+        b = batch_from_pylist(
+            [T.ArrayType("array", element=AS), nested], rows)
+        assert deserialize_batch(serialize_batch(b)).to_pylist() == rows
+
+    def test_parse_display_roundtrip(self):
+        for t in (AB, MV, RW, T.ArrayType("array", element=MV)):
+            assert T.parse_type(t.display()) == t
+
+
+class TestNestedSql:
+    CASES = [
+        ("select array[1,2,3]", ([1, 2, 3],)),
+        ("select cardinality(array[1,2,3]), array[1,2,3][2]", (3, 2)),
+        ("select element_at(array[1,2], 5)", (None,)),
+        ("select element_at(array[1,2], -1)", (2,)),
+        ("select contains(array[1,2], 2), contains(array[1,2], 9)",
+         (True, False)),
+        ("select array_position(array['a','b','c'], 'b')", (2,)),
+        ("select array_min(array[3,1,2]), array_max(array[3,1,2])", (1, 3)),
+        ("select array_distinct(array[1,1,2])", ([1, 2],)),
+        ("select array_sort(array['c','a','b'])", (["a", "b", "c"],)),
+        ("select reverse(array[1,2,3])", ([3, 2, 1],)),
+        ("select array[1,2] || array[3]", ([1, 2, 3],)),
+        ("select concat(array[1], array[2], array[3])", ([1, 2, 3],)),
+        ("select array_join(array['x','y'], '-')", ("x-y",)),
+        ("select slice(array[1,2,3,4,5], 2, 3)", ([2, 3, 4],)),
+        ("select array_remove(array[1,2,1], 1)", ([2],)),
+        ("select array_intersect(array[1,2,3], array[2,3,4])", ([2, 3],)),
+        ("select array_union(array[1,2], array[2,3])", ([1, 2, 3],)),
+        ("select array_except(array[1,2,3], array[2])", ([1, 3],)),
+        ("select arrays_overlap(array[1,2], array[2,9])", (True,)),
+        ("select flatten(array[array[1,2], array[3]])", ([1, 2, 3],)),
+        ("select repeat('ab', 3)", (["ab", "ab", "ab"],)),
+        ("select sequence(1, 5)", ([1, 2, 3, 4, 5],)),
+        ("select sequence(5, 1, -2)", ([5, 3, 1],)),
+        ("select split('a,b,c', ',')", (["a", "b", "c"],)),
+        ("select split('a,b,c', ',', 2)", (["a", "b,c"],)),
+        ("select map(array['k1','k2'], array[1,2])['k2']", (2,)),
+        ("select map_keys(map(array['k'], array[1]))", (["k"],)),
+        ("select map_values(map(array['k'], array[1]))", ([1],)),
+        ("select cardinality(map(array['a','b'], array[1,2]))", (2,)),
+        ("select element_at(map(array['a'], array[1]), 'zz')", (None,)),
+        ("select map_concat(map(array['a'], array[1]), "
+         "map(array['b'], array[2]))", ({"a": 1, "b": 2},)),
+        ("select map_from_entries(array[row('x', 1), row('y', 2)])",
+         ({"x": 1, "y": 2},)),
+        ("select row(1, 'x')", ((1, "x"),)),
+        ("select row(1, 'x')[1]", (1,)),
+        ("select cast(null as array(bigint)) is null", (True,)),
+        # lambdas
+        ("select transform(array[1,2,3], x -> x * 10)", ([10, 20, 30],)),
+        ("select filter(array[1,2,3,4], x -> x % 2 = 0)", ([2, 4],)),
+        ("select reduce(array[1,2,3], 0, (s,x) -> s + x, s -> s)", (6,)),
+        ("select any_match(array[1,2], x -> x > 1), "
+         "all_match(array[1,2], x -> x > 1), "
+         "none_match(array[1,2], x -> x > 5)", (True, False, True)),
+        ("select map_filter(map(array['a','b'], array[1,2]), "
+         "(k,v) -> v > 1)", ({"b": 2},)),
+        ("select transform_values(map(array['a'], array[2]), "
+         "(k,v) -> v * 3)", ({"a": 6},)),
+    ]
+
+    @pytest.mark.parametrize("sql,expected", CASES,
+                             ids=[c[0][:60] for c in CASES])
+    def test_scalar(self, runner, sql, expected):
+        assert q1(runner, sql) == expected
+
+    def test_lambda_capture(self, runner):
+        sql = ("select transform(arr, x -> x + y) from "
+               "(values (array[1,2], 10), (array[3], 100)) t(arr, y)")
+        assert runner.execute(sql).rows == [([11, 12],), ([103],)]
+
+    def test_nested_over_table_column(self, runner):
+        sql = ("select o_orderkey, transform(sequence(1, o_orderkey), "
+               "x -> x * 2) from orders where o_orderkey <= 3 "
+               "order by o_orderkey")
+        rows = runner.execute(sql).rows
+        assert rows[0] == (1, [2])
+        assert all(r[1] == [2 * i for i in range(1, r[0] + 1)]
+                   for r in rows)
+
+
+class TestUnnest:
+    def test_standalone(self, runner):
+        assert runner.execute(
+            "select * from unnest(array[1,2,3])").rows == [(1,), (2,), (3,)]
+
+    def test_ordinality(self, runner):
+        assert runner.execute(
+            "select * from unnest(array['a','b']) with ordinality"
+        ).rows == [("a", 1), ("b", 2)]
+
+    def test_map(self, runner):
+        assert runner.execute(
+            "select * from unnest(map(array['k1','k2'], array[10,20]))"
+        ).rows == [("k1", 10), ("k2", 20)]
+
+    def test_cross_join_lateral(self, runner):
+        sql = ("select o_orderkey, tag from (select o_orderkey, "
+               "array['p','q'] as tags from orders limit 2) "
+               "cross join unnest(tags) as t(tag) order by o_orderkey, tag")
+        rows = runner.execute(sql).rows
+        assert len(rows) == 4
+        assert rows[0][1] == "p" and rows[1][1] == "q"
+
+    def test_array_of_rows(self, runner):
+        assert runner.execute(
+            "select * from unnest(array[row(1,'a'), row(2,'b')])"
+        ).rows == [(1, "a"), (2, "b")]
+
+    def test_zip_two_arrays(self, runner):
+        assert runner.execute(
+            "select * from unnest(array[1,2,3], array['x','y'])"
+        ).rows == [(1, "x"), (2, "y"), (3, None)]
+
+    def test_zip_with_empty_array(self, runner):
+        # shorter array is EMPTY: gather index must stay in bounds
+        assert runner.execute(
+            "select * from unnest(array[1,2], array[])"
+        ).rows == [(1, None), (2, None)]
+
+    def test_left_join_unnest_preserves_outer_rows(self, runner):
+        sql = ("select t.id, u.v from (values (1, array[7]), (2, array[]),"
+               " (3, cast(null as array(bigint)))) t(id, arr) "
+               "left join unnest(t.arr) as u(v) on true order by t.id")
+        assert runner.execute(sql).rows == [(1, 7), (2, None), (3, None)]
+
+    def test_left_join_unnest_ordinality_null_on_empty(self, runner):
+        sql = ("select t.id, u.o from (values (1, array[7]), "
+               "(2, array[])) t(id, arr) left join "
+               "unnest(t.arr) with ordinality as u(v, o) on true "
+               "order by t.id")
+        assert runner.execute(sql).rows == [(1, 1), (2, None)]
+
+    def test_unnest_split_roundtrip(self, runner):
+        # split -> unnest -> array_agg: the classic pipeline
+        sql = ("select array_agg(w) from (select w from "
+               "unnest(split('a b c', ' ')) as t(w))")
+        assert q1(runner, sql) == (["a", "b", "c"],)
+
+
+class TestCollectAggregates:
+    def test_array_agg_global(self, runner):
+        assert q1(runner, "select array_agg(x) from (values (1),(2),(3)) "
+                          "t(x)") == ([1, 2, 3],)
+
+    def test_array_agg_grouped(self, runner):
+        sql = ("select k, array_agg(v) from (values (1,'a'),(1,'b'),"
+               "(2,'c')) t(k,v) group by k order by k")
+        assert runner.execute(sql).rows == [(1, ["a", "b"]), (2, ["c"])]
+
+    def test_array_agg_keeps_nulls(self, runner):
+        assert q1(runner, "select array_agg(x) from (values (1),(null),"
+                          "(3)) t(x)") == ([1, None, 3],)
+
+    def test_map_agg(self, runner):
+        assert q1(runner, "select map_agg(k, v) from (values ('x',1),"
+                          "('y',2)) t(k,v)") == ({"x": 1, "y": 2},)
+
+    def test_min_max_by(self, runner):
+        assert q1(runner, "select min_by(name, price), max_by(name, price)"
+                          " from (values ('a',3),('b',1),('c',9)) "
+                          "t(name,price)") == ("b", "c")
+
+    def test_array_agg_over_tpch(self, runner):
+        sql = ("select o_orderpriority, cardinality(array_agg(o_orderkey))"
+               ", count(*) from orders group by o_orderpriority")
+        for _, card, cnt in runner.execute(sql).rows:
+            assert card == cnt
+
+
+class TestNestedPlanSerde:
+    QUERIES = [
+        "select transform(array[1,2], x -> x + o_orderkey) from orders "
+        "where o_orderkey < 3",
+        "select array_agg(o_orderkey) from orders group by o_orderpriority",
+        "select t.v from orders cross join unnest(array[1,2]) as t(v) "
+        "where o_orderkey = 1",
+    ]
+
+    @pytest.mark.parametrize("sql", QUERIES)
+    def test_fragment_roundtrip(self, runner, sql):
+        import json
+
+        from presto_tpu.server.fragmenter import Fragmenter
+        from presto_tpu.sql.optimizer import optimize
+        from presto_tpu.sql.parser import parse_statement
+        from presto_tpu.sql.planner import Metadata, Planner
+        from presto_tpu.sql.planserde import (
+            fragment_from_json, fragment_to_json,
+        )
+
+        metadata = Metadata(runner.registry, "tpch")
+        logical = Planner(metadata).plan(parse_statement(sql))
+        dplan = Fragmenter(metadata=metadata).fragment(
+            optimize(logical, metadata))
+        for frag in dplan.fragments:
+            wire = json.dumps(fragment_to_json(frag))
+            assert fragment_from_json(json.loads(wire)) == frag
