@@ -43,6 +43,11 @@ class DynamicFilter:
         self.maxs: List[Optional[np.ndarray]] = [None] * n_keys
         self.sets: List[Optional[np.ndarray]] = [None] * n_keys
         self.build_empty = False
+        self.disabled = False    # spilled build: pass everything through
+
+    def disable(self) -> None:
+        self.disabled = True
+        self.ready = True
 
     def fill_from_build(self, data: Optional[Batch],
                         key_channels: Sequence[int]) -> None:
@@ -81,7 +86,7 @@ class DynamicFilterOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self.ctx.stats.input_rows += batch.num_rows
-        if not self.dyn.ready:
+        if not self.dyn.ready or self.dyn.disabled:
             self._pending = batch  # no filter info: pass through
             return
         if self.dyn.build_empty:

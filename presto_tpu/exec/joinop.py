@@ -74,21 +74,71 @@ class LookupSourceFactory:
         return self.source
 
 
+@dataclasses.dataclass
+class SpilledLookupSource:
+    """Build side went to the spill tier (HashBuilderOperator's
+    INPUT_SPILLED state, HashBuilderOperator.java:155): the probe operator
+    must hash-partition its input the same way and join
+    partition-by-partition (grace hash join / GenericPartitioningSpiller).
+    """
+
+    spiller: object                # PartitioningSpiller over key channels
+    n_partitions: int
+    key_channels: List[int]
+    input_types: List[T.Type]
+
+    mode: str = "spilled"
+
+
 class HashBuildOperator(Operator):
     def __init__(self, ctx: OperatorContext, factory: "HashBuildOperatorFactory"):
         super().__init__(ctx)
         self.f = factory
         self._batches: List[Batch] = []
+        self._spiller = None
+        self._accumulated_bytes = 0
 
     def add_input(self, batch: Batch) -> None:
-        self._batches.append(batch)
         self.ctx.stats.input_rows += batch.num_rows
+        if self._spiller is not None:
+            self._spiller.spill(batch.to_numpy())
+            return
+        self._batches.append(batch)
         self.ctx.memory.reserve(batch.size_bytes)
+        self._accumulated_bytes += batch.size_bytes
+        cfg = self.ctx.config
+        if (cfg.spill_enabled and self.f.allow_spill
+                and self._accumulated_bytes > cfg.spill_threshold_bytes):
+            self._spill_accumulated()
+
+    def _spill_accumulated(self) -> None:
+        """Revoke build-side memory: hash-partition everything seen so far
+        to disk; the probe side will partition itself to match."""
+        from presto_tpu.exec.spill import PartitioningSpiller
+
+        cfg = self.ctx.config
+        self._spiller = PartitioningSpiller(
+            cfg.spill_path, cfg.spill_partitions, self.f.key_channels,
+            tag=f"joinbuild-{self.ctx.name}")
+        for b in self._batches:
+            self._spiller.spill(b.to_numpy())
+        self._batches = []
+        self._accumulated_bytes = 0
+        self.ctx.memory.free()
 
     def finish(self) -> None:
         if self._finishing:
             return
         super().finish()
+        if self._spiller is not None:
+            # a spilled build side cannot feed dynamic filters cheaply;
+            # mark the filter as pass-through
+            if self.f.dynamic_filter is not None:
+                self.f.dynamic_filter.disable()
+            self.f.lookup.set(SpilledLookupSource(
+                self._spiller, self.ctx.config.spill_partitions,
+                list(self.f.key_channels), list(self.f.input_types)))
+            return
         import jax.numpy as jnp
 
         from presto_tpu import types as TT
@@ -163,11 +213,14 @@ class HashBuildOperator(Operator):
 
 class HashBuildOperatorFactory(OperatorFactory):
     def __init__(self, key_channels: Sequence[int],
-                 input_types: Sequence[T.Type], dynamic_filter=None):
+                 input_types: Sequence[T.Type], dynamic_filter=None,
+                 allow_spill: bool = True):
         self.key_channels = list(key_channels)
         self.input_types = list(input_types)
         self.lookup = LookupSourceFactory()
         self.dynamic_filter = dynamic_filter
+        # per-partition sub-builds during a grace join must not re-spill
+        self.allow_spill = allow_spill
 
     def create(self, ctx: OperatorContext) -> HashBuildOperator:
         return HashBuildOperator(ctx, self)
@@ -210,6 +263,18 @@ class LookupJoinOperator(Operator):
     def add_input(self, batch: Batch) -> None:
         self.ctx.stats.input_rows += batch.num_rows
         src = self.f.build.lookup.get()
+        if src.mode == "spilled":
+            # grace join: partition the probe the same way as the build
+            if getattr(self, "_probe_spiller", None) is None:
+                from presto_tpu.exec.spill import PartitioningSpiller
+
+                cfg = self.ctx.config
+                self._probe_spiller = PartitioningSpiller(
+                    cfg.spill_path, src.n_partitions,
+                    self.f.probe_key_channels,
+                    tag=f"joinprobe-{self.ctx.name}")
+            self._probe_spiller.spill(batch.to_numpy())
+            return
         if src.mode == "canonical":
             self._pending.append(batch)
             self.ctx.memory.reserve(batch.size_bytes)
@@ -447,8 +512,55 @@ class LookupJoinOperator(Operator):
         if self._finishing:
             return
         super().finish()
+        src = self.f.build.lookup.get()
+        if src.mode == "spilled":
+            self._join_spilled_partitions(src)
+            return
         if self._pending:
             self._probe_canonical()
+
+    def _join_spilled_partitions(self, src: "SpilledLookupSource") -> None:
+        """Grace hash join: per hash partition, rebuild a resident lookup
+        source from the spilled build rows and replay the probe rows
+        through a fresh build/probe operator pair (the reference's
+        unspill-and-join path; partitions are disjoint in keys so inner/
+        left/semi/anti all compose per partition)."""
+        probe_spiller = getattr(self, "_probe_spiller", None)
+        for p in range(src.n_partitions):
+            build_batches = list(src.spiller.partition(p))
+            probe_batches = (list(probe_spiller.partition(p))
+                             if probe_spiller is not None else [])
+            if not probe_batches:
+                continue
+            if not build_batches and self.f.join_type == "inner":
+                continue
+            sub_build_f = HashBuildOperatorFactory(
+                self.f.build.key_channels, self.f.build.input_types,
+                allow_spill=False)
+            bctx = OperatorContext(self.ctx.task,
+                                   f"{self.ctx.name}.p{p}.build")
+            bop = sub_build_f.create(bctx)
+            for b in build_batches:
+                bop.add_input(b)
+            bop.finish()
+            sub_probe_f = LookupJoinOperatorFactory(
+                sub_build_f, self.f.probe_key_channels, self.f.probe_types,
+                self.f.join_type, self.f.expansion, self.f.residual)
+            pctx = OperatorContext(self.ctx.task,
+                                   f"{self.ctx.name}.p{p}.probe")
+            pop = sub_probe_f.create(pctx)
+            for b in probe_batches:
+                pop.add_input(b)
+                while (out := pop.get_output()) is not None:
+                    self._out.append(out)
+            pop.finish()
+            while (out := pop.get_output()) is not None:
+                self._out.append(out)
+            bop.close()
+            pop.close()
+        src.spiller.close()
+        if probe_spiller is not None:
+            probe_spiller.close()
 
     def is_finished(self) -> bool:
         return self._finishing and not self._out and not self._pending

@@ -104,11 +104,13 @@ class PartitioningSpiller:
     def spill(self, batch: Batch) -> None:
         import jax.numpy as jnp
 
-        from presto_tpu.ops.hashing import partition_of, row_hash
+        from presto_tpu.ops.hashing import (
+            partition_of, row_hash, value_hash_triple,
+        )
 
         batch = batch.compact()
-        key_cols = [(batch.columns[c].values, batch.columns[c].valid,
-                     batch.columns[c].type) for c in self.channels]
+        key_cols = [value_hash_triple(batch.columns[c])
+                    for c in self.channels]
         parts = np.asarray(partition_of(row_hash(key_cols), self.n))
         for p in range(self.n):
             idx = np.nonzero(parts == p)[0]

@@ -40,3 +40,31 @@ def row_hash(columns: Sequence[Tuple[jax.Array, Optional[jax.Array], T.Type]]
 
 def partition_of(hashes: jax.Array, num_partitions: int) -> jax.Array:
     return (hashes % jnp.uint64(num_partitions)).astype(jnp.int32)
+
+
+def value_hash_triple(col) -> tuple:
+    """(values, valid, type) for partitioning hashes, with dictionary
+    columns replaced by per-ENTRY value hashes gathered on codes.
+
+    Codes are interning order — two batches (or two join sides) holding the
+    same strings in different dictionaries disagree on codes, so hashing
+    codes would route equal keys to different partitions.  Hashing each
+    dictionary entry's bytes (entries << rows, host-side) makes the
+    partition a pure function of the string value — the generalization of
+    the reference's DictionaryAware processing to the partitioning path
+    (PartitionedOutputOperator / GenericPartitioningSpiller roles)."""
+    import numpy as np
+
+    from presto_tpu import native
+    from presto_tpu import types as TT
+
+    if col.dictionary is None:
+        return (col.values, col.valid, col.type)
+    entries = col.dictionary.values
+    table = np.fromiter(
+        (native.xxh64(e.encode("utf-8", "surrogatepass")) for e in entries),
+        dtype=np.uint64, count=len(entries)).view(np.int64)
+    if len(table) == 0:
+        table = np.zeros(1, np.int64)
+    codes = np.clip(np.asarray(col.values), 0, len(table) - 1)
+    return (jnp.asarray(table)[jnp.asarray(codes)], col.valid, TT.BIGINT)

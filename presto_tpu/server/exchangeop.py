@@ -41,7 +41,9 @@ class PartitionedOutputOperator(Operator):
     def add_input(self, batch: Batch) -> None:
         import jax.numpy as jnp
 
-        from presto_tpu.ops.hashing import partition_of, row_hash
+        from presto_tpu.ops.hashing import (
+            partition_of, row_hash, value_hash_triple,
+        )
 
         self.ctx.stats.input_rows += batch.num_rows
         if self.n == 1:
@@ -49,8 +51,8 @@ class PartitionedOutputOperator(Operator):
             self.ctx.stats.output_rows += batch.num_rows
             return
         batch = batch.compact()
-        key_cols = [(batch.columns[c].values, batch.columns[c].valid,
-                     batch.columns[c].type) for c in self.channels]
+        key_cols = [value_hash_triple(batch.columns[c])
+                    for c in self.channels]
         hashes = row_hash(key_cols)
         parts = np.asarray(partition_of(hashes, self.n))
         for p in range(self.n):

@@ -344,6 +344,41 @@ class PhysicalPlanner:
             chain.append(FilterProjectOperatorFactory(None, exprs, post_in))
         return chain, splits
 
+    def _insert_dynamic_filter(self, chain: List, dyn,
+                               key_channels: List[int]) -> None:
+        """Place the runtime filter as close to the scan as channel
+        provenance allows (the reference pushes dynamic filters into the
+        probe-side TableScan, LocalDynamicFilter.java:45): walk backwards
+        over FilterProject stages remapping key channels through pure
+        InputRef projections, stopping at any operator that changes row
+        identity."""
+        from presto_tpu.exec.dynamicfilter import (
+            DynamicFilterOperatorFactory,
+        )
+
+        pos = len(chain)
+        keys = list(key_channels)
+        i = len(chain) - 1
+        while i >= 0:
+            f = chain[i]
+            if isinstance(f, FilterProjectOperatorFactory):
+                mapped = []
+                for k in keys:
+                    p = f.projections[k] if k < len(f.projections) else None
+                    if isinstance(p, InputRef):
+                        mapped.append(p.index)
+                    else:
+                        mapped = None
+                        break
+                if mapped is None:
+                    break
+                keys = mapped
+                pos = i
+                i -= 1
+                continue
+            break
+        chain.insert(pos, DynamicFilterOperatorFactory(dyn, keys))
+
     def _lower_join(self, node: JoinNode):
         if node.kind == "cross":
             build_chain, build_splits = self._lower(node.right)
@@ -372,12 +407,8 @@ class PhysicalPlanner:
                          name=self._name("build")))
             chain, splits = self._lower(node.left)
             if dyn is not None:
-                from presto_tpu.exec.dynamicfilter import (
-                    DynamicFilterOperatorFactory,
-                )
-
-                chain.append(DynamicFilterOperatorFactory(
-                    dyn, list(node.left_keys)))
+                self._insert_dynamic_filter(chain, dyn,
+                                            list(node.left_keys))
             chain.append(LookupJoinOperatorFactory(
                 build, list(node.left_keys),
                 [t for _, t in node.left.columns],
@@ -395,14 +426,23 @@ class PhysicalPlanner:
         raise NotImplementedError(f"{node.kind} join")
 
     def _lower_semijoin(self, node: SemiJoinNode):
+        dyn = None
+        if not node.negated and self.config.dynamic_filtering_enabled:
+            from presto_tpu.exec.dynamicfilter import DynamicFilter
+
+            dyn = DynamicFilter(len(node.filtering_keys))
         build_chain, build_splits = self._lower(node.filtering)
         build = HashBuildOperatorFactory(
             list(node.filtering_keys),
-            [t for _, t in node.filtering.columns])
+            [t for _, t in node.filtering.columns],
+            dynamic_filter=dyn)
         build_chain.append(build)
         self._done_pipelines.append(
             Pipeline(build_chain, build_splits, name=self._name("sbuild")))
         chain, splits = self._lower(node.source)
+        if dyn is not None:
+            self._insert_dynamic_filter(chain, dyn,
+                                        list(node.source_keys))
         chain.append(LookupJoinOperatorFactory(
             build, list(node.source_keys),
             [t for _, t in node.source.columns],

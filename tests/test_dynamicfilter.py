@@ -55,3 +55,34 @@ def test_filter_actually_prunes(on_runner):
     assert dyn.ready
     assert dyn.mins[0] == 5 and dyn.maxs[0] == 9
     assert list(dyn.sets[0]) == [5, 7, 9]
+
+
+def test_filter_placed_at_scan(on_runner):
+    """The runtime filter must sit directly after the probe TableScan
+    (channel provenance through FilterProject), not just before the join
+    (LocalDynamicFilter pushes to the scan in the reference)."""
+    from presto_tpu.exec.dynamicfilter import DynamicFilterOperatorFactory
+    from presto_tpu.exec.operators import TableScanOperatorFactory
+    from presto_tpu.sql.optimizer import optimize
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.physical import PhysicalPlanner
+    from presto_tpu.sql.planner import Metadata, Planner
+
+    md = Metadata(on_runner.registry, "tpch")
+    sql = ("select o_orderpriority, l_quantity from orders join lineitem "
+           "on o_orderkey = l_orderkey where l_quantity > 45")
+    plan = optimize(Planner(md).plan(parse_statement(sql)), md)
+    phys = PhysicalPlanner(on_runner.registry).plan(plan)
+    probe = [p for p in phys.pipelines if any(
+        isinstance(f, DynamicFilterOperatorFactory) for f in p.factories)]
+    assert probe, "no dynamic filter in any pipeline"
+    factories = probe[0].factories
+    i = next(idx for idx, f in enumerate(factories)
+             if isinstance(f, DynamicFilterOperatorFactory))
+    assert isinstance(factories[i - 1], TableScanOperatorFactory)
+
+
+def test_semijoin_dynamic_filter(on_runner, off_runner):
+    sql = ("select count(*) from lineitem where l_orderkey in "
+           "(select o_orderkey from orders where o_totalprice > 400000)")
+    assert on_runner.execute(sql).rows == off_runner.execute(sql).rows

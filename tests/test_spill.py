@@ -157,9 +157,37 @@ class TestSpilledQueries:
             mem_runner.execute(sql).rows
 
     def test_spilled_join_query(self, spill_runner, mem_runner):
-        # join whose agg sides spill
+        # join whose build side AND agg spill (grace hash join path)
         sql = ("select o_orderpriority, count(*) from orders, lineitem "
                "where o_orderkey = l_orderkey and l_quantity > 45 "
                "group by o_orderpriority")
         assert norm(spill_runner.execute(sql).rows) == \
             norm(mem_runner.execute(sql).rows)
+
+    def test_spilled_join_row_level(self, spill_runner, mem_runner):
+        # row-level join output parity through the partitioned replay
+        sql = ("select o_orderkey, l_linenumber, l_quantity from orders "
+               "join lineitem on o_orderkey = l_orderkey "
+               "where o_custkey < 50 order by 1, 2")
+        assert spill_runner.execute(sql).rows == \
+            mem_runner.execute(sql).rows
+
+    def test_spilled_left_join(self, spill_runner, mem_runner):
+        sql = ("select c_custkey, o_orderkey from customer "
+               "left join orders on c_custkey = o_custkey "
+               "where c_custkey < 100 order by 1, 2")
+        assert spill_runner.execute(sql).rows == \
+            mem_runner.execute(sql).rows
+
+    def test_spilled_join_varchar_key(self, spill_runner, mem_runner):
+        # varchar equi-key: partition routing must hash string VALUES
+        sql = ("select n1.n_name, n2.n_name from nation n1 "
+               "join nation n2 on n1.n_name = n2.n_name order by 1")
+        assert spill_runner.execute(sql).rows == \
+            mem_runner.execute(sql).rows
+
+    def test_spilled_semi_join(self, spill_runner, mem_runner):
+        sql = ("select count(*) from orders where o_orderkey in "
+               "(select l_orderkey from lineitem where l_quantity > 48)")
+        assert spill_runner.execute(sql).rows == \
+            mem_runner.execute(sql).rows
