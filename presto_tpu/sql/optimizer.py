@@ -463,13 +463,20 @@ def rewrite_distinct_aggregates(node: AggregationNode) -> PlanNode:
     """Aggregate(keys, [agg(distinct x)]) ->
     Aggregate(keys, [agg(x)]) over Aggregate(keys + x, []).
 
-    Mixed DISTINCT + plain aggregates split into two aggregations over the
-    same source joined back on the group keys (the role the reference's
-    MarkDistinct rewrite plays; NULL group keys pair as in the all-plain
-    path because both sides derive them identically — except that a join
-    on NULL keys drops them, an accepted divergence noted here)."""
-    if not all(a.distinct for a in node.aggregates):
-        return _rewrite_mixed_distinct(node)
+    Several distinct channels and/or mixed DISTINCT + plain aggregates
+    split into one aggregation per distinct channel (plus one for plains)
+    over the same source, joined back on the group keys (the role the
+    reference's MarkDistinct/OptimizeMixedDistinctAggregations rewrites
+    play; a join on NULL group keys drops them, an accepted divergence
+    noted here)."""
+    d_channels = sorted({a.channel for a in node.aggregates if a.distinct})
+    if len(d_channels) == 1 and all(a.distinct for a in node.aggregates):
+        return _rewrite_one_distinct_channel(node)
+    return _rewrite_split_distinct(node, d_channels)
+
+
+def _rewrite_one_distinct_channel(node: AggregationNode) -> PlanNode:
+    """All aggregates DISTINCT over the same single input channel."""
     in_channels = sorted({a.channel for a in node.aggregates
                           if a.channel is not None})
     inner_keys = tuple(node.group_channels) + tuple(in_channels)
@@ -492,39 +499,53 @@ def rewrite_distinct_aggregates(node: AggregationNode) -> PlanNode:
                            tuple(aggs), node.columns)
 
 
-def _rewrite_mixed_distinct(node: AggregationNode) -> PlanNode:
-    """Split mixed aggregates into a distinct-only and a plain-only
-    aggregation over the same source, joined on the group keys (cross
-    join of the two single rows in the global case)."""
+def _rewrite_split_distinct(node: AggregationNode,
+                            d_channels: List[int]) -> PlanNode:
+    """One aggregation branch per distinct channel + one for plain
+    aggregates, all over the same source, joined on the group keys
+    (cross join of single rows in the global case)."""
     ngroups = len(node.group_channels)
-    d_idx = [i for i, a in enumerate(node.aggregates) if a.distinct]
-    p_idx = [i for i, a in enumerate(node.aggregates) if not a.distinct]
     key_cols = tuple(node.columns[:ngroups])
 
-    def agg_node(indices: List[int]) -> AggregationNode:
+    parts: List[List[int]] = []          # aggregate indices per branch
+    for ch in d_channels:
+        parts.append([i for i, a in enumerate(node.aggregates)
+                      if a.distinct and a.channel == ch])
+    plains = [i for i, a in enumerate(node.aggregates) if not a.distinct]
+    if plains:
+        parts.append(plains)
+
+    def agg_node(indices: List[int]) -> PlanNode:
         aggs = tuple(node.aggregates[i] for i in indices)
         cols = key_cols + tuple(node.columns[ngroups + i] for i in indices)
-        return AggregationNode(node.source, node.group_channels, aggs,
-                               cols)
+        branch = AggregationNode(node.source, node.group_channels, aggs,
+                                 cols)
+        if any(a.distinct for a in aggs):
+            return _rewrite_one_distinct_channel(branch)
+        return branch
 
-    left = rewrite_distinct_aggregates(agg_node(d_idx))
-    right = agg_node(p_idx)
-    nleft = len(left.columns)
-    out_cols = tuple(left.columns) + tuple(right.columns)
-    if ngroups:
-        keys = tuple(range(ngroups))
-        joined: PlanNode = JoinNode("inner", left, right, keys, keys,
-                                    out_cols)
-    else:
-        joined = JoinNode("cross", left, right, (), (), out_cols)
-    # restore the original column order: keys, then aggregates interleaved
+    branches = [agg_node(p) for p in parts]
+    joined = branches[0]
+    # position of each original aggregate in the joined output
+    agg_pos: Dict[int, int] = {i: ngroups + k
+                               for k, i in enumerate(parts[0])}
+    for branch, part in zip(branches[1:], parts[1:]):
+        base = len(joined.columns)
+        out_cols = tuple(joined.columns) + tuple(branch.columns)
+        if ngroups:
+            keys = tuple(range(ngroups))
+            joined = JoinNode("inner", joined, branch, keys, keys,
+                              out_cols)
+        else:
+            joined = JoinNode("cross", joined, branch, (), (), out_cols)
+        for k, i in enumerate(part):
+            agg_pos[i] = base + ngroups + k
+    # restore the original column order: keys, then aggregates in order
     exprs: List[RowExpression] = [
         InputRef(i, t) for i, (_, t) in enumerate(key_cols)]
-    d_pos = {i: ngroups + k for k, i in enumerate(d_idx)}
-    p_pos = {i: nleft + ngroups + k for k, i in enumerate(p_idx)}
     for i in range(len(node.aggregates)):
-        src_ch = d_pos.get(i, p_pos.get(i))
-        exprs.append(InputRef(src_ch, out_cols[src_ch][1]))
+        ch = agg_pos[i]
+        exprs.append(InputRef(ch, joined.columns[ch][1]))
     return ProjectNode(joined, tuple(exprs), node.columns)
 
 

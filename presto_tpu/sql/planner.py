@@ -701,9 +701,37 @@ class Planner:
             if q.all:
                 raise SqlAnalysisError(
                     f"{q.op.upper()} ALL is not supported")
-            distinct_left = AggregationNode(lnode, all_ch, (), out_cols)
-            node = SemiJoinNode(distinct_left, rnode, all_ch, all_ch,
-                                negated=(q.op == "except"))
+            # the reference's SetOperationNodeTranslator shape: union both
+            # branches with per-side marker columns, GROUP BY all output
+            # channels (NULL keys group together — distinct semantics,
+            # unlike join matching), then filter on the side counts
+            def marked(node: PlanNode, lv: int, rv: int) -> PlanNode:
+                exprs = tuple(
+                    [B.ref(i, typ) for i, typ in enumerate(common)]
+                    + [B.const(lv, T.BIGINT), B.const(rv, T.BIGINT)])
+                cols = out_cols + (("$l", T.BIGINT), ("$r", T.BIGINT))
+                return ProjectNode(node, exprs, cols)
+
+            u_cols = out_cols + (("$l", T.BIGINT), ("$r", T.BIGINT))
+            u = UnionNode((marked(lnode, 1, 0), marked(rnode, 0, 1)),
+                          u_cols)
+            nch = len(common)
+            aggs = (PlanAggregate(resolve_aggregate("sum", T.BIGINT), nch),
+                    PlanAggregate(resolve_aggregate("sum", T.BIGINT),
+                                  nch + 1))
+            agg_cols = out_cols + (("$lc", T.BIGINT), ("$rc", T.BIGINT))
+            agg = AggregationNode(u, all_ch, aggs, agg_cols)
+            lc = B.ref(nch, T.BIGINT)
+            rc = B.ref(nch + 1, T.BIGINT)
+            in_left = B.comparison(">", lc, B.const(0, T.BIGINT))
+            in_right = B.comparison(
+                ">" if q.op == "intersect" else "=",
+                rc, B.const(0, T.BIGINT))
+            filt = FilterNode(agg, B.and_(in_left, in_right))
+            node = ProjectNode(
+                filt,
+                tuple(B.ref(i, typ) for i, typ in enumerate(common)),
+                out_cols)
         else:
             raise SqlAnalysisError(f"unknown set operation {q.op}")
 

@@ -1,0 +1,96 @@
+"""Set-operation NULL semantics and multi-channel distinct aggregates.
+
+Reference models: SetOperationNodeTranslator (markers + GROUP BY, so NULL
+keys use distinct semantics, not join matching) and the MarkDistinct /
+OptimizeMixedDistinctAggregations rewrites
+(presto-main/.../sql/planner/optimizations/)."""
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+def rows(runner, sql):
+    key = lambda v: (v is None, v)  # noqa: E731
+    return sorted(runner.execute(sql).rows,
+                  key=lambda r: tuple(key(v) for v in r))
+
+
+class TestSetOpNulls:
+    def test_intersect_keeps_null(self, runner):
+        assert rows(runner,
+                    "select x from (values (1),(null),(2)) a(x) intersect "
+                    "select y from (values (null),(2),(3)) b(y)") \
+            == [(2,), (None,)]
+
+    def test_except_keeps_null(self, runner):
+        assert rows(runner,
+                    "select x from (values (1),(null),(2)) a(x) except "
+                    "select y from (values (2)) b(y)") == [(1,), (None,)]
+
+    def test_except_removes_null(self, runner):
+        assert rows(runner,
+                    "select x from (values (1),(null)) a(x) except "
+                    "select y from (values (null)) b(y)") == [(1,)]
+
+    def test_intersect_distinct_output(self, runner):
+        assert rows(runner,
+                    "select x from (values (1),(2),(2)) a(x) intersect "
+                    "select y from (values (2),(2),(5)) b(y)") == [(2,)]
+
+    def test_multi_column(self, runner):
+        assert rows(runner,
+                    "select * from (values (1,null),(2,'b')) a(x,y) "
+                    "intersect select * from (values (1,null),(3,'c')) "
+                    "b(x,y)") == [(1, None)]
+
+    def test_tpch_intersect(self, runner):
+        got = rows(runner,
+                   "select o_orderkey from orders where o_orderkey < 10 "
+                   "intersect select l_orderkey from lineitem "
+                   "where l_orderkey < 8")
+        want = rows(runner,
+                    "select distinct o_orderkey from orders "
+                    "where o_orderkey < 8")
+        assert got == want
+
+
+class TestMultiDistinct:
+    def test_two_distinct_channels(self, runner):
+        assert runner.execute(
+            "select count(distinct l_suppkey), count(distinct l_partkey) "
+            "from lineitem").rows == [(100, 2000)]
+
+    def test_grouped_two_distinct_plus_plain(self, runner):
+        got = runner.execute(
+            "select l_returnflag, count(distinct l_suppkey), "
+            "count(distinct l_shipmode), count(*) from lineitem "
+            "group by l_returnflag order by 1").rows
+        # oracles from single-distinct queries
+        for rf, ds, dm, cnt in got:
+            (ds2,) = runner.execute(
+                f"select count(distinct l_suppkey) from lineitem "
+                f"where l_returnflag = '{rf}'").rows[0]
+            (dm2,) = runner.execute(
+                f"select count(distinct l_shipmode) from lineitem "
+                f"where l_returnflag = '{rf}'").rows[0]
+            assert (ds, dm) == (ds2, dm2)
+
+    def test_global_mixed(self, runner):
+        (a, b, c) = runner.execute(
+            "select count(distinct l_suppkey), sum(distinct l_linenumber),"
+            " count(*) from lineitem").rows[0]
+        assert a == 100 and b == 1 + 2 + 3 + 4 + 5 + 6 + 7
+        (total,) = runner.execute(
+            "select count(*) from lineitem").rows[0]
+        assert c == total
+
+    def test_same_channel_two_aggs(self, runner):
+        assert runner.execute(
+            "select count(distinct l_linenumber), "
+            "sum(distinct l_linenumber) from lineitem").rows == [(7, 28)]
