@@ -26,7 +26,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from presto_tpu import types as T
-from presto_tpu.batch import Batch
+from presto_tpu.batch import Batch, Column
 from presto_tpu.exec.context import OperatorContext
 from presto_tpu.exec.operator import Operator, OperatorFactory
 
@@ -80,46 +80,99 @@ class DynamicFilterOperator(Operator):
         self.dyn = dyn
         self.key_channels = list(key_channels)
         self._pending: Optional[Batch] = None
+        self._kernels = {}
+        # adaptive shutoff (the reference disables ineffective dynamic
+        # filters): stop filtering once observed selectivity is poor —
+        # un-pruned rows cost nothing extra in static-shape kernels, but
+        # each filter application costs a device round-trip
+        self._rows_seen = 0
+        self._rows_kept = 0
+        self._adaptive_off = False
 
     def needs_input(self) -> bool:
         return not self._finishing and self._pending is None
 
+    def _kernel_for(self, batch: Batch):
+        """One jitted mask+compact program per capacity (eager per-batch
+        dispatch costs dominate on remote-attached devices; this also
+        keeps one host sync per batch)."""
+        import jax
+
+        key = batch.capacity
+        hit = self._kernels.get(key)
+        if hit is not None:
+            return hit
+        import jax.numpy as jnp
+
+        from presto_tpu.ops.filter import selected_positions
+
+        cap = batch.capacity
+        filters = []
+        for i, ch in enumerate(self.key_channels):
+            if self.dyn.mins[i] is None:
+                continue
+            filters.append((ch, np.asarray(self.dyn.mins[i]),
+                            np.asarray(self.dyn.maxs[i]),
+                            self.dyn.sets[i]))
+        if not filters:
+            self._kernels[key] = None
+            return None
+
+        def kernel(cols, num_rows):
+            mask = jnp.ones(cap, bool)
+            for ch, mn, mx, st in filters:
+                v, valid = cols[ch]
+                m = (v >= jnp.asarray(mn, v.dtype)) & \
+                    (v <= jnp.asarray(mx, v.dtype))
+                if st is not None:
+                    table = jnp.asarray(st.astype(np.asarray(mn).dtype))
+                    idx = jnp.clip(jnp.searchsorted(table, v), 0,
+                                   table.shape[0] - 1)
+                    m = m & (table[idx] == v)
+                if valid is not None:
+                    m = m & valid
+                mask = mask & m
+            idx, count = selected_positions(mask, None, num_rows, cap)
+            gathered = tuple(
+                (v[idx], None if valid is None else valid[idx])
+                for v, valid in cols)
+            return gathered, count
+
+        jitted = jax.jit(kernel)
+        self._kernels[key] = jitted
+        return jitted
+
     def add_input(self, batch: Batch) -> None:
         self.ctx.stats.input_rows += batch.num_rows
-        if not self.dyn.ready or self.dyn.disabled:
+        if (not self.dyn.ready or self.dyn.disabled
+                or self._adaptive_off):
             self._pending = batch  # no filter info: pass through
             return
         if self.dyn.build_empty:
             return  # inner join against empty build: nothing survives
-        import jax.numpy as jnp
-
-        mask = None
-        for i, ch in enumerate(self.key_channels):
-            if self.dyn.mins[i] is None:
-                continue
-            col = batch.columns[ch]
-            v = col.values
-            m = (v >= jnp.asarray(self.dyn.mins[i], v.dtype)) & \
-                (v <= jnp.asarray(self.dyn.maxs[i], v.dtype))
-            if self.dyn.sets[i] is not None:
-                table = jnp.asarray(self.dyn.sets[i].astype(
-                    np.asarray(v).dtype))
-                idx = jnp.clip(jnp.searchsorted(table, v), 0,
-                               table.shape[0] - 1)
-                m = m & (table[idx] == v)
-            if col.valid is not None:
-                m = m & col.valid
-            mask = m if mask is None else (mask & m)
-        if mask is None:
+        if any(c.type.is_nested for c in batch.columns):
+            self._pending = batch  # nested payloads: pass through
+            return
+        kernel = self._kernel_for(batch)
+        if kernel is None:
             self._pending = batch
             return
-        live = jnp.arange(batch.capacity) < batch.num_rows
-        keep = jnp.nonzero(mask & live)[0]
-        n_keep = int(keep.shape[0])
+        from presto_tpu.exec.operator import column_pairs
+
+        outs, count = kernel(tuple(column_pairs(batch)), batch.num_rows)
+        n_keep = int(count)
+        self._rows_seen += batch.num_rows
+        self._rows_kept += n_keep
+        if self._rows_seen >= 4096 and \
+                self._rows_kept > 0.95 * self._rows_seen:
+            self._adaptive_off = True
         if n_keep == batch.num_rows:
             self._pending = batch
         elif n_keep > 0:
-            self._pending = batch.take(keep)
+            cols = tuple(
+                Column(c.type, v, valid, c.dictionary)
+                for c, (v, valid) in zip(batch.columns, outs))
+            self._pending = Batch(cols, n_keep)
         # else: fully pruned, emit nothing
         self.ctx.stats.output_rows += n_keep
 

@@ -22,8 +22,11 @@ batch shape), with output-capacity retry on expansion overflow.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial as _partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu import types as T
@@ -88,6 +91,51 @@ class SpilledLookupSource:
     input_types: List[T.Type]
 
     mode: str = "spilled"
+
+
+@jax.jit
+def _build_index_single(kv_pair, num_rows):
+    """Single-word build: ids + sorted index, one XLA program."""
+    from presto_tpu.ops import join as J
+
+    values, valid = kv_pair
+    cap = values.shape[0]
+    dead = jnp.arange(cap) >= num_rows
+    if valid is not None:
+        dead = dead | ~valid
+    ids = jnp.where(dead, jnp.int64(-2), values.astype(jnp.int64) + 2)
+    return J.build_index(ids)
+
+
+@jax.jit
+def _key_ranges(pairs, num_rows):
+    """Per-key-channel live [min, max] (packed-mode ranges), one program,
+    one small host transfer."""
+    cap = pairs[0][0].shape[0]
+    base_dead = jnp.arange(cap) >= num_rows
+    los, his = [], []
+    for values, valid in pairs:
+        dead = base_dead if valid is None else (base_dead | ~valid)
+        v = values.astype(jnp.int64)
+        los.append(jnp.where(dead, jnp.int64(2**62), v).min())
+        his.append(jnp.where(dead, jnp.int64(-2**62), v).max())
+    return jnp.stack(los), jnp.stack(his)
+
+
+@jax.jit
+def _build_index_packed(pairs, mins, strides, num_rows):
+    """Packed multi-key build: mixed-radix ids + sorted index."""
+    from presto_tpu.ops import join as J
+
+    cap = pairs[0][0].shape[0]
+    dead = jnp.arange(cap) >= num_rows
+    ids = jnp.zeros(cap, jnp.int64)
+    for i, (values, valid) in enumerate(pairs):
+        if valid is not None:
+            dead = dead | ~valid
+        ids = ids + (values.astype(jnp.int64) - mins[i]) * strides[i]
+    ids = jnp.where(dead, jnp.int64(-2), ids)
+    return J.build_index(ids)
 
 
 class HashBuildOperator(Operator):
@@ -158,47 +206,36 @@ class HashBuildOperator(Operator):
                              self.ctx.config.min_batch_capacity)
         self._batches = []
         chans = self.f.key_channels
-        cap_b = data.capacity
         n_build = data.num_rows
-        dead = jnp.arange(cap_b) >= n_build
-        for c in chans:
-            if data.columns[c].valid is not None:
-                dead = dead | ~data.columns[c].valid
+        n = jnp.asarray(n_build)
+        key_pairs = tuple(
+            (data.columns[c].values, data.columns[c].valid) for c in chans)
         if len(chans) == 1 and _is_single_word_type(data.columns[chans[0]].type):
-            ids = data.columns[chans[0]].values.astype(jnp.int64) + 2
-            ids = jnp.where(dead, jnp.int64(-2), ids)
-            sb, perm = J.build_index(ids)
+            sb, perm = _build_index_single(key_pairs[0], n)
             self.f.lookup.set(LookupSource("single", sb, perm, data, n_build,
                                            chans))
             return
         if all(_is_single_word_type(data.columns[c].type) for c in chans):
             # pack multi-channel integer keys using build-side ranges
-            mins, maxs, strides = [], [], []
-            live_any = n_build > 0
+            los, his = _key_ranges(key_pairs, n)        # one host sync
+            los = np.asarray(los)
+            his = np.asarray(his)
+            empty = bool((los > his).any())             # no live rows
+            if empty:
+                los = np.zeros_like(los)
+                his = np.zeros_like(his)
+            strides = []
             span_product = 1
-            for c in chans:
-                v = np.asarray(data.columns[c].values.astype(jnp.int64))
-                livemask = ~np.asarray(dead)
-                lv = v[livemask] if live_any else np.zeros(1, np.int64)
-                lo = int(lv.min()) if lv.size else 0
-                hi = int(lv.max()) if lv.size else 0
-                mins.append(lo)
-                maxs.append(hi)
+            for lo, hi in zip(los, his):
                 strides.append(span_product)
-                span_product *= (hi - lo + 1)
+                span_product *= int(hi - lo + 1)
             if span_product < (1 << 62):
-                mins_a = np.asarray(mins, np.int64)
-                maxs_a = np.asarray(maxs, np.int64)
                 strides_a = np.asarray(strides, np.int64)
-                ids = jnp.zeros(cap_b, jnp.int64)
-                for i, c in enumerate(chans):
-                    v = data.columns[c].values.astype(jnp.int64)
-                    ids = ids + (v - int(mins_a[i])) * int(strides_a[i])
-                ids = jnp.where(dead, jnp.int64(-2), ids)
-                sb, perm = J.build_index(ids)
+                sb, perm = _build_index_packed(
+                    key_pairs, jnp.asarray(los), jnp.asarray(strides_a), n)
                 self.f.lookup.set(LookupSource(
                     "packed", sb, perm, data, n_build, chans,
-                    mins=mins_a, strides=strides_a, maxs=maxs_a))
+                    mins=los, strides=strides_a, maxs=his))
                 return
         # general path: probe side will materialize and union-sort
         self.f.lookup.set(LookupSource("canonical", None, None, data,
@@ -224,6 +261,100 @@ class HashBuildOperatorFactory(OperatorFactory):
 
     def create(self, ctx: OperatorContext) -> HashBuildOperator:
         return HashBuildOperator(ctx, self)
+
+
+def _ids_from_pairs(jnp, pairs, key_channels, mode, mins, strides, maxs,
+                    num_rows):
+    """Probe ids for 'single'/'packed' modes over (values, valid) pairs."""
+    cap = pairs[0][0].shape[0]
+    dead = jnp.arange(cap) >= num_rows
+    for c in key_channels:
+        if pairs[c][1] is not None:
+            dead = dead | ~pairs[c][1]
+    if mode == "single":
+        ids = pairs[key_channels[0]][0].astype(jnp.int64) + 2
+        return jnp.where(dead, jnp.int64(-1), ids)
+    ids = jnp.zeros(cap, jnp.int64)
+    for i, c in enumerate(key_channels):
+        v = pairs[c][0].astype(jnp.int64)
+        dead = dead | (v < mins[i]) | (v > maxs[i])
+        ids = ids + (v - mins[i]) * strides[i]
+    return jnp.where(dead, jnp.int64(-1), ids)
+
+
+@dataclasses.dataclass(frozen=True)
+class _StreamStatics:
+    """Hashable static config for the module-level probe kernels; one jit
+    cache entry per distinct value + input shapes (the JoinCompiler
+    specialization key, shared GLOBALLY across operators and queries —
+    closures would re-trace per operator instance)."""
+
+    mode: str
+    join_type: str
+    key_channels: Tuple[int, ...]
+    out_cap: int
+    n_probe_cols: int
+
+
+@_partial(jax.jit, static_argnames=("key_channels", "mode", "join_type"))
+def _probe_expand_total(probe_pairs, sorted_ids, perm, mins, strides,
+                        maxs, num_rows, *, key_channels, mode, join_type):
+    """Phase 1: exact expansion size for this batch (so phase 2 compiles
+    at the right capacity bucket on the first try)."""
+    from presto_tpu.ops import join as J
+
+    ids = _ids_from_pairs(jnp, probe_pairs, key_channels, mode, mins,
+                          strides, maxs, num_rows)
+    _, counts = J.probe_counts(sorted_ids, perm, ids)
+    if join_type == "left":
+        cap = probe_pairs[0][0].shape[0]
+        live_probe = jnp.arange(cap) < num_rows
+        return jnp.where(live_probe, jnp.maximum(counts, 1), 0).sum()
+    return counts.sum()
+
+
+@_partial(jax.jit, static_argnames=("s",))
+def _stream_probe(probe_pairs, build_pairs, sorted_ids, perm, mins,
+                  strides, maxs, num_rows, *, s: _StreamStatics):
+    """Phase 2: the streaming probe kernel (inner/left expansion or
+    semi/anti masks) as one XLA program.  All build-side data arrives as
+    traced arguments: nothing is baked into the executable, so the
+    compile caches by shape + statics only."""
+    from presto_tpu.ops import join as J
+    from presto_tpu.ops.filter import selected_positions
+
+    cap = probe_pairs[0][0].shape[0]
+    ids = _ids_from_pairs(jnp, probe_pairs, s.key_channels, s.mode, mins,
+                          strides, maxs, num_rows)
+    lo, counts = J.probe_counts(sorted_ids, perm, ids)
+    live = ids >= 0
+    if s.join_type in ("semi", "anti"):
+        mask = J.semi_mask(counts, live, anti=(s.join_type == "anti"))
+        if s.join_type == "anti":
+            pad = jnp.arange(cap) >= num_rows
+            mask = mask | ((~live) & (~pad))   # NOT IN keeps null-key rows
+        idx, count = selected_positions(mask, None, num_rows, cap)
+        idx = idx.astype(jnp.int32)
+        outs = tuple(
+            (v[idx], None if valid is None else valid[idx])
+            for v, valid in probe_pairs)
+        return outs, count, jnp.int64(0)
+    if s.join_type == "left":
+        pi, bi, rv, unmatched, total = J.expand_matches_outer(
+            lo, counts, jnp.arange(cap) < num_rows, perm, s.out_cap)
+    else:
+        pi, bi, rv, unmatched, total = J.expand_matches(
+            lo, counts, perm, s.out_cap)
+    pi = pi.astype(jnp.int32)
+    bi = bi.astype(jnp.int32)
+    outs = []
+    for v, valid in probe_pairs:
+        outs.append((v[pi], None if valid is None else valid[pi]))
+    ones = jnp.ones(s.out_cap, bool)
+    for v, valid in build_pairs:
+        bvalid = ones if valid is None else valid[bi]
+        outs.append((v[bi], bvalid & ~unmatched))
+    return tuple(outs), total, total
 
 
 class LookupJoinOperator(Operator):
@@ -307,10 +438,9 @@ class LookupJoinOperator(Operator):
         join_type = self.f.join_type
         cap = batch.capacity
         n = jnp.asarray(batch.num_rows)
-        if join_type in ("semi", "anti") and self.f.residual is None:
-            out_cap = cap
-        else:
-            out_cap = next_bucket(cap * self.f.expansion)
+        if self.f.residual is None:
+            return self._probe_streaming_global(src, batch, n)
+        out_cap = next_bucket(cap * self.f.expansion)
         cres = self._residual_compiled(batch, src)
         while True:
             kernel = self._kernel(src, cap, out_cap, cres)
@@ -332,6 +462,57 @@ class LookupJoinOperator(Operator):
             for c, (v, valid) in zip(src.data.columns, outs[nb:]):
                 cols.append(Column(c.type, v, valid, c.dictionary))
         out = Batch(tuple(cols), min(total, out_cap))
+        self.ctx.stats.output_rows += out.num_rows
+        return out
+
+    def _probe_streaming_global(self, src: LookupSource, batch: Batch,
+                                n) -> Optional[Batch]:
+        """Residual-free probe through the globally-cached module kernels:
+        count phase picks the exact output bucket, expand phase never
+        overflows, and compiles are shared across operators and queries
+        with the same shapes."""
+        import jax.numpy as jnp
+
+        join_type = self.f.join_type
+        cap = batch.capacity
+        kc = tuple(self.f.probe_key_channels)
+        if src.mode == "packed":
+            mins = jnp.asarray(src.mins)
+            strides = jnp.asarray(src.strides)
+            maxs = jnp.asarray(src.maxs)
+        else:
+            mins = strides = maxs = jnp.zeros(1, jnp.int64)
+        probe_pairs = tuple(column_pairs(batch))
+        build_pairs = tuple(column_pairs(src.data))
+        if join_type in ("semi", "anti"):
+            out_cap = 0
+        else:
+            etotal = int(_probe_expand_total(
+                probe_pairs, src.sorted_ids, src.perm, mins, strides, maxs,
+                n, key_channels=kc, mode=src.mode, join_type=join_type))
+            out_cap = next_bucket(max(etotal, 1))
+        s = _StreamStatics(src.mode, join_type, kc, out_cap,
+                           batch.num_columns)
+        outs, count, _ = _stream_probe(
+            probe_pairs, build_pairs, src.sorted_ids, src.perm, mins,
+            strides, maxs, n, s=s)
+        # expansion joins already synced the exact total in phase 1; only
+        # semi/anti need to read the selected count (host round-trips are
+        # ~1s each on remote-attached devices)
+        total = etotal if join_type not in ("semi", "anti") else int(count)
+        cols = []
+        probe_cols = [batch.columns[i] for i in range(batch.num_columns)]
+        if join_type in ("semi", "anti"):
+            for c, (v, valid) in zip(probe_cols, outs):
+                cols.append(Column(c.type, v, valid, c.dictionary))
+        else:
+            nb = batch.num_columns
+            for c, (v, valid) in zip(probe_cols, outs[:nb]):
+                cols.append(Column(c.type, v, valid, c.dictionary))
+            for c, (v, valid) in zip(src.data.columns, outs[nb:]):
+                cols.append(Column(c.type, v, valid, c.dictionary))
+        out = Batch(tuple(cols), total if out_cap == 0
+                    else min(total, out_cap))
         self.ctx.stats.output_rows += out.num_rows
         return out
 
