@@ -111,6 +111,10 @@ class LocalQueryRunner:
 
     def _execute_statement(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
+        if isinstance(stmt, t.CallProcedure):
+            raise ValueError(
+                "procedures (kill_query) run on a coordinator; the "
+                "single-process runner executes queries synchronously")
         if isinstance(stmt, t.Explain):
             text = (self.explain_analyze_text(stmt.statement)
                     if stmt.analyze else self.explain_text(stmt.statement))

@@ -97,6 +97,7 @@ class QueryExecution:
         self.co = coordinator
         self.user = user
         self.state = "QUEUED"
+        self.canceled = False
         self.error: Optional[str] = None
         self.column_names: List[str] = []
         self.column_types: List[T.Type] = []
@@ -127,6 +128,10 @@ class QueryExecution:
         try:
             self.state = "PLANNING"
             stmt = parse_statement(self.sql)
+            if isinstance(stmt, t.CallProcedure):
+                self._run_procedure(stmt)
+                self.state = "FINISHED"
+                return
             if not isinstance(stmt, (t.Query, t.SetOperation)):
                 raise ValueError("distributed execution supports queries")
             metadata = Metadata(self.co.registry, self.co.default_catalog)
@@ -223,10 +228,40 @@ class QueryExecution:
                 raise RuntimeError(f"task create failed: {info}")
 
     # -- result drain ---------------------------------------------------
+    def _run_procedure(self, stmt: t.CallProcedure) -> None:
+        """system.runtime.kill_query (KillQueryProcedure.java role)."""
+        name = ".".join(stmt.name)
+        if name not in ("system.runtime.kill_query", "kill_query"):
+            raise ValueError(f"unknown procedure {name}")
+        if len(stmt.args) < 1 or not isinstance(stmt.args[0],
+                                                t.StringLiteral):
+            raise ValueError("kill_query(query_id) requires a string id")
+        target = self.co.queries.get(stmt.args[0].value)
+        if target is None:
+            raise ValueError(f"no such query {stmt.args[0].value!r}")
+        target.cancel()
+        self.column_names = ["result"]
+        self.column_types = [T.VARCHAR]
+        self.result_rows = [("killed",)]
+
+    def cancel(self) -> None:
+        """Kill this query (KillQueryProcedure role): flag the drain loop
+        and cancel every worker task."""
+        self.canceled = True
+        for _, wuri in self.co.nodes.alive_nodes():
+            try:
+                req = urllib.request.Request(
+                    f"{wuri}/v1/query/{self.query_id}", method="DELETE")
+                urllib.request.urlopen(req, timeout=5).close()
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+
     def _drain(self, locations: List[str]) -> None:
         for loc in locations:
             token = 0
             while True:
+                if getattr(self, "canceled", False):
+                    raise RuntimeError("Query killed")
                 url = f"{loc}/{token}"
                 with urllib.request.urlopen(url, timeout=120) as resp:
                     complete = resp.headers.get(
@@ -379,6 +414,18 @@ class CoordinatorServer:
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
 
+            def do_DELETE(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                    q = co.queries.get(parts[2])
+                    if q is None:
+                        self._json(404, {"error": "no such query"})
+                        return
+                    q.cancel()
+                    self._json(200, {"killed": parts[2]})
+                    return
+                self._json(404, {"error": f"bad path {self.path}"})
+
             def do_GET(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
                 if parts[:3] == ["v1", "statement", "executing"] \
@@ -411,6 +458,21 @@ class CoordinatorServer:
                          "user": q.user,
                          "query": q.sql[:200]}
                         for q in co.queries.values()])
+                    return
+                if parts == ["v1", "tasks"]:
+                    # aggregate live task state from every worker
+                    # (system.runtime.tasks)
+                    out = []
+                    for nid, uri in co.nodes.alive_nodes():
+                        try:
+                            with urllib.request.urlopen(
+                                    f"{uri}/v1/task", timeout=5) as resp:
+                                for t in json.loads(resp.read()):
+                                    t["nodeId"] = nid
+                                    out.append(t)
+                        except Exception:  # noqa: BLE001 - node flaky
+                            pass
+                    self._json(200, out)
                     return
                 if parts[:2] == ["v1", "query"] and len(parts) == 3:
                     q = co.queries.get(parts[2])

@@ -56,8 +56,14 @@ class DistributedQueryRunner:
                 return [(q["queryId"], q["state"], q["query"])
                         for q in fetch("/v1/query")]
 
+            def tasks_fn():
+                return [(t["taskId"], t["state"],
+                         t["taskId"].rsplit(".", 2)[0])
+                        for t in fetch("/v1/tasks")]
+
             reg.register("system", SystemConnector(
-                nodes_fn=nodes_fn, queries_fn=queries_fn))
+                nodes_fn=nodes_fn, queries_fn=queries_fn,
+                tasks_fn=tasks_fn))
             return reg
 
         # the coordinator needs the system schemas for planning (data is

@@ -107,6 +107,22 @@ class SqlTaskManager:
         with self._lock:
             return self.tasks.get(task_id)
 
+    def list_infos(self) -> List[Dict]:
+        with self._lock:
+            return [t.info() for t in self.tasks.values()]
+
+    def cancel_query(self, query_id: str) -> int:
+        """Cancel every task belonging to ``query_id`` (task ids are
+        ``{queryId}.{fragment}.{i}``); the KillQueryProcedure role."""
+        n = 0
+        with self._lock:
+            tasks = list(self.tasks.values())
+        for t in tasks:
+            if t.task_id.startswith(query_id + "."):
+                t.cancel()
+                n += 1
+        return n
+
     def cancel_all(self) -> None:
         with self._lock:
             for task in self.tasks.values():

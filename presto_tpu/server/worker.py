@@ -55,6 +55,9 @@ class WorkerServer:
                     self._json(200, {"nodeId": worker.node_id,
                                      "state": "ACTIVE"})
                     return
+                if parts == ["v1", "task"]:
+                    self._json(200, worker.task_manager.list_infos())
+                    return
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
                     task = worker.task_manager.get(parts[2])
                     if task is None:
@@ -129,6 +132,10 @@ class WorkerServer:
                     if task is not None:
                         task.cancel()
                     self._json(200, {"canceled": True})
+                    return
+                if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                    n = worker.task_manager.cancel_query(parts[2])
+                    self._json(200, {"canceledTasks": n})
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
 

@@ -40,6 +40,7 @@ KEYWORDS = {
     "unbounded", "preceding", "following", "current", "row", "create",
     "table", "insert", "into", "drop", "values", "set", "reset", "session",
     "grouping", "sets", "rollup", "cube", "array", "unnest", "ordinality",
+    "call",
 }
 
 _TWO_CHAR = ("<=", ">=", "<>", "!=", "||", "->")

@@ -104,6 +104,18 @@ class _Parser:
 
     # --- statements --------------------------------------------------------
     def parse_statement(self) -> t.Node:
+        if self.accept_kw("call"):
+            name = self.qualified_name()
+            self.expect_op("(")
+            args: List[t.Expression] = []
+            if not self.at_op(")"):
+                args.append(self.expression())
+                while self.accept_op(","):
+                    args.append(self.expression())
+            self.expect_op(")")
+            self.accept_op(";")
+            self.expect_eof()
+            return t.CallProcedure(name, tuple(args))
         if self.accept_kw("explain"):
             analyze = bool(self.accept_kw("analyze"))
             inner = self.parse_statement()

@@ -366,6 +366,12 @@ class DropTable(Node):
 
 
 @D(frozen=True)
+class CallProcedure(Node):
+    name: Tuple[str, ...]            # e.g. ('system', 'runtime', 'kill_query')
+    args: Tuple["Expression", ...]
+
+
+@D(frozen=True)
 class Explain(Node):
     statement: Node
     analyze: bool = False

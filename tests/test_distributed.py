@@ -177,3 +177,45 @@ def test_web_ui_served(cluster):
         body = resp.read().decode()
     assert resp.status == 200
     assert "tpu-sql cluster" in body and "/v1/query" in body
+
+
+def test_system_tasks_live(cluster):
+    cluster.execute("select count(*) from lineitem")
+    rows = cluster.execute("select * from system.tasks").rows
+    assert rows, "no tasks reported"
+    for task_id, state, query_id in rows:
+        assert task_id.startswith(query_id)
+        assert state in ("RUNNING", "FINISHED", "FAILED", "CANCELED")
+
+
+def test_kill_query_procedure(cluster):
+    """CALL system.runtime.kill_query (KillQueryProcedure.java role)."""
+    import json
+    import time
+    import urllib.request
+
+    body = ("select count(*) from lineitem l1, lineitem l2 "
+            "where l1.l_orderkey = l2.l_orderkey").encode()
+    req = urllib.request.Request(
+        cluster.coordinator.uri + "/v1/statement", data=body, method="POST")
+    qid = json.loads(urllib.request.urlopen(req, timeout=10).read())["id"]
+    assert cluster.execute(
+        f"call system.runtime.kill_query('{qid}')").rows == [("killed",)]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        qs = json.loads(urllib.request.urlopen(
+            cluster.coordinator.uri + "/v1/query", timeout=10).read())
+        state = next(q["state"] for q in qs if q["queryId"] == qid)
+        if state in ("FAILED", "FINISHED"):
+            break
+        time.sleep(0.5)
+    assert state == "FAILED"
+
+
+def test_kill_unknown_query_fails(cluster):
+    import pytest as _pytest
+
+    from presto_tpu.client import QueryFailed
+
+    with _pytest.raises(QueryFailed):
+        cluster.execute("call system.runtime.kill_query('nope')")
