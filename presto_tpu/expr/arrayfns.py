@@ -579,6 +579,102 @@ def map_concat(typ: T.Type):
     return impl
 
 
+def _pad_to(col: Column, target_lengths: np.ndarray) -> Column:
+    """Re-space an array column's elements to target per-row lengths,
+    null-padding the tail (zip/zip_with alignment)."""
+    lengths = _lengths(col)
+    offsets = _offsets(col)
+    total = int(target_lengths.sum())
+    row_of = np.repeat(np.arange(lengths.shape[0], dtype=np.int64),
+                       target_lengths)
+    ends = np.cumsum(target_lengths)
+    within = np.arange(total, dtype=np.int64) - \
+        np.repeat(ends - target_lengths, target_lengths)
+    present = within < lengths[row_of]
+    idx = offsets[row_of] + np.minimum(within,
+                                       np.maximum(lengths[row_of] - 1, 0))
+    kid = col.children[0]
+    if kid.values.shape[0] == 0:
+        from presto_tpu.batch import empty_column
+
+        out = empty_column(kid.type).pad(total)
+        return Column(out.type, out.values, np.zeros(total, bool),
+                      out.dictionary, out.children)
+    idx = np.clip(idx, 0, kid.values.shape[0] - 1)
+    taken = kid.take(idx)
+    valid = present if taken.valid is None \
+        else present & np.asarray(taken.valid)
+    return Column(taken.type, taken.values, valid, taken.dictionary,
+                  taken.children)
+
+
+def zip_fn(typ: T.Type):
+    """zip(a1, a2, ...) -> array(row(...)), null-padded to the longest."""
+
+    def impl(args, valids, n, xp) -> Pair:
+        maxlen = _lengths(args[0])
+        for c in args[1:]:
+            maxlen = np.maximum(maxlen, _lengths(c))
+        kids = tuple(_pad_to(c, maxlen) for c in args)
+        total = int(maxlen.sum())
+        row_col = Column(typ.element, np.zeros(total, np.int8), None,
+                         None, kids)
+        return _rebuild(typ, maxlen, [row_col]), _and_all(*valids)
+
+    return impl
+
+
+def zip_with(typ: T.Type):
+    """zip_with(a, b, (x, y) -> f): elementwise over null-padded pairs."""
+
+    def impl(args, valids, n, xp, lambdas=None) -> Pair:
+        a, b = args
+        body = lambdas[0]
+        maxlen = np.maximum(_lengths(a), _lengths(b))
+        ka = _pad_to(a, maxlen)
+        kb = _pad_to(b, maxlen)
+        total = int(maxlen.sum())
+        out_vals, out_valid = body([ka, kb], _row_ids(maxlen), total)
+        kid = _kid_from_value(typ.element, out_vals, out_valid)
+        return _rebuild(typ, maxlen, [kid]), _and_all(*valids)
+
+    return impl
+
+
+def map_entries(typ: T.Type):
+    """map_entries(m) -> array(row(key, value))."""
+
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        lengths = _lengths(col)
+        total = int(lengths.sum())
+        row_col = Column(typ.element, np.zeros(total, np.int8), None,
+                         None, tuple(col.children))
+        return _rebuild(typ, lengths, [row_col]), _and_all(*valids)
+
+    return impl
+
+
+def array_average():
+    def impl(args, valids, n, xp) -> Pair:
+        (col,) = args
+        lengths = _lengths(col)
+        row_of = _row_ids(lengths)
+        kid = col.children[0]
+        vals = np.asarray(kid.values, np.float64)
+        live = np.ones(vals.shape[0], bool) if kid.valid is None \
+            else np.asarray(kid.valid)
+        sums = np.zeros(n, np.float64)
+        cnts = np.zeros(n, np.int64)
+        np.add.at(sums, row_of[live], vals[live])
+        np.add.at(cnts, row_of[live], 1)
+        ok = cnts > 0
+        out = sums / np.maximum(cnts, 1)
+        return out, _and_all(ok, *valids)
+
+    return impl
+
+
 def map_from_entries(typ: T.Type):
     def impl(args, valids, n, xp) -> Pair:
         (col,) = args                   # array(row(k, v))

@@ -623,6 +623,22 @@ class _Parser:
         if tok.kind in ("IDENT", "QIDENT"):
             # function call?
             if (self.peek(1).kind == "OP" and self.peek(1).text == "("):
+                if tok.text == "try_cast":
+                    self.next()
+                    self.expect_op("(")
+                    e = self.expression()
+                    self.expect_kw("as")
+                    type_name = self.type_name()
+                    self.expect_op(")")
+                    return t.TryCast(e, type_name)
+                if tok.text == "position":
+                    self.next()
+                    self.expect_op("(")
+                    needle = self.additive()   # below the IN predicate
+                    self.expect_kw("in")
+                    hay = self.expression()
+                    self.expect_op(")")
+                    return t.FunctionCall("strpos", (hay, needle))
                 return self.function_call(self.identifier())
             return t.Identifier(self.qualified_name())
         raise SqlSyntaxError(f"unexpected {tok.text or 'end of input'!r}",

@@ -234,6 +234,15 @@ class Translator:
                 from presto_tpu.expr.ir import VarRef
 
                 return VarRef(e.parts[0], self.lambda_env[e.parts[0]])
+            if len(e.parts) == 1 and e.parts[0] in (
+                    "current_date", "current_timestamp", "localtimestamp"):
+                # fixed at query (translation) start, Presto semantics
+                import time as _time
+
+                now_us = int(_time.time() * 1e6)
+                if e.parts[0] == "current_date":
+                    return B.const(now_us // 86_400_000_000, T.DATE)
+                return B.const(now_us, T.TIMESTAMP)
             idx = self.scope.try_resolve(e.parts)
             if idx is None:
                 # row-field access spelled as a qualified name: resolve the
@@ -327,6 +336,13 @@ class Translator:
                                first.type)
         if isinstance(e, t.Cast):
             return B.cast(self.translate(e.expr), T.parse_type(e.type_name))
+        if isinstance(e, t.TryCast):
+            arg = self.translate(e.expr)
+            to = T.parse_type(e.type_name)
+            if arg.type == to or isinstance(arg.type, T.UnknownType):
+                return B.cast(arg, to)
+            fn = F.resolve_try_cast(arg.type, to)
+            return Call("try_cast", (arg,), to, fn)
         if isinstance(e, t.Extract):
             return B.call(f"extract_{e.field.lower()}",
                           self.translate(e.expr))
@@ -416,6 +432,17 @@ class Translator:
             return self._higher_order_call(name, e)
         if name in self._CONST_FNS and not e.args:
             return B.const(self._CONST_FNS[name], T.DOUBLE)
+        if name in ("now", "current_timestamp") and not e.args:
+            import time as _time
+
+            return B.const(int(_time.time() * 1e6), T.TIMESTAMP)
+        if name == "current_date" and not e.args:
+            import time as _time
+
+            return B.const(int(_time.time()) // 86_400, T.DATE)
+        if name == "typeof" and len(e.args) == 1:
+            return B.const(self.translate(e.args[0]).type.display(),
+                           T.VARCHAR)
         if name == "if" and len(e.args) in (2, 3):
             cond = self.translate(e.args[0])
             then = self.translate(e.args[1])
@@ -504,6 +531,17 @@ class Translator:
             lam = self._translate_lambda(e.args[1], [ft.key, ft.value])
             fn = resolve_scalar(name, [ft, lam.type])
             return Call(name, (first, lam), fn.result_type, fn)
+        if name == "zip_with":
+            if not isinstance(ft, T.ArrayType) or len(e.args) != 3:
+                raise SqlAnalysisError("zip_with(a, b, (x, y) -> ...)")
+            second = self.translate(e.args[1])
+            if not isinstance(second.type, T.ArrayType):
+                raise SqlAnalysisError("zip_with expects two arrays")
+            lam = self._translate_lambda(
+                e.args[2], [ft.element, second.type.element])
+            fn = resolve_scalar("zip_with", [ft, second.type, lam.type])
+            return Call("zip_with", (first, second, lam),
+                        fn.result_type, fn)
         if name == "reduce":
             if not isinstance(ft, T.ArrayType) or len(e.args) != 4:
                 raise SqlAnalysisError(

@@ -111,6 +111,8 @@ def expr_from_json(d: Dict[str, Any]) -> RowExpression:
         name = d["name"]
         if name == "cast":
             fn = F.resolve_cast(args[0].type, t)
+        elif name == "try_cast":
+            fn = F.resolve_try_cast(args[0].type, t)
         elif name == "round":
             fn = F.resolve_round(args[0].type, int(d.get("digits", 0)))
         elif name == "row_field":

@@ -121,6 +121,14 @@ class Cast(Expression):
 
 
 @D(frozen=True)
+class TryCast(Expression):
+    """TRY_CAST(x AS t): NULL instead of an error on conversion failure."""
+
+    expr: Expression
+    type_name: str
+
+
+@D(frozen=True)
 class ArrayConstructor(Expression):
     items: Tuple[Expression, ...]
 

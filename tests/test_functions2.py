@@ -111,3 +111,37 @@ def test_date_format_grouping(runner):
     rows = runner.execute(sql).rows
     assert all(len(ym) == 7 and ym[4] == "-" for ym, _ in rows)
     assert sorted(rows) == rows
+
+
+MORE_CASES = [
+    ("select width_bucket(3.0, 0.0, 10.0, 5)", (2,)),
+    ("select width_bucket(-1.0, 0.0, 10.0, 5)", (0,)),
+    ("select width_bucket(11.0, 0.0, 10.0, 5)", (6,)),
+    ("select try_cast('abc' as bigint)", (None,)),
+    ("select try_cast('42' as bigint)", (42,)),
+    ("select try_cast('nope' as date)", (None,)),
+    ("select position('b' in 'abc'), position('zz' in 'abc')", (2, 0)),
+    ("select typeof(1.5), typeof('x'), typeof(array[1])",
+     ("double", "varchar", "array(integer)")),
+    ("select bit_count(7, 64), bit_count(255, 8)", (3, 8)),
+    ("select normalize('abc')", ("abc",)),
+    ("select zip(array[1,2], array['a'])", ([(1, "a"), (2, None)],)),
+    ("select zip_with(array[1,2], array[10,20], (x,y) -> x + y)",
+     ([11, 22],)),
+    ("select map_entries(map(array['a'], array[1]))", ([("a", 1)],)),
+    ("select array_average(array[1.0, 2.0, 3.0])", (2.0,)),
+    ("select array_average(array[1.0, null, 3.0])", (2.0,)),
+]
+
+
+@pytest.mark.parametrize("sql,expected", MORE_CASES,
+                         ids=[c[0][:60] for c in MORE_CASES])
+def test_scalar_more(runner, sql, expected):
+    assert q1(runner, sql) == expected
+
+
+def test_current_temporals(runner):
+    d, ts_ok = q1(runner, "select current_date, now() is not null")
+    import datetime
+
+    assert isinstance(d, datetime.date) and d.year >= 2026 and ts_ok
