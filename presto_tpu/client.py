@@ -40,7 +40,11 @@ class StatementClient:
             if state == "FAILED" or "error" in payload:
                 raise QueryFailed(
                     payload.get("error", {}).get("message", "query failed"))
-            if "data" in payload or state == "FINISHED":
+            # only a results payload carries "columns"; the POST ack and
+            # queued/running payloads carry just state+nextUri (a fast
+            # statement can reach FINISHED before the first poll, so
+            # state alone must not end the loop)
+            if "columns" in payload or "data" in payload:
                 return payload.get("columns", []), payload.get("data", [])
             next_uri = payload.get("nextUri")
             if next_uri is None:

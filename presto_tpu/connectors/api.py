@@ -81,6 +81,12 @@ class TableStatistics:
     row_count: float
     # per-column distinct-count estimates, keyed by column name
     ndv: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # optional richer column stats (SHOW STATS / ANALYZE output;
+    # presto-spi ColumnStatistics role) — absent keys mean unknown
+    nulls_fraction: Dict[str, float] = dataclasses.field(default_factory=dict)
+    low: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    high: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    data_size: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 class PageSource:
@@ -125,6 +131,16 @@ class Connector:
     def get_splits(self, handle: TableHandle, desired_splits: int) -> List[Split]:
         raise NotImplementedError
 
+    def prune_splits(self, handle: TableHandle, splits: List[Split],
+                     constraints: List[Tuple[str, str, Any]]) -> List[Split]:
+        """Filter-pushdown negotiation (ConnectorMetadata.applyFilter +
+        HivePartitionManager pruning role): ``constraints`` is a
+        TupleDomain-lite list of (column, op, literal) conjuncts with op
+        in {eq, ne, lt, le, gt, ge, in}; connectors may drop splits that
+        cannot match (e.g. whole partitions).  The engine still applies
+        the full filter to surviving rows, so pruning is best-effort."""
+        return splits
+
     def page_source(self, split: Split, columns: Sequence[str],
                     batch_rows: int = 65536) -> PageSource:
         raise NotImplementedError
@@ -136,12 +152,34 @@ class Connector:
     def page_sink(self, handle: TableHandle) -> PageSink:
         raise NotImplementedError(f"{self.name}: INSERT not supported")
 
+    def drop_table(self, name: str) -> None:
+        raise NotImplementedError(f"{self.name}: DROP TABLE not supported")
+
+    def rename_table(self, name: str, new_name: str) -> None:
+        raise NotImplementedError(f"{self.name}: RENAME not supported")
+
+    def delete_rows(self, handle: TableHandle, mask_fn) -> int:
+        """DELETE support (ConnectorMetadata.beginDelete/DeleteOperator
+        role): ``mask_fn(batch) -> bool ndarray`` marks rows to delete;
+        returns the number of rows removed."""
+        raise NotImplementedError(f"{self.name}: DELETE not supported")
+
+    def collect_statistics(self, handle: TableHandle) -> None:
+        """ANALYZE support: recompute and store table statistics so
+        ``table_statistics`` reflects current data."""
+        raise NotImplementedError(f"{self.name}: ANALYZE not supported")
+
 
 class ConnectorRegistry:
-    """Mounted catalogs (ConnectorManager/catalog properties analogue)."""
+    """Mounted catalogs (ConnectorManager/catalog properties analogue).
+
+    Also holds logical views, keyed (catalog, name) -> defining SQL —
+    the ConnectorMetadata.createView/getView storage role, kept engine-
+    side since views are pure SQL-on-SQL."""
 
     def __init__(self):
         self._catalogs: Dict[str, Connector] = {}
+        self.views: Dict[tuple, str] = {}
 
     def register(self, catalog: str, connector: Connector) -> None:
         self._catalogs[catalog] = connector
@@ -153,3 +191,43 @@ class ConnectorRegistry:
 
     def catalogs(self) -> List[str]:
         return sorted(self._catalogs)
+
+
+def compute_statistics(schema: TableSchema, batches) -> TableStatistics:
+    """Full-scan column statistics from host batches (ANALYZE support
+    shared by storage connectors; presto-spi ColumnStatistics role)."""
+    import numpy as np
+
+    nrows = sum(b.num_rows for b in batches)
+    stats = TableStatistics(row_count=float(nrows))
+    for ci, cn in enumerate(schema.column_names()):
+        vals = []
+        nulls = 0
+        for b in batches:
+            col = b.columns[ci]
+            n = b.num_rows
+            v = np.asarray(col.values)[:n]
+            if col.valid is not None:
+                ok = np.asarray(col.valid)[:n].astype(bool)
+                nulls += int(n - ok.sum())
+                v = v[ok]
+            if col.dictionary is not None:
+                v = np.asarray(
+                    [col.dictionary.values[int(c)] for c in v], object)
+            vals.append(v)
+        allv = (np.concatenate(vals) if vals
+                else np.asarray([], np.int64))
+        if nrows:
+            stats.nulls_fraction[cn] = nulls / nrows
+        if allv.size:
+            stats.ndv[cn] = float(len(set(allv.tolist())))
+            try:
+                lo, hi = allv.min(), allv.max()
+                stats.low[cn] = lo.item() if hasattr(lo, "item") else lo
+                stats.high[cn] = hi.item() if hasattr(hi, "item") else hi
+            except (TypeError, ValueError):
+                pass
+            stats.data_size[cn] = float(
+                sum(len(str(x)) for x in allv)
+                if allv.dtype == object else allv.nbytes)
+    return stats

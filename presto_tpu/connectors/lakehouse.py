@@ -1,0 +1,454 @@
+"""Lakehouse connector: SQL over files in a local warehouse directory.
+
+The presto-hive role (presto-hive, 85,944 LoC: metastore, partitioned
+directory layout, format readers/writers, partition pruning, bucketing)
+collapsed to its engine-facing essentials for a single-host warehouse:
+
+- **Layout** (HiveMetastore + hive warehouse convention): one directory
+  per table under the warehouse root; ``_schema.json`` holds column
+  names/types, storage format, and partition columns; partitioned tables
+  nest ``col=value`` subdirectories (HivePartitionManager's layout);
+  data files are ``part-*.{csv,jsonl,parquet,orc}``.
+- **Formats**: csv and jsonl readers/writers are native (the
+  presto-rcfile/text role); parquet and orc go through pyarrow when
+  present (the presto-parquet/presto-orc role) and raise a clear error
+  otherwise.
+- **Partition pruning** (HivePartitionManager.getPartitions): the
+  engine's filter-pushdown negotiation (`Connector.prune_splits`) drops
+  whole partition directories whose key values cannot satisfy the
+  query's TupleDomain-lite constraints before any file is opened.
+- **Splits**: one per data file (BackgroundHiveSplitLoader's unit),
+  carrying the file path and the partition key values; partition columns
+  are materialized as constant columns at read time, never stored in the
+  files (hive semantics).
+- **Writes**: CREATE TABLE (WITH format/partitioned_by properties), CTAS
+  and INSERT via a PageSink that routes rows to per-partition files.
+
+Reference: presto-hive/src/main/java/io/prestosql/plugin/hive/
+HiveMetadata.java (create/insert), HivePartitionManager.java (pruning),
+HiveSplitManager.java / BackgroundHiveSplitLoader.java (splits),
+HivePageSourceProvider.java (partition-column materialization).
+"""
+
+from __future__ import annotations
+
+import csv
+import datetime
+import io
+import json
+import os
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, batch_from_pylist
+from presto_tpu.connectors.api import (
+    ColumnMetadata, Connector, PageSink, PageSource, Split, TableHandle,
+    TableSchema, TableStatistics, compute_statistics,
+)
+
+_SCHEMA_FILE = "_schema.json"
+_EXT = {"csv": "csv", "json": "jsonl", "parquet": "parquet", "orc": "orc"}
+
+
+def _pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+
+        return pyarrow
+    except ImportError as e:  # pragma: no cover - present in this image
+        raise RuntimeError(
+            "parquet/orc formats need pyarrow, which is not installed; "
+            "use csv or json") from e
+
+
+# --- text-domain value conversion ------------------------------------------
+
+def _to_text(typ: T.Type, v: Any) -> str:
+    if v is None:
+        return "\\N"  # hive's default null sequence
+    if isinstance(typ, T.BooleanType):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _from_text(typ: T.Type, s: str) -> Any:
+    if s == "\\N" or s == "":
+        return None
+    if isinstance(typ, T.BooleanType):
+        return s.lower() == "true"
+    if isinstance(typ, T.DateType):
+        return datetime.date.fromisoformat(s)
+    if isinstance(typ, T.TimestampType):
+        return datetime.datetime.fromisoformat(s)
+    if isinstance(typ, T.DecimalType):
+        return float(s)
+    if isinstance(typ, (T.VarcharType, T.CharType, T.VarbinaryType)):
+        return s
+    if typ.np_dtype.kind == "f":
+        return float(s)
+    return int(s)
+
+
+def _partition_path(pcols: Sequence[str], values: Sequence[Any]) -> str:
+    return os.path.join(*(f"{c}={v}" for c, v in zip(pcols, values))) \
+        if pcols else ""
+
+
+# --- format IO --------------------------------------------------------------
+
+def _write_rows(path: str, fmt: str, names: Sequence[str],
+                types: Sequence[T.Type], rows: List[tuple]) -> None:
+    if fmt == "csv":
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            for r in rows:
+                w.writerow([_to_text(t, v) for t, v in zip(types, r)])
+        return
+    if fmt == "json":
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(
+                    {n: _json_cell(v) for n, v in zip(names, r)}) + "\n")
+        return
+    pa = _pyarrow()
+    arrays = []
+    for i, t in enumerate(types):
+        arrays.append(pa.array([_arrow_cell(t, r[i]) for r in rows]))
+    table = pa.table(dict(zip(names, arrays)))
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, path)
+    elif fmt == "orc":
+        import pyarrow.orc as po
+
+        po.write_table(table, path)
+    else:
+        raise ValueError(f"unknown format {fmt}")
+
+
+def _json_cell(v: Any) -> Any:
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    return v
+
+
+def _arrow_cell(t: T.Type, v: Any) -> Any:
+    return v
+
+
+def _read_rows(path: str, fmt: str, names: Sequence[str],
+               types: Sequence[T.Type]) -> List[tuple]:
+    if fmt == "csv":
+        out = []
+        with open(path, newline="") as f:
+            for rec in csv.reader(f):
+                out.append(tuple(_from_text(t, s)
+                                 for t, s in zip(types, rec)))
+        return out
+    if fmt == "json":
+        out = []
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                obj = json.loads(line)
+                out.append(tuple(
+                    _coerce_json(t, obj.get(n)) for n, t in zip(names,
+                                                                types)))
+        return out
+    _pyarrow()
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+    elif fmt == "orc":
+        import pyarrow.orc as po
+
+        table = po.read_table(path)
+    else:
+        raise ValueError(f"unknown format {fmt}")
+    cols = [table.column(n).to_pylist() for n in names]
+    return list(zip(*cols)) if cols else []
+
+
+def _coerce_json(t: T.Type, v: Any) -> Any:
+    if v is None:
+        return None
+    if isinstance(t, T.DateType) and isinstance(v, str):
+        return datetime.date.fromisoformat(v)
+    if isinstance(t, T.TimestampType) and isinstance(v, str):
+        return datetime.datetime.fromisoformat(v)
+    return v
+
+
+# --- the connector ----------------------------------------------------------
+
+class _TableMeta:
+    def __init__(self, schema: TableSchema, fmt: str,
+                 partitioned_by: Tuple[str, ...]):
+        self.schema = schema
+        self.format = fmt
+        self.partitioned_by = partitioned_by
+
+    @property
+    def data_columns(self) -> List[ColumnMetadata]:
+        pset = set(self.partitioned_by)
+        return [c for c in self.schema.columns if c.name not in pset]
+
+
+class LakehouseConnector(Connector):
+    name = "lakehouse"
+
+    def __init__(self, root: str, default_format: str = "csv"):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.default_format = default_format
+        self._stats: Dict[str, TableStatistics] = {}
+        self._lock = threading.Lock()
+
+    # -- metadata -------------------------------------------------------
+    def _table_dir(self, table: str) -> str:
+        d = os.path.join(self.root, table)
+        if os.path.basename(d) != table or os.path.dirname(d) != self.root:
+            raise ValueError(f"bad table name {table!r}")
+        return d
+
+    def _meta(self, table: str) -> _TableMeta:
+        path = os.path.join(self._table_dir(table), _SCHEMA_FILE)
+        with open(path) as f:
+            doc = json.load(f)
+        schema = TableSchema(table, tuple(
+            ColumnMetadata(c["name"], T.parse_type(c["type"]))
+            for c in doc["columns"]))
+        return _TableMeta(schema, doc.get("format", "csv"),
+                          tuple(doc.get("partitioned_by", ())))
+
+    def list_tables(self) -> List[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isfile(os.path.join(self.root, d, _SCHEMA_FILE)))
+
+    def get_table(self, table: str) -> Optional[TableHandle]:
+        if not os.path.isfile(os.path.join(self._table_dir(table),
+                                           _SCHEMA_FILE)):
+            raise KeyError(f"lakehouse table not found: {table}")
+        return TableHandle("lakehouse", table)
+
+    def table_schema(self, handle: TableHandle) -> TableSchema:
+        return self._meta(handle.table).schema
+
+    def table_statistics(self, handle: TableHandle
+                         ) -> Optional[TableStatistics]:
+        return self._stats.get(handle.table)
+
+    def collect_statistics(self, handle: TableHandle) -> None:
+        meta = self._meta(handle.table)
+        batches = []
+        for split in self.get_splits(handle, 1):
+            batches.extend(self.page_source(
+                split, meta.schema.column_names()))
+        self._stats[handle.table] = compute_statistics(meta.schema, batches)
+
+    # -- splits ---------------------------------------------------------
+    def get_splits(self, handle: TableHandle,
+                   desired_splits: int) -> List[Split]:
+        meta = self._meta(handle.table)
+        tdir = self._table_dir(handle.table)
+        splits: List[Split] = []
+        for dirpath, _dirnames, filenames in os.walk(tdir):
+            rel = os.path.relpath(dirpath, tdir)
+            pvals: Dict[str, Any] = {}
+            if rel != ".":
+                for part in rel.split(os.sep):
+                    if "=" not in part:
+                        break
+                    k, _, raw = part.partition("=")
+                    typ = meta.schema.column_type(k)
+                    pvals[k] = _from_text(typ, raw)
+            for fn in sorted(filenames):
+                if fn == _SCHEMA_FILE or fn.startswith("."):
+                    continue
+                splits.append(Split(
+                    handle, (os.path.join(dirpath, fn), pvals)))
+        return splits or [Split(handle, (None, {}))]
+
+    def prune_splits(self, handle: TableHandle, splits: List[Split],
+                     constraints) -> List[Split]:
+        """Drop splits whose partition values cannot satisfy the pushed
+        conjuncts (HivePartitionManager.getPartitions role)."""
+        meta = self._meta(handle.table)
+        pset = set(meta.partitioned_by)
+        live = []
+        for s in splits:
+            _path, pvals = s.info
+            ok = True
+            for col, op, lit in constraints:
+                if col not in pset or col not in pvals:
+                    continue
+                v = pvals[col]
+                if v is None:
+                    ok = False  # partition key NULL never matches a range
+                    break
+                sv = self._storage(meta.schema.column_type(col), v)
+                if not _cmp(op, sv, lit):
+                    ok = False
+                    break
+            if ok:
+                live.append(s)
+        return live
+
+    @staticmethod
+    def _storage(typ: T.Type, v: Any) -> Any:
+        """Python-domain partition value -> storage domain (date -> epoch
+        days etc.) so it compares against RowExpression Constants."""
+        if v is None or isinstance(typ, (T.VarcharType, T.CharType)):
+            return v
+        return typ.from_python(v)
+
+    # -- reads ----------------------------------------------------------
+    def page_source(self, split: Split, columns: Sequence[str],
+                    batch_rows: int = 65536) -> PageSource:
+        meta = self._meta(split.handle.table)
+        path, pvals = split.info
+        data_names = [c.name for c in meta.data_columns]
+        data_types = [c.type for c in meta.data_columns]
+        ptypes = {c.name: c.type for c in meta.schema.columns}
+
+        class _Source(PageSource):
+            def __iter__(self):
+                if path is None:
+                    from presto_tpu.batch import empty_batch
+
+                    yield empty_batch([ptypes[c] for c in columns])
+                    return
+                rows = _read_rows(path, meta.format, data_names, data_types)
+                for lo in range(0, max(len(rows), 1), batch_rows):
+                    chunk = rows[lo:lo + batch_rows]
+                    out_cols = []
+                    n = len(chunk)
+                    for c in columns:
+                        if c in pvals:  # partition column: constant
+                            out_cols.append([pvals[c]] * n)
+                        else:
+                            di = data_names.index(c)
+                            out_cols.append([r[di] for r in chunk])
+                    yield batch_from_pylist(
+                        [ptypes[c] for c in columns],
+                        list(zip(*out_cols)) if columns else [])
+                    if not rows:
+                        return
+
+        return _Source()
+
+    # -- writes ---------------------------------------------------------
+    def create_table(self, name: str, schema: TableSchema,
+                     properties: Optional[Dict[str, Any]] = None
+                     ) -> TableHandle:
+        props = properties or {}
+        fmt = str(props.get("format", self.default_format)).lower()
+        if fmt not in _EXT:
+            raise ValueError(f"unknown format {fmt!r}")
+        pby = tuple(props.get("partitioned_by", ()))
+        for p in pby:
+            if p not in schema.column_names():
+                raise ValueError(f"partition column {p} not in schema")
+        tdir = self._table_dir(name)
+        with self._lock:
+            if os.path.isfile(os.path.join(tdir, _SCHEMA_FILE)):
+                raise ValueError(f"table already exists: {name}")
+            os.makedirs(tdir, exist_ok=True)
+            with open(os.path.join(tdir, _SCHEMA_FILE), "w") as f:
+                json.dump({
+                    "columns": [{"name": c.name, "type": c.type.display()}
+                                for c in schema.columns],
+                    "format": fmt,
+                    "partitioned_by": list(pby),
+                }, f, indent=1)
+        return TableHandle("lakehouse", name)
+
+    def drop_table(self, name: str) -> None:
+        import shutil
+
+        tdir = self._table_dir(name)
+        if not os.path.isfile(os.path.join(tdir, _SCHEMA_FILE)):
+            raise KeyError(f"lakehouse table not found: {name}")
+        shutil.rmtree(tdir)
+        self._stats.pop(name, None)
+
+    def rename_table(self, name: str, new_name: str) -> None:
+        src, dst = self._table_dir(name), self._table_dir(new_name)
+        if not os.path.isfile(os.path.join(src, _SCHEMA_FILE)):
+            raise KeyError(f"lakehouse table not found: {name}")
+        if os.path.exists(dst):
+            raise ValueError(f"table already exists: {new_name}")
+        os.rename(src, dst)
+        self._stats.pop(name, None)
+
+    def page_sink(self, handle: TableHandle) -> PageSink:
+        meta = self._meta(handle.table)
+        tdir = self._table_dir(handle.table)
+        return _LakehouseSink(meta, tdir)
+
+
+class _LakehouseSink(PageSink):
+    """Routes rows to one file per partition (HivePageSink +
+    HiveWriterFactory role)."""
+
+    def __init__(self, meta: _TableMeta, tdir: str):
+        self.meta = meta
+        self.tdir = tdir
+        self.by_partition: Dict[tuple, List[tuple]] = {}
+        self.rows = 0
+
+    def append(self, batch: Batch) -> None:
+        names = self.meta.schema.column_names()
+        pcols = self.meta.partitioned_by
+        pidx = [names.index(p) for p in pcols]
+        didx = [i for i, n in enumerate(names)
+                if n not in set(pcols)]
+        for row in batch.to_pylist():
+            key = tuple(row[i] for i in pidx)
+            self.by_partition.setdefault(key, []).append(
+                tuple(row[i] for i in didx))
+            self.rows += 1
+
+    def finish(self) -> int:
+        dnames = [c.name for c in self.meta.data_columns]
+        dtypes = [c.type for c in self.meta.data_columns]
+        for key, rows in self.by_partition.items():
+            pdir = os.path.join(
+                self.tdir, _partition_path(self.meta.partitioned_by, key))
+            os.makedirs(pdir, exist_ok=True)
+            fname = f"part-{uuid.uuid4().hex[:12]}.{_EXT[self.meta.format]}"
+            _write_rows(os.path.join(pdir, fname), self.meta.format,
+                        dnames, dtypes, rows)
+        self.by_partition = {}
+        return self.rows
+
+
+def _cmp(op: str, a: Any, b: Any) -> bool:
+    try:
+        if op == "eq":
+            return a == b
+        if op == "ne":
+            return a != b
+        if op == "lt":
+            return a < b
+        if op == "le":
+            return a <= b
+        if op == "gt":
+            return a > b
+        if op == "ge":
+            return a >= b
+        if op == "in":
+            return a in b
+    except TypeError:
+        return True  # incomparable: keep the split, row filter decides
+    return True

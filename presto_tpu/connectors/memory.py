@@ -49,6 +49,7 @@ class _MemTable:
     def __init__(self, schema: TableSchema):
         self.schema = schema
         self.batches: List[Batch] = []
+        self.stats: Optional[TableStatistics] = None  # set by ANALYZE
         self._lock = threading.Lock()
 
     def append_all(self, batches: List[Batch]) -> None:
@@ -84,7 +85,10 @@ class MemoryConnector(Connector):
 
     def table_statistics(self, handle: TableHandle
                          ) -> Optional[TableStatistics]:
-        return TableStatistics(row_count=self.tables[handle.table].row_count)
+        tbl = self.tables[handle.table]
+        if tbl.stats is not None:
+            return tbl.stats
+        return TableStatistics(row_count=tbl.row_count)
 
     # -- reads ----------------------------------------------------------
     def get_splits(self, handle: TableHandle,
@@ -117,6 +121,47 @@ class MemoryConnector(Connector):
 
     def page_sink(self, handle: TableHandle) -> PageSink:
         return _MemPageSink(self.tables[handle.table])
+
+    def rename_table(self, name: str, new_name: str) -> None:
+        with self._lock:
+            if name not in self.tables:
+                raise KeyError(f"memory table not found: {name}")
+            if new_name in self.tables:
+                raise ValueError(f"table already exists: {new_name}")
+            self.tables[new_name] = self.tables.pop(name)
+
+    def delete_rows(self, handle: TableHandle, mask_fn) -> int:
+        """Filter every stored batch through ``mask_fn`` (True = delete),
+        keeping survivors; rewrites in place under the table lock."""
+        import numpy as np
+
+        tbl = self.tables[handle.table]
+        deleted = 0
+        with tbl._lock:
+            kept: List[Batch] = []
+            for b in tbl.batches:
+                mask = np.asarray(mask_fn(b), bool)[:b.num_rows]
+                n_del = int(mask.sum())
+                if n_del == 0:
+                    kept.append(b)
+                    continue
+                deleted += n_del
+                if n_del == b.num_rows:
+                    continue
+                keep_idx = np.nonzero(~mask)[0]
+                kept.append(b.take(keep_idx))
+            tbl.batches = kept
+            tbl.stats = None
+        return deleted
+
+    def collect_statistics(self, handle: TableHandle) -> None:
+        """ANALYZE: full-scan column stats (row count, NDV, null fraction,
+        min/max, data size) stored on the table."""
+        from presto_tpu.connectors.api import compute_statistics
+
+        tbl = self.tables[handle.table]
+        with tbl._lock:
+            tbl.stats = compute_statistics(tbl.schema, tbl.batches)
 
 
 class BlackHoleConnector(Connector):

@@ -103,6 +103,12 @@ def between(value: RowExpression, lo: RowExpression,
 def comparison(op: str, left: RowExpression, right: RowExpression) -> Call:
     name = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le",
             ">": "gt", ">=": "ge"}[op]
+    # an untyped NULL side takes the other side's type (the analyzer's
+    # unknown-coercion rule); the comparison then yields NULL rows
+    if left.type == T.UNKNOWN and right.type != T.UNKNOWN:
+        left = cast(left, right.type)
+    elif right.type == T.UNKNOWN and left.type != T.UNKNOWN:
+        right = cast(right, left.type)
     return call(name, left, right)
 
 

@@ -34,12 +34,46 @@ class QueryResult:
     rows: List[Tuple]
 
 
+class _StagingSink:
+    """PageSink wrapper that buffers until the enclosing explicit
+    transaction commits (TransactionManager commit action)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.batches = []
+        self._rows = 0
+
+    def append(self, batch) -> None:
+        self.batches.append(batch)
+        self._rows += batch.num_rows
+
+    def finish(self) -> int:
+        return self._rows
+
+    def publish(self) -> None:
+        for b in self.batches:
+            self.inner.append(b)
+        self.inner.finish()
+        self.batches = []
+
+
+def _like(value: str, pattern: Optional[str]) -> bool:
+    """SQL LIKE for SHOW ... LIKE filters (% and _ wildcards)."""
+    if pattern is None:
+        return True
+    import re
+
+    rx = "".join(".*" if c == "%" else "." if c == "_" else re.escape(c)
+                 for c in pattern)
+    return re.fullmatch(rx, value) is not None
+
+
 class LocalQueryRunner:
     def __init__(self, registry: ConnectorRegistry, default_catalog: str,
                  config: EngineConfig = DEFAULT, session=None,
                  access_control=None):
         from presto_tpu.session import (
-            AllowAllAccessControl, Session, TransactionManager,
+            AllowAllAccessControl, GrantStore, Session, TransactionManager,
         )
 
         self.registry = registry
@@ -49,6 +83,10 @@ class LocalQueryRunner:
 
         self.session = session or Session(catalog=default_catalog)
         self.access_control = access_control or AllowAllAccessControl()
+        self.grants = GrantStore()
+        if hasattr(self.access_control, "grants") and \
+                self.access_control.grants is None:
+            self.access_control.grants = self.grants
         self.transaction_manager = TransactionManager()
         self.event_bus = EventBus()
         self._last_task = None
@@ -111,6 +149,9 @@ class LocalQueryRunner:
 
     def _execute_statement(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
+        return self._execute_parsed(stmt)
+
+    def _execute_parsed(self, stmt: t.Node) -> QueryResult:
         if isinstance(stmt, t.CallProcedure):
             raise ValueError(
                 "procedures (kill_query) run on a coordinator; the "
@@ -148,14 +189,222 @@ class LocalQueryRunner:
         if isinstance(stmt, t.Insert):
             return self._insert(stmt)
         if isinstance(stmt, t.DropTable):
-            catalog, name, conn, _ = self.metadata.resolve_table(stmt.table)
+            # unknown catalog is an error even under IF EXISTS; only a
+            # missing table is forgiven
+            cat, _tbl = self.metadata.split_name(stmt.table)
+            self.registry.get(cat)
+            try:
+                catalog, name, conn, _ = self.metadata.resolve_table(
+                    stmt.table)
+            except Exception:
+                if stmt.if_exists:
+                    return self._ok()
+                raise
             self.access_control.check_can_drop_table(
                 self.session.user, catalog, name)
             conn.drop_table(name)
-            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+            return self._ok()
+        if isinstance(stmt, t.Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, t.RenameTable):
+            catalog, name, conn, _ = self.metadata.resolve_table(stmt.table)
+            if len(stmt.new_name) == 1:
+                new_cat, new_name = catalog, stmt.new_name[0]
+            else:
+                new_cat, new_name = self.metadata.split_name(stmt.new_name)
+            if new_cat != catalog:
+                raise ValueError("RENAME cannot move between catalogs")
+            self.access_control.check_can_rename_table(
+                self.session.user, catalog, name)
+            conn.rename_table(name, new_name)
+            self.grants.rename_table(catalog, name, new_name)
+            self.access_control.notify_table_renamed(catalog, name,
+                                                     new_name)
+            return self._ok()
+        if isinstance(stmt, t.CreateView):
+            self.metadata.create_view(stmt.view, stmt.original_sql,
+                                      stmt.replace)
+            return self._ok()
+        if isinstance(stmt, t.DropView):
+            self.metadata.drop_view(stmt.view, stmt.if_exists)
+            return self._ok()
+        if isinstance(stmt, t.Prepare):
+            self.session.prepared[stmt.name] = stmt.statement
+            return self._ok()
+        if isinstance(stmt, t.ExecutePrepared):
+            prepared = self._get_prepared(stmt.name)
+            bound = t.substitute_parameters(prepared, stmt.parameters)
+            return self._execute_parsed(bound)
+        if isinstance(stmt, t.Deallocate):
+            self._get_prepared(stmt.name)
+            del self.session.prepared[stmt.name]
+            return self._ok()
+        if isinstance(stmt, t.DescribeInput):
+            prepared = self._get_prepared(stmt.name)
+            n = t.parameter_count(prepared)
+            return QueryResult(
+                ["Position", "Type"], [T.BIGINT, T.VARCHAR],
+                [(i, "unknown") for i in range(n)])
+        if isinstance(stmt, t.DescribeOutput):
+            return self._describe_output(self._get_prepared(stmt.name))
+        if isinstance(stmt, t.ShowCatalogs):
+            rows = [(c,) for c in self.registry.catalogs()
+                    if _like(c, stmt.like)]
+            return QueryResult(["Catalog"], [T.VARCHAR], rows)
+        if isinstance(stmt, t.ShowSchemas):
+            cat = stmt.catalog or self.metadata.default_catalog
+            self.registry.get(cat)  # raises for unknown catalog
+            rows = [(s,) for s in ("default", "information_schema")
+                    if _like(s, stmt.like)]
+            return QueryResult(["Schema"], [T.VARCHAR], rows)
+        if isinstance(stmt, t.ShowFunctions):
+            from presto_tpu.expr.functions import function_names
+
+            rows = [(n, kind) for n, kind in function_names()
+                    if _like(n, stmt.like)]
+            return QueryResult(["Function", "Function Type"],
+                               [T.VARCHAR, T.VARCHAR], rows)
+        if isinstance(stmt, t.ShowStats):
+            return self._show_stats(stmt)
+        if isinstance(stmt, t.ShowCreateTable):
+            _, name, _, schema = self.metadata.resolve_table(stmt.table)
+            cols = ",\n".join(
+                f"   {n} {schema.column_type(n).display()}"
+                for n in schema.column_names())
+            ddl = f"CREATE TABLE {'.'.join(stmt.table)} (\n{cols}\n)"
+            return QueryResult(["Create Table"], [T.VARCHAR], [(ddl,)])
+        if isinstance(stmt, t.ShowCreateView):
+            sql = self.metadata.get_view(stmt.view)
+            if sql is None:
+                raise ValueError(
+                    f"view {'.'.join(stmt.view)} does not exist")
+            ddl = f"CREATE VIEW {'.'.join(stmt.view)} AS\n{sql}"
+            return QueryResult(["Create View"], [T.VARCHAR], [(ddl,)])
+        if isinstance(stmt, t.Use):
+            self.registry.get(stmt.catalog)  # raises for unknown catalog
+            self.session.catalog = stmt.catalog
+            self.session.schema = stmt.schema
+            self.metadata.default_catalog = stmt.catalog
+            return self._ok()
+        if isinstance(stmt, t.StartTransaction):
+            if self.session.txn is not None:
+                raise ValueError("transaction already in progress")
+            self.session.txn = self.transaction_manager.begin(
+                auto_commit=False)
+            return self._ok()
+        if isinstance(stmt, t.Commit):
+            if self.session.txn is None:
+                raise ValueError("no transaction in progress")
+            self.transaction_manager.commit(self.session.txn)
+            self.session.txn = None
+            return self._ok()
+        if isinstance(stmt, t.Rollback):
+            if self.session.txn is None:
+                raise ValueError("no transaction in progress")
+            self.transaction_manager.abort(self.session.txn)
+            self.session.txn = None
+            return self._ok()
+        if isinstance(stmt, t.Analyze):
+            _, name, conn, _ = self.metadata.resolve_table(stmt.table)
+            conn.collect_statistics(conn.get_table(name))
+            return self._ok()
+        if isinstance(stmt, t.Grant):
+            catalog, name = self.metadata.split_name(stmt.table)
+            self.access_control.check_can_grant(
+                self.session.user, catalog, name)
+            self.grants.grant(stmt.grantee, catalog, name, stmt.privileges)
+            return self._ok()
+        if isinstance(stmt, t.Revoke):
+            catalog, name = self.metadata.split_name(stmt.table)
+            self.access_control.check_can_grant(
+                self.session.user, catalog, name)
+            self.grants.revoke(stmt.grantee, catalog, name, stmt.privileges)
+            return self._ok()
         if not isinstance(stmt, (t.Query, t.SetOperation)):
             raise ValueError(f"unsupported statement {type(stmt).__name__}")
         return self._execute_query(stmt)
+
+    @staticmethod
+    def _ok() -> QueryResult:
+        return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+
+    def _get_prepared(self, name: str) -> t.Node:
+        stmt = self.session.prepared.get(name)
+        if stmt is None:
+            raise ValueError(f"prepared statement not found: {name}")
+        return stmt
+
+    def _describe_output(self, stmt: t.Node) -> QueryResult:
+        cols = [("Column Name", T.VARCHAR), ("Type", T.VARCHAR)]
+        if isinstance(stmt, (t.Query, t.SetOperation)):
+            n_params = t.parameter_count(stmt)
+            bound = t.substitute_parameters(
+                stmt, tuple(t.NullLiteral() for _ in range(n_params)))
+            logical = Planner(self.metadata).plan(bound)
+            rows = [(cn, ty.display()) for cn, ty in logical.columns]
+        elif isinstance(stmt, (t.Insert, t.CreateTableAs, t.Delete)):
+            rows = [("rows", "bigint")]
+        else:
+            rows = [("result", "boolean")]
+        return QueryResult([c for c, _ in cols], [ty for _, ty in cols],
+                           rows)
+
+    def _show_stats(self, stmt: t.ShowStats) -> QueryResult:
+        _, name, conn, schema = self.metadata.resolve_table(stmt.table)
+        stats = conn.table_statistics(conn.get_table(name))
+        names = ["column_name", "data_size", "distinct_values_count",
+                 "nulls_fraction", "row_count", "low_value", "high_value"]
+        types = [T.VARCHAR, T.DOUBLE, T.DOUBLE, T.DOUBLE, T.DOUBLE,
+                 T.VARCHAR, T.VARCHAR]
+        rows: List[Tuple] = []
+        if stats is not None:
+            for cn in schema.column_names():
+                rows.append((
+                    cn,
+                    stats.data_size.get(cn),
+                    stats.ndv.get(cn),
+                    stats.nulls_fraction.get(cn),
+                    None,
+                    str(stats.low[cn]) if cn in stats.low else None,
+                    str(stats.high[cn]) if cn in stats.high else None))
+            rows.append((None, None, None, None, float(stats.row_count),
+                         None, None))
+        return QueryResult(names, types, rows)
+
+    def _delete(self, stmt: t.Delete) -> QueryResult:
+        """DELETE FROM t WHERE pred: the predicate is evaluated
+        connector-side per stored batch via the numpy oracle backend
+        (the reference's beginDelete + DeleteOperator + rowId path,
+        presto-main/.../operator/DeleteOperator.java:39, collapsed to a
+        mask-rewrite since storage is engine-local)."""
+        import numpy as np
+
+        from presto_tpu.expr.compile import evaluate
+        from presto_tpu.sql.planner import Field, Scope, Translator
+
+        catalog, name, conn, schema = self.metadata.resolve_table(
+            stmt.table)
+        self.access_control.check_can_delete(
+            self.session.user, catalog, name)
+        handle = conn.get_table(name)
+        if stmt.where is None:
+            mask_fn = lambda b: np.ones(b.num_rows, bool)  # noqa: E731
+        else:
+            scope = Scope([Field(n, name, schema.column_type(n))
+                           for n in schema.column_names()], None)
+            pred = Translator(scope).translate(stmt.where)
+            if pred.type != T.BOOLEAN:
+                raise ValueError("DELETE predicate must be boolean")
+
+            def mask_fn(b):
+                col = evaluate(pred, b.to_numpy())
+                vals = np.asarray(col.values)[:b.num_rows].astype(bool)
+                if col.valid is not None:
+                    vals &= np.asarray(col.valid)[:b.num_rows].astype(bool)
+                return vals
+
+        deleted = conn.delete_rows(handle, mask_fn)
+        return QueryResult(["rows"], [T.BIGINT], [(deleted,)])
 
     # --- DML (TableWriter path, SURVEY §2.6 write operators) ---------------
     def _resolve_write_target(self, table):
@@ -174,11 +423,20 @@ class LocalQueryRunner:
         self.access_control.check_can_create_table(
             self.session.user, catalog, name)
         conn = self.registry.get(catalog)
+        if stmt.if_not_exists and self._table_exists(conn, name):
+            return self._ok()
         schema = TableSchema(name, tuple(
             ColumnMetadata(cn, T.parse_type(ct))
             for cn, ct in stmt.columns))
         conn.create_table(name, schema)
         return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+
+    @staticmethod
+    def _table_exists(conn, name: str) -> bool:
+        try:
+            return conn.get_table(name) is not None
+        except Exception:
+            return False
 
     def _create_table_as(self, stmt: t.CreateTableAs) -> QueryResult:
         from presto_tpu.connectors.api import ColumnMetadata, TableSchema
@@ -188,6 +446,8 @@ class LocalQueryRunner:
         self.access_control.check_can_create_table(
             self.session.user, catalog, name)
         conn = self.registry.get(catalog)
+        if stmt.if_not_exists and self._table_exists(conn, name):
+            return QueryResult(["rows"], [T.BIGINT], [(0,)])
         schema = TableSchema(name, tuple(
             ColumnMetadata(cn, typ) for cn, typ in logical.columns))
         handle = conn.create_table(name, schema)
@@ -242,17 +502,28 @@ class LocalQueryRunner:
         optimized = optimize(logical, self.metadata)
         self._check_scans(optimized)
         planner = PhysicalPlanner(self.registry, cfg)
-        writer = TableWriterOperatorFactory(conn.page_sink(handle))
+        sink = conn.page_sink(handle)
+        explicit = self.session.txn
+        if explicit is not None:
+            # START TRANSACTION write: stage pages; publish at COMMIT
+            # (ROLLBACK discards).  DDL stays non-transactional, matching
+            # most reference connectors.
+            sink = _StagingSink(sink)
+            explicit.commit_actions.append(sink.publish)
+        writer = TableWriterOperatorFactory(sink)
         pipelines = planner.plan_fragment(optimized.source, writer)
-        # per-query auto-commit transaction: the PageSink's finish IS the
-        # commit point; failures before it leave the table untouched
-        txn = self.transaction_manager.begin()
+        # auto-commit: the PageSink's finish IS the commit point; failures
+        # before it leave the table untouched
+        txn = explicit or self.transaction_manager.begin()
         try:
             execute_pipelines(pipelines, cfg)
         except Exception:
             self.transaction_manager.abort(txn)
+            if explicit is not None:
+                self.session.txn = None
             raise
-        self.transaction_manager.commit(txn)
+        if explicit is None:
+            self.transaction_manager.commit(txn)
         return QueryResult(["rows"], [T.BIGINT],
                            [(writer.op.rows_written,)])
 

@@ -133,7 +133,12 @@ class QueryExecution:
                 self.state = "FINISHED"
                 return
             if not isinstance(stmt, (t.Query, t.SetOperation)):
-                raise ValueError("distributed execution supports queries")
+                # DDL/DML/metadata statements run coordinator-side
+                # (the reference's DataDefinitionExecution path,
+                # presto-main/.../execution/DataDefinitionExecution.java)
+                self._run_utility(stmt)
+                self.state = "FINISHED"
+                return
             metadata = Metadata(self.co.registry, self.co.default_catalog)
             logical = Planner(metadata).plan(stmt)
             optimized = optimize(logical, metadata)
@@ -228,6 +233,32 @@ class QueryExecution:
                 raise RuntimeError(f"task create failed: {info}")
 
     # -- result drain ---------------------------------------------------
+    def _run_utility(self, stmt: t.Node) -> None:
+        """Execute a non-query statement against the shared registry via
+        an embedded single-process runner.  Views/grants persist on the
+        coordinator (registry.views / co.grants); statements needing
+        client-session affinity (PREPARE, START TRANSACTION) are rejected
+        since the HTTP protocol here is stateless per query."""
+        from presto_tpu.localrunner import LocalQueryRunner
+        from presto_tpu.session import Session
+
+        if isinstance(stmt, (t.Prepare, t.ExecutePrepared, t.Deallocate,
+                             t.DescribeInput, t.DescribeOutput,
+                             t.StartTransaction, t.Commit, t.Rollback,
+                             t.Use, t.SetSession, t.ResetSession)):
+            raise ValueError(
+                f"{type(stmt).__name__} requires a session-affine "
+                "connection; use the single-process runner")
+        runner = LocalQueryRunner(
+            self.co.registry, self.co.default_catalog, self.co.config,
+            session=Session(user=self.user,
+                            catalog=self.co.default_catalog))
+        runner.grants = self.co.grants
+        res = runner._execute_parsed(stmt)
+        self.column_names = res.column_names
+        self.column_types = res.column_types
+        self.result_rows = list(res.rows)
+
     def _run_procedure(self, stmt: t.CallProcedure) -> None:
         """system.runtime.kill_query (KillQueryProcedure.java role)."""
         name = ".".join(stmt.name)
@@ -372,9 +403,12 @@ class CoordinatorServer:
         self.default_catalog = default_catalog
         self.config = config
         self.verbose = verbose
+        from presto_tpu.session import GrantStore
+
         self.nodes = NodeManager()
         self.queries: Dict[str, QueryExecution] = {}
         self.resource_groups = ResourceGroupManager()
+        self.grants = GrantStore()
         co = self
 
         class Handler(BaseHTTPRequestHandler):

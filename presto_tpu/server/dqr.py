@@ -95,11 +95,21 @@ class DistributedQueryRunner:
     @classmethod
     def tpch(cls, scale: float = 0.01, n_workers: int = 3,
              config: EngineConfig = DEFAULT) -> "DistributedQueryRunner":
+        from presto_tpu.connectors.memory import MemoryConnector
+
+        # One shared memory connector instance across every in-process
+        # node: coordinator-side DDL/DML lands in storage that worker
+        # scans see — the same effective topology as the reference's
+        # presto-memory, whose per-node stores are fed by distributed
+        # writes (here writes run coordinator-side).
+        shared_memory = MemoryConnector()
+
         def factory() -> ConnectorRegistry:
             from presto_tpu.connectors.tpch import TpchConnector
 
             reg = ConnectorRegistry()
             reg.register("tpch", TpchConnector(scale=scale))
+            reg.register("memory", shared_memory)
             return reg
 
         return cls(factory, "tpch", n_workers, config)

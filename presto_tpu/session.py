@@ -60,7 +60,13 @@ class Session:
 
     user: str = "user"
     catalog: str = "tpch"
+    schema: Optional[str] = None
     properties: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # PREPARE name FROM stmt storage (Session.preparedStatements role);
+    # values are parsed statement trees
+    prepared: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # explicit transaction opened by START TRANSACTION (None = autocommit)
+    txn: Optional[Any] = None
 
     def set_property(self, name: str, value: str) -> None:
         name = name.lower()
@@ -108,6 +114,22 @@ class AccessControl:
 
     def check_can_select(self, user: str, catalog: str, table: str) -> None:
         raise NotImplementedError
+
+    def check_can_delete(self, user: str, catalog: str, table: str) -> None:
+        # default: DELETE gated like INSERT (write privilege)
+        self.check_can_insert(user, catalog, table)
+
+    def check_can_grant(self, user: str, catalog: str, table: str) -> None:
+        # default: granting gated like dropping (ownership-level right)
+        self.check_can_drop_table(user, catalog, table)
+
+    def check_can_rename_table(self, user: str, catalog: str,
+                               table: str) -> None:
+        self.check_can_drop_table(user, catalog, table)
+
+    def notify_table_renamed(self, catalog: str, old: str,
+                             new: str) -> None:
+        """Hook so implementations can migrate per-table state."""
 
     def check_can_insert(self, user: str, catalog: str, table: str) -> None:
         raise NotImplementedError
@@ -177,6 +199,100 @@ class RuleBasedAccessControl(AccessControl):
 
     def check_can_drop_table(self, user, catalog, table):
         self._check(user, catalog, table, "drop")
+
+    def check_can_delete(self, user, catalog, table):
+        self._check(user, catalog, table, "delete")
+
+    def check_can_grant(self, user, catalog, table):
+        self._check(user, catalog, table, "grant")
+
+
+class GrantStore:
+    """SQL-managed privileges: GRANT/REVOKE state, keyed
+    (user, catalog, table) -> set of privileges ('all' covers every
+    privilege).  Thread-safe; shared by every session of a runner."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._grants: Dict[Tuple[str, str, str], set] = {}
+
+    def grant(self, user: str, catalog: str, table: str,
+              privileges) -> None:
+        with self._lock:
+            self._grants.setdefault((user, catalog, table),
+                                    set()).update(privileges)
+
+    def revoke(self, user: str, catalog: str, table: str,
+               privileges) -> None:
+        with self._lock:
+            have = self._grants.get((user, catalog, table))
+            if have:
+                have.difference_update(privileges)
+
+    def has(self, user: str, catalog: str, table: str,
+            privilege: str) -> bool:
+        with self._lock:
+            have = self._grants.get((user, catalog, table), set())
+            return privilege in have or "all" in have
+
+    def rename_table(self, catalog: str, old: str, new: str) -> None:
+        """Migrate grants when a table is renamed."""
+        with self._lock:
+            for key in [k for k in self._grants
+                        if k[1] == catalog and k[2] == old]:
+                self._grants[(key[0], catalog, new)] = \
+                    self._grants.pop(key)
+
+
+class GrantAwareAccessControl(AccessControl):
+    """Access control driven by the GrantStore: the table owner (creator)
+    and any ``admin_users`` bypass checks; everyone else needs an explicit
+    GRANT.  This is the SQL-standard access-control mode of the reference
+    (sql-standard AccessControl in presto-hive, GRANT/REVOKE in
+    StatementAnalyzer)."""
+
+    def __init__(self, grants: Optional[GrantStore] = None,
+                 admin_users=("admin",)):
+        # when None, the runner binds its shared GrantStore at attach time
+        self.grants = grants
+        self.admins = set(admin_users)
+        self._owners: Dict[Tuple[str, str], str] = {}
+
+    def _check(self, user, catalog, table, privilege):
+        if user in self.admins:
+            return
+        if self._owners.get((catalog, table)) == user:
+            return
+        if self.grants.has(user, catalog, table, privilege):
+            return
+        raise AccessDeniedError(
+            f"Access denied: {user} cannot {privilege} {catalog}.{table}")
+
+    def check_can_select(self, user, catalog, table):
+        self._check(user, catalog, table, "select")
+
+    def check_can_insert(self, user, catalog, table):
+        self._check(user, catalog, table, "insert")
+
+    def check_can_create_table(self, user, catalog, table):
+        # first creator wins: never steal ownership when the table
+        # already exists (the create itself will fail later)
+        self._owners.setdefault((catalog, table), user)
+
+    def check_can_drop_table(self, user, catalog, table):
+        if user in self.admins or self._owners.get(
+                (catalog, table)) == user:
+            return
+        self._check(user, catalog, table, "drop")
+
+    def check_can_delete(self, user, catalog, table):
+        self._check(user, catalog, table, "delete")
+
+    def notify_table_renamed(self, catalog, old, new):
+        if (catalog, old) in self._owners:
+            self._owners[(catalog, new)] = self._owners.pop((catalog, old))
+        if self.grants is not None:
+            self.grants.rename_table(catalog, old, new)
 
 
 # ---------------------------------------------------------------------------

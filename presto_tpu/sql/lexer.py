@@ -44,7 +44,7 @@ KEYWORDS = {
 }
 
 _TWO_CHAR = ("<=", ">=", "<>", "!=", "||", "->")
-_ONE_CHAR = "+-*/%(),.;<>=[]"
+_ONE_CHAR = "+-*/%(),.;<>=[]?"
 
 
 def tokenize(sql: str) -> List[Token]:

@@ -19,7 +19,7 @@ from presto_tpu.sql.lexer import SqlSyntaxError, Token, tokenize
 
 
 def parse_statement(sql: str) -> t.Node:
-    return _Parser(tokenize(sql)).parse_statement()
+    return _Parser(tokenize(sql), sql).parse_statement()
 
 
 def parse_expression(sql: str) -> t.Expression:
@@ -30,9 +30,11 @@ def parse_expression(sql: str) -> t.Expression:
 
 
 class _Parser:
-    def __init__(self, tokens: List[Token]):
+    def __init__(self, tokens: List[Token], sql: str = ""):
         self.toks = tokens
         self.pos = 0
+        self.sql = sql
+        self._param_seq = 0
 
     # --- token helpers -----------------------------------------------------
     def peek(self, ahead: int = 0) -> Token:
@@ -73,6 +75,33 @@ class _Parser:
         tok = self.next()
         if tok.kind != "OP" or tok.text != op:
             raise SqlSyntaxError(f"expected {op!r}, found "
+                                 f"{tok.text or 'end of input'!r}",
+                                 tok.line, tok.col)
+
+    # Soft (context-sensitive) keywords: words like DELETE/PREPARE/USE are
+    # only keywords in statement position; the lexer tokenizes them as
+    # IDENT, so these helpers match by text regardless of token kind
+    # (SqlBase.g4's nonReserved rule plays the same role).
+    def at_word(self, *words: str) -> bool:
+        tok = self.peek()
+        return tok.kind in ("KEYWORD", "IDENT") and tok.text in words
+
+    def at_word_seq(self, *words: str) -> bool:
+        for k, w in enumerate(words):
+            tok = self.peek(k)
+            if tok.kind not in ("KEYWORD", "IDENT") or tok.text != w:
+                return False
+        return True
+
+    def accept_word(self, *words: str) -> Optional[str]:
+        if self.at_word(*words):
+            return self.next().text
+        return None
+
+    def expect_word(self, word: str) -> None:
+        tok = self.next()
+        if tok.kind not in ("KEYWORD", "IDENT") or tok.text != word:
+            raise SqlSyntaxError(f"expected {word.upper()}, found "
                                  f"{tok.text or 'end of input'!r}",
                                  tok.line, tok.col)
 
@@ -121,17 +150,40 @@ class _Parser:
             inner = self.parse_statement()
             return t.Explain(inner, analyze)
         if self.accept_kw("create"):
+            replace = False
+            if self.accept_kw("or"):
+                self.expect_word("replace")
+                replace = True
+            if self.accept_word("view"):
+                name = self.qualified_name()
+                self.expect_kw("as")
+                start = self.pos
+                q = self.query()
+                node: t.Node = t.CreateView(
+                    name, q, replace,
+                    original_sql=self._text_between(start, self.pos))
+                self.accept_op(";")
+                self.expect_eof()
+                return node
+            if replace:
+                raise SqlSyntaxError("OR REPLACE only applies to views",
+                                     self.peek().line, self.peek().col)
             self.expect_kw("table")
+            if_not_exists = False
+            if self.accept_word("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
             name = self.qualified_name()
             if self.accept_kw("as"):
-                node: t.Node = t.CreateTableAs(name, self.query())
+                node = t.CreateTableAs(name, self.query(), if_not_exists)
             else:
                 self.expect_op("(")
                 cols = [(self.identifier(), self.type_name())]
                 while self.accept_op(","):
                     cols.append((self.identifier(), self.type_name()))
                 self.expect_op(")")
-                node = t.CreateTable(name, tuple(cols))
+                node = t.CreateTable(name, tuple(cols), if_not_exists)
             self.accept_op(";")
             self.expect_eof()
             return node
@@ -156,11 +208,111 @@ class _Parser:
             self.expect_eof()
             return t.Insert(name, cols, source)
         if self.accept_kw("drop"):
-            self.expect_kw("table")
+            is_view = bool(self.accept_word("view"))
+            if not is_view:
+                self.expect_kw("table")
+            if_exists = False
+            if self.accept_word("if"):
+                self.expect_kw("exists")
+                if_exists = True
             name = self.qualified_name()
             self.accept_op(";")
             self.expect_eof()
-            return t.DropTable(name)
+            return (t.DropView(name, if_exists) if is_view
+                    else t.DropTable(name, if_exists))
+        if self.accept_word("delete"):
+            self.expect_kw("from")
+            name = self.qualified_name()
+            where = self.expression() if self.accept_kw("where") else None
+            self.accept_op(";")
+            self.expect_eof()
+            return t.Delete(name, where)
+        if self.accept_word("alter"):
+            self.expect_kw("table")
+            name = self.qualified_name()
+            self.expect_word("rename")
+            self.expect_word("to")
+            new_name = self.qualified_name()
+            self.accept_op(";")
+            self.expect_eof()
+            return t.RenameTable(name, new_name)
+        if self.accept_word("prepare"):
+            name = self.identifier()
+            self.expect_kw("from")
+            inner = self.parse_statement()
+            return t.Prepare(name, inner)
+        if (self.at_word("execute")
+                and self.peek(1).kind in ("IDENT", "QIDENT")):
+            self.next()
+            name = self.identifier()
+            params: List[t.Expression] = []
+            if self.accept_word("using"):
+                params.append(self.expression())
+                while self.accept_op(","):
+                    params.append(self.expression())
+            self.accept_op(";")
+            self.expect_eof()
+            return t.ExecutePrepared(name, tuple(params))
+        if self.accept_word("deallocate"):
+            self.expect_word("prepare")
+            name = self.identifier()
+            self.accept_op(";")
+            self.expect_eof()
+            return t.Deallocate(name)
+        if self.accept_word("describe"):
+            if self.accept_word("input"):
+                node = t.DescribeInput(self.identifier())
+            elif self.accept_word("output"):
+                node = t.DescribeOutput(self.identifier())
+            else:
+                node = t.ShowColumns(self.qualified_name())
+            self.accept_op(";")
+            self.expect_eof()
+            return node
+        if self.accept_word("use"):
+            parts = self.qualified_name()
+            if len(parts) > 2:
+                raise SqlSyntaxError("USE catalog[.schema]",
+                                     self.peek().line, self.peek().col)
+            self.accept_op(";")
+            self.expect_eof()
+            return t.Use(parts[0], parts[1] if len(parts) > 1 else None)
+        if self.at_word("start"):
+            self.next()
+            self.expect_word("transaction")
+            self.accept_op(";")
+            self.expect_eof()
+            return t.StartTransaction()
+        if self.accept_word("commit"):
+            self.accept_word("work")
+            self.accept_op(";")
+            self.expect_eof()
+            return t.Commit()
+        if self.accept_word("rollback"):
+            self.accept_word("work")
+            self.accept_op(";")
+            self.expect_eof()
+            return t.Rollback()
+        if self.at_kw("analyze"):
+            self.next()
+            name = self.qualified_name()
+            self.accept_op(";")
+            self.expect_eof()
+            return t.Analyze(name)
+        if self.at_word("grant") or self.at_word("revoke"):
+            is_grant = self.next().text == "grant"
+            privs = [self.privilege()]
+            while self.accept_op(","):
+                privs.append(self.privilege())
+            self.expect_kw("on")
+            self.accept_kw("table")
+            name = self.qualified_name()
+            self.expect_word("to" if is_grant else "from")
+            grantee = self.identifier()
+            self.accept_op(";")
+            self.expect_eof()
+            return (t.Grant(tuple(privs), name, grantee) if is_grant
+                    else t.Revoke(tuple(privs), name, grantee))
         if self.accept_kw("set"):
             self.expect_kw("session")
             name = ".".join(self.qualified_name())
@@ -183,6 +335,24 @@ class _Parser:
                 node: t.Node = t.ShowTables()
             elif self.accept_kw("session"):
                 node = t.ShowSession()
+            elif self.accept_word("catalogs"):
+                node = t.ShowCatalogs(self._opt_like())
+            elif self.accept_word("schemas"):
+                cat = None
+                if self.accept_kw("from") or self.accept_kw("in"):
+                    cat = self.identifier()
+                node = t.ShowSchemas(cat, self._opt_like())
+            elif self.accept_word("functions"):
+                node = t.ShowFunctions(self._opt_like())
+            elif self.accept_word("stats"):
+                self.expect_kw("for")
+                node = t.ShowStats(self.qualified_name())
+            elif self.accept_kw("create"):
+                if self.accept_word("view"):
+                    node = t.ShowCreateView(self.qualified_name())
+                else:
+                    self.expect_kw("table")
+                    node = t.ShowCreateTable(self.qualified_name())
             else:
                 self.expect_kw("columns")
                 self.expect_kw("from")
@@ -583,6 +753,44 @@ class _Parser:
             return self.unary()
         return self.primary()
 
+    def privilege(self) -> str:
+        tok = self.next()
+        word = tok.text
+        if word not in ("select", "insert", "delete", "all"):
+            raise SqlSyntaxError(f"unknown privilege {word!r}",
+                                 tok.line, tok.col)
+        if word == "all":
+            self.accept_word("privileges")
+        return word
+
+    def _opt_like(self) -> Optional[str]:
+        if self.accept_kw("like"):
+            tok = self.next()
+            if tok.kind != "STRING":
+                raise SqlSyntaxError("expected string after LIKE",
+                                     tok.line, tok.col)
+            return tok.text
+        return None
+
+    def _text_between(self, start_pos: int, end_pos: int) -> str:
+        """Original SQL text between two token positions (used to store a
+        view's defining query verbatim)."""
+        if not self.sql:
+            return ""
+        line_off = [0]
+        for ln in self.sql.splitlines(keepends=True):
+            line_off.append(line_off[-1] + len(ln))
+
+        def offset(tok: Token) -> int:
+            if tok.kind == "EOF":
+                return len(self.sql)
+            return line_off[tok.line - 1] + tok.col - 1
+
+        lo = offset(self.toks[start_pos])
+        hi = (offset(self.toks[end_pos])
+              if end_pos < len(self.toks) else len(self.sql))
+        return self.sql[lo:hi].strip().rstrip(";").strip()
+
     def primary(self) -> t.Expression:
         e = self._primary_base()
         while True:
@@ -603,6 +811,11 @@ class _Parser:
 
     def _primary_base(self) -> t.Expression:
         tok = self.peek()
+        if tok.kind == "OP" and tok.text == "?":
+            self.next()
+            p = t.Parameter(self._param_seq)
+            self._param_seq += 1
+            return p
         if tok.kind == "NUMBER":
             self.next()
             return t.NumberLiteral(tok.text)

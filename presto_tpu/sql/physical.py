@@ -200,6 +200,16 @@ class PhysicalPlanner:
             cur = cur.source
         chain, splits = self._lower(cur)
         input_types = [t for _, t in cur.columns]
+        if filters and isinstance(cur, TableScanNode) and splits:
+            # filter-pushdown negotiation: offer TupleDomain-lite
+            # conjuncts to the connector so it can drop whole splits
+            # (HivePartitionManager partition-pruning role); the full
+            # filter still runs on surviving rows below
+            cons = _extract_constraints(filters, cur.column_names)
+            if cons:
+                conn = self.registry.get(cur.catalog)
+                splits = conn.prune_splits(
+                    conn.get_table(cur.table), splits, cons)
         filt = None
         if filters:
             filt = filters[-1]
@@ -510,3 +520,41 @@ def _finalize(agg: PlanAggregate, comps: List[RowExpression]
             return B.call("sqrt", var)
         return var
     raise NotImplementedError(f"finalize {fin}")
+
+
+def _extract_constraints(filters, column_names):
+    """RowExpression conjuncts -> TupleDomain-lite (col, op, literal)
+    triples for Connector.prune_splits.  Only simple comparisons and IN
+    over a bare input channel qualify; everything else is ignored (the
+    row-level filter still applies)."""
+    from presto_tpu.expr.ir import Call, Constant, SpecialForm
+
+    conjuncts = []
+    stack = list(filters)
+    while stack:
+        e = stack.pop()
+        if isinstance(e, SpecialForm) and e.form == "AND":
+            stack.extend(e.args)
+        else:
+            conjuncts.append(e)
+    flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+            "eq": "eq", "ne": "ne"}
+    out = []
+    for c in conjuncts:
+        if isinstance(c, Call) and c.name in flip and len(c.args) == 2:
+            a, b = c.args
+            if isinstance(a, InputRef) and isinstance(b, Constant) \
+                    and b.value is not None:
+                out.append((column_names[a.index], c.name, b.value))
+            elif isinstance(b, InputRef) and isinstance(a, Constant) \
+                    and a.value is not None:
+                out.append((column_names[b.index], flip[c.name], a.value))
+        elif isinstance(c, SpecialForm) and c.form == "IN" and c.args:
+            v = c.args[0]
+            items = c.args[1:]
+            if isinstance(v, InputRef) and all(
+                    isinstance(i, Constant) and i.value is not None
+                    for i in items):
+                out.append((column_names[v.index], "in",
+                            tuple(i.value for i in items)))
+    return out

@@ -181,19 +181,41 @@ class Metadata:
         self.registry = registry
         self.default_catalog = default_catalog
 
-    def resolve_table(self, parts: Tuple[str, ...]):
+    def split_name(self, parts: Tuple[str, ...]) -> Tuple[str, str]:
         if len(parts) == 1:
-            catalog, table = self.default_catalog, parts[0]
-        elif len(parts) == 2:
-            catalog, table = parts
-        else:
-            catalog, table = parts[0], parts[-1]  # catalog.schema.table
+            return self.default_catalog, parts[0]
+        if len(parts) == 2:
+            return parts[0], parts[1]
+        return parts[0], parts[-1]  # catalog.schema.table
+
+    def resolve_table(self, parts: Tuple[str, ...]):
+        catalog, table = self.split_name(parts)
         conn = self.registry.get(catalog)
         handle = conn.get_table(table)
         if handle is None:
             raise SqlAnalysisError(f"table {'.'.join(parts)} does not exist")
         schema = conn.table_schema(handle)
         return catalog, table, conn, schema
+
+    # -- views (ConnectorMetadata.getView / StatementAnalyzer view
+    #    expansion role) ----------------------------------------------------
+    def get_view(self, parts: Tuple[str, ...]) -> Optional[str]:
+        return self.registry.views.get(self.split_name(parts))
+
+    def create_view(self, parts: Tuple[str, ...], sql: str,
+                    replace: bool) -> None:
+        key = self.split_name(parts)
+        if not replace and key in self.registry.views:
+            raise SqlAnalysisError(f"view {'.'.join(key)} already exists")
+        self.registry.views[key] = sql
+
+    def drop_view(self, parts: Tuple[str, ...], if_exists: bool) -> None:
+        key = self.split_name(parts)
+        if key not in self.registry.views:
+            if if_exists:
+                return
+            raise SqlAnalysisError(f"view {'.'.join(key)} does not exist")
+        del self.registry.views[key]
 
 
 # ---------------------------------------------------------------------------
@@ -1029,6 +1051,19 @@ class Planner:
                     fields = [Field(f.name, qualifier, f.type)
                               for f in sub.scope.fields]
                     return RelationPlan(sub.node, Scope(fields, outer))
+        view_sql = self.metadata.get_view(r.name)
+        if view_sql is not None:
+            from presto_tpu.sql.parser import parse_statement
+
+            vstmt = parse_statement(view_sql)
+            if not isinstance(vstmt, (t.Query, t.SetOperation)):
+                raise SqlAnalysisError(
+                    f"view {'.'.join(r.name)} is not a query")
+            sub = self.plan_query(vstmt, outer)
+            qualifier = r.alias or r.name[-1]
+            fields = [Field(f.name, qualifier, f.type)
+                      for f in sub.scope.fields]
+            return RelationPlan(sub.node, Scope(fields, outer))
         catalog, table, conn, schema = self.metadata.resolve_table(r.name)
         names = schema.column_names()
         cols = tuple((n, schema.column_type(n)) for n in names)

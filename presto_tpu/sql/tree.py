@@ -353,12 +353,14 @@ class InlineValues(Relation):
 class CreateTable(Node):
     table: Tuple[str, ...]
     columns: Tuple[Tuple[str, str], ...]   # (name, type string)
+    if_not_exists: bool = False
 
 
 @D(frozen=True)
 class CreateTableAs(Node):
     table: Tuple[str, ...]
     query: Node
+    if_not_exists: bool = False
 
 
 @D(frozen=True)
@@ -371,6 +373,7 @@ class Insert(Node):
 @D(frozen=True)
 class DropTable(Node):
     table: Tuple[str, ...]
+    if_exists: bool = False
 
 
 @D(frozen=True)
@@ -409,3 +412,195 @@ class ShowTables(Node):
 @D(frozen=True)
 class ShowColumns(Node):
     table: Tuple[str, ...]
+
+
+# --- additional statements (SqlBase.g4 statement alternatives) -------------
+
+@D(frozen=True)
+class Delete(Node):
+    """DELETE FROM t [WHERE e] (DeleteOperator/TableDeleteOperator role)."""
+
+    table: Tuple[str, ...]
+    where: Optional[Expression] = None
+
+
+@D(frozen=True)
+class Prepare(Node):
+    """PREPARE name FROM <statement>; the statement may contain ?
+    parameters (Parameter nodes)."""
+
+    name: str
+    statement: Node
+
+
+@D(frozen=True)
+class ExecutePrepared(Node):
+    """EXECUTE name [USING expr, ...]."""
+
+    name: str
+    parameters: Tuple[Expression, ...] = ()
+
+
+@D(frozen=True)
+class Deallocate(Node):
+    name: str
+
+
+@D(frozen=True)
+class DescribeInput(Node):
+    name: str
+
+
+@D(frozen=True)
+class DescribeOutput(Node):
+    name: str
+
+
+@D(frozen=True)
+class ShowCatalogs(Node):
+    like: Optional[str] = None
+
+
+@D(frozen=True)
+class ShowSchemas(Node):
+    catalog: Optional[str] = None
+    like: Optional[str] = None
+
+
+@D(frozen=True)
+class ShowFunctions(Node):
+    like: Optional[str] = None
+
+
+@D(frozen=True)
+class ShowStats(Node):
+    """SHOW STATS FOR table (SHOW STATS FOR (query) unsupported)."""
+
+    table: Tuple[str, ...]
+
+
+@D(frozen=True)
+class ShowCreateTable(Node):
+    table: Tuple[str, ...]
+
+
+@D(frozen=True)
+class ShowCreateView(Node):
+    view: Tuple[str, ...]
+
+
+@D(frozen=True)
+class Use(Node):
+    """USE catalog | USE catalog.schema."""
+
+    catalog: str
+    schema: Optional[str] = None
+
+
+@D(frozen=True)
+class StartTransaction(Node):
+    pass
+
+
+@D(frozen=True)
+class Commit(Node):
+    pass
+
+
+@D(frozen=True)
+class Rollback(Node):
+    pass
+
+
+@D(frozen=True)
+class CreateView(Node):
+    view: Tuple[str, ...]
+    query: Node
+    replace: bool = False
+    original_sql: str = ""            # stored/rendered by SHOW CREATE VIEW
+
+
+@D(frozen=True)
+class DropView(Node):
+    view: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@D(frozen=True)
+class Analyze(Node):
+    """ANALYZE t — collect table/column statistics."""
+
+    table: Tuple[str, ...]
+
+
+@D(frozen=True)
+class Grant(Node):
+    privileges: Tuple[str, ...]       # ('select','insert',...) or ('all',)
+    table: Tuple[str, ...]
+    grantee: str
+
+
+@D(frozen=True)
+class Revoke(Node):
+    privileges: Tuple[str, ...]
+    table: Tuple[str, ...]
+    grantee: str
+
+
+@D(frozen=True)
+class RenameTable(Node):
+    """ALTER TABLE t RENAME TO u."""
+
+    table: Tuple[str, ...]
+    new_name: Tuple[str, ...]
+
+
+# --- tree utilities --------------------------------------------------------
+
+def _rewrite(node, fn):
+    """Bottom-up structural rewrite over the dataclass tree: applies ``fn``
+    to every Node after rewriting its children; tuples are rewritten
+    element-wise.  Non-Node leaves pass through."""
+    if isinstance(node, tuple):
+        return tuple(_rewrite(x, fn) for x in node)
+    if not isinstance(node, Node):
+        return node
+    changes = {}
+    for f in dataclasses.fields(node):
+        old = getattr(node, f.name)
+        new = _rewrite(old, fn)
+        if new is not old and new != old:
+            changes[f.name] = new
+    if changes:
+        node = dataclasses.replace(node, **changes)
+    return fn(node)
+
+
+def parameter_count(stmt: Node) -> int:
+    """Number of ? parameters in the statement (max index + 1)."""
+    count = [0]
+
+    def visit(n):
+        if isinstance(n, Parameter):
+            count[0] = max(count[0], n.index + 1)
+        return n
+
+    _rewrite(stmt, visit)
+    return count[0]
+
+
+def substitute_parameters(stmt: Node,
+                          values: Tuple[Expression, ...]) -> Node:
+    """Replace each Parameter node with the corresponding expression
+    (EXECUTE ... USING binding, QueryPreparer.java role)."""
+    need = parameter_count(stmt)
+    if need != len(values):
+        raise ValueError(
+            f"statement has {need} parameters, {len(values)} values given")
+
+    def visit(n):
+        if isinstance(n, Parameter):
+            return values[n.index]
+        return n
+
+    return _rewrite(stmt, visit)

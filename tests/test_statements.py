@@ -1,0 +1,231 @@
+"""Statement-surface tests: PREPARE/EXECUTE, DESCRIBE, SHOW variants,
+views, DELETE, transactions, ANALYZE/SHOW STATS, GRANT/REVOKE, USE,
+ALTER TABLE RENAME (reference: SqlBase.g4 statement alternatives and
+their executions under presto-main/.../execution/*Task.java)."""
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+def rows(runner, sql):
+    return runner.execute(sql).rows
+
+
+def test_show_catalogs(runner):
+    got = [r[0] for r in rows(runner, "SHOW CATALOGS")]
+    assert "tpch" in got and "memory" in got
+    assert [r[0] for r in rows(runner, "SHOW CATALOGS LIKE 'tp%'")] == \
+        ["tpch", "tpcds"] or set(
+            r[0] for r in rows(runner, "SHOW CATALOGS LIKE 'tp%'")
+        ) == {"tpch", "tpcds"}
+
+
+def test_show_schemas_and_functions(runner):
+    assert ("default",) in rows(runner, "SHOW SCHEMAS")
+    fns = rows(runner, "SHOW FUNCTIONS")
+    names = {r[0] for r in fns}
+    assert {"sum", "lower", "array_distinct", "row_number"} <= names
+    kinds = dict(fns)
+    assert kinds["sum"] == "aggregate"
+    assert kinds["row_number"] == "window"
+    only_like = rows(runner, "SHOW FUNCTIONS LIKE 'json%'")
+    assert only_like and all(r[0].startswith("json") for r in only_like)
+
+
+def test_describe(runner):
+    got = rows(runner, "DESCRIBE tpch.nation")
+    assert ("n_nationkey", "bigint") in got
+    assert ("n_name", "varchar") in got
+
+
+def test_show_create_table(runner):
+    txt = rows(runner, "SHOW CREATE TABLE tpch.nation")[0][0]
+    assert "CREATE TABLE" in txt and "n_nationkey bigint" in txt
+
+
+def test_prepare_execute_deallocate(runner):
+    runner.execute("PREPARE q1 FROM SELECT n_name FROM tpch.nation "
+                   "WHERE n_nationkey < ? ORDER BY n_nationkey")
+    got = rows(runner, "EXECUTE q1 USING 3")
+    assert got == [("ALGERIA",), ("ARGENTINA",), ("BRAZIL",)]
+    # re-execute with different binding
+    assert len(rows(runner, "EXECUTE q1 USING 5")) == 5
+    inp = rows(runner, "DESCRIBE INPUT q1")
+    assert inp == [(0, "unknown")]
+    out = rows(runner, "DESCRIBE OUTPUT q1")
+    assert out == [("n_name", "varchar")]
+    runner.execute("DEALLOCATE PREPARE q1")
+    with pytest.raises(Exception, match="not found"):
+        runner.execute("EXECUTE q1 USING 1")
+
+
+def test_views(runner):
+    runner.execute("CREATE VIEW v_nation AS SELECT n_name, n_regionkey "
+                   "FROM tpch.nation WHERE n_regionkey = 1")
+    got = rows(runner, "SELECT count(*) FROM v_nation")
+    assert got == [(5,)]
+    # view over view + alias
+    runner.execute("CREATE VIEW v2 AS SELECT n_name FROM v_nation")
+    assert len(rows(runner, "SELECT * FROM v2 v WHERE v.n_name LIKE "
+                            "'%A%'")) > 0
+    ddl = rows(runner, "SHOW CREATE VIEW v_nation")[0][0]
+    assert ddl.startswith("CREATE VIEW")
+    with pytest.raises(Exception, match="already exists"):
+        runner.execute("CREATE VIEW v_nation AS SELECT 1 AS x")
+    runner.execute("CREATE OR REPLACE VIEW v_nation AS SELECT 1 AS x")
+    assert rows(runner, "SELECT * FROM v_nation") == [(1,)]
+    runner.execute("DROP VIEW v2")
+    runner.execute("DROP VIEW v_nation")
+    runner.execute("DROP VIEW IF EXISTS v_nation")
+    with pytest.raises(Exception, match="does not exist"):
+        runner.execute("DROP VIEW v_nation")
+
+
+def test_delete_and_analyze_stats():
+    r = LocalQueryRunner.tpch(scale=0.01)
+    r.execute("CREATE TABLE memory.d (a bigint, s varchar)")
+    r.execute("INSERT INTO memory.d VALUES (1,'x'),(2,'y'),(3,NULL),"
+              "(4,'w'),(5,'x')")
+    assert rows(r, "DELETE FROM memory.d WHERE a % 2 = 0") == [(2,)]
+    assert rows(r, "SELECT count(*) FROM memory.d") == [(3,)]
+    # NULL predicate rows are not deleted
+    assert rows(r, "DELETE FROM memory.d WHERE s = 'nope'") == [(0,)]
+    r.execute("ANALYZE memory.d")
+    stats = rows(r, "SHOW STATS FOR memory.d")
+    by_col = {row[0]: row for row in stats}
+    assert by_col["a"][2] == 3.0          # ndv
+    assert by_col[None][4] == 3.0         # row_count summary row
+    assert by_col["s"][3] == pytest.approx(1 / 3)  # nulls fraction
+    assert rows(r, "DELETE FROM memory.d") == [(3,)]
+    assert rows(r, "SELECT count(*) FROM memory.d") == [(0,)]
+
+
+def test_transactions():
+    r = LocalQueryRunner.tpch(scale=0.01)
+    r.execute("CREATE TABLE memory.tx (a bigint)")
+    r.execute("INSERT INTO memory.tx VALUES (1)")
+    r.execute("START TRANSACTION")
+    r.execute("INSERT INTO memory.tx VALUES (2)")
+    r.execute("ROLLBACK")
+    assert rows(r, "SELECT count(*) FROM memory.tx") == [(1,)]
+    r.execute("START TRANSACTION")
+    r.execute("INSERT INTO memory.tx VALUES (3)")
+    r.execute("COMMIT")
+    assert sorted(rows(r, "SELECT a FROM memory.tx")) == [(1,), (3,)]
+    with pytest.raises(Exception, match="no transaction"):
+        r.execute("COMMIT")
+
+
+def test_use_and_rename():
+    r = LocalQueryRunner.tpch(scale=0.01)
+    r.execute("USE memory")
+    r.execute("CREATE TABLE ren (a bigint)")
+    r.execute("ALTER TABLE ren RENAME TO ren2")
+    assert ("ren2",) in rows(r, "SHOW TABLES")
+    r.execute("USE tpch")
+    assert ("nation",) in rows(r, "SHOW TABLES")
+
+
+def test_grant_revoke_access_control():
+    from presto_tpu.session import GrantAwareAccessControl, Session
+
+    ac = GrantAwareAccessControl()
+    r = LocalQueryRunner.tpch(scale=0.01, access_control=ac,
+                              session=Session(user="admin"))
+    ac.grants = r.grants
+    r.execute("CREATE TABLE memory.sec (a bigint)")
+    r.execute("INSERT INTO memory.sec VALUES (1)")
+
+    bob = LocalQueryRunner(r.registry, "tpch", r.config,
+                           session=Session(user="bob"), access_control=ac)
+    bob.grants = r.grants
+    with pytest.raises(PermissionError):
+        bob.execute("SELECT * FROM memory.sec")
+    r.execute("GRANT SELECT ON memory.sec TO bob")
+    assert bob.execute("SELECT * FROM memory.sec").rows == [(1,)]
+    with pytest.raises(PermissionError):
+        bob.execute("DELETE FROM memory.sec")
+    r.execute("REVOKE SELECT ON memory.sec FROM bob")
+    with pytest.raises(PermissionError):
+        bob.execute("SELECT * FROM memory.sec")
+
+
+def test_if_exists_variants(runner):
+    runner.execute("DROP TABLE IF EXISTS memory.nope")
+    runner.execute("CREATE TABLE memory.ife (a bigint)")
+    runner.execute("CREATE TABLE IF NOT EXISTS memory.ife (a bigint)")
+    runner.execute("DROP TABLE memory.ife")
+
+
+def test_null_comparison_coercion(runner):
+    assert rows(runner, "SELECT NULL = 1") == [(None,)]
+    assert rows(runner, "SELECT 1 < NULL") == [(None,)]
+
+
+def test_parameters_in_projection(runner):
+    runner.execute("PREPARE p2 FROM SELECT ? + n_nationkey FROM "
+                   "tpch.nation WHERE n_nationkey = ?")
+    assert rows(runner, "EXECUTE p2 USING 100, 3") == [(103,)]
+    runner.execute("DEALLOCATE PREPARE p2")
+
+
+def test_distributed_utility_statements():
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2) as dqr:
+        got = dqr.execute("SHOW CATALOGS")
+        assert ("tpch",) in got.rows
+        dqr.execute("CREATE TABLE memory.dt (a bigint)")
+        dqr.execute("INSERT INTO memory.dt VALUES (5)")
+        assert dqr.execute("SELECT * FROM memory.dt").rows == [(5,)]
+        dqr.execute("CREATE VIEW memory.dv AS SELECT a * 2 AS b "
+                    "FROM memory.dt")
+        assert dqr.execute("SELECT b FROM memory.dv").rows == [(10,)]
+        assert dqr.execute("DELETE FROM memory.dt WHERE a = 5"
+                           ).rows == [(1,)]
+        assert dqr.execute("SELECT count(*) FROM memory.dt").rows == [(0,)]
+
+
+def test_grant_requires_authority():
+    from presto_tpu.session import GrantAwareAccessControl, Session
+
+    ac = GrantAwareAccessControl()
+    admin = LocalQueryRunner.tpch(scale=0.01, access_control=ac,
+                                  session=Session(user="admin"))
+    ac.grants = admin.grants
+    admin.execute("CREATE TABLE memory.g (a bigint)")
+    mallory = LocalQueryRunner(admin.registry, "tpch", admin.config,
+                               session=Session(user="mallory"),
+                               access_control=ac)
+    mallory.grants = admin.grants
+    # self-granting must be denied
+    with pytest.raises(PermissionError):
+        mallory.execute("GRANT ALL ON memory.g TO mallory")
+    # creating over an existing table must not steal ownership
+    with pytest.raises(Exception):
+        mallory.execute("CREATE TABLE memory.g (x bigint)")
+    with pytest.raises(PermissionError):
+        mallory.execute("DROP TABLE memory.g")
+    # rename requires ownership and migrates grants
+    admin.execute("GRANT SELECT ON memory.g TO mallory")
+    with pytest.raises(PermissionError):
+        mallory.execute("ALTER TABLE memory.g RENAME TO h")
+    admin.execute("ALTER TABLE memory.g RENAME TO h")
+    assert mallory.execute("SELECT count(*) FROM memory.h").rows == [(0,)]
+
+
+def test_drop_if_exists_unknown_catalog(runner):
+    with pytest.raises(KeyError):
+        runner.execute("DROP TABLE IF EXISTS nocatalog.t")
+
+
+def test_show_functions_excludes_internal_names(runner):
+    names = {r[0] for r in rows(runner, "SHOW FUNCTIONS")}
+    assert not ({"eq", "ne", "add", "subtract", "modulus"} & names)
