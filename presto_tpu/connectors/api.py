@@ -146,7 +146,9 @@ class Connector:
         raise NotImplementedError
 
     # -- writes (optional) ----------------------------------------------
-    def create_table(self, name: str, schema: TableSchema) -> TableHandle:
+    def create_table(self, name: str, schema: TableSchema,
+                     properties: Optional[Dict[str, Any]] = None
+                     ) -> TableHandle:
         raise NotImplementedError(f"{self.name}: CREATE TABLE not supported")
 
     def page_sink(self, handle: TableHandle) -> PageSink:
@@ -191,6 +193,39 @@ class ConnectorRegistry:
 
     def catalogs(self) -> List[str]:
         return sorted(self._catalogs)
+
+
+def coerce_value(typ: T.Type, v: Any, lenient: bool = False) -> Any:
+    """External value (text or driver-native) -> engine python-domain
+    value for ``typ``.  Shared by the file/jdbc/decoder connectors so
+    conversion semantics stay uniform.  ``lenient`` maps undecodable
+    cells to NULL (record-decoder behavior) instead of raising."""
+    import datetime
+
+    if v is None:
+        return None
+    try:
+        if isinstance(typ, T.BooleanType):
+            if isinstance(v, str):
+                s = v.lower()
+                return (s in ("true", "1", "t", "yes")
+                        if lenient else s == "true")
+            return bool(v)
+        if isinstance(typ, T.DateType):
+            return (datetime.date.fromisoformat(v)
+                    if isinstance(v, str) else v)
+        if isinstance(typ, T.TimestampType):
+            return (datetime.datetime.fromisoformat(v)
+                    if isinstance(v, str) else v)
+        if isinstance(typ, (T.VarcharType, T.CharType, T.VarbinaryType)):
+            return v if isinstance(v, (str, bytes)) else str(v)
+        if isinstance(typ, T.DecimalType) or typ.np_dtype.kind == "f":
+            return float(v)
+        return int(v)
+    except (ValueError, TypeError):
+        if lenient:
+            return None
+        raise
 
 
 def compute_statistics(schema: TableSchema, batches) -> TableStatistics:

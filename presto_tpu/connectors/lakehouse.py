@@ -47,7 +47,7 @@ from presto_tpu import types as T
 from presto_tpu.batch import Batch, batch_from_pylist
 from presto_tpu.connectors.api import (
     ColumnMetadata, Connector, PageSink, PageSource, Split, TableHandle,
-    TableSchema, TableStatistics, compute_statistics,
+    TableSchema, TableStatistics, coerce_value, compute_statistics,
 )
 
 _SCHEMA_FILE = "_schema.json"
@@ -78,24 +78,19 @@ def _to_text(typ: T.Type, v: Any) -> str:
 def _from_text(typ: T.Type, s: str) -> Any:
     if s == "\\N" or s == "":
         return None
-    if isinstance(typ, T.BooleanType):
-        return s.lower() == "true"
-    if isinstance(typ, T.DateType):
-        return datetime.date.fromisoformat(s)
-    if isinstance(typ, T.TimestampType):
-        return datetime.datetime.fromisoformat(s)
-    if isinstance(typ, T.DecimalType):
-        return float(s)
-    if isinstance(typ, (T.VarcharType, T.CharType, T.VarbinaryType)):
-        return s
-    if typ.np_dtype.kind == "f":
-        return float(s)
-    return int(s)
+    return coerce_value(typ, s)
+
+
+# hive's directory name for a NULL partition key
+_NULL_PARTITION = "__DEFAULT_PARTITION__"
 
 
 def _partition_path(pcols: Sequence[str], values: Sequence[Any]) -> str:
-    return os.path.join(*(f"{c}={v}" for c, v in zip(pcols, values))) \
-        if pcols else ""
+    if not pcols:
+        return ""
+    return os.path.join(*(
+        f"{c}={_NULL_PARTITION if v is None else v}"
+        for c, v in zip(pcols, values)))
 
 
 # --- format IO --------------------------------------------------------------
@@ -177,13 +172,7 @@ def _read_rows(path: str, fmt: str, names: Sequence[str],
 
 
 def _coerce_json(t: T.Type, v: Any) -> Any:
-    if v is None:
-        return None
-    if isinstance(t, T.DateType) and isinstance(v, str):
-        return datetime.date.fromisoformat(v)
-    if isinstance(t, T.TimestampType) and isinstance(v, str):
-        return datetime.datetime.fromisoformat(v)
-    return v
+    return coerce_value(t, v)
 
 
 # --- the connector ----------------------------------------------------------
@@ -271,7 +260,8 @@ class LakehouseConnector(Connector):
                         break
                     k, _, raw = part.partition("=")
                     typ = meta.schema.column_type(k)
-                    pvals[k] = _from_text(typ, raw)
+                    pvals[k] = (None if raw == _NULL_PARTITION
+                                else _from_text(typ, raw))
             for fn in sorted(filenames):
                 if fn == _SCHEMA_FILE or fn.startswith("."):
                     continue

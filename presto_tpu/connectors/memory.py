@@ -106,7 +106,8 @@ class MemoryConnector(Connector):
         return _MemPageSource(tbl.batches[lo:hi], columns, tbl.schema)
 
     # -- writes ---------------------------------------------------------
-    def create_table(self, name: str, schema: TableSchema) -> TableHandle:
+    def create_table(self, name: str, schema: TableSchema,
+                     properties=None) -> TableHandle:
         with self._lock:
             if name in self.tables:
                 raise ValueError(f"table already exists: {name}")
@@ -200,7 +201,8 @@ class BlackHoleConnector(Connector):
 
         return _Empty()
 
-    def create_table(self, name: str, schema: TableSchema) -> TableHandle:
+    def create_table(self, name: str, schema: TableSchema,
+                     properties=None) -> TableHandle:
         self.schemas[name] = schema
         self.rows_swallowed[name] = 0
         return TableHandle("blackhole", name)

@@ -1,0 +1,348 @@
+"""Raptor-role connector: engine-native shard storage.
+
+The presto-raptor-legacy role (31,227 LoC: Presto's own storage engine —
+ORC shard files on local disk, shard metadata in a MySQL database,
+optional bucketing, background compaction, and a backup store) mapped to
+this engine's native formats:
+
+- **Shards** are files in this engine's LZ4 page wire format
+  (presto_tpu.serde — the same frames the exchange and spill tiers use,
+  raptor's ORC-file role), one or more batches per shard.
+- **Metadata** lives in a sqlite database (raptor's MySQL metadata role:
+  tables, columns, shards with row counts and optional bucket numbers).
+- **Bucketing**: tables may declare ``bucket_count`` + ``bucketed_on``
+  (one column); rows are routed to buckets by the same value-hash the
+  exchange uses, and each split carries its bucket number so bucketed
+  scans shard deterministically (raptor's bucketed tables).
+- **Compaction**: ``compact(table)`` merges small shards into fewer
+  larger ones (ShardCompactor role).
+- **Backup**: when a backup directory is configured every committed
+  shard is mirrored there and restored on read if the primary file is
+  missing (BackupStore / ShardRecoveryManager role).
+
+Reference: presto-raptor-legacy/src/main/java/io/prestosql/plugin/raptor/
+legacy/metadata/ShardManager.java, storage/OrcStorageManager.java,
+storage/ShardCompactor.java, backup/BackupStore.java.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, concat_batches, empty_batch
+from presto_tpu.connectors.api import (
+    ColumnMetadata, Connector, PageSink, PageSource, Split, TableHandle,
+    TableSchema, TableStatistics, compute_statistics,
+)
+from presto_tpu.serde import deserialize_batch, frame_size, serialize_batch
+
+_META_DB = "_raptor_meta.sqlite"
+
+
+class RaptorConnector(Connector):
+    name = "raptor"
+
+    def __init__(self, root: str, backup_root: Optional[str] = None):
+        self.root = os.path.abspath(root)
+        os.makedirs(os.path.join(self.root, "shards"), exist_ok=True)
+        self.backup_root = (os.path.abspath(backup_root)
+                            if backup_root else None)
+        if self.backup_root:
+            os.makedirs(self.backup_root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(os.path.join(self.root, _META_DB),
+                                   check_same_thread=False)
+        with self._lock:
+            self._db.executescript("""
+                CREATE TABLE IF NOT EXISTS tables (
+                    name TEXT PRIMARY KEY,
+                    columns TEXT NOT NULL,      -- json [{name,type}]
+                    bucket_count INTEGER,       -- NULL = unbucketed
+                    bucketed_on TEXT);
+                CREATE TABLE IF NOT EXISTS shards (
+                    shard_uuid TEXT PRIMARY KEY,
+                    table_name TEXT NOT NULL,
+                    bucket INTEGER,             -- NULL = unbucketed
+                    row_count INTEGER NOT NULL);
+                """)
+            self._db.commit()
+
+    # -- metadata -------------------------------------------------------
+    def _q(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
+        with self._lock:
+            cur = self._db.execute(sql, tuple(params))
+            rows = cur.fetchall()
+            self._db.commit()
+            return rows
+
+    def list_tables(self) -> List[str]:
+        return sorted(r[0] for r in self._q("SELECT name FROM tables"))
+
+    def get_table(self, table: str) -> Optional[TableHandle]:
+        if not self._q("SELECT 1 FROM tables WHERE name = ?", (table,)):
+            raise KeyError(f"raptor table not found: {table}")
+        return TableHandle("raptor", table)
+
+    def _table_row(self, table: str):
+        rows = self._q(
+            "SELECT columns, bucket_count, bucketed_on FROM tables "
+            "WHERE name = ?", (table,))
+        if not rows:
+            raise KeyError(f"raptor table not found: {table}")
+        cols_doc, bucket_count, bucketed_on = rows[0]
+        schema = TableSchema(table, tuple(
+            ColumnMetadata(c["name"], T.parse_type(c["type"]))
+            for c in json.loads(cols_doc)))
+        return schema, bucket_count, bucketed_on
+
+    def table_schema(self, handle: TableHandle) -> TableSchema:
+        return self._table_row(handle.table)[0]
+
+    def table_statistics(self, handle: TableHandle
+                         ) -> Optional[TableStatistics]:
+        full = getattr(self, "_col_stats", {}).get(handle.table)
+        if full is not None:
+            return full
+        rows = self._q(
+            "SELECT COALESCE(SUM(row_count), 0) FROM shards "
+            "WHERE table_name = ?", (handle.table,))
+        return TableStatistics(row_count=float(rows[0][0]))
+
+    def collect_statistics(self, handle: TableHandle) -> None:
+        """ANALYZE: full-scan column stats, served by table_statistics
+        until the next write invalidates them."""
+        schema, _, _ = self._table_row(handle.table)
+        batches: List[Batch] = []
+        for split in self.get_splits(handle, 1):
+            batches.extend(self.page_source(
+                split, schema.column_names()))
+        self._col_stats = getattr(self, "_col_stats", {})
+        self._col_stats[handle.table] = compute_statistics(schema, batches)
+
+    # -- shard IO -------------------------------------------------------
+    def _shard_path(self, shard_uuid: str) -> str:
+        return os.path.join(self.root, "shards", shard_uuid + ".shard")
+
+    def _write_shard(self, table: str, bucket: Optional[int],
+                     batch: Batch) -> None:
+        shard_uuid = uuid.uuid4().hex
+        blob = serialize_batch(batch.compact().to_numpy())
+        path = self._shard_path(shard_uuid)
+        with open(path, "wb") as f:
+            f.write(blob)
+        if self.backup_root:
+            with open(os.path.join(self.backup_root,
+                                   shard_uuid + ".shard"), "wb") as f:
+                f.write(blob)
+        self._q("INSERT INTO shards VALUES (?, ?, ?, ?)",
+                (shard_uuid, table, bucket, batch.num_rows))
+        getattr(self, "_col_stats", {}).pop(table, None)  # stale now
+
+    def _read_shard(self, shard_uuid: str) -> Batch:
+        path = self._shard_path(shard_uuid)
+        if not os.path.exists(path) and self.backup_root:
+            # shard recovery: restore the primary from backup
+            bpath = os.path.join(self.backup_root, shard_uuid + ".shard")
+            if os.path.exists(bpath):
+                with open(bpath, "rb") as src, open(path, "wb") as dst:
+                    dst.write(src.read())
+        with open(path, "rb") as f:
+            data = f.read()
+        batches = []
+        off = 0
+        while off < len(data):
+            size = frame_size(data, off)
+            batches.append(deserialize_batch(data[off:off + size]))
+            off += size
+        return batches[0] if len(batches) == 1 else concat_batches(batches)
+
+    # -- reads ----------------------------------------------------------
+    def get_splits(self, handle: TableHandle,
+                   desired_splits: int) -> List[Split]:
+        shards = self._q(
+            "SELECT shard_uuid, bucket, row_count FROM shards "
+            "WHERE table_name = ? ORDER BY shard_uuid", (handle.table,))
+        if not shards:
+            return [Split(handle, ((), None))]
+        # group shards into one split per bucket (bucketed) or into
+        # ~desired_splits groups (unbucketed)
+        by_bucket: Dict[Optional[int], List[str]] = {}
+        for su, bucket, _rc in shards:
+            by_bucket.setdefault(bucket, []).append(su)
+        splits: List[Split] = []
+        for bucket, uuids in sorted(by_bucket.items(),
+                                    key=lambda kv: (kv[0] is None, kv[0])):
+            if bucket is None and desired_splits > 1:
+                per = -(-len(uuids) // desired_splits)
+                for lo in range(0, len(uuids), per):
+                    splits.append(Split(
+                        handle, (tuple(uuids[lo:lo + per]), None)))
+            else:
+                splits.append(Split(handle, (tuple(uuids), bucket)))
+        return splits
+
+    def page_source(self, split: Split, columns: Sequence[str],
+                    batch_rows: int = 65536) -> PageSource:
+        schema = self.table_schema(split.handle)
+        channels = [schema.column_index(c) for c in columns]
+        uuids, _bucket = split.info
+        conn = self
+
+        class _Source(PageSource):
+            def __iter__(self):
+                if not uuids:
+                    yield empty_batch(
+                        [schema.column_type(c) for c in columns])
+                    return
+                for su in uuids:
+                    yield conn._read_shard(su).select_channels(channels)
+
+        return _Source()
+
+    # -- writes ---------------------------------------------------------
+    def create_table(self, name: str, schema: TableSchema,
+                     properties=None) -> TableHandle:
+        props = properties or {}
+        bucket_count = props.get("bucket_count")
+        bucketed_on = props.get("bucketed_on")
+        if isinstance(bucketed_on, (list, tuple)):
+            bucketed_on = bucketed_on[0] if bucketed_on else None
+        if (bucket_count is None) != (bucketed_on is None):
+            raise ValueError(
+                "bucket_count and bucketed_on must be set together")
+        if bucketed_on is not None and \
+                bucketed_on not in schema.column_names():
+            raise ValueError(f"bucket column {bucketed_on} not in schema")
+        cols = json.dumps([{"name": c.name, "type": c.type.display()}
+                           for c in schema.columns])
+        try:
+            self._q("INSERT INTO tables VALUES (?, ?, ?, ?)",
+                    (name, cols, bucket_count, bucketed_on))
+        except sqlite3.IntegrityError:
+            raise ValueError(f"table already exists: {name}")
+        return TableHandle("raptor", name)
+
+    def drop_table(self, name: str) -> None:
+        self.get_table(name)
+        for (su,) in self._q(
+                "SELECT shard_uuid FROM shards WHERE table_name = ?",
+                (name,)):
+            try:
+                os.remove(self._shard_path(su))
+            except FileNotFoundError:
+                pass
+        self._q("DELETE FROM shards WHERE table_name = ?", (name,))
+        self._q("DELETE FROM tables WHERE name = ?", (name,))
+
+    def rename_table(self, name: str, new_name: str) -> None:
+        self.get_table(name)
+        if self._q("SELECT 1 FROM tables WHERE name = ?", (new_name,)):
+            raise ValueError(f"table already exists: {new_name}")
+        self._q("UPDATE tables SET name = ? WHERE name = ?",
+                (new_name, name))
+        self._q("UPDATE shards SET table_name = ? WHERE table_name = ?",
+                (new_name, name))
+
+    def page_sink(self, handle: TableHandle) -> PageSink:
+        schema, bucket_count, bucketed_on = self._table_row(handle.table)
+        return _RaptorSink(self, handle.table, schema, bucket_count,
+                           bucketed_on)
+
+    # -- maintenance ----------------------------------------------------
+    def compact(self, table: str,
+                target_rows: int = 1 << 20) -> Tuple[int, int]:
+        """Merge small shards (per bucket) into fewer large ones
+        (ShardCompactor role).  Returns (shards_before, shards_after)."""
+        self.get_table(table)
+        shards = self._q(
+            "SELECT shard_uuid, bucket, row_count FROM shards "
+            "WHERE table_name = ? ORDER BY bucket, shard_uuid", (table,))
+        before = len(shards)
+        by_bucket: Dict[Optional[int], List[Tuple[str, int]]] = {}
+        for su, bucket, rc in shards:
+            by_bucket.setdefault(bucket, []).append((su, rc))
+        for bucket, items in by_bucket.items():
+            group: List[str] = []
+            rows = 0
+            runs: List[List[str]] = []
+            for su, rc in items:
+                group.append(su)
+                rows += rc
+                if rows >= target_rows:
+                    runs.append(group)
+                    group, rows = [], 0
+            if group:
+                runs.append(group)
+            for run in runs:
+                if len(run) < 2:
+                    continue
+                merged = concat_batches(
+                    [self._read_shard(su) for su in run])
+                self._write_shard(table, bucket, merged)
+                for su in run:
+                    self._q("DELETE FROM shards WHERE shard_uuid = ?",
+                            (su,))
+                    try:
+                        os.remove(self._shard_path(su))
+                    except FileNotFoundError:
+                        pass
+        after = len(self._q(
+            "SELECT shard_uuid FROM shards WHERE table_name = ?",
+            (table,)))
+        return before, after
+
+
+class _RaptorSink(PageSink):
+    """Buffers rows per bucket; every finished sink writes one shard per
+    bucket touched (OrcStorageManager.createStorageSink role)."""
+
+    def __init__(self, conn: RaptorConnector, table: str,
+                 schema: TableSchema, bucket_count: Optional[int],
+                 bucketed_on: Optional[str]):
+        self.conn = conn
+        self.table = table
+        self.schema = schema
+        self.bucket_count = bucket_count
+        self.bucket_channel = (schema.column_index(bucketed_on)
+                               if bucketed_on else None)
+        self.by_bucket: Dict[Optional[int], List[Batch]] = {}
+        self.rows = 0
+
+    def append(self, batch: Batch) -> None:
+        batch = batch.compact().to_numpy()
+        self.rows += batch.num_rows
+        if self.bucket_count is None:
+            self.by_bucket.setdefault(None, []).append(batch)
+            return
+        from presto_tpu.ops.hashing import value_hash_triple
+
+        col = batch.columns[self.bucket_channel]
+        vals, valid, _typ = value_hash_triple(col)
+        v = np.asarray(vals)[:batch.num_rows]
+        h = v.astype(np.int64, copy=False).view(np.uint64).copy()
+        h *= np.uint64(0x9E3779B97F4A7C15)
+        h ^= h >> np.uint64(29)
+        if valid is not None:
+            h = np.where(np.asarray(valid)[:batch.num_rows], h,
+                         np.uint64(0))
+        buckets = (h % np.uint64(self.bucket_count)).astype(np.int64)
+        for b in np.unique(buckets):
+            idx = np.nonzero(buckets == b)[0]
+            self.by_bucket.setdefault(int(b), []).append(batch.take(idx))
+
+    def finish(self) -> int:
+        for bucket, batches in self.by_bucket.items():
+            merged = (batches[0] if len(batches) == 1
+                      else concat_batches(batches))
+            if merged.num_rows:
+                self.conn._write_shard(self.table, bucket, merged)
+        self.by_bucket = {}
+        return self.rows

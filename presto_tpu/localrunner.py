@@ -162,9 +162,13 @@ class LocalQueryRunner:
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.splitlines()])
         if isinstance(stmt, t.ShowTables):
-            conn = self.registry.get(self.metadata.default_catalog)
+            cat = stmt.catalog or self.metadata.default_catalog
+            conn = self.registry.get(cat)
+            names = set(conn.list_tables())
+            names.update(n for c, n in self.registry.views if c == cat)
             return QueryResult(["Table"], [T.VARCHAR],
-                               [(n,) for n in sorted(conn.list_tables())])
+                               [(n,) for n in sorted(names)
+                                if _like(n, stmt.like)])
         if isinstance(stmt, t.ShowColumns):
             _, _, conn, schema = self.metadata.resolve_table(stmt.table)
             return QueryResult(
@@ -428,7 +432,7 @@ class LocalQueryRunner:
         schema = TableSchema(name, tuple(
             ColumnMetadata(cn, T.parse_type(ct))
             for cn, ct in stmt.columns))
-        conn.create_table(name, schema)
+        conn.create_table(name, schema, dict(stmt.properties) or None)
         return QueryResult(["result"], [T.BOOLEAN], [(True,)])
 
     @staticmethod
@@ -450,7 +454,8 @@ class LocalQueryRunner:
             return QueryResult(["rows"], [T.BIGINT], [(0,)])
         schema = TableSchema(name, tuple(
             ColumnMetadata(cn, typ) for cn, typ in logical.columns))
-        handle = conn.create_table(name, schema)
+        handle = conn.create_table(name, schema,
+                                   dict(stmt.properties) or None)
         return self._write(logical, conn, handle)
 
     def _insert(self, stmt: t.Insert) -> QueryResult:

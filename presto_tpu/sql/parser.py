@@ -177,13 +177,22 @@ class _Parser:
             name = self.qualified_name()
             if self.accept_kw("as"):
                 node = t.CreateTableAs(name, self.query(), if_not_exists)
+            elif self.at_kw("with"):
+                node = t.CreateTableAs(
+                    name, None, if_not_exists,      # query filled below
+                    self.table_properties())
+                self.expect_kw("as")
+                node = dataclasses.replace(node, query=self.query())
             else:
                 self.expect_op("(")
                 cols = [(self.identifier(), self.type_name())]
                 while self.accept_op(","):
                     cols.append((self.identifier(), self.type_name()))
                 self.expect_op(")")
-                node = t.CreateTable(name, tuple(cols), if_not_exists)
+                props = self.table_properties() if self.at_kw("with") \
+                    else ()
+                node = t.CreateTable(name, tuple(cols), if_not_exists,
+                                     props)
             self.accept_op(";")
             self.expect_eof()
             return node
@@ -332,7 +341,10 @@ class _Parser:
             return t.ResetSession(name)
         if self.accept_kw("show"):
             if self.accept_kw("tables"):
-                node: t.Node = t.ShowTables()
+                cat = None
+                if self.accept_kw("from") or self.accept_kw("in"):
+                    cat = self.identifier()
+                node: t.Node = t.ShowTables(cat, self._opt_like())
             elif self.accept_kw("session"):
                 node = t.ShowSession()
             elif self.accept_word("catalogs"):
@@ -752,6 +764,44 @@ class _Parser:
             self.next()
             return self.unary()
         return self.primary()
+
+    def table_properties(self) -> Tuple[Tuple[str, object], ...]:
+        """WITH (k = literal, ...) — literals: string, number, boolean,
+        ARRAY['a', ...] (the table-properties grammar subset the
+        connectors consume)."""
+        self.expect_kw("with")
+        self.expect_op("(")
+        props = []
+        while True:
+            key = self.identifier()
+            self.expect_op("=")
+            props.append((key, self._property_value()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return tuple(props)
+
+    def _property_value(self):
+        tok = self.peek()
+        if tok.kind == "STRING":
+            return self.next().text
+        if tok.kind == "NUMBER":
+            text = self.next().text
+            return float(text) if "." in text or "e" in text else int(text)
+        if self.accept_kw("true"):
+            return True
+        if self.accept_kw("false"):
+            return False
+        if self.accept_kw("array"):
+            self.expect_op("[")
+            items = []
+            if not self.at_op("]"):
+                items.append(self._property_value())
+                while self.accept_op(","):
+                    items.append(self._property_value())
+            self.expect_op("]")
+            return items
+        raise SqlSyntaxError("expected property value", tok.line, tok.col)
 
     def privilege(self) -> str:
         tok = self.next()

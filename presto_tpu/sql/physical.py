@@ -529,6 +529,26 @@ def _extract_constraints(filters, column_names):
     row-level filter still applies)."""
     from presto_tpu.expr.ir import Call, Constant, SpecialForm
 
+    def fold(e):
+        """Fold literal-only subtrees (e.g. cast(1:integer) from IN-list
+        coercion) to a Constant by evaluating on a zero-channel row."""
+        if isinstance(e, Constant) or any(
+                isinstance(x, InputRef) for x in _walk(e)):
+            return e
+        try:
+            from presto_tpu.batch import Batch
+            from presto_tpu.expr.compile import evaluate
+
+            col = evaluate(e, Batch((), 1))
+            if col.valid is not None and not bool(col.valid[0]):
+                return Constant(None, e.type)
+            v = col.values[0]
+            if col.dictionary is not None:
+                v = col.dictionary.values[int(v)]
+            return Constant(v.item() if hasattr(v, "item") else v, e.type)
+        except Exception:
+            return e
+
     conjuncts = []
     stack = list(filters)
     while stack:
@@ -542,7 +562,7 @@ def _extract_constraints(filters, column_names):
     out = []
     for c in conjuncts:
         if isinstance(c, Call) and c.name in flip and len(c.args) == 2:
-            a, b = c.args
+            a, b = (fold(x) for x in c.args)
             if isinstance(a, InputRef) and isinstance(b, Constant) \
                     and b.value is not None:
                 out.append((column_names[a.index], c.name, b.value))
@@ -551,10 +571,16 @@ def _extract_constraints(filters, column_names):
                 out.append((column_names[b.index], flip[c.name], a.value))
         elif isinstance(c, SpecialForm) and c.form == "IN" and c.args:
             v = c.args[0]
-            items = c.args[1:]
+            items = [fold(i) for i in c.args[1:]]
             if isinstance(v, InputRef) and all(
                     isinstance(i, Constant) and i.value is not None
                     for i in items):
                 out.append((column_names[v.index], "in",
                             tuple(i.value for i in items)))
     return out
+
+
+def _walk(e):
+    yield e
+    for a in getattr(e, "args", ()):
+        yield from _walk(a)

@@ -1055,11 +1055,22 @@ class Planner:
         if view_sql is not None:
             from presto_tpu.sql.parser import parse_statement
 
+            vkey = self.metadata.split_name(r.name)
+            expanding = getattr(self, "_expanding_views", None)
+            if expanding is None:
+                expanding = self._expanding_views = set()
+            if vkey in expanding:
+                raise SqlAnalysisError(
+                    f"view {'.'.join(vkey)} is recursive")
             vstmt = parse_statement(view_sql)
             if not isinstance(vstmt, (t.Query, t.SetOperation)):
                 raise SqlAnalysisError(
                     f"view {'.'.join(r.name)} is not a query")
-            sub = self.plan_query(vstmt, outer)
+            expanding.add(vkey)
+            try:
+                sub = self.plan_query(vstmt, outer)
+            finally:
+                expanding.discard(vkey)
             qualifier = r.alias or r.name[-1]
             fields = [Field(f.name, qualifier, f.type)
                       for f in sub.scope.fields]

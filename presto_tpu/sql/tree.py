@@ -9,7 +9,7 @@ error messages.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 D = dataclasses.dataclass
 
@@ -354,6 +354,8 @@ class CreateTable(Node):
     table: Tuple[str, ...]
     columns: Tuple[Tuple[str, str], ...]   # (name, type string)
     if_not_exists: bool = False
+    # WITH (k = v, ...) table properties (format, partitioned_by, ...)
+    properties: Tuple[Tuple[str, Any], ...] = ()
 
 
 @D(frozen=True)
@@ -361,6 +363,7 @@ class CreateTableAs(Node):
     table: Tuple[str, ...]
     query: Node
     if_not_exists: bool = False
+    properties: Tuple[Tuple[str, Any], ...] = ()
 
 
 @D(frozen=True)
@@ -406,7 +409,8 @@ class ShowSession(Node):
 
 @D(frozen=True)
 class ShowTables(Node):
-    pass
+    catalog: Optional[str] = None
+    like: Optional[str] = None
 
 
 @D(frozen=True)

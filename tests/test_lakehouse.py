@@ -1,0 +1,160 @@
+"""Lakehouse (hive-role) connector tests: formats, partitioned layout,
+partition pruning, SQL end-to-end (reference: presto-hive HiveMetadata/
+HivePartitionManager/HiveSplitManager + presto-orc/parquet format libs)."""
+
+import os
+
+import pytest
+
+from presto_tpu.connectors.lakehouse import LakehouseConnector
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner(tmp_path):
+    r = LocalQueryRunner.tpch(scale=0.01)
+    r.register("lake", LakehouseConnector(str(tmp_path)))
+    return r
+
+
+FORMATS = ["csv", "json", "parquet", "orc"]
+
+
+@pytest.mark.parametrize("fmt", FORMATS)
+def test_roundtrip_formats(runner, fmt):
+    runner.execute(
+        f"CREATE TABLE lake.t_{fmt} (a bigint, b varchar, c double, "
+        f"d date, e boolean) WITH (format = '{fmt}')")
+    runner.execute(
+        f"INSERT INTO lake.t_{fmt} VALUES "
+        "(1, 'x', 1.5, DATE '2020-01-02', true), "
+        "(2, NULL, -0.25, DATE '1999-12-31', false), "
+        "(3, 'z z', 0.0, NULL, NULL)")
+    got = sorted(runner.execute(f"SELECT * FROM lake.t_{fmt}").rows)
+    import datetime
+
+    assert got[0] == (1, "x", 1.5, datetime.date(2020, 1, 2), True)
+    assert got[1][1] is None and got[1][2] == -0.25
+    assert got[2][3] is None and got[2][4] is None
+    # column pruning + filter
+    assert runner.execute(
+        f"SELECT b FROM lake.t_{fmt} WHERE a = 1").rows == [("x",)]
+
+
+def test_ctas_from_tpch(runner):
+    runner.execute("CREATE TABLE lake.nation_copy WITH (format = 'json') "
+                   "AS SELECT n_nationkey, n_name, n_regionkey "
+                   "FROM tpch.nation")
+    assert runner.execute(
+        "SELECT count(*) FROM lake.nation_copy").rows == [(25,)]
+    a = sorted(runner.execute(
+        "SELECT n_name FROM lake.nation_copy WHERE n_regionkey = 2").rows)
+    b = sorted(runner.execute(
+        "SELECT n_name FROM tpch.nation WHERE n_regionkey = 2").rows)
+    assert a == b
+
+
+def test_partitioned_write_layout(runner, tmp_path):
+    runner.execute(
+        "CREATE TABLE lake.pt (v bigint, region bigint) "
+        "WITH (format = 'csv', partitioned_by = ARRAY['region'])")
+    runner.execute("INSERT INTO lake.pt VALUES (1, 10), (2, 10), (3, 20)")
+    # hive directory layout: region=<value>/part-*.csv
+    assert sorted(os.listdir(tmp_path / "pt")) == [
+        "_schema.json", "region=10", "region=20"]
+    # partition column not stored in the data files
+    files = os.listdir(tmp_path / "pt" / "region=10")
+    body = (tmp_path / "pt" / "region=10" / files[0]).read_text()
+    assert "10" not in body
+    got = sorted(runner.execute("SELECT region, v FROM lake.pt").rows)
+    assert got == [(10, 1), (10, 2), (20, 3)]
+
+
+def test_partition_pruning(runner):
+    conn = runner.registry.get("lake")
+    runner.execute(
+        "CREATE TABLE lake.pp (v bigint, d date) "
+        "WITH (partitioned_by = ARRAY['d'])")
+    runner.execute(
+        "INSERT INTO lake.pp VALUES "
+        "(1, DATE '2020-01-01'), (2, DATE '2020-01-02'), "
+        "(3, DATE '2020-01-03')")
+    handle = conn.get_table("pp")
+    splits = conn.get_splits(handle, 1)
+    assert len(splits) == 3
+    # prune via the connector API with storage-domain (epoch-day) literal
+    import datetime
+
+    day2 = (datetime.date(2020, 1, 2) - datetime.date(1970, 1, 1)).days
+    live = conn.prune_splits(handle, splits, [("d", "ge", day2)])
+    assert len(live) == 2
+    # and end-to-end: the engine extracts the constraint and the query
+    # still answers correctly from the pruned split set
+    got = runner.execute(
+        "SELECT sum(v) FROM lake.pp WHERE d >= DATE '2020-01-02'").rows
+    assert got == [(5,)]
+    got = runner.execute(
+        "SELECT sum(v) FROM lake.pp WHERE d = DATE '2020-01-01'").rows
+    assert got == [(1,)]
+
+
+def test_pruning_observed(runner, monkeypatch):
+    """Prove files are skipped: count page_source calls."""
+    conn = runner.registry.get("lake")
+    runner.execute(
+        "CREATE TABLE lake.po (v bigint, k bigint) "
+        "WITH (partitioned_by = ARRAY['k'])")
+    for k in range(4):
+        runner.execute(f"INSERT INTO lake.po VALUES ({k}, {k})")
+    opened = []
+    orig = LakehouseConnector.page_source
+
+    def counting(self, split, columns, batch_rows=65536):
+        opened.append(split.info[0])
+        return orig(self, split, columns, batch_rows)
+
+    monkeypatch.setattr(LakehouseConnector, "page_source", counting)
+    got = runner.execute(
+        "SELECT sum(v) FROM lake.po WHERE k IN (1, 3)").rows
+    assert got == [(4,)]
+    assert len(opened) == 2  # two of four partitions opened
+
+
+def test_analyze_stats_rename_drop(runner):
+    runner.execute("CREATE TABLE lake.s (a bigint, b varchar)")
+    runner.execute("INSERT INTO lake.s VALUES (1,'x'),(2,NULL),(3,'y')")
+    runner.execute("ANALYZE lake.s")
+    stats = runner.execute("SHOW STATS FOR lake.s").rows
+    by_col = {r[0]: r for r in stats}
+    assert by_col[None][4] == 3.0
+    assert by_col["b"][3] == pytest.approx(1 / 3)
+    runner.execute("ALTER TABLE lake.s RENAME TO s2")
+    assert runner.execute("SELECT count(*) FROM lake.s2").rows == [(3,)]
+    runner.execute("DROP TABLE lake.s2")
+    assert ("s2",) not in runner.execute("SHOW TABLES").rows
+
+
+def test_join_lake_with_tpch(runner):
+    runner.execute("CREATE TABLE lake.regions WITH (format='parquet') AS "
+                   "SELECT r_regionkey, r_name FROM tpch.region")
+    got = runner.execute(
+        "SELECT r.r_name, count(*) FROM tpch.nation n "
+        "JOIN lake.regions r ON n.n_regionkey = r.r_regionkey "
+        "GROUP BY r.r_name ORDER BY r.r_name").rows
+    assert len(got) == 5 and all(c == 5 for _, c in got)
+
+
+def test_empty_table_scan(runner):
+    runner.execute("CREATE TABLE lake.e (a bigint)")
+    assert runner.execute("SELECT count(*) FROM lake.e").rows == [(0,)]
+
+
+def test_null_partition_values(runner):
+    runner.execute(
+        "CREATE TABLE lake.np (v bigint, p bigint) "
+        "WITH (partitioned_by = ARRAY['p'])")
+    runner.execute("INSERT INTO lake.np VALUES (1, 10), (2, NULL)")
+    got = sorted(runner.execute("SELECT v, p FROM lake.np").rows)
+    assert got == [(1, 10), (2, None)]
+    assert runner.execute(
+        "SELECT v FROM lake.np WHERE p IS NULL").rows == [(2,)]

@@ -1,0 +1,107 @@
+"""Raptor-role native shard storage tests: shard files in the engine
+wire format, sqlite metadata, bucketing, compaction, backup/recovery
+(reference: presto-raptor-legacy ShardManager/OrcStorageManager/
+ShardCompactor/BackupStore)."""
+
+import os
+
+import pytest
+
+from presto_tpu.connectors.raptor import RaptorConnector
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner(tmp_path):
+    r = LocalQueryRunner.tpch(scale=0.01)
+    r.register("raptor", RaptorConnector(
+        str(tmp_path / "data"), backup_root=str(tmp_path / "backup")))
+    return r
+
+
+def test_ddl_insert_select_roundtrip(runner):
+    runner.execute("CREATE TABLE raptor.t (a bigint, b varchar, c double)")
+    runner.execute("INSERT INTO raptor.t VALUES (1,'x',0.5),(2,NULL,1.5)")
+    runner.execute("INSERT INTO raptor.t VALUES (3,'z',-2.0)")
+    got = sorted(runner.execute("SELECT * FROM raptor.t").rows)
+    assert got == [(1, "x", 0.5), (2, None, 1.5), (3, "z", -2.0)]
+    # two INSERTs -> two shards (grouped into splits on demand)
+    conn = runner.registry.get("raptor")
+    splits = conn.get_splits(conn.get_table("t"), 1)
+    assert sum(len(s.info[0]) for s in splits) == 2
+    assert len(conn.get_splits(conn.get_table("t"), 2)) == 2
+
+
+def test_ctas_and_persistence(runner, tmp_path):
+    runner.execute("CREATE TABLE raptor.nat AS SELECT n_nationkey, n_name "
+                   "FROM tpch.nation")
+    # reopen the warehouse: a fresh connector sees the same data
+    r2 = LocalQueryRunner.tpch(scale=0.01)
+    r2.register("raptor", RaptorConnector(str(tmp_path / "data")))
+    got = r2.execute("SELECT count(*) FROM raptor.nat").rows
+    assert got == [(25,)]
+    assert sorted(r2.execute(
+        "SELECT n_name FROM raptor.nat WHERE n_nationkey < 2").rows) == \
+        [("ALGERIA",), ("ARGENTINA",)]
+
+
+def test_bucketed_table(runner):
+    runner.execute(
+        "CREATE TABLE raptor.b (k bigint, v varchar) "
+        "WITH (bucket_count = 4, bucketed_on = ARRAY['k'])")
+    rows = ", ".join(f"({i}, 'v{i}')" for i in range(40))
+    runner.execute(f"INSERT INTO raptor.b VALUES {rows}")
+    conn = runner.registry.get("raptor")
+    splits = conn.get_splits(conn.get_table("b"), 1)
+    # one split per touched bucket, each tagged with its bucket number
+    buckets = {s.info[1] for s in splits}
+    assert len(splits) == len(buckets) and len(buckets) > 1
+    # same key always lands in the same bucket: re-insert key 7 and check
+    runner.execute("INSERT INTO raptor.b VALUES (7, 'again')")
+    splits2 = conn.get_splits(conn.get_table("b"), 1)
+    b7 = [s for s in splits2
+          if any("7" in str(r) for batch_rows in [
+              [b.to_pylist() for b in conn.page_source(s, ["k", "v"])]]
+              for batch in batch_rows for r in batch if r[0] == 7)]
+    assert len({s.info[1] for s in b7}) == 1
+    assert runner.execute(
+        "SELECT count(*) FROM raptor.b").rows == [(41,)]
+
+
+def test_compaction(runner):
+    runner.execute("CREATE TABLE raptor.c (a bigint)")
+    for i in range(6):
+        runner.execute(f"INSERT INTO raptor.c VALUES ({i})")
+    conn = runner.registry.get("raptor")
+    before, after = conn.compact("c")
+    assert before == 6 and after == 1
+    assert sorted(runner.execute("SELECT a FROM raptor.c").rows) == \
+        [(i,) for i in range(6)]
+
+
+def test_backup_recovery(runner, tmp_path):
+    runner.execute("CREATE TABLE raptor.r (a bigint)")
+    runner.execute("INSERT INTO raptor.r VALUES (42)")
+    conn = runner.registry.get("raptor")
+    # simulate primary shard loss
+    shard_dir = tmp_path / "data" / "shards"
+    shards = [f for f in os.listdir(shard_dir) if f.endswith(".shard")]
+    assert shards
+    for f in shards:
+        os.remove(shard_dir / f)
+    # read recovers from the backup store
+    assert runner.execute("SELECT a FROM raptor.r").rows == [(42,)]
+    # and the primary is restored on disk
+    assert any(f.endswith(".shard") for f in os.listdir(shard_dir))
+
+
+def test_rename_drop(runner, tmp_path):
+    runner.execute("CREATE TABLE raptor.x (a bigint)")
+    runner.execute("INSERT INTO raptor.x VALUES (5)")
+    runner.execute("ALTER TABLE raptor.x RENAME TO y")
+    assert runner.execute("SELECT a FROM raptor.y").rows == [(5,)]
+    runner.execute("DROP TABLE raptor.y")
+    assert not [f for f in os.listdir(tmp_path / "data" / "shards")
+                if f.endswith(".shard")]
+    with pytest.raises(Exception):
+        runner.execute("SELECT * FROM raptor.y")

@@ -229,3 +229,23 @@ def test_drop_if_exists_unknown_catalog(runner):
 def test_show_functions_excludes_internal_names(runner):
     names = {r[0] for r in rows(runner, "SHOW FUNCTIONS")}
     assert not ({"eq", "ne", "add", "subtract", "modulus"} & names)
+
+
+def test_recursive_view_rejected(runner):
+    runner.registry.views[("tpch", "rv")] = "SELECT * FROM rv"
+    try:
+        with pytest.raises(Exception, match="recursive"):
+            runner.execute("SELECT * FROM rv")
+    finally:
+        del runner.registry.views[("tpch", "rv")]
+
+
+def test_mutually_recursive_views_rejected(runner):
+    runner.registry.views[("tpch", "va")] = "SELECT * FROM vb"
+    runner.registry.views[("tpch", "vb")] = "SELECT * FROM va"
+    try:
+        with pytest.raises(Exception, match="recursive"):
+            runner.execute("SELECT * FROM va")
+    finally:
+        del runner.registry.views[("tpch", "va")]
+        del runner.registry.views[("tpch", "vb")]
