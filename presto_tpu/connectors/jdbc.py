@@ -175,19 +175,33 @@ class JdbcConnector(Connector):
         where, params = split.info
         if where:
             sql += f" WHERE {where}"
-        rows = self._run(sql, params)
         conn = self
 
         class _Source(PageSource):
             def __iter__(self):
-                for lo in range(0, max(len(rows), 1), batch_rows):
-                    chunk = rows[lo:lo + batch_rows]
-                    pyrows = [tuple(conn._from_remote(t, v)
-                                    for t, v in zip(types, r))
-                              for r in chunk]
-                    yield batch_from_pylist(types, pyrows)
-                    if not rows:
-                        return
+                # stream via fetchmany so host memory stays bounded by
+                # batch_rows, not the remote result size; the lock is
+                # taken per fetch, never held across a yield
+                cx = conn._cx()
+                with conn._lock:
+                    cur = cx.cursor()
+                    cur.execute(sql, tuple(params))
+                try:
+                    empty = True
+                    while True:
+                        with conn._lock:
+                            chunk = cur.fetchmany(batch_rows)
+                        if not chunk:
+                            break
+                        empty = False
+                        pyrows = [tuple(conn._from_remote(t, v)
+                                        for t, v in zip(types, r))
+                                  for r in chunk]
+                        yield batch_from_pylist(types, pyrows)
+                    if empty:
+                        yield batch_from_pylist(types, [])
+                finally:
+                    cur.close()
 
         return _Source()
 

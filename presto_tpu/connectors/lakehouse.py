@@ -85,12 +85,33 @@ def _from_text(typ: T.Type, s: str) -> Any:
 _NULL_PARTITION = "__DEFAULT_PARTITION__"
 
 
+def _encode_pvalue(v: Any) -> str:
+    """Partition value -> directory-safe token (hive escapes unsafe
+    chars the same way); values colliding with the NULL sentinel get
+    their first character percent-encoded so decode stays unambiguous."""
+    import urllib.parse
+
+    if v is None:
+        return _NULL_PARTITION
+    s = urllib.parse.quote(str(v), safe="")
+    if s == _NULL_PARTITION:
+        s = f"%{ord(s[0]):02X}" + s[1:]
+    return s
+
+
+def _decode_pvalue(typ: T.Type, raw: str) -> Any:
+    import urllib.parse
+
+    if raw == _NULL_PARTITION:
+        return None
+    return _from_text(typ, urllib.parse.unquote(raw))
+
+
 def _partition_path(pcols: Sequence[str], values: Sequence[Any]) -> str:
     if not pcols:
         return ""
-    return os.path.join(*(
-        f"{c}={_NULL_PARTITION if v is None else v}"
-        for c, v in zip(pcols, values)))
+    return os.path.join(*(f"{c}={_encode_pvalue(v)}"
+                          for c, v in zip(pcols, values)))
 
 
 # --- format IO --------------------------------------------------------------
@@ -260,8 +281,7 @@ class LakehouseConnector(Connector):
                         break
                     k, _, raw = part.partition("=")
                     typ = meta.schema.column_type(k)
-                    pvals[k] = (None if raw == _NULL_PARTITION
-                                else _from_text(typ, raw))
+                    pvals[k] = _decode_pvalue(typ, raw)
             for fn in sorted(filenames):
                 if fn == _SCHEMA_FILE or fn.startswith("."):
                     continue

@@ -230,15 +230,21 @@ class RaptorConnector(Connector):
             raise ValueError(f"table already exists: {name}")
         return TableHandle("raptor", name)
 
+    def _remove_shard_files(self, shard_uuid: str) -> None:
+        for path in [self._shard_path(shard_uuid)] + (
+                [os.path.join(self.backup_root, shard_uuid + ".shard")]
+                if self.backup_root else []):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+
     def drop_table(self, name: str) -> None:
         self.get_table(name)
         for (su,) in self._q(
                 "SELECT shard_uuid FROM shards WHERE table_name = ?",
                 (name,)):
-            try:
-                os.remove(self._shard_path(su))
-            except FileNotFoundError:
-                pass
+            self._remove_shard_files(su)
         self._q("DELETE FROM shards WHERE table_name = ?", (name,))
         self._q("DELETE FROM tables WHERE name = ?", (name,))
 
@@ -290,10 +296,7 @@ class RaptorConnector(Connector):
                 for su in run:
                     self._q("DELETE FROM shards WHERE shard_uuid = ?",
                             (su,))
-                    try:
-                        os.remove(self._shard_path(su))
-                    except FileNotFoundError:
-                        pass
+                    self._remove_shard_files(su)
         after = len(self._q(
             "SELECT shard_uuid FROM shards WHERE table_name = ?",
             (table,)))

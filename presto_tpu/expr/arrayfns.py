@@ -1050,3 +1050,38 @@ def _kid_from_value(typ: T.Type, values, valid) -> Column:
         # the Column; a bare code array cannot appear here
         raise NotImplementedError("string lambda results need a dictionary")
     return Column(typ, np.asarray(values), valid)
+
+
+def rows_learn(mode: str):
+    """learn_classifier / learn_regressor finalize over collected
+    array(row(label, features_json)) pairs: train per group, emit the
+    model as JSON varchar (presto-ml LearnClassifierAggregation role —
+    see expr/ml.py for the estimators)."""
+    out_dict = Dictionary()
+
+    def impl(args, valids, n, xp) -> Pair:
+        from presto_tpu.expr import ml
+
+        (col,) = args
+        offsets = _offsets(col)
+        lcol, fcol = col.children[0].children
+        labels = lcol.to_pylist(int(offsets[-1]))
+        feats = fcol.to_pylist(int(offsets[-1]))
+        codes = np.zeros(n, np.int32)
+        ok = np.zeros(n, bool)
+        for i in range(n):
+            lo, hi = int(offsets[i]), int(offsets[i + 1])
+            pairs = [(l, f) for l, f in zip(labels[lo:hi], feats[lo:hi])
+                     if l is not None and f is not None]
+            if not pairs:
+                continue
+            ls = [p[0] for p in pairs]
+            fs = [p[1] for p in pairs]
+            model = (ml.train_classifier(ls, fs)
+                     if mode == "learn_classifier"
+                     else ml.train_regressor(ls, fs))
+            codes[i] = out_dict.intern(model)
+            ok[i] = True
+        return Column(T.VARCHAR, codes, None, out_dict), ok
+
+    return impl

@@ -1,0 +1,104 @@
+"""ML functions: learn_classifier / classify, learn_regressor / regress.
+
+The presto-ml role (3,449 LoC: learn_classifier/learn_regressor
+aggregates train a libsvm model over collected (label, features) pairs;
+classify/regress scalars apply it; features(...) builds a FeatureVector).
+Here models are trained in numpy — multinomial logistic regression for
+classification, ridge-regularized least squares for regression — and
+serialized as JSON varchar so they flow through the engine as ordinary
+values (the reference's Model/Classifier SQL type role).
+
+Reference: presto-ml/src/main/java/io/prestosql/plugin/ml/
+LearnClassifierAggregation.java, ClassifyFunctions.java,
+MLFeaturesFunctions.java.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def features(*xs: float) -> str:
+    """Feature vector as a JSON array (FeatureVector analogue)."""
+    return json.dumps([float(x) for x in xs])
+
+
+def _feature_matrix(fjsons: Sequence[str]) -> np.ndarray:
+    rows = [json.loads(f) for f in fjsons]
+    width = max((len(r) for r in rows), default=0)
+    X = np.zeros((len(rows), width))
+    for i, r in enumerate(rows):
+        X[i, :len(r)] = r
+    return X
+
+
+def train_classifier(labels: Sequence, fjsons: Sequence[str],
+                     iters: int = 300, lr: float = 0.5) -> str:
+    """Multinomial logistic regression by full-batch gradient descent
+    (the libsvm-classifier role; softmax instead of SVM)."""
+    X = _feature_matrix(fjsons)
+    classes = sorted({str(l) for l in labels})
+    idx = {c: i for i, c in enumerate(classes)}
+    y = np.asarray([idx[str(l)] for l in labels])
+    n, d = X.shape
+    k = len(classes)
+    # standardize for conditioning; bake the transform into the model
+    mu = X.mean(axis=0) if n else np.zeros(d)
+    sd = X.std(axis=0) if n else np.ones(d)
+    sd = np.where(sd > 0, sd, 1.0)
+    Xs = (X - mu) / sd
+    W = np.zeros((d, k))
+    b = np.zeros(k)
+    onehot = np.eye(k)[y] if n else np.zeros((0, k))
+    for _ in range(iters):
+        logits = Xs @ W + b
+        logits -= logits.max(axis=1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(axis=1, keepdims=True)
+        g = (p - onehot) / max(n, 1)
+        W -= lr * (Xs.T @ g + 1e-4 * W)
+        b -= lr * g.sum(axis=0)
+    return json.dumps({
+        "kind": "classifier", "classes": classes, "mu": mu.tolist(),
+        "sd": sd.tolist(), "w": W.tolist(), "b": b.tolist()})
+
+
+def train_regressor(ys: Sequence[float], fjsons: Sequence[str]) -> str:
+    """Ridge-regularized least squares (closed form)."""
+    X = _feature_matrix(fjsons)
+    y = np.asarray([float(v) for v in ys])
+    n, d = X.shape
+    Xb = np.hstack([X, np.ones((n, 1))])
+    A = Xb.T @ Xb + 1e-6 * np.eye(d + 1)
+    w = np.linalg.solve(A, Xb.T @ y) if n else np.zeros(d + 1)
+    return json.dumps({"kind": "regressor", "w": w[:-1].tolist(),
+                       "b": float(w[-1])})
+
+
+def classify(fjson: str, model_json: str) -> str:
+    m = json.loads(model_json)
+    if m.get("kind") != "classifier":
+        raise ValueError("classify() needs a learn_classifier model")
+    x = np.asarray(json.loads(fjson), dtype=float)
+    d = len(m["mu"])
+    xp = np.zeros(d)
+    xp[:min(len(x), d)] = x[:d]
+    xs = (xp - np.asarray(m["mu"])) / np.asarray(m["sd"])
+    logits = xs @ np.asarray(m["w"]) + np.asarray(m["b"])
+    return m["classes"][int(np.argmax(logits))]
+
+
+def regress(fjson: str, model_json: str) -> float:
+    m = json.loads(model_json)
+    if m.get("kind") != "regressor":
+        raise ValueError("regress() needs a learn_regressor model")
+    x = np.asarray(json.loads(fjson), dtype=float)
+    w = np.asarray(m["w"])
+    d = len(w)
+    xp = np.zeros(d)
+    xp[:min(len(x), d)] = x[:d]
+    return float(xp @ w + m["b"])

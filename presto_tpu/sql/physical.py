@@ -503,6 +503,8 @@ def _finalize(agg: PlanAggregate, comps: List[RowExpression]
     if fin in ("corr", "covar_samp", "covar_pop", "regr_slope",
                "regr_intercept"):
         return B.call(f"$rows_{fin}", comps[0])
+    if fin in ("learn_classifier", "learn_regressor"):
+        return B.call(f"$rows_{fin}", comps[0])
     if fin == "geometric_mean":
         s, n = comps
         return B.call("exp", B.call("divide", s, B.cast(n, T.DOUBLE)))

@@ -37,7 +37,8 @@ AGG_NAMES = {"count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
              "arbitrary", "bool_and", "bool_or", "every", "count_if",
              "array_agg", "map_agg", "min_by", "max_by", "approx_distinct",
              "approx_percentile", "corr", "covar_samp", "covar_pop",
-             "regr_slope", "regr_intercept", "geometric_mean", "checksum"}
+             "regr_slope", "regr_intercept", "geometric_mean", "checksum",
+             "learn_classifier", "learn_regressor"}
 
 
 class SqlAnalysisError(ValueError):
@@ -1786,7 +1787,8 @@ def _collect_windows(e: t.Node, out: List[t.FunctionCall]):
 
 
 _TWO_ARG_AGGS = {"map_agg", "min_by", "max_by", "corr", "covar_samp",
-                 "covar_pop", "regr_slope", "regr_intercept"}
+                 "covar_pop", "regr_slope", "regr_intercept",
+                 "learn_classifier", "learn_regressor"}
 
 
 def _agg_input(tr: Translator, a: t.FunctionCall) -> RowExpression:

@@ -1,0 +1,166 @@
+"""Geospatial, ML, and Teradata function-pack tests (presto-geospatial
+GeoFunctions/BingTileFunctions, presto-ml, presto-teradata-functions)."""
+
+import math
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+def one(runner, sql):
+    rows = runner.execute("SELECT " + sql).rows
+    assert len(rows) == 1
+    return rows[0][0]
+
+
+# --- geospatial -------------------------------------------------------------
+
+def test_st_point_accessors(runner):
+    assert one(runner, "ST_Point(1.5, -2)") == "POINT (1.5 -2)"
+    assert one(runner, "ST_X(ST_Point(3, 4))") == 3.0
+    assert one(runner, "ST_Y(ST_Point(3, 4))") == 4.0
+    assert one(runner, "ST_GeometryType(ST_Point(0, 0))") == "ST_Point"
+
+
+def test_st_area_length(runner):
+    sq = "'POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))'"
+    assert one(runner, f"ST_Area(ST_GeometryFromText({sq}))") == 16.0
+    hole = ("'POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), "
+            "(1 1, 2 1, 2 2, 1 2, 1 1))'")
+    assert one(runner, f"ST_Area(ST_GeometryFromText({hole}))") == 15.0
+    line = "'LINESTRING (0 0, 3 4, 3 8)'"
+    assert one(runner, f"ST_Length(ST_GeometryFromText({line}))") == 9.0
+    assert one(runner, f"ST_Perimeter(ST_GeometryFromText({sq}))") == 16.0
+
+
+def test_st_contains_intersects_distance(runner):
+    sq = "'POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))'"
+    assert one(runner, f"ST_Contains(ST_GeometryFromText({sq}), "
+                       "ST_Point(5, 5))") is True
+    assert one(runner, f"ST_Contains(ST_GeometryFromText({sq}), "
+                       "ST_Point(15, 5))") is False
+    # hole excludes
+    hole = ("'POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), "
+            "(4 4, 6 4, 6 6, 4 6, 4 4))'")
+    assert one(runner, f"ST_Contains(ST_GeometryFromText({hole}), "
+                       "ST_Point(5, 5))") is False
+    assert one(runner, "ST_Intersects(ST_GeometryFromText("
+                       "'LINESTRING (0 0, 10 10)'), ST_GeometryFromText("
+                       "'LINESTRING (0 10, 10 0)'))") is True
+    assert one(runner, "ST_Distance(ST_Point(0, 0), "
+                       "ST_Point(3, 4))") == 5.0
+    d = one(runner, f"ST_Distance(ST_GeometryFromText({sq}), "
+                    "ST_Point(13, 14))")
+    assert d == 5.0  # distance to corner (10,10)
+    assert one(runner, f"ST_Within(ST_Point(5, 5), "
+                       f"ST_GeometryFromText({sq}))") is True
+
+
+def test_st_misc(runner):
+    assert one(runner, "ST_IsValid('POINT (0 0)')") is True
+    assert one(runner, "ST_IsValid('NOT WKT')") is False
+    env = one(runner, "ST_Envelope(ST_GeometryFromText("
+                      "'LINESTRING (1 2, 5 7)'))")
+    assert env == "POLYGON ((1 2, 5 2, 5 7, 1 7, 1 2))"
+    c = one(runner, "ST_Centroid(ST_GeometryFromText("
+                    "'POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))'))")
+    assert c == "POINT (1 1)"
+    assert one(runner, "ST_NumPoints(ST_GeometryFromText("
+                       "'LINESTRING (0 0, 1 1, 2 2)'))") == 3
+    area = one(runner, "ST_Area(ST_Buffer(ST_Point(0, 0), 1))")
+    assert abs(area - math.pi) < 0.02
+
+
+def test_spatial_join_via_predicate(runner):
+    """Spatial join correctness: points-in-polygons through the join
+    path with an ST_Contains predicate (SpatialJoinOperator contract)."""
+    runner.execute("CREATE TABLE memory.geoms (name varchar, g varchar)")
+    runner.execute(
+        "INSERT INTO memory.geoms VALUES "
+        "('left',  'POLYGON ((0 0, 5 0, 5 10, 0 10, 0 0))'), "
+        "('right', 'POLYGON ((5 0, 10 0, 10 10, 5 10, 5 0))')")
+    runner.execute("CREATE TABLE memory.pts (id bigint, x double, "
+                   "y double)")
+    runner.execute("INSERT INTO memory.pts VALUES "
+                   "(1, 1, 1), (2, 7, 3), (3, 3, 9), (4, 12, 1)")
+    got = sorted(runner.execute(
+        "SELECT p.id, g.name FROM memory.pts p, memory.geoms g "
+        "WHERE ST_Contains(g.g, ST_Point(p.x, p.y))").rows)
+    assert got == [(1, "left"), (2, "right"), (3, "left")]
+
+
+def test_bing_tiles(runner):
+    qk = one(runner, "bing_tile_at(47.6097, -122.3331, 8)")
+    assert isinstance(qk, str) and len(qk) == 8
+    assert one(runner, f"bing_tile_zoom_level('{qk}')") == 8
+    poly = one(runner, f"bing_tile_polygon('{qk}')")
+    assert poly.startswith("POLYGON")
+    # the tile polygon contains the original point (lon, lat order)
+    assert one(runner, f"ST_Contains('{poly}', "
+                       "ST_Point(-122.3331, 47.6097))") is True
+
+
+# --- ml ---------------------------------------------------------------------
+
+def test_learn_classifier_classify(runner):
+    runner.execute("CREATE TABLE memory.iris (label varchar, "
+                   "a double, b double)")
+    rows = []
+    import random
+
+    rnd = random.Random(7)
+    for _ in range(60):
+        rows.append(f"('low', {rnd.uniform(0,1)}, {rnd.uniform(0,1)})")
+        rows.append(f"('high', {rnd.uniform(4,5)}, {rnd.uniform(4,5)})")
+    runner.execute("INSERT INTO memory.iris VALUES " + ", ".join(rows))
+    got = runner.execute(
+        "WITH model AS (SELECT learn_classifier(label, features(a, b)) m "
+        "FROM memory.iris) "
+        "SELECT classify(features(0.5, 0.5), m), "
+        "classify(features(4.5, 4.5), m) FROM model").rows
+    assert got == [("low", "high")]
+
+
+def test_learn_regressor_regress(runner):
+    runner.execute("CREATE TABLE memory.lin (y double, x double)")
+    vals = ", ".join(f"({3.0 * i + 1.0}, {float(i)})" for i in range(20))
+    runner.execute(f"INSERT INTO memory.lin VALUES {vals}")
+    got = runner.execute(
+        "WITH model AS (SELECT learn_regressor(y, features(x)) m "
+        "FROM memory.lin) "
+        "SELECT regress(features(10), m) FROM model").rows
+    assert got[0][0] == pytest.approx(31.0, abs=1e-3)
+
+
+# --- teradata ---------------------------------------------------------------
+
+def test_teradata_functions(runner):
+    assert one(runner, "index('chip', 'ip')") == 3
+    assert one(runner, "index('chip', 'zz')") == 0
+    assert one(runner, "char2hexint('AB')") == "00410042"
+    assert one(runner, "to_char(DATE '2001-08-22', 'yyyy/mm/dd')") == \
+        "2001/08/22"
+    import datetime
+
+    assert one(runner, "to_date('1988/04/08', 'yyyy/mm/dd')") == \
+        datetime.date(1988, 4, 8)
+    assert one(runner,
+               "to_timestamp('1988/04/08 2:3:4', 'yyyy/mm/dd hh24:mi:ss')"
+               ) == datetime.datetime(1988, 4, 8, 2, 3, 4)
+
+
+def test_empty_geometries(runner):
+    assert one(runner, "ST_Distance('POINT EMPTY', 'POINT (1 1)')") is None
+    assert one(runner, "ST_Contains('POLYGON EMPTY', "
+                       "ST_Point(0, 0))") is False
+    assert one(runner, "ST_Intersects('POINT EMPTY', "
+                       "'POINT EMPTY')") is False
+    assert one(runner, "ST_Centroid('POINT EMPTY')") is None
+    assert one(runner, "ST_Envelope('LINESTRING EMPTY')") is None
+    assert one(runner, "ST_Area('POLYGON EMPTY')") == 0.0

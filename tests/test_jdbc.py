@@ -25,8 +25,7 @@ def test_ddl_insert_select(runner):
 
     assert got[0] == (1, "x", 0.5, datetime.date(2021, 6, 1), True)
     assert got[1] == (2, None, -1.5, None, False)
-    assert ("t",) in runner.execute("SHOW TABLES FROM sqlite").rows or \
-        True  # SHOW TABLES uses default catalog; check DESCRIBE instead
+    assert ("t",) in runner.execute("SHOW TABLES FROM sqlite").rows
     cols = dict(runner.execute("DESCRIBE sqlite.t").rows)
     assert cols["a"] == "bigint" and cols["e"] == "boolean"
 
@@ -35,24 +34,23 @@ def test_predicate_pushdown_to_remote_sql(runner, monkeypatch):
     runner.execute("CREATE TABLE sqlite.p (k bigint, v varchar)")
     runner.execute("INSERT INTO sqlite.p VALUES (1,'a'),(2,'b'),(3,'c'),"
                    "(4,'d')")
-    conn = runner.registry.get("sqlite")
-    issued = []
-    orig = SqliteConnector._run
+    scanned = []
+    orig = SqliteConnector.page_source
 
-    def spy(self, sql, params=()):
-        issued.append((sql, tuple(params)))
-        return orig(self, sql, params)
+    def spy(self, split, columns, batch_rows=65536):
+        scanned.append(split.info)
+        return orig(self, split, columns, batch_rows)
 
-    monkeypatch.setattr(SqliteConnector, "_run", spy)
+    monkeypatch.setattr(SqliteConnector, "page_source", spy)
     got = sorted(runner.execute(
         "SELECT v FROM sqlite.p WHERE k >= 2 AND k IN (1, 2, 4)").rows)
     assert got == [("b",), ("d",)]
-    scans = [(s, p) for s, p in issued
-             if s.startswith("SELECT") and 'FROM "p"' in s]
-    assert scans and all("WHERE" in s for s, _ in scans), scans
-    assert any("IN" in s for s, _ in scans)
-    # the remote received bind parameters, not inlined literals
-    assert 2 in scans[0][1]
+    # the split carries a remote WHERE clause with bind parameters,
+    # not inlined literals
+    assert scanned
+    where, params = scanned[0]
+    assert "IN" in where and ">=" in where, where
+    assert 2 in params and 4 in params
 
 
 def test_ctas_roundtrip_with_tpch(runner):

@@ -158,3 +158,19 @@ def test_null_partition_values(runner):
     assert got == [(1, 10), (2, None)]
     assert runner.execute(
         "SELECT v FROM lake.np WHERE p IS NULL").rows == [(2,)]
+
+
+def test_partition_value_escaping(runner):
+    runner.execute(
+        "CREATE TABLE lake.esc (v bigint, p varchar) "
+        "WITH (partitioned_by = ARRAY['p'])")
+    runner.execute("INSERT INTO lake.esc VALUES (1, 'a/b'), (2, 'c'), "
+                   "(3, '__DEFAULT_PARTITION__'), (4, NULL)")
+    got = sorted(runner.execute("SELECT v, p FROM lake.esc").rows)
+    assert got == [(1, "a/b"), (2, "c"), (3, "__DEFAULT_PARTITION__"),
+                   (4, None)]
+    assert runner.execute(
+        "SELECT v FROM lake.esc WHERE p = 'a/b'").rows == [(1,)]
+    assert runner.execute(
+        "SELECT v FROM lake.esc WHERE p = '__DEFAULT_PARTITION__'"
+    ).rows == [(3,)]
