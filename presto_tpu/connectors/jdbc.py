@@ -282,7 +282,13 @@ class SqliteConnector(JdbcConnector):
         import sqlite3
 
         def connect():
-            return sqlite3.connect(path, check_same_thread=False)
+            cx = sqlite3.connect(path, check_same_thread=False,
+                                 timeout=30.0)
+            if path != ":memory:":
+                # WAL lets writers proceed while a streaming scan keeps
+                # its read transaction open across fetchmany batches
+                cx.execute("PRAGMA journal_mode=WAL")
+            return cx
 
         super().__init__(connect, paramstyle="qmark")
 

@@ -160,6 +160,8 @@ def _n(x: float) -> str:
 
 
 def format_wkt(g: Geometry) -> str:
+    if not g.vertices():
+        return f"{g.kind.upper()} EMPTY"
     if g.kind == "point":
         return f"POINT ({_fmt_pt(g.paths[0][0])})"
     if g.kind == "multipoint":

@@ -36,7 +36,27 @@ def to_sortable_i64(xp, values, typ: T.Type):
         else:
             import jax
 
-            bits = jax.lax.bitcast_convert_type(f64, xp.int64)
+            if jax.default_backend() not in ("cpu", "gpu", "cuda",
+                                             "rocm"):
+                # TPU: the X64 rewrite emulates every 64-bit type (f64
+                # is physically f32), so an exact f64 bitcast neither
+                # compiles nor means anything on device.  Order by the
+                # f32 bit pattern instead — exact for every value the
+                # hardware can represent.  Values closer than an f32
+                # ulp become ties for sorting AND equal group-by/join
+                # keys; that is consistent with the device values
+                # themselves, which have already been rounded to f32 by
+                # the same rewrite before any comparison runs.
+                b32 = jax.lax.bitcast_convert_type(
+                    f64.astype(xp.float32), xp.int32)
+                b32 = xp.where(b32 < 0, b32 ^ xp.int32(0x7FFFFFFF), b32)
+                return b32.astype(xp.int64)
+            # CPU/GPU: exact f64 ordering; the rewrite-safe two-u32
+            # reassembly also works jitted (minor dim 0 = low bits).
+            parts = jax.lax.bitcast_convert_type(f64, xp.uint32)
+            lo = parts[..., 0].astype(xp.int64)
+            hi = parts[..., 1].astype(xp.int64)
+            bits = (hi << xp.int64(32)) | lo
         # signed-comparison order fix: negative floats have reversed bit
         # order, so flip their non-sign bits; positives compare correctly.
         return xp.where(bits < 0, bits ^ xp.int64(0x7FFFFFFFFFFFFFFF), bits)

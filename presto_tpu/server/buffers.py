@@ -75,6 +75,11 @@ class OutputBufferManager:
     def fail(self, error: Exception) -> None:
         with self._lock:
             self._failed = error
+            # release retained pages (an early-stopping consumer — TopN
+            # merge — may never ack them) and unblock parked producers
+            for buf in self.buffers.values():
+                buf.pages.clear()
+            self._bytes = 0
             self._lock.notify_all()
 
     # -- consumer side --------------------------------------------------

@@ -157,7 +157,23 @@ class QueryExecution:
             self.co.log(traceback.format_exc())
             self.state = "FAILED"
         finally:
+            # release worker-side state the drain did not consume: a
+            # TopN merge stops early, and failed queries strand tasks
+            # mid-run — cancel fans out DELETE /v1/query/{id} so output
+            # buffers are freed and blocked producers unblock
+            # (SqlQueryScheduler abort/cancel role)
+            self._cancel_worker_tasks()
             self.rows_done.set()
+
+    def _cancel_worker_tasks(self) -> None:
+        for _nid, uri in self.co.nodes.alive_nodes():
+            try:
+                req = urllib.request.Request(
+                    f"{uri}/v1/query/{self.query_id}", method="DELETE")
+                with urllib.request.urlopen(req, timeout=5):
+                    pass
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
 
     # -- scheduling -----------------------------------------------------
     def _task_count(self, partitioning: str, n_workers: int) -> int:

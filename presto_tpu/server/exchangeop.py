@@ -266,3 +266,137 @@ class ExchangeOperatorFactory(OperatorFactory):
         if self._client is None:
             self._client = ExchangeClient(self.locations)
         return ExchangeOperator(ctx, self._client)
+
+
+class MergeExchangeOperator(Operator):
+    """Order-preserving remote source: k-way merges pre-sorted producer
+    streams row-at-a-time (MergeOperator.java:45 over MergeSortedPages).
+
+    One ExchangeClient per producer location keeps each stream's page
+    order; a head row is comparable only when every unfinished stream
+    has at least one buffered row, so the merge never emits out of
+    order.  ``limit`` stops the merge early (distributed TopN)."""
+
+    def __init__(self, ctx: OperatorContext, locations: Sequence[str],
+                 sort_keys, types, limit: Optional[int] = None,
+                 batch_rows: int = 8192):
+        super().__init__(ctx)
+        self.clients = [ExchangeClient([loc]) for loc in locations]
+        self.sort_keys = list(sort_keys)   # (channel, ascending, nulls_first)
+        self.types = list(types)
+        self.limit = limit
+        self.batch_rows = batch_rows
+        self.rows_emitted = 0
+        self.queues: List[List[tuple]] = [[] for _ in locations]
+        self.positions = [0] * len(locations)
+        self.done = False
+
+    def needs_input(self) -> bool:
+        return False
+
+    def _refill(self, i: int) -> bool:
+        """True if stream i has a head row or is finished."""
+        q, pos = self.queues[i], self.positions[i]
+        if pos < len(q):
+            return True
+        self.queues[i] = []
+        self.positions[i] = 0
+        page = self.clients[i].poll_page()
+        if page is None:
+            return self.clients[i].finished
+        batch = deserialize_batch(page)
+        self.ctx.stats.input_rows += batch.num_rows
+        self.queues[i] = batch.to_pylist()
+        return bool(self.queues[i]) or self._refill(i)
+
+    def _before(self, a: tuple, b: tuple) -> bool:
+        for channel, ascending, nulls_first in self.sort_keys:
+            av, bv = a[channel], b[channel]
+            nf = bool(nulls_first)
+            if av is None or bv is None:
+                if av is None and bv is None:
+                    continue
+                return (av is None) == nf
+            if av == bv:
+                continue
+            return (av < bv) == bool(ascending)
+        return False
+
+    def get_output(self) -> Optional[Batch]:
+        from presto_tpu.batch import batch_from_pylist
+
+        if self.done:
+            return None
+        ready = True
+        for i in range(len(self.clients)):
+            if not self._refill(i):
+                ready = False
+        if not ready:
+            import time
+
+            time.sleep(0.002)  # cooperative wait; driver re-polls
+            return None
+        out: List[tuple] = []
+        while len(out) < self.batch_rows:
+            if self.limit is not None and \
+                    self.rows_emitted + len(out) >= self.limit:
+                self.done = True
+                break
+            best = -1
+            best_row = None
+            for i in range(len(self.clients)):
+                q, pos = self.queues[i], self.positions[i]
+                if pos >= len(q):
+                    continue
+                row = q[pos]
+                if best < 0 or self._before(row, best_row):
+                    best, best_row = i, row
+            if best < 0:
+                self.done = True  # every stream drained
+                break
+            out.append(best_row)
+            self.positions[best] += 1
+            if self.positions[best] >= len(self.queues[best]):
+                # _refill: True = has a head row again OR finished;
+                # False = stalled mid-merge -> emit what we have and
+                # resume next get_output once it has a head row
+                if not self._refill(best):
+                    break
+        if self.done:
+            # stop fetching immediately (limit reached / streams
+            # drained); the coordinator cancels producers afterwards
+            for c in self.clients:
+                c.close()
+        if not out:
+            return None
+        self.rows_emitted += len(out)
+        batch = batch_from_pylist(self.types, out)
+        self.ctx.stats.output_rows += batch.num_rows
+        return batch
+
+    def is_finished(self) -> bool:
+        if self.done:
+            return True
+        if all(c.finished for c in self.clients) and all(
+                self.positions[i] >= len(self.queues[i])
+                for i in range(len(self.clients))):
+            return True
+        return False
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()
+        super().close()
+
+
+class MergeExchangeOperatorFactory(OperatorFactory):
+    def __init__(self, locations: Sequence[str], sort_keys, types,
+                 limit: Optional[int] = None):
+        self.locations = list(locations)
+        self.sort_keys = list(sort_keys)
+        self.types = list(types)
+        self.limit = limit
+
+    def create(self, ctx: OperatorContext):
+        return MergeExchangeOperator(ctx, self.locations, self.sort_keys,
+                                     self.types, self.limit)

@@ -23,8 +23,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from presto_tpu import types as T
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
-    OutputNode, PlanNode, ProjectNode, RemoteSourceNode, SemiJoinNode,
-    SortNode, TableScanNode, UnionNode, UnnestNode, ValuesNode, WindowNode,
+    OutputNode, PlanNode, ProjectNode, RemoteMergeNode, RemoteSourceNode,
+    SemiJoinNode, SortNode, TableScanNode, UnionNode, UnnestNode,
+    ValuesNode, WindowNode,
 )
 
 
@@ -92,6 +93,11 @@ class Fragmenter:
             return self._visit_join(node)
         if isinstance(node, SemiJoinNode):
             return self._visit_semijoin(node)
+        if isinstance(node, SortNode):
+            return self._visit_sort(node, limit=None)
+        if isinstance(node, LimitNode) and isinstance(node.source,
+                                                     SortNode):
+            return self._visit_sort(node.source, limit=node.count)
         if isinstance(node, (FilterNode, ProjectNode, LimitNode, SortNode,
                              WindowNode, EnforceSingleRowNode, UnionNode,
                              UnnestNode)):
@@ -105,6 +111,54 @@ class Fragmenter:
             return _replace_sources(node, new_sources), consumed
         # leaves (TableScan, Values) stay put
         return node, []
+
+    def _visit_sort(self, node: SortNode, limit) -> Tuple[PlanNode,
+                                                          List[int]]:
+        """Distributed ORDER BY / TopN (MergeOperator.java:45 pattern):
+        each producer task sorts (and truncates) its share; the consumer
+        k-way merges the pre-sorted streams instead of re-sorting
+        everything on one node.  Falls back to a consumer-side full sort
+        when the subtree cannot safely run as a multi-task fragment."""
+        src, consumed = self._visit(node.source)
+        if not self._parallel_safe(src):
+            inner = SortNode(src, node.sort_keys)
+            out: PlanNode = (LimitNode(inner, limit)
+                             if limit is not None else inner)
+            return out, consumed
+        partial: PlanNode = SortNode(src, node.sort_keys)
+        if limit is not None:
+            partial = LimitNode(partial, limit)   # TopN fuses per task
+        fid = self._source_fragment(partial, consumed, ("single", ()))
+        merge = RemoteMergeNode((fid,), node.sort_keys,
+                                tuple(node.columns), limit)
+        return merge, [fid]
+
+    def _parallel_safe(self, node: PlanNode) -> bool:
+        """True when this consumer-fragment subtree can be replicated
+        into N tasks without changing results: at most one table scan
+        (split-sharded), no global aggregation / window / values /
+        single-row enforcement / cross join, whose per-task replication
+        would duplicate or starve rows."""
+        scans = 0
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, TableScanNode):
+                scans += 1
+            elif isinstance(n, AggregationNode) and not n.group_channels:
+                return False
+            elif isinstance(n, (WindowNode, EnforceSingleRowNode,
+                                UnionNode, LimitNode)):
+                # an inner LIMIT replicated into N tasks would emit up
+                # to N*limit rows
+                return False
+            elif isinstance(n, ValuesNode):
+                return False
+            elif isinstance(n, JoinNode) and (n.kind == "cross"
+                                              or not n.left_keys):
+                return False
+            stack.extend(n.sources)
+        return scans <= 1
 
     def _source_fragment(self, node: PlanNode,
                          consumed: Sequence[int],

@@ -73,7 +73,9 @@ class SqlTask:
     def cancel(self) -> None:
         if self.state == "RUNNING":
             self.state = "CANCELED"
-            self.buffers.fail(RuntimeError("task canceled"))
+        # always release buffered output: a FINISHED task can still hold
+        # pages an early-stopping consumer (TopN merge) never acked
+        self.buffers.fail(RuntimeError("task canceled"))
 
     def join(self, timeout: Optional[float] = None) -> None:
         self._thread.join(timeout)

@@ -47,9 +47,9 @@ from presto_tpu.expr import build as B
 from presto_tpu.expr.ir import InputRef, RowExpression
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
-    OutputNode, PlanAggregate, PlanNode, ProjectNode, RemoteSourceNode,
-    SemiJoinNode, SortNode, TableScanNode, UnionNode, UnnestNode, ValuesNode,
-    WindowNode,
+    OutputNode, PlanAggregate, PlanNode, ProjectNode, RemoteMergeNode,
+    RemoteSourceNode, SemiJoinNode, SortNode, TableScanNode, UnionNode,
+    UnnestNode, ValuesNode, WindowNode,
 )
 
 
@@ -125,6 +125,17 @@ class PhysicalPlanner:
             for fid in node.fragment_ids:
                 locations.extend(self.remote_sources.get(fid, ()))
             return ([ExchangeOperatorFactory(locations)], [])
+        if isinstance(node, RemoteMergeNode):
+            from presto_tpu.server.exchangeop import (
+                MergeExchangeOperatorFactory,
+            )
+
+            locations = []
+            for fid in node.fragment_ids:
+                locations.extend(self.remote_sources.get(fid, ()))
+            return ([MergeExchangeOperatorFactory(
+                locations, node.sort_keys,
+                [t for _, t in node.columns], node.limit)], [])
         if isinstance(node, ValuesNode):
             from presto_tpu.batch import batch_from_pylist
 

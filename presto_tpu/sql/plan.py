@@ -277,6 +277,19 @@ class RemoteSourceNode(PlanNode):
 
 
 @D(frozen=True)
+class RemoteMergeNode(PlanNode):
+    """Order-preserving remote source: every producer task emits a
+    pre-sorted stream and this node k-way merges them (MergeOperator
+    .java:45 + ExchangeOperator's ORDER BY variant).  ``limit`` stops
+    the merge early for distributed TopN."""
+
+    fragment_ids: Tuple[int, ...]
+    sort_keys: Tuple[Tuple[int, bool, Optional[bool]], ...]
+    columns: Tuple[Column, ...]
+    limit: Optional[int] = None
+
+
+@D(frozen=True)
 class OutputNode(PlanNode):
     source: PlanNode
     columns: Tuple[Column, ...]  # output names (possibly renamed)

@@ -27,7 +27,8 @@ from presto_tpu.server.fragmenter import PlanFragment
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanAggregate, PlanNode, PlanWindowFunction, ProjectNode,
-    RemoteSourceNode, SemiJoinNode, SortNode, TableScanNode, UnionNode,
+    RemoteMergeNode, RemoteSourceNode, SemiJoinNode, SortNode,
+    TableScanNode, UnionNode,
     UnnestNode, ValuesNode, WindowNode,
 )
 
@@ -263,6 +264,10 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
     if isinstance(n, RemoteSourceNode):
         return {"k": "remote", "fragment_ids": list(n.fragment_ids),
                 "columns": _cols(n.columns)}
+    if isinstance(n, RemoteMergeNode):
+        return {"k": "remote_merge", "fragment_ids": list(n.fragment_ids),
+                "sort_keys": _keys3(n.sort_keys),
+                "columns": _cols(n.columns), "limit": n.limit}
     if isinstance(n, OutputNode):
         return {"k": "output", "source": node_to_json(n.source),
                 "columns": _cols(n.columns)}
@@ -329,6 +334,11 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
     if k == "remote":
         return RemoteSourceNode(tuple(d["fragment_ids"]),
                                 _uncols(d["columns"]))
+    if k == "remote_merge":
+        return RemoteMergeNode(tuple(d["fragment_ids"]),
+                               _unkeys3(d["sort_keys"]),
+                               _uncols(d["columns"]),
+                               d.get("limit"))
     if k == "output":
         return OutputNode(node_from_json(d["source"]), _uncols(d["columns"]))
     raise PlanSerdeError(f"unknown plan node kind {k!r}")

@@ -164,3 +164,10 @@ def test_empty_geometries(runner):
     assert one(runner, "ST_Centroid('POINT EMPTY')") is None
     assert one(runner, "ST_Envelope('LINESTRING EMPTY')") is None
     assert one(runner, "ST_Area('POLYGON EMPTY')") == 0.0
+
+
+def test_empty_geometry_roundtrip(runner):
+    assert one(runner, "ST_GeometryFromText('POINT EMPTY')") == \
+        "POINT EMPTY"
+    assert one(runner, "ST_GeometryFromText('POLYGON EMPTY')") == \
+        "POLYGON EMPTY"

@@ -1,0 +1,110 @@
+"""Distributed ORDER BY via sorted-merge exchange (MergeOperator.java:45
+pattern: producers sort their share, the consumer k-way merges) — results
+must match the single-process runner exactly, including row order."""
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+from presto_tpu.server.dqr import DistributedQueryRunner
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=3) as dqr:
+        yield dqr
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+def same(cluster, local, sql):
+    got = cluster.execute(sql).rows
+    want = local.execute(sql).rows
+    assert got == want, (len(got), len(want), got[:3], want[:3])
+    return got
+
+
+def test_order_by_scan(cluster, local):
+    rows = same(cluster, local,
+                "SELECT o_orderkey, o_totalprice FROM orders "
+                "ORDER BY o_totalprice DESC, o_orderkey")
+    assert len(rows) == 15000
+    prices = [p for _, p in rows]
+    assert prices == sorted(prices, reverse=True)
+
+
+def test_order_by_uses_merge_fragments(cluster, local):
+    """The plan must actually split into a sorted producer fragment +
+    merge consumer (not a single-fragment full sort)."""
+    from presto_tpu.server.fragmenter import Fragmenter
+    from presto_tpu.sql.optimizer import optimize
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.plan import RemoteMergeNode, SortNode
+    from presto_tpu.sql.planner import Metadata, Planner
+
+    md = Metadata(local.registry, "tpch")
+    logical = Planner(md).plan(parse_statement(
+        "SELECT l_orderkey FROM lineitem ORDER BY l_orderkey"))
+    dplan = Fragmenter(metadata=md).fragment(optimize(logical, md))
+    root = dplan.fragments[dplan.root_fragment_id].root
+
+    def find(n, cls):
+        if isinstance(n, cls):
+            return n
+        for s in n.sources:
+            hit = find(s, cls)
+            if hit is not None:
+                return hit
+        return None
+
+    assert find(root, RemoteMergeNode) is not None
+    assert find(root, SortNode) is None  # no consumer-side re-sort
+    producer = dplan.fragments[0]
+    assert find(producer.root, SortNode) is not None
+
+
+def test_topn_distributed(cluster, local):
+    # tiebreak on orderkey+linenumber: tie order is unspecified (as in
+    # the reference), so the test pins a total order
+    rows = same(cluster, local,
+                "SELECT l_orderkey, l_linenumber, l_extendedprice "
+                "FROM lineitem ORDER BY l_extendedprice DESC, "
+                "l_orderkey, l_linenumber LIMIT 25")
+    assert len(rows) == 25
+
+
+def test_order_by_after_group_by(cluster, local):
+    same(cluster, local,
+         "SELECT l_returnflag, l_linestatus, sum(l_quantity) q "
+         "FROM lineitem GROUP BY l_returnflag, l_linestatus "
+         "ORDER BY l_returnflag, l_linestatus")
+
+
+def test_order_by_strings_and_nulls(cluster, local):
+    same(cluster, local,
+         "SELECT c_name, c_nationkey FROM customer "
+         "ORDER BY c_name DESC LIMIT 40")
+    # nulls via outer join ordering
+    same(cluster, local,
+         "SELECT o_orderpriority, count(*) c FROM orders "
+         "GROUP BY o_orderpriority ORDER BY c DESC, o_orderpriority")
+
+
+def test_order_by_join(cluster, local):
+    same(cluster, local,
+         "SELECT c.c_name, o.o_totalprice FROM customer c "
+         "JOIN orders o ON c.c_custkey = o.o_custkey "
+         "WHERE o.o_totalprice > 300000 "
+         "ORDER BY o.o_totalprice DESC, c.c_name LIMIT 50")
+
+
+def test_inner_limit_not_replicated(cluster, local):
+    """An inner LIMIT must not multiply across producer tasks
+    (parallel-safety guard on the merge push-down)."""
+    rows = same(cluster, local,
+                "SELECT o_orderkey FROM "
+                "(SELECT o_orderkey FROM orders LIMIT 10) t "
+                "ORDER BY o_orderkey")
+    assert len(rows) == 10
