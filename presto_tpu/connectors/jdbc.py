@@ -286,8 +286,13 @@ class SqliteConnector(JdbcConnector):
                                  timeout=30.0)
             if path != ":memory:":
                 # WAL lets writers proceed while a streaming scan keeps
-                # its read transaction open across fetchmany batches
-                cx.execute("PRAGMA journal_mode=WAL")
+                # its read transaction open across fetchmany batches;
+                # best-effort — a read-only file stays in its original
+                # journal mode rather than failing the connection
+                try:
+                    cx.execute("PRAGMA journal_mode=WAL")
+                except sqlite3.OperationalError:
+                    pass
             return cx
 
         super().__init__(connect, paramstyle="qmark")

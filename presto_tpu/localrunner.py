@@ -71,7 +71,7 @@ def _like(value: str, pattern: Optional[str]) -> bool:
 class LocalQueryRunner:
     def __init__(self, registry: ConnectorRegistry, default_catalog: str,
                  config: EngineConfig = DEFAULT, session=None,
-                 access_control=None):
+                 access_control=None, session_property_manager=None):
         from presto_tpu.session import (
             AllowAllAccessControl, GrantStore, Session, TransactionManager,
         )
@@ -82,6 +82,9 @@ class LocalQueryRunner:
         from presto_tpu.events import EventBus
 
         self.session = session or Session(catalog=default_catalog)
+        if session_property_manager is not None:
+            # rule-based session defaults (SET SESSION still overrides)
+            session_property_manager.apply(self.session)
         self.access_control = access_control or AllowAllAccessControl()
         self.grants = GrantStore()
         if hasattr(self.access_control, "grants") and \

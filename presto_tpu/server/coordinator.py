@@ -99,6 +99,8 @@ class QueryExecution:
         self.state = "QUEUED"
         self.canceled = False
         self.error: Optional[str] = None
+        self.plan_text: str = ""
+        self._tasks_scheduled = False
         self.column_names: List[str] = []
         self.column_types: List[T.Type] = []
         self.result_rows: List[tuple] = []
@@ -145,6 +147,7 @@ class QueryExecution:
             dplan = Fragmenter(metadata=metadata).fragment(optimized)
             self.column_names = dplan.column_names
             self.column_types = dplan.column_types
+            self.plan_text = self._format_dplan(dplan)
 
             self.state = "SCHEDULING"
             root_locations = self._schedule(dplan)
@@ -161,15 +164,39 @@ class QueryExecution:
             # TopN merge stops early, and failed queries strand tasks
             # mid-run — cancel fans out DELETE /v1/query/{id} so output
             # buffers are freed and blocked producers unblock
-            # (SqlQueryScheduler abort/cancel role)
-            self._cancel_worker_tasks()
+            # (SqlQueryScheduler abort/cancel role).  The client is
+            # unblocked first and the fan-out only runs when worker
+            # tasks were actually created.
             self.rows_done.set()
+            if self._tasks_scheduled:
+                self._cancel_worker_tasks()
+
+    @staticmethod
+    def _format_dplan(dplan: DistributedPlan) -> str:
+        """Fragment-by-fragment plan rendering (the webapp plan.html /
+        EXPLAIN (TYPE DISTRIBUTED) view)."""
+        from presto_tpu.sql.plan import format_plan
+
+        lines = []
+        for f in dplan.fragments:
+            out_kind, out_ch = f.output_partitioning
+            lines.append(
+                f"Fragment {f.fragment_id} [{f.partitioning}] "
+                f"=> output {out_kind}{list(out_ch) if out_ch else ''}")
+            for ln in format_plan(f.root).splitlines():
+                lines.append("    " + ln)
+        return "\n".join(lines)
+
+    def _internal_headers(self) -> Dict[str, str]:
+        return (self.co.internal_auth.header()
+                if self.co.internal_auth is not None else {})
 
     def _cancel_worker_tasks(self) -> None:
         for _nid, uri in self.co.nodes.alive_nodes():
             try:
                 req = urllib.request.Request(
-                    f"{uri}/v1/query/{self.query_id}", method="DELETE")
+                    f"{uri}/v1/query/{self.query_id}", method="DELETE",
+                    headers=self._internal_headers())
                 with urllib.request.urlopen(req, timeout=5):
                     pass
             except Exception:  # noqa: BLE001 - best-effort cleanup
@@ -240,9 +267,13 @@ class QueryExecution:
             "n_output_partitions": n_out,
             "broadcast_output": broadcast,
         }).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        if self.co.internal_auth is not None:
+            headers.update(self.co.internal_auth.header())
+        self._tasks_scheduled = True
         req = urllib.request.Request(
             f"{worker_uri}/v1/task/{task_id}", data=body, method="POST",
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         with urllib.request.urlopen(req, timeout=30) as resp:
             info = json.loads(resp.read())
             if info.get("state") == "FAILED":
@@ -265,10 +296,12 @@ class QueryExecution:
             raise ValueError(
                 f"{type(stmt).__name__} requires a session-affine "
                 "connection; use the single-process runner")
+        session = Session(user=self.user, catalog=self.co.default_catalog)
+        if self.co.session_property_manager is not None:
+            self.co.session_property_manager.apply(session)
         runner = LocalQueryRunner(
             self.co.registry, self.co.default_catalog, self.co.config,
-            session=Session(user=self.user,
-                            catalog=self.co.default_catalog))
+            session=session)
         runner.grants = self.co.grants
         res = runner._execute_parsed(stmt)
         self.column_names = res.column_names
@@ -298,7 +331,8 @@ class QueryExecution:
         for _, wuri in self.co.nodes.alive_nodes():
             try:
                 req = urllib.request.Request(
-                    f"{wuri}/v1/query/{self.query_id}", method="DELETE")
+                    f"{wuri}/v1/query/{self.query_id}", method="DELETE",
+                    headers=self._internal_headers())
                 urllib.request.urlopen(req, timeout=5).close()
             except Exception:  # noqa: BLE001 - best effort
                 pass
@@ -310,7 +344,9 @@ class QueryExecution:
                 if getattr(self, "canceled", False):
                     raise RuntimeError("Query killed")
                 url = f"{loc}/{token}"
-                with urllib.request.urlopen(url, timeout=120) as resp:
+                req = urllib.request.Request(
+                    url, headers=self._internal_headers())
+                with urllib.request.urlopen(req, timeout=120) as resp:
                     complete = resp.headers.get(
                         "X-Presto-Buffer-Complete") == "true"
                     token = int(resp.headers.get("X-Presto-Next-Token",
@@ -370,6 +406,8 @@ th { background: #222 } .FINISHED { color: #7fff7f }
 <h2>Nodes</h2><table id="nodes"><tr><th>node</th><th>uri</th></tr></table>
 <h2>Queries</h2><table id="queries">
 <tr><th>id</th><th>user</th><th>state</th><th>query</th></tr></table>
+<h2 id="dtitle" style="display:none">Query detail</h2>
+<pre id="detail" style="white-space:pre-wrap"></pre>
 <script>
 // Cells are populated via textContent, never innerHTML: query SQL, the
 // X-Presto-User header, and announced node ids/URIs are all untrusted.
@@ -402,7 +440,24 @@ async function refresh() {
   const qs = await (await fetch('/v1/query')).json();
   const table = document.getElementById('queries');
   header(table, ['id', 'user', 'state', 'query']);
-  for (const q of qs) row(table, [q.queryId, q.user, q.state, q.query], 2);
+  for (const q of qs) {
+    row(table, [q.queryId, q.user, q.state, q.query], 2);
+    // clicking a query id loads the plan/detail view (plan.html role)
+    const td = table.lastChild.firstChild;
+    td.style.cursor = 'pointer';
+    td.style.textDecoration = 'underline';
+    td.onclick = () => showDetail(q.queryId);
+  }
+}
+async function showDetail(id) {
+  const q = await (await fetch('/v1/query/' + id)).json();
+  document.getElementById('dtitle').style.display = '';
+  // textContent only: SQL/plan/error are untrusted
+  document.getElementById('detail').textContent =
+    'query: ' + (q.query || '') + '\n' +
+    'state: ' + q.state + (q.error ? '\nerror: ' + q.error : '') +
+    '\noutput rows: ' + q.outputRows +
+    '\n\n-- distributed plan --\n' + (q.plan || '(none)');
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
@@ -412,7 +467,10 @@ refresh(); setInterval(refresh, 2000);
 class CoordinatorServer:
     def __init__(self, registry: ConnectorRegistry, default_catalog: str,
                  config: EngineConfig = DEFAULT, port: int = 0,
-                 verbose: bool = False):
+                 verbose: bool = False, authenticator=None,
+                 internal_secret: Optional[str] = None,
+                 session_property_manager=None):
+        from presto_tpu.server.security import InternalAuthenticator
         from presto_tpu.session import ResourceGroupManager
 
         self.registry = registry
@@ -425,6 +483,16 @@ class CoordinatorServer:
         self.queries: Dict[str, QueryExecution] = {}
         self.resource_groups = ResourceGroupManager()
         self.grants = GrantStore()
+        self.authenticator = authenticator
+        self.internal_auth = (InternalAuthenticator(internal_secret)
+                              if internal_secret else None)
+        if self.internal_auth is not None:
+            from presto_tpu.server.exchangeop import (
+                set_internal_fetch_headers,
+            )
+
+            set_internal_fetch_headers(self.internal_auth.header())
+        self.session_property_manager = session_property_manager
         co = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -441,12 +509,45 @@ class CoordinatorServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _has_internal_token(self) -> bool:
+                from presto_tpu.server.security import (
+                    InternalAuthenticator,
+                )
+
+                return (co.internal_auth is not None
+                        and co.internal_auth.verify(self.headers.get(
+                            InternalAuthenticator.HEADER)))
+
+            def _authenticated_user(self):
+                """Authenticated principal, or None after sending 401.
+                Applies to every query-facing endpoint when an
+                authenticator is configured; a peer holding the cluster
+                token may vouch for the user it stamps (trusted proxy
+                / internal fetches)."""
+                user = self.headers.get("X-Presto-User", "user")
+                if co.authenticator is None:
+                    return user
+                if self._has_internal_token():
+                    return user
+                auth_user = co.authenticator.authenticate_basic(
+                    self.headers.get("Authorization"))
+                if auth_user is not None:
+                    return auth_user
+                self.send_response(401)
+                self.send_header("WWW-Authenticate",
+                                 'Basic realm="presto-tpu"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return None
+
             def do_POST(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
                 if parts == ["v1", "statement"]:
                     n = int(self.headers.get("Content-Length", 0))
                     sql = self.rfile.read(n).decode("utf-8")
-                    user = self.headers.get("X-Presto-User", "user")
+                    user = self._authenticated_user()
+                    if user is None:
+                        return
                     qid = uuid.uuid4().hex[:16]
                     q = QueryExecution(qid, sql, co, user=user)
                     co.queries[qid] = q
@@ -457,6 +558,15 @@ class CoordinatorServer:
                         "stats": {"state": q.state}})
                     return
                 if parts == ["v1", "announcement"]:
+                    # when a cluster secret exists, only peers holding
+                    # it may join: an unauthenticated announcement would
+                    # otherwise register an attacker URI that later
+                    # receives the internal token on task create
+                    if co.internal_auth is not None and \
+                            not self._has_internal_token():
+                        self._json(401, {"error": "unauthenticated "
+                                                  "announcement"})
+                        return
                     n = int(self.headers.get("Content-Length", 0))
                     ann = json.loads(self.rfile.read(n))
                     co.nodes.announce(ann["nodeId"], ann["uri"])
@@ -467,6 +577,8 @@ class CoordinatorServer:
             def do_DELETE(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
                 if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                    if self._authenticated_user() is None:
+                        return
                     q = co.queries.get(parts[2])
                     if q is None:
                         self._json(404, {"error": "no such query"})
@@ -478,6 +590,11 @@ class CoordinatorServer:
 
             def do_GET(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
+                # /v1/info stays open (health probe); everything that
+                # exposes SQL text, plans, or result rows authenticates
+                if parts != ["v1", "info"] and parts[:1] == ["v1"]:
+                    if self._authenticated_user() is None:
+                        return
                 if parts[:3] == ["v1", "statement", "executing"] \
                         and len(parts) == 5:
                     q = co.queries.get(parts[3])
@@ -515,8 +632,13 @@ class CoordinatorServer:
                     out = []
                     for nid, uri in co.nodes.alive_nodes():
                         try:
+                            hdrs = (co.internal_auth.header()
+                                    if co.internal_auth is not None
+                                    else {})
                             with urllib.request.urlopen(
-                                    f"{uri}/v1/task", timeout=5) as resp:
+                                    urllib.request.Request(
+                                        f"{uri}/v1/task", headers=hdrs),
+                                    timeout=5) as resp:
                                 for t in json.loads(resp.read()):
                                     t["nodeId"] = nid
                                     out.append(t)
@@ -533,6 +655,7 @@ class CoordinatorServer:
                         "queryId": q.query_id, "state": q.state,
                         "user": q.user, "query": q.sql,
                         "error": q.error,
+                        "plan": q.plan_text,
                         "columns": q.column_names,
                         "outputRows": len(q.result_rows)})
                     return

@@ -118,6 +118,17 @@ class TaskOutputOperatorFactory(OperatorFactory):
 # consumer side
 # ---------------------------------------------------------------------------
 
+# Intra-cluster auth headers attached to every exchange fetch.  Set once
+# per process by whichever server holds the cluster secret (all nodes of
+# one cluster share it); empty when internal auth is off.
+_INTERNAL_FETCH_HEADERS: dict = {}
+
+
+def set_internal_fetch_headers(headers: dict) -> None:
+    _INTERNAL_FETCH_HEADERS.clear()
+    _INTERNAL_FETCH_HEADERS.update(headers)
+
+
 class HttpPageClient(threading.Thread):
     """Long-polls one producer buffer, acking by token advance."""
 
@@ -131,7 +142,9 @@ class HttpPageClient(threading.Thread):
         try:
             while True:
                 url = f"{self.base_url}/{self.token}"
-                req = urllib.request.Request(url, method="GET")
+                req = urllib.request.Request(
+                    url, method="GET",
+                    headers=dict(_INTERNAL_FETCH_HEADERS))
                 with urllib.request.urlopen(req, timeout=120) as resp:
                     complete = resp.headers.get("X-Presto-Buffer-Complete") \
                         == "true"
@@ -317,6 +330,14 @@ class MergeExchangeOperator(Operator):
                 if av is None and bv is None:
                     continue
                 return (av is None) == nf
+            # NaN sorts greatest (matching to_sortable_i64's bit order
+            # on the producers); plain < would treat it as unordered
+            a_nan = isinstance(av, float) and av != av
+            b_nan = isinstance(bv, float) and bv != bv
+            if a_nan or b_nan:
+                if a_nan and b_nan:
+                    continue
+                return b_nan == bool(ascending)
             if av == bv:
                 continue
             return (av < bv) == bool(ascending)

@@ -1,0 +1,107 @@
+"""Authenticating forward proxy for the statement protocol.
+
+The presto-proxy role (1,243 LoC: an HTTP proxy that authenticates
+clients, stamps the verified principal, forwards statement requests to
+the real coordinator, and rewrites ``nextUri`` so clients keep talking
+to the proxy).  Same shape here over the stdlib HTTP server.
+
+Reference: presto-proxy/src/main/java/io/prestosql/proxy/
+ProxyResource.java (forward + URI rewrite), ProxyServlet.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class ProxyServer:
+    def __init__(self, coordinator_uri: str, authenticator=None,
+                 port: int = 0, internal_secret: Optional[str] = None):
+        from presto_tpu.server.security import InternalAuthenticator
+
+        self.coordinator_uri = coordinator_uri.rstrip("/")
+        self.authenticator = authenticator
+        # the proxy is a trusted peer: it authenticates the client and
+        # identifies itself to the coordinator with the cluster token,
+        # vouching for the X-Presto-User it stamps
+        self.internal_auth = (InternalAuthenticator(internal_secret)
+                              if internal_secret else None)
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, body: bytes,
+                       content_type: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _auth(self) -> Optional[str]:
+                """Authenticated user, or None after sending 401."""
+                if proxy.authenticator is None:
+                    return self.headers.get("X-Presto-User", "user")
+                user = proxy.authenticator.authenticate_basic(
+                    self.headers.get("Authorization"))
+                if user is None:
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate",
+                                     'Basic realm="presto-tpu-proxy"')
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return None
+                return user
+
+            def _forward(self, method: str, user: str,
+                         body: Optional[bytes] = None) -> None:
+                url = proxy.coordinator_uri + self.path
+                headers = {"X-Presto-User": user,
+                           "Content-Type": "text/plain"}
+                if proxy.internal_auth is not None:
+                    headers.update(proxy.internal_auth.header())
+                req = urllib.request.Request(
+                    url, data=body, method=method, headers=headers)
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as resp:
+                        payload = resp.read()
+                        code = resp.status
+                except urllib.error.HTTPError as e:
+                    payload, code = e.read(), e.code
+                # clients must keep talking to the proxy: rewrite every
+                # coordinator URI in the payload (nextUri etc.)
+                payload = payload.replace(
+                    proxy.coordinator_uri.encode(), proxy.uri.encode())
+                self._reply(code, payload)
+
+            def do_POST(self):  # noqa: N802
+                user = self._auth()
+                if user is None:
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                self._forward("POST", user, self.rfile.read(n))
+
+            def do_GET(self):  # noqa: N802
+                user = self._auth()
+                if user is None:
+                    return
+                self._forward("GET", user)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="proxy-http")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

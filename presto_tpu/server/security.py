@@ -1,0 +1,93 @@
+"""Server-side authentication: password file + internal cluster auth.
+
+Reference roles:
+- presto-password-authenticators (1,368 LoC): the file-based password
+  authenticator (``PasswordAuthenticator`` SPI) — users verified against
+  a credentials file, wired to HTTP Basic on the coordinator.
+- InternalAuthenticationManager (presto-main/.../server/
+  InternalAuthenticationManager.java): nodes authenticate intra-cluster
+  HTTP (task create, announcements) with a shared-secret-derived token
+  so a worker never executes plans from an unauthenticated peer.
+
+Passwords are stored salted+hashed (sha256, per-user random salt) —
+never plaintext; the internal token is an HMAC over a fixed purpose
+string so the secret itself never travels.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import secrets
+from typing import Dict, Optional, Tuple
+
+
+class PasswordAuthenticator:
+    """File-based password auth: lines of ``user:salthex:sha256hex``."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._users: Dict[str, Tuple[bytes, bytes]] = {}
+        if path and os.path.exists(path):
+            self._load()
+
+    def _load(self) -> None:
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                user, salt, digest = line.split(":")
+                self._users[user] = (bytes.fromhex(salt),
+                                    bytes.fromhex(digest))
+
+    @staticmethod
+    def _digest(salt: bytes, password: str) -> bytes:
+        return hashlib.sha256(salt + password.encode("utf-8")).digest()
+
+    def set_password(self, user: str, password: str) -> None:
+        salt = secrets.token_bytes(16)
+        self._users[user] = (salt, self._digest(salt, password))
+        if self.path:
+            with open(self.path, "w") as f:
+                for u, (s, d) in sorted(self._users.items()):
+                    f.write(f"{u}:{s.hex()}:{d.hex()}\n")
+
+    def authenticate(self, user: str, password: str) -> bool:
+        entry = self._users.get(user)
+        if entry is None:
+            return False
+        salt, want = entry
+        return hmac.compare_digest(self._digest(salt, password), want)
+
+    def authenticate_basic(self, authorization: Optional[str]
+                           ) -> Optional[str]:
+        """Authorization header -> authenticated user name, or None."""
+        if not authorization or not authorization.startswith("Basic "):
+            return None
+        try:
+            raw = base64.b64decode(authorization[6:]).decode("utf-8")
+            user, _, password = raw.partition(":")
+        except Exception:  # noqa: BLE001 - malformed header
+            return None
+        return user if self.authenticate(user, password) else None
+
+
+class InternalAuthenticator:
+    """Shared-secret token for intra-cluster requests."""
+
+    HEADER = "X-Presto-Internal-Bearer"
+
+    def __init__(self, secret: str):
+        self._token = hmac.new(secret.encode("utf-8"),
+                               b"presto-tpu-internal",
+                               hashlib.sha256).hexdigest()
+
+    def header(self) -> Dict[str, str]:
+        return {self.HEADER: self._token}
+
+    def verify(self, header_value: Optional[str]) -> bool:
+        return bool(header_value) and hmac.compare_digest(
+            header_value, self._token)

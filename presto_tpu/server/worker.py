@@ -30,9 +30,20 @@ from presto_tpu.server.task import SqlTaskManager
 class WorkerServer:
     def __init__(self, registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT, port: int = 0,
-                 node_id: str = "worker"):
+                 node_id: str = "worker",
+                 internal_secret: Optional[str] = None):
+        from presto_tpu.server.security import InternalAuthenticator
+
         self.node_id = node_id
         self.task_manager = SqlTaskManager(registry, config)
+        self.internal_auth = (InternalAuthenticator(internal_secret)
+                              if internal_secret else None)
+        if self.internal_auth is not None:
+            from presto_tpu.server.exchangeop import (
+                set_internal_fetch_headers,
+            )
+
+            set_internal_fetch_headers(self.internal_auth.header())
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -49,11 +60,31 @@ class WorkerServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _internal_ok(self, parts) -> bool:
+                """Everything under /v1/task (create, status, results,
+                cancel) requires the cluster token when one is set; the
+                /v1/info health probe stays open."""
+                if worker.internal_auth is None or \
+                        parts[:2] != ["v1", "task"]:
+                    return True
+                from presto_tpu.server.security import (
+                    InternalAuthenticator,
+                )
+
+                if worker.internal_auth.verify(self.headers.get(
+                        InternalAuthenticator.HEADER)):
+                    return True
+                self._json(401, {"error": "unauthenticated internal "
+                                          "request"})
+                return False
+
             def do_GET(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
                 if parts[:2] == ["v1", "info"]:
                     self._json(200, {"nodeId": worker.node_id,
                                      "state": "ACTIVE"})
+                    return
+                if not self._internal_ok(parts):
                     return
                 if parts == ["v1", "task"]:
                     self._json(200, worker.task_manager.list_infos())
@@ -96,6 +127,11 @@ class WorkerServer:
 
             def do_POST(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
+                # intra-cluster auth: a worker only executes plans from
+                # peers holding the shared-secret token
+                # (InternalAuthenticationManager role)
+                if not self._internal_ok(parts):
+                    return
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
                     from presto_tpu.sql.planserde import (
                         PlanSerdeError, fragment_from_json,
@@ -127,6 +163,19 @@ class WorkerServer:
 
             def do_DELETE(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
+                if not self._internal_ok(parts):
+                    return
+                if worker.internal_auth is not None and \
+                        parts[:2] == ["v1", "query"]:
+                    from presto_tpu.server.security import (
+                        InternalAuthenticator,
+                    )
+
+                    if not worker.internal_auth.verify(self.headers.get(
+                            InternalAuthenticator.HEADER)):
+                        self._json(401, {"error": "unauthenticated "
+                                                  "internal request"})
+                        return
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
                     task = worker.task_manager.get(parts[2])
                     if task is not None:

@@ -440,3 +440,45 @@ class ResourceGroupManager:
                                   self.root.max_queued, parent=self.root)
                 self._groups[session.user] = g
             return g
+
+
+# ---------------------------------------------------------------------------
+# session property managers
+# ---------------------------------------------------------------------------
+
+class SessionPropertyManager:
+    """Rule-based session property defaults
+    (presto-session-property-managers role: the db/file-backed
+    SessionPropertyConfigurationManager applies matching rules'
+    properties to a session before execution; explicit SET SESSION
+    values still win).
+
+    Rules are ordered dicts: {"user": pattern, "source": pattern,
+    "properties": {name: value}}; '*' wildcards; all matching rules
+    apply, later rules overriding earlier ones."""
+
+    def __init__(self, rules: List[Dict[str, Any]]):
+        self.rules = list(rules)
+
+    @staticmethod
+    def _match(pattern: str, value: str) -> bool:
+        import fnmatch
+
+        return fnmatch.fnmatch(value, pattern)
+
+    def defaults_for(self, user: str, source: str = "") -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for rule in self.rules:
+            if not self._match(rule.get("user", "*"), user):
+                continue
+            if not self._match(rule.get("source", "*"), source):
+                continue
+            out.update(rule.get("properties", {}))
+        return out
+
+    def apply(self, session: "Session", source: str = "") -> None:
+        """Set matched defaults that the session has not set itself."""
+        for name, value in self.defaults_for(session.user,
+                                             source).items():
+            if name.lower() not in session.properties:
+                session.set_property(name, str(value))
