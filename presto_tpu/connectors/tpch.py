@@ -158,9 +158,18 @@ def _comments(stream: int, keys: np.ndarray) -> Column:
     return Column(T.VARCHAR, codes, None, d)
 
 
+_ENUM_CACHE: Dict[tuple, Dictionary] = {}
+
+
 def _enum_column(stream: int, keys: np.ndarray, values: List[str]) -> Column:
     codes = (h64(stream, keys) % np.uint64(len(values))).astype(np.int32)
-    return Column(T.VARCHAR, codes, None, Dictionary(values))
+    # one Dictionary instance per enum domain: downstream kernel caches
+    # key on dictionary identity, so a fresh object per scan would force
+    # a re-trace of every string expression on every query
+    d = _ENUM_CACHE.get(tuple(values))
+    if d is None:
+        d = _ENUM_CACHE.setdefault(tuple(values), Dictionary(values))
+    return Column(T.VARCHAR, codes, None, d)
 
 
 def _fmt_column(prefix: str, keys: np.ndarray) -> Column:

@@ -303,7 +303,7 @@ class HashAggregationOperator(Operator):
         import jax
         import jax.numpy as jnp
 
-        from presto_tpu.ops.groupby import grouped_aggregate
+        from presto_tpu.ops.groupby import grouped_aggregate_jit
 
         if _has_collect(self.aggs):
             out = host_aggregate(batches, self.group_channels, self.aggs,
@@ -336,8 +336,8 @@ class HashAggregationOperator(Operator):
         n = jnp.asarray(data.num_rows)
         group_cap = next_bucket(1, min(max(data.num_rows, 1), 1 << 16))
         while True:
-            gi, ng, results = grouped_aggregate(key_cols, agg_ins, n,
-                                                group_cap)
+            gi, ng, results = grouped_aggregate_jit(key_cols, agg_ins, n,
+                                                    group_cap)
             num_groups = int(ng)
             if num_groups <= group_cap:
                 break
@@ -406,7 +406,7 @@ class GlobalAggregationOperator(Operator):
         import jax.numpy as jnp
         import numpy as np
 
-        from presto_tpu.ops.groupby import global_aggregate
+        from presto_tpu.ops.groupby import global_aggregate_jit
 
         if _has_collect(self.aggs):
             self._output = host_aggregate(self._batches, [], self.aggs,
@@ -443,7 +443,7 @@ class GlobalAggregationOperator(Operator):
                 vals, post = _minmax_dict_input(a, col)
                 agg_ins.append((a.prim, vals, col.valid))
                 posts.append(post)
-        results = global_aggregate(agg_ins, jnp.asarray(data.num_rows))
+        results = global_aggregate_jit(agg_ins, jnp.asarray(data.num_rows))
         for a, post, (value, cnt) in zip(self.aggs, posts, results):
             if a.prim == "count":
                 cols.append(Column(a.out_type,

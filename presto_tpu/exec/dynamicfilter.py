@@ -25,10 +25,17 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from collections import OrderedDict
+
 from presto_tpu import types as T
 from presto_tpu.batch import Batch, Column
 from presto_tpu.exec.context import OperatorContext
 from presto_tpu.exec.operator import Operator, OperatorFactory
+from presto_tpu.exec.operators import _cache_get, _cache_put
+
+# jitted dynamic-filter programs, shared across queries (values are
+# arguments, not constants — see _kernel_for)
+_DF_KERNELS: "OrderedDict[tuple, object]" = OrderedDict()
 
 # exact-set filtering only below this many distinct build keys
 MAX_DISTINCT_SET = 4096
@@ -80,7 +87,6 @@ class DynamicFilterOperator(Operator):
         self.dyn = dyn
         self.key_channels = list(key_channels)
         self._pending: Optional[Batch] = None
-        self._kernels = {}
         # adaptive shutoff (the reference disables ineffective dynamic
         # filters): stop filtering once observed selectivity is poor —
         # un-pruned rows cost nothing extra in static-shape kernels, but
@@ -92,40 +98,46 @@ class DynamicFilterOperator(Operator):
     def needs_input(self) -> bool:
         return not self._finishing and self._pending is None
 
-    def _kernel_for(self, batch: Batch):
-        """One jitted mask+compact program per capacity (eager per-batch
-        dispatch costs dominate on remote-attached devices; this also
-        keeps one host sync per batch)."""
+    def _filters(self):
+        out = []
+        for i, ch in enumerate(self.key_channels):
+            if self.dyn.mins[i] is None:
+                continue
+            out.append((ch, np.asarray(self.dyn.mins[i]),
+                        np.asarray(self.dyn.maxs[i]),
+                        self.dyn.sets[i]))
+        return out
+
+    def _kernel_for(self, batch: Batch, filters):
+        """One jitted mask+compact program per (capacity, filter shape),
+        shared GLOBALLY across queries: the bounds and IN-set tables are
+        passed as arguments, never baked in as constants, so a new
+        query's dynamic-filter values reuse the compiled program (eager
+        per-batch dispatch and retraces dominate on remote-attached
+        devices)."""
         import jax
 
-        key = batch.capacity
-        hit = self._kernels.get(key)
+        cap = batch.capacity
+        chans = tuple(ch for ch, _, _, _ in filters)
+        has_set = tuple(st is not None for _, _, _, st in filters)
+        key = (cap, chans, has_set)
+        hit = _cache_get(_DF_KERNELS, key)
         if hit is not None:
             return hit
         import jax.numpy as jnp
 
         from presto_tpu.ops.filter import selected_positions
 
-        cap = batch.capacity
-        filters = []
-        for i, ch in enumerate(self.key_channels):
-            if self.dyn.mins[i] is None:
-                continue
-            filters.append((ch, np.asarray(self.dyn.mins[i]),
-                            np.asarray(self.dyn.maxs[i]),
-                            self.dyn.sets[i]))
-        if not filters:
-            self._kernels[key] = None
-            return None
-
-        def kernel(cols, num_rows):
+        def kernel(cols, num_rows, bounds, tables):
             mask = jnp.ones(cap, bool)
-            for ch, mn, mx, st in filters:
+            ti = 0
+            for k, ch in enumerate(chans):
                 v, valid = cols[ch]
-                m = (v >= jnp.asarray(mn, v.dtype)) & \
-                    (v <= jnp.asarray(mx, v.dtype))
-                if st is not None:
-                    table = jnp.asarray(st.astype(np.asarray(mn).dtype))
+                mn, mx = bounds[k]
+                m = (v >= mn.astype(v.dtype)) & (v <= mx.astype(v.dtype))
+                if has_set[k]:
+                    table = tables[ti].astype(v.dtype)
+                    ti += 1
                     idx = jnp.clip(jnp.searchsorted(table, v), 0,
                                    table.shape[0] - 1)
                     m = m & (table[idx] == v)
@@ -139,7 +151,7 @@ class DynamicFilterOperator(Operator):
             return gathered, count
 
         jitted = jax.jit(kernel)
-        self._kernels[key] = jitted
+        _cache_put(_DF_KERNELS, key, jitted)
         return jitted
 
     def add_input(self, batch: Batch) -> None:
@@ -153,13 +165,17 @@ class DynamicFilterOperator(Operator):
         if any(c.type.is_nested for c in batch.columns):
             self._pending = batch  # nested payloads: pass through
             return
-        kernel = self._kernel_for(batch)
-        if kernel is None:
+        filters = self._filters()
+        if not filters:
             self._pending = batch
             return
+        kernel = self._kernel_for(batch, filters)
         from presto_tpu.exec.operator import column_pairs
 
-        outs, count = kernel(tuple(column_pairs(batch)), batch.num_rows)
+        bounds = tuple((mn, mx) for _, mn, mx, _ in filters)
+        tables = tuple(st for _, _, _, st in filters if st is not None)
+        outs, count = kernel(tuple(column_pairs(batch)), batch.num_rows,
+                             bounds, tables)
         n_keep = int(count)
         self._rows_seen += batch.num_rows
         self._rows_kept += n_keep

@@ -109,9 +109,42 @@ class ValuesOperatorFactory(OperatorFactory):
         return ValuesOperator(ctx, self.batches)
 
 
+import threading as _threading
+
+_CACHE_LOCK = _threading.Lock()  # guards every kernel-cache OrderedDict
+
+
+def _cache_get(cache, key):
+    with _CACHE_LOCK:
+        hit = cache.get(key)
+        if hit is not None:
+            cache.move_to_end(key)
+        return hit
+
+
+def _cache_put(cache, key, val, cap: int = 256):
+    with _CACHE_LOCK:
+        cache[key] = val
+        if len(cache) > cap:
+            cache.popitem(last=False)
+
+
+# Compiled filter/project kernels shared GLOBALLY across operator
+# instances and queries (the reference's ExpressionCompiler/
+# PageFunctionCompiler Guava caches, JoinCompiler-style): RowExpressions
+# hash structurally and dictionaries are append-only with stable ids, so
+# a repeated query shape reuses the jitted program instead of re-tracing
+# — on the TPU tunnel a retrace costs seconds per operator.
+from collections import OrderedDict  # noqa: E402
+
+_FP_KERNELS: "OrderedDict[tuple, object]" = OrderedDict()
+_FP_HOST: "OrderedDict[tuple, object]" = OrderedDict()
+
+
 class FilterProjectOperator(Operator):
     """filter -> compact -> project, fused into one jitted XLA program per
-    (capacity, dictionary-binding) — the PageProcessor replacement.
+    (expressions, capacity, dictionary-binding) — the PageProcessor
+    replacement.
 
     The compiled program returns projected columns plus the selected-row
     count; intermediate selection vectors never leave the device.
@@ -126,14 +159,14 @@ class FilterProjectOperator(Operator):
         self.projections = list(projections)
         self.input_types = list(input_types)
         self._pending: Optional[Batch] = None
-        self._kernels: Dict[tuple, object] = {}
+        self._expr_key = (filter_expr, tuple(projections),
+                          tuple(input_types))
         from presto_tpu.expr.compile import needs_host_path
 
         # expressions are fixed for the operator's lifetime: decide the
-        # host-vs-jit route once, and cache host compilations like kernels
+        # host-vs-jit route once
         self._host_exprs = needs_host_path(
             [self.filter_expr] + self.projections)
-        self._host_compiled: Dict[tuple, object] = {}
 
     def needs_input(self) -> bool:
         return self._pending is None and not self._finishing
@@ -147,8 +180,8 @@ class FilterProjectOperator(Operator):
         import jax
 
         dict_key = tuple(id(c.dictionary) for c in batch.columns)
-        key = (batch.capacity, dict_key)
-        hit = self._kernels.get(key)
+        key = (self._expr_key, batch.capacity, dict_key)
+        hit = _cache_get(_FP_KERNELS, key)
         if hit is not None:
             return hit
         compiler = ExprCompiler({i: c.dictionary
@@ -176,7 +209,7 @@ class FilterProjectOperator(Operator):
             return outs, count
 
         entry = (jax.jit(kernel), cprojs)
-        self._kernels[key] = entry
+        _cache_put(_FP_KERNELS, key, entry)
         return entry
 
     def _host_output(self, batch: Batch) -> Optional[Batch]:
@@ -191,8 +224,9 @@ class FilterProjectOperator(Operator):
         # cache per dictionary binding (same policy as the jit kernels);
         # dictionaries are append-only so the binding stays valid and
         # per-call-site output dictionaries keep stable codes
-        key = tuple(id(c.dictionary) for c in batch.columns)
-        hit = self._host_compiled.get(key)
+        key = (self._expr_key,
+               tuple(id(c.dictionary) for c in batch.columns))
+        hit = _cache_get(_FP_HOST, key)
         if hit is None:
             compiler = ExprCompiler({i: c.dictionary
                                      for i, c in enumerate(batch.columns)
@@ -200,7 +234,8 @@ class FilterProjectOperator(Operator):
             cfilter = (compiler.compile(self.filter_expr)
                        if self.filter_expr is not None else None)
             cprojs = [compiler.compile(p) for p in self.projections]
-            hit = self._host_compiled[key] = (cfilter, cprojs)
+            hit = (cfilter, cprojs)
+            _cache_put(_FP_HOST, key, hit)
         cfilter, cprojs = hit
         n = batch.num_rows
         if cfilter is not None:

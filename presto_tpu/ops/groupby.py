@@ -348,3 +348,83 @@ def global_aggregate(aggs: Sequence[AggIn], num_rows: jax.Array):
             raise ValueError(prim)
         results.append((out, cnt))
     return results
+
+
+# ---------------------------------------------------------------------------
+# Jitted wrappers with a global program cache
+# ---------------------------------------------------------------------------
+# The kernels above are pure functions of traced arrays plus static
+# metadata (types, prims, capacities).  Callers in the operator layer run
+# once per finish; without jit every jnp op dispatches eagerly — dozens
+# of device round-trips per aggregation, which dominates on
+# remote-attached TPUs.  These wrappers jit the whole kernel and share
+# the compiled program across queries (AccumulatorCompiler cache role).
+
+import threading as _threading
+from collections import OrderedDict as _OrderedDict
+
+_AGG_PROGRAMS: "_OrderedDict[tuple, object]" = _OrderedDict()
+_AGG_PROGRAMS_MAX = 256
+_AGG_LOCK = _threading.Lock()
+
+
+def _program(key, build):
+    with _AGG_LOCK:
+        hit = _AGG_PROGRAMS.get(key)
+        if hit is not None:
+            _AGG_PROGRAMS.move_to_end(key)
+            return hit
+    fn = build()
+    with _AGG_LOCK:
+        _AGG_PROGRAMS[key] = fn
+        if len(_AGG_PROGRAMS) > _AGG_PROGRAMS_MAX:
+            _AGG_PROGRAMS.popitem(last=False)
+    return fn
+
+
+def grouped_aggregate_jit(key_columns, aggs, num_rows,
+                          group_capacity: int):
+    """grouped_aggregate as one cached jitted program."""
+    key_types = tuple(t for _, _, t in key_columns)
+    kvalid = tuple(v is not None for _, v, _ in key_columns)
+    prims = tuple(p for p, _, _ in aggs)
+    avalid = tuple(v is not None for _, _, v in aggs)
+    cap = key_columns[0][0].shape[0]
+    key = ("grouped", key_types, kvalid, prims, avalid, cap,
+           group_capacity)
+
+    def build():
+        def kernel(kvals, kvalids, avals, avalids, n):
+            kc = [(kvals[i], kvalids[i], key_types[i])
+                  for i in range(len(key_types))]
+            ag = [(prims[i], avals[i], avalids[i])
+                  for i in range(len(prims))]
+            return grouped_aggregate(kc, ag, n, group_capacity)
+
+        return jax.jit(kernel)
+
+    fn = _program(key, build)
+    return fn(tuple(v for v, _, _ in key_columns),
+              tuple(v for _, v, _ in key_columns),
+              tuple(v for _, v, _ in aggs),
+              tuple(v for _, _, v in aggs), num_rows)
+
+
+def global_aggregate_jit(aggs, num_rows):
+    """global_aggregate as one cached jitted program."""
+    prims = tuple(p for p, _, _ in aggs)
+    avalid = tuple(v is not None for _, _, v in aggs)
+    cap = aggs[0][1].shape[0] if aggs else 0
+    key = ("global", prims, avalid, cap)
+
+    def build():
+        def kernel(avals, avalids, n):
+            ag = [(prims[i], avals[i], avalids[i])
+                  for i in range(len(prims))]
+            return global_aggregate(ag, n)
+
+        return jax.jit(kernel)
+
+    fn = _program(key, build)
+    return fn(tuple(v for _, v, _ in aggs),
+              tuple(v for _, _, v in aggs), num_rows)

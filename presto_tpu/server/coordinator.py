@@ -486,12 +486,6 @@ class CoordinatorServer:
         self.authenticator = authenticator
         self.internal_auth = (InternalAuthenticator(internal_secret)
                               if internal_secret else None)
-        if self.internal_auth is not None:
-            from presto_tpu.server.exchangeop import (
-                set_internal_fetch_headers,
-            )
-
-            set_internal_fetch_headers(self.internal_auth.header())
         self.session_property_manager = session_property_manager
         co = self
 
@@ -537,7 +531,9 @@ class CoordinatorServer:
                 self.send_header("WWW-Authenticate",
                                  'Basic realm="presto-tpu"')
                 self.send_header("Content-Length", "0")
+                self.send_header("Connection", "close")
                 self.end_headers()
+                self.close_connection = True
                 return None
 
             def do_POST(self):  # noqa: N802

@@ -25,11 +25,14 @@ from presto_tpu.server.worker import WorkerServer
 class DistributedQueryRunner:
     def __init__(self, registry_factory: Callable[[], ConnectorRegistry],
                  default_catalog: str, n_workers: int = 3,
-                 config: EngineConfig = DEFAULT, verbose: bool = False):
+                 config: EngineConfig = DEFAULT, verbose: bool = False,
+                 internal_secret: Optional[str] = None):
         # each node builds its own registry, as each reference node loads
         # its own connector instances from catalog config
+        self.internal_secret = internal_secret
         self.coordinator = CoordinatorServer(
-            registry_factory(), default_catalog, config, verbose=verbose)
+            registry_factory(), default_catalog, config, verbose=verbose,
+            internal_secret=internal_secret)
 
         def cluster_registry() -> ConnectorRegistry:
             # system.runtime.* backed by live coordinator state, fetched
@@ -75,7 +78,8 @@ class DistributedQueryRunner:
         self.workers: List[WorkerServer] = []
         for i in range(n_workers):
             w = WorkerServer(cluster_registry(), config,
-                             node_id=f"worker-{i}")
+                             node_id=f"worker-{i}",
+                             internal_secret=internal_secret)
             self.workers.append(w)
             self._announce(w)
         self.client = StatementClient(self.coordinator.uri)
@@ -86,9 +90,15 @@ class DistributedQueryRunner:
 
         body = json.dumps({"nodeId": worker.node_id,
                            "uri": worker.uri}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.internal_secret:
+            from presto_tpu.server.security import InternalAuthenticator
+
+            headers.update(
+                InternalAuthenticator(self.internal_secret).header())
         req = urllib.request.Request(
             f"{self.coordinator.uri}/v1/announcement", data=body,
-            method="POST", headers={"Content-Type": "application/json"})
+            method="POST", headers=headers)
         with urllib.request.urlopen(req, timeout=10) as resp:
             assert resp.status == 200
 

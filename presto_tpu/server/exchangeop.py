@@ -118,33 +118,25 @@ class TaskOutputOperatorFactory(OperatorFactory):
 # consumer side
 # ---------------------------------------------------------------------------
 
-# Intra-cluster auth headers attached to every exchange fetch.  Set once
-# per process by whichever server holds the cluster secret (all nodes of
-# one cluster share it); empty when internal auth is off.
-_INTERNAL_FETCH_HEADERS: dict = {}
-
-
-def set_internal_fetch_headers(headers: dict) -> None:
-    _INTERNAL_FETCH_HEADERS.clear()
-    _INTERNAL_FETCH_HEADERS.update(headers)
-
-
 class HttpPageClient(threading.Thread):
     """Long-polls one producer buffer, acking by token advance."""
 
-    def __init__(self, base_url: str, client: "ExchangeClient"):
+    def __init__(self, base_url: str, client: "ExchangeClient",
+                 headers: Optional[dict] = None):
         super().__init__(daemon=True)
         self.base_url = base_url.rstrip("/")
         self.client = client
         self.token = 0
+        # per-cluster intra-auth headers (one process can host clusters
+        # with different secrets; never process-global state)
+        self.headers = dict(headers or {})
 
     def run(self) -> None:
         try:
             while True:
                 url = f"{self.base_url}/{self.token}"
                 req = urllib.request.Request(
-                    url, method="GET",
-                    headers=dict(_INTERNAL_FETCH_HEADERS))
+                    url, method="GET", headers=dict(self.headers))
                 with urllib.request.urlopen(req, timeout=120) as resp:
                     complete = resp.headers.get("X-Presto-Buffer-Complete") \
                         == "true"
@@ -175,7 +167,8 @@ class ExchangeClient:
     """
 
     def __init__(self, locations: Sequence[str],
-                 max_buffered_bytes: int = 64 << 20):
+                 max_buffered_bytes: int = 64 << 20,
+                 headers: Optional[dict] = None):
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._pages: List[bytes] = []
@@ -183,7 +176,8 @@ class ExchangeClient:
         self._max_buffered_bytes = max(1, max_buffered_bytes)
         self._closed = False
         self._error: Optional[Exception] = None
-        self._clients = [HttpPageClient(loc, self) for loc in locations]
+        self._clients = [HttpPageClient(loc, self, headers=headers)
+                         for loc in locations]
         self._remaining = len(self._clients)
         for c in self._clients:
             c.start()
@@ -271,13 +265,16 @@ class ExchangeOperator(Operator):
 
 
 class ExchangeOperatorFactory(OperatorFactory):
-    def __init__(self, locations: Sequence[str]):
+    def __init__(self, locations: Sequence[str],
+                 headers: Optional[dict] = None):
         self.locations = list(locations)
+        self.headers = headers
         self._client: Optional[ExchangeClient] = None
 
     def create(self, ctx: OperatorContext):
         if self._client is None:
-            self._client = ExchangeClient(self.locations)
+            self._client = ExchangeClient(self.locations,
+                                          headers=self.headers)
         return ExchangeOperator(ctx, self._client)
 
 
@@ -292,9 +289,10 @@ class MergeExchangeOperator(Operator):
 
     def __init__(self, ctx: OperatorContext, locations: Sequence[str],
                  sort_keys, types, limit: Optional[int] = None,
-                 batch_rows: int = 8192):
+                 batch_rows: int = 8192, headers: Optional[dict] = None):
         super().__init__(ctx)
-        self.clients = [ExchangeClient([loc]) for loc in locations]
+        self.clients = [ExchangeClient([loc], headers=headers)
+                        for loc in locations]
         self.sort_keys = list(sort_keys)   # (channel, ascending, nulls_first)
         self.types = list(types)
         self.limit = limit
@@ -412,12 +410,15 @@ class MergeExchangeOperator(Operator):
 
 class MergeExchangeOperatorFactory(OperatorFactory):
     def __init__(self, locations: Sequence[str], sort_keys, types,
-                 limit: Optional[int] = None):
+                 limit: Optional[int] = None,
+                 headers: Optional[dict] = None):
         self.locations = list(locations)
         self.sort_keys = list(sort_keys)
         self.types = list(types)
         self.limit = limit
+        self.headers = headers
 
     def create(self, ctx: OperatorContext):
         return MergeExchangeOperator(ctx, self.locations, self.sort_keys,
-                                     self.types, self.limit)
+                                     self.types, self.limit,
+                                     headers=self.headers)

@@ -53,11 +53,19 @@ class ProxyServer:
                 user = proxy.authenticator.authenticate_basic(
                     self.headers.get("Authorization"))
                 if user is None:
+                    # drain any body first: leaving it unread desyncs
+                    # HTTP/1.1 keep-alive framing; also end the
+                    # connection so the client restarts cleanly
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n:
+                        self.rfile.read(n)
                     self.send_response(401)
                     self.send_header("WWW-Authenticate",
                                      'Basic realm="presto-tpu-proxy"')
                     self.send_header("Content-Length", "0")
+                    self.send_header("Connection", "close")
                     self.end_headers()
+                    self.close_connection = True
                     return None
                 return user
 
@@ -94,6 +102,13 @@ class ProxyServer:
                 if user is None:
                     return
                 self._forward("GET", user)
+
+            def do_DELETE(self):  # noqa: N802
+                # query cancel rides the same rewritten URIs
+                user = self._auth()
+                if user is None:
+                    return
+                self._forward("DELETE", user)
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]

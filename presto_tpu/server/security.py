@@ -10,8 +10,13 @@ Reference roles:
   so a worker never executes plans from an unauthenticated peer.
 
 Passwords are stored salted+hashed (sha256, per-user random salt) —
-never plaintext; the internal token is an HMAC over a fixed purpose
-string so the secret itself never travels.
+never plaintext.  The internal token is an HMAC over a fixed purpose
+string: the raw secret never travels, but the token itself is a static
+bearer credential — anyone observing one intra-cluster request can
+replay it, exactly like the reference's shared-secret JWT over plain
+HTTP.  Run intra-cluster traffic over TLS (or a trusted network) and
+rotate by changing the secret on every node, as with the reference's
+internal-communication.shared-secret.
 """
 
 from __future__ import annotations

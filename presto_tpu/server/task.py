@@ -34,7 +34,8 @@ class SqlTask:
                  remote_sources: Dict[int, List[str]],
                  n_output_partitions: int, broadcast_output: bool,
                  registry: ConnectorRegistry,
-                 config: EngineConfig = DEFAULT):
+                 config: EngineConfig = DEFAULT,
+                 fetch_headers: Optional[Dict[str, str]] = None):
         self.task_id = task_id
         self.fragment = fragment
         self.state = "RUNNING"
@@ -45,7 +46,8 @@ class SqlTask:
 
         planner = PhysicalPlanner(registry, config,
                                   scan_shard=scan_shard,
-                                  remote_sources=remote_sources)
+                                  remote_sources=remote_sources,
+                                  fetch_headers=fetch_headers)
         kind, channels = fragment.output_partitioning
         if kind == "hash" and n_output_partitions > 1:
             sink = PartitionedOutputOperatorFactory(
@@ -85,9 +87,12 @@ class SqlTaskManager:
     """Worker task registry (SqlTaskManager.java:84 role)."""
 
     def __init__(self, registry: ConnectorRegistry,
-                 config: EngineConfig = DEFAULT):
+                 config: EngineConfig = DEFAULT,
+                 fetch_headers: Optional[Dict[str, str]] = None):
         self.registry = registry
         self.config = config
+        # intra-cluster auth headers this node's exchange fetches carry
+        self.fetch_headers = fetch_headers
         self.tasks: Dict[str, SqlTask] = {}
         self._lock = threading.Lock()
 
@@ -101,7 +106,8 @@ class SqlTaskManager:
                 return self.tasks[task_id]
             task = SqlTask(task_id, fragment, scan_shard, remote_sources,
                            n_output_partitions, broadcast_output,
-                           self.registry, self.config)
+                           self.registry, self.config,
+                           fetch_headers=self.fetch_headers)
             self.tasks[task_id] = task
             return task
 

@@ -35,15 +35,12 @@ class WorkerServer:
         from presto_tpu.server.security import InternalAuthenticator
 
         self.node_id = node_id
-        self.task_manager = SqlTaskManager(registry, config)
         self.internal_auth = (InternalAuthenticator(internal_secret)
                               if internal_secret else None)
-        if self.internal_auth is not None:
-            from presto_tpu.server.exchangeop import (
-                set_internal_fetch_headers,
-            )
-
-            set_internal_fetch_headers(self.internal_auth.header())
+        self.task_manager = SqlTaskManager(
+            registry, config,
+            fetch_headers=(self.internal_auth.header()
+                           if self.internal_auth else None))
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -61,11 +58,11 @@ class WorkerServer:
                 self.wfile.write(body)
 
             def _internal_ok(self, parts) -> bool:
-                """Everything under /v1/task (create, status, results,
-                cancel) requires the cluster token when one is set; the
-                /v1/info health probe stays open."""
+                """Everything under /v1/task and /v1/query (create,
+                status, results, cancel) requires the cluster token when
+                one is set; the /v1/info health probe stays open."""
                 if worker.internal_auth is None or \
-                        parts[:2] != ["v1", "task"]:
+                        parts[:2] not in (["v1", "task"], ["v1", "query"]):
                     return True
                 from presto_tpu.server.security import (
                     InternalAuthenticator,
@@ -165,17 +162,6 @@ class WorkerServer:
                 parts = self.path.strip("/").split("/")
                 if not self._internal_ok(parts):
                     return
-                if worker.internal_auth is not None and \
-                        parts[:2] == ["v1", "query"]:
-                    from presto_tpu.server.security import (
-                        InternalAuthenticator,
-                    )
-
-                    if not worker.internal_auth.verify(self.headers.get(
-                            InternalAuthenticator.HEADER)):
-                        self._json(401, {"error": "unauthenticated "
-                                                  "internal request"})
-                        return
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
                     task = worker.task_manager.get(parts[2])
                     if task is not None:

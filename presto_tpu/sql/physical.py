@@ -65,7 +65,8 @@ class PhysicalPlanner:
     def __init__(self, registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT,
                  scan_shard: Optional[Tuple[int, int]] = None,
-                 remote_sources: Optional[dict] = None):
+                 remote_sources: Optional[dict] = None,
+                 fetch_headers: Optional[dict] = None):
         """``scan_shard=(task_index, task_count)`` makes scans generate only
         this task's deterministic share of splits (distributed source
         stages, P5); ``remote_sources`` maps fragment id -> producer buffer
@@ -74,6 +75,9 @@ class PhysicalPlanner:
         self.config = config
         self.scan_shard = scan_shard
         self.remote_sources = remote_sources or {}
+        # intra-cluster auth headers for exchange fetches (per cluster,
+        # not process-global: one process may host several clusters)
+        self.fetch_headers = fetch_headers or {}
         self._done_pipelines: List[Pipeline] = []
         self._counter = 0
 
@@ -124,7 +128,8 @@ class PhysicalPlanner:
             locations: List[str] = []
             for fid in node.fragment_ids:
                 locations.extend(self.remote_sources.get(fid, ()))
-            return ([ExchangeOperatorFactory(locations)], [])
+            return ([ExchangeOperatorFactory(
+                locations, headers=self.fetch_headers)], [])
         if isinstance(node, RemoteMergeNode):
             from presto_tpu.server.exchangeop import (
                 MergeExchangeOperatorFactory,
@@ -135,7 +140,8 @@ class PhysicalPlanner:
                 locations.extend(self.remote_sources.get(fid, ()))
             return ([MergeExchangeOperatorFactory(
                 locations, node.sort_keys,
-                [t for _, t in node.columns], node.limit)], [])
+                [t for _, t in node.columns], node.limit,
+                headers=self.fetch_headers)], [])
         if isinstance(node, ValuesNode):
             from presto_tpu.batch import batch_from_pylist
 

@@ -201,3 +201,31 @@ def test_plan_text_in_query_detail():
             detail = json.loads(resp.read())
         assert "Fragment 0" in detail["plan"]
         assert "Aggregation" in detail["plan"]
+
+
+def test_secured_dqr_end_to_end():
+    """A whole secured cluster through DistributedQueryRunner: the
+    announce/task/exchange paths all carry the cluster token."""
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    def factory():
+        reg = ConnectorRegistry()
+        reg.register("tpch", TpchConnector(scale=0.01))
+        return reg
+
+    with DistributedQueryRunner(factory, "tpch", n_workers=2,
+                                internal_secret="dqr-secret") as dqr:
+        got = dqr.execute(
+            "SELECT l_returnflag, count(*) FROM lineitem "
+            "GROUP BY l_returnflag ORDER BY l_returnflag").rows
+        assert [r[0] for r in got] == ["A", "N", "R"]
+        # a tokenless fetch against a worker's results is still rejected
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{dqr.workers[0].uri}/v1/task", timeout=5)
+        assert ei.value.code == 401
