@@ -21,9 +21,51 @@ class QueryFailed(RuntimeError):
 
 
 class StatementClient:
+    """Speaks the statement protocol and tracks client-side session
+    state the way the reference's StatementClientV1 does: SET SESSION /
+    USE / PREPARE results update local state that rides request headers
+    (X-Presto-Session / X-Presto-Catalog / X-Presto-Prepared-Statements)
+    on every subsequent statement."""
+
     def __init__(self, coordinator_uri: str, poll_interval_s: float = 0.05):
         self.base = coordinator_uri.rstrip("/")
         self.poll_interval_s = poll_interval_s
+        self.session_properties: dict = {}
+        self.catalog: Optional[str] = None
+        self.schema: Optional[str] = None
+        self.prepared_statements: dict = {}
+
+    def _headers(self) -> dict:
+        import urllib.parse
+
+        h = {"Content-Type": "text/plain"}
+        if self.session_properties:
+            h["X-Presto-Session"] = ",".join(
+                f"{k}={urllib.parse.quote(str(v))}"
+                for k, v in self.session_properties.items())
+        if self.catalog:
+            h["X-Presto-Catalog"] = self.catalog
+        if self.schema:
+            h["X-Presto-Schema"] = self.schema
+        if self.prepared_statements:
+            h["X-Presto-Prepared-Statements"] = ",".join(
+                f"{k}={urllib.parse.quote(v)}"
+                for k, v in self.prepared_statements.items())
+        return h
+
+    def _apply_session_updates(self, payload: dict) -> None:
+        for k, v in payload.get("setSession", {}).items():
+            self.session_properties[k] = v
+        for k in payload.get("resetSession", []):
+            self.session_properties.pop(k, None)
+        if payload.get("setCatalog"):
+            self.catalog = payload["setCatalog"]
+        if payload.get("setSchema"):
+            self.schema = payload["setSchema"]
+        for k, v in payload.get("addedPrepare", {}).items():
+            self.prepared_statements[k] = v
+        for k in payload.get("deallocatedPrepare", []):
+            self.prepared_statements.pop(k, None)
 
     def execute(self, sql: str,
                 timeout_s: float = 300.0
@@ -31,12 +73,19 @@ class StatementClient:
         """Returns (columns, rows); raises QueryFailed on query error."""
         req = urllib.request.Request(
             f"{self.base}/v1/statement", data=sql.encode("utf-8"),
-            method="POST", headers={"Content-Type": "text/plain"})
+            method="POST", headers=self._headers())
         with urllib.request.urlopen(req, timeout=30) as resp:
             payload = json.loads(resp.read())
         deadline = time.monotonic() + timeout_s
         while True:
             state = payload.get("stats", {}).get("state")
+            if state == "FAILED" and "error" not in payload \
+                    and payload.get("nextUri"):
+                # the POST ack of a fast failure carries only the state;
+                # the detailed error lives at the results URI
+                with urllib.request.urlopen(payload["nextUri"],
+                                            timeout=30) as resp:
+                    payload = json.loads(resp.read())
             if state == "FAILED" or "error" in payload:
                 raise QueryFailed(
                     payload.get("error", {}).get("message", "query failed"))
@@ -45,9 +94,11 @@ class StatementClient:
             # statement can reach FINISHED before the first poll, so
             # state alone must not end the loop)
             if "columns" in payload or "data" in payload:
+                self._apply_session_updates(payload)
                 return payload.get("columns", []), payload.get("data", [])
             next_uri = payload.get("nextUri")
             if next_uri is None:
+                self._apply_session_updates(payload)
                 return payload.get("columns", []), payload.get("data", [])
             if time.monotonic() > deadline:
                 raise QueryFailed("client timeout")

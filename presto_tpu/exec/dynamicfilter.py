@@ -25,17 +25,15 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from collections import OrderedDict
-
 from presto_tpu import types as T
 from presto_tpu.batch import Batch, Column
 from presto_tpu.exec.context import OperatorContext
 from presto_tpu.exec.operator import Operator, OperatorFactory
-from presto_tpu.exec.operators import _cache_get, _cache_put
+from presto_tpu.kernelcache import cache_get, cache_put, new_cache
 
 # jitted dynamic-filter programs, shared across queries (values are
 # arguments, not constants — see _kernel_for)
-_DF_KERNELS: "OrderedDict[tuple, object]" = OrderedDict()
+_DF_KERNELS = new_cache()
 
 # exact-set filtering only below this many distinct build keys
 MAX_DISTINCT_SET = 4096
@@ -121,7 +119,7 @@ class DynamicFilterOperator(Operator):
         chans = tuple(ch for ch, _, _, _ in filters)
         has_set = tuple(st is not None for _, _, _, st in filters)
         key = (cap, chans, has_set)
-        hit = _cache_get(_DF_KERNELS, key)
+        hit = cache_get(_DF_KERNELS, key)
         if hit is not None:
             return hit
         import jax.numpy as jnp
@@ -151,7 +149,7 @@ class DynamicFilterOperator(Operator):
             return gathered, count
 
         jitted = jax.jit(kernel)
-        _cache_put(_DF_KERNELS, key, jitted)
+        cache_put(_DF_KERNELS, key, jitted)
         return jitted
 
     def add_input(self, batch: Batch) -> None:

@@ -109,24 +109,8 @@ class ValuesOperatorFactory(OperatorFactory):
         return ValuesOperator(ctx, self.batches)
 
 
-import threading as _threading
-
-_CACHE_LOCK = _threading.Lock()  # guards every kernel-cache OrderedDict
-
-
-def _cache_get(cache, key):
-    with _CACHE_LOCK:
-        hit = cache.get(key)
-        if hit is not None:
-            cache.move_to_end(key)
-        return hit
-
-
-def _cache_put(cache, key, val, cap: int = 256):
-    with _CACHE_LOCK:
-        cache[key] = val
-        if len(cache) > cap:
-            cache.popitem(last=False)
+from presto_tpu.kernelcache import cache_get as _cache_get
+from presto_tpu.kernelcache import cache_put as _cache_put
 
 
 # Compiled filter/project kernels shared GLOBALLY across operator

@@ -17,15 +17,20 @@ from presto_tpu.exec.driver import Pipeline
 
 def execute_pipelines(pipelines: Sequence[Pipeline],
                       config: EngineConfig = DEFAULT,
-                      memory_limit: Optional[int] = None) -> TaskContext:
+                      memory_limit: Optional[int] = None,
+                      on_task_context=None) -> TaskContext:
     """Run pipelines sequentially in the given (dependency) order.
 
     Build pipelines come before their probe pipelines — the planner emits
     them in that order, mirroring how the reference sequences via
     LookupSourceFactory futures.  Returns the TaskContext (stats).
+    ``on_task_context`` receives the TaskContext before execution starts
+    so callers (worker memory reporting) can observe live reservations.
     """
     query = QueryContext(config, memory_limit)
     task = TaskContext(query)
+    if on_task_context is not None:
+        on_task_context(task)
     for p in pipelines:
         driver = p.instantiate(task)
         driver.run_to_completion()

@@ -1,0 +1,34 @@
+"""Shared locked-LRU cache for compiled device programs.
+
+One implementation for every kernel cache in the engine (filter/project,
+dynamic filter, aggregation, concat): the reference keeps its generated
+classes in Guava caches the same way (ExpressionCompiler /
+AccumulatorCompiler / JoinCompiler caches).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+_LOCK = threading.Lock()
+
+
+def new_cache() -> "OrderedDict[tuple, object]":
+    return OrderedDict()
+
+
+def cache_get(cache: "OrderedDict[tuple, object]", key):
+    with _LOCK:
+        hit = cache.get(key)
+        if hit is not None:
+            cache.move_to_end(key)
+        return hit
+
+
+def cache_put(cache: "OrderedDict[tuple, object]", key, val,
+              cap: int = 256):
+    with _LOCK:
+        cache[key] = val
+        if len(cache) > cap:
+            cache.popitem(last=False)

@@ -360,25 +360,17 @@ def global_aggregate(aggs: Sequence[AggIn], num_rows: jax.Array):
 # remote-attached TPUs.  These wrappers jit the whole kernel and share
 # the compiled program across queries (AccumulatorCompiler cache role).
 
-import threading as _threading
-from collections import OrderedDict as _OrderedDict
+from presto_tpu.kernelcache import cache_get, cache_put, new_cache
 
-_AGG_PROGRAMS: "_OrderedDict[tuple, object]" = _OrderedDict()
-_AGG_PROGRAMS_MAX = 256
-_AGG_LOCK = _threading.Lock()
+_AGG_PROGRAMS = new_cache()
 
 
 def _program(key, build):
-    with _AGG_LOCK:
-        hit = _AGG_PROGRAMS.get(key)
-        if hit is not None:
-            _AGG_PROGRAMS.move_to_end(key)
-            return hit
+    hit = cache_get(_AGG_PROGRAMS, key)
+    if hit is not None:
+        return hit
     fn = build()
-    with _AGG_LOCK:
-        _AGG_PROGRAMS[key] = fn
-        if len(_AGG_PROGRAMS) > _AGG_PROGRAMS_MAX:
-            _AGG_PROGRAMS.popitem(last=False)
+    cache_put(_AGG_PROGRAMS, key, fn)
     return fn
 
 

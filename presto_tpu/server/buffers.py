@@ -72,6 +72,14 @@ class OutputBufferManager:
                 buf.no_more_pages = True
             self._lock.notify_all()
 
+    def is_drained(self) -> bool:
+        """True when consumers have fetched (or can no longer fetch)
+        every page — the graceful-shutdown completion condition."""
+        with self._lock:
+            if self._failed is not None:
+                return True
+            return all(not buf.pages for buf in self.buffers.values())
+
     def fail(self, error: Exception) -> None:
         with self._lock:
             self._failed = error

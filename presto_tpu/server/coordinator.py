@@ -27,6 +27,7 @@ import json
 import threading
 import time
 import traceback
+import urllib.error
 import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -49,6 +50,7 @@ class NodeManager:
     def __init__(self, max_missed: int = 3, interval_s: float = 0.5):
         self.nodes: Dict[str, str] = {}       # node_id -> uri
         self.missed: Dict[str, int] = {}
+        self.states: Dict[str, str] = {}      # node_id -> reported state
         self.max_missed = max_missed
         self.interval_s = interval_s
         self._lock = threading.Lock()
@@ -63,6 +65,18 @@ class NodeManager:
             self.missed[node_id] = 0
 
     def alive_nodes(self) -> List[Tuple[str, str]]:
+        """Schedulable nodes: responsive AND reporting ACTIVE (a
+        SHUTTING_DOWN node finishes its tasks but gets no new ones)."""
+        with self._lock:
+            return [(nid, uri) for nid, uri in sorted(self.nodes.items())
+                    if self.missed.get(nid, 0) < self.max_missed
+                    and self.states.get(nid, "ACTIVE") == "ACTIVE"]
+
+    def responsive_nodes(self) -> List[Tuple[str, str]]:
+        """Every reachable node INCLUDING draining ones — the set for
+        cancel fan-out, memory polling, and task aggregation (a
+        SHUTTING_DOWN worker still runs tasks that must stay visible
+        and cancellable)."""
         with self._lock:
             return [(nid, uri) for nid, uri in sorted(self.nodes.items())
                     if self.missed.get(nid, 0) < self.max_missed]
@@ -73,15 +87,21 @@ class NodeManager:
                 targets = list(self.nodes.items())
             for nid, uri in targets:
                 ok = False
+                state = "ACTIVE"
                 try:
                     with urllib.request.urlopen(f"{uri}/v1/info",
                                                 timeout=2) as resp:
                         ok = resp.status == 200
+                        if ok:
+                            state = json.loads(resp.read()).get(
+                                "state", "ACTIVE")
                 except Exception:  # noqa: BLE001
                     ok = False
                 with self._lock:
                     self.missed[nid] = 0 if ok else \
                         self.missed.get(nid, 0) + 1
+                    if ok:
+                        self.states[nid] = state
 
     def close(self) -> None:
         self._stop.set()
@@ -91,11 +111,23 @@ class QueryExecution:
     """One query's lifecycle (QueryStateMachine + SqlQueryExecution)."""
 
     def __init__(self, query_id: str, sql: str,
-                 coordinator: "CoordinatorServer", user: str = "user"):
+                 coordinator: "CoordinatorServer", user: str = "user",
+                 session_properties: Optional[Dict[str, str]] = None,
+                 catalog: Optional[str] = None,
+                 prepared: Optional[Dict[str, str]] = None):
         self.query_id = query_id
         self.sql = sql
         self.co = coordinator
         self.user = user
+        # client-session state carried on the request headers
+        # (StatementClientV1 / Session roles)
+        self.session_properties = dict(session_properties or {})
+        self.catalog = catalog or coordinator.default_catalog
+        self.prepared = dict(prepared or {})
+        # session mutations this statement produced, returned in the
+        # final payload for the client to apply (X-Presto-Set-Session /
+        # X-Presto-Added-Prepare role)
+        self.session_updates: Dict = {}
         self.state = "QUEUED"
         self.canceled = False
         self.error: Optional[str] = None
@@ -130,6 +162,10 @@ class QueryExecution:
         try:
             self.state = "PLANNING"
             stmt = parse_statement(self.sql)
+            stmt = self._session_statement(stmt)
+            if stmt is None:
+                self.state = "FINISHED"
+                return
             if isinstance(stmt, t.CallProcedure):
                 self._run_procedure(stmt)
                 self.state = "FINISHED"
@@ -141,7 +177,7 @@ class QueryExecution:
                 self._run_utility(stmt)
                 self.state = "FINISHED"
                 return
-            metadata = Metadata(self.co.registry, self.co.default_catalog)
+            metadata = Metadata(self.co.registry, self.catalog)
             logical = Planner(metadata).plan(stmt)
             optimized = optimize(logical, metadata)
             dplan = Fragmenter(metadata=metadata).fragment(optimized)
@@ -156,7 +192,9 @@ class QueryExecution:
             self._drain(root_locations)
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - query failure surface
-            self.error = f"{e}"
+            # keep a more specific error set by a killer (low-memory,
+            # kill_query) over the generic drain abort
+            self.error = self.error or f"{e}"
             self.co.log(traceback.format_exc())
             self.state = "FAILED"
         finally:
@@ -187,12 +225,27 @@ class QueryExecution:
                 lines.append("    " + ln)
         return "\n".join(lines)
 
+    def _wait_for_workers(self) -> List[Tuple[str, str]]:
+        """Block until the minimum cluster size is present or the wait
+        expires (ClusterSizeMonitor.java role)."""
+        need = max(1, self.co.min_workers)
+        deadline = time.monotonic() + self.co.min_workers_wait_s
+        while True:
+            workers = self.co.nodes.alive_nodes()
+            if len(workers) >= need:
+                return workers
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"Insufficient active worker nodes: have "
+                    f"{len(workers)}, need {need}")
+            time.sleep(0.05)
+
     def _internal_headers(self) -> Dict[str, str]:
         return (self.co.internal_auth.header()
                 if self.co.internal_auth is not None else {})
 
     def _cancel_worker_tasks(self) -> None:
-        for _nid, uri in self.co.nodes.alive_nodes():
+        for _nid, uri in self.co.nodes.responsive_nodes():
             try:
                 req = urllib.request.Request(
                     f"{uri}/v1/query/{self.query_id}", method="DELETE",
@@ -207,10 +260,7 @@ class QueryExecution:
         return 1 if partitioning == "single" else max(1, n_workers)
 
     def _schedule(self, dplan: DistributedPlan) -> List[str]:
-        workers = self.co.nodes.alive_nodes()
-        if not workers:
-            raise RuntimeError("no workers available "
-                               "(ClusterSizeMonitor would block here)")
+        workers = self._wait_for_workers()
         n_workers = len(workers)
         counts = {f.fragment_id: self._task_count(f.partitioning, n_workers)
                   for f in dplan.fragments}
@@ -235,14 +285,38 @@ class QueryExecution:
                 remote[fid] = task_uris[fid]
             uris = []
             for i in range(n_tasks):
-                _, wuri = workers[i % n_workers]
                 task_id = f"{self.query_id}.{frag.fragment_id}.{i}"
                 # each consumer task i polls ITS OWN partition i on every
                 # producer task; producer URIs carry a {part} placeholder
-                # the consumer's index resolves
-                self._create_remote_task(
-                    wuri, task_id, frag, (i, n_tasks), remote,
-                    n_out, broadcast, consumer_index=i)
+                # the consumer's index resolves.  A worker that started
+                # draining between the snapshot and now answers 503 —
+                # fall over to the next worker instead of failing the
+                # query (the graceful-shutdown race).
+                last_error = None
+                for attempt in range(n_workers):
+                    _, wuri = workers[(i + attempt) % n_workers]
+                    try:
+                        self._create_remote_task(
+                            wuri, task_id, frag, (i, n_tasks), remote,
+                            n_out, broadcast, consumer_index=i)
+                        break
+                    except urllib.error.HTTPError as e:
+                        if e.code == 503:
+                            last_error = e   # draining: next worker
+                            continue
+                        body = e.read().decode("utf-8", "replace")[:500]
+                        raise RuntimeError(
+                            f"task create failed on {wuri}: "
+                            f"{e.code} {body}") from e
+                    except urllib.error.URLError as e:
+                        # node died between heartbeat and now
+                        # (RequestErrorTracker transport-retry role)
+                        last_error = e
+                        continue
+                else:
+                    raise RuntimeError(
+                        "no worker accepted task "
+                        f"{task_id}: {last_error}")
                 uris.append(
                     f"{wuri}/v1/task/{task_id}/results/{{part}}")
             task_uris[frag.fragment_id] = uris
@@ -266,6 +340,10 @@ class QueryExecution:
                                for fid, us in resolved.items()},
             "n_output_partitions": n_out,
             "broadcast_output": broadcast,
+            # per-query session property overrides; the worker folds
+            # them over its base EngineConfig (SET SESSION reaching
+            # distributed execution, SystemSessionProperties role)
+            "session_properties": self.session_properties,
         }).encode("utf-8")
         headers = {"Content-Type": "application/json"}
         if self.co.internal_auth is not None:
@@ -280,28 +358,84 @@ class QueryExecution:
                 raise RuntimeError(f"task create failed: {info}")
 
     # -- result drain ---------------------------------------------------
+    def _session(self):
+        """Session built from the request's header state."""
+        from presto_tpu.session import Session
+
+        session = Session(user=self.user, catalog=self.catalog)
+        if self.co.session_property_manager is not None:
+            self.co.session_property_manager.apply(session)
+        for k, v in self.session_properties.items():
+            session.set_property(k, v)   # validates names and values
+        for name, sql in self.prepared.items():
+            try:
+                session.prepared[name] = parse_statement(sql)
+            except Exception:  # noqa: BLE001 - stale client entry
+                pass
+        return session
+
+    def _ok_result(self) -> None:
+        self.column_names = ["result"]
+        self.column_types = [T.BOOLEAN]
+        self.result_rows = [(True,)]
+
+    def _session_statement(self, stmt: t.Node):
+        """Handle statements that mutate client-session state: execute
+        them coordinator-side (validation) and emit the session-update
+        fields the client applies to its own state.  Returns None when
+        fully handled, a (possibly rewritten) statement otherwise."""
+        if isinstance(stmt, t.SetSession):
+            self._session().set_property(stmt.name, stmt.value)  # validate
+            self.session_updates["setSession"] = {stmt.name: stmt.value}
+            self._ok_result()
+            return None
+        if isinstance(stmt, t.ResetSession):
+            self.session_updates["resetSession"] = [stmt.name]
+            self._ok_result()
+            return None
+        if isinstance(stmt, t.Use):
+            self.co.registry.get(stmt.catalog)   # raises for unknown
+            self.session_updates["setCatalog"] = stmt.catalog
+            if stmt.schema:
+                self.session_updates["setSchema"] = stmt.schema
+            self._ok_result()
+            return None
+        if isinstance(stmt, t.Prepare):
+            self.session_updates["addedPrepare"] = {
+                stmt.name: stmt.original_sql}
+            self._ok_result()
+            return None
+        if isinstance(stmt, t.Deallocate):
+            if stmt.name not in self.prepared:
+                raise ValueError(
+                    f"prepared statement not found: {stmt.name}")
+            self.session_updates["deallocatedPrepare"] = [stmt.name]
+            self._ok_result()
+            return None
+        if isinstance(stmt, t.ExecutePrepared):
+            sql = self.prepared.get(stmt.name)
+            if sql is None:
+                raise ValueError(
+                    f"prepared statement not found: {stmt.name}")
+            bound = t.substitute_parameters(parse_statement(sql),
+                                            stmt.parameters)
+            return bound
+        return stmt
+
     def _run_utility(self, stmt: t.Node) -> None:
         """Execute a non-query statement against the shared registry via
         an embedded single-process runner.  Views/grants persist on the
-        coordinator (registry.views / co.grants); statements needing
-        client-session affinity (PREPARE, START TRANSACTION) are rejected
-        since the HTTP protocol here is stateless per query."""
+        coordinator (registry.views / co.grants); explicit transactions
+        still need a session-affine connection."""
         from presto_tpu.localrunner import LocalQueryRunner
-        from presto_tpu.session import Session
 
-        if isinstance(stmt, (t.Prepare, t.ExecutePrepared, t.Deallocate,
-                             t.DescribeInput, t.DescribeOutput,
-                             t.StartTransaction, t.Commit, t.Rollback,
-                             t.Use, t.SetSession, t.ResetSession)):
+        if isinstance(stmt, (t.StartTransaction, t.Commit, t.Rollback)):
             raise ValueError(
                 f"{type(stmt).__name__} requires a session-affine "
                 "connection; use the single-process runner")
-        session = Session(user=self.user, catalog=self.co.default_catalog)
-        if self.co.session_property_manager is not None:
-            self.co.session_property_manager.apply(session)
         runner = LocalQueryRunner(
-            self.co.registry, self.co.default_catalog, self.co.config,
-            session=session)
+            self.co.registry, self.catalog, self.co.config,
+            session=self._session())
         runner.grants = self.co.grants
         res = runner._execute_parsed(stmt)
         self.column_names = res.column_names
@@ -328,7 +462,7 @@ class QueryExecution:
         """Kill this query (KillQueryProcedure role): flag the drain loop
         and cancel every worker task."""
         self.canceled = True
-        for _, wuri in self.co.nodes.alive_nodes():
+        for _, wuri in self.co.nodes.responsive_nodes():
             try:
                 req = urllib.request.Request(
                     f"{wuri}/v1/query/{self.query_id}", method="DELETE",
@@ -376,6 +510,7 @@ class QueryExecution:
             for n, typ in zip(self.column_names, self.column_types)]
         out["data"] = [[_json_value(v) for v in row]
                        for row in self.result_rows]
+        out.update(self.session_updates)
         return out
 
 
@@ -469,7 +604,10 @@ class CoordinatorServer:
                  config: EngineConfig = DEFAULT, port: int = 0,
                  verbose: bool = False, authenticator=None,
                  internal_secret: Optional[str] = None,
-                 session_property_manager=None):
+                 session_property_manager=None,
+                 cluster_memory_limit_bytes: Optional[int] = None,
+                 min_workers: int = 0,
+                 min_workers_wait_s: float = 10.0):
         from presto_tpu.server.security import InternalAuthenticator
         from presto_tpu.session import ResourceGroupManager
 
@@ -487,6 +625,19 @@ class CoordinatorServer:
         self.internal_auth = (InternalAuthenticator(internal_secret)
                               if internal_secret else None)
         self.session_property_manager = session_property_manager
+        # ClusterSizeMonitor role: queries wait for this many schedulable
+        # workers before dispatching (0 = no requirement)
+        self.min_workers = min_workers
+        self.min_workers_wait_s = min_workers_wait_s
+        # ClusterMemoryManager + TotalReservationLowMemoryKiller role
+        self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
+        self.memory_info: Dict[str, Dict] = {}   # node_id -> MemoryInfo
+        self._memory_stop = threading.Event()
+        if cluster_memory_limit_bytes is not None:
+            self._memory_thread = threading.Thread(
+                target=self._memory_loop, daemon=True,
+                name="cluster-memory-manager")
+            self._memory_thread.start()
         co = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -544,8 +695,24 @@ class CoordinatorServer:
                     user = self._authenticated_user()
                     if user is None:
                         return
+                    import urllib.parse as _up
+
+                    def _kv_header(name):
+                        raw = self.headers.get(name, "")
+                        out = {}
+                        for part in raw.split(","):
+                            if "=" in part:
+                                k, _, v = part.partition("=")
+                                out[k.strip()] = _up.unquote(v)
+                        return out
+
                     qid = uuid.uuid4().hex[:16]
-                    q = QueryExecution(qid, sql, co, user=user)
+                    q = QueryExecution(
+                        qid, sql, co, user=user,
+                        session_properties=_kv_header("X-Presto-Session"),
+                        catalog=self.headers.get("X-Presto-Catalog"),
+                        prepared=_kv_header(
+                            "X-Presto-Prepared-Statements"))
                     co.queries[qid] = q
                     self._json(200, {
                         "id": qid,
@@ -626,7 +793,7 @@ class CoordinatorServer:
                     # aggregate live task state from every worker
                     # (system.runtime.tasks)
                     out = []
-                    for nid, uri in co.nodes.alive_nodes():
+                    for nid, uri in co.nodes.responsive_nodes():
                         try:
                             hdrs = (co.internal_auth.header()
                                     if co.internal_auth is not None
@@ -664,11 +831,47 @@ class CoordinatorServer:
                                         daemon=True, name="coordinator-http")
         self._thread.start()
 
+    def _memory_loop(self, interval_s: float = 0.5) -> None:
+        """Poll worker MemoryInfo; when the cluster total exceeds the
+        limit, kill the query with the largest total reservation
+        (ClusterMemoryManager.java:173-347 +
+        TotalReservationLowMemoryKiller policy)."""
+        hdrs = (self.internal_auth.header()
+                if self.internal_auth is not None else {})
+        while not self._memory_stop.wait(interval_s):
+            total = 0
+            per_query: Dict[str, int] = {}
+            for nid, uri in self.nodes.responsive_nodes():
+                try:
+                    req = urllib.request.Request(f"{uri}/v1/memory",
+                                                 headers=dict(hdrs))
+                    with urllib.request.urlopen(req, timeout=2) as resp:
+                        info = json.loads(resp.read())
+                except Exception:  # noqa: BLE001 - node flaky
+                    continue
+                self.memory_info[nid] = info
+                total += int(info.get("reserved", 0))
+                for qid, q in info.get("queries", {}).items():
+                    per_query[qid] = per_query.get(qid, 0) + \
+                        int(q.get("reserved", 0))
+            if total <= self.cluster_memory_limit_bytes or not per_query:
+                continue
+            victim = max(per_query, key=per_query.get)
+            q = self.queries.get(victim)
+            if q is not None and q.state in ("RUNNING", "SCHEDULING"):
+                self.log(f"low-memory killer: killing {victim} "
+                         f"(cluster {total} > "
+                         f"{self.cluster_memory_limit_bytes})")
+                q.error = ("Query killed because the cluster is out of "
+                           "memory. Please try again in a few minutes.")
+                q.cancel()
+
     def log(self, msg: str) -> None:
         if self.verbose:
             print(msg)
 
     def close(self) -> None:
+        self._memory_stop.set()
         self.nodes.close()
         self._httpd.shutdown()
         self._httpd.server_close()

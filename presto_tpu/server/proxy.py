@@ -74,6 +74,14 @@ class ProxyServer:
                 url = proxy.coordinator_uri + self.path
                 headers = {"X-Presto-User": user,
                            "Content-Type": "text/plain"}
+                # client-session state must survive the hop (session
+                # properties, catalog, prepared statements)
+                for h in ("X-Presto-Session", "X-Presto-Catalog",
+                          "X-Presto-Schema",
+                          "X-Presto-Prepared-Statements"):
+                    v = self.headers.get(h)
+                    if v:
+                        headers[h] = v
                 if proxy.internal_auth is not None:
                     headers.update(proxy.internal_auth.header())
                 req = urllib.request.Request(

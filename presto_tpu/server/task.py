@@ -43,6 +43,7 @@ class SqlTask:
         self.buffers = OutputBufferManager(
             n_output_partitions, broadcast=broadcast_output)
         self._stats: Optional[TaskContext] = None
+        self._live: Optional[TaskContext] = None  # set when execution starts
 
         planner = PhysicalPlanner(registry, config,
                                   scan_shard=scan_shard,
@@ -60,8 +61,12 @@ class SqlTask:
         self._thread.start()
 
     def _run(self) -> None:
+        def observe(task_ctx):
+            self._live = task_ctx
+
         try:
-            self._stats = execute_pipelines(self._pipelines)
+            self._stats = execute_pipelines(self._pipelines,
+                                            on_task_context=observe)
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - task failure surface
             self.error = f"{e}\n{traceback.format_exc()}"
@@ -71,6 +76,15 @@ class SqlTask:
     def info(self) -> Dict:
         return {"taskId": self.task_id, "state": self.state,
                 "error": self.error}
+
+    def memory_info(self) -> Dict:
+        """Live reservation/peak bytes (MemoryPool per-task view)."""
+        ctx = self._stats or self._live
+        if ctx is None:
+            return {"reserved": 0, "peak": 0}
+        running = self.state == "RUNNING"
+        return {"reserved": ctx.memory.reserved if running else 0,
+                "peak": ctx.memory.peak}
 
     def cancel(self) -> None:
         if self.state == "RUNNING":
@@ -100,13 +114,25 @@ class SqlTaskManager:
                     scan_shard: Tuple[int, int],
                     remote_sources: Dict[int, List[str]],
                     n_output_partitions: int,
-                    broadcast_output: bool) -> SqlTask:
+                    broadcast_output: bool,
+                    session_properties: Optional[Dict[str, str]] = None
+                    ) -> SqlTask:
+        config = self.config
+        if session_properties:
+            # fold the query's SET SESSION overrides over this node's
+            # base config (validated names/values, Session role)
+            from presto_tpu.session import Session
+
+            session = Session()
+            for k, v in session_properties.items():
+                session.set_property(k, str(v))
+            config = session.effective_config(config)
         with self._lock:
             if task_id in self.tasks:
                 return self.tasks[task_id]
             task = SqlTask(task_id, fragment, scan_shard, remote_sources,
                            n_output_partitions, broadcast_output,
-                           self.registry, self.config,
+                           self.registry, config,
                            fetch_headers=self.fetch_headers)
             self.tasks[task_id] = task
             return task
@@ -135,3 +161,36 @@ class SqlTaskManager:
         with self._lock:
             for task in self.tasks.values():
                 task.cancel()
+
+    def memory_info(self) -> Dict:
+        """Node MemoryInfo (presto-main/.../memory/MemoryInfo.java role):
+        totals plus per-query reservations, aggregated from task memory
+        contexts (task ids are {queryId}.{fragment}.{i})."""
+        with self._lock:
+            tasks = list(self.tasks.values())
+        per_query: Dict[str, Dict[str, int]] = {}
+        total_reserved = 0
+        total_peak = 0
+        for t in tasks:
+            mi = t.memory_info()
+            qid = t.task_id.rsplit(".", 2)[0]
+            q = per_query.setdefault(qid, {"reserved": 0, "peak": 0})
+            q["reserved"] += mi["reserved"]
+            q["peak"] += mi["peak"]
+            total_reserved += mi["reserved"]
+            total_peak += mi["peak"]
+        return {"reserved": total_reserved, "peak": total_peak,
+                "queries": per_query}
+
+    def running_count(self) -> int:
+        with self._lock:
+            return sum(1 for t in self.tasks.values()
+                       if t.state == "RUNNING")
+
+    def undrained_count(self) -> int:
+        """Tasks still running OR holding pages a consumer has not yet
+        fetched — the set a graceful drain must wait for."""
+        with self._lock:
+            return sum(1 for t in self.tasks.values()
+                       if t.state == "RUNNING"
+                       or not t.buffers.is_drained())

@@ -41,6 +41,11 @@ class WorkerServer:
             registry, config,
             fetch_headers=(self.internal_auth.header()
                            if self.internal_auth else None))
+        # graceful shutdown (GracefulShutdownHandler.java role): once
+        # draining, new tasks are refused, /v1/info advertises
+        # SHUTTING_DOWN so the coordinator stops scheduling here, and
+        # close() waits for running tasks to finish
+        self.draining = False
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -78,8 +83,15 @@ class WorkerServer:
             def do_GET(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
                 if parts[:2] == ["v1", "info"]:
-                    self._json(200, {"nodeId": worker.node_id,
-                                     "state": "ACTIVE"})
+                    self._json(200, {
+                        "nodeId": worker.node_id,
+                        "state": ("SHUTTING_DOWN" if worker.draining
+                                  else "ACTIVE")})
+                    return
+                if parts == ["v1", "memory"]:
+                    if not self._internal_ok(["v1", "task"]):
+                        return
+                    self._json(200, worker.task_manager.memory_info())
                     return
                 if not self._internal_ok(parts):
                     return
@@ -129,6 +141,9 @@ class WorkerServer:
                 # (InternalAuthenticationManager role)
                 if not self._internal_ok(parts):
                     return
+                if parts[:2] == ["v1", "task"] and worker.draining:
+                    self._json(503, {"error": "worker is shutting down"})
+                    return
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
                     from presto_tpu.sql.planserde import (
                         PlanSerdeError, fragment_from_json,
@@ -143,18 +158,42 @@ class WorkerServer:
                                           req["remote_sources"].items()}
                         n_out = int(req["n_output_partitions"])
                         broadcast = bool(req["broadcast_output"])
+                        session_props = dict(
+                            req.get("session_properties") or {})
                     except (PlanSerdeError, KeyError, TypeError,
                             AttributeError, ValueError) as e:
                         self._json(400, {"error": f"bad task update: {e}"})
                         return
-                    task = worker.task_manager.create_task(
-                        task_id=parts[2],
-                        fragment=fragment,
-                        scan_shard=scan_shard,
-                        remote_sources=remote_sources,
-                        n_output_partitions=n_out,
-                        broadcast_output=broadcast)
+                    try:
+                        task = worker.task_manager.create_task(
+                            task_id=parts[2],
+                            fragment=fragment,
+                            scan_shard=scan_shard,
+                            remote_sources=remote_sources,
+                            n_output_partitions=n_out,
+                            broadcast_output=broadcast,
+                            session_properties=session_props)
+                    except Exception as e:  # noqa: BLE001 - bad props
+                        self._json(400, {"error": f"bad task update: {e}"})
+                        return
                     self._json(200, task.info())
+                    return
+                self._json(404, {"error": f"bad path {self.path}"})
+
+            def do_PUT(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if not self._internal_ok(["v1", "task"]):
+                    return
+                if parts == ["v1", "info", "state"]:
+                    # PUT "SHUTTING_DOWN" starts a graceful drain
+                    # (the reference's /v1/info/state shutdown trigger)
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n).decode().strip().strip('"')
+                    if body != "SHUTTING_DOWN":
+                        self._json(400, {"error": f"bad state {body!r}"})
+                        return
+                    worker.draining = True
+                    self._json(200, {"state": "SHUTTING_DOWN"})
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
 
@@ -181,6 +220,21 @@ class WorkerServer:
             target=self._httpd.serve_forever, daemon=True,
             name=f"worker-http-{self.port}")
         self._thread.start()
+
+    def shutdown_gracefully(self, drain_timeout_s: float = 30.0) -> None:
+        """Stop accepting tasks, wait for running ones, then close
+        (GracefulShutdownHandler drain sequence)."""
+        import time
+
+        self.draining = True
+        deadline = time.monotonic() + drain_timeout_s
+        # wait for tasks to finish AND for consumers to fetch their
+        # buffered output — closing earlier would destroy pages a
+        # downstream stage still needs
+        while (self.task_manager.undrained_count() > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        self.close()
 
     def close(self) -> None:
         self.task_manager.cancel_all()

@@ -248,8 +248,10 @@ class _Parser:
         if self.accept_word("prepare"):
             name = self.identifier()
             self.expect_kw("from")
+            start = self.pos
             inner = self.parse_statement()
-            return t.Prepare(name, inner)
+            return t.Prepare(name, inner,
+                             self._text_between(start, len(self.toks)))
         if (self.at_word("execute")
                 and self.peek(1).kind in ("IDENT", "QIDENT")):
             self.next()

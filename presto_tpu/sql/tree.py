@@ -431,10 +431,12 @@ class Delete(Node):
 @D(frozen=True)
 class Prepare(Node):
     """PREPARE name FROM <statement>; the statement may contain ?
-    parameters (Parameter nodes)."""
+    parameters (Parameter nodes).  ``original_sql`` keeps the statement
+    text verbatim for the client protocol's added-prepare exchange."""
 
     name: str
     statement: Node
+    original_sql: str = ""
 
 
 @D(frozen=True)
