@@ -171,9 +171,9 @@ def _device_concat_fast(live: Sequence[Batch],
     dtypes = tuple(str(live[0].columns[ci].values.dtype)
                    for ci in range(ncols))
     key = (caps, out_cap, has_valid, dtypes)
-    from presto_tpu.exec.operators import _cache_get, _cache_put
+    from presto_tpu.kernelcache import cache_get, cache_put
 
-    fn = _cache_get(_CONCAT_PROGRAMS, key)
+    fn = cache_get(_CONCAT_PROGRAMS, key)
     if fn is None:
         import jax
         import jax.numpy as jnp
@@ -193,7 +193,7 @@ def _device_concat_fast(live: Sequence[Batch],
             return tuple(outs)
 
         fn = jax.jit(kernel)
-        _cache_put(_CONCAT_PROGRAMS, key, fn, cap=128)
+        cache_put(_CONCAT_PROGRAMS, key, fn, cap=128)
     cols_per_batch = tuple(
         tuple(b.columns[ci].values for ci in range(ncols)) for b in live)
     valids_per_batch = tuple(

@@ -160,8 +160,17 @@ class LocalQueryRunner:
                 "procedures (kill_query) run on a coordinator; the "
                 "single-process runner executes queries synchronously")
         if isinstance(stmt, t.Explain):
-            text = (self.explain_analyze_text(stmt.statement)
-                    if stmt.analyze else self.explain_text(stmt.statement))
+            if stmt.analyze:
+                text = self.explain_analyze_text(stmt.statement)
+            elif stmt.plan_type == "distributed":
+                text = self.explain_distributed_text(stmt.statement)
+            elif stmt.plan_type == "validate":
+                self._validate(stmt.statement)
+                return QueryResult(["Valid"], [T.BOOLEAN], [(True,)])
+            elif stmt.plan_type == "io":
+                return self._explain_io(stmt.statement)
+            else:
+                text = self.explain_text(stmt.statement)
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.splitlines()])
         if isinstance(stmt, t.ShowTables):
@@ -547,6 +556,86 @@ class LocalQueryRunner:
         logical = Planner(self.metadata).plan(stmt)
         optimized = optimize(logical, self.metadata)
         return format_plan(optimized)
+
+    def _validate(self, stmt: t.Node) -> None:
+        """EXPLAIN (TYPE VALIDATE): analyze/plan without executing.
+        Queries plan fully; DML validates its target and source; DDL
+        validates names/types — errors raise instead of reporting
+        Valid."""
+        if isinstance(stmt, (t.Query, t.SetOperation)):
+            optimize(Planner(self.metadata).plan(stmt), self.metadata)
+            return
+        if isinstance(stmt, t.Insert):
+            catalog, name = self._resolve_write_target(stmt.table)
+            conn = self.registry.get(catalog)
+            conn.table_schema(conn.get_table(name))
+            source = (t.Query((t.SelectItem(t.Star()),), (stmt.source,))
+                      if isinstance(stmt.source, t.InlineValues)
+                      else stmt.source)
+            Planner(self.metadata).plan(source)
+            return
+        if isinstance(stmt, t.CreateTableAs):
+            Planner(self.metadata).plan(stmt.query)
+            return
+        if isinstance(stmt, t.CreateTable):
+            for _cn, ct in stmt.columns:
+                T.parse_type(ct)
+            return
+        if isinstance(stmt, (t.Delete, t.ShowStats, t.Analyze)):
+            self.metadata.resolve_table(stmt.table)
+            return
+        if isinstance(stmt, (t.DropTable, t.RenameTable)):
+            if not getattr(stmt, "if_exists", False):
+                self.metadata.resolve_table(stmt.table)
+            return
+        # session/metadata statements: parsing was the validation
+
+    def explain_distributed_text(self, stmt: t.Node) -> str:
+        """EXPLAIN (TYPE DISTRIBUTED): the fragmented plan
+        (PlanPrinter.textDistributedPlan role)."""
+        from presto_tpu.server.fragmenter import Fragmenter
+
+        if not isinstance(stmt, (t.Query, t.SetOperation)):
+            raise ValueError("EXPLAIN requires a query")
+        logical = Planner(self.metadata).plan(stmt)
+        optimized = optimize(logical, self.metadata)
+        dplan = Fragmenter(metadata=self.metadata).fragment(optimized)
+        lines = []
+        for f in dplan.fragments:
+            out_kind, out_ch = f.output_partitioning
+            lines.append(
+                f"Fragment {f.fragment_id} [{f.partitioning}] "
+                f"=> output {out_kind}{list(out_ch) if out_ch else ''}")
+            for ln in format_plan(f.root).splitlines():
+                lines.append("    " + ln)
+        return "\n".join(lines)
+
+    def _explain_io(self, stmt: t.Node) -> QueryResult:
+        """EXPLAIN (TYPE IO): the tables the query reads
+        (IoPlanPrinter role), as one JSON row."""
+        import json as _json
+
+        from presto_tpu.sql.plan import TableScanNode
+
+        if not isinstance(stmt, (t.Query, t.SetOperation)):
+            raise ValueError("EXPLAIN requires a query")
+        logical = Planner(self.metadata).plan(stmt)
+        optimized = optimize(logical, self.metadata)
+        tables = []
+
+        def walk(node):
+            if isinstance(node, TableScanNode):
+                entry = {"catalog": node.catalog, "table": node.table,
+                         "columns": list(node.column_names)}
+                if entry not in tables:
+                    tables.append(entry)
+            for s in node.sources:
+                walk(s)
+
+        walk(optimized)
+        return QueryResult(
+            ["Query Input"], [T.VARCHAR],
+            [(_json.dumps({"inputTables": tables}),)])
 
     def explain_analyze_text(self, stmt: t.Node) -> str:
         """EXPLAIN ANALYZE: run the query, render the plan plus the

@@ -231,6 +231,8 @@ class QueryExecution:
         need = max(1, self.co.min_workers)
         deadline = time.monotonic() + self.co.min_workers_wait_s
         while True:
+            if self.canceled:
+                raise RuntimeError("Query killed")
             workers = self.co.nodes.alive_nodes()
             if len(workers) >= need:
                 return workers

@@ -82,7 +82,11 @@ class SqlTask:
         ctx = self._stats or self._live
         if ctx is None:
             return {"reserved": 0, "peak": 0}
-        running = self.state == "RUNNING"
+        # a CANCELED task's pipeline may still be running (cancellation
+        # lands at the next buffer touch); report its reservations until
+        # the thread actually exits so the memory manager keeps seeing
+        # the pressure
+        running = self._thread.is_alive()
         return {"reserved": ctx.memory.reserved if running else 0,
                 "peak": ctx.memory.peak}
 

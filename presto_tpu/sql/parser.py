@@ -146,9 +146,21 @@ class _Parser:
             self.expect_eof()
             return t.CallProcedure(name, tuple(args))
         if self.accept_kw("explain"):
+            plan_type = "logical"
+            if self.at_op("(") and self.peek(1).text == "type":
+                self.next()
+                self.expect_word("type")
+                tok = self.next()
+                if tok.text not in ("logical", "distributed", "validate",
+                                    "io"):
+                    raise SqlSyntaxError(
+                        f"unknown EXPLAIN type {tok.text!r}",
+                        tok.line, tok.col)
+                plan_type = tok.text
+                self.expect_op(")")
             analyze = bool(self.accept_kw("analyze"))
             inner = self.parse_statement()
-            return t.Explain(inner, analyze)
+            return t.Explain(inner, analyze, plan_type)
         if self.accept_kw("create"):
             replace = False
             if self.accept_kw("or"):

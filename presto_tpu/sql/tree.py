@@ -389,6 +389,8 @@ class CallProcedure(Node):
 class Explain(Node):
     statement: Node
     analyze: bool = False
+    # EXPLAIN (TYPE ...) — logical | distributed | validate | io
+    plan_type: str = "logical"
 
 
 @D(frozen=True)

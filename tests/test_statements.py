@@ -249,3 +249,39 @@ def test_mutually_recursive_views_rejected(runner):
     finally:
         del runner.registry.views[("tpch", "va")]
         del runner.registry.views[("tpch", "vb")]
+
+
+def test_explain_types(runner):
+    import json as _json
+
+    dist = runner.execute(
+        "EXPLAIN (TYPE DISTRIBUTED) SELECT l_returnflag, count(*) "
+        "FROM lineitem GROUP BY l_returnflag").rows
+    text = "\n".join(r[0] for r in dist)
+    assert "Fragment 0" in text and "Aggregation" in text
+    assert runner.execute(
+        "EXPLAIN (TYPE VALIDATE) SELECT 1").rows == [(True,)]
+    io = runner.execute(
+        "EXPLAIN (TYPE IO) SELECT n_name FROM tpch.nation").rows
+    doc = _json.loads(io[0][0])
+    assert doc["inputTables"] == [{"catalog": "tpch", "table": "nation",
+                                   "columns": ["n_name"]}]
+    with pytest.raises(Exception):
+        runner.execute("EXPLAIN (TYPE BOGUS) SELECT 1")
+
+
+def test_explain_validate_checks_dml(runner):
+    with pytest.raises(Exception):
+        runner.execute(
+            "EXPLAIN (TYPE VALIDATE) INSERT INTO memory.no_such_table "
+            "VALUES (1)")
+    with pytest.raises(Exception):
+        runner.execute(
+            "EXPLAIN (TYPE VALIDATE) SELECT no_such_col FROM tpch.nation")
+    runner.execute("CREATE TABLE memory.val_t (a bigint)")
+    assert runner.execute(
+        "EXPLAIN (TYPE VALIDATE) INSERT INTO memory.val_t VALUES (1)"
+    ).rows == [(True,)]
+    assert runner.execute(
+        "SELECT count(*) FROM memory.val_t").rows == [(0,)]  # not executed
+    runner.execute("DROP TABLE memory.val_t")
