@@ -209,6 +209,72 @@ def make_partitioned_join_step(
     return shard_fn, in_specs, out_specs
 
 
+def make_partitioned_topn_step(
+    sort_types: Sequence[T.Type],
+    descending: Sequence[bool],
+    n_payload: int,
+    limit: int,
+    axis_name: str = AXIS,
+):
+    """Build the SPMD program for a distributed TopN (the mesh analogue
+    of the sorted-merge exchange / MergeOperator.java:45 pattern):
+
+        local sort + truncate to ``limit`` candidates per shard
+        -> all_gather the candidate blocks over ICI
+        -> final sort + truncate, replicated on every shard
+
+    Returned callable takes ``(sort_vals [K][P*C], sort_valids
+    [K][P*C], payload [Npay][P*C], num_rows [P])`` and returns
+    ``(top_sort_vals [K][limit], top_sort_valids, top_payload
+    [Npay][limit], count [])`` — identical (replicated) on every shard,
+    so the out specs carry no mesh axis."""
+    sort_types = list(sort_types)
+    descending = list(descending)
+    nkeys = len(sort_types)
+
+    def shard_fn(s_vals, s_valids, payload, num_rows):
+        from presto_tpu.ops.sort import sort_permutation
+
+        n = num_rows[0]
+        cap = s_vals[0].shape[0]
+        keys = [(v, g, t, d, False)
+                for v, g, t, d in zip(s_vals, s_valids, sort_types,
+                                      descending)]
+        perm = sort_permutation(keys, n)
+        # per-shard candidate block: min(limit, cap) rows (a shard can
+        # contribute at most cap rows; a limit above that is fine — the
+        # union below still holds every possible top-limit row because
+        # each shard keeps ITS best min(limit, cap))
+        block = min(limit, cap)
+        top = perm[:block].astype(jnp.int32)
+        cand = jnp.minimum(n, block)
+        cols = ([v[top] for v in s_vals] + [g[top] for g in s_valids]
+                + [p[top] for p in payload])
+        # broadcast exchange compacts the ragged candidate blocks into
+        # the identical union on every shard (P2 primitive)
+        nparts = jax.lax.axis_size(axis_name)
+        gathered, total, _of = broadcast_rows(cols, cand,
+                                              nparts * block, axis_name)
+        g_svals = gathered[:nkeys]
+        g_valids = gathered[nkeys:2 * nkeys]
+        g_pay = gathered[2 * nkeys:]
+        fkeys = [(v, g, t, d, False)
+                 for v, g, t, d in zip(g_svals, g_valids, sort_types,
+                                       descending)]
+        fperm = sort_permutation(fkeys, total)[:limit].astype(jnp.int32)
+        out_svals = [v[fperm] for v in g_svals]
+        out_valids = [g[fperm] for g in g_valids]
+        out_pay = [p[fperm] for p in g_pay]
+        return (out_svals, out_valids, out_pay,
+                jnp.minimum(total, limit))
+
+    row = P(axis_name)
+    rep = P()
+    in_specs = ([row] * nkeys, [row] * nkeys, [row] * n_payload, row)
+    out_specs = ([rep] * nkeys, [rep] * nkeys, [rep] * n_payload, rep)
+    return shard_fn, in_specs, out_specs
+
+
 def jit_step(mesh, shard_fn, in_specs, out_specs):
     """shard_map + jit a step built by one of the factories above."""
     mapped = jax.shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
