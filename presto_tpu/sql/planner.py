@@ -1236,6 +1236,14 @@ class Planner:
                      negated: bool) -> RelationPlan:
         sub_from, corr_eq, corr_other = self._plan_correlated_from(rel, q)
         if not corr_eq:
+            if corr_other:
+                # correlation exists but not as extractable equality
+                # conjuncts (e.g. "(a = b AND p) OR q"): constant-key
+                # semi join with the WHOLE predicate as residual — the
+                # nested-loop-shaped decorrelation the reference reaches
+                # via TransformCorrelatedExistsToJoin
+                return self._plan_exists_residual_only(
+                    rel, sub_from, corr_other, negated)
             raise SqlAnalysisError(
                 "uncorrelated EXISTS is not supported (always true/false)")
         outer_keys = []
@@ -1256,6 +1264,47 @@ class Planner:
         node = SemiJoinNode(src.node, sub_from.node, tuple(outer_keys),
                             tuple(sub_keys), negated, residual)
         return RelationPlan(node, src.scope)
+
+    def _plan_exists_residual_only(
+            self, rel: RelationPlan, sub_from: RelationPlan,
+            corr_conjuncts: List[t.Expression],
+            negated: bool) -> RelationPlan:
+        """EXISTS with no extractable equi-correlation: pair every outer
+        row with every subquery row via a constant join key and let the
+        residual (the full correlated predicate) decide matches.
+
+        This is inherently a nested loop — O(outer x sub) residual
+        evaluations, the same complexity the reference pays when its
+        correlated-join rewrites bottom out in a nested-loop join;
+        prefer conjunct-shaped correlation (a = b AND ...) for the hash
+        path."""
+        one_t = T.BIGINT
+
+        def with_one(node: PlanNode):
+            exprs = tuple(B.ref(i, ty)
+                          for i, (_n, ty) in enumerate(node.columns))
+            return ProjectNode(node, exprs + (B.const(1, one_t),),
+                               tuple(node.columns) + (("$one", one_t),))
+
+        src_node = with_one(rel.node)
+        sub_node = with_one(sub_from.node)
+        # residual channel layout: [probe cols incl. $one][build cols];
+        # the hidden $one field occupies its index slot, never resolved
+        comb = Scope(list(rel.scope.fields)
+                     + [Field("$one", None, one_t)]
+                     + list(sub_from.scope.fields), None)
+        ctr = Translator(comb)
+        residual = _and_all([ctr.translate(c) for c in corr_conjuncts])
+        node = SemiJoinNode(src_node, sub_node,
+                            (len(rel.node.columns),),
+                            (len(sub_from.node.columns),),
+                            negated, residual)
+        proj = ProjectNode(
+            node,
+            tuple(B.ref(i, ty)
+                  for i, (_n, ty) in enumerate(rel.node.columns)),
+            tuple(rel.node.columns))
+        return RelationPlan(proj, rel.scope)
 
     def _plan_scalar_compare(
             self, rel: RelationPlan, op: str, lhs: t.Expression,

@@ -191,14 +191,72 @@ class Gen:
         b = f"SELECT {col} AS c0 FROM {t} WHERE {self.predicate(t)}"
         return f"{a} {op} {b} ORDER BY c0"
 
+    def left_join_select(self):
+        # join smaller-to-larger reversed so unmatched rows exist
+        rt, lt, rk, lk = self.r.choice(JOINS)
+        la, ra = "t1.", "t2."
+        cols = [f"{la}{lk}", self.num_col(rt, ra), self.str_col(lt, la)]
+        sel = ", ".join(f"{c} AS c{i}" for i, c in enumerate(cols))
+        sql = (f"SELECT {sel} FROM {lt} t1 LEFT JOIN {rt} t2 "
+               f"ON {la}{lk} = {ra}{rk}")
+        preds = []
+        if self.r.random() < 0.8:
+            preds.append(self.predicate(lt, la))
+        if self.r.random() < 0.4:
+            # NULL-sensitive predicate on the nullable side
+            preds.append(f"{ra}{self.num_col(rt)} IS NULL")
+        if preds:
+            sql += " WHERE " + " AND ".join(f"({p})" for p in preds)
+        order = ", ".join(f"c{i}" for i in range(len(cols)))
+        sql += f" ORDER BY {order} LIMIT {self.r.randint(10, 90)}"
+        return sql
+
+    def subquery_select(self):
+        lt, rt, lk, rk = self.r.choice(JOINS)
+        form = self.r.random()
+        if form < 0.4:
+            inner = (f"SELECT {rk} FROM {rt} "
+                     f"WHERE {self.predicate(rt)}")
+            pred = f"{lk} IN ({inner})"
+        elif form < 0.7:
+            inner = (f"SELECT {rk} FROM {rt} "
+                     f"WHERE {self.predicate(rt)}")
+            pred = f"{lk} NOT IN ({inner})"
+        else:
+            # un-parenthesized OR makes the correlation non-extractable
+            # and exercises the keyless (nested-loop-shaped) EXISTS
+            # decorrelation — which is quadratic, so keep that shape to
+            # the small table pairs
+            raw_or = (self.r.random() < 0.4
+                      and (lt, rt) in (("nation", "region"),
+                                       ("customer", "nation")))
+            inner_pred = self.predicate(rt)
+            if not raw_or:
+                inner_pred = f"({inner_pred})"
+            inner = (f"SELECT 1 FROM {rt} WHERE {rt}.{rk} = {lt}.{lk} "
+                     f"AND {inner_pred}")
+            neg = "NOT " if self.r.random() < 0.5 else ""
+            pred = f"{neg}EXISTS ({inner})"
+        col = self.num_col(lt)
+        distinct = "DISTINCT " if self.r.random() < 0.4 else ""
+        sql = (f"SELECT {distinct}{col} AS c0 FROM {lt} "
+               f"WHERE {pred} ORDER BY c0")
+        if self.r.random() < 0.5:
+            sql += f" LIMIT {self.r.randint(5, 60)}"
+        return sql
+
     def query(self):
         kind = self.r.random()
-        if kind < 0.35:
+        if kind < 0.25:
             return self.simple_select()
-        if kind < 0.65:
+        if kind < 0.45:
             return self.agg_select()
-        if kind < 0.85:
+        if kind < 0.6:
             return self.join_select()
+        if kind < 0.75:
+            return self.left_join_select()
+        if kind < 0.9:
+            return self.subquery_select()
         return self.setop_select()
 
 
