@@ -623,6 +623,7 @@ class TpchConnector(Connector):
             name: TableSchema(name, tuple(ColumnMetadata(n, t) for n, t in cols))
             for name, cols in _schemas(money_t, qty_t).items()
         }
+        self._stats_cache: Dict[str, TableStatistics] = {}
 
     # -- key ranges per table (split domain) -----------------------------
     def _key_range(self, table: str) -> Tuple[int, int]:
@@ -658,7 +659,95 @@ class TpchConnector(Connector):
         return self._schemas[handle.table]
 
     def table_statistics(self, handle: TableHandle) -> TableStatistics:
-        return TableStatistics(row_count=float(self.row_count(handle.table)))
+        """Analytic column statistics from the generator's parameters
+        (the reference's presto-tpch ships exact ColumnStatistics the same
+        way — TpchMetadata.getTableStatistics — because counter-based
+        generation makes NDVs and ranges closed-form, no ANALYZE pass)."""
+        stats = self._stats_cache.get(handle.table)
+        if stats is None:
+            stats = self._compute_statistics(handle.table)
+            self._stats_cache[handle.table] = stats
+        return stats
+
+    def _compute_statistics(self, table: str) -> TableStatistics:
+        import datetime as _dt
+
+        def day(days: int) -> _dt.date:
+            return _dt.date(1970, 1, 1) + _dt.timedelta(days=int(days))
+
+        g = self.generator
+        rows = float(self.row_count(table))
+        ts = TableStatistics(row_count=rows)
+        nc, ns, np_, no = (g.n_customer, g.n_supplier, g.n_part, g.n_orders)
+
+        def put(col, ndv, lo=None, hi=None):
+            ts.ndv[col] = float(min(ndv, rows))
+            if lo is not None:
+                ts.low[col] = lo
+                ts.high[col] = hi
+
+        if table == "region":
+            put("r_regionkey", 5, 0, 4)
+            put("r_name", 5)
+        elif table == "nation":
+            put("n_nationkey", 25, 0, 24)
+            put("n_name", 25)
+            put("n_regionkey", 5, 0, 4)
+        elif table == "supplier":
+            put("s_suppkey", ns, 1, ns)
+            put("s_name", ns)
+            put("s_nationkey", 25, 0, 24)
+            put("s_acctbal", min(rows, 1_099_999), -999.99, 9999.99)
+        elif table == "customer":
+            put("c_custkey", nc, 1, nc)
+            put("c_name", nc)
+            put("c_nationkey", 25, 0, 24)
+            put("c_acctbal", min(rows, 1_099_999), -999.99, 9999.99)
+            put("c_mktsegment", len(SEGMENTS))
+        elif table == "part":
+            put("p_partkey", np_, 1, np_)
+            put("p_name", np_)
+            put("p_mfgr", 5)
+            put("p_brand", 25)
+            put("p_type", len(TYPE_S1) * len(TYPE_S2) * len(TYPE_S3))
+            put("p_size", 50, 1, 50)
+            put("p_container", len(CONTAINER_S1) * len(CONTAINER_S2))
+            put("p_retailprice", 20001, 900.00, 2099.00)
+        elif table == "partsupp":
+            put("ps_partkey", np_, 1, np_)
+            put("ps_suppkey", ns, 1, ns)
+            put("ps_availqty", 9999, 1, 9999)
+            put("ps_supplycost", 99_901, 1.00, 1000.00)
+        elif table == "orders":
+            put("o_orderkey", no, 1, no)
+            put("o_custkey", max((nc // 3) * 2, 1), 1, nc)
+            put("o_orderstatus", 3)
+            put("o_totalprice", rows, 810.00, 600_000.00)
+            put("o_orderdate", DATE_HI - 151 - DATE_LO + 1,
+                day(DATE_LO), day(DATE_HI - 151))
+            put("o_orderpriority", len(PRIORITIES))
+            put("o_clerk", g.n_clerks)
+            put("o_shippriority", 1, 0, 0)
+        elif table == "lineitem":
+            put("l_orderkey", no, 1, no)
+            put("l_partkey", np_, 1, np_)
+            put("l_suppkey", ns, 1, ns)
+            put("l_linenumber", 7, 1, 7)
+            put("l_quantity", 50, 1.0, 50.0)
+            put("l_extendedprice", rows / 10, 900.00, 104_950.00)
+            put("l_discount", 11, 0.00, 0.10)
+            put("l_tax", 9, 0.00, 0.08)
+            put("l_returnflag", 3)
+            put("l_linestatus", 2)
+            put("l_shipdate", DATE_HI - 151 + 121 - DATE_LO,
+                day(DATE_LO + 1), day(DATE_HI - 151 + 121))
+            put("l_commitdate", DATE_HI - 151 + 90 - DATE_LO - 30,
+                day(DATE_LO + 30), day(DATE_HI - 151 + 90))
+            put("l_receiptdate", DATE_HI - 151 + 151 - DATE_LO,
+                day(DATE_LO + 2), day(DATE_HI - 151 + 151))
+            put("l_shipinstruct", len(INSTRUCTIONS))
+            put("l_shipmode", len(SHIP_MODES))
+        return ts
 
     def get_splits(self, handle: TableHandle, desired_splits: int) -> List[Split]:
         lo, hi = self._key_range(handle.table)

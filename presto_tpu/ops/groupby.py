@@ -100,6 +100,7 @@ def grouped_aggregate(
     aggs: Sequence[AggIn],
     num_rows: jax.Array,
     group_capacity: int,
+    live_mask: Optional[jax.Array] = None,
 ):
     """Aggregate ``aggs`` per distinct key tuple.
 
@@ -118,6 +119,10 @@ def grouped_aggregate(
     """
     cap = key_columns[0][0].shape[0]
     pad = jnp.arange(cap) >= num_rows
+    if live_mask is not None:
+        # fused upstream filter (WHERE without compaction — the mesh SQL
+        # tier keeps rows in place and masks them dead)
+        pad = pad | ~live_mask
     key_words, _ = normalize_keys(jnp, key_columns, nulls_equal=True)
     perm, gid, boundary = _segment_ids(key_words, pad)
     total_segments = gid[-1] + 1
@@ -321,16 +326,23 @@ def decode_direct_keys(slots: jax.Array,
     return out[::-1]
 
 
-def global_aggregate(aggs: Sequence[AggIn], num_rows: jax.Array):
+def global_aggregate(aggs: Sequence[AggIn], num_rows: jax.Array,
+                     live_mask: Optional[jax.Array] = None):
     """Ungrouped aggregation (AggregationOperator analogue): one output row
     always (SQL: aggregates over empty input yield count=0 / sum=NULL)."""
     results = []
+    n_live = num_rows
+    if live_mask is not None:
+        n_live = ((jnp.arange(live_mask.shape[0]) < num_rows)
+                  & live_mask).sum()
     for prim, values, valid in aggs:
-        cap = (values.shape[0] if values is not None else num_rows)
-        live = jnp.arange(cap) < num_rows if values is not None else None
+        if values is not None:
+            live = jnp.arange(values.shape[0]) < num_rows
+            if live_mask is not None:
+                live = live & live_mask
         if values is None:  # count(*)
-            results.append((num_rows.astype(jnp.int64),
-                            num_rows.astype(jnp.int64)))
+            results.append((n_live.astype(jnp.int64),
+                            n_live.astype(jnp.int64)))
             continue
         if valid is not None:
             live = live & valid

@@ -52,7 +52,12 @@ def value_hash_triple(col) -> tuple:
     dictionary entry's bytes (entries << rows, host-side) makes the
     partition a pure function of the string value — the generalization of
     the reference's DictionaryAware processing to the partitioning path
-    (PartitionedOutputOperator / GenericPartitioningSpiller roles)."""
+    (PartitionedOutputOperator / GenericPartitioningSpiller roles).
+
+    ``col`` needs only ``values/valid/type/dictionary`` attributes and the
+    code array may be concrete OR traced (the mesh exchange calls this
+    inside shard_map); every caller must agree on this one hash so the
+    mesh tier and the HTTP data plane route equal keys identically."""
     import numpy as np
 
     from presto_tpu import native
@@ -66,5 +71,5 @@ def value_hash_triple(col) -> tuple:
         dtype=np.uint64, count=len(entries)).view(np.int64)
     if len(table) == 0:
         table = np.zeros(1, np.int64)
-    codes = np.clip(np.asarray(col.values), 0, len(table) - 1)
-    return (jnp.asarray(table)[jnp.asarray(codes)], col.valid, TT.BIGINT)
+    codes = jnp.clip(col.values, 0, len(table) - 1)
+    return (jnp.asarray(table)[codes], col.valid, TT.BIGINT)

@@ -64,6 +64,7 @@ class Fragmenter:
         self.broadcast_row_limit = broadcast_row_limit
         self.metadata = metadata
         self.fragments: List[PlanFragment] = []
+        self._stats_calculator = None  # one memoized derivation per query
 
     def fragment(self, root: OutputNode) -> DistributedPlan:
         node, child_frags = self._visit(root.source)
@@ -128,7 +129,8 @@ class Fragmenter:
         partial: PlanNode = SortNode(src, node.sort_keys)
         if limit is not None:
             partial = LimitNode(partial, limit)   # TopN fuses per task
-        fid = self._source_fragment(partial, consumed, ("single", ()))
+        fid = self._source_fragment(partial, consumed, ("single", ()),
+                                    check=src)
         merge = RemoteMergeNode((fid,), node.sort_keys,
                                 tuple(node.columns), limit)
         return merge, [fid]
@@ -152,6 +154,9 @@ class Fragmenter:
                 # an inner LIMIT replicated into N tasks would emit up
                 # to N*limit rows
                 return False
+            elif isinstance(n, RemoteMergeNode):
+                # an ordered merge (possibly limited) must run once
+                return False
             elif isinstance(n, ValuesNode):
                 return False
             elif isinstance(n, JoinNode) and (n.kind == "cross"
@@ -162,11 +167,21 @@ class Fragmenter:
 
     def _source_fragment(self, node: PlanNode,
                          consumed: Sequence[int],
-                         output: Tuple[str, Tuple[int, ...]]) -> int:
+                         output: Tuple[str, Tuple[int, ...]],
+                         check: Optional[PlanNode] = None) -> int:
         """Cut ``node`` into its own fragment.  Fragments containing a
         table scan are 'source'-partitioned (split-driven); fragments fed
-        only by exchanges are 'hash'-partitioned."""
-        part = "source" if _has_scan(node) else "hash"
+        only by exchanges are 'hash'-partitioned.  A subtree that cannot
+        be replicated into N tasks without changing results (cross join,
+        inner LIMIT, VALUES, scalar-subquery guard...) runs as a
+        'single'-task fragment — its output exchange still routes
+        normally.  ``check`` overrides which subtree the safety test sees
+        (a partial-aggregation wrapper is safe even when the bare partial
+        node would not be)."""
+        if not self._parallel_safe(check if check is not None else node):
+            part = "single"
+        else:
+            part = "source" if _has_scan(node) else "hash"
         return self._add(node, part, output, consumed)
 
     def _visit_aggregation(self, node: AggregationNode):
@@ -196,7 +211,7 @@ class Fragmenter:
             out = ("hash", tuple(range(ngroups)))
         else:
             out = ("single", ())
-        fid = self._source_fragment(partial, consumed, out)
+        fid = self._source_fragment(partial, consumed, out, check=src)
         remote = RemoteSourceNode((fid,), tuple(comp_cols))
         final = AggregationNode(remote, tuple(range(ngroups)),
                                 node.aggregates, node.columns, step="final")
@@ -205,8 +220,12 @@ class Fragmenter:
     def _estimate_rows(self, node: PlanNode) -> float:
         try:
             from presto_tpu.sql.optimizer import _estimate_rows
+            from presto_tpu.sql.stats import StatsCalculator
 
-            return _estimate_rows(node, self.metadata)
+            if self._stats_calculator is None:
+                self._stats_calculator = StatsCalculator(self.metadata)
+            return _estimate_rows(node, self.metadata,
+                                  self._stats_calculator)
         except Exception:
             return float("inf")
 

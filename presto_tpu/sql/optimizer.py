@@ -249,28 +249,29 @@ def _cross_leaves(node: PlanNode) -> List[PlanNode]:
     return [node]
 
 
-def _estimate_rows(node: PlanNode, metadata) -> float:
-    if isinstance(node, TableScanNode) and metadata is not None:
-        try:
-            _, _, conn, _ = metadata.resolve_table((node.catalog,
-                                                    node.table))
-            handle = conn.get_table(node.table)
-            stats = conn.table_statistics(handle)
-            if stats is not None and getattr(stats, "row_count", None):
-                return float(stats.row_count)
-        except Exception:
-            pass
+def _estimate_rows(node: PlanNode, metadata,
+                   calculator=None) -> float:
+    """Stats-driven row estimate (the StatsCalculator entry used by join
+    ordering and the fragmenter's distribution choice); heuristic
+    fallbacks apply only where the derivation reports unknown."""
+    from presto_tpu.sql.stats import StatsCalculator
+
+    sc = calculator or StatsCalculator(metadata)
+    rc = sc.stats(node).row_count
+    if rc is not None:
+        return rc
+    if isinstance(node, TableScanNode):
         return 1e6
     if isinstance(node, (FilterNode, ProjectNode, LimitNode, SortNode)):
-        return _estimate_rows(node.sources[0], metadata) * (
+        return _estimate_rows(node.sources[0], metadata, sc) * (
             0.3 if isinstance(node, FilterNode) else 1.0)
     if isinstance(node, AggregationNode):
-        return _estimate_rows(node.sources[0], metadata) * 0.1
+        return _estimate_rows(node.sources[0], metadata, sc) * 0.1
     if isinstance(node, JoinNode):
-        return max(_estimate_rows(node.left, metadata),
-                   _estimate_rows(node.right, metadata))
+        return max(_estimate_rows(node.left, metadata, sc),
+                   _estimate_rows(node.right, metadata, sc))
     if isinstance(node, SemiJoinNode):
-        return _estimate_rows(node.sources[0], metadata)
+        return _estimate_rows(node.sources[0], metadata, sc)
     if isinstance(node, EnforceSingleRowNode):
         return 1.0
     return 1e4
@@ -351,9 +352,14 @@ def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
     for leaf, preds in zip(leaves, pushed):
         nodes.append(FilterNode(leaf, and_all(preds)) if preds else leaf)
 
-    # greedy left-deep order: start at the largest relation (probe side),
-    # join connected relations build-side (the broadcast-join shape)
-    sizes = [_estimate_rows(n, metadata) for n in nodes]
+    # greedy left-deep order: start at the largest relation (probe side);
+    # at each step join the connected relation whose join yields the
+    # SMALLEST estimated intermediate (the ReorderJoins cost objective,
+    # evaluated through the stats derivation instead of leaf sizes alone)
+    from presto_tpu.sql.stats import StatsCalculator
+
+    sc = StatsCalculator(metadata)
+    sizes = [_estimate_rows(n, metadata, sc) for n in nodes]
     remaining = set(range(len(nodes)))
     start = max(remaining, key=lambda i: sizes[i])
     joined = [start]
@@ -365,10 +371,28 @@ def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
     used_edges = [False] * len(edges)
     pending_residual = list(residual)
 
+    def candidate_keys(nxt: int
+                       ) -> Tuple[List[int], List[int], List[int]]:
+        """Join keys (and their edge indices) connecting the joined
+        prefix to ``nxt`` — the ONE source of truth for both costing a
+        candidate and building the chosen join."""
+        lks: List[int] = []
+        rks: List[int] = []
+        eis: List[int] = []
+        for i, (la, ca, lb, cb) in enumerate(edges):
+            if used_edges[i]:
+                continue
+            if la in joined and lb == nxt:
+                lks.append(chan_map[(la, ca)])
+                rks.append(cb)
+                eis.append(i)
+            elif lb in joined and la == nxt:
+                lks.append(chan_map[(lb, cb)])
+                rks.append(ca)
+                eis.append(i)
+        return lks, rks, eis
+
     def connected() -> Optional[int]:
-        # among relations connected to the joined prefix, take the
-        # smallest estimate first (build small hash tables early, the
-        # DetermineJoinDistributionType/ReorderJoins cost intuition)
         candidates = set()
         for i, (la, _, lb, _) in enumerate(edges):
             if used_edges[i]:
@@ -378,7 +402,15 @@ def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
             if lb in joined and la in remaining:
                 candidates.add(la)
         if candidates:
-            return min(candidates, key=lambda i: sizes[i])
+            def join_cost(i: int) -> Tuple[float, float]:
+                lks, rks, _ = candidate_keys(i)
+                cols = current.columns + nodes[i].columns
+                kind = "inner" if lks else "cross"
+                probe = JoinNode(kind, current, nodes[i],
+                                 tuple(lks), tuple(rks), cols)
+                return (_estimate_rows(probe, metadata, sc), sizes[i])
+
+            return min(candidates, key=join_cost)
         return next(iter(remaining)) if remaining else None
 
     while remaining:
@@ -386,20 +418,9 @@ def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
         if nxt is None:
             break
         nxt_node = nodes[nxt]
-        left_keys: List[int] = []
-        right_keys: List[int] = []
-        extra_eq: List[Tuple[int, int]] = []  # both keys already joined
-        for i, (la, ca, lb, cb) in enumerate(edges):
-            if used_edges[i]:
-                continue
-            if la in joined and lb == nxt:
-                left_keys.append(chan_map[(la, ca)])
-                right_keys.append(cb)
-                used_edges[i] = True
-            elif lb in joined and la == nxt:
-                left_keys.append(chan_map[(lb, cb)])
-                right_keys.append(ca)
-                used_edges[i] = True
+        left_keys, right_keys, edge_idx = candidate_keys(nxt)
+        for i in edge_idx:
+            used_edges[i] = True
         base = len(current.columns)
         cols = current.columns + nxt_node.columns
         if left_keys:

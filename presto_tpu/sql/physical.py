@@ -245,48 +245,8 @@ class PhysicalPlanner:
         chain, splits = self._lower(node.source)
         input_types = [t for _, t in node.source.columns]
 
-        # pre-projection for sumsq components (x*x channels)
-        pre_exprs = [InputRef(i, t) for i, t in enumerate(input_types)]
-        agg_channels: List[AggChannel] = []
-        finalize_specs: List[Tuple[PlanAggregate, List[int]]] = []
-        for agg in node.aggregates:
-            comp_channels: List[int] = []
-            for prim, ctype in agg.spec.components:
-                if agg.channel is None:
-                    agg_channels.append(AggChannel("count", None, ctype))
-                    comp_channels.append(len(agg_channels) - 1)
-                    continue
-                in_ref = InputRef(agg.channel, input_types[agg.channel])
-                if prim == "sumsq":
-                    sq = B.call("multiply", in_ref, in_ref)
-                    pre_exprs.append(_coerce_to(sq, ctype))
-                    ch = len(pre_exprs) - 1
-                    agg_channels.append(AggChannel("sum", ch, ctype))
-                elif prim in ("sum", "min", "max", "count"):
-                    arg = in_ref
-                    if prim == "sum" and arg.type != ctype:
-                        pre_exprs.append(_coerce_to(arg, ctype))
-                        ch = len(pre_exprs) - 1
-                    else:
-                        ch = agg.channel
-                    agg_channels.append(AggChannel(prim, ch, ctype))
-                elif prim in ("collect", "hll"):
-                    agg_channels.append(
-                        AggChannel(prim, agg.channel, ctype))
-                elif prim == "sumln":
-                    ln = B.call("ln", _coerce_to(in_ref, T.DOUBLE))
-                    pre_exprs.append(ln)
-                    agg_channels.append(
-                        AggChannel("sum", len(pre_exprs) - 1, ctype))
-                elif prim == "sumhash":
-                    h = B.call("hash64", in_ref)
-                    pre_exprs.append(h)
-                    agg_channels.append(
-                        AggChannel("sum", len(pre_exprs) - 1, ctype))
-                else:
-                    raise NotImplementedError(f"agg component {prim}")
-                comp_channels.append(len(agg_channels) - 1)
-            finalize_specs.append((agg, comp_channels))
+        pre_exprs, agg_channels, finalize_specs = decompose_aggregates(
+            node.aggregates, input_types)
 
         needs_pre = len(pre_exprs) > len(input_types)
         if needs_pre:
@@ -338,17 +298,8 @@ class PhysicalPlanner:
         chain, splits = self._lower(node.source)
         input_types = [t for _, t in node.source.columns]
         ngroups = len(node.group_channels)
-        agg_channels: List[AggChannel] = []
-        finalize_specs: List[Tuple[PlanAggregate, List[int]]] = []
-        comp_ch = ngroups
-        for agg in node.aggregates:
-            comp_channels: List[int] = []
-            for prim, ctype in agg.spec.components:
-                merge = self._FINAL_PRIM[prim if prim != "sumsq" else "sum"]
-                agg_channels.append(AggChannel(merge, comp_ch, ctype))
-                comp_channels.append(len(agg_channels) - 1)
-                comp_ch += 1
-            finalize_specs.append((agg, comp_channels))
+        agg_channels, finalize_specs = merge_agg_channels(
+            node.aggregates, ngroups)
 
         if ngroups:
             chain.append(HashAggregationOperatorFactory(
@@ -487,6 +438,78 @@ def _coerce_to(expr: RowExpression, typ: T.Type) -> RowExpression:
     if expr.type == typ:
         return expr
     return B.cast(expr, typ)
+
+
+def decompose_aggregates(aggregates: Sequence[PlanAggregate],
+                         input_types: Sequence[T.Type]):
+    """Aggregate specs -> primitive channels (the AccumulatorCompiler
+    decomposition, shared by the operator and mesh lowerings).
+
+    Returns (pre_exprs, agg_channels, finalize_specs): ``pre_exprs`` is the
+    pre-projection (identity refs plus any derived channels such as x*x for
+    sumsq); a pre-projection is needed iff len(pre_exprs) > len(input_types).
+    """
+    pre_exprs: List[RowExpression] = [
+        InputRef(i, t) for i, t in enumerate(input_types)]
+    agg_channels: List[AggChannel] = []
+    finalize_specs: List[Tuple[PlanAggregate, List[int]]] = []
+    for agg in aggregates:
+        comp_channels: List[int] = []
+        for prim, ctype in agg.spec.components:
+            if agg.channel is None:
+                agg_channels.append(AggChannel("count", None, ctype))
+                comp_channels.append(len(agg_channels) - 1)
+                continue
+            in_ref = InputRef(agg.channel, input_types[agg.channel])
+            if prim == "sumsq":
+                sq = B.call("multiply", in_ref, in_ref)
+                pre_exprs.append(_coerce_to(sq, ctype))
+                ch = len(pre_exprs) - 1
+                agg_channels.append(AggChannel("sum", ch, ctype))
+            elif prim in ("sum", "min", "max", "count"):
+                arg = in_ref
+                if prim == "sum" and arg.type != ctype:
+                    pre_exprs.append(_coerce_to(arg, ctype))
+                    ch = len(pre_exprs) - 1
+                else:
+                    ch = agg.channel
+                agg_channels.append(AggChannel(prim, ch, ctype))
+            elif prim in ("collect", "hll"):
+                agg_channels.append(
+                    AggChannel(prim, agg.channel, ctype))
+            elif prim == "sumln":
+                ln = B.call("ln", _coerce_to(in_ref, T.DOUBLE))
+                pre_exprs.append(ln)
+                agg_channels.append(
+                    AggChannel("sum", len(pre_exprs) - 1, ctype))
+            elif prim == "sumhash":
+                h = B.call("hash64", in_ref)
+                pre_exprs.append(h)
+                agg_channels.append(
+                    AggChannel("sum", len(pre_exprs) - 1, ctype))
+            else:
+                raise NotImplementedError(f"agg component {prim}")
+            comp_channels.append(len(agg_channels) - 1)
+        finalize_specs.append((agg, comp_channels))
+    return pre_exprs, agg_channels, finalize_specs
+
+
+def merge_agg_channels(aggregates: Sequence[PlanAggregate], ngroups: int):
+    """FINAL-step channels: re-aggregate each partial component with its
+    merge primitive (HashAggregationOperator.Step:61 role)."""
+    agg_channels: List[AggChannel] = []
+    finalize_specs: List[Tuple[PlanAggregate, List[int]]] = []
+    comp_ch = ngroups
+    for agg in aggregates:
+        comp_channels: List[int] = []
+        for prim, ctype in agg.spec.components:
+            merge = PhysicalPlanner._FINAL_PRIM[
+                prim if prim != "sumsq" else "sum"]
+            agg_channels.append(AggChannel(merge, comp_ch, ctype))
+            comp_channels.append(len(agg_channels) - 1)
+            comp_ch += 1
+        finalize_specs.append((agg, comp_channels))
+    return agg_channels, finalize_specs
 
 
 def _finalize(agg: PlanAggregate, comps: List[RowExpression]

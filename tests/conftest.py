@@ -19,8 +19,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "collective_call_terminate_timeout" not in _flags:
+    # single-core hosts run the 8 virtual devices' shards sequentially;
+    # XLA's default 40s collective-rendezvous abort is too eager for
+    # the larger mesh-SQL programs (the wait is progress, not deadlock)
+    _flags = (_flags
+              + " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+os.environ["XLA_FLAGS"] = _flags
 
 # The sitecustomize hook may already have switched jax_platforms to the
 # axon TPU plugin; switch back before any backend initializes.

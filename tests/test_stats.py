@@ -1,0 +1,152 @@
+"""Cost-based-optimizer tests: stats derivation, selectivity, the
+broadcast-vs-partitioned distribution flip, and stats-driven join order
+(cost/FilterStatsCalculator.java, iterative/rule/
+DetermineJoinDistributionType.java:50, ReorderJoins analogues)."""
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+from presto_tpu.server.fragmenter import Fragmenter
+from presto_tpu.sql.optimizer import optimize
+from presto_tpu.sql.parser import parse_statement
+from presto_tpu.sql.plan import JoinNode, TableScanNode
+from presto_tpu.sql.planner import Planner
+from presto_tpu.sql.stats import StatsCalculator
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=1.0)   # stats are analytic: no data
+
+
+def _plan(runner, sql):
+    stmt = parse_statement(sql)
+    logical = Planner(runner.metadata).plan(stmt)
+    return optimize(logical, runner.metadata)
+
+
+def _fragment(runner, sql):
+    return Fragmenter(metadata=runner.metadata).fragment(
+        _plan(runner, sql))
+
+
+def test_scan_stats(runner):
+    plan = _plan(runner, "select o_orderkey, o_orderdate from orders")
+    sc = StatsCalculator(runner.metadata)
+    st = sc.stats(plan.source)
+    assert st.row_count == pytest.approx(1_500_000)
+
+
+def test_range_filter_selectivity(runner):
+    # ~one year out of the ~6.5-year o_orderdate domain
+    plan = _plan(runner, "select o_orderkey from orders "
+                         "where o_orderdate >= date '1997-01-01' "
+                         "and o_orderdate < date '1998-01-01'")
+    sc = StatsCalculator(runner.metadata)
+    rc = sc.stats(plan.source).row_count
+    assert 130_000 < rc < 320_000, rc
+
+
+def test_equality_selectivity_uses_ndv(runner):
+    plan = _plan(runner, "select c_custkey from customer "
+                         "where c_mktsegment = 'BUILDING'")
+    sc = StatsCalculator(runner.metadata)
+    rc = sc.stats(plan.source).row_count
+    # 5 segments -> 1/5 of 150k
+    assert rc == pytest.approx(30_000, rel=0.01)
+
+
+def test_join_output_uses_key_ndv(runner):
+    plan = _plan(runner, "select count(*) from customer "
+                         "join orders on c_custkey = o_custkey")
+    sc = StatsCalculator(runner.metadata)
+
+    def find_join(node):
+        if isinstance(node, JoinNode):
+            return node
+        for s in node.sources:
+            j = find_join(s)
+            if j is not None:
+                return j
+        return None
+
+    join = find_join(plan)
+    rc = sc.stats(join).row_count
+    # every order matches exactly one customer -> ~|orders|
+    assert 1_000_000 < rc < 2_500_000, rc
+
+
+def test_filtered_table_flips_to_broadcast(runner):
+    """A large build side qualifies for broadcast once its FILTERED
+    cardinality is small (the VERDICT round-2 finding: the decision must
+    use post-filter stats, not the raw connector row count)."""
+    big = ("select count(*) from lineitem "
+           "join orders on l_orderkey = o_orderkey")
+    filtered = ("select count(*) from lineitem l join "
+                "(select o_orderkey from orders where "
+                "o_orderkey < 300) o on l.l_orderkey = o.o_orderkey")
+    frags_big = _fragment(runner, big).fragments
+    frags_filt = _fragment(runner, filtered).fragments
+    kinds_big = {f.output_partitioning[0] for f in frags_big}
+    kinds_filt = {f.output_partitioning[0] for f in frags_filt}
+    assert "broadcast" not in kinds_big        # 1.5M-row build: hash-hash
+    assert "broadcast" in kinds_filt           # ~300-row build: broadcast
+
+
+def test_cache_does_not_alias_recycled_ids(runner):
+    """Throwaway probe nodes at recycled object addresses must not
+    inherit a previous node's memoized stats."""
+    import dataclasses
+
+    plan = _plan(runner, "select count(*) from customer "
+                         "join orders on c_custkey = o_custkey")
+
+    def find(node):
+        if isinstance(node, JoinNode):
+            return node
+        for s in node.sources:
+            j = find(s)
+            if j is not None:
+                return j
+
+    join = find(plan)
+    sc = StatsCalculator(runner.metadata)
+    a = dataclasses.replace(join)
+    inner_rc = sc.stats(a).row_count
+    del a  # free the address so CPython may recycle it
+    b = dataclasses.replace(join, kind="cross", left_keys=(),
+                            right_keys=())
+    cross_rc = sc.stats(b).row_count
+    assert cross_rc > inner_rc * 10, (inner_rc, cross_rc)
+
+
+def test_join_order_smallest_intermediate_first(runner):
+    """Q9-style chain: greedy order joins the most selective edge first.
+    lineitem x (part filtered to ~1/25 by brand) must join part before
+    the unfiltered orders relation."""
+    sql = ("select count(*) from lineitem, orders, part "
+           "where l_orderkey = o_orderkey and l_partkey = p_partkey "
+           "and p_brand = 'Brand#11'")
+    plan = _plan(runner, sql)
+
+    order = []
+
+    def walk(node):
+        if isinstance(node, JoinNode):
+            walk(node.left)
+            order.append(node)
+            return
+        for s in node.sources:
+            walk(s)
+
+    walk(plan)
+    # the first (innermost) join's build side must reach the part scan
+    def scans(node, acc):
+        if isinstance(node, TableScanNode):
+            acc.append(node.table)
+        for s in node.sources:
+            scans(s, acc)
+        return acc
+
+    first_build = scans(order[0].right, [])
+    assert first_build == ["part"], first_build
