@@ -53,11 +53,16 @@ class Dictionary:
     stable), so sharing is safe.
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_lock")
 
     def __init__(self, values: Sequence[str] = ()):  # noqa: D401
+        import threading
+
         self.values: List[str] = list(values)
         self._index = {v: i for i, v in enumerate(self.values)}
+        # concurrent feed drivers (LocalExchange tier) may intern into a
+        # shared dictionary; appends must stay code-stable
+        self._lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self.values)
@@ -68,9 +73,12 @@ class Dictionary:
     def intern(self, value: str) -> int:
         code = self._index.get(value)
         if code is None:
-            code = len(self.values)
-            self.values.append(value)
-            self._index[value] = code
+            with self._lock:
+                code = self._index.get(value)
+                if code is None:
+                    code = len(self.values)
+                    self.values.append(value)
+                    self._index[value] = code
         return code
 
     def intern_many(self, values: Iterable[str]) -> np.ndarray:

@@ -14,6 +14,7 @@ get_output/add_input call, which is what EXPLAIN ANALYZE renders.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -34,11 +35,19 @@ class MemoryContext:
         self.limit = limit
         self.reserved = 0
         self.peak = 0
+        self._tree_lock = (parent._tree_lock if parent is not None
+                           else threading.Lock())
 
     def reserve(self, bytes_: int) -> None:
         self.set_bytes(self.reserved + bytes_)
 
     def set_bytes(self, bytes_: int) -> None:
+        # one lock per reservation TREE (root-owned): concurrent feed
+        # drivers of one task serialize, unrelated queries do not
+        with self._tree_lock:
+            self._set_bytes_locked(bytes_)
+
+    def _set_bytes_locked(self, bytes_: int) -> None:
         delta = bytes_ - self.reserved
         node = self
         while node is not None:

@@ -199,6 +199,8 @@ class DynamicFilterOperator(Operator):
 
 
 class DynamicFilterOperatorFactory(OperatorFactory):
+    parallel_safe = True
+
     def __init__(self, dyn: DynamicFilter, key_channels: Sequence[int]):
         self.dyn = dyn
         self.key_channels = list(key_channels)

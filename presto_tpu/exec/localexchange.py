@@ -1,0 +1,125 @@
+"""Intra-task driver parallelism: N scan-feed drivers, one consumer.
+
+The reference runs several drivers per pipeline and stitches them with
+LocalExchange (presto-main/.../operator/exchange/LocalExchange.java:53),
+planned by AddLocalExchanges (sql/planner/optimizations/
+AddLocalExchanges.java:95).  On TPU the kernels are internally parallel,
+so the win is HOST-side: several drivers pull splits, decode pages, and
+queue device work concurrently while the consumer chain drains — the
+scan feed no longer starves the accumulating operator between batches.
+
+``LocalExchange`` is a bounded rendezvous (backpressure both ways):
+producers block when the buffer is full (OutputBufferMemoryManager role),
+the consumer waits briefly when it is empty.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, List, Optional
+
+from presto_tpu.batch import Batch
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.operator import Operator, OperatorFactory
+
+
+class LocalExchange:
+    def __init__(self, n_producers: int, capacity: int = 16):
+        self._batches: Deque[Batch] = deque()
+        self._remaining = n_producers
+        self._capacity = capacity
+        self._error: Optional[BaseException] = None
+        self._cond = threading.Condition()
+
+    def put(self, batch: Batch) -> None:
+        with self._cond:
+            while (len(self._batches) >= self._capacity
+                   and self._error is None):
+                self._cond.wait(timeout=1.0)
+            if self._error is not None:
+                raise self._error
+            self._batches.append(batch)
+            self._cond.notify_all()
+
+    def producer_finished(self) -> None:
+        with self._cond:
+            self._remaining -= 1
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cond:
+            if self._error is None:
+                self._error = exc
+            self._cond.notify_all()
+
+    def poll(self, wait_s: float = 0.005) -> Optional[Batch]:
+        """One batch, or None; raises a producer's error."""
+        with self._cond:
+            if self._error is not None:
+                raise self._error
+            if not self._batches and self._remaining > 0:
+                self._cond.wait(timeout=wait_s)
+            if self._error is not None:
+                raise self._error
+            if self._batches:
+                out = self._batches.popleft()
+                self._cond.notify_all()
+                return out
+            return None
+
+    def drained(self) -> bool:
+        with self._cond:
+            return self._remaining == 0 and not self._batches
+
+
+class LocalExchangeSinkOperator(Operator):
+    def __init__(self, ctx: OperatorContext, exchange: LocalExchange):
+        super().__init__(ctx)
+        self.exchange = exchange
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_rows += batch.num_rows
+        self.exchange.put(batch)
+
+    def finish(self) -> None:
+        if not self._finishing:
+            self.exchange.producer_finished()
+        super().finish()
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class LocalExchangeSinkOperatorFactory(OperatorFactory):
+    def __init__(self, exchange: LocalExchange):
+        self.exchange = exchange
+
+    def create(self, ctx: OperatorContext) -> LocalExchangeSinkOperator:
+        return LocalExchangeSinkOperator(ctx, self.exchange)
+
+
+class LocalExchangeSourceOperator(Operator):
+    def __init__(self, ctx: OperatorContext, exchange: LocalExchange):
+        super().__init__(ctx)
+        self.exchange = exchange
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[Batch]:
+        batch = self.exchange.poll()
+        if batch is not None:
+            self.ctx.stats.output_rows += batch.num_rows
+        return batch
+
+    def is_finished(self) -> bool:
+        return self.exchange.drained()
+
+
+class LocalExchangeSourceOperatorFactory(OperatorFactory):
+    def __init__(self, exchange: LocalExchange):
+        self.exchange = exchange
+
+    def create(self, ctx: OperatorContext) -> LocalExchangeSourceOperator:
+        return LocalExchangeSourceOperator(ctx, self.exchange)

@@ -58,7 +58,14 @@ class Operator:
 
 class OperatorFactory:
     """Creates per-driver Operator instances
-    (reference OperatorFactory; duplicated per driver for parallelism)."""
+    (reference OperatorFactory; duplicated per driver for parallelism).
+
+    ``parallel_safe`` marks row-local factories (scan, filter/project,
+    unnest, dynamic filter) whose operators may replicate into N
+    concurrent feed drivers without changing results — the
+    AddLocalExchanges eligibility bit."""
+
+    parallel_safe = False
 
     def create(self, ctx: OperatorContext) -> Operator:
         raise NotImplementedError

@@ -72,6 +72,8 @@ class TableScanOperator(SourceOperator):
 
 
 class TableScanOperatorFactory(OperatorFactory):
+    parallel_safe = True
+
     def __init__(self, connector: Connector, columns: Sequence[str],
                  batch_rows: int = 65536, to_device: bool = True):
         self.connector = connector
@@ -261,6 +263,8 @@ class FilterProjectOperator(Operator):
 
 
 class FilterProjectOperatorFactory(OperatorFactory):
+    parallel_safe = True
+
     def __init__(self, filter_expr: Optional[RowExpression],
                  projections: Sequence[RowExpression],
                  input_types: Sequence[T.Type]):

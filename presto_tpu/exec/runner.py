@@ -4,15 +4,80 @@ The reference's LocalQueryRunner plans SQL then hand-pumps drivers in one
 process (presto-main/.../testing/LocalQueryRunner.java:214,616-665).  This
 module is the pumping half: it executes a DAG of Pipelines in dependency
 order.  The SQL half (sql/ package) lowers plans into these pipelines.
+
+Multi-split pipelines whose leading operators are parallel-safe run as
+``config.task_concurrency`` concurrent feed drivers stitched to the rest
+of the chain through a LocalExchange (the reference's
+AddLocalExchanges.java:95 + LocalExchange.java:53 shape) — host-side scan
+decode overlaps the consumer's device work.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Sequence
 
 from presto_tpu.config import DEFAULT, EngineConfig
 from presto_tpu.exec.context import QueryContext, TaskContext
 from presto_tpu.exec.driver import Pipeline
+
+
+def _parallel_prefix(p: Pipeline, config: EngineConfig) -> int:
+    """Length of the leading factory run that may replicate into N
+    drivers (0 = run the pipeline single-driver)."""
+    if config.task_concurrency <= 1 or len(p.splits) <= 1:
+        return 0
+    k = 0
+    for f in p.factories:
+        if getattr(f, "parallel_safe", False):
+            k += 1
+        else:
+            break
+    # the whole chain being safe means there is no consumer stage left
+    # to protect — still split before the terminal sink
+    return min(k, len(p.factories) - 1)
+
+
+def _run_parallel(p: Pipeline, task: TaskContext, prefix: int,
+                  width: int) -> None:
+    from presto_tpu.exec.localexchange import (
+        LocalExchange, LocalExchangeSinkOperatorFactory,
+        LocalExchangeSourceOperatorFactory,
+    )
+
+    exchange = LocalExchange(width)
+    errors: List[BaseException] = []
+
+    def feed(i: int) -> None:
+        feeder = Pipeline(
+            p.factories[:prefix]
+            + [LocalExchangeSinkOperatorFactory(exchange)],
+            p.splits[i::width], name=f"{p.name}.feed{i}")
+        try:
+            feeder.instantiate(task).run_to_completion()
+        except BaseException as e:  # noqa: BLE001 - crossed to consumer
+            errors.append(e)
+            exchange.fail(e)
+
+    threads = [threading.Thread(target=feed, args=(i,), daemon=True,
+                                name=f"{p.name}.feed{i}")
+               for i in range(width)]
+    for t in threads:
+        t.start()
+    consumer = Pipeline(
+        [LocalExchangeSourceOperatorFactory(exchange)]
+        + p.factories[prefix:], name=p.name)
+    try:
+        consumer.instantiate(task).run_to_completion()
+    except BaseException as e:
+        # unblock feeders stuck in put() backpressure, then re-raise
+        exchange.fail(e)
+        raise
+    finally:
+        for t in threads:
+            t.join(timeout=30)
+    if errors:
+        raise errors[0]
 
 
 def execute_pipelines(pipelines: Sequence[Pipeline],
@@ -32,6 +97,11 @@ def execute_pipelines(pipelines: Sequence[Pipeline],
     if on_task_context is not None:
         on_task_context(task)
     for p in pipelines:
-        driver = p.instantiate(task)
-        driver.run_to_completion()
+        prefix = _parallel_prefix(p, config)
+        width = min(config.task_concurrency, len(p.splits))
+        if prefix > 0 and width > 1:
+            _run_parallel(p, task, prefix, width)
+        else:
+            driver = p.instantiate(task)
+            driver.run_to_completion()
     return task

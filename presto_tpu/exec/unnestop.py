@@ -138,6 +138,8 @@ class UnnestOperator(Operator):
 
 
 class UnnestOperatorFactory(OperatorFactory):
+    parallel_safe = True
+
     def __init__(self, replicate_channels: Sequence[int],
                  unnest_channels: Sequence[int], ordinality: bool,
                  outer: bool = False):

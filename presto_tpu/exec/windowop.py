@@ -198,7 +198,8 @@ class WindowOperator(Operator):
             c = data.columns[fn.arg_channels[0]]
             vals, ok = W.framed_minmax(seg, peer, c.values, c.valid,
                                        fn.frame_unit, fn.frame_start,
-                                       fn.frame_end, is_max=(name == "max"))
+                                       fn.frame_end, is_max=(name == "max"),
+                                       lo=lo, hi=hi)
             return Column(rt, vals, ok, c.dictionary)
         raise NotImplementedError(f"window function {name}")
 

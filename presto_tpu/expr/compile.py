@@ -488,10 +488,11 @@ class ExprCompiler:
         value = self.compile(expr.args[0])
         items = expr.args[1:]
         if value.type.is_dictionary:
-            consts = {str(i.value) for i in items
-                      if isinstance(i, Constant) and i.value is not None}
-            if len(consts) != len(items):
+            if not all(isinstance(i, Constant) and i.value is not None
+                       for i in items):
                 raise NotImplementedError("IN over non-constant string list")
+            # a set: duplicate literals in the IN list are legal SQL
+            consts = {str(i.value) for i in items}
             lookup_np = np.asarray(
                 [e in consts for e in value.dictionary.values], dtype=bool)
 

@@ -250,15 +250,67 @@ def framed_sum_count(seg: Array, values: Array, valid: Optional[Array],
     return s, c
 
 
+def framed_minmax_range(values: Array, valid: Optional[Array],
+                        lo: Array, hi: Array, is_max: bool
+                        ) -> Tuple[Array, Array]:
+    """min/max over arbitrary [lo, hi] frames (bounded ``N PRECEDING``
+    starts included) via a doubling sparse table: level k holds the
+    extremum of each 2^k-wide window, and a query covers [lo, hi] with
+    two overlapping power-of-two windows — O(n log n) build of purely
+    elementwise mins, O(1) gathers per row; the TPU shape of a
+    range-extremum query (no per-row loops).
+
+    ``lo``/``hi`` must already be clipped to partition bounds (as
+    frame_ends produces), so queries never straddle partitions."""
+    n = values.shape[0]
+    info = (jnp.finfo if jnp.issubdtype(values.dtype, jnp.floating)
+            else jnp.iinfo)
+    sentinel = info(values.dtype).min if is_max else info(values.dtype).max
+    ok = jnp.ones(n, jnp.bool_) if valid is None else valid
+    masked = jnp.where(ok, values, jnp.asarray(sentinel, values.dtype))
+    op = jnp.maximum if is_max else jnp.minimum
+
+    levels = [masked]
+    counts = [ok.astype(jnp.int32)]
+    width = 1
+    while width < n:
+        prev = levels[-1]
+        pcnt = counts[-1]
+        pad = jnp.full((width,), sentinel, values.dtype)
+        levels.append(op(prev, jnp.concatenate([prev[width:], pad])))
+        counts.append(pcnt + jnp.concatenate(
+            [pcnt[width:], jnp.zeros(width, jnp.int32)]))
+        width *= 2
+    table = jnp.stack(levels)            # [L, n]
+    ctable = jnp.stack(counts)
+
+    length = jnp.maximum(hi - lo + 1, 1)
+    k = (jnp.ceil(jnp.log2(length.astype(jnp.float64) + 0.5))
+         .astype(jnp.int32) - 1)
+    k = jnp.clip(k, 0, len(levels) - 1)  # floor(log2(length))
+    span = jnp.left_shift(jnp.int64(1), k.astype(jnp.int64))
+    a = jnp.clip(lo, 0, n - 1)
+    b = jnp.clip(hi - span + 1, 0, n - 1)
+    out = op(table[k, a], table[k, b])
+    any_ok = (ctable[k, a] + ctable[k, b]) > 0
+    empty = lo > hi
+    return out, any_ok & ~empty
+
+
 def framed_minmax(seg: Array, peer: Array, values: Array,
                   valid: Optional[Array], unit: str, start: str, end: str,
-                  is_max: bool) -> Tuple[Array, Array]:
+                  is_max: bool, lo: Optional[Array] = None,
+                  hi: Optional[Array] = None) -> Tuple[Array, Array]:
     """min/max over frames with an unbounded edge (the common shapes):
     [unbounded_preceding, current|unbounded_following].  Running extremum
-    via segmented cummax/cummin; range frames gather at the peer end."""
+    via segmented cummax/cummin; range frames gather at the peer end.
+    Bounded starts (``N PRECEDING``) route to the sparse-table range
+    query when the caller supplies the frame ends."""
     if start != "unbounded_preceding":
-        raise NotImplementedError(
-            "min/max window requires an UNBOUNDED PRECEDING frame start")
+        if lo is None or hi is None:
+            raise NotImplementedError(
+                "bounded min/max frame requires precomputed frame ends")
+        return framed_minmax_range(values, valid, lo, hi, is_max)
     info = jnp.finfo if jnp.issubdtype(values.dtype, jnp.floating) else jnp.iinfo
     sentinel = info(values.dtype).min if is_max else info(values.dtype).max
     ok = jnp.ones(values.shape[0], jnp.bool_) if valid is None else valid

@@ -149,6 +149,8 @@ class MeshQueryRunner:
         self.config = config
         self.mesh = make_mesh(n_devices)
         self.nparts = n_devices
+        # sql text -> compiled _MeshProgram (trace/compile amortization)
+        self._programs: Dict[str, "_MeshProgram"] = {}
 
     @classmethod
     def tpch(cls, scale: float = 0.01, n_devices: int = 8,
@@ -178,6 +180,17 @@ class MeshQueryRunner:
     def execute(self, sql: str):
         from presto_tpu.localrunner import QueryResult
 
+        cached = self._programs.get(sql)
+        if cached is not None:
+            # repeat query: the compiled SPMD program and device-resident
+            # scan inputs are reused — one dispatch per execution (the
+            # kernel-cache policy applied at whole-query granularity)
+            batch, overflowed = cached.run()
+            if not overflowed:
+                dplan = cached.dplan
+                return QueryResult(dplan.column_names, dplan.column_types,
+                                   batch.to_pylist())
+            del self._programs[sql]
         dplan = self.plan_distributed(sql)
         for frag in dplan.fragments:
             _check_supported(frag.root)
@@ -188,6 +201,7 @@ class MeshQueryRunner:
                                 prepared=prog)
             batch, overflowed = prog.run()
             if not overflowed:
+                self._programs[sql] = prog
                 return QueryResult(dplan.column_names, dplan.column_types,
                                    batch.to_pylist())
             last_err = f"overflow at cap_scale={1 << attempt}"
@@ -210,6 +224,8 @@ class _MeshProgram:
         self.cap_scale = cap_scale
         self.nparts = runner.nparts
         self.config = runner.config
+        self._jitted = None
+        self._args = None
         if prepared is not None:
             # overflow retry: only capacities change — reuse the loaded,
             # sharded scan inputs instead of re-reading every base table
@@ -290,7 +306,10 @@ class _MeshProgram:
 
         root_frag = self.dplan.fragments[self.dplan.root_fragment_id]
         ncols = len(root_frag.root.columns)
-        self._out_meta: List[Tuple[T.Type, Optional[Dictionary]]] = []
+        if self._jitted is None:
+            # _out_meta/_flag_labels are trace-time side effects; cached
+            # re-runs skip the trace and must keep the recorded values
+            self._out_meta: List[Tuple[T.Type, Optional[Dictionary]]] = []
 
         def program(*inputs):
             import jax.numpy as jnp
@@ -321,14 +340,17 @@ class _MeshProgram:
                                    if flags else jnp.zeros(0, bool)))
 
         n_out = 2 * ncols + 4
-        mapped = jax.shard_map(
-            program, mesh=self.runner.mesh,
-            in_specs=tuple(PS(AXIS) for _ in self.inputs),
-            out_specs=tuple(PS(AXIS) for _ in range(n_out)),
-            check_vma=False)
-        args = [jax.device_put(a, row_sharding(self.runner.mesh, 1))
+        if self._jitted is None:
+            mapped = jax.shard_map(
+                program, mesh=self.runner.mesh,
+                in_specs=tuple(PS(AXIS) for _ in self.inputs),
+                out_specs=tuple(PS(AXIS) for _ in range(n_out)),
+                check_vma=False)
+            self._args = [
+                jax.device_put(a, row_sharding(self.runner.mesh, 1))
                 for a in self.inputs]
-        out = jax.jit(mapped)(*args)
+            self._jitted = jax.jit(mapped)
+        out = self._jitted(*self._args)
         out = [np.asarray(a) for a in out]
         of = bool(out[-3].any())
         err = bool(out[-2].any())
@@ -410,10 +432,18 @@ class _MeshProgram:
                            else jnp.ones(t.cap, bool))
             return out
 
-        if kind == "hash":
+        if kind in ("hash", "arbitrary"):
             arrays = col_arrays(table)
-            triples = [self._hash_triple(table.cols[ch]) for ch in channels]
-            dest = partition_of(row_hash(triples), self.nparts)
+            if kind == "hash":
+                triples = [self._hash_triple(table.cols[ch])
+                           for ch in channels]
+                dest = partition_of(row_hash(triples), self.nparts)
+            else:
+                # P3 round-robin: rotate rows across shards for balance
+                # (no key semantics downstream)
+                dest = ((jnp.arange(table.cap)
+                         + jax.lax.axis_index(AXIS))
+                        % self.nparts).astype(jnp.int32)
             recv, n_recv, of = repartition(
                 arrays, table.live, dest,
                 slot_cap=min(table.cap, out_cap), out_cap=out_cap,

@@ -51,6 +51,7 @@ class NodeManager:
         self.nodes: Dict[str, str] = {}       # node_id -> uri
         self.missed: Dict[str, int] = {}
         self.states: Dict[str, str] = {}      # node_id -> reported state
+        self.locations: Dict[str, str] = {}   # node_id -> topology label
         self.max_missed = max_missed
         self.interval_s = interval_s
         self._lock = threading.Lock()
@@ -59,10 +60,37 @@ class NodeManager:
                                         daemon=True, name="failure-detector")
         self._thread.start()
 
-    def announce(self, node_id: str, uri: str) -> None:
+    def announce(self, node_id: str, uri: str,
+                 location: str = "") -> None:
         with self._lock:
             self.nodes[node_id] = uri
             self.missed[node_id] = 0
+            if location:
+                self.locations[node_id] = location
+
+    def topology_ordered(self, nodes: List[Tuple[str, str]]
+                         ) -> List[Tuple[str, str]]:
+        """Round-robin across topology locations (rack labels) so the
+        i-th task of every stage lands in a different failure/bandwidth
+        domain — the TopologyAwareNodeSelector placement role
+        (presto-main/.../scheduler/TopologyAwareNodeSelector.java:50,
+        NetworkTopology).  Nodes without a label form one domain."""
+        with self._lock:
+            locs = dict(self.locations)
+        by_loc: Dict[str, List[Tuple[str, str]]] = {}
+        for nid, uri in nodes:
+            by_loc.setdefault(locs.get(nid, ""), []).append((nid, uri))
+        out: List[Tuple[str, str]] = []
+        queues = [by_loc[k] for k in sorted(by_loc)]
+        i = 0
+        while any(queues):
+            q = queues[i % len(queues)]
+            if q:
+                out.append(q.pop(0))
+            i += 1
+            if i > 10_000:  # defensive
+                break
+        return out
 
     def alive_nodes(self) -> List[Tuple[str, str]]:
         """Schedulable nodes: responsive AND reporting ACTIVE (a
@@ -133,6 +161,9 @@ class QueryExecution:
         self.error: Optional[str] = None
         self.plan_text: str = ""
         self._tasks_scheduled = False
+        # (fragment_id, task_id, worker_uri) per scheduled task — the
+        # stats-fetch targets for distributed EXPLAIN ANALYZE
+        self._placements: List[Tuple[int, str, str]] = []
         self.column_names: List[str] = []
         self.column_types: List[T.Type] = []
         self.result_rows: List[tuple] = []
@@ -170,6 +201,16 @@ class QueryExecution:
                 self._run_procedure(stmt)
                 self.state = "FINISHED"
                 return
+            analyze = False
+            if (isinstance(stmt, t.Explain) and stmt.analyze
+                    and isinstance(stmt.statement,
+                                   (t.Query, t.SetOperation))):
+                # distributed EXPLAIN ANALYZE: run the inner query across
+                # the cluster, then roll task-level operator stats up
+                # into the fragment plan (ExplainAnalyzeOperator.java:34
+                # + stage-stats rollup role)
+                analyze = True
+                stmt = stmt.statement
             if not isinstance(stmt, (t.Query, t.SetOperation)):
                 # DDL/DML/metadata statements run coordinator-side
                 # (the reference's DataDefinitionExecution path,
@@ -190,6 +231,12 @@ class QueryExecution:
 
             self.state = "RUNNING"
             self._drain(root_locations)
+            if analyze:
+                text = self._render_analyze(dplan)
+                self.column_names = ["Query Plan"]
+                self.column_types = [T.VARCHAR]
+                self.result_rows = [(line,)
+                                    for line in text.splitlines()]
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - query failure surface
             # keep a more specific error set by a killer (low-memory,
@@ -225,6 +272,67 @@ class QueryExecution:
                 lines.append("    " + ln)
         return "\n".join(lines)
 
+    def _fetch_task_info(self, task_id: str, wuri: str) -> Dict:
+        req = urllib.request.Request(f"{wuri}/v1/task/{task_id}",
+                                     headers=self._internal_headers())
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def _render_analyze(self, dplan: DistributedPlan) -> str:
+        """Fragment plan + per-operator stats aggregated across each
+        fragment's tasks: rows summed, wall = slowest task (the
+        StageStats/PlanPrinter textDistributedPlan-with-stats role)."""
+        from presto_tpu.sql.plan import format_plan
+
+        lines: List[str] = []
+        header = (f"{'operator':<36} {'tasks':>5} {'in rows':>11} "
+                  f"{'out rows':>11} {'wall ms':>9} {'peak MiB':>9}")
+        for f in dplan.fragments:
+            tasks = [(tid, uri) for fid, tid, uri in self._placements
+                     if fid == f.fragment_id]
+            out_kind, out_ch = f.output_partitioning
+            lines.append(
+                f"Fragment {f.fragment_id} [{f.partitioning}] "
+                f"x{len(tasks)} tasks => output "
+                f"{out_kind}{list(out_ch) if out_ch else ''}")
+            for ln in format_plan(f.root).splitlines():
+                lines.append("    " + ln)
+            # aggregate operator stats by operator NAME: concurrent
+            # feed drivers append stats in nondeterministic order, so
+            # list position is not comparable across tasks
+            agg: Dict[str, Dict] = {}
+            peak = 0
+            n_reporting = 0
+            for tid, uri in tasks:
+                try:
+                    info = self._fetch_task_info(tid, uri)
+                except Exception:  # noqa: BLE001 - worker may be gone
+                    continue
+                stats = info.get("operatorStats") or []
+                peak = max(peak, int(info.get("peakMemory", 0)))
+                if stats:
+                    n_reporting += 1
+                for s in stats:
+                    wall = s["wall_ns"] + s["finish_wall_ns"]
+                    a = agg.get(s["operator"])
+                    if a is None:
+                        a = dict(s)
+                        a["wall_ns"] = wall
+                        agg[s["operator"]] = a
+                    else:
+                        a["input_rows"] += s["input_rows"]
+                        a["output_rows"] += s["output_rows"]
+                        a["wall_ns"] = max(a["wall_ns"], wall)
+            lines.append("    " + header)
+            lines.append("    " + "-" * len(header))
+            for a in agg.values():
+                wall_ms = a["wall_ns"] / 1e6
+                lines.append(
+                    f"    {a['operator']:<36} {n_reporting:>5} "
+                    f"{a['input_rows']:>11} {a['output_rows']:>11} "
+                    f"{wall_ms:>9.1f} {peak / (1 << 20):>9.1f}")
+        return "\n".join(lines)
+
     def _wait_for_workers(self) -> List[Tuple[str, str]]:
         """Block until the minimum cluster size is present or the wait
         expires (ClusterSizeMonitor.java role)."""
@@ -235,7 +343,8 @@ class QueryExecution:
                 raise RuntimeError("Query killed")
             workers = self.co.nodes.alive_nodes()
             if len(workers) >= need:
-                return workers
+                # spread consecutive tasks across topology domains
+                return self.co.nodes.topology_ordered(workers)
             if time.monotonic() >= deadline:
                 raise RuntimeError(
                     f"Insufficient active worker nodes: have "
@@ -321,6 +430,8 @@ class QueryExecution:
                         f"{task_id}: {last_error}")
                 uris.append(
                     f"{wuri}/v1/task/{task_id}/results/{{part}}")
+                self._placements.append(
+                    (frag.fragment_id, task_id, wuri))
             task_uris[frag.fragment_id] = uris
         return [u.format(part=0)
                 for u in task_uris[dplan.root_fragment_id]]
@@ -734,7 +845,8 @@ class CoordinatorServer:
                         return
                     n = int(self.headers.get("Content-Length", 0))
                     ann = json.loads(self.rfile.read(n))
-                    co.nodes.announce(ann["nodeId"], ann["uri"])
+                    co.nodes.announce(ann["nodeId"], ann["uri"],
+                                      ann.get("location", ""))
                     self._json(200, {"ok": True})
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
@@ -856,6 +968,14 @@ class CoordinatorServer:
                 for qid, q in info.get("queries", {}).items():
                     per_query[qid] = per_query.get(qid, 0) + \
                         int(q.get("reserved", 0))
+            # feed group memory usage so soft limits gate new admissions
+            # (InternalResourceGroup soft_memory_limit role)
+            per_user: Dict[str, int] = {}
+            for qid, used in per_query.items():
+                q = self.queries.get(qid)
+                if q is not None:
+                    per_user[q.user] = per_user.get(q.user, 0) + used
+            self.resource_groups.update_memory_usage(per_user)
             if total <= self.cluster_memory_limit_bytes or not per_query:
                 continue
             victim = max(per_query, key=per_query.get)

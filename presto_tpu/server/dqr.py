@@ -77,9 +77,11 @@ class DistributedQueryRunner:
 
         self.workers: List[WorkerServer] = []
         for i in range(n_workers):
+            # two simulated racks: placement spreads tasks across them
             w = WorkerServer(cluster_registry(), config,
                              node_id=f"worker-{i}",
-                             internal_secret=internal_secret)
+                             internal_secret=internal_secret,
+                             location=f"rack{i % 2}")
             self.workers.append(w)
             self._announce(w)
         self.client = StatementClient(self.coordinator.uri)
@@ -89,7 +91,8 @@ class DistributedQueryRunner:
         import urllib.request
 
         body = json.dumps({"nodeId": worker.node_id,
-                           "uri": worker.uri}).encode()
+                           "uri": worker.uri,
+                           "location": worker.location}).encode()
         headers = {"Content-Type": "application/json"}
         if self.internal_secret:
             from presto_tpu.server.security import InternalAuthenticator

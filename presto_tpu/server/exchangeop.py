@@ -94,6 +94,36 @@ class TaskOutputOperator(Operator):
         return self._finishing
 
 
+class RoundRobinOutputOperator(Operator):
+    """P3 (FIXED_ARBITRARY_DISTRIBUTION): whole batches rotate across the
+    consumer partitions for load balance without key semantics — the
+    ArbitraryOutputBuffer/RandomExchanger role
+    (presto-main/.../execution/buffer/ArbitraryOutputBuffer.java:60,
+    operator/exchange/LocalExchange.java:112)."""
+
+    def __init__(self, ctx: OperatorContext, buffers: OutputBufferManager,
+                 n_partitions: int):
+        super().__init__(ctx)
+        self.buffers = buffers
+        self.n = n_partitions
+        self._next = 0
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_rows += batch.num_rows
+        self.buffers.enqueue(self._next % self.n,
+                             serialize_batch(batch.compact()))
+        self._next += 1
+        self.ctx.stats.output_rows += batch.num_rows
+
+    def finish(self) -> None:
+        if not self._finishing:
+            super().finish()
+            self.buffers.set_no_more_pages()
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
 class PartitionedOutputOperatorFactory(OperatorFactory):
     def __init__(self, buffers: OutputBufferManager,
                  channels: Sequence[int], n_partitions: int):
@@ -104,6 +134,16 @@ class PartitionedOutputOperatorFactory(OperatorFactory):
     def create(self, ctx: OperatorContext):
         return PartitionedOutputOperator(ctx, self.buffers, self.channels,
                                          self.n_partitions)
+
+
+class RoundRobinOutputOperatorFactory(OperatorFactory):
+    def __init__(self, buffers: OutputBufferManager, n_partitions: int):
+        self.buffers = buffers
+        self.n_partitions = n_partitions
+
+    def create(self, ctx: OperatorContext):
+        return RoundRobinOutputOperator(ctx, self.buffers,
+                                        self.n_partitions)
 
 
 class TaskOutputOperatorFactory(OperatorFactory):

@@ -99,8 +99,10 @@ class Fragmenter:
         if isinstance(node, LimitNode) and isinstance(node.source,
                                                      SortNode):
             return self._visit_sort(node.source, limit=node.count)
+        if isinstance(node, UnionNode):
+            return self._visit_union(node)
         if isinstance(node, (FilterNode, ProjectNode, LimitNode, SortNode,
-                             WindowNode, EnforceSingleRowNode, UnionNode,
+                             WindowNode, EnforceSingleRowNode,
                              UnnestNode)):
             # stays in the consumer fragment; recurse into sources
             new_sources = []
@@ -112,6 +114,32 @@ class Fragmenter:
             return _replace_sources(node, new_sources), consumed
         # leaves (TableScan, Values) stay put
         return node, []
+
+    def _visit_union(self, node: UnionNode) -> Tuple[PlanNode, List[int]]:
+        """UNION ALL branches with their own scans become source fragments
+        with round-robin ('arbitrary') output — P3, the
+        FIXED_ARBITRARY_DISTRIBUTION / ArbitraryOutputBuffer shape — so
+        each branch's scan parallelizes instead of the whole union
+        running in one task.  Branches without scans stay local."""
+        fids: List[int] = []
+        local_inputs: List[PlanNode] = []
+        consumed: List[int] = []
+        for inp in node.inputs:
+            src, c = self._visit(inp)
+            if _has_scan(src) and self._parallel_safe(src):
+                fid = self._source_fragment(src, c, ("arbitrary", ()))
+                fids.append(fid)
+                consumed.append(fid)
+            else:
+                local_inputs.append(src)
+                consumed += c
+        if not fids:
+            return _replace_sources(node, local_inputs), consumed
+        remote = RemoteSourceNode(tuple(fids), tuple(node.columns))
+        if not local_inputs:
+            return remote, consumed
+        return (UnionNode(tuple([remote] + local_inputs), node.columns),
+                consumed)
 
     def _visit_sort(self, node: SortNode, limit) -> Tuple[PlanNode,
                                                           List[int]]:

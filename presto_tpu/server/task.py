@@ -53,7 +53,14 @@ class SqlTask:
         if kind == "hash" and n_output_partitions > 1:
             sink = PartitionedOutputOperatorFactory(
                 self.buffers, channels, n_output_partitions)
-        else:  # 'single', 'broadcast', or 1-consumer hash
+        elif kind == "arbitrary" and n_output_partitions > 1:
+            from presto_tpu.server.exchangeop import (
+                RoundRobinOutputOperatorFactory,
+            )
+
+            sink = RoundRobinOutputOperatorFactory(
+                self.buffers, n_output_partitions)
+        else:  # 'single', 'broadcast', or 1-consumer output
             sink = TaskOutputOperatorFactory(self.buffers)
         self._pipelines = planner.plan_fragment(fragment.root, sink)
         self._thread = threading.Thread(
@@ -74,8 +81,15 @@ class SqlTask:
             self.buffers.fail(RuntimeError(f"task {self.task_id}: {e}"))
 
     def info(self) -> Dict:
+        """TaskInfo with the per-operator stats rollup the coordinator's
+        distributed EXPLAIN ANALYZE aggregates (TaskStatus + TaskStats,
+        presto-main/.../execution/TaskInfo.java role)."""
+        ctx = self._stats or self._live
+        stats = ([s.as_dict() for s in ctx.operator_stats]
+                 if ctx is not None else [])
         return {"taskId": self.task_id, "state": self.state,
-                "error": self.error}
+                "error": self.error, "operatorStats": stats,
+                "peakMemory": ctx.memory.peak if ctx is not None else 0}
 
     def memory_info(self) -> Dict:
         """Live reservation/peak bytes (MemoryPool per-task view)."""

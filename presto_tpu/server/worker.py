@@ -31,10 +31,14 @@ class WorkerServer:
     def __init__(self, registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT, port: int = 0,
                  node_id: str = "worker",
-                 internal_secret: Optional[str] = None):
+                 internal_secret: Optional[str] = None,
+                 location: str = ""):
         from presto_tpu.server.security import InternalAuthenticator
 
         self.node_id = node_id
+        # topology label (rack/zone) announced to the
+        # coordinator for TopologyAwareNodeSelector placement
+        self.location = location
         self.internal_auth = (InternalAuthenticator(internal_secret)
                               if internal_secret else None)
         self.task_manager = SqlTaskManager(

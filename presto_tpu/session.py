@@ -358,51 +358,139 @@ class QueryQueueFullError(RuntimeError):
     pass
 
 
+class _Ticket:
+    """One queued admission request (ordering handle)."""
+
+    __slots__ = ("seq", "group")
+
+    def __init__(self, seq: int, group: "ResourceGroup"):
+        self.seq = seq
+        self.group = group
+
+
 class ResourceGroup:
     """One node of the admission-control tree
-    (InternalResourceGroup.java:77): bounded running + queued queries,
-    FIFO release.  ``hard_concurrency_limit`` / ``max_queued`` follow the
-    reference's property names."""
+    (InternalResourceGroup.java:77,91,95): bounded running + queued
+    queries, policy-driven release order, and a soft memory limit that
+    stops NEW admissions while the group's tracked usage exceeds it.
+    ``hard_concurrency_limit`` / ``max_queued`` / ``soft_memory_limit`` /
+    ``scheduling_policy`` / ``scheduling_weight`` follow the reference's
+    property names.
+
+    Policies decide which child subtree's waiter runs when a slot frees:
+    - 'fair' (default): the child with the fewest running queries, FIFO
+      within a child (the reference's fair queue);
+    - 'weighted_fair': the child with the lowest running/weight ratio
+      (WeightedFairQueue.java role);
+    - 'query_priority': strict FIFO over every waiter in the subtree.
+    """
 
     def __init__(self, name: str, hard_concurrency_limit: int = 16,
                  max_queued: int = 64,
-                 parent: Optional["ResourceGroup"] = None):
+                 parent: Optional["ResourceGroup"] = None,
+                 scheduling_weight: int = 1,
+                 scheduling_policy: str = "fair",
+                 soft_memory_limit_bytes: Optional[int] = None):
         self.name = name
         self.hard_concurrency_limit = hard_concurrency_limit
         self.max_queued = max_queued
         self.parent = parent
+        self.scheduling_weight = max(int(scheduling_weight), 1)
+        self.scheduling_policy = scheduling_policy
+        self.soft_memory_limit_bytes = soft_memory_limit_bytes
+        self.memory_usage = 0
         self.running = 0
         self.queued = 0
-        self._cond = threading.Condition(
-            parent._cond if parent is not None else threading.Lock())
+        self.children: List["ResourceGroup"] = []
+        self._queue: List[_Ticket] = []   # this group's own waiters, FIFO
+        # ONE condition per tree: a release in any group must be able to
+        # wake a waiter in a sibling (the policy walk decides which)
+        self._cond = (parent._cond if parent is not None
+                      else threading.Condition())
+        if parent is not None:
+            parent.children.append(self)
+        root = self
+        while root.parent is not None:
+            root = root.parent
+        self._root = root
+        if parent is None:
+            self._seq = 0
 
-    def _can_run_locked(self) -> bool:
-        node: Optional[ResourceGroup] = self
-        while node is not None:
-            if node.running >= node.hard_concurrency_limit:
-                return False
-            node = node.parent
+    # -- selection (policy) ---------------------------------------------
+    def _slot_free_locked(self) -> bool:
+        if self.running >= self.hard_concurrency_limit:
+            return False
+        if (self.soft_memory_limit_bytes is not None
+                and self.memory_usage > self.soft_memory_limit_bytes):
+            return False
         return True
 
+    def _select_locked(self) -> Optional[_Ticket]:
+        """The next ticket in this subtree eligible to run, or None."""
+        if not self._slot_free_locked():
+            return None
+        ranked: List[Tuple[float, int, _Ticket]] = []
+        if self._queue:
+            t = self._queue[0]
+            ranked.append((0.0, t.seq, t))
+        for c in self.children:
+            t = c._select_locked()
+            if t is None:
+                continue
+            if self.scheduling_policy == "weighted_fair":
+                # post-admission share: at equal running counts the
+                # higher-weight group is the more under-served one
+                key = (c.running + 1) / c.scheduling_weight
+            elif self.scheduling_policy == "query_priority":
+                key = 0.0        # strict FIFO: sequence decides
+            else:                # fair
+                key = float(c.running)
+            ranked.append((key, t.seq, t))
+        if not ranked:
+            return None
+        return min(ranked)[2]
+
     def acquire(self, timeout_s: Optional[float] = None) -> None:
-        """Block until a run slot frees; raise when the queue is full."""
+        """Block until this group's waiter is chosen by the root's policy
+        walk AND every ancestor has a free slot; raise when the queue is
+        full."""
         with self._cond:
-            if self._can_run_locked():
+            root = self._root
+            if self._chain_free_locked() and root._select_locked() is None:
+                # capacity available and no eligible waiter to barge past
                 self._grab_locked()
                 return
             if self.queued >= self.max_queued:
                 raise QueryQueueFullError(
                     f"Too many queued queries for {self.name!r}")
+            root._seq += 1
+            ticket = _Ticket(root._seq, self)
             self.queued += 1
+            self._queue.append(ticket)
             try:
-                ok = self._cond.wait_for(self._can_run_locked,
-                                         timeout=timeout_s)
+                ok = self._cond.wait_for(
+                    lambda: (root._select_locked() is ticket
+                             and self._chain_free_locked()),
+                    timeout=timeout_s)
                 if not ok:
                     raise QueryQueueFullError(
                         f"queue wait timed out for {self.name!r}")
+                self._queue.remove(ticket)
                 self._grab_locked()
+                # another slot may still be free for the next waiter
+                self._cond.notify_all()
             finally:
                 self.queued -= 1
+                if ticket in self._queue:
+                    self._queue.remove(ticket)
+
+    def _chain_free_locked(self) -> bool:
+        node: Optional[ResourceGroup] = self
+        while node is not None:
+            if not node._slot_free_locked():
+                return False
+            node = node.parent
+        return True
 
     def _grab_locked(self) -> None:
         node: Optional[ResourceGroup] = self
@@ -418,15 +506,24 @@ class ResourceGroup:
                 node = node.parent
             self._cond.notify_all()
 
+    def set_memory_usage(self, bytes_: int) -> None:
+        """Feed tracked memory (ClusterMemoryManager assigns query memory
+        to groups); crossing below the soft limit wakes waiters."""
+        with self._cond:
+            self.memory_usage = bytes_
+            self._cond.notify_all()
+
 
 class ResourceGroupManager:
     """Selects the group for a session (the rule-based selector role:
     per-user groups under a root)."""
 
     def __init__(self, hard_concurrency_limit: int = 16,
-                 max_queued: int = 64, per_user_limit: int = 8):
+                 max_queued: int = 64, per_user_limit: int = 8,
+                 scheduling_policy: str = "fair"):
         self.root = ResourceGroup("global", hard_concurrency_limit,
-                                  max_queued)
+                                  max_queued,
+                                  scheduling_policy=scheduling_policy)
         self.per_user_limit = per_user_limit
         self._groups: Dict[str, ResourceGroup] = {}
         self._lock = threading.Lock()
@@ -440,6 +537,25 @@ class ResourceGroupManager:
                                   self.root.max_queued, parent=self.root)
                 self._groups[session.user] = g
             return g
+
+    def configure_group(self, user: str, **kwargs) -> ResourceGroup:
+        """Pre-create / tune a user group (weight, soft memory limit,
+        concurrency) — the DB/file-backed resource-group config role."""
+        with self._lock:
+            g = self._groups.get(user)
+            if g is None:
+                g = ResourceGroup(f"global.{user}", self.per_user_limit,
+                                  self.root.max_queued, parent=self.root)
+                self._groups[user] = g
+        for k, v in kwargs.items():
+            setattr(g, k, v)
+        return g
+
+    def update_memory_usage(self, per_user_bytes: Dict[str, int]) -> None:
+        with self._lock:
+            groups = dict(self._groups)
+        for user, g in groups.items():
+            g.set_memory_usage(per_user_bytes.get(user, 0))
 
 
 # ---------------------------------------------------------------------------

@@ -110,7 +110,12 @@ class PhysicalPlanner:
             conn = self.registry.get(node.catalog)
             handle = conn.get_table(node.table)
             if self.scan_shard is None:
-                splits = conn.get_splits(handle, 1)
+                # enough splits to feed task_concurrency drivers through
+                # the LocalExchange tier (4x for balance, the reference's
+                # split-batch shape)
+                desired = (max(4 * self.config.task_concurrency, 4)
+                           if self.config.task_concurrency > 1 else 1)
+                splits = conn.get_splits(handle, desired)
             else:
                 # deterministic split-modulo placement: every task of a
                 # source stage generates the full split list and keeps its

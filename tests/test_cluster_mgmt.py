@@ -168,3 +168,21 @@ def test_schedule_fails_over_draining_worker():
         got = dqr.execute("SELECT l_returnflag, count(*) FROM lineitem "
                           "GROUP BY l_returnflag ORDER BY 1").rows
         assert [r[0] for r in got] == ["A", "N", "R"]
+
+
+def test_topology_aware_ordering():
+    """Consecutive tasks land in alternating topology domains
+    (TopologyAwareNodeSelector.java:50 role)."""
+    from presto_tpu.server.coordinator import NodeManager
+
+    nm = NodeManager(interval_s=60)
+    try:
+        nm.announce("a1", "uri-a1", "rackA")
+        nm.announce("a2", "uri-a2", "rackA")
+        nm.announce("b1", "uri-b1", "rackB")
+        nm.announce("b2", "uri-b2", "rackB")
+        ordered = nm.topology_ordered(nm.alive_nodes())
+        racks = ["A" if n.startswith("a") else "B" for n, _ in ordered]
+        assert racks == ["A", "B", "A", "B"], ordered
+    finally:
+        nm.close()

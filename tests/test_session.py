@@ -5,6 +5,7 @@ SPI with file-based rules, TransactionManager, InternalResourceGroup
 (SURVEY §2.12, §5.6)."""
 
 import threading
+import time
 
 import pytest
 
@@ -144,3 +145,78 @@ class TestResourceGroups:
         gb.acquire()  # b unaffected by a's per-user limit
         ga.release()
         gb.release()
+
+    def test_weighted_fair_prefers_higher_weight(self):
+        """When one root slot frees with both users waiting, the
+        weighted_fair policy admits the under-served high-weight group
+        (WeightedFairQueue.java role)."""
+        mgr = ResourceGroupManager(hard_concurrency_limit=1,
+                                   per_user_limit=5,
+                                   scheduling_policy="weighted_fair")
+        heavy = mgr.configure_group("heavy", scheduling_weight=10)
+        light = mgr.configure_group("light", scheduling_weight=1)
+        blocker = mgr.group_for(Session(user="blocker"))
+        blocker.acquire()          # occupies the single root slot
+        order = []
+        done = {"light": threading.Event(), "heavy": threading.Event()}
+
+        def waiter(name, g):
+            g.acquire(timeout_s=10)
+            order.append(name)
+            done[name].set()
+
+        # light queues FIRST; weighted_fair must still pick heavy
+        tl = threading.Thread(target=waiter, args=("light", light),
+                              daemon=True)
+        tl.start()
+        time.sleep(0.1)
+        th = threading.Thread(target=waiter, args=("heavy", heavy),
+                              daemon=True)
+        th.start()
+        time.sleep(0.1)
+        blocker.release()
+        assert done["heavy"].wait(5)
+        assert order[0] == "heavy", order
+        heavy.release()
+        assert done["light"].wait(5)
+        light.release()
+
+    def test_fair_policy_fifo_within_group(self):
+        mgr = ResourceGroupManager(hard_concurrency_limit=1,
+                                   per_user_limit=5)
+        g = mgr.group_for(Session(user="u"))
+        g.acquire()
+        order = []
+        evs = [threading.Event() for _ in range(2)]
+
+        def waiter(i):
+            g.acquire(timeout_s=10)
+            order.append(i)
+            evs[i].set()
+
+        for i in range(2):
+            threading.Thread(target=waiter, args=(i,), daemon=True).start()
+            time.sleep(0.1)
+        g.release()
+        assert evs[0].wait(5)
+        assert order[0] == 0, order   # FIFO: first waiter first
+        g.release()
+        assert evs[1].wait(5)
+        g.release()
+
+    def test_soft_memory_limit_gates_admission(self):
+        mgr = ResourceGroupManager(hard_concurrency_limit=10,
+                                   per_user_limit=10)
+        g = mgr.configure_group("u", soft_memory_limit_bytes=1000)
+        g.set_memory_usage(5000)   # over the soft limit
+        admitted = threading.Event()
+
+        def waiter():
+            g.acquire(timeout_s=10)
+            admitted.set()
+
+        threading.Thread(target=waiter, daemon=True).start()
+        assert not admitted.wait(0.3)          # blocked by memory
+        g.set_memory_usage(0)                  # usage drops
+        assert admitted.wait(5)
+        g.release()

@@ -24,10 +24,22 @@ def _close(a, b):
     return a == b
 
 
+
+def _pair_key(r):
+    """Sort key that pairs rows robustly across float summation-order
+    noise: floats participate rounded, so nearly-equal rows sort
+    identically on both sides."""
+    return tuple(
+        (1, round(v, 4)) if isinstance(v, float)
+        else (2, "") if v is None
+        else (0, str(v))
+        for v in r)
+
+
 def assert_same(mesh_result, local_result, ordered=False):
     m, l = mesh_result.rows, local_result.rows
     if not ordered:
-        m, l = sorted(m, key=repr), sorted(l, key=repr)
+        m, l = sorted(m, key=_pair_key), sorted(l, key=_pair_key)
     assert len(m) == len(l), (len(m), len(l))
     for x, y in zip(m, l):
         assert len(x) == len(y), (x, y)
@@ -144,3 +156,10 @@ def test_unsupported_falls_out(runners):
         mesh.execute("select l_returnflag, "
                      "rank() over (order by count(*)) from lineitem "
                      "group by l_returnflag")
+
+
+def test_union_all_distributes(runners):
+    check(runners,
+          "select count(*), sum(x) from ("
+          "select o_totalprice x from orders "
+          "union all select l_extendedprice x from lineitem)")

@@ -1,0 +1,70 @@
+"""TPC-DS conformance: the query suite vs a sqlite3 oracle.
+
+Same rig as the TPC-H conformance tier (presto-testing's H2QueryRunner
+role): the tpcds connector's data is loaded into sqlite, the query text is
+adapted to sqlite's dialect, and results are compared row-for-row with
+float tolerance.  This value-verifies every query in
+``tests/tpcds_queries.py`` including the BASELINE.md pinned Q72/Q95.
+"""
+
+import sqlite3
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+
+from test_tpch_conformance import (
+    _sqlite_type, _to_sqlite, assert_rows_match, to_sqlite_sql,
+)
+from tpcds_queries import QUERIES
+
+SCALE = 0.003
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+@pytest.fixture(scope="module")
+def oracle(runner):
+    conn = sqlite3.connect(":memory:")
+    conn.execute("PRAGMA case_sensitive_like = ON")
+    tpcds = runner.registry.get("tpcds")
+    for table in tpcds.list_tables():
+        handle = tpcds.get_table(table)
+        schema = tpcds.table_schema(handle)
+        names = schema.column_names()
+        cols_sql = ", ".join(f"{n} {_sqlite_type(schema.column_type(n))}"
+                             for n in names)
+        conn.execute(f"create table {table} ({cols_sql})")
+        for split in tpcds.get_splits(handle, 1):
+            for batch in tpcds.page_source(split, names, 1 << 20):
+                rows = [tuple(_to_sqlite(v) for v in r)
+                        for r in batch.to_pylist()]
+                ph = ", ".join("?" * len(names))
+                conn.executemany(
+                    f"insert into {table} values ({ph})", rows)
+        # without indexes sqlite nested-loops the 8-10-way star joins
+        # (Q72 alone runs for hours); index every surrogate key
+        for n in names:
+            if n.endswith("_sk") or n.endswith("_number"):
+                conn.execute(
+                    f"create index idx_{table}_{n} on {table} ({n})")
+    conn.execute("analyze")
+    conn.commit()
+    return conn
+
+
+def _strip_catalog(sql: str) -> str:
+    return sql.replace("tpcds.", "")
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpcds_query(runner, oracle, qnum):
+    sql = QUERIES[qnum]
+    got = runner.execute(sql).rows
+    want = oracle.execute(_strip_catalog(to_sqlite_sql(sql))).fetchall()
+    # sorted-multiset comparison: ORDER BY ties beyond the sort keys make
+    # positional diffs flaky (same policy as the TPC-H tier)
+    assert_rows_match(got, want, ordered=False)

@@ -97,6 +97,10 @@ def to_sqlite_sql(sql: str) -> str:
     sql = _DATE_LIT.sub(lambda m: "'" + m.group(1) + "'", sql)
     sql = re.sub(r"extract\s*\(\s*year\s+from\s+(\w+(?:\.\w+)?)\s*\)",
                  r"cast(substr(\1, 1, 4) as integer)", sql)
+    # date_diff('day', a, b) -> whole-day difference on ISO strings
+    sql = re.sub(
+        r"date_diff\s*\(\s*'day'\s*,\s*([\w.]+)\s*,\s*([\w.]+)\s*\)",
+        r"cast(julianday(\2) - julianday(\1) as integer)", sql)
     # sqlite has no derived-table column alias lists (``as t (a, b)``);
     # the inner selects already alias matching names (Q13), so drop them
     sql = re.sub(r"\bas\s+(\w+)\s*\(\s*\w+(?:\s*,\s*\w+)*\s*\)",

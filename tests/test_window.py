@@ -183,6 +183,32 @@ class TestWindowAggregates:
                 want = sum(r[2] for r in p[max(0, i - 2):i + 1])
                 assert math.isclose(row[3], want, rel_tol=1e-9)
 
+    def test_rows_frame_moving_minmax(self, runner):
+        """Bounded N PRECEDING frame starts for min/max (the sparse-table
+        range-extremum path)."""
+        rows = fetch(runner, """
+            select o_custkey, o_orderkey, o_totalprice,
+                   min(o_totalprice) over (partition by o_custkey
+                        order by o_orderkey
+                        rows between 2 preceding and current row) mn,
+                   max(o_totalprice) over (partition by o_custkey
+                        order by o_orderkey
+                        rows between 3 preceding and 1 preceding) mx
+            from orders""")
+        parts = by_partition(rows, [0], lambda r: r[1])
+        for p in parts.values():
+            for i, row in enumerate(p):
+                mn_want = min(r[2] for r in p[max(0, i - 2):i + 1])
+                assert math.isclose(row[3], mn_want, rel_tol=1e-9), (
+                    row, mn_want)
+                window = p[max(0, i - 3):i]
+                if window:
+                    mx_want = max(r[2] for r in window)
+                    assert math.isclose(row[4], mx_want, rel_tol=1e-9), (
+                        row, mx_want)
+                else:
+                    assert row[4] is None, row
+
     def test_range_frame_peers(self, runner):
         # RANGE (default) includes the whole peer group in the running sum
         rows = fetch(runner, """

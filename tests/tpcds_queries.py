@@ -101,6 +101,301 @@ group by i_item_desc, w_warehouse_name, d1.d_week_seq
 order by total_cnt desc, i_item_desc, w_warehouse_name, d1.d_week_seq
 limit 100
 """,
+    # demographic/state brackets driving avg quantities
+    13: """
+select avg(ss_quantity) q, avg(ss_ext_sales_price) p,
+       avg(ss_ext_wholesale_cost) c, sum(ss_ext_wholesale_cost) s
+from tpcds.store_sales, tpcds.store, tpcds.customer_demographics,
+     tpcds.household_demographics, tpcds.customer_address, tpcds.date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2001
+  and ((ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M' and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00 and hd_dep_count = 3)
+   or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'S' and cd_education_status = 'College'
+        and ss_sales_price between 50.00 and 100.00 and hd_dep_count = 1)
+   or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'W' and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 150.00 and 200.00 and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('TX', 'OH', 'TX')
+        and ss_net_profit between 100 and 200)
+   or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('OR', 'MN', 'KY')
+        and ss_net_profit between 150 and 300)
+   or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('VA', 'TX', 'MI')
+        and ss_net_profit between 50 and 250))
+""",
+    # catalog sales by buyer zip bracket
+    15: """
+select ca_zip, sum(cs_sales_price) total
+from tpcds.catalog_sales, tpcds.customer, tpcds.customer_address,
+     tpcds.date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and (substr(ca_zip, 1, 5) in ('10012', '10033', '10074', '10105',
+                                '10146', '10187', '10060', '10081')
+       or ca_state in ('CA', 'WA', 'GA') or cs_sales_price > 500)
+  and cs_sold_date_sk = d_date_sk and d_qoy = 2 and d_year = 2001
+group by ca_zip order by ca_zip limit 100
+""",
+    # catalog-channel analogue of Q7
+    26: """
+select i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_sales_price) agg4
+from tpcds.catalog_sales, tpcds.customer_demographics, tpcds.date_dim,
+     tpcds.item, tpcds.promotion
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id order by i_item_id limit 100
+""",
+    # three-channel union by manufacturer
+    33: """
+with ss as (
+    select i_manufact_id, sum(ss_ext_sales_price) total_sales
+    from tpcds.store_sales, tpcds.date_dim, tpcds.customer_address,
+         tpcds.item
+    where i_category = 'Electronics' and ss_item_sk = i_item_sk
+      and ss_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 5
+      and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+    group by i_manufact_id),
+ cs as (
+    select i_manufact_id, sum(cs_ext_sales_price) total_sales
+    from tpcds.catalog_sales, tpcds.date_dim, tpcds.customer_address,
+         tpcds.item
+    where i_category = 'Electronics' and cs_item_sk = i_item_sk
+      and cs_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 5
+      and cs_ship_addr_sk = ca_address_sk and ca_gmt_offset = -5
+    group by i_manufact_id),
+ ws as (
+    select i_manufact_id, sum(ws_ext_sales_price) total_sales
+    from tpcds.web_sales, tpcds.date_dim, tpcds.customer_address,
+         tpcds.item
+    where i_category = 'Electronics' and ws_item_sk = i_item_sk
+      and ws_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 5
+      and ws_ship_addr_sk = ca_address_sk and ca_gmt_offset = -5
+    group by i_manufact_id)
+select i_manufact_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) tmp1
+group by i_manufact_id order by total_sales, i_manufact_id limit 100
+""",
+    # big-party tickets (HAVING over per-ticket counts)
+    34: """
+select c_last_name, c_first_name, ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from tpcds.store_sales, tpcds.date_dim, tpcds.store,
+           tpcds.household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (d_dom between 1 and 3 or d_dom between 25 and 28)
+        and (hd_buy_potential = '>10000'
+             or hd_buy_potential = 'Unknown')
+        and hd_vehicle_count > 0
+        and d_year in (1999, 2000, 2001)
+        and s_county in ('Williamson County', 'Franklin Parish')
+      group by ss_ticket_number, ss_customer_sk) dn, tpcds.customer
+where ss_customer_sk = c_customer_sk and cnt between 2 and 20
+order by c_last_name, c_first_name, ss_ticket_number desc, cnt
+""",
+    # catalog items with bounded inventory in a window
+    37: """
+select i_item_id, i_item_desc, i_current_price
+from tpcds.item, tpcds.inventory, tpcds.date_dim, tpcds.catalog_sales
+where i_current_price between 20 and 50
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_date between date '1999-03-06' and date '1999-05-05'
+  and i_manufact_id in (18, 120, 260, 402, 482, 566, 659, 775)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id limit 100
+""",
+    # catalog sales net of returns before/after a pivot date
+    40: """
+select w_state, i_item_id,
+       sum(case when d_date < date '1999-04-10'
+                then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                else 0 end) sales_before,
+       sum(case when d_date >= date '1999-04-10'
+                then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                else 0 end) sales_after
+from tpcds.catalog_sales
+left join tpcds.catalog_returns on cs_order_number = cr_order_number
+    and cs_item_sk = cr_item_sk, tpcds.warehouse, tpcds.item,
+    tpcds.date_dim
+where i_current_price between 0.99 and 1.49
+  and i_item_sk = cs_item_sk and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '1999-03-10' and date '1999-05-10'
+group by w_state, i_item_id order by w_state, i_item_id limit 100
+""",
+    # store sales per day-of-week, pivoted with CASE
+    43: """
+select s_store_name, s_store_id,
+       sum(case when d_day_name = 'Sunday' then ss_sales_price
+                else null end) sun_sales,
+       sum(case when d_day_name = 'Monday' then ss_sales_price
+                else null end) mon_sales,
+       sum(case when d_day_name = 'Friday' then ss_sales_price
+                else null end) fri_sales,
+       sum(case when d_day_name = 'Saturday' then ss_sales_price
+                else null end) sat_sales
+from tpcds.date_dim, tpcds.store, tpcds.store_sales
+where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+  and s_gmt_offset = -5 and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id limit 100
+""",
+    # web buyers in zip list or buying flagged items
+    45: """
+select ca_zip, ca_county, sum(ws_ext_sales_price) total
+from tpcds.web_sales, tpcds.customer, tpcds.customer_address,
+     tpcds.date_dim, tpcds.item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk and ws_item_sk = i_item_sk
+  and (substr(ca_zip, 1, 5) in ('10012', '10033', '10074', '10105',
+                                '10146', '10187', '10060', '10081')
+       or i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29))
+  and ws_sold_date_sk = d_date_sk and d_qoy = 2 and d_year = 2001
+group by ca_zip, ca_county order by ca_zip, ca_county limit 100
+""",
+    # quantity sum over demographic/state/price brackets
+    48: """
+select sum(ss_quantity) q
+from tpcds.store_sales, tpcds.store, tpcds.customer_demographics,
+     tpcds.customer_address, tpcds.date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2000
+  and ((cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+   or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'D'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 50.00 and 100.00)
+   or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 150.00 and 200.00))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('CO', 'OH', 'TX')
+        and ss_net_profit between 0 and 2000)
+   or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('OR', 'MN', 'KY')
+        and ss_net_profit between 150 and 3000)
+   or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('VA', 'CA', 'MS')
+        and ss_net_profit between 50 and 25000))
+""",
+    # three-channel union by item id for one category
+    60: """
+with ss as (
+    select i_item_id, sum(ss_ext_sales_price) total_sales
+    from tpcds.store_sales, tpcds.date_dim, tpcds.customer_address,
+         tpcds.item
+    where i_category = 'Music' and ss_item_sk = i_item_sk
+      and ss_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 9
+      and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+    group by i_item_id),
+ cs as (
+    select i_item_id, sum(cs_ext_sales_price) total_sales
+    from tpcds.catalog_sales, tpcds.date_dim, tpcds.customer_address,
+         tpcds.item
+    where i_category = 'Music' and cs_item_sk = i_item_sk
+      and cs_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 9
+      and cs_ship_addr_sk = ca_address_sk and ca_gmt_offset = -5
+    group by i_item_id),
+ ws as (
+    select i_item_id, sum(ws_ext_sales_price) total_sales
+    from tpcds.web_sales, tpcds.date_dim, tpcds.customer_address,
+         tpcds.item
+    where i_category = 'Music' and ws_item_sk = i_item_sk
+      and ws_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 9
+      and ws_ship_addr_sk = ca_address_sk and ca_gmt_offset = -5
+    group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) tmp1
+group by i_item_id order by i_item_id, total_sales limit 100
+""",
+    # items selling at <= 10% of their store's average revenue
+    65: """
+select s_store_name, i_item_desc, sc.revenue, i_current_price,
+       i_wholesale_cost, i_brand
+from tpcds.store, tpcds.item,
+     (select ss_store_sk, avg(revenue) as ave
+      from (select ss_store_sk, ss_item_sk,
+                   sum(ss_sales_price) as revenue
+            from tpcds.store_sales, tpcds.date_dim
+            where ss_sold_date_sk = d_date_sk
+              and d_month_seq between 108 and 119
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+      from tpcds.store_sales, tpcds.date_dim
+      where ss_sold_date_sk = d_date_sk
+        and d_month_seq between 108 and 119
+      group by ss_store_sk, ss_item_sk) sc
+where sb.ss_store_sk = sc.ss_store_sk and sc.revenue <= 0.1 * sb.ave
+  and s_store_sk = sc.ss_store_sk and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_desc, sc.revenue limit 100
+""",
+    # purchase-estimate histogram for store-only shoppers
+    69: """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2
+from tpcds.customer c, tpcds.customer_address ca,
+     tpcds.customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('KY', 'GA', 'NC')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from tpcds.store_sales, tpcds.date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 2001
+                and d_moy between 4 and 6)
+  and not exists (select * from tpcds.web_sales, tpcds.date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk and d_year = 2001
+                    and d_moy between 4 and 6)
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate limit 100
+""",
+    # store analogue of Q37 (inventory-bounded items)
+    82: """
+select i_item_id, i_item_desc, i_current_price
+from tpcds.item, tpcds.inventory, tpcds.date_dim, tpcds.store_sales
+where i_current_price between 30 and 60
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_date between date '2002-05-30' and date '2002-07-29'
+  and i_manufact_id in (437, 129, 727, 663, 850, 311, 419, 584)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id limit 100
+""",
+    # class revenue share within category (window over aggregation)
+    98: """
+select i_item_desc, i_category, i_class, i_current_price, itemrevenue,
+       itemrevenue * 100 / sum(itemrevenue)
+           over (partition by i_class) as revenueratio
+from (select i_item_desc, i_category, i_class, i_current_price,
+             sum(ss_ext_sales_price) as itemrevenue
+      from tpcds.store_sales, tpcds.item, tpcds.date_dim
+      where ss_item_sk = i_item_sk
+        and i_category in ('Sports', 'Books', 'Home')
+        and ss_sold_date_sk = d_date_sk
+        and d_date between date '1999-02-22' and date '1999-03-24'
+      group by i_item_desc, i_category, i_class, i_current_price) t
+order by i_category, i_class, i_item_desc, revenueratio
+limit 100
+""",
     # BASELINE config: multi-warehouse returned web orders
     95: """
 with ws_wh as (
