@@ -145,6 +145,19 @@ class Connector:
                     batch_rows: int = 65536) -> PageSource:
         raise NotImplementedError
 
+    def bucket_splits(self, handle: TableHandle, column: str,
+                      n_buckets: int
+                      ) -> Optional[Tuple[Tuple[int, int],
+                                          List[List[Split]]]]:
+        """Co-bucketed split groups for grouped execution (P9): when the
+        table can be range-bucketed on ``column``, return ((domain_lo,
+        domain_hi), [splits of bucket 0, ...]).  Two scans co-partition
+        iff their domains match — the ConnectorNodePartitioningProvider
+        role (presto-spi/.../connector/ConnectorNodePartitioningProvider
+        .java) driving Lifespan.java:26 bucket-by-bucket execution.
+        None = not bucketable on that column."""
+        return None
+
     # -- writes (optional) ----------------------------------------------
     def create_table(self, name: str, schema: TableSchema,
                      properties: Optional[Dict[str, Any]] = None

@@ -647,6 +647,38 @@ class TpchConnector(Connector):
             "lineitem": 4 * g.n_orders,  # expected 4/order
         }[table]
 
+    # which column IS the split-range key of each table (the implicit
+    # bucketing column, TpchNodePartitioningProvider role)
+    _BUCKET_COLUMN = {
+        "supplier": "s_suppkey", "customer": "c_custkey",
+        "part": "p_partkey", "partsupp": "ps_partkey",
+        "orders": "o_orderkey", "lineitem": "l_orderkey",
+    }
+
+    def bucket_splits(self, handle: TableHandle, column: str,
+                      n_buckets: int):
+        """Range buckets over the key domain: orders and lineitem share
+        the orderkey domain, so joins on it co-partition exactly (the
+        grouped-execution qualifier, Lifespan.java:26)."""
+        if self._BUCKET_COLUMN.get(handle.table) != column:
+            return None
+        lo, hi = self._key_range(handle.table)
+        n = hi - lo
+        if n < n_buckets:
+            return None
+        per = -(-n // n_buckets)
+        mult = 4 if handle.table in ("partsupp", "lineitem") else 1
+        buckets: List[List[Split]] = []
+        for b in range(n_buckets):
+            blo = lo + b * per
+            bhi = min(blo + per, hi)
+            if blo >= bhi:
+                buckets.append([])
+                continue
+            buckets.append([Split(handle, (blo, bhi),
+                                  estimated_rows=(bhi - blo) * mult)])
+        return (lo, hi), buckets
+
     def list_tables(self) -> List[str]:
         return sorted(self._schemas)
 

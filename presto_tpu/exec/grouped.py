@@ -1,0 +1,143 @@
+"""Grouped (bucket-by-bucket) join execution — P9, the Lifespan tier.
+
+The reference bounds join memory by running co-bucketed fragments one
+driver-group at a time (execution/Lifespan.java:26-38,
+PlanFragmenter.analyzeGroupedExecution:146,
+PipelineExecutionStrategy.GROUPED_EXECUTION): only 1/k of the build side
+is resident at once.  Here the same contract is an operator-level
+harness: when both join sides scan tables that the connector can
+co-bucket on the join key (range buckets over the key domain), the join
+runs bucket-sequentially — build bucket b, probe bucket b, release, next
+— on a feeder thread, streaming joined batches to the consumer chain
+through a bounded LocalExchange.  Peak HBM for the build side scales
+with 1/k; the release is HashBuildOperatorFactory.release() (the
+Lifespan-retirement hook).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from presto_tpu.batch import Batch
+from presto_tpu.connectors.api import Split
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.driver import Pipeline
+from presto_tpu.exec.localexchange import (
+    LocalExchange, LocalExchangeSinkOperatorFactory,
+)
+from presto_tpu.exec.operator import Operator, OperatorFactory
+
+
+class GroupedJoinSourceOperatorFactory(OperatorFactory):
+    """Source operator that owns the bucket-sequential execution.
+
+    ``buckets`` is a list of
+    (build_factories, build_splits, probe_factories, probe_splits); the
+    probe factory chain already ends with the LookupJoin for that
+    bucket's build.  Each bucket's pipelines run to completion before
+    the next bucket starts (the lifespan), with joined batches flowing
+    out through a bounded exchange so downstream operators consume
+    concurrently instead of buffering every bucket's output.
+    """
+
+    def __init__(self, buckets: Sequence[Tuple[List[OperatorFactory],
+                                               List[Split],
+                                               List[OperatorFactory],
+                                               List[Split]]]):
+        self.buckets = list(buckets)
+
+    def create(self, ctx: OperatorContext) -> "GroupedJoinSourceOperator":
+        return GroupedJoinSourceOperator(ctx, self)
+
+
+class GroupedJoinSourceOperator(Operator):
+    def __init__(self, ctx: OperatorContext,
+                 factory: GroupedJoinSourceOperatorFactory):
+        super().__init__(ctx)
+        self.f = factory
+        # buckets run SEQUENTIALLY: they share ONE producer slot (a
+        # strict round-robin consumer must never wait on a producer
+        # that has not started) and the runner thread signals finish
+        # once after the last lifespan
+        self.exchange = LocalExchange(n_producers=1, capacity=8)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def _run_buckets(self) -> None:
+        task = self.ctx.task
+        try:
+            for i, (bfs, bsplits, pfs, psplits) in enumerate(
+                    self.f.buckets):
+                build = Pipeline(bfs, bsplits, name=f"lifespan{i}.build")
+                build.instantiate(task).run_to_completion()
+                probe = Pipeline(
+                    pfs + [LocalExchangeSinkOperatorFactory(
+                        self.exchange, producer=0,
+                        signal_finish=False)],
+                    psplits, name=f"lifespan{i}.probe")
+                # the probe driver's close releases this bucket's build
+                # (HashBuildOperatorFactory.release) before the next
+                # lifespan builds — the 1/k memory bound
+                probe.instantiate(task).run_to_completion()
+        except BaseException as e:  # noqa: BLE001 - crossed to consumer
+            self._error = e
+            self.exchange.fail(e)
+        finally:
+            self.exchange.producer_finished(0)
+
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run_buckets, daemon=True,
+                name=f"grouped-join-{self.ctx.name}")
+            self._thread.start()
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[Batch]:
+        self._ensure_started()
+        batch = self.exchange.poll()
+        if batch is not None:
+            self.ctx.stats.output_rows += batch.num_rows
+        return batch
+
+    def is_finished(self) -> bool:
+        self._ensure_started()
+        return self.exchange.drained()
+
+    def close(self) -> None:
+        super().close()
+        if self._thread is not None:
+            self.exchange.fail(RuntimeError("grouped join canceled"))
+            self._thread.join(timeout=30)
+
+
+def scan_column_for_channel(factories: Sequence[OperatorFactory],
+                            channel: int) -> Optional[Tuple[object, str]]:
+    """Trace an output channel of a factory chain back to its scan
+    column through pure InputRef projections.  Returns
+    (TableScanOperatorFactory, column_name) or None (the channel is
+    computed, or the chain has no scan)."""
+    from presto_tpu.exec.operators import (
+        FilterProjectOperatorFactory, TableScanOperatorFactory,
+    )
+    from presto_tpu.expr.ir import InputRef
+
+    ch = channel
+    for f in reversed(list(factories)):
+        if isinstance(f, FilterProjectOperatorFactory):
+            if ch >= len(f.projections):
+                return None
+            p = f.projections[ch]
+            if not isinstance(p, InputRef):
+                return None
+            ch = p.index
+        elif isinstance(f, TableScanOperatorFactory):
+            if ch >= len(f.columns):
+                return None
+            return f, f.columns[ch]
+        else:
+            return None
+    return None

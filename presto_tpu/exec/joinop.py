@@ -142,9 +142,16 @@ class HashBuildOperator(Operator):
     def __init__(self, ctx: OperatorContext, factory: "HashBuildOperatorFactory"):
         super().__init__(ctx)
         self.f = factory
+        factory._build_ctxs.append(ctx)
         self._batches: List[Batch] = []
         self._spiller = None
         self._accumulated_bytes = 0
+
+    def close(self) -> None:
+        # the LookupSource keeps the build data alive through the probe:
+        # the reservation is released by the probe side
+        # (LookupJoinOperator.close -> factory.release), not here
+        pass
 
     def add_input(self, batch: Batch) -> None:
         self.ctx.stats.input_rows += batch.num_rows
@@ -258,9 +265,19 @@ class HashBuildOperatorFactory(OperatorFactory):
         self.dynamic_filter = dynamic_filter
         # per-partition sub-builds during a grace join must not re-spill
         self.allow_spill = allow_spill
+        self._build_ctxs: List[OperatorContext] = []
 
     def create(self, ctx: OperatorContext) -> HashBuildOperator:
         return HashBuildOperator(ctx, self)
+
+    def release(self) -> None:
+        """Drop the lookup source and the build-side reservation.  Called
+        when the probe finishes — under grouped execution this is what
+        makes peak memory scale with 1/buckets (Lifespan retirement,
+        execution/Lifespan.java:26-38 role)."""
+        self.lookup.source = None
+        for ctx in self._build_ctxs:
+            ctx.memory.free()
 
 
 def _ids_from_pairs(jnp, pairs, key_channels, mode, mins, strides, maxs,
@@ -361,6 +378,10 @@ class LookupJoinOperator(Operator):
     """Probe side.  Output layout: all probe channels, then all build
     channels (planner projects away what it does not need).  semi/anti emit
     probe channels only."""
+
+    def close(self) -> None:
+        super().close()
+        self.f.build.release()
 
     def __init__(self, ctx: OperatorContext, factory: "LookupJoinOperatorFactory"):
         super().__init__(ctx)

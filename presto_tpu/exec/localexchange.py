@@ -25,26 +25,35 @@ from presto_tpu.exec.operator import Operator, OperatorFactory
 
 
 class LocalExchange:
+    """Deterministic N-producer rendezvous: the consumer drains batches
+    in strict producer round-robin, so the DOWNSTREAM batch order is a
+    pure function of each producer's (deterministic) output — float
+    aggregation results stay reproducible run-to-run even though the
+    producers execute concurrently (the reference pins the same property
+    with PlanDeterminismChecker / TestQueryPlanDeterminism)."""
+
     def __init__(self, n_producers: int, capacity: int = 16):
-        self._batches: Deque[Batch] = deque()
-        self._remaining = n_producers
-        self._capacity = capacity
+        self._queues: List[Deque[Batch]] = [deque()
+                                            for _ in range(n_producers)]
+        self._done = [False] * n_producers
+        self._cursor = 0
+        self._capacity = max(capacity // max(n_producers, 1), 2)
         self._error: Optional[BaseException] = None
         self._cond = threading.Condition()
 
-    def put(self, batch: Batch) -> None:
+    def put(self, producer: int, batch: Batch) -> None:
         with self._cond:
-            while (len(self._batches) >= self._capacity
-                   and self._error is None):
+            q = self._queues[producer]
+            while len(q) >= self._capacity and self._error is None:
                 self._cond.wait(timeout=1.0)
             if self._error is not None:
                 raise self._error
-            self._batches.append(batch)
+            q.append(batch)
             self._cond.notify_all()
 
-    def producer_finished(self) -> None:
+    def producer_finished(self, producer: int) -> None:
         with self._cond:
-            self._remaining -= 1
+            self._done[producer] = True
             self._cond.notify_all()
 
     def fail(self, exc: BaseException) -> None:
@@ -53,38 +62,61 @@ class LocalExchange:
                 self._error = exc
             self._cond.notify_all()
 
+    def _next_ready_locked(self) -> Optional[int]:
+        """The producer whose turn it is, skipping finished-and-empty
+        ones; None when every producer is drained.  Waits for the
+        CURRENT producer rather than taking whatever arrived first —
+        that wait is what buys determinism."""
+        n = len(self._queues)
+        for _ in range(n):
+            q = self._queues[self._cursor]
+            if q:
+                return self._cursor
+            if self._done[self._cursor]:
+                self._cursor = (self._cursor + 1) % n
+                continue
+            return self._cursor  # its turn, but not ready yet
+        return None
+
     def poll(self, wait_s: float = 0.005) -> Optional[Batch]:
-        """One batch, or None; raises a producer's error."""
+        """One batch in deterministic order, or None; raises a
+        producer's error."""
         with self._cond:
             if self._error is not None:
                 raise self._error
-            if not self._batches and self._remaining > 0:
+            cur = self._next_ready_locked()
+            if cur is not None and not self._queues[cur]:
                 self._cond.wait(timeout=wait_s)
-            if self._error is not None:
-                raise self._error
-            if self._batches:
-                out = self._batches.popleft()
-                self._cond.notify_all()
-                return out
-            return None
+                if self._error is not None:
+                    raise self._error
+                cur = self._next_ready_locked()
+            if cur is None or not self._queues[cur]:
+                return None
+            out = self._queues[cur].popleft()
+            self._cursor = (cur + 1) % len(self._queues)
+            self._cond.notify_all()
+            return out
 
     def drained(self) -> bool:
         with self._cond:
-            return self._remaining == 0 and not self._batches
+            return all(self._done) and not any(self._queues)
 
 
 class LocalExchangeSinkOperator(Operator):
-    def __init__(self, ctx: OperatorContext, exchange: LocalExchange):
+    def __init__(self, ctx: OperatorContext, exchange: LocalExchange,
+                 producer: int, signal_finish: bool):
         super().__init__(ctx)
         self.exchange = exchange
+        self.producer = producer
+        self.signal_finish = signal_finish
 
     def add_input(self, batch: Batch) -> None:
         self.ctx.stats.input_rows += batch.num_rows
-        self.exchange.put(batch)
+        self.exchange.put(self.producer, batch)
 
     def finish(self) -> None:
-        if not self._finishing:
-            self.exchange.producer_finished()
+        if not self._finishing and self.signal_finish:
+            self.exchange.producer_finished(self.producer)
         super().finish()
 
     def is_finished(self) -> bool:
@@ -92,11 +124,20 @@ class LocalExchangeSinkOperator(Operator):
 
 
 class LocalExchangeSinkOperatorFactory(OperatorFactory):
-    def __init__(self, exchange: LocalExchange):
+    def __init__(self, exchange: LocalExchange, producer: int = 0,
+                 signal_finish: bool = True):
+        """``signal_finish=False`` for SEQUENTIAL pipelines sharing one
+        producer slot (grouped-execution lifespans): the owner signals
+        once after the last pipeline, since a strict round-robin
+        consumer must never wait on a producer that has not started."""
         self.exchange = exchange
+        self.producer = producer
+        self.signal_finish = signal_finish
 
     def create(self, ctx: OperatorContext) -> LocalExchangeSinkOperator:
-        return LocalExchangeSinkOperator(ctx, self.exchange)
+        return LocalExchangeSinkOperator(ctx, self.exchange,
+                                         self.producer,
+                                         self.signal_finish)
 
 
 class LocalExchangeSourceOperator(Operator):

@@ -75,11 +75,13 @@ class TableScanOperatorFactory(OperatorFactory):
     parallel_safe = True
 
     def __init__(self, connector: Connector, columns: Sequence[str],
-                 batch_rows: int = 65536, to_device: bool = True):
+                 batch_rows: int = 65536, to_device: bool = True,
+                 table: str = ""):
         self.connector = connector
         self.columns = list(columns)
         self.batch_rows = batch_rows
         self.to_device = to_device
+        self.table = table  # for grouped-execution bucket lookup
 
     def create(self, ctx: OperatorContext) -> TableScanOperator:
         return TableScanOperator(ctx, self.connector, self.columns,

@@ -51,7 +51,7 @@ def _run_parallel(p: Pipeline, task: TaskContext, prefix: int,
     def feed(i: int) -> None:
         feeder = Pipeline(
             p.factories[:prefix]
-            + [LocalExchangeSinkOperatorFactory(exchange)],
+            + [LocalExchangeSinkOperatorFactory(exchange, producer=i)],
             p.splits[i::width], name=f"{p.name}.feed{i}")
         try:
             feeder.instantiate(task).run_to_completion()

@@ -126,7 +126,8 @@ class PhysicalPlanner:
                 splits = all_splits[idx::count]
             return ([TableScanOperatorFactory(
                 conn, node.column_names,
-                batch_rows=self.config.scan_batch_rows)], splits)
+                batch_rows=self.config.scan_batch_rows,
+                table=node.table)], splits)
         if isinstance(node, RemoteSourceNode):
             from presto_tpu.server.exchangeop import ExchangeOperatorFactory
 
@@ -375,12 +376,19 @@ class PhysicalPlanner:
             chain.append(NestedLoopJoinOperatorFactory(build))
             return chain, splits
         if node.kind in ("inner", "left"):
+            # sides are lowered ONCE; the grouped-execution attempt and
+            # the standard path share the chains (re-lowering would
+            # duplicate nested build pipelines)
+            build_chain, build_splits = self._lower(node.right)
+            chain, splits = self._lower(node.left)
+            grouped = self._try_grouped_join(node, chain, build_chain)
+            if grouped is not None:
+                return grouped
             dyn = None
             if node.kind == "inner" and self.config.dynamic_filtering_enabled:
                 from presto_tpu.exec.dynamicfilter import DynamicFilter
 
                 dyn = DynamicFilter(len(node.right_keys))
-            build_chain, build_splits = self._lower(node.right)
             build = HashBuildOperatorFactory(
                 list(node.right_keys), [t for _, t in node.right.columns],
                 dynamic_filter=dyn)
@@ -388,7 +396,6 @@ class PhysicalPlanner:
             self._done_pipelines.append(
                 Pipeline(build_chain, build_splits,
                          name=self._name("build")))
-            chain, splits = self._lower(node.left)
             if dyn is not None:
                 self._insert_dynamic_filter(chain, dyn,
                                             list(node.left_keys))
@@ -407,6 +414,48 @@ class PhysicalPlanner:
                     node.residual, proj, types))
             return chain, splits
         raise NotImplementedError(f"{node.kind} join")
+
+    def _try_grouped_join(self, node: JoinNode, probe_chain,
+                          build_chain):
+        """Grouped execution (P9, Lifespan.java:26-38): when both join
+        sides scan tables the connector co-buckets on the join key, run
+        the join bucket-sequentially so only 1/k of the build side is
+        resident.  Returns the (chain, splits) lowering or None when the
+        shape does not qualify (caller falls through to the standard
+        lowering, reusing the same chains)."""
+        k = self.config.grouped_execution_buckets
+        if k <= 1 or len(node.left_keys) != 1 or node.residual is not None:
+            return None
+        from presto_tpu.exec.grouped import (
+            GroupedJoinSourceOperatorFactory, scan_column_for_channel,
+        )
+
+        probe_col = scan_column_for_channel(probe_chain, node.left_keys[0])
+        build_col = scan_column_for_channel(build_chain,
+                                            node.right_keys[0])
+        if probe_col is None or build_col is None:
+            # a side is not a pure scan chain (exchange, nested join...)
+            return None
+        (pscan, pname), (bscan, bname) = probe_col, build_col
+        pb = pscan.connector.bucket_splits(
+            pscan.connector.get_table(_scan_table(pscan)), pname, k)
+        bb = bscan.connector.bucket_splits(
+            bscan.connector.get_table(_scan_table(bscan)), bname, k)
+        if pb is None or bb is None or pb[0] != bb[0]:
+            # not bucketable, or the key domains differ (no co-partition)
+            return None
+        buckets = []
+        for b in range(k):
+            build = HashBuildOperatorFactory(
+                list(node.right_keys), [t for _, t in node.right.columns])
+            bfs = list(build_chain) + [build]
+            pfs = list(probe_chain) + [LookupJoinOperatorFactory(
+                build, list(node.left_keys),
+                [t for _, t in node.left.columns],
+                join_type=node.kind,
+                expansion=self.config.join_expansion_factor)]
+            buckets.append((bfs, bb[1][b], pfs, pb[1][b]))
+        return [GroupedJoinSourceOperatorFactory(buckets)], []
 
     def _lower_semijoin(self, node: SemiJoinNode):
         dyn = None
@@ -437,6 +486,13 @@ class PhysicalPlanner:
     def _name(self, prefix: str) -> str:
         self._counter += 1
         return f"{prefix}{self._counter}"
+
+
+def _scan_table(scan_factory) -> str:
+    """Table name a TableScanOperatorFactory reads (for bucket lookup);
+    scans keep a handle-producing connector but not the name directly,
+    so it rides on the factory (set at construction)."""
+    return scan_factory.table
 
 
 def _coerce_to(expr: RowExpression, typ: T.Type) -> RowExpression:

@@ -1,0 +1,77 @@
+"""Grouped (bucket-by-bucket) join execution — P9 Lifespans: identical
+results to the all-at-once join, with build-side peak memory scaling
+~1/k (execution/Lifespan.java:26-38, PlanFragmenter.java:146 roles)."""
+
+import pytest
+
+from presto_tpu.config import EngineConfig
+from presto_tpu.localrunner import LocalQueryRunner
+
+SCALE = 0.02
+
+
+def _runner(buckets: int) -> LocalQueryRunner:
+    cfg = EngineConfig(grouped_execution_buckets=buckets,
+                       task_concurrency=1,
+                       dynamic_filtering_enabled=False)
+    return LocalQueryRunner.tpch(scale=SCALE, config=cfg)
+
+
+@pytest.fixture(scope="module")
+def plain():
+    return _runner(1)
+
+
+@pytest.fixture(scope="module")
+def grouped():
+    return _runner(8)
+
+
+JOIN_SQL = ("select count(*), sum(l_extendedprice) from orders "
+            "join lineitem on o_orderkey = l_orderkey "
+            "where o_totalprice > 50000")
+
+
+def test_results_identical(plain, grouped):
+    a = plain.execute(JOIN_SQL).rows
+    b = grouped.execute(JOIN_SQL).rows
+    assert a[0][0] == b[0][0]
+    assert abs(a[0][1] - b[0][1]) <= 1e-6 * abs(a[0][1])
+
+
+def test_left_join_grouped(plain, grouped):
+    sql = ("select count(*) from orders left join lineitem "
+           "on o_orderkey = l_orderkey where o_orderkey < 1000")
+    assert plain.execute(sql).rows == grouped.execute(sql).rows
+
+
+def test_peak_memory_scales_down(plain, grouped):
+    """With 8 lifespans only ~1/8 of the build side is resident."""
+    plain.execute(JOIN_SQL)
+    peak1 = plain._last_task.memory.peak
+    grouped.execute(JOIN_SQL)
+    peak8 = grouped._last_task.memory.peak
+    assert peak8 < peak1 * 0.5, (peak1, peak8)
+
+
+def test_non_coparitioned_join_falls_back(grouped):
+    # customer x orders joins custkey against an orderkey-bucketed
+    # table: domains differ, so the standard join runs (still correct)
+    sql = ("select count(*) from customer join orders "
+           "on c_custkey = o_custkey")
+    plain = _runner(1)
+    assert grouped.execute(sql).rows == plain.execute(sql).rows
+
+
+def test_many_batches_per_bucket_no_deadlock():
+    """Each bucket emits many more batches than the exchange capacity:
+    the sequential-producer protocol must keep streaming (regression:
+    strict round-robin waiting on a not-yet-started bucket while the
+    current bucket blocked on a full queue)."""
+    cfg = EngineConfig(grouped_execution_buckets=4, task_concurrency=1,
+                       dynamic_filtering_enabled=False,
+                       scan_batch_rows=512)
+    r = LocalQueryRunner.tpch(scale=SCALE, config=cfg)
+    got = r.execute(JOIN_SQL).rows
+    want = _runner(1).execute(JOIN_SQL).rows
+    assert got[0][0] == want[0][0]
