@@ -78,6 +78,10 @@ class EngineConfig:
     # Build-side key domains prune probe rows before the join kernel
     # (DynamicFilterSourceOperator role, SURVEY §2.6).
     dynamic_filtering_enabled: bool = True
+    # Sorted/clustered-input aggregation (StreamingAggregationOperator
+    # role): group keys tracing to a prefix of the scan's sort order
+    # aggregate run-by-run with no sort and one open group carried.
+    streaming_aggregation_enabled: bool = True
     # Grouped execution (P9, Lifespan role): joins whose sides co-bucket
     # on the join key run bucket-by-bucket with only 1/k of the build
     # side resident.  1 = off.

@@ -145,6 +145,13 @@ class Connector:
                     batch_rows: int = 65536) -> PageSource:
         raise NotImplementedError
 
+    def sort_order(self, handle: TableHandle) -> List[str]:
+        """Columns the table's rows are clustered/sorted by, in order
+        (the LocalProperties/StreamPropertyDerivations source): scans
+        emit rows grouped by any prefix of this list, enabling
+        streaming aggregation.  Empty = no declared order."""
+        return []
+
     def bucket_splits(self, handle: TableHandle, column: str,
                       n_buckets: int
                       ) -> Optional[Tuple[Tuple[int, int],

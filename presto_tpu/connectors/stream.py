@@ -61,8 +61,10 @@ class Transport:
 
 class DirTransport(Transport):
     """Directory of message files: ``<root>/<topic>/<partition>.msgs``
-    with one message per line (the deterministic test transport; also
-    the presto-local-file role)."""
+    with one message per line, or ``<partition>.bin`` with 4-byte
+    big-endian length-prefixed frames (binary payloads — avro — may
+    contain newlines).  The deterministic test transport; also the
+    presto-local-file role."""
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
@@ -76,16 +78,33 @@ class DirTransport(Transport):
             return [0]
         out = []
         for fn in os.listdir(d):
-            if fn.endswith(".msgs"):
-                try:
-                    out.append(int(fn[:-5]))
-                except ValueError:
-                    pass
-        return sorted(out) or [0]
+            for suffix in (".msgs", ".bin"):
+                if fn.endswith(suffix):
+                    try:
+                        out.append(int(fn[:-len(suffix)]))
+                    except ValueError:
+                        pass
+        return sorted(set(out)) or [0]
 
     def messages(self, topic: str,
                  partition: int) -> Iterator[Tuple[int, bytes]]:
-        path = os.path.join(self._topic_dir(topic), f"{partition}.msgs")
+        import struct as _struct
+
+        d = self._topic_dir(topic)
+        framed = os.path.join(d, f"{partition}.bin")
+        if os.path.exists(framed):
+            with open(framed, "rb") as f:
+                data = f.read()
+            off = 0
+            pos = 0
+            while pos + 4 <= len(data):
+                (n,) = _struct.unpack(">I", data[pos:pos + 4])
+                pos += 4
+                yield off, data[pos:pos + n]
+                pos += n
+                off += 1
+            return
+        path = os.path.join(d, f"{partition}.msgs")
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
@@ -115,14 +134,17 @@ class StreamTableDescription:
     table-description analogue)."""
 
     def __init__(self, name: str, topic: str, decoder: str,
-                 columns: Sequence[Tuple[str, str, Optional[str]]]):
-        """columns: (name, type string, decoder mapping or None)."""
+                 columns: Sequence[Tuple[str, str, Optional[str]]],
+                 data_schema: Optional[Dict[str, Any]] = None):
+        """columns: (name, type string, decoder mapping or None);
+        ``data_schema`` is the avro writer schema (dataSchema role)."""
         self.name = name
         self.topic = topic
         self.decoder_kind = decoder
         self.columns = tuple(
             ColumnMetadata(n, T.parse_type(ts)) for n, ts, _ in columns)
         self.mappings = tuple(m for _, _, m in columns)
+        self.data_schema = data_schema
 
     @classmethod
     def from_dict(cls, doc: Dict[str, Any]) -> "StreamTableDescription":
@@ -130,7 +152,8 @@ class StreamTableDescription:
             doc["name"], doc.get("topic", doc["name"]),
             doc.get("decoder", "json"),
             [(c["name"], c["type"], c.get("mapping"))
-             for c in doc["columns"]])
+             for c in doc["columns"]],
+            data_schema=doc.get("dataSchema"))
 
 
 class MessageStreamConnector(Connector):
@@ -163,7 +186,7 @@ class MessageStreamConnector(Connector):
                     batch_rows: int = 65536) -> PageSource:
         desc = self.tables[split.handle.table]
         decoder = make_decoder(desc.decoder_kind, desc.columns,
-                               desc.mappings)
+                               desc.mappings, schema=desc.data_schema)
         partition = split.info
         schema = self.table_schema(split.handle)
         types = [schema.column_type(c) for c in columns]

@@ -647,6 +647,20 @@ class TpchConnector(Connector):
             "lineitem": 4 * g.n_orders,  # expected 4/order
         }[table]
 
+    _SORT_ORDER = {
+        "supplier": ["s_suppkey"], "customer": ["c_custkey"],
+        "part": ["p_partkey"], "partsupp": ["ps_partkey", "ps_suppkey"],
+        "orders": ["o_orderkey"],
+        "lineitem": ["l_orderkey", "l_linenumber"],
+        "nation": ["n_nationkey"], "region": ["r_regionkey"],
+    }
+
+    def sort_order(self, handle: TableHandle) -> List[str]:
+        """Generation order: every table is emitted ascending by its
+        surrogate key (lineitem clustered by orderkey, then line
+        number) — the property StreamingAggregation exploits."""
+        return list(self._SORT_ORDER.get(handle.table, []))
+
     # which column IS the split-range key of each table (the implicit
     # bucketing column, TpchNodePartitioningProvider role)
     _BUCKET_COLUMN = {

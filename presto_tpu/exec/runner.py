@@ -27,6 +27,11 @@ def _parallel_prefix(p: Pipeline, config: EngineConfig) -> int:
     drivers (0 = run the pipeline single-driver)."""
     if config.task_concurrency <= 1 or len(p.splits) <= 1:
         return 0
+    if any(getattr(f, "requires_ordered_input", False)
+           for f in p.factories):
+        # round-robin feeds would interleave the clustered key order a
+        # streaming aggregation depends on
+        return 0
     k = 0
     for f in p.factories:
         if getattr(f, "parallel_safe", False):

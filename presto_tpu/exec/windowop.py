@@ -54,7 +54,11 @@ class WindowOperator(Operator):
         self._output = self._evaluate(data)
         self.ctx.stats.output_rows += self._output.num_rows
 
-    def _evaluate(self, data: Batch) -> Batch:
+    def _sort_and_segment(self, data: Batch):
+        """Sort by (partition, order) and derive partition/peer segment
+        ids — shared by the window evaluation and the TopNRowNumber
+        truncation (computed ONCE; each extra device dispatch costs
+        seconds through the remote-TPU tunnel)."""
         import jax.numpy as jnp
 
         from presto_tpu.ops import window as W
@@ -111,11 +115,14 @@ class WindowOperator(Operator):
         for ch, _, _ in self.order_keys:
             peer_eq = peer_eq & eq_prev(ch)
         peer = W.segment_ids(peer_eq)
+        return data, seg, peer, live
 
+    def _evaluate(self, data: Batch) -> Batch:
+        data, seg, peer, _live = self._sort_and_segment(data)
         out_cols = list(data.columns)
         for fn in self.functions:
             out_cols.append(self._eval_function(fn, data, seg, peer))
-        return Batch(tuple(out_cols), n)
+        return Batch(tuple(out_cols), data.num_rows)
 
     def _eval_function(self, fn: PlanWindowFunction, data: Batch,
                        seg, peer) -> Column:
@@ -209,6 +216,49 @@ class WindowOperator(Operator):
 
     def is_finished(self) -> bool:
         return self._finishing and self._output is None
+
+
+class TopNRowNumberOperator(WindowOperator):
+    """Fused ``row_number() OVER (partition ORDER BY ...) <= N``
+    (TopNRowNumberOperator.java:38 role): sorts once by (partition,
+    order), keeps only each partition's first N rows, and emits the row
+    number with them — the filtered rows never materialize downstream."""
+
+    def __init__(self, ctx: OperatorContext, factory:
+                 "TopNRowNumberOperatorFactory"):
+        super().__init__(ctx, factory.partition_channels,
+                         factory.order_keys, [])
+        self.limit = factory.limit
+        self.rn_type = factory.rn_type
+
+    def _evaluate(self, data: Batch) -> Batch:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from presto_tpu.ops import window as W
+
+        full, seg, _peer, live = self._sort_and_segment(data)
+        rn = W.row_number(seg)
+        keep = np.asarray(live & (rn <= self.limit))
+        idx = np.nonzero(keep)[0]
+        out = full.take(jnp.asarray(idx))
+        rn_col = Column(self.rn_type,
+                        jnp.asarray(rn)[jnp.asarray(idx)]
+                        .astype(self.rn_type.np_dtype))
+        return Batch(tuple(out.columns) + (rn_col,), len(idx))
+
+
+class TopNRowNumberOperatorFactory(OperatorFactory):
+    def __init__(self, partition_channels: Sequence[int],
+                 order_keys: Sequence[Tuple[int, bool, Optional[bool]]],
+                 limit: int, rn_type: T.Type):
+        self.partition_channels = list(partition_channels)
+        self.order_keys = list(order_keys)
+        self.limit = limit
+        self.rn_type = rn_type
+
+    def create(self, ctx: OperatorContext) -> TopNRowNumberOperator:
+        return TopNRowNumberOperator(ctx, self)
 
 
 class WindowOperatorFactory(OperatorFactory):

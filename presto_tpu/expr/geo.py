@@ -365,7 +365,12 @@ def st_contains(wkt_a: str, wkt_b: str) -> bool:
     """A contains B: every vertex of B inside A and no edge of B crosses
     out of A (exact for points; the standard approximation for
     area/line operands)."""
-    a, b = parse_wkt(wkt_a), parse_wkt(wkt_b)
+    return contains_geoms(parse_wkt(wkt_a), parse_wkt(wkt_b))
+
+
+def contains_geoms(a: Geometry, b: Geometry) -> bool:
+    """st_contains over pre-parsed geometries (the spatial-join hot
+    path: candidates are checked without re-parsing WKT per pair)."""
     if not a.vertices() or not b.vertices():
         return False  # EMPTY geometries contain/are contained by nothing
     if not a.is_area():
@@ -398,7 +403,10 @@ def st_within(wkt_a: str, wkt_b: str) -> bool:
 
 
 def st_intersects(wkt_a: str, wkt_b: str) -> bool:
-    a, b = parse_wkt(wkt_a), parse_wkt(wkt_b)
+    return intersects_geoms(parse_wkt(wkt_a), parse_wkt(wkt_b))
+
+
+def intersects_geoms(a: Geometry, b: Geometry) -> bool:
     if not a.vertices() or not b.vertices():
         return False  # EMPTY intersects nothing
     if _bbox_disjoint(a, b):

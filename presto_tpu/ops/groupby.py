@@ -165,6 +165,64 @@ def grouped_aggregate(
     return group_index, num_groups, results
 
 
+def clustered_aggregate(
+    key_columns: Sequence[Tuple[jax.Array, Optional[jax.Array], T.Type]],
+    aggs: Sequence[AggIn],
+    num_rows: jax.Array,
+    group_capacity: int,
+):
+    """Sort-free grouped aggregation over input ALREADY clustered by the
+    key columns (equal keys adjacent): run boundaries come from
+    neighbor comparison, groups are segment reductions in input order.
+    The StreamingAggregationOperator kernel
+    (StreamingAggregationOperator.java:38 role) — emitted groups keep
+    the input's key order, so the carry-across-batches merge is the
+    first/last group only.
+
+    Returns (group_index, num_groups, results) like grouped_aggregate,
+    with group_index pointing at each group's FIRST input row.
+    """
+    cap = key_columns[0][0].shape[0]
+    pad = jnp.arange(cap) >= num_rows
+    key_words, _ = normalize_keys(jnp, key_columns, nulls_equal=True)
+    boundary = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for w in key_words:
+        w = jnp.where(pad, jnp.int64(0), w)
+        boundary = boundary.at[1:].set(boundary[1:] | (w[1:] != w[:-1]))
+    boundary = boundary.at[1:].set(boundary[1:] | (pad[1:] != pad[:-1]))
+    boundary = boundary & ~pad  # pad rows fold into one trailing segment
+    gid = jnp.cumsum(boundary) - 1
+    gid = jnp.where(pad, gid[-1] + 1, gid).astype(jnp.int32)
+    num_groups = jnp.where(num_rows > 0, gid[-1] + 1
+                           - pad.any().astype(jnp.int32), 0)
+    first_pos = jnp.nonzero(boundary, size=group_capacity,
+                            fill_value=cap - 1)[0]
+
+    results = []
+    for prim, values, valid in aggs:
+        live = ~pad
+        if valid is not None:
+            live = live & valid
+        cnt = jax.ops.segment_sum(live.astype(jnp.int64), gid,
+                                  num_segments=group_capacity)
+        if prim == "count":
+            results.append((cnt, cnt))
+            continue
+        if prim == "sum":
+            v = jnp.where(live, values, jnp.asarray(0, values.dtype))
+            out = jax.ops.segment_sum(v, gid, num_segments=group_capacity)
+        elif prim == "min":
+            v = jnp.where(live, values, _min_identity(values.dtype))
+            out = jax.ops.segment_min(v, gid, num_segments=group_capacity)
+        elif prim == "max":
+            v = jnp.where(live, values, _max_identity(values.dtype))
+            out = jax.ops.segment_max(v, gid, num_segments=group_capacity)
+        else:
+            raise ValueError(f"unknown aggregation primitive {prim}")
+        results.append((out, cnt))
+    return first_pos, num_groups, results
+
+
 def direct_grouped_aggregate(
     key_codes: Sequence[Tuple[jax.Array, Optional[jax.Array]]],
     domain_sizes: Sequence[int],
@@ -412,6 +470,34 @@ def grouped_aggregate_jit(key_columns, aggs, num_rows,
               tuple(v for _, v, _ in key_columns),
               tuple(v for _, v, _ in aggs),
               tuple(v for _, _, v in aggs), num_rows)
+
+
+def clustered_aggregate_jit(key_columns, aggs, num_rows,
+                            group_capacity: int):
+    """clustered_aggregate as one cached jitted program."""
+    key_types = tuple(t for _, _, t in key_columns)
+    kvalid = tuple(v is not None for _, v, _ in key_columns)
+    prims = tuple(p for p, _, _ in aggs)
+    avalid = tuple(v is not None for _, _, v in aggs)
+    cap = key_columns[0][0].shape[0]
+    key = ("clustered", key_types, kvalid, prims, avalid, cap,
+           group_capacity)
+
+    def build():
+        def kernel(kvals, kvalids, avals, avalids, n):
+            kc = [(kvals[i], kvalids[i], key_types[i])
+                  for i in range(len(key_types))]
+            ag = [(prims[i], avals[i], avalids[i])
+                  for i in range(len(prims))]
+            return clustered_aggregate(kc, ag, n, group_capacity)
+
+        return jax.jit(kernel)
+
+    fn = _program(key, build)
+    return fn(tuple(v for v, _, _ in key_columns),
+              tuple(v for _, v, _ in key_columns),
+              tuple(v for v, _ in [(a[1], a[2]) for a in aggs]),
+              tuple(v for _, v in [(a[1], a[2]) for a in aggs]), num_rows)
 
 
 def global_aggregate_jit(aggs, num_rows):

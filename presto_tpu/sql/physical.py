@@ -221,6 +221,40 @@ class PhysicalPlanner:
         while isinstance(cur, FilterNode):
             filters.append(cur.predicate)
             cur = cur.source
+        if (filters and isinstance(cur, WindowNode)
+                and len(cur.functions) == 1
+                and cur.functions[0].name == "row_number"):
+            # TopNRowNumber fusion (TopNRowNumberOperator.java:38): a
+            # row_number <= N conjunct becomes a per-partition truncation
+            # inside the window sort; filtered rows never materialize
+            rn_ch = len(cur.source.columns)
+            limit, rest = _extract_rn_limit(filters, rn_ch)
+            if limit is not None:
+                from presto_tpu.exec.windowop import (
+                    TopNRowNumberOperatorFactory,
+                )
+
+                chain, splits = self._lower(cur.source)
+                chain.append(TopNRowNumberOperatorFactory(
+                    cur.partition_channels, cur.order_keys, limit,
+                    cur.columns[rn_ch][1]))
+                input_types = [t for _, t in cur.columns]
+                filt = None
+                if rest:
+                    filt = rest[-1]
+                    for f in reversed(rest[:-1]):
+                        filt = B.and_(filt, f)
+                if projections is None:
+                    projections = tuple(InputRef(i, t)
+                                        for i, t in enumerate(input_types))
+                chain.append(FilterProjectOperatorFactory(
+                    filt, list(projections), input_types))
+                return chain, splits
+        if (filters and isinstance(cur, JoinNode) and cur.kind == "cross"
+                and not cur.left_keys):
+            spatial = _extract_spatial(filters, len(cur.left.columns))
+            if spatial is not None:
+                return self._lower_spatial_join(cur, spatial, projections)
         chain, splits = self._lower(cur)
         input_types = [t for _, t in cur.columns]
         if filters and isinstance(cur, TableScanNode) and splits:
@@ -263,8 +297,17 @@ class PhysicalPlanner:
 
         ngroups = len(node.group_channels)
         if ngroups:
-            chain.append(HashAggregationOperatorFactory(
-                list(node.group_channels), agg_channels, input_types))
+            if self._streaming_eligible(chain, node.group_channels,
+                                        agg_channels, input_types):
+                from presto_tpu.exec.streamagg import (
+                    StreamingAggregationOperatorFactory,
+                )
+
+                chain.append(StreamingAggregationOperatorFactory(
+                    list(node.group_channels), agg_channels, input_types))
+            else:
+                chain.append(HashAggregationOperatorFactory(
+                    list(node.group_channels), agg_channels, input_types))
         else:
             chain.append(GlobalAggregationOperatorFactory(
                 agg_channels, input_types))
@@ -289,6 +332,43 @@ class PhysicalPlanner:
             chain.append(FilterProjectOperatorFactory(
                 None, exprs, post_in))
         return chain, splits
+
+    def _streaming_eligible(self, chain, group_channels,
+                            agg_channels, input_types) -> bool:
+        """True when the group keys trace to a PREFIX of the scan's
+        declared sort order (rows arrive clustered by the keys), so the
+        sort-free streaming aggregation applies
+        (StreamingAggregationOperator.java:38; eligibility is the
+        reference's LocalProperties/StreamPropertyDerivations check)."""
+        if not self.config.streaming_aggregation_enabled:
+            return False
+        for ch in agg_channels:
+            if ch.prim not in ("sum", "count", "min", "max"):
+                return False
+            if (ch.prim in ("min", "max") and ch.channel is not None
+                    and input_types[ch.channel].is_dictionary):
+                # the carry merge would compare interning codes
+                return False
+        from presto_tpu.exec.grouped import scan_column_for_channel
+
+        traced = []
+        scan = None
+        for g in group_channels:
+            hit = scan_column_for_channel(chain, g)
+            if hit is None:
+                return False
+            f, col = hit
+            if scan is None:
+                scan = f
+            elif scan is not f:
+                return False
+            traced.append(col)
+        if scan is None:
+            return False
+        order = scan.connector.sort_order(
+            scan.connector.get_table(scan.table))
+        k = len(traced)
+        return bool(order) and set(traced) == set(order[:k])
 
     # merge prim for each partial component prim (steps.py uses the same
     # table for the SPMD in-program exchange variant)
@@ -415,6 +495,45 @@ class PhysicalPlanner:
             return chain, splits
         raise NotImplementedError(f"{node.kind} join")
 
+    def _lower_spatial_join(self, node: JoinNode, spatial, projections):
+        """Filter(ST_pred)(cross join) -> grid-indexed spatial join
+        (SpatialJoinOperator.java:42 role): the right side becomes the
+        indexed build, candidates come from grid cells, and only they
+        run the exact predicate — no cartesian product."""
+        from presto_tpu.exec.spatialjoin import SpatialJoinOperatorFactory
+
+        kind, flip, build_expr, probe_expr, radius, rest = spatial
+        strict = False
+        if isinstance(radius, tuple):
+            radius, strict = radius
+        build_chain, build_splits = self._lower(node.right)
+        build = NestedLoopBuildOperatorFactory(
+            [t for _, t in node.right.columns])
+        build_chain.append(build)
+        self._done_pipelines.append(
+            Pipeline(build_chain, build_splits,
+                     name=self._name("spatialbuild")))
+        chain, splits = self._lower(node.left)
+        if flip:
+            # the probe side is the container: the operator's exact
+            # check swaps operand roles via the 'within' kind
+            kind = {"contains": "within"}.get(kind, kind)
+        chain.append(SpatialJoinOperatorFactory(
+            build, build_expr, probe_expr, kind, radius,
+            strict=strict))
+        types = [t for _, t in node.columns]
+        filt = None
+        if rest:
+            filt = rest[-1]
+            for f in reversed(rest[:-1]):
+                filt = B.and_(filt, f)
+        if projections is None:
+            projections = tuple(InputRef(i, t)
+                                for i, t in enumerate(types))
+        chain.append(FilterProjectOperatorFactory(
+            filt, list(projections), types))
+        return chain, splits
+
     def _try_grouped_join(self, node: JoinNode, probe_chain,
                           build_chain):
         """Grouped execution (P9, Lifespan.java:26-38): when both join
@@ -425,6 +544,11 @@ class PhysicalPlanner:
         lowering, reusing the same chains)."""
         k = self.config.grouped_execution_buckets
         if k <= 1 or len(node.left_keys) != 1 or node.residual is not None:
+            return None
+        if self.scan_shard is not None:
+            # distributed source stage: every task would run ALL buckets
+            # over the full table and duplicate the join output — bucket
+            # lifespans currently apply to single-task lowering only
             return None
         from presto_tpu.exec.grouped import (
             GroupedJoinSourceOperatorFactory, scan_column_for_channel,
@@ -486,6 +610,118 @@ class PhysicalPlanner:
     def _name(self, prefix: str) -> str:
         self._counter += 1
         return f"{prefix}{self._counter}"
+
+
+def _extract_spatial(filters, nleft: int):
+    """Find one spatial conjunct over a cross join whose two geometry
+    arguments come from opposite sides: ST_Contains/ST_Intersects(a, b)
+    or ST_Distance(a, b) <= r.  Returns (kind, flip, build_expr,
+    probe_expr, radius, remaining conjuncts) or None; expressions are
+    remapped into their side's own channel space."""
+    from presto_tpu.expr.ir import Call, Constant, input_channels
+    from presto_tpu.sql.optimizer import remap, split_and
+
+    conjuncts = []
+    for f in filters:
+        conjuncts.extend(split_and(f))
+
+    def sides(expr):
+        chans = input_channels(expr)
+        if not chans:
+            return None
+        if all(ch < nleft for ch in chans):
+            return "left"
+        if all(ch >= nleft for ch in chans):
+            return "right"
+        return None
+
+    def split_args(a, b):
+        sa, sb = sides(a), sides(b)
+        if sa == "left" and sb == "right":
+            return a, b, False   # probe_expr=a(left), build=b(right)
+        if sa == "right" and sb == "left":
+            return b, a, True
+        return None
+
+    found = None
+    rest = []
+    for c in conjuncts:
+        if found is None and isinstance(c, Call):
+            if c.name in ("st_contains", "st_intersects") \
+                    and len(c.args) == 2:
+                hit = split_args(c.args[0], c.args[1])
+                if hit is not None:
+                    probe_e, build_e, arg0_is_right = hit
+                    kind = ("intersects" if c.name == "st_intersects"
+                            else "contains")
+                    # contains(A, B): A is the container; flip when the
+                    # container argument came from the LEFT (probe) side
+                    flip = (kind == "contains") and not arg0_is_right
+                    found = (kind, flip, build_e, probe_e, None)
+                    continue
+            if c.name in ("le", "lt", "ge", "gt") and len(c.args) == 2:
+                a, b = c.args
+                op = c.name
+                if isinstance(a, Constant):
+                    a, b = b, a
+                    op = {"lt": "gt", "le": "ge",
+                          "gt": "lt", "ge": "le"}[op]
+                if (isinstance(a, Call) and a.name == "st_distance"
+                        and op in ("le", "lt") and isinstance(b, Constant)
+                        and isinstance(b.value, (int, float))):
+                    hit = split_args(a.args[0], a.args[1])
+                    if hit is not None:
+                        probe_e, build_e, _ = hit
+                        found = ("distance", False, build_e, probe_e,
+                                 (float(b.value), op == "lt"))
+                        continue
+        rest.append(c)
+    if found is None:
+        return None
+    kind, flip, build_e, probe_e, radius = found
+    build_e = remap(build_e, {ch: ch - nleft
+                              for ch in input_channels(build_e)})
+    return kind, flip, build_e, probe_e, radius, rest
+
+
+def _extract_rn_limit(filters, rn_channel: int):
+    """Find one ``row_number <= K`` upper bound among the filter
+    conjuncts; returns (K | None, remaining conjuncts)."""
+    from presto_tpu.expr.ir import Call, Constant, InputRef
+    from presto_tpu.sql.optimizer import split_and
+
+    conjuncts = []
+    for f in filters:
+        conjuncts.extend(split_and(f))
+    limit = None
+    rest = []
+    for c in conjuncts:
+        k = None
+        if (limit is None and isinstance(c, Call)
+                and c.name in ("le", "lt", "eq", "ge", "gt")
+                and len(c.args) == 2):
+            a, b = c.args
+            op = c.name
+            if isinstance(b, InputRef) and isinstance(a, Constant):
+                a, b = b, a
+                op = {"lt": "gt", "le": "ge",
+                      "gt": "lt", "ge": "le"}.get(op, op)
+            if (isinstance(a, InputRef) and a.index == rn_channel
+                    and isinstance(b, Constant)
+                    and isinstance(b.value, int)):
+                if op == "le":
+                    k = b.value
+                elif op == "lt":
+                    k = b.value - 1
+                elif op == "eq" and b.value == 1:
+                    k = 1
+        if k is not None and k >= 1:
+            # the per-partition truncation IS the bound (le/lt/eq-1 all
+            # keep exactly rows with rn <= k)
+            limit = k
+            continue
+        rest.append(c)
+    return limit, rest
 
 
 def _scan_table(scan_factory) -> str:

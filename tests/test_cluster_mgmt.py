@@ -16,6 +16,9 @@ from presto_tpu.server.coordinator import CoordinatorServer
 from presto_tpu.server.dqr import DistributedQueryRunner
 from presto_tpu.server.worker import WorkerServer
 
+pytestmark = pytest.mark.slow
+
+
 
 def _factory(scale=0.01):
     def factory():

@@ -18,6 +18,9 @@ import pytest
 
 from presto_tpu.localrunner import LocalQueryRunner
 
+pytestmark = pytest.mark.slow
+
+
 SCALE = 0.01
 TABLES = {
     # table -> numeric columns, string columns (dialect-neutral subset)

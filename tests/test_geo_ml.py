@@ -171,3 +171,59 @@ def test_empty_geometry_roundtrip(runner):
         "POINT EMPTY"
     assert one(runner, "ST_GeometryFromText('POLYGON EMPTY')") == \
         "POLYGON EMPTY"
+
+
+def test_spatial_join_uses_grid_index(runner):
+    """The Filter(ST_Contains)(cross join) shape lowers to the
+    grid-indexed SpatialJoinOperator (SpatialJoinOperator.java:42 +
+    PagesRTreeIndex role), not a cartesian product."""
+    runner.execute("CREATE TABLE memory.zones (zname varchar, zg varchar)")
+    rows = ", ".join(
+        f"('z{i}', 'POLYGON (({i*10} 0, {i*10+8} 0, {i*10+8} 8, "
+        f"{i*10} 8, {i*10} 0))')" for i in range(20))
+    runner.execute(f"INSERT INTO memory.zones VALUES {rows}")
+    runner.execute("CREATE TABLE memory.probes (pid bigint, px double, "
+                   "py double)")
+    pts = ", ".join(f"({i}, {i * 5 + 1}, 4)" for i in range(40))
+    runner.execute(f"INSERT INTO memory.probes VALUES {pts}")
+    got = sorted(runner.execute(
+        "SELECT p.pid, z.zname FROM memory.probes p, memory.zones z "
+        "WHERE ST_Contains(z.zg, ST_Point(p.px, p.py))").rows)
+    # oracle: point (5i+1, 4) is in zone j iff 10j <= 5i+1 <= 10j+8
+    want = sorted(
+        (i, f"z{(5 * i + 1) // 10}") for i in range(40)
+        if (5 * i + 1) % 10 <= 8 and (5 * i + 1) // 10 < 20)
+    assert got == want
+    stats = runner._last_task.operator_stats
+    assert any("SpatialJoin" in s.operator for s in stats), \
+        [s.operator for s in stats]
+
+
+def test_spatial_distance_join(runner):
+    runner.execute("CREATE TABLE memory.sites (sid bigint, sx double, "
+                   "sy double)")
+    runner.execute("INSERT INTO memory.sites VALUES (1, 0, 0), "
+                   "(2, 100, 100), (3, 0.5, 0.5)")
+    got = sorted(runner.execute(
+        "SELECT a.sid, b.sid FROM memory.sites a, memory.sites b "
+        "WHERE ST_Distance(ST_Point(a.sx, a.sy), "
+        "ST_Point(b.sx, b.sy)) <= 1.0 AND a.sid < b.sid").rows)
+    assert got == [(1, 3)]
+
+
+def test_spatial_distance_strict_vs_inclusive(runner):
+    """ST_Distance < r must exclude pairs at exactly r (the fused plan
+    must not widen < to <=)."""
+    runner.execute("CREATE TABLE memory.dpts (did bigint, dx double, "
+                   "dy double)")
+    runner.execute("INSERT INTO memory.dpts VALUES (1, 0, 0), (2, 1, 0)")
+    strict = runner.execute(
+        "SELECT a.did, b.did FROM memory.dpts a, memory.dpts b "
+        "WHERE ST_Distance(ST_Point(a.dx, a.dy), ST_Point(b.dx, b.dy)) "
+        "< 1.0 AND a.did < b.did").rows
+    assert strict == []
+    incl = runner.execute(
+        "SELECT a.did, b.did FROM memory.dpts a, memory.dpts b "
+        "WHERE ST_Distance(ST_Point(a.dx, a.dy), ST_Point(b.dx, b.dy)) "
+        "<= 1.0 AND a.did < b.did").rows
+    assert incl == [(1, 2)]

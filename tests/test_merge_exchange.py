@@ -7,6 +7,9 @@ import pytest
 from presto_tpu.localrunner import LocalQueryRunner
 from presto_tpu.server.dqr import DistributedQueryRunner
 
+pytestmark = pytest.mark.slow
+
+
 
 @pytest.fixture(scope="module")
 def cluster():

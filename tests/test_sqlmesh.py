@@ -9,6 +9,9 @@ import pytest
 from presto_tpu.localrunner import LocalQueryRunner
 from presto_tpu.parallel.sqlmesh import MeshQueryRunner, MeshUnsupported
 
+pytestmark = pytest.mark.slow
+
+
 SCALE = 0.005  # tiny: the 1-core CI host executes 8 shards sequentially
 
 

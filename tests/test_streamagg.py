@@ -1,0 +1,79 @@
+"""Streaming aggregation over key-clustered scans
+(StreamingAggregationOperator.java:38 role): results equal the hash
+aggregation, the carry survives batch boundaries, and the planner picks
+the operator exactly when the keys are a sort-order prefix."""
+
+import pytest
+
+from presto_tpu.config import EngineConfig
+from presto_tpu.localrunner import LocalQueryRunner
+
+SCALE = 0.01
+
+
+def _runner(streaming: bool, batch_rows: int = 4096) -> LocalQueryRunner:
+    cfg = EngineConfig(streaming_aggregation_enabled=streaming,
+                       task_concurrency=1, scan_batch_rows=batch_rows)
+    return LocalQueryRunner.tpch(scale=SCALE, config=cfg)
+
+
+@pytest.fixture(scope="module")
+def on():
+    return _runner(True)
+
+
+@pytest.fixture(scope="module")
+def off():
+    return _runner(False)
+
+
+def _same(on, off, sql):
+    a = sorted(on.execute(sql).rows, key=repr)
+    b = sorted(off.execute(sql).rows, key=repr)
+    assert len(a) == len(b), (len(a), len(b))
+    for x, y in zip(a, b):
+        for u, v in zip(x, y):
+            if isinstance(u, float):
+                assert u == pytest.approx(v, rel=1e-9), (x, y)
+            else:
+                assert u == v, (x, y)
+
+
+def test_clustered_group_by(on, off):
+    # l_orderkey is the lineitem sort key: streaming path engages
+    _same(on, off,
+          "select l_orderkey, count(*), sum(l_quantity), "
+          "min(l_extendedprice), max(l_discount) from lineitem "
+          "group by l_orderkey")
+    stats = on._last_task.operator_stats
+    assert any("StreamingAggregation" in s.operator for s in stats), \
+        [s.operator for s in stats]
+
+
+def test_carry_across_tiny_batches(on):
+    # 64-row batches guarantee many groups straddle batch boundaries
+    tiny = _runner(True, batch_rows=64)
+    base = _runner(False)
+    _same(tiny, base,
+          "select l_orderkey, count(*), sum(l_extendedprice) "
+          "from lineitem where l_orderkey < 500 group by l_orderkey")
+
+
+def test_multi_key_prefix(on, off):
+    _same(on, off,
+          "select l_orderkey, l_linenumber, sum(l_quantity) "
+          "from lineitem group by l_orderkey, l_linenumber")
+
+
+def test_non_prefix_uses_hash(on):
+    # l_partkey is not the sort key: the hash path must be chosen
+    on.execute("select l_partkey, count(*) from lineitem "
+               "where l_partkey < 50 group by l_partkey")
+    stats = on._last_task.operator_stats
+    assert not any("StreamingAggregation" in s.operator for s in stats)
+
+
+def test_filtered_clustered(on, off):
+    _same(on, off,
+          "select o_orderkey, count(*) from orders "
+          "where o_totalprice > 100000 group by o_orderkey")

@@ -14,6 +14,9 @@ from presto_tpu.connectors.tpcds import TpcdsConnector
 from presto_tpu.localrunner import LocalQueryRunner
 from tests.tpcds_queries import QUERIES
 
+pytestmark = pytest.mark.slow
+
+
 SCALE = 0.005
 
 

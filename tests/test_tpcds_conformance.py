@@ -13,6 +13,9 @@ import pytest
 
 from presto_tpu.localrunner import LocalQueryRunner
 
+pytestmark = pytest.mark.slow
+
+
 from test_tpch_conformance import (
     _sqlite_type, _to_sqlite, assert_rows_match, to_sqlite_sql,
 )

@@ -18,6 +18,9 @@ import pytest
 
 from presto_tpu.localrunner import LocalQueryRunner
 
+pytestmark = pytest.mark.slow
+
+
 from tpch_queries import QUERIES
 
 SCALE = 0.01
