@@ -78,6 +78,13 @@ class EngineConfig:
     # Build-side key domains prune probe rows before the join kernel
     # (DynamicFilterSourceOperator role, SURVEY §2.6).
     dynamic_filtering_enabled: bool = True
+    # Whole-query execution: compile supported queries into ONE XLA
+    # program (the parallel/sqlmesh lowering on a single-device mesh)
+    # instead of per-operator dispatches — repeat executions are a
+    # single device dispatch.  Falls back to the operator tier for
+    # unsupported shapes.  Off by default: the operator tier remains
+    # the reference path.
+    whole_query_execution: bool = False
     # Sorted/clustered-input aggregation (StreamingAggregationOperator
     # role): group keys tracing to a prefix of the scan's sort order
     # aggregate run-by-run with no sort and one open group carried.

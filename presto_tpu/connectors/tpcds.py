@@ -726,6 +726,10 @@ class _TpcdsPageSource(PageSource):
 class TpcdsConnector(Connector):
     """The tpcds catalog: TPC-DS tables generated on the fly."""
 
+    # generated data never changes: whole-query programs
+    # may cache device-resident scans
+    immutable_data = True
+
     name = "tpcds"
 
     def __init__(self, scale: float = 1.0):

@@ -612,6 +612,10 @@ class _TpchPageSource(PageSource):
 class TpchConnector(Connector):
     """The tpch catalog: tables generated on the fly at a given scale."""
 
+    # generated data never changes: whole-query programs
+    # may cache device-resident scans
+    immutable_data = True
+
     name = "tpch"
 
     def __init__(self, scale: float = 1.0, money: str = "double"):

@@ -104,7 +104,7 @@ class SpatialJoinOperator(Operator):
     # -- probe ----------------------------------------------------------
     def add_input(self, batch: Batch) -> None:
         from presto_tpu.expr.geo import (
-            contains_geoms, intersects_geoms, st_distance,
+            contains_geoms, distance_geoms, intersects_geoms,
         )
 
         self.ctx.stats.input_rows += batch.num_rows
@@ -153,10 +153,7 @@ class SpatialJoinOperator(Operator):
                     elif self.f.kind == "intersects":
                         ok = intersects_geoms(bg, pg)
                     else:  # distance
-                        from presto_tpu.expr.geo import format_wkt
-
-                        d = st_distance(format_wkt(bg),
-                                        format_wkt(pg))
+                        d = distance_geoms(bg, pg)
                         ok = d is not None and (
                             d < self.f.radius if self.f.strict
                             else d <= self.f.radius)

@@ -26,7 +26,7 @@ import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.batch import Batch, Column, next_bucket
-from presto_tpu.exec.aggregation import AggChannel, _minmax_dict_input
+from presto_tpu.exec.aggregation import AggChannel
 from presto_tpu.exec.context import OperatorContext
 from presto_tpu.exec.operator import Operator, OperatorFactory
 
@@ -56,23 +56,19 @@ class StreamingAggregationOperator(Operator):
         key_cols = [data.columns[c] for c in self.group_channels]
         key_triples = [(c.values, c.valid, c.type) for c in key_cols]
         agg_ins = []
-        posts = []
         for a in self.aggs:
             if a.channel is None:
                 agg_ins.append(("count", jnp.zeros(data.capacity, jnp.int8),
                                 None))
-                posts.append(None)
             else:
                 col = data.columns[a.channel]
-                vals, post = _minmax_dict_input(a, col)
-                agg_ins.append((a.prim, vals, col.valid))
-                posts.append(post)
+                agg_ins.append((a.prim, col.values, col.valid))
         cap = data.capacity
         group_cap = next_bucket(min(cap, max(data.num_rows, 1)),
                                 minimum=16)
         gi, ng, results = clustered_aggregate_jit(
             key_triples, agg_ins, jnp.asarray(data.num_rows), group_cap)
-        return key_cols, gi, int(ng), results, posts, group_cap
+        return key_cols, gi, int(ng), results, group_cap
 
     # -- carry merge (the combine rule per primitive) --------------------
     @staticmethod
@@ -94,7 +90,7 @@ class StreamingAggregationOperator(Operator):
         self.ctx.stats.input_rows += batch.num_rows
         if batch.num_rows == 0:
             return
-        (key_cols, gi, ng, results, posts,
+        (key_cols, gi, ng, results,
          group_cap) = self._aggregate_batch(batch)
         if ng == 0:
             return
@@ -103,12 +99,8 @@ class StreamingAggregationOperator(Operator):
         key_out = [c.take(gi_h).to_numpy() for c in key_cols]
         vals_h = []
         cnts_h = []
-        for (values, cnt), post in zip(results, posts):
-            v = np.asarray(values)[:ng]
-            if post is not None:
-                codes, d = post(values[:ng])
-                v = np.asarray(codes)
-            vals_h.append(v)
+        for values, cnt in results:
+            vals_h.append(np.asarray(values)[:ng])
             cnts_h.append(np.asarray(cnt)[:ng])
         first_key = tuple(k.to_pylist(ng)[0] for k in key_out)
 
@@ -164,14 +156,8 @@ class StreamingAggregationOperator(Operator):
         if a.prim == "count":
             return Column(a.out_type, vals)
         valid = cnts > 0
-        d = None
-        if a.channel is not None and a.prim in ("min", "max"):
-            src = self.input_types[a.channel]
-            if src.is_dictionary:
-                # _minmax_dict_input's post already mapped ranks->codes
-                d = None
         return Column(a.out_type, vals,
-                      None if bool(valid.all()) else valid, d)
+                      None if bool(valid.all()) else valid)
 
     def _state_batch(self, key_cols: List[Column],
                      state: List[Tuple[object, int]]) -> Batch:
@@ -213,6 +199,13 @@ class StreamingAggregationOperatorFactory(OperatorFactory):
     def __init__(self, group_channels: Sequence[int],
                  aggs: Sequence[AggChannel],
                  input_types: Sequence[T.Type]):
+        for a in aggs:
+            # the planner's eligibility check guarantees this; direct
+            # construction must honor it too (the carry merge would
+            # compare dictionary interning codes)
+            assert not (a.prim in ("min", "max") and a.channel is not None
+                        and input_types[a.channel].is_dictionary), \
+                "min/max over dictionary columns is not streamable"
         self.group_channels = list(group_channels)
         self.aggs = list(aggs)
         self.input_types = list(input_types)

@@ -431,10 +431,13 @@ def intersects_geoms(a: Geometry, b: Geometry) -> bool:
 
 
 def st_distance(wkt_a: str, wkt_b: str) -> Optional[float]:
-    a, b = parse_wkt(wkt_a), parse_wkt(wkt_b)
+    return distance_geoms(parse_wkt(wkt_a), parse_wkt(wkt_b))
+
+
+def distance_geoms(a: Geometry, b: Geometry) -> Optional[float]:
     if not a.vertices() or not b.vertices():
         return None  # NULL for EMPTY operands (reference behavior)
-    if st_intersects(wkt_a, wkt_b):
+    if intersects_geoms(a, b):
         return 0.0
     best = math.inf
     a_edges = a.edges()

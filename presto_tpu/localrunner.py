@@ -94,6 +94,7 @@ class LocalQueryRunner:
         self.event_bus = EventBus()
         self._last_task = None
         self._query_seq = 0
+        self._whole_query = None   # lazy MeshQueryRunner (1-device)
 
     @classmethod
     def tpch(cls, scale: float = 0.01,
@@ -673,7 +674,34 @@ class LocalQueryRunner:
         logical = Planner(self.metadata).plan(q)
         optimized = optimize(logical, self.metadata)
         self._check_scans(optimized)
+        if cfg.whole_query_execution:
+            result = self._try_whole_query(q, optimized)
+            if result is not None:
+                return result
         phys = PhysicalPlanner(self.registry, cfg).plan(optimized)
         self._last_task = execute_pipelines(phys.pipelines, cfg)
         return QueryResult(phys.column_names, phys.column_types,
                            phys.collector.rows())
+
+    def _try_whole_query(self, q: t.Node,
+                         optimized) -> Optional[QueryResult]:
+        """Whole-query XLA execution: the mesh-SQL lowering on a
+        single-device mesh compiles the ENTIRE query into one cached
+        program — repeat executions are one device dispatch instead of
+        per-operator round-trips (decisive on remote-attached TPUs
+        where each dispatch costs ~0.1-1 s).  Unsupported shapes fall
+        back to the operator tier."""
+        from presto_tpu.parallel.sqlmesh import (
+            MeshQueryRunner, MeshUnsupported,
+        )
+
+        if self._whole_query is None:
+            self._whole_query = MeshQueryRunner(
+                self.registry, self.metadata.default_catalog,
+                n_devices=1, config=self.config)
+        try:
+            # the optimized plan is reused (no second plan+optimize);
+            # access control already ran over its scans
+            return self._whole_query.execute_plan(optimized, repr(q))
+        except MeshUnsupported:
+            return None

@@ -35,8 +35,13 @@ import jax.numpy as jnp
 from presto_tpu import types as T
 from presto_tpu.ops.keys import normalize_keys
 
-_BUILD_DEAD = jnp.int64(-2)   # build row excluded (null key or padding)
-_PROBE_DEAD = jnp.int64(-1)   # probe row excluded (null key or padding)
+# Dead-row sentinels as plain Python ints, NOT jnp scalars: a module
+# imported lazily inside a jit trace would bake module-level jnp values
+# as tracers of that trace, poisoning every later program that closes
+# over them (observed: whole-query programs compiled with phantom
+# parameters).  Literals promote to the operand dtype at use sites.
+_BUILD_DEAD = -2   # build row excluded (null key or padding)
+_PROBE_DEAD = -1   # probe row excluded (null key or padding)
 
 
 def canonical_ids(

@@ -164,20 +164,45 @@ class MeshQueryRunner:
         return cls(reg, "tpch", n_devices, config)
 
     def plan_distributed(self, sql: str):
-        from presto_tpu.server.fragmenter import Fragmenter
+        from presto_tpu.sql.parser import parse_statement
+
+        return self.plan_distributed_stmt(parse_statement(sql))
+
+    def plan_distributed_stmt(self, stmt):
         from presto_tpu.sql import tree as t
         from presto_tpu.sql.optimizer import optimize
-        from presto_tpu.sql.parser import parse_statement
         from presto_tpu.sql.planner import Planner
 
-        stmt = parse_statement(sql)
         if not isinstance(stmt, (t.Query, t.SetOperation)):
             raise MeshUnsupported("only queries run on the mesh")
         logical = Planner(self.metadata).plan(stmt)
-        optimized = optimize(logical, self.metadata)
+        return self.fragment_plan(optimize(logical, self.metadata))
+
+    def fragment_plan(self, optimized):
+        from presto_tpu.server.fragmenter import Fragmenter
+
         return Fragmenter(metadata=self.metadata).fragment(optimized)
 
     def execute(self, sql: str):
+        from presto_tpu.sql.parser import parse_statement
+
+        return self.execute_stmt(parse_statement(sql), key=sql)
+
+    def execute_stmt(self, stmt, key: Optional[str] = None):
+        """Execute a parsed query; ``key`` caches the compiled program
+        (falls back to the statement's repr — tree nodes are frozen
+        dataclasses, so the repr is a stable structural key)."""
+        return self._execute_planned(
+            key if key is not None else repr(stmt),
+            lambda: self.plan_distributed_stmt(stmt))
+
+    def execute_plan(self, optimized, key: str):
+        """Execute an ALREADY-optimized logical plan (LocalQueryRunner's
+        whole-query path plans once and hands it over)."""
+        return self._execute_planned(
+            key, lambda: self.fragment_plan(optimized))
+
+    def _execute_planned(self, sql: str, make_dplan):
         from presto_tpu.localrunner import QueryResult
 
         cached = self._programs.get(sql)
@@ -191,7 +216,7 @@ class MeshQueryRunner:
                 return QueryResult(dplan.column_names, dplan.column_types,
                                    batch.to_pylist())
             del self._programs[sql]
-        dplan = self.plan_distributed(sql)
+        dplan = make_dplan()
         for frag in dplan.fragments:
             _check_supported(frag.root)
         last_err = None
@@ -201,7 +226,8 @@ class MeshQueryRunner:
                                 prepared=prog)
             batch, overflowed = prog.run()
             if not overflowed:
-                self._programs[sql] = prog
+                if prog.cacheable:
+                    self._programs[sql] = prog
                 return QueryResult(dplan.column_names, dplan.column_types,
                                    batch.to_pylist())
             last_err = f"overflow at cap_scale={1 << attempt}"
@@ -226,6 +252,10 @@ class _MeshProgram:
         self.config = runner.config
         self._jitted = None
         self._args = None
+        # a retry shares the prepared scans, so it must inherit their
+        # mutability verdict (scan prep is the only place it is learned)
+        self.cacheable = prepared.cacheable if prepared is not None \
+            else True
         if prepared is not None:
             # overflow retry: only capacities change — reuse the loaded,
             # sharded scan inputs instead of re-reading every base table
@@ -249,6 +279,11 @@ class _MeshProgram:
     def _prepare_scan(self, node: TableScanNode, frag) -> None:
         P = self.nparts
         conn = self.runner.registry.get(node.catalog)
+        if not getattr(conn, "immutable_data", False):
+            # the compiled program embeds this scan's rows; a mutable
+            # table (memory connector INSERTs...) would serve stale data
+            # from the cache — execute, but do not cache
+            self.cacheable = False
         handle = conn.get_table(node.table)
         splits = conn.get_splits(handle, 1)
         batches = []
@@ -349,7 +384,12 @@ class _MeshProgram:
             self._args = [
                 jax.device_put(a, row_sharding(self.runner.mesh, 1))
                 for a in self.inputs]
-            self._jitted = jax.jit(mapped)
+            # AOT-compile and keep the loaded executable: the plain
+            # jit dispatch path can lose the trace-time constant buffers
+            # when several whole-query programs coexist in one process
+            # (observed as "supplied N buffers but expected N+consts");
+            # the AOT executable binds its constants explicitly
+            self._jitted = jax.jit(mapped).lower(*self._args).compile()
         out = self._jitted(*self._args)
         out = [np.asarray(a) for a in out]
         of = bool(out[-3].any())

@@ -47,6 +47,12 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "dynamic_filtering_enabled": ("dynamic_filtering_enabled",
                                   lambda v: v.lower() in ("true", "1",
                                                           "on")),
+    "whole_query_execution": ("whole_query_execution",
+                              lambda v: v.lower() in ("true", "1", "on")),
+    "streaming_aggregation_enabled": (
+        "streaming_aggregation_enabled",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "grouped_execution_buckets": ("grouped_execution_buckets", int),
 }
 
 

@@ -50,9 +50,15 @@ def test_raw_decoder():
     assert d.decode(msg) == (77, "wxyz")
 
 
-def test_make_decoder_avro_gated():
-    with pytest.raises(ValueError, match="avro"):
-        make_decoder("avro", COLS, [None] * 3)
+def test_make_decoder_avro_needs_schema():
+    """The avro decoder requires the table description's dataSchema
+    (it is no longer gated on an external library)."""
+    from presto_tpu.connectors.api import ColumnMetadata
+    from presto_tpu.connectors.decoder import make_decoder
+    from presto_tpu import types as T
+
+    with pytest.raises(ValueError, match="dataSchema"):
+        make_decoder("avro", [ColumnMetadata("a", T.BIGINT)], [None])
 
 
 def test_kafka_transport_gated():
