@@ -148,6 +148,10 @@ class AvroRowDecoder(RowDecoder):
     def _read(self, typ, buf: memoryview, pos: int):
         if isinstance(typ, list):              # union: varint branch
             branch, pos = self._varint(buf, pos)
+            if not 0 <= branch < len(typ):
+                # a negative branch would silently pick typ[-1] via Python
+                # indexing and decode garbage; reject the row instead
+                raise ValueError(f"avro union branch {branch} out of range")
             return self._read(typ[branch], buf, pos)
         if isinstance(typ, dict):
             typ = typ.get("type", "null")

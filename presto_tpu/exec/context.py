@@ -96,6 +96,21 @@ class TaskContext:
         self.config = query.config
         self.memory = MemoryContext(query.memory, f"task:{task_id}")
         self.operator_stats: List[OperatorStats] = []
+        self._cleanups: List = []
+
+    def register_cleanup(self, fn) -> None:
+        """Register an idempotent resource-release callback to run at task
+        teardown (the SqlTask cleanup role): a backstop for reservations
+        normally released by a downstream pipeline that may never run."""
+        self._cleanups.append(fn)
+
+    def close(self) -> None:
+        cleanups, self._cleanups = self._cleanups, []
+        for fn in cleanups:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
 
 
 class OperatorContext:

@@ -40,8 +40,9 @@ _PACKABLE = ("bigint", "integer", "smallint", "tinyint", "date", "boolean")
 
 
 def _is_single_word_type(t: T.Type) -> bool:
-    return (T.is_integral(t) or t.name in ("date", "timestamp", "boolean")
-            or isinstance(t, T.DecimalType) or t.is_dictionary)
+    from presto_tpu.ops.join import single_word_joinable
+
+    return single_word_joinable(t, t.is_dictionary)
 
 
 @dataclasses.dataclass
@@ -54,9 +55,11 @@ class LookupSource:
     data: Batch                    # padded device build batch
     n_build: int
     key_channels: List[int]
-    mins: Optional[np.ndarray] = None     # packed: per-channel min
+    mins: Optional[np.ndarray] = None     # packed: per-channel min;
+                                          # single: build live min (device)
     strides: Optional[np.ndarray] = None  # packed: per-channel stride
     maxs: Optional[np.ndarray] = None
+    has_null_key: object = None           # device bool scalar (single/packed)
 
 
 class LookupSourceFactory:
@@ -95,16 +98,27 @@ class SpilledLookupSource:
 
 @jax.jit
 def _build_index_single(kv_pair, num_rows):
-    """Single-word build: ids + sorted index, one XLA program."""
+    """Single-word build: ids + sorted index + the live minimum, one XLA
+    program.  Ids are (value - min + 2) so NEGATIVE key values map to
+    valid non-negative ids too (the sentinels own {-2,-1}); the min rides
+    to the probe side as a device scalar — no host sync."""
     from presto_tpu.ops import join as J
 
     values, valid = kv_pair
     cap = values.shape[0]
-    dead = jnp.arange(cap) >= num_rows
+    in_row = jnp.arange(cap) < num_rows
+    dead = ~in_row
     if valid is not None:
         dead = dead | ~valid
-    ids = jnp.where(dead, jnp.int64(-2), values.astype(jnp.int64) + 2)
-    return J.build_index(ids)
+        has_null = (in_row & ~valid).any()
+    else:
+        has_null = jnp.zeros((), bool)
+    v = values.astype(jnp.int64)
+    bmin = jnp.min(jnp.where(dead, jnp.int64(2**62), v))
+    bmin = jnp.where(jnp.all(dead), jnp.int64(0), bmin)
+    ids = jnp.where(dead, jnp.int64(-2), v - bmin + 2)
+    sb, perm = J.build_index(ids)
+    return sb, perm, bmin, has_null
 
 
 @jax.jit
@@ -128,14 +142,18 @@ def _build_index_packed(pairs, mins, strides, num_rows):
     from presto_tpu.ops import join as J
 
     cap = pairs[0][0].shape[0]
-    dead = jnp.arange(cap) >= num_rows
+    in_row = jnp.arange(cap) < num_rows
+    dead = ~in_row
+    has_null = jnp.zeros((), bool)
     ids = jnp.zeros(cap, jnp.int64)
     for i, (values, valid) in enumerate(pairs):
         if valid is not None:
             dead = dead | ~valid
+            has_null = has_null | (in_row & ~valid).any()
         ids = ids + (values.astype(jnp.int64) - mins[i]) * strides[i]
     ids = jnp.where(dead, jnp.int64(-2), ids)
-    return J.build_index(ids)
+    sb, perm = J.build_index(ids)
+    return sb, perm, has_null
 
 
 class HashBuildOperator(Operator):
@@ -143,6 +161,10 @@ class HashBuildOperator(Operator):
         super().__init__(ctx)
         self.f = factory
         factory._build_ctxs.append(ctx)
+        # backstop: if the probe pipeline never instantiates (earlier
+        # pipeline failure / cancellation between pipelines) the task
+        # teardown releases the build reservation instead of the probe
+        ctx.task.register_cleanup(factory.release)
         self._batches: List[Batch] = []
         self._spiller = None
         self._accumulated_bytes = 0
@@ -218,10 +240,17 @@ class HashBuildOperator(Operator):
         key_pairs = tuple(
             (data.columns[c].values, data.columns[c].valid) for c in chans)
         if len(chans) == 1 and _is_single_word_type(data.columns[chans[0]].type):
-            sb, perm = _build_index_single(key_pairs[0], n)
-            self.f.lookup.set(LookupSource("single", sb, perm, data, n_build,
-                                           chans))
-            return
+            # one host sync guards the id arithmetic: a live key spread
+            # >= 2^62 would overflow the (value - min + 2) ids, silently
+            # dropping matches — such builds take the canonical path
+            los, his = _key_ranges(key_pairs, n)
+            if int(his[0]) - int(los[0]) < (1 << 62):
+                sb, perm, bmin, has_null = _build_index_single(
+                    key_pairs[0], n)
+                self.f.lookup.set(LookupSource(
+                    "single", sb, perm, data, n_build, chans, mins=bmin,
+                    has_null_key=has_null))
+                return
         if all(_is_single_word_type(data.columns[c].type) for c in chans):
             # pack multi-channel integer keys using build-side ranges
             los, his = _key_ranges(key_pairs, n)        # one host sync
@@ -238,11 +267,12 @@ class HashBuildOperator(Operator):
                 span_product *= int(hi - lo + 1)
             if span_product < (1 << 62):
                 strides_a = np.asarray(strides, np.int64)
-                sb, perm = _build_index_packed(
+                sb, perm, has_null = _build_index_packed(
                     key_pairs, jnp.asarray(los), jnp.asarray(strides_a), n)
                 self.f.lookup.set(LookupSource(
                     "packed", sb, perm, data, n_build, chans,
-                    mins=los, strides=strides_a, maxs=his))
+                    mins=los, strides=strides_a, maxs=his,
+                    has_null_key=has_null))
                 return
         # general path: probe side will materialize and union-sort
         self.f.lookup.set(LookupSource("canonical", None, None, data,
@@ -274,9 +304,12 @@ class HashBuildOperatorFactory(OperatorFactory):
         """Drop the lookup source and the build-side reservation.  Called
         when the probe finishes — under grouped execution this is what
         makes peak memory scale with 1/buckets (Lifespan retirement,
-        execution/Lifespan.java:26-38 role)."""
+        execution/Lifespan.java:26-38 role).  Idempotent: contexts are
+        freed once; the task-teardown backstop may call this again for a
+        build whose probe pipeline never instantiated."""
         self.lookup.source = None
-        for ctx in self._build_ctxs:
+        ctxs, self._build_ctxs = self._build_ctxs, []
+        for ctx in ctxs:
             ctx.memory.free()
 
 
@@ -289,8 +322,10 @@ def _ids_from_pairs(jnp, pairs, key_channels, mode, mins, strides, maxs,
         if pairs[c][1] is not None:
             dead = dead | ~pairs[c][1]
     if mode == "single":
-        ids = pairs[key_channels[0]][0].astype(jnp.int64) + 2
-        return jnp.where(dead, jnp.int64(-1), ids)
+        # mins = build-side live minimum (device scalar); probe values
+        # below it cannot match any build row -> dead sentinel
+        ids = pairs[key_channels[0]][0].astype(jnp.int64) - mins + 2
+        return jnp.where(dead | (ids < 0), jnp.int64(-1), ids)
     ids = jnp.zeros(cap, jnp.int64)
     for i, c in enumerate(key_channels):
         v = pairs[c][0].astype(jnp.int64)
@@ -311,6 +346,7 @@ class _StreamStatics:
     key_channels: Tuple[int, ...]
     out_cap: int
     n_probe_cols: int
+    null_aware: bool = False
 
 
 @_partial(jax.jit, static_argnames=("key_channels", "mode", "join_type"))
@@ -332,7 +368,7 @@ def _probe_expand_total(probe_pairs, sorted_ids, perm, mins, strides,
 
 @_partial(jax.jit, static_argnames=("s",))
 def _stream_probe(probe_pairs, build_pairs, sorted_ids, perm, mins,
-                  strides, maxs, num_rows, *, s: _StreamStatics):
+                  strides, maxs, num_rows, bstats, *, s: _StreamStatics):
     """Phase 2: the streaming probe kernel (inner/left expansion or
     semi/anti masks) as one XLA program.  All build-side data arrives as
     traced arguments: nothing is baked into the executable, so the
@@ -346,10 +382,17 @@ def _stream_probe(probe_pairs, build_pairs, sorted_ids, perm, mins,
     lo, counts = J.probe_counts(sorted_ids, perm, ids)
     live = ids >= 0
     if s.join_type in ("semi", "anti"):
-        mask = J.semi_mask(counts, live, anti=(s.join_type == "anti"))
         if s.join_type == "anti":
-            pad = jnp.arange(cap) >= num_rows
-            mask = mask | ((~live) & (~pad))   # NOT IN keeps null-key rows
+            in_row = jnp.arange(cap) < num_rows
+            key_nonnull = jnp.ones(cap, bool)
+            for c in s.key_channels:
+                if probe_pairs[c][1] is not None:
+                    key_nonnull = key_nonnull & probe_pairs[c][1]
+            n_build, has_null = bstats
+            mask = J.anti_keep_mask(counts, live, key_nonnull, in_row,
+                                    s.null_aware, n_build, has_null)
+        else:
+            mask = J.semi_mask(counts, live, anti=False)
         idx, count = selected_positions(mask, None, num_rows, cap)
         idx = idx.astype(jnp.int32)
         outs = tuple(
@@ -400,8 +443,9 @@ class LookupJoinOperator(Operator):
             if batch.columns[c].valid is not None:
                 dead = dead | ~batch.columns[c].valid
         if src.mode == "single":
-            ids = batch.columns[chans[0]].values.astype(jnp.int64) + 2
-            return jnp.where(dead, jnp.int64(-1), ids)
+            ids = (batch.columns[chans[0]].values.astype(jnp.int64)
+                   - src.mins + 2)
+            return jnp.where(dead | (ids < 0), jnp.int64(-1), ids)
         assert src.mode == "packed"
         ids = jnp.zeros(cap, jnp.int64)
         for i, c in enumerate(chans):
@@ -501,6 +545,10 @@ class LookupJoinOperator(Operator):
             mins = jnp.asarray(src.mins)
             strides = jnp.asarray(src.strides)
             maxs = jnp.asarray(src.maxs)
+        elif src.mode == "single":
+            # build-side live minimum (device scalar from the build kernel)
+            mins = src.mins
+            strides = maxs = jnp.zeros(1, jnp.int64)
         else:
             mins = strides = maxs = jnp.zeros(1, jnp.int64)
         probe_pairs = tuple(column_pairs(batch))
@@ -513,10 +561,13 @@ class LookupJoinOperator(Operator):
                 n, key_channels=kc, mode=src.mode, join_type=join_type))
             out_cap = next_bucket(max(etotal, 1))
         s = _StreamStatics(src.mode, join_type, kc, out_cap,
-                           batch.num_columns)
+                           batch.num_columns, self.f.null_aware)
+        bstats = (jnp.asarray(src.n_build, jnp.int64),
+                  src.has_null_key if src.has_null_key is not None
+                  else jnp.zeros((), bool))
         outs, count, _ = _stream_probe(
             probe_pairs, build_pairs, src.sorted_ids, src.perm, mins,
-            strides, maxs, n, s=s)
+            strides, maxs, n, bstats, s=s)
         # expansion joins already synced the exact total in phase 1; only
         # semi/anti need to read the selected count (host round-trips are
         # ~1s each on remote-attached devices)
@@ -580,13 +631,26 @@ class LookupJoinOperator(Operator):
                         mask = (live & ~any_pass) | ((~live) & (~pad))
                 else:
                     etotal = zero
-                    mask = J.semi_mask(counts, live,
-                                       anti=(join_type == "anti"))
-                    # null-key rows: SQL anti (NOT EXISTS) keeps them:
                     if join_type == "anti":
-                        pad = jnp.arange(cap) >= num_rows
-                        nullkey = (~live) & (~pad)
-                        mask = mask | nullkey
+                        in_row = jnp.arange(cap) < num_rows
+                        key_nonnull = jnp.ones(cap, bool)
+                        for c in probe_op.f.probe_key_channels:
+                            if probe_cols_pairs[c][1] is not None:
+                                key_nonnull = (key_nonnull
+                                               & probe_cols_pairs[c][1])
+                        bhn = jnp.zeros((), bool)
+                        for c in probe_op.f.build.key_channels:
+                            bvalid = build_cols_pairs[c][1]
+                            if bvalid is not None:
+                                bin_row = (jnp.arange(bvalid.shape[0])
+                                           < src.n_build)
+                                bhn = bhn | (bin_row & ~bvalid).any()
+                        mask = J.anti_keep_mask(
+                            counts, live, key_nonnull, in_row,
+                            probe_op.f.null_aware,
+                            jnp.int64(src.n_build), bhn)
+                    else:
+                        mask = J.semi_mask(counts, live, anti=False)
                 idx, count = selected_positions(mask, None, num_rows,
                                                 cap)
                 idx = idx.astype(jnp.int32)
@@ -647,7 +711,26 @@ class LookupJoinOperator(Operator):
         if join_type in ("semi", "anti"):
             cres = self._residual_compiled(probe, src)
             if cres is None:
-                mask = J.semi_mask(counts, live, anti=(join_type == "anti"))
+                if join_type == "anti":
+                    in_row = jnp.arange(cap) < n
+                    key_nonnull = jnp.ones(cap, bool)
+                    for c in self.f.probe_key_channels:
+                        if probe.columns[c].valid is not None:
+                            key_nonnull = (key_nonnull
+                                           & probe.columns[c].valid)
+                    bhn = jnp.zeros((), bool)
+                    for c in self.f.build.key_channels:
+                        bc = src.data.columns[c]
+                        if bc.valid is not None:
+                            bin_row = (jnp.arange(bc.valid.shape[0])
+                                       < src.data.num_rows)
+                            bhn = bhn | (bin_row & ~bc.valid).any()
+                    mask = J.anti_keep_mask(
+                        counts, live, key_nonnull, in_row,
+                        self.f.null_aware,
+                        jnp.int64(src.data.num_rows), bhn)
+                else:
+                    mask = J.semi_mask(counts, live, anti=False)
             else:
                 out_cap = next_bucket(cap * self.f.expansion)
                 while True:
@@ -670,9 +753,11 @@ class LookupJoinOperator(Operator):
                 any_pass = jnp.zeros(cap, bool).at[pi].max(ok, mode="drop")
                 mask = (live & ~any_pass if join_type == "anti"
                         else live & any_pass)
-            if join_type == "anti":
-                pad = jnp.arange(cap) >= n
-                mask = mask | ((~live) & (~pad))
+                if join_type == "anti":
+                    # residual anti = correlated NOT EXISTS: null-key
+                    # rows never match, keep them
+                    pad = jnp.arange(cap) >= n
+                    mask = mask | ((~live) & (~pad))
             idx, count = selected_positions(mask, None, n, cap)
             cols = tuple(
                 Column(c.type, c.values[idx],
@@ -790,7 +875,7 @@ class LookupJoinOperatorFactory(OperatorFactory):
                  probe_key_channels: Sequence[int],
                  probe_types: Sequence[T.Type],
                  join_type: str = "inner", expansion: int = 2,
-                 residual=None):
+                 residual=None, null_aware: bool = False):
         assert join_type in ("inner", "left", "semi", "anti")
         if residual is not None and join_type not in ("semi", "anti"):
             # inner-join residuals become post-join filters in the
@@ -804,6 +889,7 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self.join_type = join_type
         self.expansion = expansion
         self.residual = residual
+        self.null_aware = null_aware
 
     def create(self, ctx: OperatorContext) -> LookupJoinOperator:
         return LookupJoinOperator(ctx, self)

@@ -101,12 +101,15 @@ def execute_pipelines(pipelines: Sequence[Pipeline],
     task = TaskContext(query)
     if on_task_context is not None:
         on_task_context(task)
-    for p in pipelines:
-        prefix = _parallel_prefix(p, config)
-        width = min(config.task_concurrency, len(p.splits))
-        if prefix > 0 and width > 1:
-            _run_parallel(p, task, prefix, width)
-        else:
-            driver = p.instantiate(task)
-            driver.run_to_completion()
+    try:
+        for p in pipelines:
+            prefix = _parallel_prefix(p, config)
+            width = min(config.task_concurrency, len(p.splits))
+            if prefix > 0 and width > 1:
+                _run_parallel(p, task, prefix, width)
+            else:
+                driver = p.instantiate(task)
+                driver.run_to_completion()
+    finally:
+        task.close()
     return task

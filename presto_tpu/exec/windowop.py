@@ -25,6 +25,98 @@ from presto_tpu.exec.sortop import SortSpec
 from presto_tpu.sql.plan import PlanWindowFunction
 
 
+def eval_window_function(fn: PlanWindowFunction, columns, seg, peer):
+    """Evaluate one window function over partition-sorted columns.
+
+    ``columns`` is any sequence of column-like objects exposing
+    ``values / valid / type / dictionary`` (the operator tier's Column and
+    the mesh tier's MCol both do).  Returns
+    ``(result_type, values, valid|None, dictionary|None)``.
+    """
+    import jax.numpy as jnp
+
+    from presto_tpu.ops import window as W
+
+    name = fn.name
+    rt = fn.result_type
+    if name == "row_number":
+        return rt, W.row_number(seg), None, None
+    if name == "rank":
+        return rt, W.rank(seg, peer), None, None
+    if name == "dense_rank":
+        return rt, W.dense_rank(seg, peer), None, None
+    if name == "percent_rank":
+        return rt, W.percent_rank(seg, peer), None, None
+    if name == "cume_dist":
+        return rt, W.cume_dist(seg, peer), None, None
+    if name == "ntile":
+        return rt, W.ntile(seg, fn.offset), None, None
+
+    if name in ("lag", "lead"):
+        c = columns[fn.arg_channels[0]]
+        default = (columns[fn.default_channel].values
+                   if fn.default_channel is not None else None)
+        off = fn.offset if name == "lag" else -fn.offset
+        vals, ok = W.shift_in_partition(seg, c.values, c.valid, off,
+                                        default)
+        return rt, vals, ok, c.dictionary
+
+    lo, hi = W.frame_ends(seg, peer, fn.frame_unit, fn.frame_start,
+                          fn.frame_end, fn.frame_start_offset,
+                          fn.frame_end_offset)
+    if name in ("first_value", "nth_value"):
+        c = columns[fn.arg_channels[0]]
+        k = fn.offset or 1
+        target = lo + (k - 1)
+        in_frame = target <= hi
+        tc = jnp.clip(target, 0, c.values.shape[0] - 1)
+        vals = c.values[tc]
+        ok = in_frame if c.valid is None else (in_frame & c.valid[tc])
+        return rt, vals, ok, c.dictionary
+    if name == "last_value":
+        c = columns[fn.arg_channels[0]]
+        vals, ok = W.value_at(c.values, c.valid, hi)
+        ok = ok & (lo <= hi)
+        return rt, vals, ok, c.dictionary
+
+    # framed aggregates
+    if name == "count":
+        if not fn.arg_channels:
+            ones = jnp.ones(seg.shape[0], jnp.int64)
+            s, _ = W.framed_sum_count(seg, ones, None, lo, hi)
+            return rt, s, None, None
+        c = columns[fn.arg_channels[0]]
+        _, cnt = W.framed_sum_count(
+            seg, jnp.zeros(seg.shape[0], jnp.int64), c.valid, lo, hi)
+        return rt, cnt, None, None
+    if name in ("sum", "avg"):
+        c = columns[fn.arg_channels[0]]
+        vals = c.values
+        if T.is_integral(c.type) or isinstance(c.type, T.DecimalType):
+            vals = vals.astype(jnp.int64)
+        s, cnt = W.framed_sum_count(seg, vals, c.valid, lo, hi)
+        ok = cnt > 0
+        if name == "sum":
+            return rt, s.astype(rt.np_dtype), ok, None
+        cnt_safe = jnp.maximum(cnt, 1)
+        if isinstance(rt, T.DecimalType):
+            # scaled-integer average, round half away from zero
+            q = s / cnt_safe
+            avg = jnp.where(q >= 0, jnp.floor(q + 0.5),
+                            jnp.ceil(q - 0.5)).astype(jnp.int64)
+            return rt, avg, ok, None
+        avg = s.astype(jnp.float64) / cnt_safe.astype(jnp.float64)
+        return rt, avg, ok, None
+    if name in ("min", "max"):
+        c = columns[fn.arg_channels[0]]
+        vals, ok = W.framed_minmax(seg, peer, c.values, c.valid,
+                                   fn.frame_unit, fn.frame_start,
+                                   fn.frame_end, is_max=(name == "max"),
+                                   lo=lo, hi=hi)
+        return rt, vals, ok, c.dictionary
+    raise NotImplementedError(f"window function {name}")
+
+
 class WindowOperator(Operator):
     def __init__(self, ctx: OperatorContext,
                  partition_channels: Sequence[int],
@@ -126,89 +218,8 @@ class WindowOperator(Operator):
 
     def _eval_function(self, fn: PlanWindowFunction, data: Batch,
                        seg, peer) -> Column:
-        import jax.numpy as jnp
-
-        from presto_tpu.ops import window as W
-
-        name = fn.name
-        rt = fn.result_type
-        if name == "row_number":
-            return Column(rt, W.row_number(seg))
-        if name == "rank":
-            return Column(rt, W.rank(seg, peer))
-        if name == "dense_rank":
-            return Column(rt, W.dense_rank(seg, peer))
-        if name == "percent_rank":
-            return Column(rt, W.percent_rank(seg, peer))
-        if name == "cume_dist":
-            return Column(rt, W.cume_dist(seg, peer))
-        if name == "ntile":
-            return Column(rt, W.ntile(seg, fn.offset))
-
-        if name in ("lag", "lead"):
-            c = data.columns[fn.arg_channels[0]]
-            default = (data.columns[fn.default_channel].values
-                       if fn.default_channel is not None else None)
-            off = fn.offset if name == "lag" else -fn.offset
-            vals, ok = W.shift_in_partition(seg, c.values, c.valid, off,
-                                            default)
-            return Column(rt, vals, ok, c.dictionary)
-
-        lo, hi = W.frame_ends(seg, peer, fn.frame_unit, fn.frame_start,
-                              fn.frame_end, fn.frame_start_offset,
-                              fn.frame_end_offset)
-        if name in ("first_value", "nth_value"):
-            c = data.columns[fn.arg_channels[0]]
-            k = fn.offset or 1
-            target = lo + (k - 1)
-            in_frame = target <= hi
-            tc = jnp.clip(target, 0, c.values.shape[0] - 1)
-            vals = c.values[tc]
-            ok = in_frame if c.valid is None else (in_frame & c.valid[tc])
-            return Column(rt, vals, ok, c.dictionary)
-        if name == "last_value":
-            c = data.columns[fn.arg_channels[0]]
-            vals, ok = W.value_at(c.values, c.valid, hi)
-            ok = ok & (lo <= hi)
-            return Column(rt, vals, ok, c.dictionary)
-
-        # framed aggregates
-        if name == "count":
-            if not fn.arg_channels:
-                ones = jnp.ones(seg.shape[0], jnp.int64)
-                s, _ = W.framed_sum_count(seg, ones, None, lo, hi)
-                return Column(rt, s)
-            c = data.columns[fn.arg_channels[0]]
-            _, cnt = W.framed_sum_count(
-                seg, jnp.zeros(seg.shape[0], jnp.int64), c.valid, lo, hi)
-            return Column(rt, cnt)
-        if name in ("sum", "avg"):
-            c = data.columns[fn.arg_channels[0]]
-            vals = c.values
-            if T.is_integral(c.type) or isinstance(c.type, T.DecimalType):
-                vals = vals.astype(jnp.int64)
-            s, cnt = W.framed_sum_count(seg, vals, c.valid, lo, hi)
-            if name == "sum":
-                ok = cnt > 0
-                return Column(rt, s.astype(rt.np_dtype), ok)
-            ok = cnt > 0
-            cnt_safe = jnp.maximum(cnt, 1)
-            if isinstance(rt, T.DecimalType):
-                # scaled-integer average, round half away from zero
-                q = s / cnt_safe
-                avg = jnp.where(q >= 0, jnp.floor(q + 0.5),
-                                jnp.ceil(q - 0.5)).astype(jnp.int64)
-                return Column(rt, avg, ok)
-            avg = s.astype(jnp.float64) / cnt_safe.astype(jnp.float64)
-            return Column(rt, avg, ok)
-        if name in ("min", "max"):
-            c = data.columns[fn.arg_channels[0]]
-            vals, ok = W.framed_minmax(seg, peer, c.values, c.valid,
-                                       fn.frame_unit, fn.frame_start,
-                                       fn.frame_end, is_max=(name == "max"),
-                                       lo=lo, hi=hi)
-            return Column(rt, vals, ok, c.dictionary)
-        raise NotImplementedError(f"window function {name}")
+        rt, vals, ok, d = eval_window_function(fn, data.columns, seg, peer)
+        return Column(rt, vals, ok, d)
 
     def get_output(self) -> Optional[Batch]:
         out, self._output = self._output, None

@@ -703,5 +703,17 @@ class LocalQueryRunner:
             # the optimized plan is reused (no second plan+optimize);
             # access control already ran over its scans
             return self._whole_query.execute_plan(optimized, repr(q))
-        except MeshUnsupported:
+        except (MeshUnsupported, NotImplementedError):
+            return None
+        except ValueError:
+            # query-semantic errors surfaced during mesh EXECUTION (e.g.
+            # "scalar subquery returned more than one row") are the user's
+            # answer, not a lowering failure — don't re-run the query
+            raise
+        except Exception as exc:  # noqa: BLE001 - operator tier can still run
+            import warnings
+            warnings.warn(
+                f"whole-query mesh trace failed ({type(exc).__name__}: {exc}); "
+                "falling back to the operator tier", RuntimeWarning,
+                stacklevel=2)
             return None

@@ -61,7 +61,12 @@ def canonical_ids(
     pw, pnull = normalize_keys(jnp, probe_keys, nulls_equal=False)
     words = [jnp.concatenate([b, p]) for b, p in zip(bw, pw)]
     n = cap_b + cap_p
-    if len(words) == 1:
+    from presto_tpu.ops.radix import radix_argsort_i64, use_radix
+
+    if use_radix():
+        perm = radix_argsort_i64(words)
+        sorted_words = [w[perm] for w in words]
+    elif len(words) == 1:
         combined = words[0]
         perm = jnp.argsort(combined)
         sorted_words = [combined[perm]]
@@ -85,6 +90,29 @@ def canonical_ids(
     return build_ids, probe_ids
 
 
+def single_word_joinable(typ: T.Type, has_dictionary: bool = False) -> bool:
+    """May this key channel take the single-word fast path (values ARE
+    the ids)?  Integer-word types and dictionary codes qualify."""
+    return (has_dictionary or T.is_integral(typ)
+            or typ.name in ("date", "timestamp", "boolean")
+            or isinstance(typ, T.DecimalType))
+
+
+def single_word_span_too_big(build_key, n_build) -> jax.Array:
+    """Device flag: the live build-key spread would overflow the
+    (value - min + 2) id arithmetic (callers must then route to the
+    canonical path, or fail over to a tier that can)."""
+    values, valid, _ = build_key
+    cap = values.shape[0]
+    dead = jnp.arange(cap) >= n_build
+    if valid is not None:
+        dead = dead | ~valid
+    u = values.astype(jnp.int64).astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+    umin = jnp.min(jnp.where(dead, jnp.uint64(2**64 - 1), u))
+    umax = jnp.max(jnp.where(dead, jnp.uint64(0), u))
+    return (~jnp.all(dead)) & ((umax - umin) >= jnp.uint64(1 << 62))
+
+
 def single_word_ids(
     build_key: Tuple[jax.Array, Optional[jax.Array], T.Type],
     probe_key: Tuple[jax.Array, Optional[jax.Array], T.Type],
@@ -94,17 +122,17 @@ def single_word_ids(
     """Fast path for one integer-typed key channel: values ARE the ids.
 
     Requires a type whose normalized word is the value itself (ints, dates,
-    decimals, dictionary codes).  Negative values are lifted by shifting is
-    NOT done — instead dead rows use sentinels below int64 min-plausible
-    keys; to stay exact we offset values by +2 and reserve {-2,-1}.
+    decimals, dictionary codes).  Both sides shift by the build side's
+    live minimum so ids are non-negative for every matchable value —
+    negative keys included — leaving {-2,-1} as dead-row sentinels.
+    Probe values below the build minimum cannot match any build row, so
+    mapping them to the dead sentinel preserves inner/semi semantics,
+    and anti joins read the separate live mask, not the id.
     """
     bvals, bvalid, btyp = build_key
     pvals, pvalid, ptyp = probe_key
     b = bvals.astype(jnp.int64)
     p = pvals.astype(jnp.int64)
-    # shift by +2 so sentinels are strictly below every live id
-    b = b + 2
-    p = p + 2
     cap_b, cap_p = b.shape[0], p.shape[0]
     dead_b = jnp.arange(cap_b) >= n_build
     dead_p = jnp.arange(cap_p) >= n_probe
@@ -112,25 +140,123 @@ def single_word_ids(
         dead_b = dead_b | ~bvalid
     if pvalid is not None:
         dead_p = dead_p | ~pvalid
+    bmin = jnp.min(jnp.where(dead_b, jnp.int64(2**62), b))
+    bmin = jnp.where(jnp.all(dead_b), jnp.int64(0), bmin)
+    b = b - bmin + 2
+    p = p - bmin + 2
     return (jnp.where(dead_b, _BUILD_DEAD, b),
-            jnp.where(dead_p, _PROBE_DEAD, p))
+            jnp.where(dead_p | (p < 0), _PROBE_DEAD, p))
 
 
 def build_index(build_ids: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Sort the build side: the LookupSource build
     (HashBuilderOperator finish -> PagesHash ctor analogue)."""
-    perm = jnp.argsort(build_ids)
+    from presto_tpu.ops.radix import radix_argsort_i64, use_radix
+
+    if use_radix():
+        perm = radix_argsort_i64([build_ids])
+    else:
+        perm = jnp.argsort(build_ids)
     return build_ids[perm], perm
+
+
+def _lower_bound(sorted_arr: jax.Array, queries: jax.Array,
+                 inclusive: bool) -> jax.Array:
+    """Vectorized binary search as a static loop of flat gathers —
+    measured ~2.5x faster than XLA's searchsorted lowering on v5e
+    (random gather is ~7 ms/M rows; searchsorted's per-step cost was
+    ~17 ms/M).  ``inclusive=False`` -> first i with arr[i] >= q (left);
+    ``inclusive=True`` -> first i with arr[i] > q (right)."""
+    n = sorted_arr.shape[0]
+    lo = jnp.zeros(queries.shape[0], jnp.int32)
+    hi = jnp.full(queries.shape[0], n, jnp.int32)
+    for _ in range(n.bit_length()):
+        mid = (lo + hi) >> 1
+        v = sorted_arr[jnp.minimum(mid, n - 1)]
+        go_right = (v <= queries) if inclusive else (v < queries)
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    return lo
+
+
+def _dense_scratch(cap_b: int, cap_p: int) -> int:
+    """Static histogram size for the dense-domain probe path: large
+    enough for generated-key ranges at small/medium scale, capped so the
+    scratch stays tens of MB."""
+    want = 4 * (cap_b + cap_p)
+    size = 1 << 14
+    while size < want and size < (1 << 24):
+        size <<= 1
+    return size
 
 
 def probe_counts(sorted_build: jax.Array, perm_b: jax.Array,
                  probe_ids: jax.Array):
-    """Per-probe-row match range in the sorted build order."""
-    lo = jnp.searchsorted(sorted_build, probe_ids, side="left")
-    hi = jnp.searchsorted(sorted_build, probe_ids, side="right")
-    live = probe_ids >= 0
-    counts = jnp.where(live, hi - lo, 0)
-    return lo, counts
+    """Per-probe-row match range in the sorted build order.
+
+    Two runtime-selected strategies (one compiled program, lax.cond):
+    when the live build-key span fits a static histogram, match ranges
+    come from two gathers into (hist, starts) arrays — the BigintGroupByHash
+    dense-path idea applied to the probe (GroupByHash.java:30-43 role);
+    otherwise vectorized binary search over the sorted build."""
+    cap_b = sorted_build.shape[0]
+    live_b = sorted_build >= 0
+    n_dead = (cap_b - live_b.sum()).astype(jnp.int32)
+    live_p = probe_ids >= 0
+    S = _dense_scratch(cap_b, probe_ids.shape[0])
+
+    bmin = jnp.min(jnp.where(live_b, sorted_build, jnp.int64(2**62)))
+    bmax = jnp.max(jnp.where(live_b, sorted_build, jnp.int64(-1)))
+    any_b = live_b.any()
+    fits = any_b & ((bmax - bmin) < (S - 1))
+
+    def dense(_):
+        off = jnp.where(live_b, sorted_build - bmin, jnp.int64(S))
+        hist = (jnp.zeros(S, jnp.int32)
+                .at[off.astype(jnp.int32)].add(1, mode="drop"))
+        starts_d = (jnp.cumsum(hist) - hist).astype(jnp.int32)
+        q = probe_ids - bmin
+        in_rng = live_p & (q >= 0) & (q < S)
+        qi = jnp.clip(q, 0, S - 1).astype(jnp.int32)
+        cnt = jnp.where(in_rng, hist[qi], 0)
+        lo_ = jnp.where(in_rng, n_dead + starts_d[qi], 0)
+        return lo_.astype(jnp.int64), cnt.astype(jnp.int64)
+
+    def search(_):
+        lo_ = _lower_bound(sorted_build, probe_ids, inclusive=False)
+        hi_ = _lower_bound(sorted_build, probe_ids, inclusive=True)
+        cnt = jnp.where(live_p, hi_ - lo_, 0)
+        return lo_.astype(jnp.int64), cnt.astype(jnp.int64)
+
+    return jax.lax.cond(fits, dense, search, 0)
+
+
+def _expand_probe_idx(emit: jax.Array, out_capacity: int):
+    """Map each output slot to its source probe row, scatter-free of
+    search: mark each emitting row's start slot with +1, cumsum over the
+    output space, and translate emit-rank back to row via a compacted
+    index.  Replaces an out_capacity-query searchsorted that measured
+    2.7 s/4M slots on v5e with ~2 scatters + a cumsum (~50 ms)."""
+    n = emit.shape[0]
+    inclusive = jnp.cumsum(emit)
+    total = inclusive[-1]
+    starts = (inclusive - emit).astype(jnp.int64)
+    emitting = emit > 0
+    erank = (jnp.cumsum(emitting.astype(jnp.int32)) - 1).astype(jnp.int32)
+    # emit-rank -> probe row (rank r is the r-th emitting row)
+    rows = (jnp.zeros(n, jnp.int32)
+            .at[jnp.where(emitting, erank, n)]
+            .set(jnp.arange(n, dtype=jnp.int32), mode="drop",
+                 unique_indices=True))
+    # +1 at each emitting row's first output slot (disjoint ranges ->
+    # distinct starts among emitting rows); slots past out_capacity drop
+    start_slots = jnp.where(emitting & (starts < out_capacity), starts,
+                            jnp.int64(out_capacity))
+    flag = (jnp.zeros(out_capacity, jnp.int32)
+            .at[start_slots.astype(jnp.int32)].add(1, mode="drop"))
+    dense_rank = jnp.cumsum(flag) - 1
+    probe_idx = rows[jnp.clip(dense_rank, 0, n - 1)]
+    return probe_idx.astype(jnp.int64), starts, total
 
 
 def expand_matches(lo: jax.Array, counts: jax.Array, perm_b: jax.Array,
@@ -142,12 +268,8 @@ def expand_matches(lo: jax.Array, counts: jax.Array, perm_b: jax.Array,
     unmatched [out_cap], total).  ``total`` may exceed out_capacity (host
     re-runs bigger).
     """
-    inclusive = jnp.cumsum(counts)
-    total = inclusive[-1]
-    starts = inclusive - counts
+    probe_idx, starts, total = _expand_probe_idx(counts, out_capacity)
     j = jnp.arange(out_capacity)
-    probe_idx = jnp.searchsorted(inclusive, j, side="right")
-    probe_idx = jnp.minimum(probe_idx, counts.shape[0] - 1)
     k = j - starts[probe_idx]
     build_sorted_pos = jnp.minimum(lo[probe_idx] + k, perm_b.shape[0] - 1)
     build_idx = perm_b[build_sorted_pos]
@@ -160,12 +282,8 @@ def expand_matches_outer(lo: jax.Array, counts: jax.Array, live_probe: jax.Array
                          perm_b: jax.Array, out_capacity: int):
     """Left-outer expansion: every live probe row emits max(count, 1) rows."""
     emit = jnp.where(live_probe, jnp.maximum(counts, 1), 0)
-    inclusive = jnp.cumsum(emit)
-    total = inclusive[-1]
-    starts = inclusive - emit
+    probe_idx, starts, total = _expand_probe_idx(emit, out_capacity)
     j = jnp.arange(out_capacity)
-    probe_idx = jnp.searchsorted(inclusive, j, side="right")
-    probe_idx = jnp.minimum(probe_idx, counts.shape[0] - 1)
     k = j - starts[probe_idx]
     unmatched = counts[probe_idx] == 0
     build_sorted_pos = jnp.minimum(lo[probe_idx] + k, perm_b.shape[0] - 1)
@@ -180,6 +298,31 @@ def semi_mask(counts: jax.Array, live_probe: jax.Array, anti: bool):
     if anti:
         return live_probe & (counts == 0)
     return live_probe & (counts > 0)
+
+
+def anti_keep_mask(counts: jax.Array, live_ids: jax.Array,
+                   key_nonnull: jax.Array, in_row: jax.Array,
+                   null_aware: bool, n_build_rows=None, build_has_null=None):
+    """Which probe rows survive an anti join.
+
+    NOT EXISTS (``null_aware=False``): keep every unmatched in-range row,
+    null keys included (they never match anything).
+
+    NOT IN (``null_aware=True``) follows SQL three-valued logic
+    (SemiJoinNode's nullable-output contract in the reference,
+    HashSemiJoinOperator.java:47): an empty filtering side keeps every
+    row; otherwise a NULL probe key or any NULL among the filtering keys
+    makes the predicate UNKNOWN -> row excluded; matched rows are FALSE
+    -> excluded; only non-null unmatched rows against a null-free side
+    survive.  ``live_ids`` = id >= 0 (non-null AND within build range);
+    ``key_nonnull`` = the key columns are actually non-null (an id can be
+    dead merely for being below the build minimum).
+    """
+    if not null_aware:
+        return in_row & ((live_ids & (counts == 0)) | ~live_ids)
+    empty = n_build_rows == 0
+    survive = in_row & key_nonnull & (counts == 0) & ~build_has_null
+    return jnp.where(empty, in_row, survive)
 
 
 def matched_build_mask(lo: jax.Array, counts: jax.Array, cap_b: int,

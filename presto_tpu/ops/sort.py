@@ -24,7 +24,15 @@ SortKey = Tuple[jax.Array, Optional[jax.Array], T.Type, bool, bool]
 
 def sort_permutation(keys: Sequence[SortKey], num_rows: jax.Array) -> jax.Array:
     """Stable permutation ordering live rows by the sort spec; padding rows
-    sort to the end."""
+    sort to the end.
+
+    On TPU this routes to the radix passes (ops/radix.py): XLA's sort
+    lowering compiles in time proportional to N there, the radix program
+    in O(1).  CPU/GPU keep the native sort."""
+    from presto_tpu.ops.radix import radix_sort_permutation, use_radix
+
+    if use_radix():
+        return radix_sort_permutation(keys, num_rows)
     cap = keys[0][0].shape[0]
     pad = (jnp.arange(cap) >= num_rows).astype(jnp.int8)
     major = []  # built major-to-minor, reversed for lexsort below

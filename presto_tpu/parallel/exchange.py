@@ -45,7 +45,13 @@ def repartition(
     P = jax.lax.axis_size(axis_name)
     C = dest.shape[0]
     d = jnp.where(live, dest.astype(jnp.int32), jnp.int32(P))
-    order = jnp.argsort(d)  # stable: preserves row order within a bucket
+    from presto_tpu.ops.radix import counting_sort_perm, use_radix
+
+    if use_radix():
+        # single counting pass over the (static) P+1 bucket domain
+        order = counting_sort_perm(d, P + 1)
+    else:
+        order = jnp.argsort(d)  # stable: preserves row order within a bucket
     ds = d[order]
     buckets = jnp.arange(P, dtype=ds.dtype)
     starts = jnp.searchsorted(ds, buckets, side="left")

@@ -103,7 +103,7 @@ class MTable:
 
 
 def _check_supported(node: PlanNode) -> None:
-    if isinstance(node, (WindowNode, UnnestNode)):
+    if isinstance(node, UnnestNode):
         raise MeshUnsupported(type(node).__name__)
     for _, t in node.columns:
         if t.is_nested:
@@ -391,29 +391,72 @@ class _MeshProgram:
             # the AOT executable binds its constants explicitly
             self._jitted = jax.jit(mapped).lower(*self._args).compile()
         out = self._jitted(*self._args)
-        out = [np.asarray(a) for a in out]
-        of = bool(out[-3].any())
-        err = bool(out[-2].any())
+        # Read only the control outputs eagerly — on a remote-attached
+        # TPU every host transfer costs a tunnel round trip, and the
+        # content arrays are full static capacity regardless of how few
+        # rows are live.
+        of = bool(np.asarray(out[-3]).any())
         if of:
-            flags = out[-1].reshape(self.nparts, -1)
+            flags = np.asarray(out[-1]).reshape(self.nparts, -1)
             self.overflow_labels = [
                 lbl for i, lbl in enumerate(self._flag_labels)
                 if flags[:, i].any()]
             return Batch((), 0), True
-        if err:
+        if bool(np.asarray(out[-2]).any()):
             raise ValueError(
                 "scalar subquery returned more than one row")
-        live_g = out[-4]
+        live_g = np.asarray(out[-4])
         cap = live_g.shape[0] // self.nparts
         live = live_g[:cap]
-        idx = np.nonzero(live)[0]
+        n_live = int(live.sum())
+        ncols = len(self._out_meta)
+        # One extra device dispatch compacts live rows to a prefix bucket
+        # and stacks same-dtype outputs, so the host reads a handful of
+        # right-sized arrays instead of 2*ncols capacity-sized ones (the
+        # tunnel charges a round trip per array AND bytes).
+        bucket = min(next_bucket(max(n_live, 1), minimum=8), cap)
+        host = self._sliced_content(out, cap, bucket, ncols)
         cols = []
         for i, (typ, d) in enumerate(self._out_meta):
-            vals = out[2 * i][:cap][idx]
-            valid = out[2 * i + 1][:cap][idx]
+            vals = host[2 * i][:n_live]
+            valid = host[2 * i + 1][:n_live]
             cols.append(Column(typ, vals,
                                None if valid.all() else valid, d))
-        return Batch(tuple(cols), len(idx)), False
+        return Batch(tuple(cols), n_live), False
+
+    def _sliced_content(self, out, cap: int, bucket: int, ncols: int):
+        """Device-side stable compaction of live rows + slice to the
+        ``bucket`` prefix; transfers O(live) bytes instead of O(cap)."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_slicers"):
+            self._slicers = {}
+        arrays = list(out[:2 * ncols])
+        # group same-dtype outputs into one stacked transfer each: the
+        # tunnel charges a round trip PER ARRAY, which dominates once the
+        # payloads are small
+        groups: Dict[object, List[int]] = {}
+        for i, a in enumerate(arrays):
+            groups.setdefault(np.dtype(a.dtype), []).append(i)
+        layout = tuple(sorted((str(k), tuple(v)) for k, v in groups.items()))
+        fn = self._slicers.get((bucket, layout))
+        if fn is None:
+            from presto_tpu.ops.radix import stable_partition_perm
+
+            def slicer(arrs, live_full):
+                perm = stable_partition_perm(~live_full[:cap])[:bucket]
+                return tuple(jnp.stack([arrs[i][:cap][perm] for i in idxs])
+                             for _, idxs in layout)
+
+            fn = jax.jit(slicer)
+            self._slicers[(bucket, layout)] = fn
+        stacked = [np.asarray(a) for a in fn(tuple(arrays), out[-4])]
+        host: List[Optional[np.ndarray]] = [None] * len(arrays)
+        for (_, idxs), mat in zip(layout, stacked):
+            for row, i in enumerate(idxs):
+                host[i] = mat[row]
+        return host
 
     # ---------------- traced lowering ----------------
     def _lower_fragment(self, fid: int) -> MTable:
@@ -545,7 +588,85 @@ class _MeshProgram:
             return _concat([self._lower(s) for s in node.inputs])
         if isinstance(node, EnforceSingleRowNode):
             return self._lower_single_row(node)
+        if isinstance(node, WindowNode):
+            return self._lower_window(node)
         raise MeshUnsupported(f"mesh lowering for {type(node).__name__}")
+
+    def _lower_window(self, node: WindowNode) -> MTable:
+        """Window functions as segmented scans over a partition-sorted
+        shard (WindowOperator.java:61 role; kernels in ops/window.py,
+        shared with the operator tier via eval_window_function).
+
+        Window fragments are single-partitioned (the fragmenter's
+        _parallel_safe veto), so a sharded input is first replicated —
+        every shard then holds whole partitions and computes identical
+        results, which is exactly the 'single' fragment contract."""
+        import jax.numpy as jnp
+
+        from presto_tpu.exec.windowop import eval_window_function
+        from presto_tpu.ops import window as W
+
+        src = self._lower(node.source)
+        if not src.replicated and self.nparts > 1:
+            from presto_tpu.parallel.exchange import broadcast_rows
+            from presto_tpu.parallel.mesh import AXIS
+
+            ct = _compact(src)
+            out_cap = next_bucket(self.nparts * src.est, minimum=8)
+            arrays = []
+            for c in ct.cols:
+                arrays.append(c.values)
+                arrays.append(c.valid if c.valid is not None
+                              else jnp.ones(ct.cap, bool))
+            recv, n_recv, of = broadcast_rows(arrays, ct.num_rows,
+                                              out_cap, AXIS)
+            self._overflow.append(('window gather', of))
+            cols = [MCol(recv[2 * i], recv[2 * i + 1], c.type, c.dictionary)
+                    for i, c in enumerate(ct.cols)]
+            src = MTable(cols, jnp.arange(out_cap) < n_recv, out_cap,
+                         self.nparts * src.est, compacted=True,
+                         replicated=True)
+        table = _compact(src)
+        cap = table.cap
+        n = table.num_rows
+
+        sort_keys = [(ch, True, False) for ch in node.partition_channels]
+        sort_keys += [(ch, asc, bool(nf)) for ch, asc, nf in node.order_keys]
+        if sort_keys:
+            table = self._sort(table, sort_keys)
+        live = jnp.arange(cap) < n
+
+        def eq_prev(ch: int):
+            c = table.cols[ch]
+            v = c.values
+            same = jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), v[1:] == v[:-1]])
+            if c.valid is not None:
+                g = c.valid
+                both_null = jnp.concatenate(
+                    [jnp.ones((1,), jnp.bool_), (~g[1:]) & (~g[:-1])])
+                both_ok = jnp.concatenate(
+                    [jnp.ones((1,), jnp.bool_), g[1:] & g[:-1]])
+                same = both_null | (both_ok & same)
+            return same
+
+        part_eq = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                   live[1:] == live[:-1]])
+        for ch in node.partition_channels:
+            part_eq = part_eq & eq_prev(ch)
+        seg = W.segment_ids(part_eq)
+        peer_eq = part_eq
+        for ch, _, _ in node.order_keys:
+            peer_eq = peer_eq & eq_prev(ch)
+        peer = W.segment_ids(peer_eq)
+
+        out_cols = list(table.cols)
+        for fn in node.functions:
+            rt, vals, ok, d = eval_window_function(fn, table.cols, seg,
+                                                   peer)
+            out_cols.append(MCol(vals, ok, rt, d))
+        return MTable(out_cols, live, cap, table.est, compacted=True,
+                      replicated=table.replicated)
 
     def _lower_scan(self, node: TableScanNode) -> MTable:
         import jax.numpy as jnp
@@ -633,6 +754,24 @@ class _MeshProgram:
         )
         fin = _finalize
 
+        if (node.step == "final" and self.nparts == 1
+                and isinstance(node.source, RemoteSourceNode)
+                and len(node.source.fragment_ids) == 1):
+            # Single-device mesh: the partial/final split exists to ride a
+            # hash exchange between fragments; with one shard the exchange
+            # is an identity and the split just aggregates TWICE over the
+            # full capacity.  Fuse back into one single-step aggregation
+            # over the partial's source (the whole-query LocalRunner tier
+            # always runs here).
+            fid = node.source.fragment_ids[0]
+            root = self.dplan.fragments[fid].root
+            if (isinstance(root, AggregationNode) and root.step == "partial"
+                    and fid not in self._cache):
+                fused = AggregationNode(root.source, root.group_channels,
+                                        node.aggregates, node.columns,
+                                        step="single")
+                return self._lower_agg(fused)
+
         src = self._lower(node.source)
         input_types = [t for _, t in node.source.columns]
         ngroups = len(node.group_channels)
@@ -664,20 +803,25 @@ class _MeshProgram:
 
         if ngroups:
             key_cols = [src.cols[c] for c in node.group_channels]
-            key_triples = [(c.values, c.valid, c.type) for c in key_cols]
-            group_cap = src.cap
-            gi, ng, results = grouped_aggregate(
-                key_triples, aggs, src.cap, group_cap, live_mask=src.live)
-            self._overflow.append(('groupby', ng > group_cap))
-            out_cols: List[MCol] = []
-            for c in key_cols:
-                out_cols.append(MCol(
-                    c.values[gi],
-                    None if c.valid is None else c.valid[gi],
-                    c.type, c.dictionary))
-            live = jnp.arange(group_cap) < jnp.minimum(ng, group_cap)
-            cap = group_cap
-            est = min(src.est, self.nparts * group_cap)
+            direct = self._try_direct_agg(src, key_cols, aggs)
+            if direct is not None:
+                out_cols, results, live, cap, est = direct
+            else:
+                key_triples = [(c.values, c.valid, c.type) for c in key_cols]
+                group_cap = src.cap
+                gi, ng, results = grouped_aggregate(
+                    key_triples, aggs, src.cap, group_cap,
+                    live_mask=src.live)
+                self._overflow.append(('groupby', ng > group_cap))
+                out_cols = []
+                for c in key_cols:
+                    out_cols.append(MCol(
+                        c.values[gi],
+                        None if c.valid is None else c.valid[gi],
+                        c.type, c.dictionary))
+                live = jnp.arange(group_cap) < jnp.minimum(ng, group_cap)
+                cap = group_cap
+                est = min(src.est, self.nparts * group_cap)
         else:
             results = global_aggregate(aggs, src.cap, live_mask=src.live)
             out_cols = []
@@ -691,7 +835,10 @@ class _MeshProgram:
             if v.dtype != np.dtype(ch.out_type.np_dtype):
                 v = v.astype(ch.out_type.np_dtype)
             out_cols.append(MCol(v, valid, ch.out_type, None))
-        table = MTable(out_cols, live, cap, est, compacted=True,
+        # the direct dense-domain path leaves holes (absent key combos):
+        # live rows are NOT a prefix there
+        compacted = not (ngroups and direct is not None)
+        table = MTable(out_cols, live, cap, est, compacted=compacted,
                        replicated=src.replicated)
 
         if node.step == "partial":
@@ -708,6 +855,46 @@ class _MeshProgram:
         out.cols = [MCol(c.values, c.valid, typ, c.dictionary)
                     for c, (_, typ) in zip(out.cols, node.columns)]
         return out
+
+    def _try_direct_agg(self, src: MTable, key_cols, aggs):
+        """Dense-domain GROUP BY: when every key is a dictionary code /
+        boolean with a trace-time-known domain whose product is small,
+        aggregate arithmetically over the dense domain
+        (ops.groupby.direct_grouped_aggregate — the BigintGroupByHash
+        special-case analogue, ~100x the sort path and the output
+        capacity collapses from src.cap to the domain size)."""
+        import jax.numpy as jnp
+
+        from presto_tpu.ops.groupby import (
+            decode_direct_keys, direct_grouped_aggregate,
+        )
+
+        doms: List[int] = []
+        for c in key_cols:
+            if c.dictionary is not None:
+                doms.append(max(1, len(c.dictionary)))
+            elif c.type.name == "boolean":
+                doms.append(2)
+            else:
+                return None
+        total = 1
+        for c, d in zip(key_cols, doms):
+            total *= d + (1 if c.valid is not None else 0)
+        if total > self.config.direct_groupby_max_domain:
+            return None
+        key_codes = [(c.values, c.valid) for c in key_cols]
+        present, results = direct_grouped_aggregate(
+            key_codes, doms, aggs, src.cap, live_mask=src.live)
+        D = present.shape[0]
+        decoded = decode_direct_keys(
+            jnp.arange(D), [c.valid is not None for c in key_cols], doms)
+        out_cols: List[MCol] = []
+        for c, (codes, valid) in zip(key_cols, decoded):
+            out_cols.append(MCol(codes.astype(c.values.dtype),
+                                 valid, c.type, c.dictionary))
+        # est feeds downstream exchange capacity: a single/gather consumer
+        # receives up to nparts * D rows
+        return out_cols, results, present, D, min(src.est, self.nparts * D)
 
     # ---------------- joins ----------------
     def _key_triples(self, table: MTable, channels, other: MTable,
@@ -747,8 +934,24 @@ class _MeshProgram:
 
         btrip, ptrip = self._key_triples(right, node.right_keys,
                                          left, node.left_keys)
-        # sides: build = right, probe = left (matches operator tier)
-        bids, pids = J.canonical_ids(btrip, ptrip, right.cap, left.cap)
+        # sides: build = right, probe = left (matches operator tier).
+        # Single integer-word keys (ints, dates, decimals, dictionary
+        # codes) skip the canonicalization sort entirely: the values ARE
+        # the ids (the operator tier's 'single' LookupSource mode).
+        single = (len(btrip) == 1 and J.single_word_joinable(
+            btrip[0][2],
+            right.cols[node.right_keys[0]].dictionary is not None))
+        if single:
+            # a >=2^62 key spread would overflow the dense-id arithmetic;
+            # flagging it as overflow makes the runner fail over to the
+            # operator tier's canonical path
+            self._overflow.append((
+                'join key span',
+                J.single_word_span_too_big(btrip[0], right.cap)))
+            bids, pids = J.single_word_ids(btrip[0], ptrip[0],
+                                           right.cap, left.cap)
+        else:
+            bids, pids = J.canonical_ids(btrip, ptrip, right.cap, left.cap)
         sorted_b, perm_b = J.build_index(bids)
         lo, counts = J.probe_counts(sorted_b, perm_b, pids)
         # Per-shard match capacity: FK-shaped joins emit ~probe-count rows,
@@ -835,14 +1038,49 @@ class _MeshProgram:
         filt = self._lower(node.filtering)
         btrip, strip = self._key_triples(filt, node.filtering_keys,
                                          src, node.source_keys)
-        bids, sids = J.canonical_ids(btrip, strip, filt.cap, src.cap)
+        if len(btrip) == 1 and J.single_word_joinable(
+                btrip[0][2],
+                filt.cols[node.filtering_keys[0]].dictionary is not None):
+            self._overflow.append((
+                'semijoin key span',
+                J.single_word_span_too_big(btrip[0], filt.cap)))
+            bids, sids = J.single_word_ids(btrip[0], strip[0],
+                                           filt.cap, src.cap)
+        else:
+            bids, sids = J.canonical_ids(btrip, strip, filt.cap, src.cap)
         sorted_b, perm_b = J.build_index(bids)
         _, counts = J.probe_counts(sorted_b, perm_b, sids)
         if src.replicated and not filt.replicated:
             # each shard would apply only ITS slice of the filtering set
             raise MeshUnsupported("semi join: replicated source over "
                                   "sharded filtering side")
-        mask = J.semi_mask(counts, src.live, node.negated)
+        if node.negated and node.null_aware:
+            import jax.numpy as jnp
+
+            # NOT IN three-valued logic (see ops.join.anti_keep_mask)
+            key_nonnull = jnp.ones(src.cap, bool)
+            for ch in node.source_keys:
+                if src.cols[ch].valid is not None:
+                    key_nonnull = key_nonnull & src.cols[ch].valid
+            bhn = jnp.zeros((), bool)
+            for ch in node.filtering_keys:
+                fc = filt.cols[ch]
+                if fc.valid is not None:
+                    bhn = bhn | (filt.live & ~fc.valid).any()
+            if not filt.replicated:
+                # filtering rows are sharded: null presence / emptiness
+                # are global facts
+                import jax
+
+                from presto_tpu.parallel.mesh import AXIS
+                bhn = jax.lax.pmax(bhn.astype(jnp.int32), AXIS) > 0
+                n_filt = jax.lax.psum(filt.live.sum(), AXIS)
+            else:
+                n_filt = filt.live.sum()
+            mask = J.anti_keep_mask(counts, sids >= 0, key_nonnull,
+                                    src.live, True, n_filt, bhn)
+        else:
+            mask = J.semi_mask(counts, src.live, node.negated)
         return MTable(src.cols, src.live & mask, src.cap, src.est,
                       compacted=False, replicated=src.replicated)
 
@@ -896,7 +1134,12 @@ def _compact(table: MTable) -> MTable:
 
     if table.compacted:
         return table
-    order = jnp.argsort((~table.live).astype(jnp.int8)).astype(jnp.int32)
+    from presto_tpu.ops.radix import stable_partition_perm, use_radix
+
+    if use_radix():
+        order = stable_partition_perm(~table.live)
+    else:
+        order = jnp.argsort((~table.live).astype(jnp.int8)).astype(jnp.int32)
     n = table.live.sum()
     cols = [MCol(c.values[order],
                  None if c.valid is None else c.valid[order],

@@ -681,7 +681,7 @@ def _prune(node: PlanNode,
         new_node = SemiJoinNode(ssrc, fsrc,
                                 tuple(sm[c] for c in node.source_keys),
                                 tuple(fm[c] for c in node.filtering_keys),
-                                node.negated, residual)
+                                node.negated, residual, node.null_aware)
         return new_node, {ch: sm[ch] for ch in needed}
     if isinstance(node, SortNode):
         child_needed = sorted(set(needed)

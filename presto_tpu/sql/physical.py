@@ -591,7 +591,10 @@ class PhysicalPlanner:
         build = HashBuildOperatorFactory(
             list(node.filtering_keys),
             [t for _, t in node.filtering.columns],
-            dynamic_filter=dyn)
+            dynamic_filter=dyn,
+            # a spilled (grace) build loses the global has-null/emptiness
+            # facts a null-aware NOT IN needs; keep it resident
+            allow_spill=not (node.negated and node.null_aware))
         build_chain.append(build)
         self._done_pipelines.append(
             Pipeline(build_chain, build_splits, name=self._name("sbuild")))
@@ -604,7 +607,8 @@ class PhysicalPlanner:
             [t for _, t in node.source.columns],
             join_type="anti" if node.negated else "semi",
             expansion=self.config.join_expansion_factor,
-            residual=node.residual))
+            residual=node.residual,
+            null_aware=node.null_aware))
         return chain, splits
 
     def _name(self, prefix: str) -> str:

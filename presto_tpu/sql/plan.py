@@ -135,6 +135,10 @@ class SemiJoinNode(PlanNode):
     filtering_keys: Tuple[int, ...]
     negated: bool = False        # NOT IN / NOT EXISTS (anti join)
     residual: Optional[RowExpression] = None
+    # NOT IN three-valued-logic semantics (vs NOT EXISTS): a NULL probe
+    # key or any NULL in a non-empty filtering side yields UNKNOWN ->
+    # row excluded; an EMPTY filtering side keeps every row
+    null_aware: bool = False
 
     @property
     def columns(self):  # type: ignore[override]

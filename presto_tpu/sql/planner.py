@@ -1229,7 +1229,8 @@ class Planner:
         tr = Translator(rel.scope)
         key = tr.translate(e.expr)
         src, key_ch = _channel_for(rel, key)
-        node = SemiJoinNode(src.node, sub.node, (key_ch,), (0,), negated)
+        node = SemiJoinNode(src.node, sub.node, (key_ch,), (0,), negated,
+                            null_aware=True)
         return RelationPlan(node, src.scope)
 
     def _plan_exists(self, rel: RelationPlan, q: t.Query,

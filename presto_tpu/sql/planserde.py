@@ -236,7 +236,8 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "filtering_keys": list(n.filtering_keys),
                 "negated": n.negated,
                 "residual": None if n.residual is None
-                else expr_to_json(n.residual)}
+                else expr_to_json(n.residual),
+                "null_aware": n.null_aware}
     if isinstance(n, WindowNode):
         return {"k": "window", "source": node_to_json(n.source),
                 "partition_channels": list(n.partition_channels),
@@ -308,7 +309,8 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
                             tuple(d["filtering_keys"]),
                             d.get("negated", False),
                             None if d.get("residual") is None
-                            else expr_from_json(d["residual"]))
+                            else expr_from_json(d["residual"]),
+                            d.get("null_aware", False))
     if k == "window":
         return WindowNode(node_from_json(d["source"]),
                           tuple(d["partition_channels"]),

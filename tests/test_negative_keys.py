@@ -1,0 +1,57 @@
+"""Joins and semijoins over NEGATIVE key values.
+
+Regression: the single-word id fast path used a fixed +2 shift, so any
+key <= -3 collided with the dead-row sentinels and silently never
+matched (and NOT IN wrongly retained rows present in the subquery).
+Both tiers now shift by the build side's live minimum.
+"""
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner.tpch(scale=0.01)
+    r.execute("CREATE TABLE memory.neg_a (k BIGINT, v BIGINT)")
+    r.execute("INSERT INTO memory.neg_a VALUES "
+              "(-5, 1), (-3, 2), (0, 3), (7, 4), (NULL, 5)")
+    r.execute("CREATE TABLE memory.neg_b (k BIGINT, w BIGINT)")
+    r.execute("INSERT INTO memory.neg_b VALUES "
+              "(-5, 10), (-1, 20), (7, 30), (NULL, 40)")
+    return r
+
+
+def test_inner_join_negative_keys(runner):
+    got = sorted(runner.execute(
+        "SELECT a.k, a.v, b.w FROM memory.neg_a a "
+        "JOIN memory.neg_b b ON a.k = b.k").rows)
+    assert got == [(-5, 1, 10), (7, 4, 30)]
+
+
+def test_left_join_negative_keys(runner):
+    got = sorted(runner.execute(
+        "SELECT a.k, b.w FROM memory.neg_a a "
+        "LEFT JOIN memory.neg_b b ON a.k = b.k").rows,
+        key=lambda r: (r[0] is None, r[0]))
+    assert got == [(-5, 10), (-3, None), (0, None), (7, 30), (None, None)]
+
+
+def test_semi_anti_negative_keys(runner):
+    got = sorted(r[0] for r in runner.execute(
+        "SELECT v FROM memory.neg_a WHERE k IN "
+        "(SELECT k FROM memory.neg_b WHERE k IS NOT NULL)").rows)
+    assert got == [1, 4]
+    # k=-3 is genuinely absent from b; k=-5 and 7 are present
+    got = sorted(r[0] for r in runner.execute(
+        "SELECT v FROM memory.neg_a WHERE k NOT IN "
+        "(SELECT k FROM memory.neg_b WHERE k IS NOT NULL)").rows)
+    assert got == [2, 3]
+
+
+def test_group_by_negative_keys(runner):
+    got = sorted(runner.execute(
+        "SELECT k, count(*) FROM memory.neg_a GROUP BY k").rows,
+        key=lambda r: (r[0] is None, r[0]))
+    assert got == [(-5, 1), (-3, 1), (0, 1), (7, 1), (None, 1)]
