@@ -36,7 +36,20 @@ jax.config.update("jax_enable_x64", True)
 def _default_cache_dir() -> str:
     base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
         os.path.expanduser("~"), ".cache")
-    return os.path.join(base, "presto_tpu", "xla")
+    # scope by CPU identity: XLA:CPU AOT executables embed the compile
+    # machine's feature set and are rejected (noisily) or worse on a
+    # different host — a shared home dir must not share them
+    try:
+        import hashlib
+        with open("/proc/cpuinfo", "rb") as f:
+            info = f.read()
+        flags = [ln for ln in info.splitlines()
+                 if ln.startswith((b"flags", b"model name"))]
+        tag = hashlib.sha1(b"\n".join(flags[:2])).hexdigest()[:12]
+    except Exception:  # noqa: BLE001 - non-Linux fallback
+        import platform
+        tag = platform.machine() or "any"
+    return os.path.join(base, "presto_tpu", f"xla-{tag}")
 
 
 _cache_dir = os.environ.get("PRESTO_TPU_XLA_CACHE", _default_cache_dir())

@@ -107,6 +107,14 @@ class PageSink:
         """Commit; returns row count written."""
         raise NotImplementedError
 
+    def fragment(self) -> Optional[str]:
+        """Opaque per-task commit token, valid after finish() (the
+        ConnectorPageSink.finish() Slice fragments role): a distributed
+        write's TableFinish step passes every task's fragment to
+        Connector.finish_write for the atomic commit.  None for sinks
+        whose finish() IS the commit (single-process path)."""
+        return None
+
 
 class Connector:
     """One mounted catalog (Connector + ConnectorMetadata +
@@ -173,6 +181,37 @@ class Connector:
 
     def page_sink(self, handle: TableHandle) -> PageSink:
         raise NotImplementedError(f"{self.name}: INSERT not supported")
+
+    # -- distributed writes (P6, optional) ------------------------------
+    # The two-phase write protocol behind scaled writers
+    # (SCALED_WRITER_DISTRIBUTION, SystemPartitioningHandle.java:62 +
+    # TableWriterOperator.java:58 / TableFinishOperator.java:46): worker
+    # tasks stream rows into task_sink()s whose finish() stages data
+    # WITHOUT publishing and whose fragment() returns a commit token;
+    # the single TableFinish task then calls finish_write(tokens) for the
+    # all-or-nothing publish.
+    supports_distributed_write: bool = False
+
+    def begin_write(self, handle: TableHandle) -> str:
+        """Start a distributed write; returns an opaque write id."""
+        raise NotImplementedError(
+            f"{self.name}: distributed write not supported")
+
+    def task_sink(self, handle: TableHandle, write_id: str,
+                  task_id: str) -> PageSink:
+        """Per-task staging sink.  finish() stages (returns rows);
+        fragment() returns the commit token."""
+        raise NotImplementedError(
+            f"{self.name}: distributed write not supported")
+
+    def finish_write(self, handle: TableHandle, write_id: str,
+                     fragments: Sequence[str]) -> None:
+        """Atomically publish every staged fragment."""
+        raise NotImplementedError(
+            f"{self.name}: distributed write not supported")
+
+    def abort_write(self, handle: TableHandle, write_id: str) -> None:
+        """Discard staged state for an abandoned write (best-effort)."""
 
     def drop_table(self, name: str) -> None:
         raise NotImplementedError(f"{self.name}: DROP TABLE not supported")

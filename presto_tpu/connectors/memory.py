@@ -225,4 +225,24 @@ class BlackHoleConnector(Connector):
                 connector.rows_swallowed[table] += self.count
                 return self.count
 
+            def fragment(self):
+                return str(self.count)
+
         return _Sink()
+
+    # -- distributed writes (write-benchmark sink for scaled writers) ---
+    supports_distributed_write = True
+
+    def begin_write(self, handle: TableHandle) -> str:
+        return "bh"
+
+    def task_sink(self, handle: TableHandle, write_id: str,
+                  task_id: str) -> PageSink:
+        return self.page_sink(handle)
+
+    def finish_write(self, handle: TableHandle, write_id: str,
+                     fragments) -> None:
+        pass
+
+    def abort_write(self, handle: TableHandle, write_id: str) -> None:
+        pass

@@ -131,8 +131,9 @@ class RaptorConnector(Connector):
     def _shard_path(self, shard_uuid: str) -> str:
         return os.path.join(self.root, "shards", shard_uuid + ".shard")
 
-    def _write_shard(self, table: str, bucket: Optional[int],
-                     batch: Batch) -> None:
+    def _write_shard_file(self, batch: Batch) -> str:
+        """Stage shard bytes on storage (+backup) WITHOUT registering
+        them — invisible to readers until the metadata insert."""
         shard_uuid = uuid.uuid4().hex
         blob = serialize_batch(batch.compact().to_numpy())
         path = self._shard_path(shard_uuid)
@@ -142,9 +143,25 @@ class RaptorConnector(Connector):
             with open(os.path.join(self.backup_root,
                                    shard_uuid + ".shard"), "wb") as f:
                 f.write(blob)
-        self._q("INSERT INTO shards VALUES (?, ?, ?, ?)",
-                (shard_uuid, table, bucket, batch.num_rows))
+        return shard_uuid
+
+    def _register_shards(self, table: str,
+                         rows: Sequence[Tuple[str, Optional[int], int]]
+                         ) -> None:
+        """Atomically publish staged shards (one metadata transaction —
+        the ShardManager.commitShards role)."""
+        with self._lock:
+            self._db.executemany(
+                "INSERT INTO shards VALUES (?, ?, ?, ?)",
+                [(su, table, bucket, rc) for su, bucket, rc in rows])
+            self._db.commit()
         getattr(self, "_col_stats", {}).pop(table, None)  # stale now
+
+    def _write_shard(self, table: str, bucket: Optional[int],
+                     batch: Batch) -> None:
+        shard_uuid = self._write_shard_file(batch)
+        self._register_shards(table, [(shard_uuid, bucket,
+                                       batch.num_rows)])
 
     def _read_shard(self, shard_uuid: str) -> Batch:
         path = self._shard_path(shard_uuid)
@@ -262,6 +279,36 @@ class RaptorConnector(Connector):
         return _RaptorSink(self, handle.table, schema, bucket_count,
                            bucketed_on)
 
+    # -- distributed writes (P6) ----------------------------------------
+    # Shards live on shared storage and the metadata db is the commit
+    # point, so N writer tasks stage shard files concurrently and ONE
+    # TableFinish transaction publishes them (ShardManager.commitShards +
+    # ScaledWriterScheduler's target, re-imagined for this storage).
+    supports_distributed_write = True
+
+    def begin_write(self, handle: TableHandle) -> str:
+        return uuid.uuid4().hex
+
+    def task_sink(self, handle: TableHandle, write_id: str,
+                  task_id: str) -> PageSink:
+        schema, bucket_count, bucketed_on = self._table_row(handle.table)
+        return _RaptorTaskSink(self, handle.table, schema, bucket_count,
+                               bucketed_on)
+
+    def finish_write(self, handle: TableHandle, write_id: str,
+                     fragments: Sequence[str]) -> None:
+        rows: List[Tuple[str, Optional[int], int]] = []
+        for frag in fragments:
+            for su, bucket, rc in json.loads(frag):
+                rows.append((su, bucket, rc))
+        self._register_shards(handle.table, rows)
+
+    def abort_write(self, handle: TableHandle, write_id: str) -> None:
+        # staged shard files are unreachable without metadata rows; a
+        # background sweep comparing storage against metadata reclaims
+        # them (the ShardCleaner role) — nothing to do inline
+        pass
+
     # -- maintenance ----------------------------------------------------
     def compact(self, table: str,
                 target_rows: int = 1 << 20) -> Tuple[int, int]:
@@ -349,3 +396,24 @@ class _RaptorSink(PageSink):
                 self.conn._write_shard(self.table, bucket, merged)
         self.by_bucket = {}
         return self.rows
+
+
+class _RaptorTaskSink(_RaptorSink):
+    """Distributed-write variant: finish() stages shard files only; the
+    commit token carries (shard_uuid, bucket, rows) triples for
+    finish_write's atomic metadata publish."""
+
+    def finish(self) -> int:
+        staged: List[Tuple[str, Optional[int], int]] = []
+        for bucket, batches in self.by_bucket.items():
+            merged = (batches[0] if len(batches) == 1
+                      else concat_batches(batches))
+            if merged.num_rows:
+                su = self.conn._write_shard_file(merged)
+                staged.append((su, bucket, merged.num_rows))
+        self.by_bucket = {}
+        self._fragment = json.dumps(staged)
+        return self.rows
+
+    def fragment(self) -> Optional[str]:
+        return getattr(self, "_fragment", None)

@@ -102,6 +102,14 @@ class TpcdsGenerator:
         self.n_promo = 300
         self.n_web_site = 30
         self.n_address = self.n_customer // 2
+        self.n_store_returns = self.n_store_sales // 10
+        self.n_reason = 35
+        self.n_ship_mode = 20
+        self.n_call_center = 6
+        self.n_catalog_page = 200
+        self.n_web_page = 60
+        self.n_income_band = 20
+        self.n_time = 86400
         self.n_weeks = _N_DAYS // 7
         # inventory tracks a quarter of items weekly per warehouse; the
         # tracked-item count shrinks with sub-unit scales so the fact
@@ -145,6 +153,16 @@ class TpcdsGenerator:
                 codes = (idx % 7).astype(np.int32)
                 cols.append(Column(T.VARCHAR, codes,
                                    None, Dictionary(DAY_NAMES)))
+            elif c == "d_dow":
+                cols.append(Column(T.INTEGER,
+                                   ((idx + 1) % 7).astype(np.int32)))
+            elif c == "d_quarter_name":
+                q = (month - 1) // 3 + 1
+                vocab = [f"{y}Q{i}" for y in range(1990, 2004)
+                         for i in range(1, 5)]
+                codes = ((year - 1990) * 4 + q - 1).astype(np.int32)
+                cols.append(Column(T.VARCHAR, codes, None,
+                                   Dictionary(vocab)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(idx))
@@ -201,6 +219,28 @@ class TpcdsGenerator:
                          for b in ("n st", "able", "ought", "anti", "cally")]
                 codes, d = _pick(313, keys, vocab)
                 cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "i_color":
+                vocab = ["red", "green", "blue", "yellow", "black",
+                         "white", "purple", "orange", "pink", "brown",
+                         "gray", "cyan", "magenta", "olive", "navy"]
+                codes, d = _pick(314, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "i_size":
+                vocab = ["small", "medium", "large", "extra large",
+                         "economy", "N/A", "petite"]
+                codes, d = _pick(315, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "i_units":
+                vocab = ["Each", "Dozen", "Case", "Pallet", "Gross",
+                         "Oz", "Lb", "Ton", "Bunch", "Box"]
+                codes, d = _pick(316, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "i_manufact":
+                vocab = [f"{a}{b}" for a in ("ought", "able", "pri",
+                                             "ese", "anti")
+                         for b in ("", "n st", "bar", "cally")]
+                codes, d = _pick(317, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -229,6 +269,50 @@ class TpcdsGenerator:
             elif c == "s_gmt_offset":
                 cols.append(Column(T.DOUBLE, -5.0 - u_int(
                     404, keys, 0, 3).astype(np.float64)))
+            elif c == "s_city":
+                vocab = ["Midway", "Fairview", "Oak Grove", "Five Points",
+                         "Pleasant Hill", "Centerville"]
+                codes, d = _pick(405, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "s_company_id":
+                cols.append(Column(T.INTEGER,
+                                   np.ones(len(keys), np.int32)))
+            elif c == "s_company_name":
+                cols.append(Column(T.VARCHAR,
+                                   np.zeros(len(keys), np.int32), None,
+                                   Dictionary(["Unknown"])))
+            elif c == "s_market_id":
+                cols.append(Column(T.INTEGER,
+                                   u_int(406, keys, 1, 10)
+                                   .astype(np.int32)))
+            elif c == "s_number_employees":
+                cols.append(Column(T.INTEGER,
+                                   u_int(407, keys, 200, 300)
+                                   .astype(np.int32)))
+            elif c == "s_street_number":
+                d = Dictionary([str(n) for n in range(1, 1001)])
+                cols.append(Column(T.VARCHAR,
+                                   u_int(408, keys, 0, 999)
+                                   .astype(np.int32), None, d))
+            elif c == "s_street_name":
+                vocab = ["Main", "Oak", "Park", "First", "Second",
+                         "Elm", "Cedar", "Maple"]
+                codes, d = _pick(409, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "s_street_type":
+                vocab = ["St", "Ave", "Blvd", "Ct", "Dr", "Ln", "Rd"]
+                codes, d = _pick(410, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "s_suite_number":
+                d = Dictionary([f"Suite {n}" for n in range(0, 100, 10)])
+                cols.append(Column(T.VARCHAR,
+                                   u_int(411, keys, 0, 9)
+                                   .astype(np.int32), None, d))
+            elif c == "s_zip":
+                d = Dictionary([f"{z:05d}" for z in range(10000, 10200)])
+                cols.append(Column(T.VARCHAR,
+                                   u_int(412, keys, 0, 199)
+                                   .astype(np.int32), None, d))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -250,6 +334,22 @@ class TpcdsGenerator:
             elif c == "w_state":
                 codes, d = _pick(501, keys, STATES[:6])
                 cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "w_warehouse_sq_ft":
+                cols.append(Column(T.INTEGER,
+                                   u_int(502, keys, 50_000, 990_000)
+                                   .astype(np.int32)))
+            elif c == "w_city":
+                vocab = ["Midway", "Fairview", "Oak Grove", "Five Points",
+                         "Pleasant Hill"]
+                codes, d = _pick(503, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "w_county":
+                codes, d = _pick(504, keys, COUNTIES)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "w_country":
+                cols.append(Column(
+                    T.VARCHAR, np.zeros(len(keys), np.int32), None,
+                    Dictionary(["United States"])))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -313,6 +413,46 @@ class TpcdsGenerator:
                          "JAPAN", "BRAZIL"]
                 codes, d = _pick(706, keys, vocab)
                 cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "c_salutation":
+                vocab = ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir", "Miss"]
+                codes, d = _pick(707, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "c_preferred_cust_flag":
+                cols.append(Column(
+                    T.VARCHAR, u_int(708, keys, 0, 1).astype(np.int32),
+                    None, Dictionary(["N", "Y"])))
+            elif c == "c_birth_day":
+                cols.append(Column(T.INTEGER,
+                                   u_int(709, keys, 1, 28)
+                                   .astype(np.int32)))
+            elif c == "c_birth_month":
+                cols.append(Column(T.INTEGER,
+                                   u_int(710, keys, 1, 12)
+                                   .astype(np.int32)))
+            elif c == "c_birth_year":
+                cols.append(Column(T.INTEGER,
+                                   u_int(711, keys, 1924, 1992)
+                                   .astype(np.int32)))
+            elif c == "c_email_address":
+                d = Dictionary([f"user{k}@example.com"
+                                for k in range(200)])
+                cols.append(Column(T.VARCHAR,
+                                   u_int(712, keys, 0, 199)
+                                   .astype(np.int32), None, d))
+            elif c == "c_login":
+                d = Dictionary([f"login{k}" for k in range(200)])
+                cols.append(Column(T.VARCHAR,
+                                   u_int(713, keys, 0, 199)
+                                   .astype(np.int32), None, d))
+            elif c == "c_last_review_date_sk":
+                cols.append(Column(T.BIGINT, _DATE_SK_BASE + u_int(
+                    714, keys, 0, _N_DAYS - 1)))
+            elif c == "c_first_sales_date_sk":
+                cols.append(Column(T.BIGINT, _DATE_SK_BASE + u_int(
+                    715, keys, 0, _N_DAYS - 1)))
+            elif c == "c_first_shipto_date_sk":
+                cols.append(Column(T.BIGINT, _DATE_SK_BASE + u_int(
+                    716, keys, 0, _N_DAYS - 1)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -341,6 +481,36 @@ class TpcdsGenerator:
             elif c == "ca_gmt_offset":
                 cols.append(Column(T.DOUBLE, -5.0 - u_int(
                     804, keys, 0, 3).astype(np.float64)))
+            elif c == "ca_street_number":
+                d = Dictionary([str(n) for n in range(1, 1001)])
+                cols.append(Column(T.VARCHAR,
+                                   u_int(805, keys, 0, 999)
+                                   .astype(np.int32), None, d))
+            elif c == "ca_street_name":
+                vocab = ["Main", "Oak", "Park", "First", "Second",
+                         "Elm", "Cedar", "Maple", "Pine", "Hill"]
+                codes, d = _pick(806, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "ca_street_type":
+                vocab = ["St", "Ave", "Blvd", "Ct", "Dr", "Ln", "Rd",
+                         "Way", "Pkwy", "Cir"]
+                codes, d = _pick(807, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "ca_suite_number":
+                d = Dictionary([f"Suite {n}" for n in range(0, 100, 10)])
+                cols.append(Column(T.VARCHAR,
+                                   u_int(808, keys, 0, 9)
+                                   .astype(np.int32), None, d))
+            elif c == "ca_city":
+                vocab = ["Midway", "Fairview", "Oak Grove", "Five Points",
+                         "Pleasant Hill", "Centerville", "Liberty",
+                         "Salem", "Greenville", "Bethel"]
+                codes, d = _pick(809, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            elif c == "ca_location_type":
+                vocab = ["apartment", "condo", "single family"]
+                codes, d = _pick(810, keys, vocab)
+                cols.append(Column(T.VARCHAR, codes, None, d))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -374,6 +544,12 @@ class TpcdsGenerator:
             elif c == "cd_dep_count":
                 cols.append(Column(T.INTEGER,
                                    ((keys // 5600) % 7).astype(np.int32)))
+            elif c == "cd_dep_employed_count":
+                cols.append(Column(T.INTEGER,
+                                   ((keys // 800) % 7).astype(np.int32)))
+            elif c == "cd_dep_college_count":
+                cols.append(Column(T.INTEGER,
+                                   ((keys // 400) % 7).astype(np.int32)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -468,6 +644,61 @@ class TpcdsGenerator:
             sk = u_int(111, keys, 1, self.n_promo)
             null = h64(112, keys) % np.uint64(2) == 0  # half un-promoted
             return Column(T.BIGINT, sk, ~null)
+        if c == f"{p}_coupon_amt":
+            return Column(T.DOUBLE, _money(113, keys, 0.0, 50.0))
+        if c == f"{p}_ext_tax":
+            return Column(T.DOUBLE, _money(114, keys, 0.0, 80.0))
+        if c == f"{p}_net_paid_inc_tax":
+            q = u_int(104, keys, 1, 100).astype(np.float64)
+            return Column(T.DOUBLE, _money(108, keys, 0.0, 200.0) * q
+                          + _money(114, keys, 0.0, 80.0))
+        if c == f"{p}_sold_time_sk":
+            return Column(T.BIGINT, u_int(115, keys // 8, 0, 86399))
+        if c == f"{p}_ext_ship_cost":
+            return Column(T.DOUBLE, _money(116, keys, 0.0, 500.0))
+        return None
+
+    def _return_common(self, c: str, keys: np.ndarray, p: str,
+                       sale_row: np.ndarray) -> Optional[Column]:
+        """Columns shared by the three returns channels.  Key/sk columns
+        that must JOIN back to the originating sale regenerate with the
+        SALE's streams over ``sale_row``; measures use fresh streams."""
+        if c == f"{p}_item_sk":
+            return Column(T.BIGINT, u_int(103, sale_row, 1, self.n_item))
+        if c == f"{p}_return_quantity":
+            return Column(T.INTEGER,
+                          u_int(401, keys, 1, 40).astype(np.int32))
+        if c == f"{p}_returned_date_sk":
+            return Column(T.BIGINT, _DATE_SK_BASE + u_int(
+                402, keys, 0, _N_DAYS - 1))
+        if c == f"{p}_return_amt":
+            return Column(T.DOUBLE, _money(403, keys, 0.0, 500.0))
+        if c == f"{p}_return_amt_inc_tax":
+            return Column(T.DOUBLE, _money(403, keys, 0.0, 500.0)
+                          + _money(404, keys, 0.0, 40.0))
+        if c == f"{p}_net_loss":
+            return Column(T.DOUBLE, _money(405, keys, 0.0, 300.0))
+        if c == f"{p}_fee":
+            return Column(T.DOUBLE, _money(406, keys, 0.0, 100.0))
+        if c == f"{p}_refunded_cash":
+            return Column(T.DOUBLE, _money(407, keys, 0.0, 500.0))
+        if c == f"{p}_reversed_charge":
+            return Column(T.DOUBLE, _money(408, keys, 0.0, 200.0))
+        if c == f"{p}_store_credit":
+            return Column(T.DOUBLE, _money(409, keys, 0.0, 200.0))
+        if c == f"{p}_reason_sk":
+            return Column(T.BIGINT, u_int(410, keys, 1, self.n_reason))
+        if c == f"{p}_returning_customer_sk":
+            return Column(T.BIGINT,
+                          u_int(411, keys, 1, self.n_customer))
+        if c == f"{p}_returning_addr_sk":
+            return Column(T.BIGINT, u_int(412, keys, 1, self.n_address))
+        if c == f"{p}_returning_cdemo_sk":
+            return Column(T.BIGINT, u_int(413, keys, 1, self.n_cdemo))
+        if c == f"{p}_refunded_addr_sk":
+            return Column(T.BIGINT, u_int(414, keys, 1, self.n_address))
+        if c == f"{p}_refunded_cdemo_sk":
+            return Column(T.BIGINT, u_int(415, keys, 1, self.n_cdemo))
         return None
 
     def gen_store_sales(self, columns, lo, hi) -> Batch:
@@ -526,6 +757,25 @@ class TpcdsGenerator:
                 cols.append(Column(T.BIGINT,
                                    u_int(134, keys // 8, 1,
                                          self.n_address)))
+            elif c == "cs_bill_addr_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(135, keys // 8, 1,
+                                         self.n_address)))
+            elif c == "cs_ship_customer_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(136, keys // 8, 1,
+                                         self.n_customer)))
+            elif c == "cs_call_center_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(137, keys // 8, 1,
+                                         self.n_call_center)))
+            elif c == "cs_catalog_page_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(138, keys, 1,
+                                         self.n_catalog_page)))
+            elif c == "cs_ship_mode_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(139, keys, 1, self.n_ship_mode)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -536,19 +786,49 @@ class TpcdsGenerator:
         sale_row = (keys * np.int64(10)) % np.int64(self.n_catalog_sales)
         cols = []
         for c in columns:
-            if c == "cr_order_number":
+            shared = self._return_common(c, keys, "cr", sale_row)
+            if shared is not None:
+                cols.append(shared)
+            elif c == "cr_order_number":
                 cols.append(Column(T.BIGINT, sale_row // 8 + 1))
-            elif c == "cr_item_sk":
+            elif c == "cr_call_center_sk":
                 cols.append(Column(T.BIGINT,
-                                   u_int(103, sale_row, 1, self.n_item)))
-            elif c == "cr_return_quantity":
-                cols.append(Column(T.INTEGER,
-                                   u_int(140, keys, 1, 40).astype(np.int32)))
-            elif c == "cr_returned_date_sk":
-                cols.append(Column(T.BIGINT, _DATE_SK_BASE + u_int(
-                    141, keys, 0, _N_DAYS - 1)))
-            elif c == "cr_refunded_cash":
-                cols.append(Column(T.DOUBLE, _money(142, keys, 0.0, 500.0)))
+                                   u_int(420, keys, 1,
+                                         self.n_call_center)))
+            elif c == "cr_catalog_page_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(421, keys, 1,
+                                         self.n_catalog_page)))
+            elif c == "cr_return_amount":
+                cols.append(Column(T.DOUBLE, _money(403, keys, 0.0, 500.0)))
+            else:
+                raise KeyError(c)
+        return Batch(tuple(cols), len(keys))
+
+    def gen_store_returns(self, columns, lo, hi) -> Batch:
+        keys = np.arange(lo, hi, dtype=np.int64)
+        sale_row = (keys * np.int64(10)) % np.int64(self.n_store_sales)
+        cols = []
+        for c in columns:
+            shared = self._return_common(c, keys, "sr", sale_row)
+            if shared is not None:
+                cols.append(shared)
+            elif c == "sr_ticket_number":
+                cols.append(Column(T.BIGINT, sale_row // 8 + 1))
+            elif c == "sr_customer_sk":
+                # the originating sale's customer (joins ss & sr on
+                # ticket+customer must line up)
+                cols.append(Column(T.BIGINT,
+                                   u_int(120, sale_row // 8, 1,
+                                         self.n_customer)))
+            elif c == "sr_cdemo_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(121, sale_row // 8, 1,
+                                         self.n_cdemo)))
+            elif c == "sr_store_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(124, sale_row // 8, 1,
+                                         self.n_store)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -577,8 +857,24 @@ class TpcdsGenerator:
             elif c == "ws_warehouse_sk":
                 cols.append(Column(T.BIGINT,
                                    u_int(153, keys, 1, self.n_warehouse)))
-            elif c == "ws_ext_ship_cost":
-                cols.append(Column(T.DOUBLE, _money(154, keys, 0.0, 500.0)))
+            elif c == "ws_bill_addr_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(155, keys // 8, 1,
+                                         self.n_address)))
+            elif c == "ws_ship_customer_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(156, keys // 8, 1,
+                                         self.n_customer)))
+            elif c == "ws_ship_hdemo_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(157, keys // 8, 1, self.n_hdemo)))
+            elif c == "ws_ship_mode_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(158, keys, 1, self.n_ship_mode)))
+            elif c == "ws_web_page_sk":
+                cols.append(Column(T.BIGINT,
+                                   u_int(159, keys // 8, 1,
+                                         self.n_web_page)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -588,20 +884,182 @@ class TpcdsGenerator:
         sale_row = (keys * np.int64(10)) % np.int64(self.n_web_sales)
         cols = []
         for c in columns:
-            if c == "wr_order_number":
+            shared = self._return_common(c, keys, "wr", sale_row)
+            if shared is not None:
+                cols.append(shared)
+            elif c == "wr_order_number":
                 cols.append(Column(T.BIGINT, sale_row // 8 + 1))
-            elif c == "wr_item_sk":
+            elif c == "wr_web_page_sk":
                 cols.append(Column(T.BIGINT,
-                                   u_int(103, sale_row, 1, self.n_item)))
-            elif c == "wr_return_quantity":
-                cols.append(Column(T.INTEGER,
-                                   u_int(160, keys, 1, 40).astype(np.int32)))
-            elif c == "wr_returned_date_sk":
-                cols.append(Column(T.BIGINT, _DATE_SK_BASE + u_int(
-                    161, keys, 0, _N_DAYS - 1)))
+                                   u_int(430, keys, 1, self.n_web_page)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
+
+    # -- small dimensions added for full-suite coverage ------------------
+    def gen_time_dim(self, columns, lo, hi) -> Batch:
+        idx = np.arange(lo, hi, dtype=np.int64)  # one row per second
+        cols = []
+        for c in columns:
+            if c == "t_time_sk":
+                cols.append(Column(T.BIGINT, idx))
+            elif c == "t_time":
+                cols.append(Column(T.INTEGER, idx.astype(np.int32)))
+            elif c == "t_hour":
+                cols.append(Column(T.INTEGER,
+                                   (idx // 3600).astype(np.int32)))
+            elif c == "t_minute":
+                cols.append(Column(T.INTEGER,
+                                   ((idx % 3600) // 60).astype(np.int32)))
+            elif c == "t_second":
+                cols.append(Column(T.INTEGER,
+                                   (idx % 60).astype(np.int32)))
+            elif c == "t_meal_time":
+                hour = idx // 3600
+                vocab = ["breakfast", "lunch", "dinner"]
+                code = np.where(
+                    (hour >= 6) & (hour < 9), 0,
+                    np.where((hour >= 11) & (hour < 13), 1,
+                             np.where((hour >= 17) & (hour < 20), 2, 0)))
+                valid = (((hour >= 6) & (hour < 9))
+                         | ((hour >= 11) & (hour < 13))
+                         | ((hour >= 17) & (hour < 20)))
+                cols.append(Column(T.VARCHAR, code.astype(np.int32),
+                                   valid, Dictionary(vocab)))
+            elif c == "t_am_pm":
+                vocab = ["AM", "PM"]
+                cols.append(Column(T.VARCHAR,
+                                   (idx // 43200).astype(np.int32), None,
+                                   Dictionary(vocab)))
+            else:
+                raise KeyError(c)
+        return Batch(tuple(cols), len(idx))
+
+    def gen_reason(self, columns, lo, hi) -> Batch:
+        idx = np.arange(lo, hi, dtype=np.int64)
+        cols = []
+        for c in columns:
+            if c == "r_reason_sk":
+                cols.append(Column(T.BIGINT, idx + 1))
+            elif c == "r_reason_id":
+                vocab = [f"reason_id_{i}" for i in range(self.n_reason)]
+                cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
+                                   Dictionary(vocab)))
+            elif c == "r_reason_desc":
+                vocab = [f"reason {w}" for w in DESC_WORDS[:self.n_reason]]
+                while len(vocab) < self.n_reason:
+                    vocab.append(f"reason {len(vocab)}")
+                cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
+                                   Dictionary(vocab)))
+            else:
+                raise KeyError(c)
+        return Batch(tuple(cols), len(idx))
+
+    def gen_ship_mode(self, columns, lo, hi) -> Batch:
+        idx = np.arange(lo, hi, dtype=np.int64)
+        types = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "TWO DAY"]
+        carriers = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS",
+                    "ZHOU", "ZOUROS", "MSC", "LATVIAN"]
+        cols = []
+        for c in columns:
+            if c == "sm_ship_mode_sk":
+                cols.append(Column(T.BIGINT, idx + 1))
+            elif c == "sm_ship_mode_id":
+                vocab = [f"ship_mode_{i}" for i in range(self.n_ship_mode)]
+                cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
+                                   Dictionary(vocab)))
+            elif c == "sm_type":
+                cols.append(Column(T.VARCHAR,
+                                   (idx % len(types)).astype(np.int32),
+                                   None, Dictionary(types)))
+            elif c == "sm_carrier":
+                cols.append(Column(T.VARCHAR,
+                                   (idx % len(carriers)).astype(np.int32),
+                                   None, Dictionary(carriers)))
+            elif c == "sm_code":
+                vocab = ["AIR", "SURFACE", "SEA"]
+                cols.append(Column(T.VARCHAR,
+                                   (idx % 3).astype(np.int32), None,
+                                   Dictionary(vocab)))
+            else:
+                raise KeyError(c)
+        return Batch(tuple(cols), len(idx))
+
+    def gen_income_band(self, columns, lo, hi) -> Batch:
+        idx = np.arange(lo, hi, dtype=np.int64)
+        cols = []
+        for c in columns:
+            if c == "ib_income_band_sk":
+                cols.append(Column(T.BIGINT, idx + 1))
+            elif c == "ib_lower_bound":
+                cols.append(Column(T.INTEGER,
+                                   (idx * 10000).astype(np.int32)))
+            elif c == "ib_upper_bound":
+                cols.append(Column(T.INTEGER,
+                                   ((idx + 1) * 10000).astype(np.int32)))
+            else:
+                raise KeyError(c)
+        return Batch(tuple(cols), len(idx))
+
+    def gen_call_center(self, columns, lo, hi) -> Batch:
+        idx = np.arange(lo, hi, dtype=np.int64)
+        n = self.n_call_center
+        cols = []
+        for c in columns:
+            if c == "cc_call_center_sk":
+                cols.append(Column(T.BIGINT, idx + 1))
+            elif c == "cc_call_center_id":
+                vocab = [f"cc_id_{i}" for i in range(n)]
+                cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
+                                   Dictionary(vocab)))
+            elif c == "cc_name":
+                vocab = ["NY Metro", "Mid Atlantic", "Midwest",
+                         "North Midwest", "California", "Pacific NW"]
+                cols.append(Column(T.VARCHAR,
+                                   (idx % len(vocab)).astype(np.int32),
+                                   None, Dictionary(vocab)))
+            elif c == "cc_manager":
+                vocab = [f"Manager {i}" for i in range(n)]
+                cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
+                                   Dictionary(vocab)))
+            elif c == "cc_county":
+                codes, d = _pick(440, idx, COUNTIES)
+                cols.append(Column(T.VARCHAR, codes, None, d))
+            else:
+                raise KeyError(c)
+        return Batch(tuple(cols), len(idx))
+
+    def gen_catalog_page(self, columns, lo, hi) -> Batch:
+        idx = np.arange(lo, hi, dtype=np.int64)
+        cols = []
+        for c in columns:
+            if c == "cp_catalog_page_sk":
+                cols.append(Column(T.BIGINT, idx + 1))
+            elif c == "cp_catalog_page_id":
+                vocab = [f"cp_id_{i}" for i in range(self.n_catalog_page)]
+                cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
+                                   Dictionary(vocab)))
+            else:
+                raise KeyError(c)
+        return Batch(tuple(cols), len(idx))
+
+    def gen_web_page(self, columns, lo, hi) -> Batch:
+        idx = np.arange(lo, hi, dtype=np.int64)
+        cols = []
+        for c in columns:
+            if c == "wp_web_page_sk":
+                cols.append(Column(T.BIGINT, idx + 1))
+            elif c == "wp_web_page_id":
+                vocab = [f"wp_id_{i}" for i in range(self.n_web_page)]
+                cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
+                                   Dictionary(vocab)))
+            elif c == "wp_char_count":
+                cols.append(Column(T.INTEGER,
+                                   u_int(450, idx, 100, 8000)
+                                   .astype(np.int32)))
+            else:
+                raise KeyError(c)
+        return Batch(tuple(cols), len(idx))
 
     def gen_inventory(self, columns, lo, hi) -> Batch:
         keys = np.arange(lo, hi, dtype=np.int64)
@@ -639,32 +1097,66 @@ _SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
     "date_dim": [("d_date_sk", _B), ("d_date", _DT), ("d_year", _I),
                  ("d_moy", _I), ("d_dom", _I), ("d_qoy", _I),
                  ("d_week_seq", _I), ("d_month_seq", _I),
-                 ("d_day_name", _V)],
+                 ("d_day_name", _V), ("d_dow", _I),
+                 ("d_quarter_name", _V)],
+    "time_dim": [("t_time_sk", _B), ("t_time", _I), ("t_hour", _I),
+                 ("t_minute", _I), ("t_second", _I), ("t_meal_time", _V),
+                 ("t_am_pm", _V)],
     "item": [("i_item_sk", _B), ("i_item_id", _V), ("i_item_desc", _V),
              ("i_current_price", _D), ("i_wholesale_cost", _D),
              ("i_brand_id", _I), ("i_brand", _V), ("i_class_id", _I),
              ("i_class", _V), ("i_category_id", _I), ("i_category", _V),
              ("i_manufact_id", _I), ("i_manager_id", _I),
-             ("i_product_name", _V)],
+             ("i_product_name", _V), ("i_color", _V), ("i_size", _V),
+             ("i_units", _V), ("i_manufact", _V)],
     "store": [("s_store_sk", _B), ("s_store_id", _V), ("s_store_name", _V),
-              ("s_state", _V), ("s_county", _V), ("s_gmt_offset", _D)],
+              ("s_state", _V), ("s_county", _V), ("s_gmt_offset", _D),
+              ("s_city", _V), ("s_company_id", _I),
+              ("s_company_name", _V), ("s_market_id", _I),
+              ("s_number_employees", _I), ("s_street_number", _V),
+              ("s_street_name", _V), ("s_street_type", _V),
+              ("s_suite_number", _V), ("s_zip", _V)],
     "warehouse": [("w_warehouse_sk", _B), ("w_warehouse_name", _V),
-                  ("w_state", _V)],
+                  ("w_state", _V), ("w_warehouse_sq_ft", _I),
+                  ("w_city", _V), ("w_county", _V), ("w_country", _V)],
     "promotion": [("p_promo_sk", _B), ("p_promo_id", _V),
                   ("p_channel_dmail", _V), ("p_channel_email", _V),
                   ("p_channel_tv", _V), ("p_channel_event", _V),
                   ("p_promo_name", _V)],
+    "reason": [("r_reason_sk", _B), ("r_reason_id", _V),
+               ("r_reason_desc", _V)],
+    "ship_mode": [("sm_ship_mode_sk", _B), ("sm_ship_mode_id", _V),
+                  ("sm_type", _V), ("sm_carrier", _V), ("sm_code", _V)],
+    "income_band": [("ib_income_band_sk", _B), ("ib_lower_bound", _I),
+                    ("ib_upper_bound", _I)],
+    "call_center": [("cc_call_center_sk", _B), ("cc_call_center_id", _V),
+                    ("cc_name", _V), ("cc_manager", _V),
+                    ("cc_county", _V)],
+    "catalog_page": [("cp_catalog_page_sk", _B),
+                     ("cp_catalog_page_id", _V)],
+    "web_page": [("wp_web_page_sk", _B), ("wp_web_page_id", _V),
+                 ("wp_char_count", _I)],
     "customer": [("c_customer_sk", _B), ("c_customer_id", _V),
                  ("c_current_cdemo_sk", _B), ("c_current_hdemo_sk", _B),
                  ("c_current_addr_sk", _B), ("c_first_name", _V),
-                 ("c_last_name", _V), ("c_birth_country", _V)],
+                 ("c_last_name", _V), ("c_birth_country", _V),
+                 ("c_salutation", _V), ("c_preferred_cust_flag", _V),
+                 ("c_birth_day", _I), ("c_birth_month", _I),
+                 ("c_birth_year", _I), ("c_email_address", _V),
+                 ("c_login", _V), ("c_last_review_date_sk", _B),
+                 ("c_first_sales_date_sk", _B),
+                 ("c_first_shipto_date_sk", _B)],
     "customer_address": [("ca_address_sk", _B), ("ca_state", _V),
                          ("ca_county", _V), ("ca_zip", _V),
-                         ("ca_country", _V), ("ca_gmt_offset", _D)],
+                         ("ca_country", _V), ("ca_gmt_offset", _D),
+                         ("ca_street_number", _V), ("ca_street_name", _V),
+                         ("ca_street_type", _V), ("ca_suite_number", _V),
+                         ("ca_city", _V), ("ca_location_type", _V)],
     "customer_demographics": [
         ("cd_demo_sk", _B), ("cd_gender", _V), ("cd_marital_status", _V),
         ("cd_education_status", _V), ("cd_purchase_estimate", _I),
-        ("cd_credit_rating", _V), ("cd_dep_count", _I)],
+        ("cd_credit_rating", _V), ("cd_dep_count", _I),
+        ("cd_dep_employed_count", _I), ("cd_dep_college_count", _I)],
     "household_demographics": [
         ("hd_demo_sk", _B), ("hd_income_band_sk", _B),
         ("hd_buy_potential", _V), ("hd_dep_count", _I),
@@ -672,38 +1164,80 @@ _SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
     "web_site": [("web_site_sk", _B), ("web_site_id", _V),
                  ("web_name", _V), ("web_company_name", _V)],
     "store_sales": [
-        ("ss_sold_date_sk", _B), ("ss_item_sk", _B), ("ss_customer_sk", _B),
+        ("ss_sold_date_sk", _B), ("ss_sold_time_sk", _B),
+        ("ss_item_sk", _B), ("ss_customer_sk", _B),
         ("ss_cdemo_sk", _B), ("ss_hdemo_sk", _B), ("ss_addr_sk", _B),
         ("ss_store_sk", _B), ("ss_promo_sk", _B), ("ss_ticket_number", _B),
         ("ss_quantity", _I), ("ss_wholesale_cost", _D),
         ("ss_list_price", _D), ("ss_sales_price", _D),
         ("ss_ext_sales_price", _D), ("ss_ext_discount_amt", _D),
         ("ss_ext_list_price", _D), ("ss_ext_wholesale_cost", _D),
-        ("ss_net_profit", _D), ("ss_net_paid", _D)],
+        ("ss_net_profit", _D), ("ss_net_paid", _D),
+        ("ss_net_paid_inc_tax", _D), ("ss_coupon_amt", _D),
+        ("ss_ext_tax", _D)],
+    "store_returns": [
+        ("sr_returned_date_sk", _B), ("sr_item_sk", _B),
+        ("sr_customer_sk", _B), ("sr_cdemo_sk", _B), ("sr_store_sk", _B),
+        ("sr_reason_sk", _B), ("sr_ticket_number", _B),
+        ("sr_return_quantity", _I), ("sr_return_amt", _D),
+        ("sr_return_amt_inc_tax", _D), ("sr_fee", _D),
+        ("sr_refunded_cash", _D), ("sr_reversed_charge", _D),
+        ("sr_store_credit", _D), ("sr_net_loss", _D)],
     "catalog_sales": [
-        ("cs_sold_date_sk", _B), ("cs_ship_date_sk", _B),
+        ("cs_sold_date_sk", _B), ("cs_sold_time_sk", _B),
+        ("cs_ship_date_sk", _B),
         ("cs_bill_customer_sk", _B), ("cs_bill_cdemo_sk", _B),
-        ("cs_bill_hdemo_sk", _B), ("cs_item_sk", _B), ("cs_promo_sk", _B),
+        ("cs_bill_hdemo_sk", _B), ("cs_bill_addr_sk", _B),
+        ("cs_ship_customer_sk", _B), ("cs_item_sk", _B),
+        ("cs_promo_sk", _B),
         ("cs_order_number", _B), ("cs_warehouse_sk", _B),
-        ("cs_ship_addr_sk", _B), ("cs_quantity", _I),
+        ("cs_ship_addr_sk", _B), ("cs_call_center_sk", _B),
+        ("cs_catalog_page_sk", _B), ("cs_ship_mode_sk", _B),
+        ("cs_quantity", _I),
         ("cs_wholesale_cost", _D), ("cs_list_price", _D),
         ("cs_sales_price", _D), ("cs_ext_sales_price", _D),
-        ("cs_ext_list_price", _D), ("cs_net_profit", _D)],
+        ("cs_ext_list_price", _D), ("cs_net_profit", _D),
+        ("cs_ext_discount_amt", _D), ("cs_ext_wholesale_cost", _D),
+        ("cs_ext_ship_cost", _D), ("cs_ext_tax", _D),
+        ("cs_net_paid", _D), ("cs_net_paid_inc_tax", _D),
+        ("cs_coupon_amt", _D)],
     "catalog_returns": [
         ("cr_order_number", _B), ("cr_item_sk", _B),
         ("cr_return_quantity", _I), ("cr_returned_date_sk", _B),
-        ("cr_refunded_cash", _D)],
+        ("cr_refunded_cash", _D), ("cr_returning_customer_sk", _B),
+        ("cr_returning_addr_sk", _B), ("cr_call_center_sk", _B),
+        ("cr_catalog_page_sk", _B), ("cr_reason_sk", _B),
+        ("cr_return_amount", _D), ("cr_return_amt_inc_tax", _D),
+        ("cr_reversed_charge", _D), ("cr_store_credit", _D),
+        ("cr_net_loss", _D)],
     "web_sales": [
-        ("ws_sold_date_sk", _B), ("ws_ship_date_sk", _B),
+        ("ws_sold_date_sk", _B), ("ws_sold_time_sk", _B),
+        ("ws_ship_date_sk", _B),
         ("ws_item_sk", _B), ("ws_order_number", _B),
-        ("ws_bill_customer_sk", _B), ("ws_ship_addr_sk", _B),
-        ("ws_web_site_sk", _B), ("ws_warehouse_sk", _B),
-        ("ws_quantity", _I), ("ws_ext_sales_price", _D),
+        ("ws_bill_customer_sk", _B), ("ws_bill_addr_sk", _B),
+        ("ws_ship_customer_sk", _B), ("ws_ship_hdemo_sk", _B),
+        ("ws_ship_addr_sk", _B),
+        ("ws_web_site_sk", _B), ("ws_web_page_sk", _B),
+        ("ws_warehouse_sk", _B), ("ws_ship_mode_sk", _B),
+        ("ws_promo_sk", _B),
+        ("ws_quantity", _I), ("ws_wholesale_cost", _D),
+        ("ws_list_price", _D), ("ws_sales_price", _D),
+        ("ws_ext_sales_price", _D),
         ("ws_ext_ship_cost", _D), ("ws_net_profit", _D),
-        ("ws_ext_list_price", _D)],
+        ("ws_ext_list_price", _D), ("ws_ext_discount_amt", _D),
+        ("ws_ext_wholesale_cost", _D), ("ws_ext_tax", _D),
+        ("ws_net_paid", _D), ("ws_net_paid_inc_tax", _D),
+        ("ws_coupon_amt", _D)],
     "web_returns": [
         ("wr_order_number", _B), ("wr_item_sk", _B),
-        ("wr_return_quantity", _I), ("wr_returned_date_sk", _B)],
+        ("wr_return_quantity", _I), ("wr_returned_date_sk", _B),
+        ("wr_return_amt", _D), ("wr_return_amt_inc_tax", _D),
+        ("wr_fee", _D), ("wr_refunded_cash", _D),
+        ("wr_reversed_charge", _D), ("wr_net_loss", _D),
+        ("wr_reason_sk", _B), ("wr_web_page_sk", _B),
+        ("wr_returning_customer_sk", _B), ("wr_returning_addr_sk", _B),
+        ("wr_returning_cdemo_sk", _B), ("wr_refunded_addr_sk", _B),
+        ("wr_refunded_cdemo_sk", _B)],
     "inventory": [
         ("inv_date_sk", _B), ("inv_item_sk", _B),
         ("inv_warehouse_sk", _B), ("inv_quantity_on_hand", _I)],
@@ -748,10 +1282,15 @@ class TpcdsConnector(Connector):
             "customer_demographics": g.n_cdemo,
             "household_demographics": g.n_hdemo,
             "web_site": g.n_web_site, "store_sales": g.n_store_sales,
+            "store_returns": g.n_store_returns,
             "catalog_sales": g.n_catalog_sales,
             "catalog_returns": g.n_catalog_returns,
             "web_sales": g.n_web_sales, "web_returns": g.n_web_returns,
-            "inventory": g.n_inventory,
+            "inventory": g.n_inventory, "time_dim": g.n_time,
+            "reason": g.n_reason, "ship_mode": g.n_ship_mode,
+            "income_band": g.n_income_band,
+            "call_center": g.n_call_center,
+            "catalog_page": g.n_catalog_page, "web_page": g.n_web_page,
         }[table]
 
     def list_tables(self) -> List[str]:

@@ -50,7 +50,8 @@ def _minmax_dict_input(a: "AggChannel", col):
     return vals, post
 
 
-_HOST_PRIMS = ("collect", "collect_merge", "hll", "hll_merge")
+_HOST_PRIMS = ("collect", "collect_merge", "hll", "hll_merge",
+               "kll", "kll_merge")
 
 
 def _has_collect(aggs: Sequence[AggChannel]) -> bool:
@@ -148,6 +149,23 @@ def host_aggregate(batches: List[Batch], group_channels: Sequence[int],
                     sketches[g].add_value(v)
             cols.append(column_from_pylist(
                 a.out_type, [s.serialize() for s in sketches]))
+            continue
+        if a.prim in ("kll", "kll_merge"):
+            from presto_tpu.sketch import KllSketch
+
+            merge = a.prim == "kll_merge"
+            qsketches = [KllSketch() for _ in range(ng)]
+            for i in range(n):
+                v = in_list[i]
+                if v is None:
+                    continue
+                g = int(gids[i])
+                if merge:
+                    qsketches[g].merge(KllSketch.deserialize(v))
+                else:
+                    qsketches[g].add_value(v)
+            cols.append(column_from_pylist(
+                a.out_type, [s.serialize() for s in qsketches]))
             continue
         # sum / min / max over non-null values
         out2: List[Optional[object]] = [None] * ng

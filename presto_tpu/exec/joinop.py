@@ -98,10 +98,13 @@ class SpilledLookupSource:
 
 @jax.jit
 def _build_index_single(kv_pair, num_rows):
-    """Single-word build: ids + sorted index + the live minimum, one XLA
-    program.  Ids are (value - min + 2) so NEGATIVE key values map to
-    valid non-negative ids too (the sentinels own {-2,-1}); the min rides
-    to the probe side as a device scalar — no host sync."""
+    """Single-word build: ids + sorted index + the live minimum + a
+    span-overflow flag, one XLA program.  Ids are (value - min + 2) so
+    NEGATIVE key values map to valid non-negative ids too (the sentinels
+    own {-2,-1}); the min rides to the probe side as a device scalar.
+    The caller reads only the flag (one scalar sync) and falls back to
+    the canonical path when the live key spread would overflow the id
+    arithmetic."""
     from presto_tpu.ops import join as J
 
     values, valid = kv_pair
@@ -114,11 +117,15 @@ def _build_index_single(kv_pair, num_rows):
     else:
         has_null = jnp.zeros((), bool)
     v = values.astype(jnp.int64)
+    u = v.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+    umin = jnp.min(jnp.where(dead, jnp.uint64(2**64 - 1), u))
+    umax = jnp.max(jnp.where(dead, jnp.uint64(0), u))
+    span_big = (~jnp.all(dead)) & ((umax - umin) >= jnp.uint64(1 << 62))
     bmin = jnp.min(jnp.where(dead, jnp.int64(2**62), v))
     bmin = jnp.where(jnp.all(dead), jnp.int64(0), bmin)
     ids = jnp.where(dead, jnp.int64(-2), v - bmin + 2)
     sb, perm = J.build_index(ids)
-    return sb, perm, bmin, has_null
+    return sb, perm, bmin, has_null, span_big
 
 
 @jax.jit
@@ -240,13 +247,12 @@ class HashBuildOperator(Operator):
         key_pairs = tuple(
             (data.columns[c].values, data.columns[c].valid) for c in chans)
         if len(chans) == 1 and _is_single_word_type(data.columns[chans[0]].type):
-            # one host sync guards the id arithmetic: a live key spread
+            # one scalar sync guards the id arithmetic: a live key spread
             # >= 2^62 would overflow the (value - min + 2) ids, silently
             # dropping matches — such builds take the canonical path
-            los, his = _key_ranges(key_pairs, n)
-            if int(his[0]) - int(los[0]) < (1 << 62):
-                sb, perm, bmin, has_null = _build_index_single(
-                    key_pairs[0], n)
+            sb, perm, bmin, has_null, span_big = _build_index_single(
+                key_pairs[0], n)
+            if not bool(span_big):
                 self.f.lookup.set(LookupSource(
                     "single", sb, perm, data, n_build, chans, mins=bmin,
                     has_null_key=has_null))
@@ -383,14 +389,11 @@ def _stream_probe(probe_pairs, build_pairs, sorted_ids, perm, mins,
     live = ids >= 0
     if s.join_type in ("semi", "anti"):
         if s.join_type == "anti":
-            in_row = jnp.arange(cap) < num_rows
-            key_nonnull = jnp.ones(cap, bool)
-            for c in s.key_channels:
-                if probe_pairs[c][1] is not None:
-                    key_nonnull = key_nonnull & probe_pairs[c][1]
             n_build, has_null = bstats
-            mask = J.anti_keep_mask(counts, live, key_nonnull, in_row,
-                                    s.null_aware, n_build, has_null)
+            mask = J.anti_keep_from_parts(
+                counts, live, jnp.arange(cap) < num_rows, s.null_aware,
+                [probe_pairs[c][1] for c in s.key_channels],
+                n_build, build_has_null=has_null)
         else:
             mask = J.semi_mask(counts, live, anti=False)
         idx, count = selected_positions(mask, None, num_rows, cap)
@@ -632,23 +635,17 @@ class LookupJoinOperator(Operator):
                 else:
                     etotal = zero
                     if join_type == "anti":
-                        in_row = jnp.arange(cap) < num_rows
-                        key_nonnull = jnp.ones(cap, bool)
-                        for c in probe_op.f.probe_key_channels:
-                            if probe_cols_pairs[c][1] is not None:
-                                key_nonnull = (key_nonnull
-                                               & probe_cols_pairs[c][1])
-                        bhn = jnp.zeros((), bool)
-                        for c in probe_op.f.build.key_channels:
-                            bvalid = build_cols_pairs[c][1]
-                            if bvalid is not None:
-                                bin_row = (jnp.arange(bvalid.shape[0])
-                                           < src.n_build)
-                                bhn = bhn | (bin_row & ~bvalid).any()
-                        mask = J.anti_keep_mask(
-                            counts, live, key_nonnull, in_row,
+                        bcap = build_cols_pairs[0][0].shape[0]
+                        mask = J.anti_keep_from_parts(
+                            counts, live, jnp.arange(cap) < num_rows,
                             probe_op.f.null_aware,
-                            jnp.int64(src.n_build), bhn)
+                            [probe_cols_pairs[c][1]
+                             for c in probe_op.f.probe_key_channels],
+                            jnp.int64(src.n_build),
+                            build_key_valids=[
+                                build_cols_pairs[c][1]
+                                for c in probe_op.f.build.key_channels],
+                            build_in_row=jnp.arange(bcap) < src.n_build)
                     else:
                         mask = J.semi_mask(counts, live, anti=False)
                 idx, count = selected_positions(mask, None, num_rows,
@@ -712,23 +709,18 @@ class LookupJoinOperator(Operator):
             cres = self._residual_compiled(probe, src)
             if cres is None:
                 if join_type == "anti":
-                    in_row = jnp.arange(cap) < n
-                    key_nonnull = jnp.ones(cap, bool)
-                    for c in self.f.probe_key_channels:
-                        if probe.columns[c].valid is not None:
-                            key_nonnull = (key_nonnull
-                                           & probe.columns[c].valid)
-                    bhn = jnp.zeros((), bool)
-                    for c in self.f.build.key_channels:
-                        bc = src.data.columns[c]
-                        if bc.valid is not None:
-                            bin_row = (jnp.arange(bc.valid.shape[0])
-                                       < src.data.num_rows)
-                            bhn = bhn | (bin_row & ~bc.valid).any()
-                    mask = J.anti_keep_mask(
-                        counts, live, key_nonnull, in_row,
+                    bcap = src.data.capacity
+                    mask = J.anti_keep_from_parts(
+                        counts, live, jnp.arange(cap) < n,
                         self.f.null_aware,
-                        jnp.int64(src.data.num_rows), bhn)
+                        [probe.columns[c].valid
+                         for c in self.f.probe_key_channels],
+                        jnp.int64(src.data.num_rows),
+                        build_key_valids=[
+                            src.data.columns[c].valid
+                            for c in self.f.build.key_channels],
+                        build_in_row=(jnp.arange(bcap)
+                                      < src.data.num_rows))
                 else:
                     mask = J.semi_mask(counts, live, anti=False)
             else:

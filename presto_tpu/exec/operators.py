@@ -362,6 +362,117 @@ class TableWriterOperatorFactory(OperatorFactory):
         return self.op
 
 
+class DistributedTableWriterOperator(Operator):
+    """Worker half of a distributed write (P6): stream input into the
+    connector's per-task STAGING sink and emit one (rows, fragment) row;
+    nothing is visible to readers until the TableFinish commit
+    (TableWriterOperator.java:58 under SCALED_WRITER_DISTRIBUTION)."""
+
+    def __init__(self, ctx: OperatorContext, sink):
+        super().__init__(ctx)
+        self.sink = sink
+        self._row: Optional[tuple] = None
+        self._emitted = False
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_rows += batch.num_rows
+        self.sink.append(batch)
+
+    def finish(self) -> None:
+        if not self._finishing:
+            super().finish()
+            rows = self.sink.finish()
+            self._row = (rows, self.sink.fragment())
+
+    def get_output(self) -> Optional[Batch]:
+        if self._row is None or self._emitted:
+            return None
+        self._emitted = True
+        from presto_tpu.batch import batch_from_pylist
+
+        self.ctx.stats.output_rows += 1
+        return batch_from_pylist([T.BIGINT, T.VARCHAR], [self._row])
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class DistributedTableWriterOperatorFactory(OperatorFactory):
+    def __init__(self, registry, catalog: str, table: str, write_id: str,
+                 task_tag: str):
+        self.registry = registry
+        self.catalog = catalog
+        self.table = table
+        self.write_id = write_id
+        self.task_tag = task_tag
+
+    def create(self, ctx: OperatorContext
+               ) -> DistributedTableWriterOperator:
+        conn = self.registry.get(self.catalog)
+        handle = conn.get_table(self.table)
+        sink = conn.task_sink(handle, self.write_id,
+                              f"{self.task_tag}.{ctx.name}")
+        return DistributedTableWriterOperator(ctx, sink)
+
+
+class TableFinishOperator(Operator):
+    """Commit half (TableFinishOperator.java:46): collects every writer
+    task's (rows, fragment) row, publishes all fragments in ONE
+    connector call (all-or-nothing), and emits the total row count."""
+
+    def __init__(self, ctx: OperatorContext, registry, catalog: str,
+                 table: str, write_id: str):
+        super().__init__(ctx)
+        self.registry = registry
+        self.catalog = catalog
+        self.table = table
+        self.write_id = write_id
+        self._rows = 0
+        self._fragments: List[str] = []
+        self._emitted = False
+        self._committed = False
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_rows += batch.num_rows
+        for rows, frag in batch.to_pylist():
+            self._rows += int(rows)
+            if frag is not None:
+                self._fragments.append(frag)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        super().finish()
+        conn = self.registry.get(self.catalog)
+        handle = conn.get_table(self.table)
+        conn.finish_write(handle, self.write_id, self._fragments)
+        self._committed = True
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._committed or self._emitted:
+            return None
+        self._emitted = True
+        from presto_tpu.batch import batch_from_pylist
+
+        self.ctx.stats.output_rows += 1
+        return batch_from_pylist([T.BIGINT], [(self._rows,)])
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class TableFinishOperatorFactory(OperatorFactory):
+    def __init__(self, registry, catalog: str, table: str, write_id: str):
+        self.registry = registry
+        self.catalog = catalog
+        self.table = table
+        self.write_id = write_id
+
+    def create(self, ctx: OperatorContext) -> TableFinishOperator:
+        return TableFinishOperator(ctx, self.registry, self.catalog,
+                                   self.table, self.write_id)
+
+
 class OutputCollector(Operator):
     """Terminal sink gathering result batches host-side
     (TaskOutputOperator / test MaterializedResult role)."""

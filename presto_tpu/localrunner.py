@@ -455,7 +455,10 @@ class LocalQueryRunner:
         except Exception:
             return False
 
-    def _create_table_as(self, stmt: t.CreateTableAs) -> QueryResult:
+    def prepare_ctas(self, stmt: t.CreateTableAs):
+        """Plan CTAS: returns (logical OutputNode | None-if-exists, conn,
+        handle, catalog, name).  Shared by the local write path and the
+        coordinator's distributed writer planning."""
         from presto_tpu.connectors.api import ColumnMetadata, TableSchema
 
         logical = Planner(self.metadata).plan(stmt.query)
@@ -464,16 +467,23 @@ class LocalQueryRunner:
             self.session.user, catalog, name)
         conn = self.registry.get(catalog)
         if stmt.if_not_exists and self._table_exists(conn, name):
-            return QueryResult(["rows"], [T.BIGINT], [(0,)])
+            return None, conn, None, catalog, name
         schema = TableSchema(name, tuple(
             ColumnMetadata(cn, typ) for cn, typ in logical.columns))
         handle = conn.create_table(name, schema,
                                    dict(stmt.properties) or None)
+        return logical, conn, handle, catalog, name
+
+    def _create_table_as(self, stmt: t.CreateTableAs) -> QueryResult:
+        logical, conn, handle, _, _ = self.prepare_ctas(stmt)
+        if logical is None:
+            return QueryResult(["rows"], [T.BIGINT], [(0,)])
         return self._write(logical, conn, handle)
 
-    def _insert(self, stmt: t.Insert) -> QueryResult:
+    def prepare_insert(self, stmt: t.Insert):
+        """Plan INSERT with column alignment/coercion: returns
+        (logical OutputNode, conn, handle, catalog, name)."""
         from presto_tpu.expr import build as B
-        from presto_tpu.expr.ir import InputRef
         from presto_tpu.sql.plan import OutputNode, ProjectNode
 
         catalog, name = self._resolve_write_target(stmt.table)
@@ -510,7 +520,10 @@ class LocalQueryRunner:
         cols = tuple((cn, schema.column_type(cn))
                      for cn in schema.column_names())
         project = ProjectNode(logical.source, tuple(exprs), cols)
-        logical = OutputNode(project, cols)
+        return OutputNode(project, cols), conn, handle, catalog, name
+
+    def _insert(self, stmt: t.Insert) -> QueryResult:
+        logical, conn, handle, _, _ = self.prepare_insert(stmt)
         return self._write(logical, conn, handle)
 
     def _write(self, logical, conn, handle) -> QueryResult:

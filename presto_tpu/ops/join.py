@@ -325,6 +325,34 @@ def anti_keep_mask(counts: jax.Array, live_ids: jax.Array,
     return jnp.where(empty, in_row, survive)
 
 
+def anti_keep_from_parts(counts, live_ids, in_row, null_aware: bool,
+                         probe_key_valids, n_build_rows,
+                         build_has_null=None, build_key_valids=(),
+                         build_in_row=None):
+    """anti_keep_mask with the key-nonnull / build-has-null inputs derived
+    from raw validity masks — the one place the NOT IN plumbing lives
+    (every execution tier calls this instead of re-rolling it).
+
+    ``probe_key_valids``: per-probe-key-channel valid masks (None entries
+    = non-nullable).  Build-side null presence comes either precomputed
+    (``build_has_null``, a device scalar from the build kernel) or from
+    ``build_key_valids`` + ``build_in_row``.
+    """
+    cap = counts.shape[0]
+    key_nonnull = jnp.ones(cap, bool)
+    for v in probe_key_valids:
+        if v is not None:
+            key_nonnull = key_nonnull & v
+    if build_has_null is None:
+        build_has_null = jnp.zeros((), bool)
+        for bv in build_key_valids:
+            if bv is not None:
+                bad = ~bv if build_in_row is None else (build_in_row & ~bv)
+                build_has_null = build_has_null | bad.any()
+    return anti_keep_mask(counts, live_ids, key_nonnull, in_row,
+                          null_aware, n_build_rows, build_has_null)
+
+
 def matched_build_mask(lo: jax.Array, counts: jax.Array, cap_b: int,
                        perm_b: jax.Array) -> jax.Array:
     """Which build rows matched >= 1 probe row (for right/full outer).

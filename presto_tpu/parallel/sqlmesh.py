@@ -125,8 +125,6 @@ def _check_supported(node: PlanNode) -> None:
         exprs.append(node.predicate)
     if isinstance(node, ProjectNode):
         exprs.extend(node.expressions)
-    if isinstance(node, SemiJoinNode) and node.residual is not None:
-        raise MeshUnsupported("correlated EXISTS residual")
     if isinstance(node, JoinNode) and node.residual is not None:
         exprs.append(node.residual)
     if exprs and needs_host_path(exprs):
@@ -1032,8 +1030,6 @@ class _MeshProgram:
     def _lower_semijoin(self, node: SemiJoinNode) -> MTable:
         from presto_tpu.ops import join as J
 
-        if node.residual is not None:
-            raise MeshUnsupported("correlated EXISTS residual")
         src = self._lower(node.source)
         filt = self._lower(node.filtering)
         btrip, strip = self._key_triples(filt, node.filtering_keys,
@@ -1049,19 +1045,53 @@ class _MeshProgram:
         else:
             bids, sids = J.canonical_ids(btrip, strip, filt.cap, src.cap)
         sorted_b, perm_b = J.build_index(bids)
-        _, counts = J.probe_counts(sorted_b, perm_b, sids)
+        lo, counts = J.probe_counts(sorted_b, perm_b, sids)
         if src.replicated and not filt.replicated:
             # each shard would apply only ITS slice of the filtering set
             raise MeshUnsupported("semi join: replicated source over "
                                   "sharded filtering side")
+        if node.residual is not None:
+            # correlated EXISTS residual (TPC-H Q21 shape): expand key
+            # matches, evaluate the residual over [source cols, filtering
+            # cols] per candidate pair, reduce any-pass per source row —
+            # the operator tier's canonical semi/anti kernel, in-trace
+            import jax.numpy as jnp
+
+            out_cap = next_bucket(
+                self.cap_scale * max(src.cap, filt.cap), minimum=8)
+            pi, bi, rv, _, total = J.expand_matches(lo, counts, perm_b,
+                                                    out_cap)
+            self._overflow.append(('semijoin residual expand',
+                                   total > out_cap))
+            pi = pi.astype(jnp.int32)
+            bi = bi.astype(jnp.int32)
+            pair_cols = []
+            for c in src.cols:
+                pair_cols.append(MCol(
+                    c.values[pi],
+                    None if c.valid is None else c.valid[pi],
+                    c.type, c.dictionary))
+            for c in filt.cols:
+                pair_cols.append(MCol(
+                    c.values[bi],
+                    None if c.valid is None else c.valid[bi],
+                    c.type, c.dictionary))
+            pairs = MTable(pair_cols, rv, out_cap, src.est,
+                           compacted=True, replicated=src.replicated)
+            (ce,) = self._compile([node.residual], pairs)
+            v, valid = ce.run(pairs.pairs(), out_cap, jnp)
+            ok = rv & v
+            if valid is not None:
+                ok = ok & valid
+            matched = (jnp.zeros(src.cap, bool)
+                       .at[pi].max(ok, mode="drop"))
+            keep = (~matched) if node.negated else matched
+            return MTable(src.cols, src.live & keep, src.cap, src.est,
+                          compacted=False, replicated=src.replicated)
         if node.negated and node.null_aware:
             import jax.numpy as jnp
 
             # NOT IN three-valued logic (see ops.join.anti_keep_mask)
-            key_nonnull = jnp.ones(src.cap, bool)
-            for ch in node.source_keys:
-                if src.cols[ch].valid is not None:
-                    key_nonnull = key_nonnull & src.cols[ch].valid
             bhn = jnp.zeros((), bool)
             for ch in node.filtering_keys:
                 fc = filt.cols[ch]
@@ -1077,8 +1107,10 @@ class _MeshProgram:
                 n_filt = jax.lax.psum(filt.live.sum(), AXIS)
             else:
                 n_filt = filt.live.sum()
-            mask = J.anti_keep_mask(counts, sids >= 0, key_nonnull,
-                                    src.live, True, n_filt, bhn)
+            mask = J.anti_keep_from_parts(
+                counts, sids >= 0, src.live, True,
+                [src.cols[ch].valid for ch in node.source_keys],
+                n_filt, build_has_null=bhn)
         else:
             mask = J.semi_mask(counts, src.live, node.negated)
         return MTable(src.cols, src.live & mask, src.cap, src.est,

@@ -211,6 +211,28 @@ class QueryExecution:
                 # + stage-stats rollup role)
                 analyze = True
                 stmt = stmt.statement
+            if isinstance(stmt, (t.Insert, t.CreateTableAs)):
+                dwrite = self._plan_distributed_write(stmt)
+                if dwrite == "done":
+                    self.state = "FINISHED"
+                    return
+                if dwrite is not None:
+                    # distributed DML: writer fragments on workers,
+                    # atomic TableFinish commit (P6)
+                    dplan, abort = dwrite
+                    self.column_names = dplan.column_names
+                    self.column_types = dplan.column_types
+                    self.plan_text = self._format_dplan(dplan)
+                    self.state = "SCHEDULING"
+                    try:
+                        root_locations = self._schedule(dplan)
+                        self.state = "RUNNING"
+                        self._drain(root_locations)
+                    except Exception:
+                        abort()
+                        raise
+                    self.state = "FINISHED"
+                    return
             if not isinstance(stmt, (t.Query, t.SetOperation)):
                 # DDL/DML/metadata statements run coordinator-side
                 # (the reference's DataDefinitionExecution path,
@@ -367,13 +389,29 @@ class QueryExecution:
                 pass
 
     # -- scheduling -----------------------------------------------------
-    def _task_count(self, partitioning: str, n_workers: int) -> int:
-        return 1 if partitioning == "single" else max(1, n_workers)
+    # rows one writer task absorbs before another is warranted (the
+    # writerMinSize role of ScaledWriterScheduler.java:40, expressed in
+    # rows since CBO stats are row-based)
+    SCALED_WRITER_ROWS_PER_TASK = 200_000
+
+    def _task_count(self, frag, n_workers: int) -> int:
+        if frag.partitioning == "single":
+            return 1
+        if frag.partitioning == "scaled":
+            # scaled writers (P6): size the writer-task count to the
+            # estimated volume — small INSERTs get one writer, bulk CTAS
+            # scales to every worker
+            rows = frag.scale_rows
+            if rows is None:
+                return max(1, n_workers)
+            need = int(rows // self.SCALED_WRITER_ROWS_PER_TASK) + 1
+            return max(1, min(n_workers, need))
+        return max(1, n_workers)
 
     def _schedule(self, dplan: DistributedPlan) -> List[str]:
         workers = self._wait_for_workers()
         n_workers = len(workers)
-        counts = {f.fragment_id: self._task_count(f.partitioning, n_workers)
+        counts = {f.fragment_id: self._task_count(f, n_workers)
                   for f in dplan.fragments}
         consumers: Dict[int, int] = {}  # producer fid -> consumer fid
         for f in dplan.fragments:
@@ -534,6 +572,79 @@ class QueryExecution:
                                             stmt.parameters)
             return bound
         return stmt
+
+    def _plan_distributed_write(self, stmt):
+        """INSERT/CTAS against a connector with two-phase write support
+        becomes a distributed plan: query fragments -> round-robin
+        exchange -> 'scaled' writer fragment -> single TableFinish commit
+        fragment (P6).  Returns (DistributedPlan, abort_fn) or None to
+        fall back to the coordinator-side write."""
+        from presto_tpu.localrunner import LocalQueryRunner
+        from presto_tpu.sql.plan import (
+            OutputNode, TableFinishNode, TableWriterNode,
+        )
+
+        runner = LocalQueryRunner(
+            self.co.registry, self.catalog, self.co.config,
+            session=self._session())
+        runner.grants = self.co.grants
+        # cheap gates FIRST: the CTAS prepare creates the target table, so
+        # a later fallback must not have run it (the coordinator-side path
+        # would then see "table already exists")
+        if runner.session.txn is not None:
+            return None               # explicit txn needs session affinity
+        try:
+            target_catalog, _ = runner._resolve_write_target(stmt.table)
+            conn0 = self.co.registry.get(target_catalog)
+        except Exception:  # noqa: BLE001 - let the utility path report it
+            return None
+        if not getattr(conn0, "supports_distributed_write", False):
+            return None
+        if isinstance(stmt, t.Insert):
+            logical, conn, handle, catalog, name = \
+                runner.prepare_insert(stmt)
+        else:
+            logical, conn, handle, catalog, name = \
+                runner.prepare_ctas(stmt)
+            if logical is None:       # IF NOT EXISTS, table present
+                return self._empty_write_result()
+        is_ctas = isinstance(stmt, t.CreateTableAs)
+        write_id = None
+
+        def abort():
+            try:
+                if write_id is not None:
+                    conn.abort_write(handle, write_id)
+                if is_ctas:
+                    # CTAS is all-or-nothing: no empty table left behind
+                    conn.drop_table(name)
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+
+        try:
+            metadata = Metadata(self.co.registry, self.catalog)
+            optimized = optimize(logical, metadata)
+            write_id = conn.begin_write(handle)
+            wcols = (("rows", T.BIGINT), ("fragment", T.VARCHAR))
+            fcols = (("rows", T.BIGINT),)
+            writer = TableWriterNode(optimized.source, catalog, name,
+                                     write_id, wcols)
+            finish = TableFinishNode(writer, catalog, name, write_id,
+                                     fcols)
+            root = OutputNode(finish, fcols)
+            dplan = Fragmenter(metadata=metadata).fragment(root)
+        except Exception:
+            abort()
+            raise
+        return dplan, abort
+
+    def _empty_write_result(self):
+        """CTAS IF NOT EXISTS with the table already present: done, wrote
+        0 rows; no plan to run and nothing to fall back to."""
+        self.column_names = ["rows"]
+        self.column_types = [T.BIGINT]
+        self.result_rows = [(0,)]
+        return "done"
 
     def _run_utility(self, stmt: t.Node) -> None:
         """Execute a non-query statement against the shared registry via

@@ -24,7 +24,8 @@ from presto_tpu import types as T
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanNode, ProjectNode, RemoteMergeNode, RemoteSourceNode,
-    SemiJoinNode, SortNode, TableScanNode, UnionNode, UnnestNode,
+    SemiJoinNode, SortNode, TableFinishNode, TableScanNode,
+    TableWriterNode, UnionNode, UnnestNode,
     ValuesNode, WindowNode,
 )
 
@@ -46,6 +47,10 @@ class PlanFragment:
     partitioning: str
     output_partitioning: Tuple[str, Tuple[int, ...]]
     consumed_fragments: Tuple[int, ...]
+    # 'scaled' fragments only: estimated input rows, so the scheduler can
+    # size the writer-task count to the data volume
+    # (ScaledWriterScheduler role, statically decided)
+    scale_rows: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -88,6 +93,8 @@ class Fragmenter:
     # fragment(s).
     # ------------------------------------------------------------------
     def _visit(self, node: PlanNode) -> Tuple[PlanNode, List[int]]:
+        if isinstance(node, TableFinishNode):
+            return self._visit_table_finish(node)
         if isinstance(node, AggregationNode):
             return self._visit_aggregation(node)
         if isinstance(node, JoinNode):
@@ -114,6 +121,33 @@ class Fragmenter:
             return _replace_sources(node, new_sources), consumed
         # leaves (TableScan, Values) stay put
         return node, []
+
+    def _visit_table_finish(self, node) -> Tuple[PlanNode, List[int]]:
+        """Distributed DML (P6, scaled writers): the query subtree becomes
+        its own fragment with round-robin ('arbitrary') output feeding a
+        'scaled'-partitioned writer fragment whose task count the
+        scheduler sizes to the estimated volume
+        (SCALED_WRITER_DISTRIBUTION, SystemPartitioningHandle.java:62 +
+        ScaledWriterScheduler.java:40); the TableFinish commit stays in
+        the single root fragment."""
+        writer: TableWriterNode = node.source
+        src, consumed = self._visit(writer.source)
+        est = None
+        try:
+            est = self._estimate_rows(writer.source)
+        except Exception:  # noqa: BLE001 - stats are advisory
+            pass
+        fid_src = self._source_fragment(src, consumed, ("arbitrary", ()))
+        remote = RemoteSourceNode((fid_src,),
+                                  tuple(writer.source.columns))
+        w = TableWriterNode(remote, writer.catalog, writer.table,
+                            writer.write_id, writer.columns)
+        fid_w = self._add(w, "scaled", ("single", ()), [fid_src])
+        self.fragments[fid_w].scale_rows = est
+        remote_w = RemoteSourceNode((fid_w,), tuple(writer.columns))
+        finish = TableFinishNode(remote_w, node.catalog, node.table,
+                                 node.write_id, node.columns)
+        return finish, [fid_w]
 
     def _visit_union(self, node: UnionNode) -> Tuple[PlanNode, List[int]]:
         """UNION ALL branches with their own scans become source fragments

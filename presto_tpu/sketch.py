@@ -86,3 +86,111 @@ class HyperLogLog:
 
 def hll_cardinality(payload: str) -> int:
     return HyperLogLog.deserialize(payload).cardinality()
+
+
+# ---------------------------------------------------------------------------
+# KLL quantile sketch (approx_percentile)
+# ---------------------------------------------------------------------------
+
+class KllSketch:
+    """Mergeable streaming quantile sketch (KLL16-style).
+
+    Replaces the reference's qdigest state
+    (presto-main/.../aggregation/QuantileDigestAggregationFunction.java)
+    with the simpler KLL compactor scheme: level h holds items each
+    representing 2^h input values; a full level sorts itself and keeps
+    alternate items (random offset), promoting them one level up.  State
+    is O(k * log(n/k)) regardless of input size — the bounded-memory,
+    exchange-friendly property the old collect-everything implementation
+    lacked.  Error is rank-based (~1.5/k one-sided at default k).
+
+    Values are stored as floats (SQL numeric inputs convert losslessly for
+    realistic magnitudes); quantile() returns a float the caller casts to
+    the column type.
+    """
+
+    K = 200
+
+    def __init__(self, levels=None, count: int = 0, seed: int = 0x9E3779B9):
+        self.levels = [list(lv) for lv in levels] if levels else [[]]
+        self.count = count
+        self._rng = np.random.default_rng(seed)
+
+    # -- building -------------------------------------------------------
+    def add_value(self, value) -> None:
+        if value is None:
+            return
+        self.levels[0].append(float(value))
+        self.count += 1
+        if len(self.levels[0]) >= self._cap(0):
+            self._compact()
+
+    def add_many(self, values: Iterable) -> None:
+        for v in values:
+            self.add_value(v)
+
+    def _cap(self, level: int) -> int:
+        # higher levels shrink geometrically (KLL's (2/3)^depth rule,
+        # floored) — most memory lives at the base
+        depth = max(len(self.levels) - 1 - level, 0)
+        return max(int(self.K * (2.0 / 3.0) ** depth), 8)
+
+    def _compact(self) -> None:
+        for h in range(len(self.levels)):
+            if len(self.levels[h]) < self._cap(h):
+                continue
+            buf = sorted(self.levels[h])
+            keep = buf[int(self._rng.integers(0, 2))::2]
+            self.levels[h] = []
+            if h + 1 == len(self.levels):
+                self.levels.append([])
+            self.levels[h + 1].extend(keep)
+
+    # -- merge / query --------------------------------------------------
+    def merge(self, other: "KllSketch") -> None:
+        while len(self.levels) < len(other.levels):
+            self.levels.append([])
+        for h, lv in enumerate(other.levels):
+            self.levels[h].extend(lv)
+        self.count += other.count
+        for h in range(len(self.levels)):
+            while len(self.levels[h]) >= 2 * self._cap(h):
+                self._compact_level(h)
+
+    def _compact_level(self, h: int) -> None:
+        buf = sorted(self.levels[h])
+        keep = buf[int(self._rng.integers(0, 2))::2]
+        self.levels[h] = []
+        if h + 1 == len(self.levels):
+            self.levels.append([])
+        self.levels[h + 1].extend(keep)
+
+    def quantile(self, q: float) -> Optional[float]:
+        items: list = []
+        for h, lv in enumerate(self.levels):
+            w = 1 << h
+            items.extend((v, w) for v in lv)
+        if not items:
+            return None
+        items.sort()
+        total = sum(w for _, w in items)
+        target = q * total
+        acc = 0
+        for v, w in items:
+            acc += w
+            if acc >= target:
+                return v
+        return items[-1][0]
+
+    # -- serde ----------------------------------------------------------
+    def serialize(self) -> str:
+        import json
+
+        return json.dumps({"c": self.count, "l": self.levels})
+
+    @classmethod
+    def deserialize(cls, payload: str) -> "KllSketch":
+        import json
+
+        doc = json.loads(payload)
+        return cls(levels=doc["l"], count=int(doc["c"]))

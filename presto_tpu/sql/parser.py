@@ -917,6 +917,11 @@ class _Parser:
                     self.expect_op(")")
                     return t.FunctionCall("strpos", (hay, needle))
                 return self.function_call(self.identifier())
+            if (tok.kind == "IDENT" and tok.text == "decimal"
+                    and self.peek(1).kind == "STRING"):
+                # DECIMAL '1.2' typed literal (SqlBase.g4 numericLiteral)
+                self.next()
+                return t.TypedLiteral("decimal", self.next().text)
             return t.Identifier(self.qualified_name())
         raise SqlSyntaxError(f"unexpected {tok.text or 'end of input'!r}",
                              tok.line, tok.col)
@@ -943,7 +948,7 @@ class _Parser:
         if word in ("true", "false"):
             self.next()
             return t.BooleanLiteral(word == "true")
-        if word in ("date", "timestamp", "time"):
+        if word in ("date", "timestamp", "time", "decimal"):
             if self.peek(1).kind == "STRING":
                 self.next()
                 return t.TypedLiteral(word, self.next().text)

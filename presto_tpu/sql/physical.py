@@ -48,7 +48,8 @@ from presto_tpu.expr.ir import InputRef, RowExpression
 from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanAggregate, PlanNode, ProjectNode, RemoteMergeNode,
-    RemoteSourceNode, SemiJoinNode, SortNode, TableScanNode, UnionNode,
+    RemoteSourceNode, SemiJoinNode, SortNode, TableFinishNode,
+    TableScanNode, TableWriterNode, UnionNode,
     UnnestNode, ValuesNode, WindowNode,
 )
 
@@ -195,6 +196,25 @@ class PhysicalPlanner:
             chain.append(UnnestOperatorFactory(
                 node.replicate_channels, node.unnest_channels,
                 node.ordinality, node.outer))
+            return chain, splits
+        if isinstance(node, TableWriterNode):
+            from presto_tpu.exec.operators import (
+                DistributedTableWriterOperatorFactory,
+            )
+
+            chain, splits = self._lower(node.source)
+            task_tag = (str(self.scan_shard[0])
+                        if self.scan_shard is not None else "0")
+            chain.append(DistributedTableWriterOperatorFactory(
+                self.registry, node.catalog, node.table, node.write_id,
+                task_tag))
+            return chain, splits
+        if isinstance(node, TableFinishNode):
+            from presto_tpu.exec.operators import TableFinishOperatorFactory
+
+            chain, splits = self._lower(node.source)
+            chain.append(TableFinishOperatorFactory(
+                self.registry, node.catalog, node.table, node.write_id))
             return chain, splits
         if isinstance(node, UnionNode):
             buffer = UnionBuffer(len(node.inputs))
@@ -375,7 +395,8 @@ class PhysicalPlanner:
     _FINAL_PRIM = {"count": "sum", "sum": "sum", "min": "min", "max": "max",
                    "collect": "collect_merge",  # partial arrays flatten
                    "sumln": "sum", "sumhash": "sum",
-                   "hll": "hll_merge"}          # partial sketches max-merge
+                   "hll": "hll_merge",          # partial sketches max-merge
+                   "kll": "kll_merge"}          # quantile sketch union
 
     def _lower_final_aggregation(self, node: AggregationNode):
         """FINAL step over a partial's output: [keys..., comp0, comp1, ...].
@@ -775,7 +796,7 @@ def decompose_aggregates(aggregates: Sequence[PlanAggregate],
                 else:
                     ch = agg.channel
                 agg_channels.append(AggChannel(prim, ch, ctype))
-            elif prim in ("collect", "hll"):
+            elif prim in ("collect", "hll", "kll"):
                 agg_channels.append(
                     AggChannel(prim, agg.channel, ctype))
             elif prim == "sumln":
@@ -835,12 +856,11 @@ def _finalize(agg: PlanAggregate, comps: List[RowExpression]
         return B.call("$hll_cardinality", comps[0])
     if fin.startswith("approx_percentile:"):
         from presto_tpu.expr import functions as F
-
-        p = float(fin.split(":", 1)[1])
-        fn = F.resolve_array_percentile(comps[0].type, p)
         from presto_tpu.expr.ir import Call
 
-        return Call("$array_percentile", (comps[0],), fn.result_type, fn)
+        p = float(fin.split(":", 1)[1])
+        fn = F.resolve_kll_percentile(agg.spec.result_type, p)
+        return Call("$kll_percentile", (comps[0],), fn.result_type, fn)
     if fin in ("corr", "covar_samp", "covar_pop", "regr_slope",
                "regr_intercept"):
         return B.call(f"$rows_{fin}", comps[0])

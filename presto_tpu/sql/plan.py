@@ -294,6 +294,42 @@ class RemoteMergeNode(PlanNode):
 
 
 @D(frozen=True)
+class TableWriterNode(PlanNode):
+    """Streams source rows into a per-task connector staging sink and
+    emits ONE (rows, fragment) row (TableWriterOperator.java:58 role);
+    the matching TableFinishNode commits.  Writer fragments are
+    'scaled'-partitioned (SCALED_WRITER_DISTRIBUTION,
+    SystemPartitioningHandle.java:62)."""
+
+    source: PlanNode
+    catalog: str
+    table: str
+    write_id: str
+    columns: Tuple[Column, ...]  # (("rows", BIGINT), ("fragment", VARCHAR))
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
+class TableFinishNode(PlanNode):
+    """Collects every writer task's (rows, fragment) row, commits the
+    write atomically via Connector.finish_write, and emits the total row
+    count (TableFinishOperator.java:46 role)."""
+
+    source: PlanNode
+    catalog: str
+    table: str
+    write_id: str
+    columns: Tuple[Column, ...]  # (("rows", BIGINT),)
+
+    @property
+    def sources(self):  # type: ignore[override]
+        return (self.source,)
+
+
+@D(frozen=True)
 class OutputNode(PlanNode):
     source: PlanNode
     columns: Tuple[Column, ...]  # output names (possibly renamed)
@@ -333,6 +369,8 @@ def format_plan(node: PlanNode, indent: int = 0) -> str:
                             for c, a, _ in node.sort_keys])
     elif isinstance(node, LimitNode):
         detail = f" {node.count}"
+    elif isinstance(node, (TableWriterNode, TableFinishNode)):
+        detail = f" {node.catalog}.{node.table}"
     out = f"{pad}{name}{detail}  => {[n for n, _ in node.columns]}\n"
     for s in node.sources:
         out += format_plan(s, indent + 1)

@@ -288,7 +288,16 @@ class Translator:
         if isinstance(e, t.NullLiteral):
             return B.null(T.UNKNOWN)
         if isinstance(e, t.TypedLiteral):
-            typ = T.parse_type(e.type_name)
+            if e.type_name == "decimal":
+                # DECIMAL '1.2': precision/scale inferred from the text
+                # (DecimalParseResult role)
+                txt = e.value.strip().lstrip("+-")
+                digits = txt.replace(".", "")
+                scale = len(txt.split(".")[1]) if "." in txt else 0
+                typ: T.Type = T.DecimalType(
+                    "decimal", precision=max(len(digits), 1), scale=scale)
+            else:
+                typ = T.parse_type(e.type_name)
             return B.const(e.value, typ)
         if isinstance(e, t.IntervalLiteral):
             raise SqlAnalysisError(

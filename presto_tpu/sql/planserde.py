@@ -28,6 +28,7 @@ from presto_tpu.sql.plan import (
     AggregationNode, EnforceSingleRowNode, FilterNode, JoinNode, LimitNode,
     OutputNode, PlanAggregate, PlanNode, PlanWindowFunction, ProjectNode,
     RemoteMergeNode, RemoteSourceNode, SemiJoinNode, SortNode,
+    TableFinishNode, TableWriterNode,
     TableScanNode, UnionNode,
     UnnestNode, ValuesNode, WindowNode,
 )
@@ -229,6 +230,14 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "columns": _cols(n.columns),
                 "residual": None if n.residual is None
                 else expr_to_json(n.residual)}
+    if isinstance(n, TableWriterNode):
+        return {"k": "tablewriter", "source": node_to_json(n.source),
+                "catalog": n.catalog, "table": n.table,
+                "write_id": n.write_id, "columns": _cols(n.columns)}
+    if isinstance(n, TableFinishNode):
+        return {"k": "tablefinish", "source": node_to_json(n.source),
+                "catalog": n.catalog, "table": n.table,
+                "write_id": n.write_id, "columns": _cols(n.columns)}
     if isinstance(n, SemiJoinNode):
         return {"k": "semijoin", "source": node_to_json(n.source),
                 "filtering": node_to_json(n.filtering),
@@ -302,6 +311,14 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
                         tuple(d["right_keys"]), _uncols(d["columns"]),
                         None if d.get("residual") is None
                         else expr_from_json(d["residual"]))
+    if k == "tablewriter":
+        return TableWriterNode(node_from_json(d["source"]), d["catalog"],
+                               d["table"], d["write_id"],
+                               _uncols(d["columns"]))
+    if k == "tablefinish":
+        return TableFinishNode(node_from_json(d["source"]), d["catalog"],
+                               d["table"], d["write_id"],
+                               _uncols(d["columns"]))
     if k == "semijoin":
         return SemiJoinNode(node_from_json(d["source"]),
                             node_from_json(d["filtering"]),
@@ -355,11 +372,13 @@ def fragment_to_json(f: PlanFragment) -> Dict[str, Any]:
     return {"fragment_id": f.fragment_id, "root": node_to_json(f.root),
             "partitioning": f.partitioning,
             "output_partitioning": [kind, list(channels)],
-            "consumed_fragments": list(f.consumed_fragments)}
+            "consumed_fragments": list(f.consumed_fragments),
+            "scale_rows": f.scale_rows}
 
 
 def fragment_from_json(d: Dict[str, Any]) -> PlanFragment:
     kind, channels = d["output_partitioning"]
     return PlanFragment(int(d["fragment_id"]), node_from_json(d["root"]),
                         str(d["partitioning"]), (str(kind), tuple(channels)),
-                        tuple(d["consumed_fragments"]))
+                        tuple(d["consumed_fragments"]),
+                        d.get("scale_rows"))

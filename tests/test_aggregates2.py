@@ -72,20 +72,21 @@ class TestHll:
 
 
 class TestPercentile:
-    def test_median_matches_exact(self, runner):
+    def test_median_rank_accuracy(self, runner):
         (p50,) = q1(runner,
                     "select approx_percentile(l_quantity, 0.5) "
                     "from lineitem")
-        # exact nearest-rank median from the oracle
+        # sketch-backed (KLL): approximate by design, like the
+        # reference's qdigest — check the RANK error, not exact equality
         from presto_tpu.connectors.tpch import TpchConnector
 
         conn = TpchConnector(scale=0.01)
         h = conn.get_table("lineitem")
         s = conn.get_splits(h, 1)[0]
         b = next(iter(conn.page_source(s, ["l_quantity"], 1 << 22)))
-        vals = np.sort(np.asarray(b.columns[0].values)[:b.num_rows])
-        exact = vals[int(np.ceil(0.5 * len(vals))) - 1]
-        assert p50 == exact
+        vals = np.asarray(b.columns[0].values)[:b.num_rows]
+        rank_err = abs(float((vals <= p50).mean()) - 0.5)
+        assert rank_err < 0.03, (p50, rank_err)
 
     def test_two_percentiles(self, runner):
         p50, p90 = q1(runner, "select approx_percentile(l_quantity, 0.5), "
@@ -152,8 +153,12 @@ class TestDistributedMerge:
         assert cluster.execute(sql).rows == runner.execute(sql).rows
 
     def test_percentile_merge(self, cluster, runner):
+        # sketch results depend on the split/merge plan; both answers
+        # must sit within rank tolerance of the true median (l_quantity
+        # is uniform 1..50 -> true median 25.5)
         sql = "select approx_percentile(l_quantity, 0.5) from lineitem"
-        assert cluster.execute(sql).rows == runner.execute(sql).rows
+        (d,), (l,) = cluster.execute(sql).rows[0], runner.execute(sql).rows[0]
+        assert 23 <= d <= 28 and 23 <= l <= 28, (d, l)
 
     def test_corr_merge(self, cluster, runner):
         sql = "select corr(l_quantity, l_extendedprice) from lineitem"

@@ -1,0 +1,88 @@
+"""Mergeable approx_percentile (KLL sketch) — bounded state, partial/final
+parity (the QuantileDigestAggregationFunction role, VERDICT r3 #7)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.localrunner import LocalQueryRunner
+from presto_tpu.sketch import KllSketch
+
+
+def test_rank_accuracy_and_bounded_state():
+    rng = np.random.default_rng(1)
+    data = rng.lognormal(size=100_000)
+    s = KllSketch()
+    s.add_many(data.tolist())
+    for q in (0.05, 0.25, 0.5, 0.75, 0.95):
+        got = s.quantile(q)
+        rank_err = abs(float((data <= got).mean()) - q)
+        assert rank_err < 0.02, (q, rank_err)
+    # bounded state: far below the 100k raw values
+    assert len(s.serialize()) < 64_000
+
+
+def test_merge_matches_single_sketch():
+    rng = np.random.default_rng(2)
+    data = rng.normal(size=40_000)
+    parts = [KllSketch(seed=i + 1) for i in range(8)]
+    for i, chunk in enumerate(np.array_split(data, 8)):
+        parts[i].add_many(chunk.tolist())
+    merged = KllSketch()
+    for p in parts:
+        merged.merge(KllSketch.deserialize(p.serialize()))
+    assert merged.count == len(data)
+    for q in (0.1, 0.5, 0.9):
+        got = merged.quantile(q)
+        rank_err = abs(float((data <= got).mean()) - q)
+        assert rank_err < 0.03, (q, rank_err)
+
+
+def test_partial_final_split_parity():
+    """The distributed path: partial 'kll' components on row slices,
+    'kll_merge' at FINAL — same answer as one sketch over everything."""
+    from presto_tpu.batch import batch_from_pylist
+    from presto_tpu.exec.aggregation import AggChannel, host_aggregate
+
+    rng = np.random.default_rng(3)
+    vals = rng.integers(0, 1000, size=9000).astype(float)
+    batches = [
+        batch_from_pylist([T.BIGINT, T.DOUBLE],
+                          [(int(v) % 3, float(v)) for v in chunk])
+        for chunk in np.array_split(vals, 4)
+    ]
+    partials = []
+    for b in batches:
+        out = host_aggregate([b], [0], [AggChannel("kll", 1, T.VARBINARY)],
+                             global_row=False)
+        partials.append(out)
+    final = host_aggregate(partials, [0],
+                           [AggChannel("kll_merge", 1, T.VARBINARY)],
+                           global_row=False)
+    rows = final.to_pylist()
+    assert len(rows) == 3
+    for key, payload in rows:
+        grp = vals[vals.astype(int) % 3 == key]
+        med = KllSketch.deserialize(payload).quantile(0.5)
+        rank_err = abs(float((grp <= med).mean()) - 0.5)
+        assert rank_err < 0.03, (key, rank_err)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+def test_sql_approx_percentile(runner):
+    (m,) = runner.execute(
+        "SELECT approx_percentile(l_quantity, 0.5) "
+        "FROM tpch.lineitem").rows[0]
+    # l_quantity is uniform 1..50: true median 25, rank tolerance ~2
+    assert 23 <= m <= 27
+    rows = runner.execute(
+        "SELECT l_returnflag, approx_percentile(l_extendedprice, 0.9) "
+        "FROM tpch.lineitem GROUP BY l_returnflag").rows
+    assert len(rows) == 3 and all(r[1] > 0 for r in rows)
+    assert runner.execute(
+        "SELECT approx_percentile(l_quantity, 0.5) FROM tpch.lineitem "
+        "WHERE 1=0").rows == [(None,)]
