@@ -32,6 +32,7 @@ ride in "extras":
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -365,16 +366,133 @@ def bench_q3(scale: float):
     }
 
 
+def bench_whole_query_q3(scale: float):
+    """The generic one-XLA-program tier (parallel/sqlmesh) on TPC-H Q3
+    text — the flagship mode's warm wall clock (cold compile amortized
+    by the persistent XLA cache)."""
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.parallel.sqlmesh import MeshQueryRunner
+
+    sql = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+    reg = ConnectorRegistry()
+    reg.register("tpch", TpchConnector(scale=scale))
+    r = MeshQueryRunner(reg, "tpch", n_devices=1)
+    r.execute(sql)                         # compile + warm
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = r.execute(sql)
+        walls.append(time.perf_counter() - t0)
+    return {
+        "metric": f"tpch_sf{scale:g}_q3_whole_query_warm_wall_s",
+        "value": round(min(walls), 3), "unit": "s",
+        "vs_baseline": 0.0,
+        "note": ("generic SPMD lowering, one program; includes the "
+                 "remote-TPU tunnel's per-dispatch latency"),
+        "rows": len(res.rows),
+    }
+
+
+def bench_sqlite_baseline(scale: float):
+    """External (non-self-authored) CPU baseline: the sqlite3 engine over
+    IDENTICAL generated data, per BASELINE.md's measurement note — the
+    'reference CPU engine' stand-in the builder did not write."""
+    import sqlite3
+
+    from presto_tpu.connectors.tpch import TpchConnector
+
+    conn = TpchConnector(scale=scale)
+    db = sqlite3.connect(":memory:")
+    for table, cols in (
+        ("lineitem", ["l_orderkey", "l_quantity", "l_extendedprice",
+                      "l_discount", "l_tax", "l_returnflag",
+                      "l_linestatus", "l_shipdate"]),
+    ):
+        h = conn.get_table(table)
+        schema = conn.table_schema(h)
+        db.execute(f"create table {table} ("
+                   + ", ".join(f"{c} NUMERIC" for c in cols) + ")")
+        n = 0
+        for split in conn.get_splits(h, 1):
+            for b in conn.page_source(split, cols, 1 << 20):
+                rows = b.to_pylist()
+                db.executemany(
+                    f"insert into {table} values "
+                    f"({', '.join('?' * len(cols))})",
+                    [[str(v) if not isinstance(v, (int, float)) else v
+                      for v in r] for r in rows])
+                n += b.num_rows
+        db.commit()
+    t0 = time.perf_counter()
+    db.execute(
+        "select l_returnflag, l_linestatus, sum(l_quantity), "
+        "sum(l_extendedprice), sum(l_extendedprice*(1-l_discount)), "
+        "sum(l_extendedprice*(1-l_discount)*(1+l_tax)), sum(l_discount), "
+        "count(*) from lineitem where l_shipdate <= 10471 "
+        "group by l_returnflag, l_linestatus").fetchall()
+    q1_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    db.execute(
+        "select sum(l_extendedprice*l_discount) from lineitem "
+        f"where l_shipdate >= {Q6_DATE_LO} and l_shipdate < {Q6_DATE_HI} "
+        f"and l_discount > {Q6_DISC_LO} and l_discount < {Q6_DISC_HI} "
+        "and l_quantity < 24").fetchall()
+    q6_s = time.perf_counter() - t0
+    db.close()
+    return {
+        "metric": f"cpu_sqlite_sf{scale:g}_q1_rows_per_sec",
+        "value": round(n / q1_s, 1), "unit": "rows/s",
+        "vs_baseline": 1.0,
+        "note": "external engine (sqlite3) on identical generated data",
+        "q6_rows_per_sec": round(n / q6_s, 1),
+    }
+
+
 def main() -> None:
     q1_scale = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
     headline = bench_q1(q1_scale)
     extras = []
-    for fn, scale in ((bench_q6, 10.0), (bench_q3, 1.0)):
+    t_start = time.perf_counter()
+    budget_s = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET_S", "1500"))
+    # cheap configs first; the SF100 north-star (config 3) runs only with
+    # budget left — its host generation + 10GB tunnel transfer is minutes
+    # SF100 Q3 (config 3's stated scale) exceeds the axon tunnel's
+    # remote-compile helper (HTTP 500 at the 600M-row program); SF30 is
+    # the largest join+agg scale the tunnel toolchain accepts — the
+    # single-chip HBM ceiling itself is ~SF120 for the Q3 working set
+    # (see BASELINE.md)
+    jobs = [(bench_q6, 10.0, 0.0), (bench_q3, 1.0, 0.0),
+            (bench_whole_query_q3, 0.1, 0.0),
+            (bench_sqlite_baseline, 0.2, 0.0),
+            (bench_q3, 10.0, 0.55), (bench_q3, 30.0, 0.35)]
+    for fn, scale, need_frac in jobs:
+        elapsed = time.perf_counter() - t_start
+        if need_frac and elapsed > budget_s * (1.0 - need_frac):
+            extras.append({"metric": f"{fn.__name__}_sf{scale:g}_skipped",
+                           "note": f"bench budget ({elapsed:.0f}s used)"})
+            continue
         try:
             extras.append(fn(scale))
         except Exception as e:  # noqa: BLE001 - one config must not
             extras.append({"metric": f"{fn.__name__}_sf{scale:g}_failed",
                            "error": str(e)[:200]})
+    # anchor the headline ratio externally when the sqlite baseline ran:
+    # rows/s at the measured scales (sqlite rows/s is ~scale-invariant)
+    for e in extras:
+        if e.get("metric", "").startswith("cpu_sqlite") \
+                and "value" in e and headline.get("value"):
+            headline["vs_external_sqlite"] = round(
+                headline["value"] / e["value"], 1)
     if not headline.pop("parity", True):
         headline = {"metric": "tpch_q1_parity_failure", "value": 0.0,
                     "unit": "rows/s", "vs_baseline": 0.0}

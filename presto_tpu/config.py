@@ -45,10 +45,17 @@ def _default_cache_dir() -> str:
             info = f.read()
         flags = [ln for ln in info.splitlines()
                  if ln.startswith((b"flags", b"model name"))]
-        tag = hashlib.sha1(b"\n".join(flags[:2])).hexdigest()[:12]
+        ident = b"\n".join(flags[:2])
     except Exception:  # noqa: BLE001 - non-Linux fallback
         import platform
-        tag = platform.machine() or "any"
+        ident = (platform.machine() or "any").encode()
+    # compile options change generated code too (e.g. XLA:CPU feature
+    # preferences set via flags) — key them in
+    import hashlib
+
+    ident += b"|" + os.environ.get("XLA_FLAGS", "").encode()
+    ident += b"|" + jax.__version__.encode()
+    tag = hashlib.sha1(ident).hexdigest()[:12]
     return os.path.join(base, "presto_tpu", f"xla-{tag}")
 
 
@@ -123,6 +130,32 @@ class EngineConfig:
     # on the join key run bucket-by-bucket with only 1/k of the build
     # side resident.  1 = off.
     grouped_execution_buckets: int = 1
+    # --- distributed-planning knobs (FeaturesConfig /
+    # SystemSessionProperties surface) -----------------------------------
+    # automatic = CBO decides per join; broadcast / partitioned force the
+    # distribution (join_distribution_type session property,
+    # DetermineJoinDistributionType role).
+    join_distribution_type: str = "automatic"
+    # estimated build rows below which AUTOMATIC picks broadcast
+    broadcast_join_row_limit: int = 100_000
+    # automatic = cost-based join reordering; none = keep syntactic order
+    # (ReorderJoins / join_reordering_strategy role)
+    join_reordering_strategy: str = "automatic"
+    # split grouped aggregation into partial (producer fragment) + final;
+    # off = aggregate once at the consumer (push_partial_aggregation role)
+    partial_aggregation_enabled: bool = True
+    # scaled writers (P6): rows one writer task absorbs before another is
+    # warranted (writerMinSize role, row-denominated)
+    scaled_writer_rows_per_task: int = 200_000
+    # tasks per hash-partitioned fragment; 0 = one per worker
+    # (hash_partition_count session property)
+    hash_partition_count: int = 0
+    # per-query memory ceiling enforced by the reservation tree;
+    # 0 = unlimited (query_max_memory role)
+    query_max_memory_bytes: int = 0
+    # wall-clock ceiling for one query; 0 = unlimited
+    # (query_max_run_time role)
+    query_max_run_time_s: float = 0.0
 
 
 DEFAULT = EngineConfig()

@@ -56,13 +56,21 @@ class Driver:
         # let the terminal operator drain even with no downstream
         return ops[-1].is_finished()
 
-    def run_to_completion(self, max_iterations: int = 10_000_000) -> None:
+    def run_to_completion(self, max_iterations: int = 10_000_000,
+                          deadline: Optional[float] = None) -> None:
         # Mirror Driver.close(): operators always release their resources
         # (memory reservations, exchange fetcher threads), success or not.
         try:
-            for _ in range(max_iterations):
+            for i in range(max_iterations):
                 if self.process():
                     return
+                # query_max_run_time enforcement between quanta (checked
+                # sparsely — monotonic() per quantum is cheap but the
+                # loop can spin fast on tiny batches)
+                if deadline is not None and (i & 0xF) == 0 \
+                        and time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "Query exceeded maximum run time")
             raise RuntimeError(
                 "driver did not converge (operator protocol bug)")
         finally:

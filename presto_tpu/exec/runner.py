@@ -97,19 +97,27 @@ def execute_pipelines(pipelines: Sequence[Pipeline],
     ``on_task_context`` receives the TaskContext before execution starts
     so callers (worker memory reporting) can observe live reservations.
     """
+    import time as _time
+
     query = QueryContext(config, memory_limit)
     task = TaskContext(query)
+    deadline = (_time.monotonic() + config.query_max_run_time_s
+                if getattr(config, "query_max_run_time_s", 0) > 0 else None)
     if on_task_context is not None:
         on_task_context(task)
     try:
         for p in pipelines:
+            if deadline is not None and _time.monotonic() > deadline:
+                raise RuntimeError(
+                    "Query exceeded maximum run time "
+                    f"({config.query_max_run_time_s:g}s)")
             prefix = _parallel_prefix(p, config)
             width = min(config.task_concurrency, len(p.splits))
             if prefix > 0 and width > 1:
                 _run_parallel(p, task, prefix, width)
             else:
                 driver = p.instantiate(task)
-                driver.run_to_completion()
+                driver.run_to_completion(deadline=deadline)
     finally:
         task.close()
     return task

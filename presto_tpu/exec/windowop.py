@@ -118,6 +118,14 @@ def eval_window_function(fn: PlanWindowFunction, columns, seg, peer):
 
 
 class WindowOperator(Operator):
+    """Spill-capable (SURVEY §2.9: WindowOperator is a spill consumer):
+    input accumulates through an embedded external sort keyed by
+    (partition, order) — over the revocable threshold, sorted runs go to
+    the spill tier and are k-way merged at finish — then window
+    evaluation proceeds chunk-by-chunk over groups of COMPLETE
+    partitions, so device memory is bounded by the chunk size (a single
+    partition larger than memory still must fit, as in the reference)."""
+
     def __init__(self, ctx: OperatorContext,
                  partition_channels: Sequence[int],
                  order_keys: Sequence[Tuple[int, bool, Optional[bool]]],
@@ -127,30 +135,161 @@ class WindowOperator(Operator):
         self.order_keys = list(order_keys)
         self.functions = list(functions)
         self._batches: List[Batch] = []
-        self._output: Optional[Batch] = None
+        self._sorter = None
+        self._outputs: List[Batch] = []
+
+    def _sort_specs(self):
+        from presto_tpu.exec.sortop import SortSpec
+
+        specs = [SortSpec(ch, False, False)
+                 for ch in self.partition_channels]
+        specs += [SortSpec(ch, not asc, bool(nf))
+                  for ch, asc, nf in self.order_keys]
+        return specs
 
     def add_input(self, batch: Batch) -> None:
-        self._batches.append(batch)
         self.ctx.stats.input_rows += batch.num_rows
-        self.ctx.memory.reserve(batch.size_bytes)
+        specs = self._sort_specs()
+        if not specs:
+            # OVER (): one global partition — nothing to sort or chunk
+            self._batches.append(batch)
+            self.ctx.memory.reserve(batch.size_bytes)
+            return
+        if self._sorter is None:
+            from presto_tpu.exec.context import OperatorContext as OC
+            from presto_tpu.exec.sortop import OrderByOperator
+
+            sub = OC(self.ctx.task, f"{self.ctx.name}.sort")
+            self._sorter = OrderByOperator(sub, specs)
+        self._sorter.add_input(batch)
 
     def finish(self) -> None:
         if self._finishing:
             return
         super().finish()
-        data = device_concat(self._batches, self.ctx.config.min_batch_capacity)
-        self._batches = []
-        self.ctx.memory.free()
-        if data is None:
+        if self._sorter is None:
+            data = device_concat(self._batches,
+                                 self.ctx.config.min_batch_capacity)
+            self._batches = []
+            self.ctx.memory.free()
+            if data is not None:
+                self._emit(self._evaluate(data, presorted=False))
             return
-        self._output = self._evaluate(data)
-        self.ctx.stats.output_rows += self._output.num_rows
+        self._sorter.finish()
+        self._consume_sorted()
+        self._sorter = None
 
-    def _sort_and_segment(self, data: Batch):
+    def _emit(self, out: Batch) -> None:
+        self._outputs.append(out)
+        self.ctx.stats.output_rows += out.num_rows
+
+    def _partition_starts(self, batch: Batch, prev_tail):
+        """Host-side: bool[n] marking rows that START a new partition,
+        given the previous stream row's key tuple (or None).  Returns
+        (starts, this batch's last-row key tuple)."""
+        import numpy as np
+
+        n = batch.num_rows
+        if not self.partition_channels:
+            starts = np.zeros(n, bool)
+            if prev_tail is None and n:
+                starts[0] = True
+            return starts, ()
+        vals = []
+        for ch in self.partition_channels:
+            c = batch.columns[ch]
+            v = np.asarray(c.values)[:n]
+            if c.dictionary is not None:
+                # codes are per-batch after a merge of spilled runs:
+                # compare decoded values
+                dic = np.asarray(list(c.dictionary.values) or [""],
+                                 dtype=object)
+                v = dic[np.clip(v, 0, len(dic) - 1)]
+            g = (np.ones(n, bool) if c.valid is None
+                 else np.asarray(c.valid)[:n])
+            if c.valid is not None:
+                # NULL rows may carry arbitrary buffer residue: mask
+                # values so null==null (the validity bit carries the
+                # distinction), matching the cross-batch tail compare
+                v = v.copy()
+                v[~g] = "" if v.dtype == object else v.dtype.type(0)
+            vals.append((v, g))
+        starts = np.zeros(n, bool)
+        for v, g in vals:
+            diff = np.zeros(n, bool)
+            diff[1:] = (v[1:] != v[:-1]) | (g[1:] != g[:-1])
+            starts |= diff
+        if prev_tail is None:
+            if n:
+                starts[0] = True
+        else:
+            first = tuple((None if not g[0] else v[0])
+                          for v, g in vals)
+            if first != prev_tail:
+                starts[0] = True
+        tail = tuple((None if not g[-1] else v[-1]) for v, g in vals) \
+            if n else prev_tail
+        return starts, tail
+
+    def _consume_sorted(self) -> None:
+        """Stream the (possibly spill-merged) sorted batches, cutting
+        evaluation chunks at partition boundaries."""
+        import numpy as np
+
+        from presto_tpu.batch import concat_batches
+
+        target = max(self.ctx.config.scan_batch_rows, 1)
+        pending: List[Batch] = []
+        pending_rows = 0
+        # global row index (within pending) of each partition start
+        starts_acc: List[int] = []
+        prev_tail = None
+
+        def evaluate_rows(batches: List[Batch]) -> None:
+            data = device_concat(batches,
+                                 self.ctx.config.min_batch_capacity)
+            if data is not None:
+                self._emit(self._evaluate(data, presorted=True))
+
+        while True:
+            b = self._sorter.get_output()
+            if b is None:
+                break
+            hb = b.compact().to_numpy() if pending else b
+            starts, prev_tail = self._partition_starts(hb, prev_tail)
+            starts_acc.extend((pending_rows + i)
+                              for i in np.nonzero(starts)[0])
+            pending.append(hb)
+            pending_rows += hb.num_rows
+            if pending_rows >= target:
+                # split at the LAST partition start > 0 so every emitted
+                # chunk holds only complete partitions
+                cut = None
+                for s in reversed(starts_acc):
+                    if s > 0:
+                        cut = s
+                        break
+                if cut is None:
+                    continue      # one giant partition: keep growing
+                merged = (concat_batches([x.compact().to_numpy()
+                                          for x in pending])
+                          if len(pending) > 1 else
+                          pending[0].compact().to_numpy())
+                head = merged.take(np.arange(0, cut))
+                rest = merged.take(np.arange(cut, merged.num_rows))
+                evaluate_rows([head])
+                pending = [rest] if rest.num_rows else []
+                pending_rows = rest.num_rows
+                starts_acc = [s - cut for s in starts_acc if s >= cut]
+        if pending_rows:
+            evaluate_rows(pending)
+
+    def _sort_and_segment(self, data: Batch, presorted: bool = False):
         """Sort by (partition, order) and derive partition/peer segment
         ids — shared by the window evaluation and the TopNRowNumber
         truncation (computed ONCE; each extra device dispatch costs
-        seconds through the remote-TPU tunnel)."""
+        seconds through the remote-TPU tunnel).  ``presorted`` skips the
+        sort (spill-merged chunks arrive already ordered)."""
         import jax.numpy as jnp
 
         from presto_tpu.ops import window as W
@@ -170,7 +309,7 @@ class WindowOperator(Operator):
         keys = [sort_key(ch, False, False) for ch in self.partition_channels]
         keys += [sort_key(ch, not asc, bool(nf))
                  for ch, asc, nf in self.order_keys]
-        if keys:
+        if keys and not presorted:
             perm = sort_permutation(keys, jnp.asarray(n))
             data = Batch(tuple(
                 Column(c.type, c.values[perm],
@@ -209,8 +348,8 @@ class WindowOperator(Operator):
         peer = W.segment_ids(peer_eq)
         return data, seg, peer, live
 
-    def _evaluate(self, data: Batch) -> Batch:
-        data, seg, peer, _live = self._sort_and_segment(data)
+    def _evaluate(self, data: Batch, presorted: bool = False) -> Batch:
+        data, seg, peer, _live = self._sort_and_segment(data, presorted)
         out_cols = list(data.columns)
         for fn in self.functions:
             out_cols.append(self._eval_function(fn, data, seg, peer))
@@ -222,11 +361,12 @@ class WindowOperator(Operator):
         return Column(rt, vals, ok, d)
 
     def get_output(self) -> Optional[Batch]:
-        out, self._output = self._output, None
-        return out
+        if self._outputs:
+            return self._outputs.pop(0)
+        return None
 
     def is_finished(self) -> bool:
-        return self._finishing and self._output is None
+        return self._finishing and not self._outputs
 
 
 class TopNRowNumberOperator(WindowOperator):
@@ -242,13 +382,13 @@ class TopNRowNumberOperator(WindowOperator):
         self.limit = factory.limit
         self.rn_type = factory.rn_type
 
-    def _evaluate(self, data: Batch) -> Batch:
+    def _evaluate(self, data: Batch, presorted: bool = False) -> Batch:
         import jax.numpy as jnp
         import numpy as np
 
         from presto_tpu.ops import window as W
 
-        full, seg, _peer, live = self._sort_and_segment(data)
+        full, seg, _peer, live = self._sort_and_segment(data, presorted)
         rn = W.row_number(seg)
         keep = np.asarray(live & (rn <= self.limit))
         idx = np.nonzero(keep)[0]

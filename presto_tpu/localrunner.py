@@ -611,9 +611,11 @@ class LocalQueryRunner:
 
         if not isinstance(stmt, (t.Query, t.SetOperation)):
             raise ValueError("EXPLAIN requires a query")
+        cfg = self.session.effective_config(self.config)
         logical = Planner(self.metadata).plan(stmt)
-        optimized = optimize(logical, self.metadata)
-        dplan = Fragmenter(metadata=self.metadata).fragment(optimized)
+        optimized = optimize(logical, self.metadata, cfg)
+        dplan = Fragmenter(metadata=self.metadata,
+                           config=cfg).fragment(optimized)
         lines = []
         for f in dplan.fragments:
             out_kind, out_ch = f.output_partitioning
@@ -685,14 +687,16 @@ class LocalQueryRunner:
     def _execute_query(self, q: t.Node) -> QueryResult:
         cfg = self.session.effective_config(self.config)
         logical = Planner(self.metadata).plan(q)
-        optimized = optimize(logical, self.metadata)
+        optimized = optimize(logical, self.metadata, cfg)
         self._check_scans(optimized)
         if cfg.whole_query_execution:
             result = self._try_whole_query(q, optimized)
             if result is not None:
                 return result
         phys = PhysicalPlanner(self.registry, cfg).plan(optimized)
-        self._last_task = execute_pipelines(phys.pipelines, cfg)
+        self._last_task = execute_pipelines(
+            phys.pipelines, cfg,
+            memory_limit=cfg.query_max_memory_bytes or None)
         return QueryResult(phys.column_names, phys.column_types,
                            phys.collector.rows())
 

@@ -179,7 +179,8 @@ class MeshQueryRunner:
     def fragment_plan(self, optimized):
         from presto_tpu.server.fragmenter import Fragmenter
 
-        return Fragmenter(metadata=self.metadata).fragment(optimized)
+        return Fragmenter(metadata=self.metadata,
+                          config=self.config).fragment(optimized)
 
     def execute(self, sql: str):
         from presto_tpu.sql.parser import parse_statement

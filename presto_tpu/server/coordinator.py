@@ -241,9 +241,12 @@ class QueryExecution:
                 self.state = "FINISHED"
                 return
             metadata = Metadata(self.co.registry, self.catalog)
+            cfg = self._session().effective_config(self.co.config)
+            self._cfg = cfg
             logical = Planner(metadata).plan(stmt)
-            optimized = optimize(logical, metadata)
-            dplan = Fragmenter(metadata=metadata).fragment(optimized)
+            optimized = optimize(logical, metadata, cfg)
+            dplan = Fragmenter(metadata=metadata,
+                               config=cfg).fragment(optimized)
             self.column_names = dplan.column_names
             self.column_types = dplan.column_types
             self.plan_text = self._format_dplan(dplan)
@@ -389,23 +392,22 @@ class QueryExecution:
                 pass
 
     # -- scheduling -----------------------------------------------------
-    # rows one writer task absorbs before another is warranted (the
-    # writerMinSize role of ScaledWriterScheduler.java:40, expressed in
-    # rows since CBO stats are row-based)
-    SCALED_WRITER_ROWS_PER_TASK = 200_000
-
     def _task_count(self, frag, n_workers: int) -> int:
+        cfg = getattr(self, "_cfg", None) or self.co.config
         if frag.partitioning == "single":
             return 1
         if frag.partitioning == "scaled":
             # scaled writers (P6): size the writer-task count to the
             # estimated volume — small INSERTs get one writer, bulk CTAS
-            # scales to every worker
+            # scales to every worker (writerMinSize role, row-based;
+            # scaled_writer_rows_per_task session property)
             rows = frag.scale_rows
             if rows is None:
                 return max(1, n_workers)
-            need = int(rows // self.SCALED_WRITER_ROWS_PER_TASK) + 1
+            need = int(rows // max(cfg.scaled_writer_rows_per_task, 1)) + 1
             return max(1, min(n_workers, need))
+        if frag.partitioning == "hash" and cfg.hash_partition_count > 0:
+            return cfg.hash_partition_count
         return max(1, n_workers)
 
     def _schedule(self, dplan: DistributedPlan) -> List[str]:
@@ -623,7 +625,9 @@ class QueryExecution:
 
         try:
             metadata = Metadata(self.co.registry, self.catalog)
-            optimized = optimize(logical, metadata)
+            cfg = runner.session.effective_config(self.co.config)
+            self._cfg = cfg
+            optimized = optimize(logical, metadata, cfg)
             write_id = conn.begin_write(handle)
             wcols = (("rows", T.BIGINT), ("fragment", T.VARCHAR))
             fcols = (("rows", T.BIGINT),)
@@ -632,7 +636,8 @@ class QueryExecution:
             finish = TableFinishNode(writer, catalog, name, write_id,
                                      fcols)
             root = OutputNode(finish, fcols)
-            dplan = Fragmenter(metadata=metadata).fragment(root)
+            dplan = Fragmenter(metadata=metadata,
+                               config=cfg).fragment(root)
         except Exception:
             abort()
             raise
@@ -696,11 +701,18 @@ class QueryExecution:
                 pass
 
     def _drain(self, locations: List[str]) -> None:
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        deadline = (time.monotonic() + cfg.query_max_run_time_s
+                    if cfg.query_max_run_time_s > 0 else None)
         for loc in locations:
             token = 0
             while True:
                 if getattr(self, "canceled", False):
                     raise RuntimeError("Query killed")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "Query exceeded maximum run time "
+                        f"({cfg.query_max_run_time_s:g}s)")
                 url = f"{loc}/{token}"
                 req = urllib.request.Request(
                     url, headers=self._internal_headers())
@@ -898,8 +910,14 @@ class CoordinatorServer:
                     return user
                 if self._has_internal_token():
                     return user
-                auth_user = co.authenticator.authenticate_basic(
-                    self.headers.get("Authorization"))
+                # authenticator may be a single mechanism or an
+                # AuthenticatorStack (Basic password, Bearer JWT, ...)
+                if hasattr(co.authenticator, "authenticate_header"):
+                    auth_user = co.authenticator.authenticate_header(
+                        self.headers)
+                else:
+                    auth_user = co.authenticator.authenticate_basic(
+                        self.headers.get("Authorization"))
                 if auth_user is not None:
                     return auth_user
                 self.send_response(401)

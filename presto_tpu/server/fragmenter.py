@@ -64,9 +64,14 @@ class DistributedPlan:
 class Fragmenter:
     """One instance per query."""
 
-    def __init__(self, broadcast_row_limit: int = 100_000,
-                 metadata=None):
-        self.broadcast_row_limit = broadcast_row_limit
+    def __init__(self, broadcast_row_limit: Optional[int] = None,
+                 metadata=None, config=None):
+        from presto_tpu.config import DEFAULT
+
+        self.config = config or DEFAULT
+        self.broadcast_row_limit = (
+            broadcast_row_limit if broadcast_row_limit is not None
+            else self.config.broadcast_join_row_limit)
         self.metadata = metadata
         self.fragments: List[PlanFragment] = []
         self._stats_calculator = None  # one memoized derivation per query
@@ -248,6 +253,16 @@ class Fragmenter:
 
     def _visit_aggregation(self, node: AggregationNode):
         src, consumed = self._visit(node.source)
+        if not self.config.partial_aggregation_enabled:
+            # partial_aggregation_enabled=false: single-step aggregation
+            # after a hash exchange on the group keys (or at the gather
+            # fragment for global aggregates)
+            if not node.group_channels:
+                return _replace_sources(node, [src]), consumed
+            fid = self._source_fragment(
+                src, consumed, ("hash", tuple(node.group_channels)))
+            remote = RemoteSourceNode((fid,), tuple(node.source.columns))
+            return _replace_sources(node, [remote]), [fid]
         if any(a.distinct for a in node.aggregates):
             # distinct aggs need every row of a group on one node; hash
             # exchange on the group keys then single-step aggregate
@@ -300,7 +315,13 @@ class Fragmenter:
         left, lc = self._visit(node.left)
         right, rc = self._visit(node.right)
 
-        if self._estimate_rows(node.right) <= self.broadcast_row_limit:
+        # join_distribution_type session property: force a distribution,
+        # or let the estimate decide (DetermineJoinDistributionType role)
+        dist = self.config.join_distribution_type
+        broadcast = (dist == "broadcast" if dist != "automatic"
+                     else self._estimate_rows(node.right)
+                     <= self.broadcast_row_limit)
+        if broadcast:
             # P2: broadcast the small build side into every probe task;
             # probe stays in ITS OWN fragment (no exchange for probe rows)
             rfid = self._source_fragment(

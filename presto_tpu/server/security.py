@@ -80,19 +80,191 @@ class PasswordAuthenticator:
         return user if self.authenticate(user, password) else None
 
 
+# ---------------------------------------------------------------------------
+# JWT (HS256, stdlib-only)
+# ---------------------------------------------------------------------------
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode("ascii")
+
+
+def _unb64url(text: str) -> bytes:
+    pad = "=" * (-len(text) % 4)
+    return base64.urlsafe_b64decode(text + pad)
+
+
+def jwt_encode(claims: Dict, secret: str) -> str:
+    import json
+
+    header = _b64url(b'{"alg":"HS256","typ":"JWT"}')
+    payload = _b64url(json.dumps(claims, separators=(",", ":"))
+                      .encode("utf-8"))
+    signing = f"{header}.{payload}".encode("ascii")
+    sig = hmac.new(secret.encode("utf-8"), signing,
+                   hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64url(sig)}"
+
+
+def jwt_decode(token: str, secret: str, now: Optional[float] = None
+               ) -> Optional[Dict]:
+    """Verified claims, or None (bad structure / signature / expired).
+    Only HS256 is accepted — the alg header is NOT trusted."""
+    import json
+    import time
+
+    parts = token.split(".")
+    if len(parts) != 3:
+        return None
+    header, payload, sig = parts
+    try:
+        signing = f"{header}.{payload}".encode("ascii")
+        want = hmac.new(secret.encode("utf-8"), signing,
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(_unb64url(sig), want):
+            return None
+        head = json.loads(_unb64url(header))
+        if head.get("alg") != "HS256":
+            return None
+        claims = json.loads(_unb64url(payload))
+    except Exception:  # noqa: BLE001 - malformed token
+        return None
+    exp = claims.get("exp")
+    if exp is not None and (now if now is not None else time.time()) >= exp:
+        return None
+    return claims
+
+
+class JwtAuthenticator:
+    """Bearer-token user authentication
+    (JsonWebTokenAuthenticator.java role): HS256 JWTs signed with a
+    shared key; the principal comes from a configurable claim; optional
+    required issuer/audience."""
+
+    def __init__(self, secret: str, issuer: Optional[str] = None,
+                 audience: Optional[str] = None,
+                 principal_claim: str = "sub"):
+        self.secret = secret
+        self.issuer = issuer
+        self.audience = audience
+        self.principal_claim = principal_claim
+
+    def create_token(self, user: str, ttl_s: float = 300.0,
+                     **extra) -> str:
+        import time
+
+        claims = {self.principal_claim: user,
+                  "exp": time.time() + ttl_s}
+        if self.issuer:
+            claims["iss"] = self.issuer
+        if self.audience:
+            claims["aud"] = self.audience
+        claims.update(extra)
+        return jwt_encode(claims, self.secret)
+
+    def authenticate_header(self, headers) -> Optional[str]:
+        auth = headers.get("Authorization")
+        if not auth or not auth.startswith("Bearer "):
+            return None
+        claims = jwt_decode(auth[7:], self.secret)
+        if claims is None:
+            return None
+        if self.issuer and claims.get("iss") != self.issuer:
+            return None
+        if self.audience and claims.get("aud") != self.audience:
+            return None
+        principal = claims.get(self.principal_claim)
+        return principal if isinstance(principal, str) else None
+
+
+class CertificateAuthenticator:
+    """Client-certificate principal extraction
+    (CertificateAuthenticator.java role): maps a TLS peer certificate's
+    subject CN to the principal, optionally restricted to an allowed CA
+    issuer CN.  TLS itself terminates at the listener or a fronting
+    proxy; this class owns only the subject -> principal policy."""
+
+    def __init__(self, allowed_issuer_cn: Optional[str] = None):
+        self.allowed_issuer_cn = allowed_issuer_cn
+
+    @staticmethod
+    def _cn(name_tuples) -> Optional[str]:
+        # ssl.getpeercert() subject format: ((('commonName','x'),), ...)
+        for rdn in name_tuples or ():
+            for key, value in rdn:
+                if key == "commonName":
+                    return value
+        return None
+
+    def authenticate_cert(self, peer_cert: Optional[Dict]
+                          ) -> Optional[str]:
+        if not peer_cert:
+            return None
+        if self.allowed_issuer_cn is not None:
+            issuer = self._cn(peer_cert.get("issuer"))
+            if issuer != self.allowed_issuer_cn:
+                return None
+        return self._cn(peer_cert.get("subject"))
+
+
+class AuthenticatorStack:
+    """Ordered authenticator chain (the reference's pluggable
+    authenticator list): the first mechanism that positively identifies
+    a principal wins."""
+
+    def __init__(self, *authenticators):
+        self.authenticators = [a for a in authenticators if a is not None]
+
+    def authenticate_header(self, headers) -> Optional[str]:
+        for a in self.authenticators:
+            if hasattr(a, "authenticate_header"):
+                user = a.authenticate_header(headers)
+            elif hasattr(a, "authenticate_basic"):
+                user = a.authenticate_basic(headers.get("Authorization"))
+            else:
+                user = None
+            if user is not None:
+                return user
+        return None
+
+    def authenticate_basic(self, authorization: Optional[str]
+                           ) -> Optional[str]:
+        for a in self.authenticators:
+            if hasattr(a, "authenticate_basic"):
+                user = a.authenticate_basic(authorization)
+                if user is not None:
+                    return user
+        return None
+
+
 class InternalAuthenticator:
-    """Shared-secret token for intra-cluster requests."""
+    """Intra-cluster request authentication with SHORT-LIVED signed
+    tokens (InternalAuthenticationManager.java role — it likewise signs
+    expiring JWTs from the shared secret).  Tokens rotate automatically;
+    verification checks signature AND expiry, so a captured token stops
+    replaying after ``ttl_s`` (unlike a static bearer)."""
 
     HEADER = "X-Presto-Internal-Bearer"
+    ISSUER = "presto-tpu-internal"
 
-    def __init__(self, secret: str):
-        self._token = hmac.new(secret.encode("utf-8"),
-                               b"presto-tpu-internal",
-                               hashlib.sha256).hexdigest()
+    def __init__(self, secret: str, ttl_s: float = 300.0):
+        self._secret = secret
+        self._ttl = ttl_s
+        self._token: Optional[str] = None
+        self._token_exp = 0.0
 
     def header(self) -> Dict[str, str]:
+        import time
+
+        now = time.time()
+        if self._token is None or now > self._token_exp - self._ttl / 4:
+            self._token = jwt_encode(
+                {"iss": self.ISSUER, "exp": now + self._ttl},
+                self._secret)
+            self._token_exp = now + self._ttl
         return {self.HEADER: self._token}
 
     def verify(self, header_value: Optional[str]) -> bool:
-        return bool(header_value) and hmac.compare_digest(
-            header_value, self._token)
+        if not header_value:
+            return False
+        claims = jwt_decode(header_value, self._secret)
+        return claims is not None and claims.get("iss") == self.ISSUER

@@ -32,6 +32,17 @@ from presto_tpu.config import DEFAULT, EngineConfig
 # session properties
 # ---------------------------------------------------------------------------
 
+def _enum_parser(name: str, allowed: Tuple[str, ...]):
+    def parse(v: str) -> str:
+        lv = v.lower()
+        if lv not in allowed:
+            raise ValueError(
+                f"{name} must be one of {', '.join(allowed)}")
+        return lv
+
+    return parse
+
+
 # property name -> (config field, parser); the SystemSessionProperties
 # registry: every entry is typed and validated on SET
 SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
@@ -53,6 +64,19 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
         "streaming_aggregation_enabled",
         lambda v: v.lower() in ("true", "1", "on")),
     "grouped_execution_buckets": ("grouped_execution_buckets", int),
+    "join_distribution_type": ("join_distribution_type", _enum_parser(
+        "join_distribution_type",
+        ("automatic", "broadcast", "partitioned"))),
+    "broadcast_join_row_limit": ("broadcast_join_row_limit", int),
+    "join_reordering_strategy": ("join_reordering_strategy", _enum_parser(
+        "join_reordering_strategy", ("automatic", "none"))),
+    "partial_aggregation_enabled": (
+        "partial_aggregation_enabled",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "scaled_writer_rows_per_task": ("scaled_writer_rows_per_task", int),
+    "hash_partition_count": ("hash_partition_count", int),
+    "query_max_memory_bytes": ("query_max_memory_bytes", int),
+    "query_max_run_time_s": ("query_max_run_time_s", float),
 }
 
 

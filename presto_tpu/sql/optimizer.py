@@ -34,9 +34,15 @@ from presto_tpu.sql.plan import (
 )
 
 
-def optimize(plan: OutputNode, metadata=None) -> OutputNode:
+def optimize(plan: OutputNode, metadata=None, config=None) -> OutputNode:
+    """Optimizer pipeline.  ``config`` carries the session-steerable
+    policies (join_reordering_strategy, ... — the SystemSessionProperties
+    reaching PlanOptimizers role); None = engine defaults."""
+    from presto_tpu.config import DEFAULT
+
+    config = config or DEFAULT
     node = push_filters_down(plan)
-    node = _rewrite_bottom_up(node, metadata)
+    node = _rewrite_bottom_up(node, metadata, config)
     node = prune_columns(node)
     return node
 
@@ -153,7 +159,7 @@ def _cross_chain(leaves: List[PlanNode]) -> PlanNode:
     return cur
 
 
-def _rewrite_bottom_up(node: PlanNode, metadata) -> PlanNode:
+def _rewrite_bottom_up(node: PlanNode, metadata, config=None) -> PlanNode:
     # Filter-over-join-chain (and bare chains): flatten BEFORE recursing
     # so WHERE conjuncts and ON keys place together during join
     # reordering (ReorderJoins + PredicatePushDown interplay); recursion
@@ -166,17 +172,17 @@ def _rewrite_bottom_up(node: PlanNode, metadata) -> PlanNode:
         chain = node
     if chain is not None:
         tree, conjs = _flatten_joins(chain)
-        leaves = [_rewrite_bottom_up(l, metadata)
+        leaves = [_rewrite_bottom_up(l, metadata, config)
                   for l in _cross_leaves(tree)]
         tree = _cross_chain(leaves)
         conjs = conjs + extra
         if conjs:
             return extract_joins(FilterNode(tree, and_all(conjs)),
-                                 metadata)
+                                 metadata, config)
         return tree
 
     node = _replace_sources(
-        node, [_rewrite_bottom_up(s, metadata) for s in node.sources])
+        node, [_rewrite_bottom_up(s, metadata, config) for s in node.sources])
     if isinstance(node, AggregationNode) and any(
             a.distinct for a in node.aggregates):
         return rewrite_distinct_aggregates(node)
@@ -309,7 +315,7 @@ def factor_or_conjuncts(expr: RowExpression) -> List[RowExpression]:
     return out
 
 
-def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
+def extract_joins(filter_node: FilterNode, metadata, config=None) -> PlanNode:
     """Filter(cross-join tree) -> pushed filters + left-deep equi joins."""
     leaves = _cross_leaves(filter_node.source)
     offsets = []
@@ -361,7 +367,10 @@ def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
     sc = StatsCalculator(metadata)
     sizes = [_estimate_rows(n, metadata, sc) for n in nodes]
     remaining = set(range(len(nodes)))
-    start = max(remaining, key=lambda i: sizes[i])
+    # join_reordering_strategy=none keeps the syntactic order
+    syntactic = (config is not None
+                 and config.join_reordering_strategy == "none")
+    start = 0 if syntactic else max(remaining, key=lambda i: sizes[i])
     joined = [start]
     remaining.discard(start)
     current = nodes[start]
@@ -402,6 +411,9 @@ def extract_joins(filter_node: FilterNode, metadata) -> PlanNode:
             if lb in joined and la in remaining:
                 candidates.add(la)
         if candidates:
+            if syntactic:
+                return min(candidates)
+
             def join_cost(i: int) -> Tuple[float, float]:
                 lks, rks, _ = candidate_keys(i)
                 cols = current.columns + nodes[i].columns

@@ -32,6 +32,7 @@ JOIN_SQL = ("select count(*), sum(l_extendedprice) from orders "
             "where o_totalprice > 50000")
 
 
+@pytest.mark.slow
 def test_results_identical(plain, grouped):
     a = plain.execute(JOIN_SQL).rows
     b = grouped.execute(JOIN_SQL).rows
@@ -39,6 +40,7 @@ def test_results_identical(plain, grouped):
     assert abs(a[0][1] - b[0][1]) <= 1e-6 * abs(a[0][1])
 
 
+@pytest.mark.slow
 def test_left_join_grouped(plain, grouped):
     sql = ("select count(*) from orders left join lineitem "
            "on o_orderkey = l_orderkey where o_orderkey < 1000")

@@ -59,6 +59,7 @@ def test_rollback(runner):
         [(10,), (30,)]
 
 
+@pytest.mark.slow
 def test_ctas_from_tpch_and_formats(runner):
     runner.execute("CREATE TABLE iceberg.nat WITH (format = 'json') AS "
                    "SELECT n_nationkey, n_name FROM tpch.nation")

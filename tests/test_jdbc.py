@@ -53,6 +53,7 @@ def test_predicate_pushdown_to_remote_sql(runner, monkeypatch):
     assert 2 in params and 4 in params
 
 
+@pytest.mark.slow
 def test_ctas_roundtrip_with_tpch(runner):
     runner.execute("CREATE TABLE sqlite.nat AS SELECT n_nationkey, n_name "
                    "FROM tpch.nation WHERE n_regionkey = 0")

@@ -134,6 +134,7 @@ def test_analyze_stats_rename_drop(runner):
     assert ("s2",) not in runner.execute("SHOW TABLES").rows
 
 
+@pytest.mark.slow
 def test_join_lake_with_tpch(runner):
     runner.execute("CREATE TABLE lake.regions WITH (format='parquet') AS "
                    "SELECT r_regionkey, r_name FROM tpch.region")

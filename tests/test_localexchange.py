@@ -39,6 +39,7 @@ def test_scan_aggregate(serial, parallel):
                 "from lineitem group by l_returnflag")
 
 
+@pytest.mark.slow
 def test_join_parallel_feed(serial, parallel):
     assert_same(serial, parallel,
                 "select c_mktsegment, count(*) from customer "

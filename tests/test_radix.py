@@ -18,9 +18,9 @@ def _rand(rng, n, lo, hi):
 @pytest.mark.parametrize("n,lo,hi", [
     (1, 0, 10),
     (17, 0, 4),               # heavy duplicates, tests stability
-    (128, -1000, 1000),       # negatives
-    (1000, -2**62, 2**62),    # full 64-bit spread
-    (513, 0, 250),            # dictionary-code-ish range
+    pytest.param(128, -1000, 1000, marks=pytest.mark.slow),
+    pytest.param(1000, -2**62, 2**62, marks=pytest.mark.slow),
+    pytest.param(513, 0, 250, marks=pytest.mark.slow),
 ])
 def test_argsort_single_word(n, lo, hi):
     rng = np.random.default_rng(n)
@@ -30,6 +30,7 @@ def test_argsort_single_word(n, lo, hi):
     np.testing.assert_array_equal(perm, expect)
 
 
+@pytest.mark.slow
 def test_argsort_extreme_spread():
     """Live spread exceeding int64 must not wrap the range reduction
     (regression: pass-skipping saw rng=0 and ran zero passes)."""
@@ -39,6 +40,7 @@ def test_argsort_extreme_spread():
     np.testing.assert_array_equal(perm, np.argsort(w, kind="stable"))
 
 
+@pytest.mark.slow
 def test_argsort_multi_word():
     rng = np.random.default_rng(7)
     a = _rand(rng, 400, 0, 5)
@@ -49,6 +51,7 @@ def test_argsort_multi_word():
     np.testing.assert_array_equal(perm, expect)
 
 
+@pytest.mark.slow
 def test_argsort_with_pad():
     rng = np.random.default_rng(3)
     w = _rand(rng, 100, 0, 50)
@@ -60,8 +63,8 @@ def test_argsort_with_pad():
     assert set(perm[60:].tolist()) == set(range(60, 100))
 
 
-@pytest.mark.parametrize("desc", [False, True])
-@pytest.mark.parametrize("nulls_first", [False, True])
+@pytest.mark.parametrize("desc", [False, pytest.param(True, marks=pytest.mark.slow)])
+@pytest.mark.parametrize("nulls_first", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_sort_permutation_parity(desc, nulls_first):
     """radix_sort_permutation == sort_permutation on mixed-type keys with
     nulls, descending, and padding."""

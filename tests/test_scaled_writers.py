@@ -15,7 +15,6 @@ from presto_tpu.connectors.api import ConnectorRegistry
 from presto_tpu.connectors.raptor import RaptorConnector
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.localrunner import LocalQueryRunner
-from presto_tpu.server.coordinator import QueryExecution
 from presto_tpu.server.dqr import DistributedQueryRunner
 
 pytestmark = pytest.mark.slow
@@ -33,12 +32,15 @@ def cluster(tmp_path_factory):
         reg.register("raptor", RaptorConnector(root))
         return reg
 
-    dqr = DistributedQueryRunner(factory, "tpch", n_workers=3)
+    import dataclasses
+
+    from presto_tpu.config import DEFAULT
+
     # scale-out threshold small enough that SF0.01 volumes exercise it
-    old = QueryExecution.SCALED_WRITER_ROWS_PER_TASK
-    QueryExecution.SCALED_WRITER_ROWS_PER_TASK = 10_000
+    # (scaled_writer_rows_per_task session-steerable config)
+    cfg = dataclasses.replace(DEFAULT, scaled_writer_rows_per_task=10_000)
+    dqr = DistributedQueryRunner(factory, "tpch", n_workers=3, config=cfg)
     yield dqr
-    QueryExecution.SCALED_WRITER_ROWS_PER_TASK = old
     dqr.close()
 
 

@@ -229,3 +229,128 @@ def test_secured_dqr_end_to_end():
             urllib.request.urlopen(
                 f"{dqr.workers[0].uri}/v1/task", timeout=5)
         assert ei.value.code == 401
+
+
+# ---------------------------------------------------------------------------
+# JWT / certificate tiers (JsonWebTokenAuthenticator.java,
+# CertificateAuthenticator.java roles; VERDICT r3 #10)
+# ---------------------------------------------------------------------------
+
+def test_jwt_roundtrip_and_rejection():
+    from presto_tpu.server.security import JwtAuthenticator, jwt_decode
+
+    a = JwtAuthenticator("k1", issuer="corp", audience="presto")
+    tok = a.create_token("alice")
+    assert a.authenticate_header({"Authorization": f"Bearer {tok}"}) \
+        == "alice"
+    # wrong key
+    b = JwtAuthenticator("other", issuer="corp", audience="presto")
+    assert b.authenticate_header({"Authorization": f"Bearer {tok}"}) is None
+    # wrong issuer / audience
+    c = JwtAuthenticator("k1", issuer="else", audience="presto")
+    assert c.authenticate_header({"Authorization": f"Bearer {tok}"}) is None
+    # tampered payload
+    h, p, s = tok.split(".")
+    assert a.authenticate_header(
+        {"Authorization": f"Bearer {h}.{p[:-2]}AA.{s}"}) is None
+    # alg header is not trusted (alg=none style downgrade)
+    import base64 as b64
+    forged_h = b64.urlsafe_b64encode(
+        b'{"alg":"none","typ":"JWT"}').rstrip(b"=").decode()
+    assert jwt_decode(f"{forged_h}.{p}.{s}", "k1") is None
+
+
+def test_jwt_expiry():
+    from presto_tpu.server.security import JwtAuthenticator, jwt_decode
+
+    a = JwtAuthenticator("k1")
+    tok = a.create_token("bob", ttl_s=-1)          # already expired
+    assert a.authenticate_header({"Authorization": f"Bearer {tok}"}) is None
+    tok2 = a.create_token("bob", ttl_s=60)
+    import time
+    assert jwt_decode(tok2, "k1", now=time.time() + 120) is None
+
+
+def test_internal_tokens_expire_and_rotate():
+    from presto_tpu.server.security import InternalAuthenticator
+
+    a = InternalAuthenticator("s", ttl_s=0.05)
+    tok = a.header()[InternalAuthenticator.HEADER]
+    assert a.verify(tok)
+    import time
+    time.sleep(0.08)
+    assert not a.verify(tok)                # captured token stops working
+    tok2 = a.header()[InternalAuthenticator.HEADER]
+    assert tok2 != tok and a.verify(tok2)   # fresh token auto-minted
+
+
+def test_certificate_authenticator():
+    from presto_tpu.server.security import CertificateAuthenticator
+
+    cert = {"subject": ((("commonName", "svc-reporting"),),),
+            "issuer": ((("commonName", "corp-ca"),),)}
+    assert CertificateAuthenticator().authenticate_cert(cert) \
+        == "svc-reporting"
+    assert CertificateAuthenticator("corp-ca").authenticate_cert(cert) \
+        == "svc-reporting"
+    assert CertificateAuthenticator("other-ca").authenticate_cert(cert) \
+        is None
+    assert CertificateAuthenticator().authenticate_cert(None) is None
+
+
+def test_jwt_bearer_against_live_coordinator():
+    """Secured cluster end-to-end: Bearer JWT accepted, expired/garbage
+    rejected with 401, Basic password still works through the stack."""
+    import urllib.error
+    import urllib.request
+
+    from presto_tpu.client import StatementClient
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.server.security import (
+        AuthenticatorStack, JwtAuthenticator, PasswordAuthenticator,
+    )
+    from presto_tpu.server.worker import WorkerServer
+
+    reg = ConnectorRegistry()
+    reg.register("tpch", TpchConnector(scale=0.001))
+    pw = PasswordAuthenticator()
+    pw.set_password("carol", "pw123")
+    jwt_auth = JwtAuthenticator("jwt-secret")
+    co = CoordinatorServer(reg, "tpch",
+                           authenticator=AuthenticatorStack(jwt_auth, pw),
+                           internal_secret="cs")
+
+    def reg2():
+        r = ConnectorRegistry()
+        r.register("tpch", TpchConnector(scale=0.001))
+        return r
+
+    w = WorkerServer(reg2(), co.uri, internal_secret="cs")
+    try:
+        def post(headers):
+            req = urllib.request.Request(
+                f"{co.uri}/v1/statement",
+                data=b"SELECT count(*) FROM tpch.region",
+                headers={"X-Presto-User": "x", **headers})
+            return urllib.request.urlopen(req, timeout=30).status
+
+        tok = jwt_auth.create_token("carol", ttl_s=60)
+        assert post({"Authorization": f"Bearer {tok}"}) == 200
+        expired = jwt_auth.create_token("carol", ttl_s=-1)
+        for bad in ({"Authorization": f"Bearer {expired}"},
+                    {"Authorization": "Bearer junk"},
+                    {}):
+            try:
+                post(bad)
+                raise AssertionError(f"expected 401 for {bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+        # password Basic still works through the stack
+        import base64
+        basic = "Basic " + base64.b64encode(b"carol:pw123").decode()
+        assert post({"Authorization": basic}) == 200
+    finally:
+        w.close()
+        co.close()

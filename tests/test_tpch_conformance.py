@@ -92,10 +92,92 @@ def _shift_date(iso: str, sign: str, n: int, unit: str) -> str:
     return datetime.date(y, m + 1, day).isoformat()
 
 
+def _strip_union_parens(sql: str) -> str:
+    """sqlite rejects a parenthesized right-hand UNION operand
+    (``... UNION ALL (SELECT ...)`` — same for INTERSECT/EXCEPT);
+    strip those operand parens.  A
+    paren BEFORE a union is left alone — it may be a derived table of
+    the first operand (``SELECT ... FROM (sub) UNION ALL ...``)."""
+    def match_fwd(s, open_):             # index of ')' matching s[open_]=='('
+        depth = 0
+        for i in range(open_, len(s)):
+            if s[i] == "(":
+                depth += 1
+            elif s[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    return i
+        return -1
+
+    def match_back(s, close):            # index of '(' matching s[close]==')'
+        depth = 0
+        for i in range(close, -1, -1):
+            if s[i] == ")":
+                depth += 1
+            elif s[i] == "(":
+                depth -= 1
+                if depth == 0:
+                    return i
+        return -1
+
+    changed = True
+    while changed:
+        changed = False
+        for m in re.finditer(r"(?i)\b(?:union(?:\s+all)?|intersect|except)\b", sql):
+            # operand before: ( (SELECT ...) INTERSECT ... — strip only
+            # when the operand paren is itself directly inside another
+            # paren (a FROM-derived-table paren is preceded by FROM, not
+            # by '(', and must stay)
+            j = m.start() - 1
+            while j >= 0 and sql[j].isspace():
+                j -= 1
+            if j >= 0 and sql[j] == ")":
+                o = match_back(sql, j)
+                p = o - 1
+                while p >= 0 and sql[p].isspace():
+                    p -= 1
+                inner = sql[o + 1:j].lstrip()
+                if (o >= 0 and inner[:6].lower() == "select"
+                        and (p < 0 or sql[p] == "(")):
+                    sql = (sql[:o] + " " + sql[o + 1:j] + " "
+                           + sql[j + 1:])
+                    changed = True
+                    break
+            # operand after: UNION ( SELECT ...
+            k = m.end()
+            while k < len(sql) and sql[k].isspace():
+                k += 1
+            if k < len(sql) and sql[k] == "(":
+                c = match_fwd(sql, k)
+                inner = sql[k + 1:c].lstrip()
+                if c >= 0 and inner[:6].lower() == "select":
+                    sql = (sql[:k] + " " + sql[k + 1:c] + " "
+                           + sql[c + 1:])
+                    changed = True
+                    break
+    return sql
+
+
 def to_sqlite_sql(sql: str) -> str:
+    # quoted function names ("sum"(...) in the benchto texts) are
+    # identifiers to sqlite — unquote them
+    sql = re.sub(r'"(\w+)"\s*\(', r"\1(", sql)
+    sql = _strip_union_parens(sql)
+    # DECIMAL '1.2' typed literals -> plain numeric literal
+    sql = re.sub(r"(?i)\bdecimal\s+'(-?[0-9.]+)'", r"\1", sql)
     sql = _DATE_ARITH.sub(
         lambda m: "'" + _shift_date(m.group(1), m.group(2),
                                     int(m.group(3)), m.group(4)) + "'",
+        sql)
+    # CAST(x AS DATE) truncates TEXT to an integer in sqlite; dates are
+    # already ISO strings, so drop the cast (literals and columns alike)
+    sql = re.sub(r"(?i)\bcast\s*\(\s*('[^']*'|\"?[\w.]+\"?)\s+as\s+date"
+                 r"\s*\)", r"\1", sql)
+    # (date_expr + INTERVAL '30' DAY) over TEXT dates
+    sql = re.sub(
+        r"(?i)\(?\s*('[^']*'|[\w.\"]+)\s*([+-])\s*interval\s+'(\d+)'"
+        r"\s+day\s*\)?",
+        lambda m: f"date({m.group(1)}, '{m.group(2)}{m.group(3)} days')",
         sql)
     sql = _DATE_LIT.sub(lambda m: "'" + m.group(1) + "'", sql)
     sql = re.sub(r"extract\s*\(\s*year\s+from\s+(\w+(?:\.\w+)?)\s*\)",

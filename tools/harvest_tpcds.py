@@ -60,6 +60,17 @@ def main() -> None:
                     f"create index idx_{table}_{n} on {table}({n})")
     oracle.commit()
 
+    import signal
+
+    class _Timeout(Exception):
+        pass
+
+    def _alarm(_sig, _frm):
+        raise _Timeout()
+
+    signal.signal(signal.SIGALRM, _alarm)
+    per_query_s = int(os.environ.get("HARVEST_TIMEOUT_S", "120"))
+
     ok, results = 0, []
     for path in sorted(glob.glob(os.path.join(REF, "q*.sql"))):
         qn = os.path.basename(path)[1:-4]
@@ -68,21 +79,35 @@ def main() -> None:
         sql = normalize(open(path).read())
         t0 = time.time()
         try:
+            signal.alarm(per_query_s)
             got = runner.execute(sql)
+        except _Timeout:
+            results.append((qn, "ENGINE", "Timeout"))
+            print(f"q{qn}: ENGINE Timeout", flush=True)
+            continue
         except Exception as e:
             msg = f"{type(e).__name__}: {str(e)[:110]}".replace("\n", " ")
             results.append((qn, "ENGINE", msg))
             print(f"q{qn}: ENGINE {msg}", flush=True)
             continue
+        finally:
+            signal.alarm(0)
         try:
+            signal.alarm(per_query_s)
             osql = to_sqlite_sql(sql.replace("tpcds.", ""))
             cur = oracle.execute(osql)
             want = cur.fetchall()
+        except _Timeout:
+            results.append((qn, "ORACLE", "Timeout"))
+            print(f"q{qn}: ORACLE Timeout", flush=True)
+            continue
         except Exception as e:
             msg = f"{type(e).__name__}: {str(e)[:110]}".replace("\n", " ")
             results.append((qn, "ORACLE", msg))
             print(f"q{qn}: ORACLE {msg}", flush=True)
             continue
+        finally:
+            signal.alarm(0)
         try:
             ordered = "order by" in sql.lower()
             assert_rows_match(got.rows, want, ordered)
