@@ -1,0 +1,117 @@
+"""HTTP connector: tables served by a remote HTTP endpoint.
+
+Role model: presto-example-http (presto-example-http/src/main/java/io/
+prestosql/plugin/example/ExampleClient.java:41 — a metadata JSON
+document fetched over HTTP maps tables to a list of CSV source URIs;
+each URI becomes one split, fetched over the network at scan time by
+ExampleRecordCursor).  This is the engine's proof that the connector
+SPI reaches a real network protocol, not just files and sqlite.
+
+Metadata document (fetched from ``metadata_uri``)::
+
+    {"tables": [{"name": "numbers",
+                 "columns": [{"name": "text", "type": "varchar"},
+                             {"name": "value", "type": "bigint"}],
+                 "sources": ["http://host/numbers-1.csv", ...]}]}
+
+Each source URI is one Split (P5: source partitioning over network
+shards); rows decode through the shared record-decoder tier
+(connectors/decoder.py CSV rules).  Relative source URIs resolve
+against the metadata URI.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, column_from_pylist
+from presto_tpu.connectors.api import (
+    ColumnMetadata, Connector, PageSource, Split, TableHandle, TableSchema,
+)
+
+
+class HttpConnector(Connector):
+    name = "http"
+
+    def __init__(self, metadata_uri: str, timeout_s: float = 30.0):
+        self.metadata_uri = metadata_uri
+        self.timeout_s = timeout_s
+        self._tables: Optional[Dict[str, dict]] = None
+
+    # -- metadata -------------------------------------------------------
+    def _fetch(self, uri: str) -> bytes:
+        with urllib.request.urlopen(uri, timeout=self.timeout_s) as resp:
+            return resp.read()
+
+    def _load(self) -> Dict[str, dict]:
+        if self._tables is None:
+            doc = json.loads(self._fetch(self.metadata_uri))
+            self._tables = {t["name"]: t for t in doc.get("tables", [])}
+        return self._tables
+
+    def list_tables(self) -> List[str]:
+        return sorted(self._load())
+
+    def get_table(self, table: str) -> Optional[TableHandle]:
+        if table not in self._load():
+            raise KeyError(f"http table not found: {table}")
+        return TableHandle("http", table)
+
+    def table_schema(self, handle: TableHandle) -> TableSchema:
+        doc = self._load()[handle.table]
+        return TableSchema(handle.table, tuple(
+            ColumnMetadata(c["name"], T.parse_type(c["type"].lower()))
+            for c in doc["columns"]))
+
+    # -- reads ----------------------------------------------------------
+    def get_splits(self, handle: TableHandle,
+                   desired_splits: int) -> List[Split]:
+        doc = self._load()[handle.table]
+        return [Split(handle,
+                      urllib.parse.urljoin(self.metadata_uri, src))
+                for src in doc.get("sources", [])]
+
+    def page_source(self, split: Split, columns: Sequence[str],
+                    batch_rows: int = 65536) -> PageSource:
+        from presto_tpu.connectors.decoder import CsvRowDecoder
+
+        schema = self.table_schema(split.handle)
+        conn = self
+        names = schema.column_names()
+        types = {n: schema.column_type(n) for n in names}
+        # decode the SELECTED columns through the shared record-decoder
+        # tier: mapping = each column's field index in the CSV record
+        decoder = CsvRowDecoder(
+            [ColumnMetadata(c, types[c]) for c in columns],
+            [str(names.index(c)) for c in columns])
+
+        class _Source(PageSource):
+            def __iter__(self):
+                body = conn._fetch(split.info)
+                rows: List[tuple] = []
+                for line in body.splitlines():
+                    if not line.strip():
+                        continue
+                    row = decoder.decode(line)
+                    if row is None:
+                        continue
+                    rows.append(row)
+                    if len(rows) >= batch_rows:
+                        yield _batch(rows, columns, types)
+                        rows = []
+                if rows:
+                    yield _batch(rows, columns, types)
+
+        return _Source()
+
+
+def _batch(rows: List[tuple], columns: Sequence[str],
+           types: Dict[str, T.Type]) -> Batch:
+    cols = []
+    for j, c in enumerate(columns):
+        cols.append(column_from_pylist(types[c], [r[j] for r in rows]))
+    return Batch(tuple(cols), len(rows))

@@ -158,7 +158,8 @@ def _arrow_cell(t: T.Type, v: Any) -> Any:
 
 
 def _read_rows(path: str, fmt: str, names: Sequence[str],
-               types: Sequence[T.Type]) -> List[tuple]:
+               types: Sequence[T.Type],
+               row_group: Optional[int] = None) -> List[tuple]:
     if fmt == "csv":
         out = []
         with open(path, newline="") as f:
@@ -181,7 +182,10 @@ def _read_rows(path: str, fmt: str, names: Sequence[str],
     if fmt == "parquet":
         import pyarrow.parquet as pq
 
-        table = pq.read_table(path)
+        if row_group is not None:
+            table = pq.ParquetFile(path).read_row_group(row_group)
+        else:
+            table = pq.read_table(path)
     elif fmt == "orc":
         import pyarrow.orc as po
 
@@ -285,9 +289,24 @@ class LakehouseConnector(Connector):
             for fn in sorted(filenames):
                 if fn == _SCHEMA_FILE or fn.startswith("."):
                     continue
-                splits.append(Split(
-                    handle, (os.path.join(dirpath, fn), pvals)))
-        return splits or [Split(handle, (None, {}))]
+                path = os.path.join(dirpath, fn)
+                if meta.format == "parquet":
+                    # one split PER ROW GROUP (the stripe/rowgroup split
+                    # granularity of presto-parquet, ParquetReader.java:64):
+                    # finer P5 parallelism and per-rowgroup stats pruning
+                    try:
+                        import pyarrow.parquet as pq
+
+                        n_rg = pq.ParquetFile(path).metadata.num_row_groups
+                    except Exception:  # noqa: BLE001 - unreadable footer
+                        n_rg = 0
+                    if n_rg > 1:
+                        splits.extend(
+                            Split(handle, (path, pvals, rg))
+                            for rg in range(n_rg))
+                        continue
+                splits.append(Split(handle, (path, pvals, None)))
+        return splits or [Split(handle, (None, {}, None))]
 
     def prune_splits(self, handle: TableHandle, splits: List[Split],
                      constraints) -> List[Split]:
@@ -297,7 +316,7 @@ class LakehouseConnector(Connector):
         pset = set(meta.partitioned_by)
         live = []
         for s in splits:
-            _path, pvals = s.info
+            _path, pvals = s.info[0], s.info[1]
             ok = True
             for col, op, lit in constraints:
                 if col not in pset or col not in pvals:
@@ -312,7 +331,59 @@ class LakehouseConnector(Connector):
                     break
             if ok:
                 live.append(s)
+        if meta.format == "parquet" and constraints:
+            md_cache: Dict[str, object] = {}
+            live = [s for s in live
+                    if self._parquet_may_match(s, meta, constraints,
+                                               md_cache)]
         return live
+
+    def _parquet_may_match(self, s: Split, meta, constraints,
+                           md_cache: Dict[str, object]) -> bool:
+        """Row-group min/max stats pruning (the presto-parquet predicate
+        pushdown, ParquetReader.java:64 + TupleDomainParquetPredicate
+        role): a row group whose column range cannot satisfy a pushed
+        conjunct never reaches the scan.  Columns match by the FILE's
+        path_in_schema, not table-schema position — externally written
+        files may order columns differently."""
+        path, pvals, rg = s.info
+        if path is None or not str(path).endswith(".parquet"):
+            return True
+        md = md_cache.get(path)
+        if md is None:
+            try:
+                import pyarrow.parquet as pq
+
+                md = pq.ParquetFile(path).metadata
+            except Exception:  # noqa: BLE001 - unreadable footer: keep
+                md = "unreadable"
+            md_cache[path] = md
+        if md == "unreadable" or md.num_row_groups == 0:
+            return True
+        groups = [rg] if rg is not None else range(md.num_row_groups)
+        rg0 = md.row_group(0)
+        file_cols = {rg0.column(i).path_in_schema: i
+                     for i in range(rg0.num_columns)}
+        for col, op, lit in constraints:
+            if col in pvals or col not in file_cols:
+                continue
+            typ = meta.schema.column_type(col)
+            lo = hi = None
+            for g in groups:
+                rgmd = md.row_group(g)
+                st = rgmd.column(file_cols[col]).statistics
+                if st is None or not st.has_min_max:
+                    lo = hi = None
+                    break
+                smin = self._storage(typ, st.min)
+                smax = self._storage(typ, st.max)
+                lo = smin if lo is None else min(lo, smin)
+                hi = smax if hi is None else max(hi, smax)
+            if lo is None:
+                continue          # stats missing: cannot prune this col
+            if not _range_may_match(op, lo, hi, lit):
+                return False
+        return True
 
     @staticmethod
     def _storage(typ: T.Type, v: Any) -> Any:
@@ -326,7 +397,8 @@ class LakehouseConnector(Connector):
     def page_source(self, split: Split, columns: Sequence[str],
                     batch_rows: int = 65536) -> PageSource:
         meta = self._meta(split.handle.table)
-        path, pvals = split.info
+        path, pvals = split.info[0], split.info[1]
+        row_group = split.info[2] if len(split.info) > 2 else None
         data_names = [c.name for c in meta.data_columns]
         data_types = [c.type for c in meta.data_columns]
         ptypes = {c.name: c.type for c in meta.schema.columns}
@@ -338,7 +410,8 @@ class LakehouseConnector(Connector):
 
                     yield empty_batch([ptypes[c] for c in columns])
                     return
-                rows = _read_rows(path, meta.format, data_names, data_types)
+                rows = _read_rows(path, meta.format, data_names,
+                                  data_types, row_group)
                 for lo in range(0, max(len(rows), 1), batch_rows):
                     chunk = rows[lo:lo + batch_rows]
                     out_cols = []
@@ -441,6 +514,29 @@ class _LakehouseSink(PageSink):
                         dnames, dtypes, rows)
         self.by_partition = {}
         return self.rows
+
+
+def _range_may_match(op: str, lo: Any, hi: Any, lit: Any) -> bool:
+    """May ANY value in [lo, hi] satisfy ``value <op> lit``?  False only
+    when the whole range provably fails (pruning must stay sound)."""
+    try:
+        if op == "eq":
+            return lo <= lit <= hi
+        if op == "lt":
+            return lo < lit
+        if op == "le":
+            return lo <= lit
+        if op == "gt":
+            return hi > lit
+        if op == "ge":
+            return hi >= lit
+        if op == "in":
+            return any(lo <= v <= hi for v in lit)
+        if op == "ne":
+            return not (lo == hi == lit)
+    except TypeError:
+        return True  # incomparable stats: keep the split
+    return True
 
 
 def _cmp(op: str, a: Any, b: Any) -> bool:

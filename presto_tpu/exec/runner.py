@@ -44,7 +44,7 @@ def _parallel_prefix(p: Pipeline, config: EngineConfig) -> int:
 
 
 def _run_parallel(p: Pipeline, task: TaskContext, prefix: int,
-                  width: int) -> None:
+                  width: int, deadline=None) -> None:
     from presto_tpu.exec.localexchange import (
         LocalExchange, LocalExchangeSinkOperatorFactory,
         LocalExchangeSourceOperatorFactory,
@@ -59,7 +59,7 @@ def _run_parallel(p: Pipeline, task: TaskContext, prefix: int,
             + [LocalExchangeSinkOperatorFactory(exchange, producer=i)],
             p.splits[i::width], name=f"{p.name}.feed{i}")
         try:
-            feeder.instantiate(task).run_to_completion()
+            feeder.instantiate(task).run_to_completion(deadline=deadline)
         except BaseException as e:  # noqa: BLE001 - crossed to consumer
             errors.append(e)
             exchange.fail(e)
@@ -73,7 +73,7 @@ def _run_parallel(p: Pipeline, task: TaskContext, prefix: int,
         [LocalExchangeSourceOperatorFactory(exchange)]
         + p.factories[prefix:], name=p.name)
     try:
-        consumer.instantiate(task).run_to_completion()
+        consumer.instantiate(task).run_to_completion(deadline=deadline)
     except BaseException as e:
         # unblock feeders stuck in put() backpressure, then re-raise
         exchange.fail(e)
@@ -114,7 +114,7 @@ def execute_pipelines(pipelines: Sequence[Pipeline],
             prefix = _parallel_prefix(p, config)
             width = min(config.task_concurrency, len(p.splits))
             if prefix > 0 and width > 1:
-                _run_parallel(p, task, prefix, width)
+                _run_parallel(p, task, prefix, width, deadline=deadline)
             else:
                 driver = p.instantiate(task)
                 driver.run_to_completion(deadline=deadline)

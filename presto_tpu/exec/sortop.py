@@ -255,6 +255,15 @@ class OrderByOperator(Operator):
             s.close()
         self._runs = []
 
+    def close(self) -> None:
+        super().close()
+        for s in self._runs:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+        self._runs = []
+
     def get_output(self) -> Optional[Batch]:
         if not self._outputs:
             return None

@@ -179,6 +179,18 @@ class WindowOperator(Operator):
         self._consume_sorted()
         self._sorter = None
 
+    def close(self) -> None:
+        super().close()
+        # the embedded sorter is not in the driver's operator list: free
+        # its reservations and spilled run files here (failure paths
+        # included — the Driver close invariant)
+        if self._sorter is not None:
+            try:
+                self._sorter.close()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+            self._sorter = None
+
     def _emit(self, out: Batch) -> None:
         self._outputs.append(out)
         self.ctx.stats.output_rows += out.num_rows

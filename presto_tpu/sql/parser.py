@@ -656,6 +656,21 @@ class _Parser:
                 self.expect_op(")")
                 alias, col_aliases = self._relation_alias()
                 return t.SubqueryRelation(q, alias, col_aliases)
+            if self.at_op("("):
+                # ambiguous '((': a parenthesized QUERY whose first
+                # set-operation operand is itself parenthesized
+                # ("((SELECT..) INTERSECT SELECT..) t", SqlBase.g4
+                # queryPrimary), or a parenthesized RELATION (join
+                # grouping) — try the query reading first, backtrack on
+                # failure
+                save = self.pos
+                try:
+                    q = self.query()
+                    self.expect_op(")")
+                    alias, col_aliases = self._relation_alias()
+                    return t.SubqueryRelation(q, alias, col_aliases)
+                except SqlSyntaxError:
+                    self.pos = save
             rel = self.relation()
             self.expect_op(")")
             return rel

@@ -228,13 +228,21 @@ class Translator:
 
     def __init__(self, scope: Scope,
                  grouped: Optional["GroupingContext"] = None,
-                 windows: Optional[Dict[t.Expression, RowExpression]] = None):
+                 windows: Optional[Dict[t.Expression, RowExpression]] = None,
+                 subquery_refs: Optional[Dict[int, RowExpression]] = None):
         self.scope = scope
         self.grouped = grouped
         self.windows = windows
+        # id(AST subquery node) -> hoisted RowExpression: subqueries the
+        # planner has already attached as channels (apply/decorrelation)
+        self.subquery_refs = subquery_refs
         self.lambda_env: Dict[str, T.Type] = {}  # lambda params in scope
 
     def translate(self, expr: t.Expression) -> RowExpression:
+        if self.subquery_refs is not None:
+            hit = self.subquery_refs.get(id(expr))
+            if hit is not None:
+                return hit
         if self.windows is not None:
             hit = self.windows.get(expr)
             if hit is not None:
@@ -885,13 +893,28 @@ class Planner:
             rel, win_map = self._plan_windows(rel, win_calls, grouping)
             tr = Translator(rel.scope, grouping, win_map)
 
+        # scalar subqueries inside SELECT expressions (q09-style CASE
+        # over subquery counts): hoist as channels first; Star expansion
+        # below must not see the hidden channels
+        visible_fields = list(rel.scope.fields)
+        sub_refs: Dict[int, RowExpression] = {}
+        for item in q.select:
+            if not isinstance(item.expr, t.Star) \
+                    and _contains_subquery(item.expr):
+                rel = self._hoist_subqueries(rel, item.expr, sub_refs,
+                                             grouping)
+        if sub_refs:
+            tr = Translator(rel.scope, grouping,
+                            getattr(tr, "windows", None),
+                            subquery_refs=sub_refs)
+
         # SELECT projection
         exprs: List[RowExpression] = []
         fields: List[Field] = []
         item_asts: List[Optional[t.Expression]] = []
         for item in q.select:
             if isinstance(item.expr, t.Star):
-                for i, f in enumerate(rel.scope.fields):
+                for i, f in enumerate(visible_fields):
                     if (item.expr.qualifier is not None
                             and f.qualifier != item.expr.qualifier[0]):
                         continue
@@ -1227,8 +1250,10 @@ class Planner:
                            "=": "=", "<>": "<>"}[inner.op]
                 return self._plan_scalar_compare(rel, flipped, inner.right,
                                                  inner.left.query, grouping)
-        raise SqlAnalysisError(
-            f"unsupported subquery predicate {type(inner).__name__}")
+        # general positions: EXISTS/IN under OR, scalar subqueries nested
+        # in arithmetic/CASE — hoist into channels/markers and filter on
+        # the rewritten expression
+        return self._plan_general_subquery_filter(rel, c, grouping)
 
     def _plan_in_subquery(self, rel: RelationPlan, e: t.InSubquery,
                           negated: bool) -> RelationPlan:
@@ -1320,107 +1345,17 @@ class Planner:
             self, rel: RelationPlan, op: str, lhs: t.Expression,
             q: t.Query,
             grouping: Optional[GroupingContext] = None) -> RelationPlan:
-        probe = self._try_uncorrelated(q, rel)
-        if probe is not None:
-            sub = probe
-            # cross join a single row, filter, project away
-            nleft = len(rel.scope.fields)
-            single = EnforceSingleRowNode(sub.node)
-            cols = rel.node.columns + sub.node.columns
-            joined = JoinNode("cross", rel.node, single, (), (), cols)
-            scope = Scope(rel.scope.fields
-                          + [Field(f.name, "$subquery", f.type)
-                             for f in sub.scope.fields], rel.scope.parent)
-            tr = Translator(scope, grouping)
-            pred = B.comparison(op, tr.translate(lhs),
-                                B.ref(nleft, sub.scope.fields[0].type))
-            filtered = FilterNode(joined, pred)
-            keep = tuple(range(nleft))
-            proj = ProjectNode(
-                filtered,
-                tuple(B.ref(i, rel.node.columns[i][1]) for i in keep),
-                rel.node.columns)
-            return RelationPlan(proj, rel.scope)
-        # correlated scalar aggregate -> group by correlation keys + join
-        sub_from, corr_eq, corr_other = self._plan_correlated_from(rel, q)
-        if corr_other:
-            raise SqlAnalysisError(
-                "only equality correlation is supported in scalar "
-                "subqueries")
-        if not (len(q.select) == 1
-                and _contains_aggregate(q.select[0].expr)):
-            raise SqlAnalysisError(
-                "correlated scalar subquery must be a single aggregate")
-        sub_keys = [ch for ch, _ in corr_eq]
-        # aggregate the subquery over its correlation keys
-        agg_asts: List[t.FunctionCall] = []
-        _collect_aggs(q.select[0].expr, agg_asts)
-        sub_tr = Translator(sub_from.scope)
-        pre_exprs = [B.ref(ch, sub_from.scope.fields[ch].type)
-                     for ch in sub_keys]
-        aggs: List[PlanAggregate] = []
-        agg_inputs: List[RowExpression] = []
-        for a in agg_asts:
-            if a.is_star or not a.args:
-                spec = resolve_aggregate("count", None)
-                aggs.append(PlanAggregate(spec, None, a.distinct))
-                continue
-            arg = sub_tr.translate(a.args[0])
-            agg_inputs.append(arg)
-            spec = resolve_aggregate(a.name, arg.type)
-            aggs.append(PlanAggregate(spec,
-                                      len(pre_exprs) + len(agg_inputs) - 1,
-                                      a.distinct))
-        pre_cols = (tuple((f"k{i}", x.type)
-                          for i, x in enumerate(pre_exprs))
-                    + tuple((f"a{i}", x.type)
-                            for i, x in enumerate(agg_inputs)))
-        pre = ProjectNode(sub_from.node, tuple(pre_exprs + agg_inputs),
-                          pre_cols)
-        agg_cols = (tuple(pre_cols[:len(sub_keys)])
-                    + tuple((f"agg{i}", a.spec.result_type)
-                            for i, a in enumerate(aggs)))
-        agg_node = AggregationNode(pre, tuple(range(len(sub_keys))),
-                                   tuple(aggs), agg_cols)
-        # value expression over [keys..., agg results...]
-        g_fields = [Field(n, None, typ) for n, typ in agg_cols]
-        gctx = GroupingContext([], agg_asts, g_fields)
-        # shift agg channels past keys
-        gctx.group_asts = [None] * len(sub_keys)  # type: ignore[list-item]
-        val_tr = Translator(Scope(g_fields), gctx)
-        value = val_tr.translate(q.select[0].expr)
-        val_cols = agg_cols[:len(sub_keys)] + (("$value", value.type),)
-        val_proj = ProjectNode(
-            agg_node,
-            tuple(B.ref(i, agg_cols[i][1]) for i in range(len(sub_keys)))
-            + (value,),
-            val_cols)
-        # join outer on correlation keys
-        outer_keys = []
-        src = rel
-        tr = Translator(src.scope)
-        for _, outer_ast in corr_eq:
-            key = tr.translate(outer_ast)
-            src, ch = _channel_for(src, key)
-            tr = Translator(src.scope)
-            outer_keys.append(ch)
-        nleft = len(src.scope.fields)
-        cols = src.node.columns + val_cols
-        joined = JoinNode("inner", src.node, val_proj, tuple(outer_keys),
-                          tuple(range(len(sub_keys))), cols)
-        jscope = Scope(src.scope.fields
-                       + [Field(n, "$subquery", typ) for n, typ in val_cols],
-                       src.scope.parent)
-        jtr = Translator(jscope)
-        pred = B.comparison(op, jtr.translate(lhs),
-                            B.ref(nleft + len(sub_keys), value.type))
-        filtered = FilterNode(joined, pred)
-        keep = tuple(range(len(rel.scope.fields)))
+        orig_fields = list(rel.scope.fields)
+        orig_cols = tuple(rel.node.columns[:len(orig_fields)])
+        rel2, val = self._attach_scalar_subquery(rel, q, grouping)
+        tr = Translator(rel2.scope, grouping)
+        pred = B.comparison(op, tr.translate(lhs), val)
+        filtered = FilterNode(rel2.node, pred)
         proj = ProjectNode(
             filtered,
-            tuple(B.ref(i, src.node.columns[i][1]) for i in keep),
-            tuple(src.node.columns[i] for i in keep))
-        return RelationPlan(proj, rel.scope)
+            tuple(B.ref(i, ty) for i, (_n, ty) in enumerate(orig_cols)),
+            orig_cols)
+        return RelationPlan(proj, Scope(orig_fields, rel.scope.parent))
 
     def _try_uncorrelated(self, q: t.Query,
                           rel: RelationPlan) -> Optional[RelationPlan]:
@@ -1484,6 +1419,265 @@ class Planner:
                            _and_all([tr.translate(c) for c in local])),
                 sub.scope)
         return sub, corr_eq, corr_other
+
+    # --- general subquery hoisting (apply/decorrelation) -------------------
+    # Subqueries in arbitrary expression positions — scalar subqueries
+    # nested in arithmetic or CASE, EXISTS/IN under OR — hoist into
+    # channels/markers joined to the relation, then the surrounding
+    # expression translates normally (the reference's ApplyNode +
+    # TransformCorrelated* / semiJoinOutput-symbol design).
+
+    def _hoist_subqueries(self, rel: RelationPlan, expr: t.Node,
+                          refs: Dict[int, RowExpression],
+                          grouping=None) -> RelationPlan:
+        """Attach every top-level subquery inside ``expr`` as a channel;
+        ``refs`` maps id(ast node) -> replacement RowExpression."""
+        if isinstance(expr, t.ScalarSubquery):
+            rel, rex = self._attach_scalar_subquery(rel, expr.query,
+                                                    grouping)
+            refs[id(expr)] = rex
+            return rel
+        if isinstance(expr, t.Exists):
+            rel, rex = self._attach_exists_marker(rel, expr.query)
+            refs[id(expr)] = B.not_(rex) if expr.negated else rex
+            return rel
+        if isinstance(expr, t.InSubquery):
+            rel, rex = self._attach_in_marker(rel, expr)
+            refs[id(expr)] = B.not_(rex) if expr.negated else rex
+            return rel
+        for f in getattr(expr, "__dataclass_fields__", {}):
+            v = getattr(expr, f)
+            if isinstance(v, t.Node):
+                rel = self._hoist_subqueries(rel, v, refs, grouping)
+            elif isinstance(v, tuple):
+                for item in v:
+                    if isinstance(item, t.Node):
+                        rel = self._hoist_subqueries(rel, item, refs,
+                                                     grouping)
+                    elif isinstance(item, tuple):
+                        for sub in item:
+                            if isinstance(sub, t.Node):
+                                rel = self._hoist_subqueries(
+                                    rel, sub, refs, grouping)
+        return rel
+
+    def _plan_general_subquery_filter(
+            self, rel: RelationPlan, c: t.Expression,
+            grouping=None) -> RelationPlan:
+        """WHERE/HAVING conjunct with subqueries in general positions."""
+        orig_fields = list(rel.scope.fields)
+        orig_cols = rel.node.columns[:len(orig_fields)]
+        refs: Dict[int, RowExpression] = {}
+        rel = self._hoist_subqueries(rel, c, refs, grouping)
+        tr = Translator(rel.scope, grouping, subquery_refs=refs)
+        filtered = FilterNode(rel.node, tr.translate(c))
+        proj = ProjectNode(
+            filtered,
+            tuple(B.ref(i, ty) for i, (_n, ty) in enumerate(orig_cols)),
+            tuple(orig_cols))
+        return RelationPlan(proj, Scope(orig_fields, rel.scope.parent))
+
+    def _attach_scalar_subquery(self, rel: RelationPlan, q: t.Query,
+                                grouping=None
+                                ) -> Tuple[RelationPlan, RowExpression]:
+        """Attach a scalar subquery's single value as a channel: cross
+        join + EnforceSingleRow when uncorrelated; group-by-correlation-
+        keys + LEFT join for correlated aggregates (empty groups yield
+        NULL, SQL scalar-subquery semantics)."""
+        probe = self._try_uncorrelated(q, rel)
+        if probe is not None:
+            nleft = len(rel.scope.fields)
+            single = EnforceSingleRowNode(probe.node)
+            cols = rel.node.columns + probe.node.columns
+            joined = JoinNode("cross", rel.node, single, (), (), cols)
+            scope = Scope(rel.scope.fields
+                          + [Field(f.name, "$subquery", f.type)
+                             for f in probe.scope.fields],
+                          rel.scope.parent)
+            return (RelationPlan(joined, scope),
+                    B.ref(nleft, probe.scope.fields[0].type))
+        sub_from, corr_eq, corr_other = self._plan_correlated_from(rel, q)
+        if corr_other:
+            raise SqlAnalysisError(
+                "only equality correlation is supported in scalar "
+                "subqueries")
+        if not (len(q.select) == 1
+                and _contains_aggregate(q.select[0].expr)):
+            raise SqlAnalysisError(
+                "correlated scalar subquery must be a single aggregate")
+        val_proj, value_type, n_keys = self._correlated_agg_value(
+            sub_from, corr_eq, q)
+        src = rel
+        tr = Translator(src.scope)
+        outer_keys = []
+        for _, outer_ast in corr_eq:
+            key = tr.translate(outer_ast)
+            src, ch = _channel_for(src, key)
+            tr = Translator(src.scope)
+            outer_keys.append(ch)
+        nleft = len(src.scope.fields)
+        cols = src.node.columns + val_proj.columns
+        joined = JoinNode("left", src.node, val_proj, tuple(outer_keys),
+                          tuple(range(n_keys)), cols)
+        jscope = Scope(src.scope.fields
+                       + [Field(n, "$subquery", ty)
+                          for n, ty in val_proj.columns],
+                       src.scope.parent)
+        return (RelationPlan(joined, jscope),
+                B.ref(nleft + n_keys, value_type))
+
+    def _correlated_agg_value(self, sub_from: RelationPlan, corr_eq,
+                              q: t.Query):
+        """[keys..., $value] projection of a correlated aggregate
+        subquery grouped by its correlation keys."""
+        sub_keys = [ch for ch, _ in corr_eq]
+        agg_asts: List[t.FunctionCall] = []
+        _collect_aggs(q.select[0].expr, agg_asts)
+        sub_tr = Translator(sub_from.scope)
+        pre_exprs = [B.ref(ch, sub_from.scope.fields[ch].type)
+                     for ch in sub_keys]
+        aggs: List[PlanAggregate] = []
+        agg_inputs: List[RowExpression] = []
+        for a in agg_asts:
+            if a.is_star or not a.args:
+                spec = resolve_aggregate("count", None)
+                aggs.append(PlanAggregate(spec, None, a.distinct))
+                continue
+            arg = sub_tr.translate(a.args[0])
+            agg_inputs.append(arg)
+            spec = resolve_aggregate(a.name, arg.type)
+            aggs.append(PlanAggregate(
+                spec, len(pre_exprs) + len(agg_inputs) - 1, a.distinct))
+        pre_cols = (tuple((f"k{i}", x.type)
+                          for i, x in enumerate(pre_exprs))
+                    + tuple((f"a{i}", x.type)
+                            for i, x in enumerate(agg_inputs)))
+        pre = ProjectNode(sub_from.node, tuple(pre_exprs + agg_inputs),
+                          pre_cols)
+        agg_cols = (tuple(pre_cols[:len(sub_keys)])
+                    + tuple((f"agg{i}", a.spec.result_type)
+                            for i, a in enumerate(aggs)))
+        agg_node = AggregationNode(pre, tuple(range(len(sub_keys))),
+                                   tuple(aggs), agg_cols)
+        g_fields = [Field(n, None, ty) for n, ty in agg_cols]
+        gctx = GroupingContext([], agg_asts, g_fields)
+        gctx.group_asts = [None] * len(sub_keys)  # type: ignore[list-item]
+        val_tr = Translator(Scope(g_fields), gctx)
+        value = val_tr.translate(q.select[0].expr)
+        val_cols = agg_cols[:len(sub_keys)] + (("$value", value.type),)
+        val_proj = ProjectNode(
+            agg_node,
+            tuple(B.ref(i, agg_cols[i][1])
+                  for i in range(len(sub_keys))) + (value,),
+            val_cols)
+        return val_proj, value.type, len(sub_keys)
+
+    def _attach_exists_marker(self, rel: RelationPlan, q: t.Query
+                              ) -> Tuple[RelationPlan, RowExpression]:
+        """EXISTS as a BOOLEAN channel (semiJoinOutput symbol role)."""
+        probe = self._try_uncorrelated(q, rel)
+        if probe is not None:
+            # global count > 0 cross-joined (always exactly one row)
+            cnt_spec = resolve_aggregate("count", None)
+            agg = AggregationNode(
+                probe.node, (), (PlanAggregate(cnt_spec, None),),
+                (("$cnt", T.BIGINT),))
+            nleft = len(rel.scope.fields)
+            cols = rel.node.columns + agg.columns
+            joined = JoinNode("cross", rel.node, agg, (), (), cols)
+            scope = Scope(rel.scope.fields
+                          + [Field("$cnt", "$subquery", T.BIGINT)],
+                          rel.scope.parent)
+            marker = B.comparison(">", B.ref(nleft, T.BIGINT),
+                                  B.const(0, T.BIGINT))
+            return RelationPlan(joined, scope), marker
+        sub_from, corr_eq, corr_other = self._plan_correlated_from(rel, q)
+        if corr_other or not corr_eq:
+            raise SqlAnalysisError(
+                "EXISTS in this position supports only equality "
+                "correlation")
+        sub_keys = [ch for ch, _ in corr_eq]
+        pre_exprs = tuple(B.ref(ch, sub_from.scope.fields[ch].type)
+                          for ch in sub_keys)
+        pre_cols = tuple((f"k{i}", x.type)
+                         for i, x in enumerate(pre_exprs))
+        pre = ProjectNode(sub_from.node, pre_exprs, pre_cols)
+        cnt_spec = resolve_aggregate("count", None)
+        agg_cols = pre_cols + (("$cnt", T.BIGINT),)
+        agg = AggregationNode(pre, tuple(range(len(sub_keys))),
+                              (PlanAggregate(cnt_spec, None),), agg_cols)
+        src = rel
+        tr = Translator(src.scope)
+        outer_keys = []
+        for _, outer_ast in corr_eq:
+            key = tr.translate(outer_ast)
+            src, ch = _channel_for(src, key)
+            tr = Translator(src.scope)
+            outer_keys.append(ch)
+        nleft = len(src.scope.fields)
+        cols = src.node.columns + agg_cols
+        joined = JoinNode("left", src.node, agg, tuple(outer_keys),
+                          tuple(range(len(sub_keys))), cols)
+        scope = Scope(src.scope.fields
+                      + [Field(n, "$subquery", ty) for n, ty in agg_cols],
+                      src.scope.parent)
+        marker = B.call("is_not_null",
+                        B.ref(nleft + len(sub_keys), T.BIGINT))
+        return RelationPlan(joined, scope), marker
+
+    def _attach_in_marker(self, rel: RelationPlan, e: t.InSubquery
+                          ) -> Tuple[RelationPlan, RowExpression]:
+        """``x IN (subquery)`` as a three-valued BOOLEAN channel
+        (semiJoinOutput semantics): LEFT JOIN a DISTINCT build on x;
+        TRUE on match, UNKNOWN for NULL x or an unmatched x against a
+        build containing NULL, else FALSE — so NOT IN under OR negates
+        correctly."""
+        sub = self._try_uncorrelated(e.query, rel)
+        if sub is None:
+            raise SqlAnalysisError(
+                "correlated IN subquery in this position")
+        if len(sub.scope.fields) != 1:
+            raise SqlAnalysisError("IN subquery must return one column")
+        k_type = sub.scope.fields[0].type
+        distinct = AggregationNode(sub.node, (0,), (),
+                                   (("$k", k_type),))
+        tr = Translator(rel.scope)
+        key = tr.translate(e.expr)
+        src, ch = _channel_for(rel, key)
+        nleft = len(src.scope.fields)
+        cols = src.node.columns + distinct.columns
+        joined = JoinNode("left", src.node, distinct, (ch,), (0,), cols)
+        scope = Scope(src.scope.fields
+                      + [Field("$k", "$subquery", k_type)],
+                      src.scope.parent)
+        rel2 = RelationPlan(joined, scope)
+        # build-side NULL presence (one extra global-agg scan of the
+        # subquery, cross-joined as a single row)
+        sub2 = self._try_uncorrelated(e.query, rel)
+        has_null_src = FilterNode(
+            sub2.node, B.call("is_null", B.ref(0, k_type)))
+        cnt_spec = resolve_aggregate("count", None)
+        bhn_agg = AggregationNode(
+            has_null_src, (), (PlanAggregate(cnt_spec, None),),
+            (("$bhn", T.BIGINT),))
+        nleft2 = len(rel2.scope.fields)
+        cols2 = rel2.node.columns + bhn_agg.columns
+        joined2 = JoinNode("cross", rel2.node, bhn_agg, (), (), cols2)
+        scope2 = Scope(rel2.scope.fields
+                       + [Field("$bhn", "$subquery", T.BIGINT)],
+                       rel2.scope.parent)
+        matched = B.call("is_not_null", B.ref(nleft, k_type))
+        build_has_null = B.comparison(
+            ">", B.ref(nleft2, T.BIGINT), B.const(0, T.BIGINT))
+        key_ref = B.ref(ch, key.type)
+        # 3VL: NULL x -> UNKNOWN; match -> TRUE; no match w/ build NULL
+        # -> UNKNOWN; else FALSE
+        marker = B.if_(
+            B.call("is_null", key_ref), B.null(T.BOOLEAN),
+            B.if_(matched, B.const(True, T.BOOLEAN),
+                  B.if_(build_has_null, B.null(T.BOOLEAN),
+                        B.const(False, T.BOOLEAN))))
+        return RelationPlan(joined2, scope2), marker
 
     class _FoldedValue:
         """Plan-time-folded VALUES entry (Python-domain value + type)."""

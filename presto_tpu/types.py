@@ -128,7 +128,13 @@ class DateType(_Fixed):
         import datetime
 
         if isinstance(value, str):
-            value = datetime.date.fromisoformat(value)
+            try:
+                value = datetime.date.fromisoformat(value)
+            except ValueError:
+                # lenient y-m-d (DATE '2002-2-01' appears in standard
+                # TPC-DS query text)
+                y, m, d = (int(p) for p in value.strip().split("-"))
+                value = datetime.date(y, m, d)
         if isinstance(value, datetime.date):
             return (value - datetime.date(1970, 1, 1)).days
         return int(value)

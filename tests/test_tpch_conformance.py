@@ -58,6 +58,57 @@ def oracle(runner):
     return conn
 
 
+def register_sqlite_fns(conn) -> None:
+    """Statistical aggregates sqlite lacks but the suites use."""
+    class _Var:
+        def __init__(self, pop=False):
+            self.n = 0
+            self.s = 0.0
+            self.sq = 0.0
+            self.pop = pop
+
+        def step(self, v):
+            if v is None:
+                return
+            self.n += 1
+            self.s += v
+            self.sq += v * v
+
+        def value(self):
+            d = self.n if self.pop else self.n - 1
+            if d <= 0:
+                return None
+            return max(self.sq - self.s * self.s / self.n, 0.0) / d
+
+        def finalize(self):
+            return self.value()
+
+    def _std(pop):
+        class _S(_Var):
+            def __init__(self):
+                super().__init__(pop)
+
+            def finalize(self):
+                v = self.value()
+                return None if v is None else math.sqrt(v)
+
+        return _S
+
+    def _var(pop):
+        class _V(_Var):
+            def __init__(self):
+                super().__init__(pop)
+
+        return _V
+
+    conn.create_aggregate("stddev_samp", 1, _std(False))
+    conn.create_aggregate("stddev", 1, _std(False))
+    conn.create_aggregate("stddev_pop", 1, _std(True))
+    conn.create_aggregate("var_samp", 1, _var(False))
+    conn.create_aggregate("variance", 1, _var(False))
+    conn.create_aggregate("var_pop", 1, _var(True))
+
+
 def _sqlite_type(typ) -> str:
     if typ.name in ("varchar", "char"):
         return "TEXT"

@@ -30,13 +30,15 @@ def normalize(sql: str) -> str:
 def main() -> None:
     from presto_tpu.localrunner import LocalQueryRunner
     from test_tpch_conformance import (
-        _sqlite_type, _to_sqlite, assert_rows_match, to_sqlite_sql,
+        _sqlite_type, _to_sqlite, assert_rows_match, register_sqlite_fns,
+        to_sqlite_sql,
     )
 
     only = set(sys.argv[1].split(",")) if len(sys.argv) > 1 else None
     runner = LocalQueryRunner.tpch(scale=SCALE)
     oracle = sqlite3.connect(":memory:")
     oracle.execute("PRAGMA case_sensitive_like = ON")
+    register_sqlite_fns(oracle)
     tpcds = runner.registry.get("tpcds")
     for table in tpcds.list_tables():
         handle = tpcds.get_table(table)
