@@ -55,6 +55,10 @@ def _default_cache_dir() -> str:
 
     ident += b"|" + os.environ.get("XLA_FLAGS", "").encode()
     ident += b"|" + jax.__version__.encode()
+    # TPU-attached sessions compile through the axon remote service on a
+    # DIFFERENT host cpu — their CPU AOT entries must not mix with local
+    # CPU-only runs
+    ident += b"|axon" if os.environ.get("PALLAS_AXON_POOL_IPS") else b"|"
     tag = hashlib.sha1(ident).hexdigest()[:12]
     return os.path.join(base, "presto_tpu", f"xla-{tag}")
 

@@ -626,7 +626,11 @@ def _prune(node: PlanNode,
                 {ch: i for i, ch in enumerate(needed)})
     if isinstance(node, AggregationNode):
         ngroups = len(node.group_channels)
-        agg_needed = [i - ngroups for i in needed if i >= ngroups]
+        # the empty-needed [0] fallback can point past a zero-column
+        # aggregation (grouping-sets grand-total branch); clamp to
+        # channels the node actually has
+        agg_needed = [i - ngroups for i in needed
+                      if ngroups <= i < ngroups + len(node.aggregates)]
         keep_aggs = [node.aggregates[i] for i in agg_needed]
         child_needed = sorted(set(node.group_channels)
                               | {a.channel for a in keep_aggs

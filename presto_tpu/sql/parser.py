@@ -985,6 +985,11 @@ class _Parser:
                 raise SqlSyntaxError(f"bad interval unit {unit!r}",
                                      unit_tok.line, unit_tok.col)
             return t.IntervalLiteral(val.text, unit, sign)
+        if word == "grouping" and self.peek(1).kind == "OP" \
+                and self.peek(1).text == "(":
+            # grouping(col, ...) function (vs GROUPING SETS keyword)
+            self.next()
+            return self.function_call("grouping")
         if word == "case":
             self.next()
             operand = None

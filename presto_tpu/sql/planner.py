@@ -254,10 +254,44 @@ class Translator:
             hit = self.grouped.lookup(expr)
             if hit is not None:
                 return hit
+            if isinstance(expr, t.FunctionCall) \
+                    and expr.name == "grouping":
+                return self._translate_grouping(expr)
             if isinstance(expr, t.FunctionCall) and expr.name in AGG_NAMES:
                 raise SqlAnalysisError(
                     f"aggregate {expr.name} not found in grouping context")
         return self._translate(expr)
+
+    def _translate_grouping(self, expr: t.FunctionCall) -> RowExpression:
+        """grouping(c1, ..) -> bitmask (1 = aggregated away), from the
+        grouping-sets $grouping_id channel (GroupIdOperator's groupId)."""
+        positions = []
+        for arg in expr.args:
+            pos = None
+            for i, g in enumerate(self.grouped.group_asts):
+                if g == arg:
+                    pos = i
+                    break
+            if pos is None:
+                raise SqlAnalysisError(
+                    "grouping() argument must be a grouping column")
+            positions.append(pos)
+        gch = self.grouped.grouping_id_channel
+        if gch is None:
+            return B.const(0, T.BIGINT)   # plain GROUP BY: all grouped
+        gid = B.ref(gch, T.BIGINT)
+        n = len(positions)
+        out: RowExpression = B.const(0, T.BIGINT)
+        for j, pos in enumerate(positions):
+            bit = B.call("mod",
+                         B.call("divide", gid,
+                                B.const(1 << pos, T.BIGINT)),
+                         B.const(2, T.BIGINT))
+            term = (bit if n - 1 - j == 0 else
+                    B.call("multiply", bit,
+                           B.const(1 << (n - 1 - j), T.BIGINT)))
+            out = B.call("add", out, term)
+        return out
 
     def _translate(self, e: t.Expression) -> RowExpression:
         if isinstance(e, t.Identifier):
@@ -682,10 +716,14 @@ class GroupingContext:
 
     def __init__(self, group_asts: List[t.Expression],
                  agg_asts: List[t.FunctionCall],
-                 out_fields: List[Field]):
+                 out_fields: List[Field],
+                 grouping_id_channel: Optional[int] = None):
         self.group_asts = group_asts
         self.agg_asts = agg_asts
         self.out_fields = out_fields
+        # GROUPING SETS only: channel of the per-branch grouping-id
+        # bitmask (bit i set = key i aggregated away in this row)
+        self.grouping_id_channel = grouping_id_channel
 
     def lookup(self, expr: t.Expression) -> Optional[RowExpression]:
         for i, g in enumerate(self.group_asts):
@@ -918,6 +956,8 @@ class Planner:
                     if (item.expr.qualifier is not None
                             and f.qualifier != item.expr.qualifier[0]):
                         continue
+                    if f.name.startswith("$"):
+                        continue  # hidden channels ($grouping_id, ...)
                     exprs.append(B.ref(i, f.type))
                     fields.append(Field(f.name, None, f.type))
                     item_asts.append(t.Identifier((f.name,))
@@ -1166,18 +1206,26 @@ class Planner:
             else:
                 residuals.append(c)
 
-        # single-side conjuncts push into the inputs (safe for inner and
-        # for the preserved side's opposite input on outer joins)
+        # single-side conjuncts push into the inputs when that side is
+        # NOT preserved (inner both sides; outer joins only the build
+        # side).  A conjunct on the PRESERVED side of an outer join only
+        # gates matching — those rows split: the passing slice joins,
+        # the failing slice flows through null-extended.
+        preserved_only: List[t.Expression] = []
         if left_only:
-            if r.kind in ("inner", "left"):
+            if r.kind == "inner":
                 left = self._filter_rel(left, left_only)
+            elif r.kind == "left":
+                preserved_only = left_only
             else:
                 residuals.extend(left_only)
         if right_only:
-            if r.kind in ("inner", "right") or r.kind == "left":
+            if r.kind in ("inner", "left"):
                 # left outer: filtering the build side is ON-clause
                 # semantics (non-matching right rows just don't match)
                 right = self._filter_rel(right, right_only)
+            elif r.kind == "right":
+                preserved_only = right_only
             else:
                 residuals.extend(right_only)
 
@@ -1196,11 +1244,97 @@ class Planner:
                                       cols)
             if residual_rex is not None:
                 node = FilterNode(node, residual_rex)
+        elif r.kind == "right":
+            # RIGHT JOIN = LEFT JOIN with the sides swapped, projected
+            # back to the original [left cols, right cols] layout
+            nright = len(right.node.columns)
+            swapped_cols = right.node.columns + left.node.columns
+            res = None
+            if residual_rex is not None:
+                from presto_tpu.sql.optimizer import remap as _remap
+
+                mapping = {ch: (ch + nright if ch < nleft
+                                else ch - nleft)
+                           for ch in range(len(cols))}
+                res = _remap(residual_rex, mapping)
+            preserved = right.node
+            ext = None
+            if preserved_only:
+                preserved, ext = self._split_preserved(
+                    right, preserved_only,
+                    lambda fail: ProjectNode(
+                        fail,
+                        tuple(B.null(ty)
+                              for _n, ty in left.node.columns)
+                        + tuple(B.ref(i, ty)
+                                for i, (_n, ty)
+                                in enumerate(right.node.columns)),
+                        cols))
+            swapped = JoinNode("left", preserved, left.node,
+                               tuple(right_keys), tuple(left_keys),
+                               swapped_cols, res)
+            node = ProjectNode(
+                swapped,
+                tuple(B.ref(nright + i, ty)
+                      for i, (_n, ty) in enumerate(left.node.columns))
+                + tuple(B.ref(i, ty)
+                        for i, (_n, ty) in enumerate(right.node.columns)),
+                cols)
+            if ext is not None:
+                node = UnionNode((node, ext), cols)
+        elif r.kind == "full":
+            # FULL JOIN = LEFT JOIN  UNION ALL  (unmatched right rows,
+            # null-extended) — the right/full-outer composition over
+            # matched_build_mask's role (ops/join.py)
+            if residual_rex is not None:
+                raise SqlAnalysisError(
+                    "full join residuals are not supported")
+            left_join = JoinNode("left", left.node, right.node,
+                                 tuple(left_keys), tuple(right_keys),
+                                 cols)
+            anti_b = SemiJoinNode(right.node, left.node,
+                                  tuple(right_keys), tuple(left_keys),
+                                  negated=True)
+            extended = ProjectNode(
+                anti_b,
+                tuple(B.null(ty) for _n, ty in left.node.columns)
+                + tuple(B.ref(i, ty)
+                        for i, (_n, ty) in enumerate(right.node.columns)),
+                cols)
+            node = UnionNode((left_join, extended), cols)
         else:
-            node = JoinNode(r.kind, left.node, right.node,
+            preserved = left.node
+            ext = None
+            if preserved_only and r.kind == "left":
+                preserved, ext = self._split_preserved(
+                    left, preserved_only,
+                    lambda fail: ProjectNode(
+                        fail,
+                        tuple(B.ref(i, ty)
+                              for i, (_n, ty)
+                              in enumerate(left.node.columns))
+                        + tuple(B.null(ty)
+                                for _n, ty in right.node.columns),
+                        cols))
+            node = JoinNode(r.kind, preserved, right.node,
                             tuple(left_keys), tuple(right_keys), cols,
                             residual_rex)
+            if ext is not None:
+                node = UnionNode((node, ext), cols)
         return RelationPlan(node, combined.scope)
+
+    def _split_preserved(self, rel: RelationPlan,
+                         conjuncts: List[t.Expression], null_extend):
+        """Split an outer join's PRESERVED side on its own ON-clause
+        conjuncts: the passing slice participates in matching, the
+        failing slice (including UNKNOWN) flows through null-extended."""
+        tr = Translator(Scope(rel.scope.fields, None))
+        pred = B.coalesce(
+            _and_all([tr.translate(c) for c in conjuncts]),
+            B.const(False, T.BOOLEAN))
+        passing = FilterNode(rel.node, pred)
+        failing = FilterNode(rel.node, B.not_(pred))
+        return passing, null_extend(failing)
 
     def _filter_rel(self, rel: RelationPlan,
                     conjuncts: List[t.Expression]) -> RelationPlan:
@@ -1347,7 +1481,8 @@ class Planner:
             grouping: Optional[GroupingContext] = None) -> RelationPlan:
         orig_fields = list(rel.scope.fields)
         orig_cols = tuple(rel.node.columns[:len(orig_fields)])
-        rel2, val = self._attach_scalar_subquery(rel, q, grouping)
+        rel2, val = self._attach_scalar_subquery(rel, q, grouping,
+                                                 join_kind="inner")
         tr = Translator(rel2.scope, grouping)
         pred = B.comparison(op, tr.translate(lhs), val)
         filtered = FilterNode(rel2.node, pred)
@@ -1478,12 +1613,15 @@ class Planner:
         return RelationPlan(proj, Scope(orig_fields, rel.scope.parent))
 
     def _attach_scalar_subquery(self, rel: RelationPlan, q: t.Query,
-                                grouping=None
+                                grouping=None, join_kind: str = "left"
                                 ) -> Tuple[RelationPlan, RowExpression]:
         """Attach a scalar subquery's single value as a channel: cross
         join + EnforceSingleRow when uncorrelated; group-by-correlation-
-        keys + LEFT join for correlated aggregates (empty groups yield
-        NULL, SQL scalar-subquery semantics)."""
+        keys + join for correlated aggregates.  ``join_kind`` is "left"
+        in expression positions (empty groups yield NULL, SQL scalar-
+        subquery semantics); the comparison-FILTER path passes "inner" —
+        NULL comparisons are filtered anyway, and inner joins keep the
+        optimizer's reorder/flatten paths."""
         probe = self._try_uncorrelated(q, rel)
         if probe is not None:
             nleft = len(rel.scope.fields)
@@ -1517,14 +1655,21 @@ class Planner:
             outer_keys.append(ch)
         nleft = len(src.scope.fields)
         cols = src.node.columns + val_proj.columns
-        joined = JoinNode("left", src.node, val_proj, tuple(outer_keys),
-                          tuple(range(n_keys)), cols)
+        joined = JoinNode(join_kind, src.node, val_proj,
+                          tuple(outer_keys), tuple(range(n_keys)), cols)
         jscope = Scope(src.scope.fields
                        + [Field(n, "$subquery", ty)
                           for n, ty in val_proj.columns],
                        src.scope.parent)
-        return (RelationPlan(joined, jscope),
-                B.ref(nleft + n_keys, value_type))
+        val: RowExpression = B.ref(nleft + n_keys, value_type)
+        # count over an empty group is 0, not NULL: an unmatched outer
+        # row must read the count's default (the reference plants the
+        # same coalesce after the decorrelating join)
+        sel = q.select[0].expr
+        if (isinstance(sel, t.FunctionCall)
+                and sel.name.lower() in ("count", "count_if")):
+            val = B.coalesce(val, B.const(0, value_type))
+        return RelationPlan(joined, jscope), val
 
     def _correlated_agg_value(self, sub_from: RelationPlan, corr_eq,
                               q: t.Query):
@@ -1835,13 +1980,22 @@ class Planner:
         out_cols = (tuple((f"g{i}", typ)
                           for i, typ in enumerate(key_types))
                     + tuple((f"agg{i}", a.spec.result_type)
-                            for i, a in enumerate(aggs)))
+                            for i, a in enumerate(aggs))
+                    + (("$grouping_id", T.BIGINT),))
         branches: List[PlanNode] = []
         for subset in q.grouping_sets:
+            branch_aggs = tuple(aggs)
             branch_cols = (tuple((f"g{i}", key_types[i]) for i in subset)
                            + tuple((f"agg{i}", a.spec.result_type)
                                    for i, a in enumerate(aggs)))
-            agg_node = AggregationNode(pre, tuple(subset), tuple(aggs),
+            if not subset and not aggs:
+                # a zero-column aggregation cannot execute; the grand
+                # total branch carries a hidden count(*) the projection
+                # ignores
+                branch_aggs = (PlanAggregate(
+                    resolve_aggregate("count", None), None),)
+                branch_cols = (("$cnt", T.BIGINT),)
+            agg_node = AggregationNode(pre, tuple(subset), branch_aggs,
                                        branch_cols)
             pos = {ch: k for k, ch in enumerate(subset)}
             exprs: List[RowExpression] = []
@@ -1852,11 +2006,18 @@ class Planner:
                     exprs.append(B.null(typ))
             for j, a in enumerate(aggs):
                 exprs.append(B.ref(len(subset) + j, a.spec.result_type))
+            # grouping-id bitmask for the grouping() function (GroupId
+            # operator's groupId symbol): bit i = key i absent here
+            gid = sum(1 << i for i in range(len(key_types))
+                      if i not in pos)
+            exprs.append(B.const(gid, T.BIGINT))
             branches.append(ProjectNode(agg_node, tuple(exprs), out_cols))
         node: PlanNode = (branches[0] if len(branches) == 1
                           else UnionNode(tuple(branches), out_cols))
         out_fields = [Field(n, None, typ) for n, typ in out_cols]
-        grouping = GroupingContext(group_asts, agg_asts, out_fields)
+        grouping = GroupingContext(
+            group_asts, agg_asts, out_fields,
+            grouping_id_channel=len(key_types) + len(aggs))
         return RelationPlan(node, Scope(out_fields, scope.parent)), grouping
 
     # --- window functions --------------------------------------------------

@@ -158,3 +158,30 @@ class TestGeneralSubqueryPositions:
             "(SELECT n FROM memory.nio_b) OR y = 1").rows)
         # NOT IN is UNKNOWN for unmatched x against a NULL-bearing build
         assert got == [2]
+
+    def test_right_full_joins(self, runner):
+        rows = sorted(runner.execute(
+            "SELECT r.r_name, n.n_name FROM tpch.nation n RIGHT JOIN "
+            "tpch.region r ON n.n_regionkey = r.r_regionkey "
+            "AND r.r_name = 'ASIA'").rows, key=str)
+        assert len({x[0] for x in rows}) == 5       # regions preserved
+        assert sum(1 for x in rows if x[1] is not None) == 5
+        full = runner.execute(
+            "SELECT count(*) FROM tpch.nation n FULL JOIN tpch.region r "
+            "ON n.n_regionkey = r.r_regionkey").rows
+        assert full == [(25,)]                       # every region matches
+
+    def test_left_join_preserved_side_on_conjunct(self, runner):
+        rows = runner.execute(
+            "SELECT n.n_name, r.r_name FROM tpch.nation n LEFT JOIN "
+            "tpch.region r ON n.n_regionkey = r.r_regionkey "
+            "AND n.n_name = 'CHINA'").rows
+        assert len(rows) == 25
+        assert sum(1 for x in rows if x[1] is not None) == 1
+
+    def test_correlated_count_defaults_zero(self, runner):
+        got = runner.execute(
+            "SELECT c_custkey, (SELECT count(*) FROM tpch.orders o "
+            "WHERE o.o_custkey = c.c_custkey) FROM tpch.customer c").rows
+        assert all(x[1] is not None for x in got)
+        assert any(x[1] == 0 for x in got)           # 1/3 customers

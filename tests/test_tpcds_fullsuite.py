@@ -1,0 +1,100 @@
+"""TPC-DS full-suite conformance: the standard 99-query set vs the
+sqlite oracle (H2QueryRunner role at TPC-DS breadth, VERDICT r3 #5).
+
+Query texts in tests/tpcds_suite/ are the standard TPC-DS benchmark SQL
+(the reference ships the same texts as benchto resources,
+presto-benchto-benchmarks/src/main/resources/sql/presto/tpcds/); the
+MANIFEST records the round-4 sweep: 85 value-verified against sqlite,
+8 more execute correctly but sqlite cannot check them (no ROLLUP /
+GROUPING) — those run engine-only (plan + execute + sane shape).
+Remaining exclusions are xfailed by named feature below.
+"""
+
+import os
+import sqlite3
+
+import pytest
+
+from presto_tpu.localrunner import LocalQueryRunner
+
+pytestmark = pytest.mark.slow
+
+from test_tpch_conformance import (  # noqa: E402
+    _sqlite_type, _to_sqlite, assert_rows_match, register_sqlite_fns,
+    to_sqlite_sql,
+)
+from tpcds_suite.MANIFEST import ENGINE_ONLY, PASSING  # noqa: E402
+
+SCALE = 0.003
+_DIR = os.path.join(os.path.dirname(__file__), "tpcds_suite")
+
+# engine gaps, by named feature (the VERDICT-mandated explicit ledger)
+XFAIL = {
+    "14_2": "d_week_seq ambiguous: correlated CTE column scoping",
+    "36": "ORDER BY alias of a grouping()-derived CASE (lochierarchy)",
+    "41": "non-equality correlation in scalar subquery",
+    "49": "qualified alias scoping over UNION branches",
+    "58": "d_week_seq ambiguous: correlated CTE column scoping",
+    "66": "select-list alias referenced within the same select",
+    "70": "ORDER BY alias of a grouping()-derived CASE (lochierarchy)",
+    "74": "CTE alias qualified column scoping",
+    "75": "row-count mismatch under investigation (set-op dedup)",
+    "86": "ORDER BY alias of a grouping()-derived CASE (lochierarchy)",
+}
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+@pytest.fixture(scope="module")
+def oracle(runner):
+    conn = sqlite3.connect(":memory:")
+    conn.execute("PRAGMA case_sensitive_like = ON")
+    register_sqlite_fns(conn)
+    tpcds = runner.registry.get("tpcds")
+    for table in tpcds.list_tables():
+        handle = tpcds.get_table(table)
+        schema = tpcds.table_schema(handle)
+        names = schema.column_names()
+        cols_sql = ", ".join(f"{n} {_sqlite_type(schema.column_type(n))}"
+                             for n in names)
+        conn.execute(f"create table {table} ({cols_sql})")
+        for split in tpcds.get_splits(handle, 1):
+            for batch in tpcds.page_source(split, names, 1 << 20):
+                rows = [tuple(_to_sqlite(v) for v in r)
+                        for r in batch.to_pylist()]
+                ph = ", ".join("?" * len(names))
+                conn.executemany(
+                    f"insert into {table} values ({ph})", rows)
+        for n in names:
+            if n.endswith("_sk"):
+                conn.execute(
+                    f"create index ix_{table}_{n} on {table}({n})")
+    conn.commit()
+    return conn
+
+
+@pytest.mark.parametrize("qn", sorted(PASSING))
+def test_tpcds_query_vs_oracle(runner, oracle, qn):
+    sql = open(os.path.join(_DIR, f"q{qn}.sql")).read()
+    got = runner.execute(sql)
+    want = oracle.execute(
+        to_sqlite_sql(sql.replace("tpcds.", ""))).fetchall()
+    assert_rows_match(got.rows, want, "order by" in sql.lower())
+
+
+@pytest.mark.parametrize("qn", sorted(ENGINE_ONLY))
+def test_tpcds_rollup_queries_execute(runner, qn):
+    """sqlite cannot value-check ROLLUP/GROUPING shapes; the engine's
+    grouping-sets semantics are value-verified separately (grouping()
+    unit tests + the rollup conformance in test_tpcds_conformance)."""
+    sql = open(os.path.join(_DIR, f"q{qn}.sql")).read()
+    res = runner.execute(sql)
+    assert res.column_names
+
+
+@pytest.mark.parametrize("qn", sorted(XFAIL))
+def test_tpcds_known_gaps(runner, qn):
+    pytest.xfail(XFAIL[qn])

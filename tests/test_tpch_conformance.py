@@ -101,6 +101,13 @@ def register_sqlite_fns(conn) -> None:
 
         return _V
 
+    def _concat(*parts):
+        # Presto concat is NULL-propagating (engine matches)
+        if any(p is None for p in parts):
+            return None
+        return "".join(str(p) for p in parts)
+
+    conn.create_function("concat", -1, _concat)
     conn.create_aggregate("stddev_samp", 1, _std(False))
     conn.create_aggregate("stddev", 1, _std(False))
     conn.create_aggregate("stddev_pop", 1, _std(True))
@@ -241,6 +248,10 @@ def to_sqlite_sql(sql: str) -> str:
     # the inner selects already alias matching names (Q13), so drop them
     sql = re.sub(r"\bas\s+(\w+)\s*\(\s*\w+(?:\s*,\s*\w+)*\s*\)",
                  r"as \1", sql)
+    # NULL ordering: Presto ASC = NULLS LAST / DESC = NULLS FIRST;
+    # sqlite defaults to the opposite
+    sql = re.sub(r"(?i)\basc\b(?!\s+nulls)", "ASC NULLS LAST", sql)
+    sql = re.sub(r"(?i)\bdesc\b(?!\s+nulls)", "DESC NULLS FIRST", sql)
     return sql
 
 

@@ -15,6 +15,15 @@ import sys
 import time
 import traceback
 
+# conformance runs on CPU like the test suite: the remote-TPU tunnel's
+# per-dispatch latency (0.1-1 s, degrading over long sessions) dominates
+# the operator tier's many small dispatches at toy scales
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -34,7 +43,14 @@ def main() -> None:
         to_sqlite_sql,
     )
 
-    only = set(sys.argv[1].split(",")) if len(sys.argv) > 1 else None
+    only = None
+    slice_lo = slice_hi = None
+    if len(sys.argv) > 1:
+        if ":" in sys.argv[1]:
+            a, _, b = sys.argv[1].partition(":")
+            slice_lo, slice_hi = int(a), int(b)
+        else:
+            only = set(sys.argv[1].split(","))
     runner = LocalQueryRunner.tpch(scale=SCALE)
     oracle = sqlite3.connect(":memory:")
     oracle.execute("PRAGMA case_sensitive_like = ON")
@@ -74,9 +90,12 @@ def main() -> None:
     per_query_s = int(os.environ.get("HARVEST_TIMEOUT_S", "120"))
 
     ok, results = 0, []
-    for path in sorted(glob.glob(os.path.join(REF, "q*.sql"))):
+    paths = sorted(glob.glob(os.path.join(REF, "q*.sql")))
+    if slice_lo is not None:
+        paths = paths[slice_lo:slice_hi]
+    for path in paths:
         qn = os.path.basename(path)[1:-4]
-        if only and qn not in only and str(int(qn)) not in only:
+        if only and qn not in only:
             continue
         sql = normalize(open(path).read())
         t0 = time.time()
