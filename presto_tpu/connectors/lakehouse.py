@@ -189,7 +189,10 @@ def _read_rows(path: str, fmt: str, names: Sequence[str],
     elif fmt == "orc":
         import pyarrow.orc as po
 
-        table = po.read_table(path)
+        if row_group is not None:   # ORC: the index is a STRIPE
+            table = po.ORCFile(path).read_stripe(row_group)
+        else:
+            table = po.read_table(path)
     else:
         raise ValueError(f"unknown format {fmt}")
     cols = [table.column(n).to_pylist() for n in names]
@@ -290,14 +293,22 @@ class LakehouseConnector(Connector):
                 if fn == _SCHEMA_FILE or fn.startswith("."):
                     continue
                 path = os.path.join(dirpath, fn)
-                if meta.format == "parquet":
-                    # one split PER ROW GROUP (the stripe/rowgroup split
-                    # granularity of presto-parquet, ParquetReader.java:64):
-                    # finer P5 parallelism and per-rowgroup stats pruning
+                if meta.format in ("parquet", "orc"):
+                    # one split PER ROW GROUP / STRIPE (the split
+                    # granularity of presto-parquet ParquetReader.java:64
+                    # and presto-orc's stripe scheduling,
+                    # OrcRecordReader.java:72): finer P5 parallelism and
+                    # per-unit stats pruning
                     try:
-                        import pyarrow.parquet as pq
+                        if meta.format == "parquet":
+                            import pyarrow.parquet as pq
 
-                        n_rg = pq.ParquetFile(path).metadata.num_row_groups
+                            n_rg = (pq.ParquetFile(path)
+                                    .metadata.num_row_groups)
+                        else:
+                            import pyarrow.orc as po
+
+                            n_rg = po.ORCFile(path).nstripes
                     except Exception:  # noqa: BLE001 - unreadable footer
                         n_rg = 0
                     if n_rg > 1:
@@ -336,7 +347,56 @@ class LakehouseConnector(Connector):
             live = [s for s in live
                     if self._parquet_may_match(s, meta, constraints,
                                                md_cache)]
+        if meta.format == "orc" and constraints:
+            st_cache: Dict[str, object] = {}
+            live = [s for s in live
+                    if self._orc_may_match(s, meta, constraints,
+                                           st_cache)]
         return live
+
+    def _orc_may_match(self, s: Split, meta, constraints,
+                       st_cache: Dict[str, object]) -> bool:
+        """Stripe min/max stats pruning (presto-orc's stripe-level
+        predicate pushdown, OrcRecordReader.java:72/356): a stripe whose
+        column range cannot satisfy a pushed conjunct never reaches the
+        scan.  Stats come from our own footer/metadata parse
+        (orcmeta.py) — pyarrow exposes no stripe-statistics values."""
+        from presto_tpu.connectors.orcmeta import read_stripe_stats
+
+        path, pvals, stripe = s.info
+        if path is None or not str(path).endswith(".orc"):
+            return True
+        st = st_cache.get(path)
+        if st is None:
+            st = read_stripe_stats(path) or "unreadable"
+            st_cache[path] = st
+        if st == "unreadable":
+            return True
+        stripes = [stripe] if stripe is not None else range(st.nstripes)
+        for col, op, lit in constraints:
+            if col in pvals:
+                continue
+            typ = meta.schema.column_type(col)
+            lo = hi = None
+            missing = False
+            for g in stripes:
+                cs = st.stripe_column(g, col)
+                if cs is None or cs["min"] is None or cs["max"] is None:
+                    missing = True
+                    break
+                if isinstance(typ, T.DateType):
+                    # orcmeta DateStatistics are ALREADY epoch days
+                    smin, smax = cs["min"], cs["max"]
+                else:
+                    smin = self._storage(typ, cs["min"])
+                    smax = self._storage(typ, cs["max"])
+                lo = smin if lo is None else min(lo, smin)
+                hi = smax if hi is None else max(hi, smax)
+            if missing or lo is None:
+                continue          # stats missing: cannot prune this col
+            if not _range_may_match(op, lo, hi, lit):
+                return False
+        return True
 
     def _parquet_may_match(self, s: Split, meta, constraints,
                            md_cache: Dict[str, object]) -> bool:

@@ -1,0 +1,214 @@
+"""Minimal ORC footer/metadata reader: per-stripe column min/max stats.
+
+pyarrow reads ORC data but exposes no accessor for stripe statistics
+(`ORCFile.nstripe_statistics` counts them; nothing returns the values),
+so the stats-pruning tier parses the file tail itself — the same
+protobuf metadata the reference's native reader consumes
+(presto-orc/src/main/java/io/prestosql/orc/OrcReader.java:72 footer
+parse; stripe-stats pruning drives OrcRecordReader.java:356 nextPage's
+stripe skipping).  Only what pruning needs is decoded: PostScript,
+Footer.types/statistics, Metadata.stripeStats with integer / double /
+string / date min-max.
+
+Layout (ORC spec): ... | metadata | footer | postscript | psLen(1B).
+Footer/metadata are compression-chunked when compression != NONE; ZLIB
+(raw deflate) and ZSTD are handled, other codecs yield None (callers
+fall back to no pruning, never an error).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+_NONE, _ZLIB, _SNAPPY, _LZO, _LZ4, _ZSTD = range(6)
+
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    """(field_number, wire_type, value) over one protobuf message.
+    Wire 0 -> int, 2 -> bytes, 1/5 -> raw fixed bytes."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, i = _varint(buf, i)
+        elif wire == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wire == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:  # groups (3/4): not in ORC protos
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, v
+
+
+def _decompress(buf: bytes, kind: int) -> Optional[bytes]:
+    if kind == _NONE:
+        return buf
+    if kind == _ZLIB:
+        import zlib
+
+        dec = lambda b: zlib.decompress(b, wbits=-15)  # noqa: E731
+    elif kind == _ZSTD:
+        try:
+            import zstandard
+        except ImportError:
+            return None
+        dec = zstandard.ZstdDecompressor().decompress
+    else:
+        return None
+    out = []
+    i = 0
+    while i + 3 <= len(buf):
+        hdr = buf[i] | (buf[i + 1] << 8) | (buf[i + 2] << 16)
+        i += 3
+        ln, original = hdr >> 1, hdr & 1
+        chunk = buf[i:i + ln]
+        i += ln
+        out.append(chunk if original else dec(chunk))
+    return b"".join(out)
+
+
+def _column_stat(buf: bytes) -> Dict[str, Any]:
+    """ColumnStatistics -> {min, max, has_null, n} (min/max None when the
+    type carries no orderable stats)."""
+    st: Dict[str, Any] = {"min": None, "max": None, "has_null": None,
+                          "n": None}
+    for field, wire, v in _fields(buf):
+        if field == 1 and wire == 0:
+            st["n"] = v
+        elif field == 10 and wire == 0:
+            st["has_null"] = bool(v)
+        elif field == 2 and wire == 2:      # IntegerStatistics
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    st["min"] = _zigzag(v2)
+                elif f2 == 2 and w2 == 0:
+                    st["max"] = _zigzag(v2)
+        elif field == 3 and wire == 2:      # DoubleStatistics
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 1:
+                    st["min"] = struct.unpack("<d", v2)[0]
+                elif f2 == 2 and w2 == 1:
+                    st["max"] = struct.unpack("<d", v2)[0]
+        elif field == 4 and wire == 2:      # StringStatistics
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 2:
+                    st["min"] = v2.decode("utf-8", "replace")
+                elif f2 == 2 and w2 == 2:
+                    st["max"] = v2.decode("utf-8", "replace")
+        elif field == 7 and wire == 2:      # DateStatistics (epoch days)
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    st["min"] = _zigzag(v2)
+                elif f2 == 2 and w2 == 0:
+                    st["max"] = _zigzag(v2)
+    return st
+
+
+class OrcFileStats:
+    """Parsed tail of one ORC file: column names (root struct fields)
+    and per-stripe column stats aligned to them."""
+
+    def __init__(self, column_names: List[str],
+                 per_stripe: List[List[Dict[str, Any]]]):
+        self.column_names = column_names
+        self.per_stripe = per_stripe  # [stripe][data_column] -> stat
+
+    @property
+    def nstripes(self) -> int:
+        return len(self.per_stripe)
+
+    def stripe_column(self, stripe: int,
+                      name: str) -> Optional[Dict[str, Any]]:
+        try:
+            i = self.column_names.index(name)
+        except ValueError:
+            return None
+        row = self.per_stripe[stripe]
+        return row[i] if i < len(row) else None
+
+
+def read_stripe_stats(path: str) -> Optional[OrcFileStats]:
+    """None when the tail cannot be parsed (foreign codec, truncation,
+    not-ORC) — pruning then simply does not happen."""
+    try:
+        return _read(path)
+    except Exception:  # noqa: BLE001 - stats are an optimization only
+        return None
+
+
+def _read(path: str) -> Optional[OrcFileStats]:
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        tail_len = min(size, 1 << 20)
+        f.seek(size - tail_len)
+        tail = f.read(tail_len)
+    ps_len = tail[-1]
+    ps = tail[-1 - ps_len:-1]
+    footer_len = metadata_len = 0
+    compression = _NONE
+    for field, wire, v in _fields(ps):
+        if field == 1 and wire == 0:
+            footer_len = v
+        elif field == 2 and wire == 0:
+            compression = v
+        elif field == 5 and wire == 0:
+            metadata_len = v
+    need = 1 + ps_len + footer_len + metadata_len
+    if need > len(tail):
+        with open(path, "rb") as f:
+            f.seek(size - need)
+            tail = f.read(need)
+    footer_raw = tail[-1 - ps_len - footer_len:-1 - ps_len]
+    meta_raw = tail[-1 - ps_len - footer_len - metadata_len:
+                    -1 - ps_len - footer_len]
+    footer = _decompress(footer_raw, compression)
+    metadata = _decompress(meta_raw, compression)
+    if footer is None or metadata is None:
+        return None
+
+    # root struct's field names, in data-column order; stats index 0 is
+    # the root itself, data column i maps to stats index i+1
+    names: List[str] = []
+    first_type = True
+    for field, wire, v in _fields(footer):
+        if field == 4 and wire == 2 and first_type:
+            first_type = False
+            for f2, w2, v2 in _fields(v):
+                if f2 == 3 and w2 == 2:
+                    names.append(v2.decode("utf-8", "replace"))
+
+    per_stripe: List[List[Dict[str, Any]]] = []
+    for field, wire, v in _fields(metadata):
+        if field == 1 and wire == 2:        # StripeStatistics
+            cols = [_column_stat(v2) for f2, w2, v2 in _fields(v)
+                    if f2 == 1 and w2 == 2]
+            per_stripe.append(cols[1:len(names) + 1])  # drop root
+    if not names or not per_stripe:
+        return None
+    return OrcFileStats(names, per_stripe)

@@ -225,6 +225,17 @@ class WindowOperator(Operator):
                 # distinction), matching the cross-batch tail compare
                 v = v.copy()
                 v[~g] = "" if v.dtype == object else v.dtype.type(0)
+            if v.dtype.kind == "f":
+                # NaN != NaN would split a NaN partition into per-row
+                # partitions (and break the cross-batch tail compare);
+                # compare bit patterns with NaN canonicalized and -0.0
+                # folded into +0.0, matching the device-side segment path
+                v = v.copy()
+                v[v == 0.0] = 0.0
+                w = v.view(np.int64 if v.dtype.itemsize == 8 else np.int32)
+                w = w.copy()
+                w[np.isnan(v)] = -1
+                v = w
             vals.append((v, g))
         starts = np.zeros(n, bool)
         for v, g in vals:

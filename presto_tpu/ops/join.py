@@ -174,6 +174,10 @@ def _lower_bound(sorted_arr: jax.Array, queries: jax.Array,
         mid = (lo + hi) >> 1
         v = sorted_arr[jnp.minimum(mid, n - 1)]
         go_right = (v <= queries) if inclusive else (v < queries)
+        # Once lo==hi the interval is empty: without this guard the
+        # clamped gather rereads arr[n-1] and pushes lo past n for
+        # queries equal to the build max (one duplicate row per probe).
+        go_right = go_right & (lo < hi)
         lo = jnp.where(go_right, mid + 1, lo)
         hi = jnp.where(go_right, hi, mid)
     return lo
@@ -244,8 +248,11 @@ def _expand_probe_idx(emit: jax.Array, out_capacity: int):
     emitting = emit > 0
     erank = (jnp.cumsum(emitting.astype(jnp.int32)) - 1).astype(jnp.int32)
     # emit-rank -> probe row (rank r is the r-th emitting row)
+    # Dropped (non-emitting) writes go to distinct OOB slots n+i so the
+    # index vector is genuinely unique — a shared OOB index would break
+    # the unique_indices contract even though mode="drop" discards it.
     rows = (jnp.zeros(n, jnp.int32)
-            .at[jnp.where(emitting, erank, n)]
+            .at[jnp.where(emitting, erank, n + jnp.arange(n, dtype=jnp.int32))]
             .set(jnp.arange(n, dtype=jnp.int32), mode="drop",
                  unique_indices=True))
     # +1 at each emitting row's first output slot (disjoint ranges ->

@@ -214,7 +214,10 @@ class Fragmenter:
             n = stack.pop()
             if isinstance(n, TableScanNode):
                 scans += 1
-            elif isinstance(n, AggregationNode) and not n.group_channels:
+            elif isinstance(n, AggregationNode) and not n.group_channels \
+                    and n.step != "partial":
+                # a PARTIAL global aggregation replicates fine: each task
+                # emits one component row and the FINAL stage merges them
                 return False
             elif isinstance(n, (WindowNode, EnforceSingleRowNode,
                                 UnionNode, LimitNode)):
@@ -253,6 +256,17 @@ class Fragmenter:
 
     def _visit_aggregation(self, node: AggregationNode):
         src, consumed = self._visit(node.source)
+        if node.step != "single":
+            # already split by the logical tier (partial-agg-through-
+            # union rule): a FINAL merges wherever its input lands after
+            # a hash exchange on the keys; a PARTIAL stays in place
+            if node.step == "final" and node.group_channels:
+                fid = self._source_fragment(
+                    src, consumed, ("hash", tuple(node.group_channels)))
+                remote = RemoteSourceNode((fid,),
+                                          tuple(node.source.columns))
+                return _replace_sources(node, [remote]), [fid]
+            return _replace_sources(node, [src]), consumed
         if not self.config.partial_aggregation_enabled:
             # partial_aggregation_enabled=false: single-step aggregation
             # after a hash exchange on the group keys (or at the gather

@@ -2,8 +2,8 @@
 
 Token stream for the recursive-descent parser (the role ANTLR's generated
 lexer plays for SqlBase.g4 in the reference).  Keywords are recognized
-case-insensitively; identifiers lowercase unless double-quoted (SQL spec
-folding, matching the reference's parser behavior).
+case-insensitively; identifiers fold to lowercase, double-quoted included
+(the reference's legacy canonicalization — `"YEAR"` resolves as "year").
 """
 
 from __future__ import annotations
@@ -111,7 +111,11 @@ def tokenize(sql: str) -> List[Token]:
                 raise SqlSyntaxError("unterminated quoted identifier",
                                      start_line, start_col)
             advance(1)
-            out.append(Token("QIDENT", "".join(buf), start_line, start_col))
+            # the reference canonicalizes ALL identifiers to lowercase,
+            # quoted included (legacy Presto folding: `"YEAR"` == "year";
+            # TPC-DS q66/q74 alias "YEAR" then reference "year")
+            out.append(Token("QIDENT", "".join(buf).lower(),
+                             start_line, start_col))
             continue
         if c.isdigit() or (c == "." and sql[i + 1:i + 2].isdigit()):
             start_line, start_col = line, col

@@ -40,9 +40,20 @@ def optimize(plan: OutputNode, metadata=None, config=None) -> OutputNode:
     reaching PlanOptimizers role); None = engine defaults."""
     from presto_tpu.config import DEFAULT
 
+    from presto_tpu.sql.rules import (
+        DEFAULT_RULES, RuleContext, iterative_optimize,
+    )
+
     config = config or DEFAULT
+    ctx = RuleContext(metadata, config)
     node = push_filters_down(plan)
+    # iterative rule engine (IterativeOptimizer role) runs before join
+    # extraction (limits/filters normalize, partial aggs split through
+    # unions) and again after (projection-through-join sees the built
+    # join tree)
+    node = iterative_optimize(node, DEFAULT_RULES, ctx)
     node = _rewrite_bottom_up(node, metadata, config)
+    node = iterative_optimize(node, DEFAULT_RULES, ctx)
     node = prune_columns(node)
     return node
 
@@ -354,6 +365,73 @@ def extract_joins(filter_node: FilterNode, metadata, config=None) -> PlanNode:
         else:
             residual.append(c)
 
+    # Transitive equality inference (EqualityInference.java role):
+    # equivalence classes over the equality edges (a) replicate
+    # single-column constant predicates to every equivalent column's
+    # leaf (o_orderkey < K infers l_orderkey < K through
+    # l_orderkey = o_orderkey), and (b) derive join edges between leaf
+    # pairs connected only transitively, giving the reorderer equi-join
+    # options where it would otherwise cross-join.  Derived edges are
+    # implied by the direct ones (every class is spanned by enforced
+    # direct edges), so they never become post-join filters.
+    parent: Dict[Tuple[int, int], Tuple[int, int]] = {}
+
+    def find(x):
+        r = x
+        while parent.get(r, r) != r:
+            r = parent[r]
+        while parent.get(x, x) != x:
+            parent[x], x = r, parent[x]
+        return r
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    for la, ca, lb, cb in edges:
+        union((la, ca), (lb, cb))
+    classes: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
+    for la, ca, lb, cb in edges:
+        for m in ((la, ca), (lb, cb)):
+            classes.setdefault(find(m), [])
+            if m not in classes[find(m)]:
+                classes[find(m)].append(m)
+
+    def _constant_pred_channel(p: RowExpression) -> Optional[int]:
+        """The single input channel of a comparison-vs-constant."""
+        if not (isinstance(p, Call) and len(p.args) == 2
+                and p.name in ("eq", "ne", "lt", "le", "gt", "ge")):
+            return None
+        chans = input_channels(p)
+        if len(chans) != 1:
+            return None
+        if not any(isinstance(a, Constant) for a in p.args):
+            return None
+        return next(iter(chans))
+
+    for li in range(len(leaves)):
+        for p in list(pushed[li]):
+            ch = _constant_pred_channel(p)
+            if ch is None:
+                continue
+            for lj, cj in classes.get(find((li, ch)), ()):
+                if lj == li:
+                    continue
+                repl = remap(p, {ch: cj})
+                if not any(repl == q for q in pushed[lj]):
+                    pushed[lj].append(repl)
+    direct_pairs = {frozenset((la, lb)) for la, _, lb, _ in edges}
+    derived_from = len(edges)
+    for members in classes.values():
+        for i in range(len(members)):
+            for j in range(i + 1, len(members)):
+                (la, ca), (lb, cb) = members[i], members[j]
+                if la == lb or frozenset((la, lb)) in direct_pairs:
+                    continue
+                edges.append((la, ca, lb, cb))
+                direct_pairs.add(frozenset((la, lb)))
+
     nodes: List[PlanNode] = []
     for leaf, preds in zip(leaves, pushed):
         nodes.append(FilterNode(leaf, and_all(preds)) if preds else leaf)
@@ -451,6 +529,11 @@ def extract_joins(filter_node: FilterNode, metadata, config=None) -> PlanNode:
         for i, (la, ca, lb, cb) in enumerate(edges):
             if not used_edges[i] and la in joined and lb in joined:
                 used_edges[i] = True
+                if i >= derived_from:
+                    # transitively-derived edge: implied by the direct
+                    # edges (all enforced as keys or filters) — adding a
+                    # filter would just re-check a=c after a=b and b=c
+                    continue
                 extra_now.append(
                     B.comparison("=",
                                  _ref_at(current, chan_map[(la, ca)]),
@@ -626,6 +709,15 @@ def _prune(node: PlanNode,
                 {ch: i for i, ch in enumerate(needed)})
     if isinstance(node, AggregationNode):
         ngroups = len(node.group_channels)
+        if node.step != "single":
+            # partial/final pairs speak the positional component-column
+            # contract (keys, then each spec's components in order):
+            # pruning through would desync the layouts — keep the full
+            # source schema
+            src, m = _prune(node.source,
+                            sorted(range(len(node.source.columns))))
+            new_node = _replace_sources(node, [src])
+            return new_node, {ch: ch for ch in needed}
         # the empty-needed [0] fallback can point past a zero-column
         # aggregation (grouping-sets grand-total branch); clamp to
         # channels the node actually has

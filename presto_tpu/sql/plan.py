@@ -356,6 +356,8 @@ def format_plan(node: PlanNode, indent: int = 0) -> str:
             + ("/distinct" if a.distinct else "")
             for a in node.aggregates)
         detail = f" keys={list(node.group_channels)} [{aggs}]"
+        if node.step != "single":
+            detail += f" step={node.step}"
     elif isinstance(node, JoinNode):
         detail = (f" {node.kind} on {list(node.left_keys)}="
                   f"{list(node.right_keys)}")

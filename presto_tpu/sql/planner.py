@@ -124,6 +124,59 @@ def _identifiers(expr: t.Node):
                     yield from _identifiers(item[1])
 
 
+def _rewrite_identifiers(expr, fn):
+    """Structurally rewrite Identifier leaves via ``fn`` (not descending
+    into subqueries, whose identifiers live in their own scopes)."""
+    if isinstance(expr, t.Identifier):
+        return fn(expr)
+    if isinstance(expr, (t.InSubquery, t.Exists, t.ScalarSubquery)) \
+            or not hasattr(expr, "__dataclass_fields__"):
+        return expr
+    changes = {}
+    for f in expr.__dataclass_fields__:
+        v = getattr(expr, f)
+        if isinstance(v, t.Node):
+            nv = _rewrite_identifiers(v, fn)
+            if nv is not v:
+                changes[f] = nv
+        elif isinstance(v, tuple):
+            items = []
+            changed = False
+            for item in v:
+                if isinstance(item, t.Node):
+                    ni = _rewrite_identifiers(item, fn)
+                    changed |= ni is not item
+                    items.append(ni)
+                elif isinstance(item, tuple):
+                    ni = tuple(_rewrite_identifiers(s, fn)
+                               if isinstance(s, t.Node) else s
+                               for s in item)
+                    changed |= ni != item
+                    items.append(ni)
+                else:
+                    items.append(item)
+            if changed:
+                changes[f] = tuple(items)
+    return dataclasses.replace(expr, **changes) if changes else expr
+
+
+def _substitute_select_aliases(expr: t.Expression, q: t.Query):
+    """Replace single-part identifiers naming a select-list alias with
+    that item's expression (one shot, no re-substitution) — the
+    StatementAnalyzer ORDER-BY-scope rule that makes aliases usable
+    inside ORDER BY expressions."""
+    aliases = {item.alias: item.expr for item in q.select
+               if item.alias is not None
+               and not isinstance(item.expr, t.Star)}
+
+    def fn(ident: t.Identifier):
+        if len(ident.parts) == 1 and ident.parts[0] in aliases:
+            return aliases[ident.parts[0]]
+        return ident
+
+    return _rewrite_identifiers(expr, fn)
+
+
 def _contains_subquery(expr: t.Node) -> bool:
     if isinstance(expr, (t.InSubquery, t.Exists, t.ScalarSubquery)):
         return True
@@ -169,6 +222,52 @@ def split_conjuncts(expr: Optional[t.Expression]) -> List[t.Expression]:
     if isinstance(expr, t.LogicalBinary) and expr.op == "and":
         return split_conjuncts(expr.left) + split_conjuncts(expr.right)
     return [expr]
+
+
+def _split_disjuncts(expr: t.Expression) -> List[t.Expression]:
+    if isinstance(expr, t.LogicalBinary) and expr.op == "or":
+        return _split_disjuncts(expr.left) + _split_disjuncts(expr.right)
+    return [expr]
+
+
+def _and_asts(parts: List[t.Expression]) -> t.Expression:
+    out = parts[0]
+    for p in parts[1:]:
+        out = t.LogicalBinary("and", out, p)
+    return out
+
+
+def _or_asts(parts: List[t.Expression]) -> t.Expression:
+    out = parts[0]
+    for p in parts[1:]:
+        out = t.LogicalBinary("or", out, p)
+    return out
+
+
+def factor_common_disjunct_conjuncts(expr: t.Expression) -> t.Expression:
+    """(A AND X) OR (A AND Y) -> A AND (X OR Y): conjuncts shared (by AST
+    equality) between every disjunct hoist to the top AND level — the
+    ExtractCommonPredicatesExpressionRewriter role
+    (presto-main/.../sql/planner/iterative/rule/
+    ExtractCommonPredicatesExpressionRewriter.java).  TPC-DS q41's
+    correlation is only extractable after this factoring."""
+    disjuncts = _split_disjuncts(expr)
+    if len(disjuncts) < 2:
+        return expr
+    per = [split_conjuncts(d) for d in disjuncts]
+    common = [c for c in per[0]
+              if all(any(c == o for o in others) for others in per[1:])]
+    if not common:
+        return expr
+    rests = []
+    for conj in per:
+        rest = list(conj)
+        for c in common:
+            rest.remove(c)
+        if not rest:        # a disjunct reduced to TRUE: OR collapses
+            return _and_asts(common)
+        rests.append(_and_asts(rest))
+    return _and_asts(common + [_or_asts(rests)])
 
 
 # ---------------------------------------------------------------------------
@@ -995,7 +1094,15 @@ class Planner:
                 except SqlAnalysisError:
                     if q.distinct:
                         raise  # DISTINCT output hides source columns
-                    rex = tr.translate(item.expr)
+                    try:
+                        rex = tr.translate(item.expr)
+                    except SqlAnalysisError:
+                        # the expression may use select-list ALIASES
+                        # (TPC-DS q36/q70/q86: CASE WHEN lochierarchy=0
+                        # ...): substitute each alias with its select
+                        # expression and retry over the input scope
+                        rex = tr.translate(
+                            _substitute_select_aliases(item.expr, q))
                     hidden_exprs.append(rex)
                     ch = n_visible + len(hidden_exprs) - 1
                 keys.append((ch, item.ascending, item.nulls_first))
@@ -1523,7 +1630,12 @@ class Planner:
         corr_eq: List[Tuple[int, t.Expression]] = []
         corr_other: List[t.Expression] = []
         sub_scope_only = Scope(sub.scope.fields, None)
-        for c in split_conjuncts(q.where):
+        # factor (A AND X) OR (A AND Y) -> A AND (X OR Y) so shared
+        # correlation equalities become extractable conjuncts (q41)
+        conjuncts = [c2 for c in split_conjuncts(q.where)
+                     for c2 in split_conjuncts(
+                         factor_common_disjunct_conjuncts(c))]
+        for c in conjuncts:
             if _contains_subquery(c):
                 # nested subquery inside a correlated subquery: plan it
                 # against the sub scope
@@ -1628,8 +1740,11 @@ class Planner:
             single = EnforceSingleRowNode(probe.node)
             cols = rel.node.columns + probe.node.columns
             joined = JoinNode("cross", rel.node, single, (), (), cols)
+            # "$"-prefixed hidden names: no SQL identifier can spell them,
+            # so an attached value named like an outer column (q58's
+            # d_week_seq) can never make name resolution ambiguous
             scope = Scope(rel.scope.fields
-                          + [Field(f.name, "$subquery", f.type)
+                          + [Field(f"${f.name}", "$subquery", f.type)
                              for f in probe.scope.fields],
                           rel.scope.parent)
             return (RelationPlan(joined, scope),
@@ -1658,7 +1773,7 @@ class Planner:
         joined = JoinNode(join_kind, src.node, val_proj,
                           tuple(outer_keys), tuple(range(n_keys)), cols)
         jscope = Scope(src.scope.fields
-                       + [Field(n, "$subquery", ty)
+                       + [Field(f"${n}", "$subquery", ty)
                           for n, ty in val_proj.columns],
                        src.scope.parent)
         val: RowExpression = B.ref(nleft + n_keys, value_type)

@@ -231,6 +231,23 @@ def test_multi_key_canonical_ids():
     assert got == sorted(reference_inner_join(bk, pk))
 
 
+def test_search_path_probe_key_equals_build_max():
+    """Wide key span forces the binary-search fallback; probe keys equal
+    to the build-side max must emit exactly one row each (regression:
+    _lower_bound without the lo<hi guard overshot to n+1 and
+    duplicated every max-key match)."""
+    span = 40_000  # > dense scratch minimum (1 << 14)
+    bkeys = [0, 7, 7, span]
+    pkeys = [span, span, 7, -3]
+    bids, pids, sb, perm_b, lo, counts = run_join(bkeys, pkeys)
+    assert np.asarray(counts).tolist() == [1, 1, 2, 0]
+    probe_idx, build_idx, valid, _, total = J.expand_matches(
+        lo, counts, perm_b, 16)
+    got = sorted((int(p), int(b)) for p, b, ok in
+                 zip(probe_idx, build_idx, valid) if ok)
+    assert got == sorted(reference_inner_join(bkeys, pkeys))
+
+
 def test_matched_build_mask():
     bkeys = [1, 2, 2, 9]
     pkeys = [2, 7]

@@ -198,6 +198,36 @@ class TestSpilledQueries:
             mem_runner.execute(sql).rows
 
 
+class TestPartitionStarts:
+    def test_nan_partition_keys_form_one_partition(self, tmp_path):
+        """NaN != NaN must not split a NaN partition into per-row
+        partitions on the chunked (host) path; cross-batch tails with
+        NaN keys must also compare equal (ADVICE r4)."""
+        import dataclasses as dc
+        import math
+
+        from presto_tpu import types as T
+        from presto_tpu.batch import batch_from_pylist
+        from presto_tpu.exec.context import (
+            OperatorContext, QueryContext, TaskContext,
+        )
+        from presto_tpu.exec.windowop import WindowOperator
+
+        cfg = dc.replace(DEFAULT, spill_path=str(tmp_path))
+        ctx = OperatorContext(TaskContext(QueryContext(cfg)), "win")
+        op = WindowOperator(ctx, [0], [], [])
+        nan = math.nan
+        b1 = batch_from_pylist([T.DOUBLE],
+                               [(1.0,), (nan,), (nan,), (-0.0,)])
+        starts, tail = op._partition_starts(b1, None)
+        # rows: 1.0 | nan nan | -0.0  -> starts at 0, 1, 3
+        assert starts.tolist() == [True, True, False, True]
+        b2 = batch_from_pylist([T.DOUBLE], [(0.0,), (nan,), (nan,)])
+        starts2, _ = op._partition_starts(b2, tail)
+        # -0.0 tail == +0.0 head (SQL equality); nan run starts once
+        assert starts2.tolist() == [False, True, False]
+
+
 @pytest.mark.slow
 class TestWindowSpill:
     """WindowOperator as a spill consumer (SURVEY §2.9, VERDICT r3 #8):

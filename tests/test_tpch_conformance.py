@@ -223,6 +223,13 @@ def to_sqlite_sql(sql: str) -> str:
     sql = _strip_union_parens(sql)
     # DECIMAL '1.2' typed literals -> plain numeric literal
     sql = re.sub(r"(?i)\bdecimal\s+'(-?[0-9.]+)'", r"\1", sql)
+    # CAST(x AS DECIMAL(p, s)) -> CAST(x AS REAL): sqlite NUMERIC
+    # affinity keeps integers integral, so q75's
+    # cast(cnt as decimal)/cast(cnt as decimal) would integer-divide
+    # (61/62 = 0) and wrongly pass the < 0.9 filter the engine's real
+    # decimal division correctly rejects
+    sql = re.sub(r"(?i)\bas\s+decimal\s*\(\s*\d+\s*(?:,\s*\d+\s*)?\)",
+                 "as real", sql)
     sql = _DATE_ARITH.sub(
         lambda m: "'" + _shift_date(m.group(1), m.group(2),
                                     int(m.group(3)), m.group(4)) + "'",

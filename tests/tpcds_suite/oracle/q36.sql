@@ -1,0 +1,37 @@
+-- sqlite-oracle variant of q36: GROUP BY ROLLUP(i_category, i_class)
+-- expanded into a UNION ALL of its three grouping levels, with
+-- GROUPING(...) replaced by per-level constants (sqlite has neither
+-- ROLLUP nor GROUPING); semantics otherwise identical to q36.sql
+WITH lvl AS (
+   SELECT sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin,
+          i_category, i_class, 0 lochierarchy, 0 g_class
+   FROM store_sales, date_dim d1, item, store
+   WHERE d1.d_year = 2001 AND d1.d_date_sk = ss_sold_date_sk
+     AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+     AND s_state IN ('TN', 'TN', 'TN', 'TN', 'TN', 'TN', 'TN', 'TN')
+   GROUP BY i_category, i_class
+   UNION ALL
+   SELECT sum(ss_net_profit) / sum(ss_ext_sales_price),
+          i_category, NULL, 1, 1
+   FROM store_sales, date_dim d1, item, store
+   WHERE d1.d_year = 2001 AND d1.d_date_sk = ss_sold_date_sk
+     AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+     AND s_state IN ('TN', 'TN', 'TN', 'TN', 'TN', 'TN', 'TN', 'TN')
+   GROUP BY i_category
+   UNION ALL
+   SELECT sum(ss_net_profit) / sum(ss_ext_sales_price),
+          NULL, NULL, 2, 1
+   FROM store_sales, date_dim d1, item, store
+   WHERE d1.d_year = 2001 AND d1.d_date_sk = ss_sold_date_sk
+     AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+     AND s_state IN ('TN', 'TN', 'TN', 'TN', 'TN', 'TN', 'TN', 'TN')
+)
+SELECT gross_margin, i_category, i_class, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                    CASE WHEN g_class = 0 THEN i_category END
+                    ORDER BY gross_margin ASC) rank_within_parent
+FROM lvl
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN i_category END ASC,
+         rank_within_parent ASC, i_category, i_class
+LIMIT 100

@@ -1,0 +1,49 @@
+-- sqlite-oracle variant of q70: ROLLUP(s_state, s_county) expanded to a
+-- UNION ALL of grouping levels with GROUPING() as per-level constants
+WITH top_states AS (
+   SELECT s_state
+   FROM (
+      SELECT s_state s_state,
+             rank() OVER (PARTITION BY s_state
+                          ORDER BY sum(ss_net_profit) DESC) ranking
+      FROM store_sales, store, date_dim
+      WHERE d_month_seq BETWEEN 1200 AND (1200 + 11)
+        AND d_date_sk = ss_sold_date_sk
+        AND s_store_sk = ss_store_sk
+      GROUP BY s_state
+   ) tmp1
+   WHERE ranking <= 5
+), lvl AS (
+   SELECT sum(ss_net_profit) total_sum, s_state, s_county,
+          0 lochierarchy, 0 g_county
+   FROM store_sales, date_dim d1, store
+   WHERE d1.d_month_seq BETWEEN 1200 AND (1200 + 11)
+     AND d1.d_date_sk = ss_sold_date_sk
+     AND s_store_sk = ss_store_sk
+     AND s_state IN (SELECT s_state FROM top_states)
+   GROUP BY s_state, s_county
+   UNION ALL
+   SELECT sum(ss_net_profit), s_state, NULL, 1, 1
+   FROM store_sales, date_dim d1, store
+   WHERE d1.d_month_seq BETWEEN 1200 AND (1200 + 11)
+     AND d1.d_date_sk = ss_sold_date_sk
+     AND s_store_sk = ss_store_sk
+     AND s_state IN (SELECT s_state FROM top_states)
+   GROUP BY s_state
+   UNION ALL
+   SELECT sum(ss_net_profit), NULL, NULL, 2, 1
+   FROM store_sales, date_dim d1, store
+   WHERE d1.d_month_seq BETWEEN 1200 AND (1200 + 11)
+     AND d1.d_date_sk = ss_sold_date_sk
+     AND s_store_sk = ss_store_sk
+     AND s_state IN (SELECT s_state FROM top_states)
+)
+SELECT total_sum, s_state, s_county, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                    CASE WHEN g_county = 0 THEN s_state END
+                    ORDER BY total_sum DESC) rank_within_parent
+FROM lvl
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN s_state END ASC,
+         rank_within_parent ASC
+LIMIT 100

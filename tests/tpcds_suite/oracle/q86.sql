@@ -1,0 +1,33 @@
+-- sqlite-oracle variant of q86: ROLLUP(i_category, i_class) expanded to
+-- a UNION ALL of grouping levels with GROUPING() as per-level constants
+WITH lvl AS (
+   SELECT sum(ws_net_paid) total_sum, i_category, i_class,
+          0 lochierarchy, 0 g_class
+   FROM web_sales, date_dim d1, item
+   WHERE d1.d_month_seq BETWEEN 1200 AND (1200 + 11)
+     AND d1.d_date_sk = ws_sold_date_sk
+     AND i_item_sk = ws_item_sk
+   GROUP BY i_category, i_class
+   UNION ALL
+   SELECT sum(ws_net_paid), i_category, NULL, 1, 1
+   FROM web_sales, date_dim d1, item
+   WHERE d1.d_month_seq BETWEEN 1200 AND (1200 + 11)
+     AND d1.d_date_sk = ws_sold_date_sk
+     AND i_item_sk = ws_item_sk
+   GROUP BY i_category
+   UNION ALL
+   SELECT sum(ws_net_paid), NULL, NULL, 2, 1
+   FROM web_sales, date_dim d1, item
+   WHERE d1.d_month_seq BETWEEN 1200 AND (1200 + 11)
+     AND d1.d_date_sk = ws_sold_date_sk
+     AND i_item_sk = ws_item_sk
+)
+SELECT total_sum, i_category, i_class, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                    CASE WHEN g_class = 0 THEN i_category END
+                    ORDER BY total_sum DESC) rank_within_parent
+FROM lvl
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN i_category END ASC,
+         rank_within_parent ASC
+LIMIT 100
