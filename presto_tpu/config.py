@@ -22,6 +22,35 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+# --- jax version compatibility ------------------------------------------
+# The engine is written against the modern top-level `jax.shard_map` /
+# `jax.enable_x64` surface; older jaxlib builds ship both under
+# jax.experimental (with `check_rep` instead of `check_vma`).  config is
+# the first engine module imported (package __init__), so aliasing here
+# keeps every call site on the one spelling.
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)
+
+    jax.shard_map = _compat_shard_map
+if not hasattr(jax, "enable_x64"):
+    from jax.experimental import disable_x64 as _disable_x64
+    from jax.experimental import enable_x64 as _enable_x64
+
+    jax.enable_x64 = (
+        lambda enabled=True: _enable_x64() if enabled else _disable_x64())
+if not hasattr(jax.lax, "axis_size"):
+    def _axis_size(axis_name):
+        from jax._src.core import get_axis_env
+
+        return get_axis_env().axis_size(axis_name)
+
+    jax.lax.axis_size = _axis_size
+
 # Persistent XLA compile cache: TPU sort lowering costs compile time
 # proportional to the sort LENGTH (measured ~0.4 ms/row on v5e for a
 # 2-key lexsort), so large-shape query programs are expensive to build —
@@ -155,6 +184,16 @@ class EngineConfig:
     # automatic = cost-based join reordering; none = keep syntactic order
     # (ReorderJoins / join_reordering_strategy role)
     join_reordering_strategy: str = "automatic"
+    # Memo-based cost exploration (sql/memo.py — the Cascades-style
+    # Memo/ReorderJoins/DetermineJoinDistribution tier): ON explores join
+    # orders and exchange placement by cost; it falls back to the greedy
+    # orderer per join graph when leaf stats are unavailable or the graph
+    # exceeds memo_max_reorder_relations.  OFF restores the pre-memo
+    # greedy path exactly.
+    optimizer_use_memo: bool = True
+    # largest join graph the memo enumerates exhaustively (the reference's
+    # max_reorder_joins, ReorderJoins.java getMaxReorderedJoins; 9 there)
+    memo_max_reorder_relations: int = 9
     # split grouped aggregation into partial (producer fragment) + final;
     # off = aggregate once at the consumer (push_partial_aggregation role)
     partial_aggregation_enabled: bool = True

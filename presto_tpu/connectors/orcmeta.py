@@ -144,6 +144,11 @@ class OrcFileStats:
 
     def stripe_column(self, stripe: int,
                       name: str) -> Optional[Dict[str, Any]]:
+        if not 0 <= stripe < len(self.per_stripe):
+            # stats pruning is strictly best-effort: a split enumerating
+            # more stripes than the metadata covers must not fail the
+            # query on an out-of-range index
+            return None
         try:
             i = self.column_names.index(name)
         except ValueError:
@@ -193,15 +198,25 @@ def _read(path: str) -> Optional[OrcFileStats]:
         return None
 
     # root struct's field names, in data-column order; stats index 0 is
-    # the root itself, data column i maps to stats index i+1
+    # the root itself, data column i maps to stats index i+1.  That flat
+    # mapping holds ONLY when every root field is primitive: a nested
+    # field (struct/list/map/union) owns additional Type entries whose
+    # stats slots interleave, so the i+1 indexing would read the wrong
+    # column's min/max.  Count the footer's Type entries and refuse the
+    # mapping unless the tree is exactly root + one type per field.
     names: List[str] = []
+    n_types = 0
     first_type = True
     for field, wire, v in _fields(footer):
-        if field == 4 and wire == 2 and first_type:
-            first_type = False
-            for f2, w2, v2 in _fields(v):
-                if f2 == 3 and w2 == 2:
-                    names.append(v2.decode("utf-8", "replace"))
+        if field == 4 and wire == 2:
+            n_types += 1
+            if first_type:
+                first_type = False
+                for f2, w2, v2 in _fields(v):
+                    if f2 == 3 and w2 == 2:
+                        names.append(v2.decode("utf-8", "replace"))
+    if n_types != len(names) + 1:
+        return None     # nested schema: no safe flat stats mapping
 
     per_stripe: List[List[Dict[str, Any]]] = []
     for field, wire, v in _fields(metadata):

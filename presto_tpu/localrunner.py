@@ -567,9 +567,18 @@ class LocalQueryRunner:
     def explain_text(self, stmt: t.Node) -> str:
         if not isinstance(stmt, (t.Query, t.SetOperation)):
             raise ValueError("EXPLAIN requires a query")
+        cfg = self.session.effective_config(self.config)
         logical = Planner(self.metadata).plan(stmt)
-        optimized = optimize(logical, self.metadata)
-        return format_plan(optimized)
+        optimized = optimize(logical, self.metadata, cfg)
+        # surface the optimizer's estimates alongside the plan (the
+        # PlanPrinter stats/cost annotation role); rows/cost render only
+        # where the stats derivation produced estimates
+        annotator = None
+        if cfg.optimizer_use_memo:
+            from presto_tpu.sql.memo import cost_annotator
+
+            annotator = cost_annotator(self.metadata, cfg)
+        return format_plan(optimized, annotator=annotator)
 
     def _validate(self, stmt: t.Node) -> None:
         """EXPLAIN (TYPE VALIDATE): analyze/plan without executing.

@@ -329,12 +329,18 @@ class Fragmenter:
         left, lc = self._visit(node.left)
         right, rc = self._visit(node.right)
 
-        # join_distribution_type session property: force a distribution,
-        # or let the estimate decide (DetermineJoinDistributionType role)
+        # join_distribution_type session property forces a distribution;
+        # otherwise a memo-annotated join (DetermineJoinDistribution,
+        # sql/memo.py) carries its cost-chosen placement; otherwise the
+        # stats threshold decides (DetermineJoinDistributionType role)
         dist = self.config.join_distribution_type
-        broadcast = (dist == "broadcast" if dist != "automatic"
-                     else self._estimate_rows(node.right)
-                     <= self.broadcast_row_limit)
+        if dist != "automatic":
+            broadcast = dist == "broadcast"
+        elif node.distribution is not None:
+            broadcast = node.distribution == "replicated"
+        else:
+            broadcast = (self._estimate_rows(node.right)
+                         <= self.broadcast_row_limit)
         if broadcast:
             # P2: broadcast the small build side into every probe task;
             # probe stays in ITS OWN fragment (no exchange for probe rows)

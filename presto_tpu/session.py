@@ -70,6 +70,9 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "broadcast_join_row_limit": ("broadcast_join_row_limit", int),
     "join_reordering_strategy": ("join_reordering_strategy", _enum_parser(
         "join_reordering_strategy", ("automatic", "none"))),
+    "optimizer_use_memo": ("optimizer_use_memo",
+                           lambda v: v.lower() in ("true", "1", "on")),
+    "memo_max_reorder_relations": ("memo_max_reorder_relations", int),
     "partial_aggregation_enabled": (
         "partial_aggregation_enabled",
         lambda v: v.lower() in ("true", "1", "on")),

@@ -4,12 +4,14 @@ The reference runs ~40 ordered passes (PlanOptimizers.java:160) with 113
 iterative rules; this module implements the subset that changes the game
 for the executable query shapes, in the same spirit:
 
-- ``extract_joins``: Filter-over-cross-join -> equi-join tree with pushed
-  single-relation predicates and residual placement (the PredicatePushDown
-  + join-graph part of the reference's AddExchanges preparation).  Join
-  order is greedy over the connectivity graph, probe side = largest
-  estimated relation (the DetermineJoinDistributionType/ReorderJoins
-  stand-in until a real CBO lands).
+- ``build_join_graph`` + ``extract_joins``: Filter-over-cross-join ->
+  equi-join tree with pushed single-relation predicates and residual
+  placement (the PredicatePushDown + join-graph part of the reference's
+  AddExchanges preparation).  With ``optimizer_use_memo`` on (default)
+  the graph feeds the Memo-based ReorderJoins/DetermineJoinDistribution
+  exploration in sql/memo.py; this module's greedy orderer (left-deep,
+  probe side = largest estimated relation) is the fallback when stats
+  are absent or the graph exceeds the enumeration bound.
 - ``prune_columns``: unreferenced-output elimination down to the scans
   (PruneUnreferencedOutputs + pushdown-into-scan).
 - ``rewrite_distinct_aggregates``: count(DISTINCT x) -> two-level
@@ -188,8 +190,15 @@ def _rewrite_bottom_up(node: PlanNode, metadata, config=None) -> PlanNode:
         tree = _cross_chain(leaves)
         conjs = conjs + extra
         if conjs:
-            return extract_joins(FilterNode(tree, and_all(conjs)),
-                                 metadata, config)
+            fnode = FilterNode(tree, and_all(conjs))
+            if (config is not None and config.optimizer_use_memo
+                    and config.join_reordering_strategy != "none"):
+                from presto_tpu.sql.memo import try_memo_extract_joins
+
+                out = try_memo_extract_joins(fnode, metadata, config)
+                if out is not None:   # None: stats absent / graph too big
+                    return out
+            return extract_joins(fnode, metadata, config)
         return tree
 
     node = _replace_sources(
@@ -326,15 +335,39 @@ def factor_or_conjuncts(expr: RowExpression) -> List[RowExpression]:
     return out
 
 
-def extract_joins(filter_node: FilterNode, metadata, config=None) -> PlanNode:
-    """Filter(cross-join tree) -> pushed filters + left-deep equi joins."""
+@dataclasses.dataclass
+class JoinGraph:
+    """The join graph shared by the greedy orderer and the memo-based
+    ``ReorderJoins`` exploration (sql/memo.py): filtered leaves, equality
+    edges (direct first, then transitively-derived), and the residual
+    conjuncts that could not push or become keys.  Channels in
+    ``residual`` are in the ORIGINAL concatenated leaf space."""
+
+    nodes: List[PlanNode]                      # leaves w/ pushed filters
+    offsets: List[int]                         # original channel offsets
+    edges: List[Tuple[int, int, int, int]]     # (leaf_a, ch_a, leaf_b, ch_b)
+    derived_from: int                          # edges[:derived_from] direct
+    residual: List[RowExpression]
+    columns: Tuple                             # original concat columns
+
+    def leaf_of(self, ch: int) -> int:
+        for i in range(len(self.nodes) - 1, -1, -1):
+            if ch >= self.offsets[i]:
+                return i
+        raise AssertionError
+
+
+def build_join_graph(filter_node: FilterNode) -> JoinGraph:
+    """Filter(cross-join tree) -> JoinGraph: push single-leaf conjuncts
+    onto their leaves, classify two-leaf equalities as edges, run the
+    transitive equality inference (EqualityInference.java role), and
+    keep the rest as residual conjuncts."""
     leaves = _cross_leaves(filter_node.source)
     offsets = []
     off = 0
     for leaf in leaves:
         offsets.append(off)
         off += len(leaf.columns)
-    total = off
 
     def leaf_of(ch: int) -> int:
         for i in range(len(leaves) - 1, -1, -1):
@@ -435,6 +468,20 @@ def extract_joins(filter_node: FilterNode, metadata, config=None) -> PlanNode:
     nodes: List[PlanNode] = []
     for leaf, preds in zip(leaves, pushed):
         nodes.append(FilterNode(leaf, and_all(preds)) if preds else leaf)
+
+    return JoinGraph(nodes, offsets, edges, derived_from, residual,
+                     tuple(col for leaf in leaves for col in leaf.columns))
+
+
+def extract_joins(filter_node: FilterNode, metadata, config=None) -> PlanNode:
+    """Filter(cross-join tree) -> pushed filters + left-deep equi joins."""
+    graph = build_join_graph(filter_node)
+    nodes = graph.nodes
+    offsets = graph.offsets
+    edges = graph.edges
+    derived_from = graph.derived_from
+    residual = graph.residual
+    leaf_of = graph.leaf_of
 
     # greedy left-deep order: start at the largest relation (probe side);
     # at each step join the connected relation whose join yields the
@@ -554,14 +601,19 @@ def extract_joins(filter_node: FilterNode, metadata, config=None) -> PlanNode:
         if ready:
             current = FilterNode(current, and_all(ready))
 
-    # restore original channel order for the parent
+    return restore_leaf_order(graph, current, chan_map)
+
+
+def restore_leaf_order(graph: JoinGraph, current: PlanNode,
+                       chan_map: Dict[Tuple[int, int], int]) -> PlanNode:
+    """Project the ordered join tree back to the original concatenated
+    leaf channel order for the parent (shared greedy/memo epilogue)."""
     out_exprs = []
-    for li, leaf in enumerate(leaves):
+    for li, leaf in enumerate(graph.nodes):
         for j in range(len(leaf.columns)):
             ch = chan_map[(li, j)]
             out_exprs.append(InputRef(ch, current.columns[ch][1]))
-    orig_cols = tuple(col for leaf in leaves for col in leaf.columns)
-    return ProjectNode(current, tuple(out_exprs), orig_cols)
+    return ProjectNode(current, tuple(out_exprs), graph.columns)
 
 
 def _ref_at(node: PlanNode, ch: int) -> InputRef:
@@ -767,7 +819,7 @@ def _prune(node: PlanNode,
         new_node = JoinNode(node.kind, lsrc, rsrc,
                             tuple(lm[c] for c in node.left_keys),
                             tuple(rm[c] for c in node.right_keys),
-                            cols, residual)
+                            cols, residual, node.distribution)
         return new_node, {ch: mapping[ch] for ch in needed}
     if isinstance(node, SemiJoinNode):
         nsrc = len(node.source.columns)

@@ -116,6 +116,11 @@ class JoinNode(PlanNode):
     right_keys: Tuple[int, ...]
     columns: Tuple[Column, ...]
     residual: Optional[RowExpression] = None
+    # cost-chosen exchange placement (JoinNode.DistributionType role):
+    # 'replicated' broadcasts the build side, 'partitioned' co-hash-
+    # partitions both sides; None = let the fragmenter's stats threshold
+    # decide (pre-CBO behavior)
+    distribution: Optional[str] = None
 
     @property
     def sources(self):  # type: ignore[override]
@@ -339,8 +344,10 @@ class OutputNode(PlanNode):
         return (self.source,)
 
 
-def format_plan(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN-style text rendering (planPrinter role)."""
+def format_plan(node: PlanNode, indent: int = 0, annotator=None) -> str:
+    """EXPLAIN-style text rendering (planPrinter role).  ``annotator``
+    (node -> str) appends per-node text — the EXPLAIN cost/stats surface
+    (sql/memo.py cost_annotator)."""
     pad = "  " * indent
     name = type(node).__name__.replace("Node", "")
     detail = ""
@@ -361,6 +368,8 @@ def format_plan(node: PlanNode, indent: int = 0) -> str:
     elif isinstance(node, JoinNode):
         detail = (f" {node.kind} on {list(node.left_keys)}="
                   f"{list(node.right_keys)}")
+        if node.distribution is not None:
+            detail += f" dist={node.distribution}"
         if node.residual is not None:
             detail += f" residual=[{node.residual}]"
     elif isinstance(node, SemiJoinNode):
@@ -373,7 +382,10 @@ def format_plan(node: PlanNode, indent: int = 0) -> str:
         detail = f" {node.count}"
     elif isinstance(node, (TableWriterNode, TableFinishNode)):
         detail = f" {node.catalog}.{node.table}"
-    out = f"{pad}{name}{detail}  => {[n for n, _ in node.columns]}\n"
+    out = f"{pad}{name}{detail}  => {[n for n, _ in node.columns]}"
+    if annotator is not None:
+        out += annotator(node)
+    out += "\n"
     for s in node.sources:
-        out += format_plan(s, indent + 1)
+        out += format_plan(s, indent + 1, annotator)
     return out

@@ -255,15 +255,21 @@ def factor_common_disjunct_conjuncts(expr: t.Expression) -> t.Expression:
     if len(disjuncts) < 2:
         return expr
     per = [split_conjuncts(d) for d in disjuncts]
-    common = [c for c in per[0]
-              if all(any(c == o for o in others) for others in per[1:])]
+    # dedupe: (A AND A AND X) repeats A in per[0]; keeping both would
+    # double-remove below (historically a ValueError on rest.remove)
+    common: List[t.Expression] = []
+    for c in per[0]:
+        if any(c == seen for seen in common):
+            continue
+        if all(any(c == o for o in others) for others in per[1:]):
+            common.append(c)
     if not common:
         return expr
     rests = []
     for conj in per:
-        rest = list(conj)
-        for c in common:
-            rest.remove(c)
+        # drop EVERY occurrence of each common conjunct (A AND A == A)
+        rest = [c for c in conj
+                if not any(c == h for h in common)]
         if not rest:        # a disjunct reduced to TRUE: OR collapses
             return _and_asts(common)
         rests.append(_and_asts(rest))

@@ -229,7 +229,8 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "right_keys": list(n.right_keys),
                 "columns": _cols(n.columns),
                 "residual": None if n.residual is None
-                else expr_to_json(n.residual)}
+                else expr_to_json(n.residual),
+                "distribution": n.distribution}
     if isinstance(n, TableWriterNode):
         return {"k": "tablewriter", "source": node_to_json(n.source),
                 "catalog": n.catalog, "table": n.table,
@@ -310,7 +311,8 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
                         node_from_json(d["right"]), tuple(d["left_keys"]),
                         tuple(d["right_keys"]), _uncols(d["columns"]),
                         None if d.get("residual") is None
-                        else expr_from_json(d["residual"]))
+                        else expr_from_json(d["residual"]),
+                        d.get("distribution"))
     if k == "tablewriter":
         return TableWriterNode(node_from_json(d["source"]), d["catalog"],
                                d["table"], d["write_id"],

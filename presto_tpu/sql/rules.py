@@ -6,11 +6,11 @@ Memo.java, iterative/rule/).  This module plays that role for the
 immutable-dataclass plan tree: each Rule pattern-matches one node and
 returns a replacement (or None), and ``iterative_optimize`` applies the
 rule set bottom-up to fixpoint with an explicit rewrite budget (the
-IterativeOptimizer timeout analogue).  A Memo with group sharing buys
-the reference dedup across alternatives it must track for cost-based
-exploration; this engine rewrites destructively-by-construction (each
-rule fires only when it improves the plan), so plain structural
-fixpointing reaches the same fixed plans without the group machinery.
+IterativeOptimizer timeout analogue).  This destructive fixpointing
+serves the always-good rules below (each fires only when it improves
+the plan); decisions that need to HOLD alternatives — join order,
+exchange placement — run the same Rule protocol non-destructively over
+Memo groups in sql/memo.py, where cost extraction picks the winner.
 
 Rules implemented (reference analogues cited per class):
 - MergeFilters, MergeLimits

@@ -17,15 +17,34 @@ import os
 # driver benchmarks on real chips separately.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+def _flag_supported(flag: str) -> bool:
+    """XLA hard-aborts the process on unknown XLA_FLAGS entries
+    (parse_flags_from_env.cc "Unknown flags"), so an optional flag the
+    installed jaxlib predates/dropped must be probed in a throwaway
+    subprocess before it poisons every backend init in the suite."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, XLA_FLAGS=flag, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, capture_output=True, timeout=300)
+    except Exception:  # noqa: BLE001 - treat probe failure as unsupported
+        return False
+    return proc.returncode == 0
+
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
-if "collective_call_terminate_timeout" not in _flags:
+_collective = "--xla_cpu_collective_call_terminate_timeout_seconds=1200"
+if "collective_call_terminate_timeout" not in _flags \
+        and _flag_supported(_collective):
     # single-core hosts run the 8 virtual devices' shards sequentially;
     # XLA's default 40s collective-rendezvous abort is too eager for
     # the larger mesh-SQL programs (the wait is progress, not deadlock)
-    _flags = (_flags
-              + " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+    _flags = (_flags + " " + _collective)
 os.environ["XLA_FLAGS"] = _flags
 
 # The sitecustomize hook may already have switched jax_platforms to the

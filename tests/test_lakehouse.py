@@ -252,3 +252,47 @@ def test_orc_stripe_stats_pruning(tmp_path):
     got = runner.execute(
         "SELECT count(*), sum(v) FROM lake3.st WHERE k < 500").rows
     assert got == [(500, float(sum(range(500))))]
+
+
+def test_orc_nested_schema_refuses_flat_stats_mapping(tmp_path):
+    """A nested root field owns extra Type entries, so the flat
+    'data column i <-> stats index i+1' mapping would read the WRONG
+    column's min/max (e.g. column after a struct reads the struct's
+    first child).  The parser must refuse (None -> no pruning) unless
+    every root field is primitive (ADVICE r5)."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.orc as po
+
+    from presto_tpu.connectors.orcmeta import read_stripe_stats
+
+    nested = os.path.join(str(tmp_path), "nested.orc")
+    table = pa.table({
+        "a": pa.array(range(100), pa.int64()),
+        "st": pa.array([{"x": i, "y": float(i)} for i in range(100)],
+                       pa.struct([("x", pa.int64()),
+                                  ("y", pa.float64())])),
+        "b": pa.array(range(1000, 1100), pa.int64())})
+    po.write_table(table, nested, compression="zlib")
+    assert read_stripe_stats(nested) is None
+    # an all-primitive file keeps parsing
+    flat = os.path.join(str(tmp_path), "flat.orc")
+    po.write_table(pa.table({"a": pa.array(range(100), pa.int64())}),
+                   flat, compression="zlib")
+    st = read_stripe_stats(flat)
+    assert st is not None
+    assert st.stripe_column(0, "a")["min"] == 0
+
+
+def test_orc_stripe_index_bound_checked():
+    """A split enumerating more stripes than the parsed metadata covers
+    must degrade to no-pruning (None), never an IndexError."""
+    from presto_tpu.connectors.orcmeta import OrcFileStats
+
+    st = OrcFileStats(["a"], [[{"min": 0, "max": 9, "has_null": False,
+                                "n": 10}]])
+    assert st.stripe_column(0, "a")["max"] == 9
+    assert st.stripe_column(1, "a") is None
+    assert st.stripe_column(-1, "a") is None
+    assert st.stripe_column(0, "missing") is None

@@ -162,6 +162,96 @@ class TestLimitAndProjectionDecisions:
         assert len(re.findall(r"Fragment \d+ \[single\]", text)) == 1, text
 
 
+class TestMemoDecisions:
+    """Memo/CBO pins (sql/memo.py): the q72-class multi-join where
+    bounded bushy enumeration beats the greedy left-deep orderer, the
+    cost-chosen distribution annotation, and the memo-off restore."""
+
+    Q72_CLASS = """select count(*)
+                   from lineitem, orders, customer, supplier, nation
+                   where l_orderkey = o_orderkey
+                     and o_custkey = c_custkey
+                     and c_nationkey = n_nationkey
+                     and l_suppkey = s_suppkey
+                     and n_name = 'CHINA'"""
+
+    @staticmethod
+    def _joins(plan):
+        from presto_tpu.sql.plan import JoinNode
+
+        out = []
+
+        def walk(n):
+            if isinstance(n, JoinNode):
+                out.append(n)
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
+        return out
+
+    def _optimized(self, runner, **cfg_over):
+        import dataclasses as dc
+
+        cfg = dc.replace(runner.session.effective_config(runner.config),
+                         **cfg_over)
+        return optimize(Planner(runner.metadata).plan(
+            parse_statement(self.Q72_CLASS)), runner.metadata, cfg)
+
+    def test_memo_picks_bushy_build_side(self, runner):
+        """Memo pin: the dimension chain orders->customer->nation builds
+        as its OWN join subtree (bushy) — the right (build) child of some
+        join is itself a join, a shape the greedy left-deep orderer can
+        never produce."""
+        from presto_tpu.sql.plan import JoinNode
+
+        plan = self._optimized(runner)
+        joins = self._joins(plan)
+        assert any(isinstance(j.right, JoinNode) for j in joins), \
+            format_plan(plan)
+        # lineitem still anchors the probe side (largest relation)
+        scans = re.findall(r"TableScan tpch\.(\w+)", format_plan(plan))
+        assert scans[0] == "lineitem", scans
+
+    def test_memo_annotates_cost_chosen_distribution(self, runner):
+        """Every keyed join in the memo plan carries its cost-chosen
+        distribution; small builds replicate."""
+        joins = self._joins(self._optimized(runner))
+        assert joins and all(j.distribution is not None for j in joins)
+        assert any(j.distribution == "replicated" for j in joins)
+
+    def test_memo_off_restores_left_deep_greedy(self, runner):
+        """optimizer_use_memo=false restores the greedy plans exactly:
+        strictly left-deep (no join ever builds against a join subtree),
+        no distribution annotations."""
+        from presto_tpu.sql.plan import JoinNode
+
+        plan = self._optimized(runner, optimizer_use_memo=False)
+        joins = self._joins(plan)
+        assert joins and all(not isinstance(j.right, JoinNode)
+                             for j in joins), format_plan(plan)
+        assert all(j.distribution is None for j in joins)
+        scans = re.findall(r"TableScan tpch\.(\w+)", format_plan(plan))
+        assert scans[0] == "lineitem", scans
+
+    def test_memo_and_greedy_value_parity(self, runner):
+        on = runner.execute(self.Q72_CLASS).rows
+        runner.execute("set session optimizer_use_memo = false")
+        off = runner.execute(self.Q72_CLASS).rows
+        runner.execute("reset session optimizer_use_memo")
+        assert on == off
+
+    def test_memo_distribution_respects_broadcast_cap(self, runner):
+        """Tightening the broadcast cap flips the memo's choice to
+        PARTITIONED and the fragmenter emits hash exchanges."""
+        sql = """select count(*) from orders join lineitem
+                 on o_orderkey = l_orderkey where o_custkey > 100"""
+        text = distributed(runner, sql, broadcast_join_row_limit=100)
+        assert re.search(r"output hash\[\d", text), text
+        text = distributed(runner, sql)
+        assert "dist=replicated" in text or "broadcast" in text, text
+
+
 class TestWriterDecisions:
     def test_scaled_writer_fragment(self, runner):
         """INSERT plans a 'scaled' writer fragment sized by estimated

@@ -87,6 +87,51 @@ def test_order_by_ordinal_and_alias(metadata):
     assert "Sort" in text
 
 
+class TestFactorCommonDisjunctConjuncts:
+    """(A AND A AND X) OR (A AND Y) regression (ADVICE r5): duplicated
+    conjuncts historically double-removed in the factoring rewriter and
+    raised ValueError; A must hoist once and duplicates collapse."""
+
+    def test_duplicated_common_conjunct_factors_once(self):
+        from presto_tpu.sql.planner import (
+            factor_common_disjunct_conjuncts, split_conjuncts,
+        )
+
+        e = parse_expression(
+            "(a = b and a = b and x > 1) or (a = b and y > 2)")
+        out = factor_common_disjunct_conjuncts(e)   # pre-fix: ValueError
+        conjs = split_conjuncts(out)
+        a_eq_b = parse_expression("a = b")
+        assert sum(1 for c in conjs if c == a_eq_b) == 1
+        assert len(conjs) == 2                      # A, (X OR Y)
+
+    def test_branch_fully_covered_collapses_to_common(self):
+        from presto_tpu.sql.planner import (
+            factor_common_disjunct_conjuncts, split_conjuncts,
+        )
+
+        e = parse_expression("(a = b and a = b) or (a = b and y > 2)")
+        out = factor_common_disjunct_conjuncts(e)
+        assert split_conjuncts(out) == [parse_expression("a = b")]
+
+    def test_correlated_subquery_with_duplicated_conjuncts(self):
+        """End-to-end through the correlated-EXISTS path that invokes
+        the factoring rewriter (the q41-class shape)."""
+        from presto_tpu.localrunner import LocalQueryRunner
+
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        got = runner.execute(
+            "select count(*) from tpch.customer c where exists ("
+            "select 1 from tpch.orders o where "
+            "(o.o_custkey = c.c_custkey and o.o_custkey = c.c_custkey "
+            "and o.o_totalprice > 1000) or "
+            "(o.o_custkey = c.c_custkey and o.o_orderstatus = 'F'))").rows
+        want = runner.execute(
+            "select count(distinct o_custkey) from tpch.orders "
+            "where o_totalprice > 1000 or o_orderstatus = 'F'").rows
+        assert got == want
+
+
 class TestGeneralSubqueryPositions:
     """Subqueries hoisted into channels/markers (ApplyNode +
     semiJoinOutput-symbol design, round 4): EXISTS/IN under OR, scalar

@@ -123,11 +123,18 @@ def test_cache_does_not_alias_recycled_ids(runner):
 def test_join_order_smallest_intermediate_first(runner):
     """Q9-style chain: greedy order joins the most selective edge first.
     lineitem x (part filtered to ~1/25 by brand) must join part before
-    the unfiltered orders relation."""
+    the unfiltered orders relation.  Pins the GREEDY orderer (memo off);
+    the memo path has its own pins in test_plan_golden/test_memo."""
+    import dataclasses as dc
+
+    from presto_tpu.config import DEFAULT
+
     sql = ("select count(*) from lineitem, orders, part "
            "where l_orderkey = o_orderkey and l_partkey = p_partkey "
            "and p_brand = 'Brand#11'")
-    plan = _plan(runner, sql)
+    logical = Planner(runner.metadata).plan(parse_statement(sql))
+    plan = optimize(logical, runner.metadata,
+                    dc.replace(DEFAULT, optimizer_use_memo=False))
 
     order = []
 

@@ -76,3 +76,36 @@ class TestBenchmarkDriver:
 
         assert len(load_suite("tpch")) == 22
         assert "q72" in load_suite("tpcds")
+
+
+class TestPlanDiff:
+    def test_memo_vs_greedy_diff(self, capsys):
+        """tools/plan_diff.py prints both plan shapes with cost
+        estimates and reports the memo plan no costlier than greedy."""
+        import importlib
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        plan_diff = importlib.import_module("plan_diff")
+        rc = plan_diff.main(["q3", "--scale", "0.001"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "=== memo-on ===" in out
+        assert "=== memo-off (greedy) ===" in out
+        assert "estimated cost" in out
+        assert "WARNING" not in out    # memo never costlier than greedy
+
+    def test_query_name_parsing(self):
+        import importlib
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        plan_diff = importlib.import_module("plan_diff")
+        catalog, sql = plan_diff.load_query("tpcds/q72")
+        assert catalog == "tpcds" and "inventory" in sql
+        catalog, _ = plan_diff.load_query("q9")
+        assert catalog == "tpch"
