@@ -209,6 +209,23 @@ class EngineConfig:
     # wall-clock ceiling for one query; 0 = unlimited
     # (query_max_run_time role)
     query_max_run_time_s: float = 0.0
+    # --- distributed fault-tolerance knobs (RequestErrorTracker /
+    # remote-task error budget, server/errortracker.py) ------------------
+    # first backoff step after a retryable transport error; doubles per
+    # consecutive error up to the max (query.remote-task.min-error-duration
+    # neighborhood in the reference's RequestErrorTracker)
+    remote_request_min_backoff_s: float = 0.05
+    remote_request_max_backoff_s: float = 2.0
+    # error budget: consecutive-transport-failure window per endpoint
+    # before the request (and with it the task/query) is failed with the
+    # task id + endpoint attached (max-error-duration role)
+    remote_request_max_error_duration_s: float = 30.0
+    # mid-query task recovery: reschedule leaf (no-remote-source) tasks
+    # of a dead worker onto a survivor and repoint their consumers
+    task_recovery_enabled: bool = True
+    # how often the per-query monitor checks the failure detector's view
+    # of the workers hosting this query's tasks
+    task_recovery_interval_s: float = 0.25
 
 
 DEFAULT = EngineConfig()

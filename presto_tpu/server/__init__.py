@@ -3,6 +3,11 @@
 The host-side cluster runtime around the TPU compute path, mirroring the
 reference's layered control plane (SURVEY §1 L5-L7, §2.5, §2.8, §5.8):
 
+- ``errortracker`` — RequestErrorTracker role: transport-vs-fatal error
+                     classification, deterministic backoff, per-endpoint
+                     error budgets on every intra-cluster request
+- ``faults``       — deterministic fault injection (the chaos substrate:
+                     fail-n-times / http-503 / drop-connection / delay)
 - ``fragmenter``   — AddExchanges + PlanFragmenter role: logical plan ->
                      PlanFragments cut at exchange boundaries
 - ``buffers``      — worker-side OutputBuffers with the token-ack pull

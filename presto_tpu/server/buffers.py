@@ -82,7 +82,11 @@ class OutputBufferManager:
 
     def fail(self, error: Exception) -> None:
         with self._lock:
-            self._failed = error
+            # first error wins: the cancel fan-out's generic "task
+            # canceled" must not mask the original task failure a
+            # consumer still needs to see
+            if self._failed is None:
+                self._failed = error
             # release retained pages (an early-stopping consumer — TopN
             # merge — may never ack them) and unblock parked producers
             for buf in self.buffers.values():

@@ -37,6 +37,7 @@ from presto_tpu import types as T
 from presto_tpu.config import DEFAULT, EngineConfig
 from presto_tpu.connectors.api import ConnectorRegistry
 from presto_tpu.serde import deserialize_batch, frame_size
+from presto_tpu.server.errortracker import RemoteRequestError
 from presto_tpu.server.fragmenter import DistributedPlan, Fragmenter
 from presto_tpu.sql import tree as t
 from presto_tpu.sql.optimizer import optimize
@@ -109,6 +110,14 @@ class NodeManager:
             return [(nid, uri) for nid, uri in sorted(self.nodes.items())
                     if self.missed.get(nid, 0) < self.max_missed]
 
+    def dead_uris(self) -> set:
+        """URIs the failure detector has declared dead (consecutive
+        missed heartbeats) — the excluded-node set task recovery and
+        replacement placement consult."""
+        with self._lock:
+            return {uri for nid, uri in self.nodes.items()
+                    if self.missed.get(nid, 0) >= self.max_missed}
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             with self._lock:
@@ -164,6 +173,16 @@ class QueryExecution:
         # (fragment_id, task_id, worker_uri) per scheduled task — the
         # stats-fetch targets for distributed EXPLAIN ANALYZE
         self._placements: List[Tuple[int, str, str]] = []
+        # -- mid-query task recovery state --------------------------------
+        self._dplan: Optional[DistributedPlan] = None
+        self._consumers: Dict[int, int] = {}     # producer fid -> consumer
+        self._task_specs: Dict[str, Dict] = {}   # task id -> create args
+        # root-drain location rewrites after a root producer was
+        # rescheduled (original location -> replacement location)
+        self._relocations: Dict[str, str] = {}
+        self._recovered_uris: set = set()        # workers already handled
+        self._recovery_lock = threading.Lock()
+        self._monitor_stop = threading.Event()
         self.column_names: List[str] = []
         self.column_types: List[T.Type] = []
         self.result_rows: List[tuple] = []
@@ -278,6 +297,7 @@ class QueryExecution:
             # unblocked first and the fan-out only runs when worker
             # tasks were actually created.
             self.rows_done.set()
+            self._monitor_stop.set()
             if self._tasks_scheduled:
                 self._cancel_worker_tasks()
 
@@ -298,10 +318,10 @@ class QueryExecution:
         return "\n".join(lines)
 
     def _fetch_task_info(self, task_id: str, wuri: str) -> Dict:
-        req = urllib.request.Request(f"{wuri}/v1/task/{task_id}",
-                                     headers=self._internal_headers())
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            return json.loads(resp.read().decode("utf-8"))
+        resp = self.co.http.request(
+            f"{wuri}/v1/task/{task_id}", headers=self._internal_headers(),
+            timeout=10, task_id=task_id, description="task status")
+        return resp.json()
 
     def _render_analyze(self, dplan: DistributedPlan) -> str:
         """Fragment plan + per-operator stats aggregated across each
@@ -381,15 +401,22 @@ class QueryExecution:
                 if self.co.internal_auth is not None else {})
 
     def _cancel_worker_tasks(self) -> None:
+        """DELETE fan-out over every responsive node.  Best-effort, but
+        no longer silent: per-endpoint failures are logged, and retries
+        are bounded by a small error budget so one hung worker cannot
+        stall the fan-out for the full transport budget."""
         for _nid, uri in self.co.nodes.responsive_nodes():
             try:
-                req = urllib.request.Request(
+                self.co.http.request(
                     f"{uri}/v1/query/{self.query_id}", method="DELETE",
-                    headers=self._internal_headers())
-                with urllib.request.urlopen(req, timeout=5):
-                    pass
-            except Exception:  # noqa: BLE001 - best-effort cleanup
-                pass
+                    headers=self._internal_headers(), timeout=5,
+                    description="cancel fan-out",
+                    max_error_duration_s=min(
+                        2.0,
+                        self.co.config.remote_request_max_error_duration_s))
+            except Exception as e:  # noqa: BLE001 - best-effort cleanup
+                self.co.log(f"cancel fan-out for {self.query_id} to "
+                            f"{uri} failed: {e}")
 
     # -- scheduling -----------------------------------------------------
     def _task_count(self, frag, n_workers: int) -> int:
@@ -419,6 +446,8 @@ class QueryExecution:
         for f in dplan.fragments:
             for fid in f.consumed_fragments:
                 consumers[fid] = f.fragment_id
+        self._dplan = dplan
+        self._consumers = consumers
 
         # producers first (fragments list is already topological)
         task_uris: Dict[int, List[str]] = {}
@@ -451,19 +480,20 @@ class QueryExecution:
                             wuri, task_id, frag, (i, n_tasks), remote,
                             n_out, broadcast, consumer_index=i)
                         break
-                    except urllib.error.HTTPError as e:
-                        if e.code == 503:
-                            last_error = e   # draining: next worker
+                    except RemoteRequestError as e:
+                        if e.retryable:
+                            # draining worker (503) or node died between
+                            # heartbeat and now: fall over to the next
+                            # worker instead of failing the query
+                            last_error = e
                             continue
-                        body = e.read().decode("utf-8", "replace")[:500]
+                        body = ""
+                        if isinstance(e.cause, urllib.error.HTTPError):
+                            body = e.cause.read().decode(
+                                "utf-8", "replace")[:500]
                         raise RuntimeError(
                             f"task create failed on {wuri}: "
-                            f"{e.code} {body}") from e
-                    except urllib.error.URLError as e:
-                        # node died between heartbeat and now
-                        # (RequestErrorTracker transport-retry role)
-                        last_error = e
-                        continue
+                            f"{e}{' ' + body if body else ''}") from e
                 else:
                     raise RuntimeError(
                         "no worker accepted task "
@@ -472,9 +502,122 @@ class QueryExecution:
                     f"{wuri}/v1/task/{task_id}/results/{{part}}")
                 self._placements.append(
                     (frag.fragment_id, task_id, wuri))
+                # the recreate recipe for mid-query recovery (leaf
+                # fragments only ever need it, but recording all is
+                # cheap and keeps the monitor simple)
+                self._task_specs[task_id] = {
+                    "frag": frag, "scan_shard": (i, n_tasks),
+                    "remote": remote, "n_out": n_out,
+                    "broadcast": broadcast, "consumer_index": i}
             task_uris[frag.fragment_id] = uris
+        self._start_recovery_monitor()
         return [u.format(part=0)
                 for u in task_uris[dplan.root_fragment_id]]
+
+    # -- mid-query task recovery ----------------------------------------
+    def _start_recovery_monitor(self) -> None:
+        """Watch the failure detector for workers hosting this query's
+        tasks; reschedule leaf tasks of a dead worker onto a survivor
+        (the one recovery shape that is always safe: no remote sources,
+        deterministic scan shard) and repoint consumers."""
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        if not cfg.task_recovery_enabled:
+            return
+        threading.Thread(
+            target=self._recovery_loop,
+            args=(max(cfg.task_recovery_interval_s, 0.05),),
+            daemon=True, name=f"recovery-{self.query_id}").start()
+
+    def _recovery_loop(self, interval_s: float) -> None:
+        while not self._monitor_stop.wait(interval_s):
+            if self.state not in ("SCHEDULING", "RUNNING"):
+                return
+            dead = self.co.nodes.dead_uris()
+            with self._recovery_lock:
+                targets = sorted(
+                    {uri for _, _, uri in self._placements
+                     if uri in dead and uri not in self._recovered_uris})
+            for uri in targets:
+                try:
+                    self._recover_worker(uri)
+                except Exception as e:  # noqa: BLE001 - fail fast
+                    self.error = self.error or f"{e}"
+                    self.co.log(f"task recovery for {self.query_id} "
+                                f"failed: {e}")
+                    self.cancel()   # unblocks the drain
+                    return
+
+    def _recover_worker(self, dead_uri: str) -> None:
+        """Reschedule every task this query had on ``dead_uri``.  Only
+        leaf fragments (no remote sources) are recoverable — their
+        replacement regenerates the same deterministic output from its
+        scan shard; anything downstream fails fast with the task id and
+        endpoint attached."""
+        with self._recovery_lock:
+            if dead_uri in self._recovered_uris:
+                return
+            self._recovered_uris.add(dead_uri)
+            affected = [(fid, tid) for fid, tid, uri in self._placements
+                        if uri == dead_uri]
+        if not affected or self._dplan is None:
+            return
+        frag_by_id = {f.fragment_id: f for f in self._dplan.fragments}
+        for fid, tid in affected:
+            if frag_by_id[fid].consumed_fragments:
+                raise RuntimeError(
+                    f"Worker died mid-query and task {tid} "
+                    f"({dead_uri}/v1/task/{tid}) consumes remote "
+                    f"sources: stage {fid} is not reschedulable")
+        dead = self.co.nodes.dead_uris() | {dead_uri}
+        survivors = [uri for _, uri in self.co.nodes.alive_nodes()
+                     if uri not in dead]
+        if not survivors:
+            raise RuntimeError(
+                f"Worker {dead_uri} died mid-query and no surviving "
+                f"worker remains to reschedule its tasks")
+        for k, (fid, tid) in enumerate(affected):
+            spec = self._task_specs[tid]
+            new_uri = survivors[k % len(survivors)]
+            self._create_remote_task(
+                new_uri, tid, spec["frag"], spec["scan_shard"],
+                spec["remote"], spec["n_out"], spec["broadcast"],
+                consumer_index=spec["consumer_index"])
+            with self._recovery_lock:
+                self._placements = [
+                    (f, t, new_uri if t == tid else u)
+                    for f, t, u in self._placements]
+            old_prefix = f"{dead_uri}/v1/task/{tid}/results/"
+            new_prefix = f"{new_uri}/v1/task/{tid}/results/"
+            self.co.log(f"recovery: rescheduled {tid} from {dead_uri} "
+                        f"to {new_uri}")
+            self._repoint_consumers(fid, tid, dead_uri,
+                                    old_prefix, new_prefix)
+
+    def _repoint_consumers(self, fid: int, tid: str, dead_uri: str,
+                           old_prefix: str, new_prefix: str) -> None:
+        cons_fid = self._consumers.get(fid)
+        if cons_fid is None:
+            # root fragment: the coordinator's own drain is the consumer
+            self._relocations[old_prefix + "0"] = new_prefix + "0"
+            return
+        headers = {"Content-Type": "application/json"}
+        headers.update(self._internal_headers())
+        body = json.dumps({"old_prefix": old_prefix,
+                           "new_prefix": new_prefix}).encode("utf-8")
+        with self._recovery_lock:
+            consumers = [(t, u) for f, t, u in self._placements
+                         if f == cons_fid and u != dead_uri]
+        for ctid, curi in consumers:
+            resp = self.co.http.request(
+                f"{curi}/v1/task/{ctid}/remote-sources", method="POST",
+                data=body, headers=headers, timeout=10, task_id=ctid,
+                description="remote-source repoint")
+            status = resp.json().get("status")
+            if status == "delivered":
+                raise RuntimeError(
+                    f"Task {tid} on dead worker {dead_uri} already "
+                    f"delivered pages to consumer {ctid}: not "
+                    f"recoverable without restarting the query")
 
     def _create_remote_task(self, worker_uri: str, task_id: str, frag,
                             scan_shard, remote, n_out, broadcast,
@@ -502,13 +645,17 @@ class QueryExecution:
         if self.co.internal_auth is not None:
             headers.update(self.co.internal_auth.header())
         self._tasks_scheduled = True
-        req = urllib.request.Request(
-            f"{worker_uri}/v1/task/{task_id}", data=body, method="POST",
-            headers=headers)
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            info = json.loads(resp.read())
-            if info.get("state") == "FAILED":
-                raise RuntimeError(f"task create failed: {info}")
+        # budget 0: a single classified attempt — transport failures
+        # surface as retryable RemoteRequestError so the scheduler falls
+        # over to the NEXT worker immediately instead of backing off
+        # against a node the failure detector may not have excluded yet
+        resp = self.co.http.request(
+            f"{worker_uri}/v1/task/{task_id}", method="POST", data=body,
+            headers=headers, timeout=30, task_id=task_id,
+            description="task create", max_error_duration_s=0.0)
+        info = resp.json()
+        if info.get("state") == "FAILED":
+            raise RuntimeError(f"task create failed: {info}")
 
     # -- result drain ---------------------------------------------------
     def _session(self):
@@ -691,20 +838,20 @@ class QueryExecution:
         """Kill this query (KillQueryProcedure role): flag the drain loop
         and cancel every worker task."""
         self.canceled = True
-        for _, wuri in self.co.nodes.responsive_nodes():
-            try:
-                req = urllib.request.Request(
-                    f"{wuri}/v1/query/{self.query_id}", method="DELETE",
-                    headers=self._internal_headers())
-                urllib.request.urlopen(req, timeout=5).close()
-            except Exception:  # noqa: BLE001 - best effort
-                pass
+        self._cancel_worker_tasks()
 
     def _drain(self, locations: List[str]) -> None:
+        """Pull the root stage's pages.  Transport errors retry through
+        the error tracker (the token only advances on success, so a
+        retried GET re-fetches unacked pages); if the root producer was
+        rescheduled by task recovery, the drain follows the relocation —
+        but only from token 0, since a replacement regenerates its
+        stream from scratch."""
         cfg = getattr(self, "_cfg", None) or self.co.config
         deadline = (time.monotonic() + cfg.query_max_run_time_s
                     if cfg.query_max_run_time_s > 0 else None)
-        for loc in locations:
+        for orig_loc in locations:
+            loc = orig_loc
             token = 0
             while True:
                 if getattr(self, "canceled", False):
@@ -713,15 +860,29 @@ class QueryExecution:
                     raise RuntimeError(
                         "Query exceeded maximum run time "
                         f"({cfg.query_max_run_time_s:g}s)")
-                url = f"{loc}/{token}"
-                req = urllib.request.Request(
-                    url, headers=self._internal_headers())
-                with urllib.request.urlopen(req, timeout=120) as resp:
-                    complete = resp.headers.get(
-                        "X-Presto-Buffer-Complete") == "true"
-                    token = int(resp.headers.get("X-Presto-Next-Token",
-                                                 token))
-                    body = resp.read()
+
+                def _on_retry(exc, _loc=loc, _token=token):
+                    if getattr(self, "canceled", False):
+                        raise RuntimeError("Query killed")
+                    moved = self._relocations.get(_loc)
+                    if moved is None:
+                        return None
+                    if _token != 0:
+                        raise RuntimeError(
+                            f"root task output at {_loc} lost mid-drain "
+                            f"after {_token} page(s); replacement at "
+                            f"{moved} cannot resume") from exc
+                    return f"{moved}/{_token}"
+                resp = self.co.http.request(
+                    f"{loc}/{token}", headers=self._internal_headers(),
+                    timeout=120, description="result drain",
+                    endpoint=loc, retry_cb=_on_retry)
+                loc = self._relocations.get(orig_loc, loc)
+                complete = resp.headers.get(
+                    "X-Presto-Buffer-Complete") == "true"
+                token = int(resp.headers.get("X-Presto-Next-Token",
+                                             token))
+                body = resp.body
                 off = 0
                 while off < len(body):
                     size = frame_size(body, off)
@@ -843,7 +1004,11 @@ class CoordinatorServer:
                  session_property_manager=None,
                  cluster_memory_limit_bytes: Optional[int] = None,
                  min_workers: int = 0,
-                 min_workers_wait_s: float = 10.0):
+                 min_workers_wait_s: float = 10.0,
+                 http_client=None, fault_injector=None,
+                 heartbeat_interval_s: float = 0.5,
+                 heartbeat_max_missed: int = 3):
+        from presto_tpu.server.errortracker import RetryingHttpClient
         from presto_tpu.server.security import InternalAuthenticator
         from presto_tpu.session import ResourceGroupManager
 
@@ -853,7 +1018,17 @@ class CoordinatorServer:
         self.verbose = verbose
         from presto_tpu.session import GrantStore
 
-        self.nodes = NodeManager()
+        # every coordinator->worker request (task create, status poll,
+        # result drain, cancel fan-out) goes through the error-tracked
+        # client; ``fault_injector`` simulates transport failures on
+        # this path in chaos tests
+        self.http = http_client or RetryingHttpClient(
+            max_error_duration_s=config.remote_request_max_error_duration_s,
+            min_backoff_s=config.remote_request_min_backoff_s,
+            max_backoff_s=config.remote_request_max_backoff_s,
+            injector=fault_injector)
+        self.nodes = NodeManager(max_missed=heartbeat_max_missed,
+                                 interval_s=heartbeat_interval_s)
         self.queries: Dict[str, QueryExecution] = {}
         self.resource_groups = ResourceGroupManager()
         self.grants = GrantStore()
@@ -1041,13 +1216,13 @@ class CoordinatorServer:
                             hdrs = (co.internal_auth.header()
                                     if co.internal_auth is not None
                                     else {})
-                            with urllib.request.urlopen(
-                                    urllib.request.Request(
-                                        f"{uri}/v1/task", headers=hdrs),
-                                    timeout=5) as resp:
-                                for t in json.loads(resp.read()):
-                                    t["nodeId"] = nid
-                                    out.append(t)
+                            resp = co.http.request(
+                                f"{uri}/v1/task", headers=hdrs,
+                                timeout=5, description="task listing",
+                                max_error_duration_s=0.0)
+                            for t in resp.json():
+                                t["nodeId"] = nid
+                                out.append(t)
                         except Exception:  # noqa: BLE001 - node flaky
                             pass
                     self._json(200, out)

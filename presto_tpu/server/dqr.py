@@ -26,13 +26,22 @@ class DistributedQueryRunner:
     def __init__(self, registry_factory: Callable[[], ConnectorRegistry],
                  default_catalog: str, n_workers: int = 3,
                  config: EngineConfig = DEFAULT, verbose: bool = False,
-                 internal_secret: Optional[str] = None):
+                 internal_secret: Optional[str] = None,
+                 coordinator_injector=None, worker_injectors=None,
+                 heartbeat_interval_s: float = 0.5,
+                 heartbeat_max_missed: int = 3):
         # each node builds its own registry, as each reference node loads
         # its own connector instances from catalog config
+        # ``coordinator_injector`` fails coordinator-originated requests
+        # client-side; ``worker_injectors`` (index -> FaultInjector) hook
+        # each worker's HTTP handler (server/faults.py chaos substrate)
         self.internal_secret = internal_secret
         self.coordinator = CoordinatorServer(
             registry_factory(), default_catalog, config, verbose=verbose,
-            internal_secret=internal_secret)
+            internal_secret=internal_secret,
+            fault_injector=coordinator_injector,
+            heartbeat_interval_s=heartbeat_interval_s,
+            heartbeat_max_missed=heartbeat_max_missed)
 
         def cluster_registry() -> ConnectorRegistry:
             # system.runtime.* backed by live coordinator state, fetched
@@ -81,10 +90,19 @@ class DistributedQueryRunner:
             w = WorkerServer(cluster_registry(), config,
                              node_id=f"worker-{i}",
                              internal_secret=internal_secret,
-                             location=f"rack{i % 2}")
+                             location=f"rack{i % 2}",
+                             fault_injector=(worker_injectors or
+                                             {}).get(i))
             self.workers.append(w)
             self._announce(w)
         self.client = StatementClient(self.coordinator.uri)
+
+    def kill_worker(self, i: int) -> WorkerServer:
+        """Abruptly stop worker ``i`` (chaos: simulated node death — the
+        coordinator learns of it only through missed heartbeats)."""
+        w = self.workers.pop(i)
+        w.close()
+        return w
 
     def _announce(self, worker: WorkerServer) -> None:
         import json
@@ -107,7 +125,8 @@ class DistributedQueryRunner:
 
     @classmethod
     def tpch(cls, scale: float = 0.01, n_workers: int = 3,
-             config: EngineConfig = DEFAULT) -> "DistributedQueryRunner":
+             config: EngineConfig = DEFAULT,
+             **kwargs) -> "DistributedQueryRunner":
         from presto_tpu.connectors.memory import MemoryConnector
 
         # One shared memory connector instance across every in-process
@@ -125,7 +144,7 @@ class DistributedQueryRunner:
             reg.register("memory", shared_memory)
             return reg
 
-        return cls(factory, "tpch", n_workers, config)
+        return cls(factory, "tpch", n_workers, config, **kwargs)
 
     def execute(self, sql: str) -> QueryResult:
         columns, data = self.client.execute(sql)

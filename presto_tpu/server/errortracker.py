@@ -1,0 +1,283 @@
+"""Transport error tracking with deterministic backoff and error budgets.
+
+RequestErrorTracker role (presto-main/.../server/remotetask/
+RequestErrorTracker.java, used by HttpRemoteTask.java:100 and
+ContinuousTaskStatusFetcher): every coordinator->worker and
+worker->worker HTTP request distinguishes *retryable transport errors*
+(connection refused/reset, timeouts, 502/503/504) from *fatal
+application errors* (4xx, plan errors, task failure bodies).  Retryable
+errors back off exponentially and accumulate against a per-endpoint
+error budget (the reference's max-error-duration); once the budget is
+exhausted the request fails with the task id + endpoint attached so the
+operator can see exactly which hop died.
+
+The clock and sleeper are injectable so chaos tests drive the whole
+schedule without real delays (FakeTicker/TestingTicker pattern).
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: HTTP statuses treated as transient transport conditions: a draining
+#: worker answers 503 (GracefulShutdownHandler role) and proxies in the
+#: path emit 502/504 on upstream flaps.
+RETRYABLE_STATUSES = (502, 503, 504)
+
+
+class RemoteRequestError(RuntimeError):
+    """A remote request failed past classification.
+
+    ``retryable`` distinguishes an exhausted-transport-budget failure
+    (the peer may simply be gone) from a fatal application error (the
+    request must not be repeated anywhere).
+    """
+
+    def __init__(self, message: str, *, endpoint: str,
+                 task_id: Optional[str] = None,
+                 cause: Optional[BaseException] = None,
+                 retryable: bool = False, status: Optional[int] = None,
+                 error_count: int = 0, elapsed_s: float = 0.0):
+        super().__init__(message)
+        self.endpoint = endpoint
+        self.task_id = task_id
+        self.cause = cause
+        self.retryable = retryable
+        self.status = status
+        self.error_count = error_count
+        self.elapsed_s = elapsed_s
+
+
+def error_status(exc: BaseException) -> Optional[int]:
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code
+    return None
+
+
+def describe_error(exc: BaseException) -> str:
+    """str(exc), plus the response body for HTTP errors — a worker's
+    500 carries the real task failure (task id, producer endpoint) and
+    'HTTP Error 500' alone would hide it."""
+    if isinstance(exc, urllib.error.HTTPError):
+        try:
+            body = exc.read().decode("utf-8", "replace")[:300]
+        except Exception:  # noqa: BLE001 - already-consumed stream
+            body = ""
+        return f"{exc}{' ' + body if body else ''}"
+    return str(exc)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Transport-level failures are retryable; application-level HTTP
+    errors are not (the reference retries only transport errors)."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code in RETRYABLE_STATUSES
+    if isinstance(exc, urllib.error.URLError):
+        return True
+    # raw socket/HTTP-protocol failures escape urllib unwrapped in some
+    # paths (RemoteDisconnected from a dropped keep-alive connection)
+    if isinstance(exc, (socket.timeout, TimeoutError, ConnectionError,
+                        http.client.HTTPException, OSError)):
+        return True
+    return False
+
+
+class RequestErrorTracker:
+    """Error budget + deterministic exponential backoff for ONE endpoint.
+
+    ``failed(exc)`` either sleeps the next backoff step and returns (the
+    caller retries), or raises ``RemoteRequestError`` when the error is
+    fatal or the budget since the first unrecovered error is exhausted.
+    ``succeeded()`` resets the budget.
+    """
+
+    def __init__(self, endpoint: str, *, task_id: Optional[str] = None,
+                 description: str = "request",
+                 max_error_duration_s: float = 30.0,
+                 min_backoff_s: float = 0.05, max_backoff_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleeper: Callable[[float], None] = time.sleep):
+        self.endpoint = endpoint
+        self.task_id = task_id
+        self.description = description
+        self.max_error_duration_s = max_error_duration_s
+        self.min_backoff_s = min_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.clock = clock
+        self.sleeper = sleeper
+        self.error_count = 0
+        self.first_error_at: Optional[float] = None
+        self.errors: List[BaseException] = []   # recent causes, bounded
+
+    def backoff_delay(self) -> float:
+        """Deterministic schedule: min * 2^(n-1), capped at max."""
+        if self.error_count <= 0:
+            return 0.0
+        return min(self.min_backoff_s * (2 ** (self.error_count - 1)),
+                   self.max_backoff_s)
+
+    def succeeded(self) -> None:
+        self.error_count = 0
+        self.first_error_at = None
+        self.errors.clear()
+
+    def reset(self, endpoint: Optional[str] = None) -> None:
+        """Forget accumulated errors (e.g. after the source was
+        repointed at a replacement task)."""
+        if endpoint is not None:
+            self.endpoint = endpoint
+        self.succeeded()
+
+    def _fail(self, exc: BaseException, retryable: bool,
+              elapsed: float) -> "RemoteRequestError":
+        who = f" for task {self.task_id}" if self.task_id else ""
+        detail = describe_error(exc)
+        if retryable:
+            msg = (f"{self.description}{who} to {self.endpoint} failed "
+                   f"{self.error_count} time(s) over {elapsed:.2f}s "
+                   f"(error budget {self.max_error_duration_s:g}s "
+                   f"exhausted): {detail}")
+        else:
+            msg = (f"{self.description}{who} to {self.endpoint} "
+                   f"failed: {detail}")
+        return RemoteRequestError(
+            msg, endpoint=self.endpoint, task_id=self.task_id, cause=exc,
+            retryable=retryable, status=error_status(exc),
+            error_count=self.error_count, elapsed_s=elapsed)
+
+    def failed(self, exc: BaseException) -> None:
+        """Record a request failure; sleep the backoff and return when
+        the caller should retry, raise when it must give up."""
+        now = self.clock()
+        if self.first_error_at is None:
+            self.first_error_at = now
+        self.error_count += 1
+        if len(self.errors) < 8:
+            self.errors.append(exc)
+        elapsed = now - self.first_error_at
+        if not is_retryable(exc):
+            raise self._fail(exc, retryable=False, elapsed=elapsed) \
+                from exc
+        if elapsed >= self.max_error_duration_s:
+            raise self._fail(exc, retryable=True, elapsed=elapsed) \
+                from exc
+        self.sleeper(self.backoff_delay())
+
+
+class HttpResponse:
+    """Fully-read response (bodies on this control plane are small)."""
+
+    __slots__ = ("status", "headers", "body")
+
+    def __init__(self, status: int, headers, body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    def json(self):
+        import json
+
+        return json.loads(self.body.decode("utf-8"))
+
+
+class RetryingHttpClient:
+    """urllib front-end that routes every request through a
+    ``RequestErrorTracker`` and an optional client-side fault injector.
+
+    One instance per node (coordinator / worker); per-endpoint trackers
+    accumulate the error budget across calls and reset on success.
+    """
+
+    def __init__(self, *, max_error_duration_s: float = 30.0,
+                 min_backoff_s: float = 0.05, max_backoff_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleeper: Callable[[float], None] = time.sleep,
+                 injector=None,
+                 opener: Callable = urllib.request.urlopen):
+        self.max_error_duration_s = max_error_duration_s
+        self.min_backoff_s = min_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.clock = clock
+        self.sleeper = sleeper
+        self.injector = injector          # FaultInjector (client side)
+        self.opener = opener
+        self._trackers: Dict[Tuple[str, str], RequestErrorTracker] = {}
+
+    def new_tracker(self, endpoint: str, *,
+                    task_id: Optional[str] = None,
+                    description: str = "request",
+                    max_error_duration_s: Optional[float] = None
+                    ) -> RequestErrorTracker:
+        budget = (self.max_error_duration_s if max_error_duration_s
+                  is None else max_error_duration_s)
+        return RequestErrorTracker(
+            endpoint, task_id=task_id, description=description,
+            max_error_duration_s=budget,
+            min_backoff_s=self.min_backoff_s,
+            max_backoff_s=self.max_backoff_s,
+            clock=self.clock, sleeper=self.sleeper)
+
+    def request_once(self, url: str, *, method: str = "GET",
+                     data: Optional[bytes] = None,
+                     headers: Optional[dict] = None,
+                     timeout: float = 30.0) -> HttpResponse:
+        """One attempt, no tracking: classification is the caller's."""
+        if self.injector is not None:
+            self.injector.apply_client(url, method)
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=dict(headers or {}))
+        with self.opener(req, timeout=timeout) as resp:
+            return HttpResponse(resp.status, resp.headers, resp.read())
+
+    def request(self, url: str, *, method: str = "GET",
+                data: Optional[bytes] = None,
+                headers: Optional[dict] = None, timeout: float = 30.0,
+                task_id: Optional[str] = None,
+                description: str = "request",
+                endpoint: Optional[str] = None,
+                max_error_duration_s: Optional[float] = None,
+                retry_cb: Optional[Callable[[BaseException],
+                                            Optional[str]]] = None
+                ) -> HttpResponse:
+        """Tracked request: retries retryable transport errors with
+        backoff until the per-endpoint error budget is exhausted.
+
+        ``endpoint`` keys the budget (defaults to the url — pass the
+        token-free prefix for paged fetches so the budget spans the
+        stream).  ``retry_cb`` runs before each retry; it may raise to
+        abort, or return a replacement URL (mid-query task recovery
+        repointing) which also resets the budget.
+        """
+        key = (method, endpoint or url)
+        tracker = self._trackers.get(key)
+        if tracker is None or tracker.task_id != task_id:
+            if len(self._trackers) > 2048:
+                # endpoints are per-task/per-query: prune rather than
+                # grow forever on a long-lived coordinator (budget state
+                # for live endpoints restarts, which is safe)
+                self._trackers.clear()
+            tracker = self.new_tracker(
+                endpoint or url, task_id=task_id, description=description,
+                max_error_duration_s=max_error_duration_s)
+            self._trackers[key] = tracker
+        elif max_error_duration_s is not None:
+            tracker.max_error_duration_s = max_error_duration_s
+        while True:
+            try:
+                resp = self.request_once(url, method=method, data=data,
+                                         headers=headers, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 - classified below
+                tracker.failed(e)   # raises when fatal/budget exhausted
+                if retry_cb is not None:
+                    moved = retry_cb(e)
+                    if moved:
+                        url = moved
+                        tracker.reset(endpoint=moved)
+                continue
+            tracker.succeeded()
+            return resp

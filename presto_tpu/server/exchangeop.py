@@ -16,7 +16,6 @@ Reference models:
 from __future__ import annotations
 
 import threading
-import urllib.request
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -26,6 +25,9 @@ from presto_tpu.exec.context import OperatorContext
 from presto_tpu.exec.operator import Operator, OperatorFactory
 from presto_tpu.serde import deserialize_batch, frame_size, serialize_batch
 from presto_tpu.server.buffers import OutputBufferManager
+from presto_tpu.server.errortracker import (
+    RemoteRequestError, RetryingHttpClient,
+)
 
 
 class PartitionedOutputOperator(Operator):
@@ -159,10 +161,21 @@ class TaskOutputOperatorFactory(OperatorFactory):
 # ---------------------------------------------------------------------------
 
 class HttpPageClient(threading.Thread):
-    """Long-polls one producer buffer, acking by token advance."""
+    """Long-polls one producer buffer, acking by token advance.
+
+    Transport errors retry through a ``RequestErrorTracker``: because
+    the token only advances on success, a retried GET simply re-fetches
+    the unacked pages (at-least-once delivery with token dedup — the
+    HttpPageBufferClient.java:297 semantics).  ``repoint`` redirects the
+    poll at a replacement task mid-stream (mid-query task recovery);
+    only safe before any page was delivered, so the replacement's
+    regenerated stream cannot double-count.
+    """
 
     def __init__(self, base_url: str, client: "ExchangeClient",
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None,
+                 http: Optional[RetryingHttpClient] = None,
+                 task_id: Optional[str] = None):
         super().__init__(daemon=True)
         self.base_url = base_url.rstrip("/")
         self.client = client
@@ -170,29 +183,62 @@ class HttpPageClient(threading.Thread):
         # per-cluster intra-auth headers (one process can host clusters
         # with different secrets; never process-global state)
         self.headers = dict(headers or {})
+        self.http = http or RetryingHttpClient()
+        self.task_id = task_id
+        self.pages_delivered = 0
+        self._lock = threading.Lock()
+        self._tracker = self.http.new_tracker(
+            self.base_url, task_id=task_id, description="exchange fetch")
+
+    def repoint(self, new_base_url: str) -> bool:
+        """Redirect at a replacement producer; False once pages from the
+        old producer were already delivered (not recoverable)."""
+        with self._lock:
+            if self.pages_delivered > 0:
+                return False
+            self.base_url = new_base_url.rstrip("/")
+            self.token = 0
+            self._tracker.reset(endpoint=self.base_url)
+            return True
 
     def run(self) -> None:
         try:
             while True:
-                url = f"{self.base_url}/{self.token}"
-                req = urllib.request.Request(
-                    url, method="GET", headers=dict(self.headers))
-                with urllib.request.urlopen(req, timeout=120) as resp:
-                    complete = resp.headers.get("X-Presto-Buffer-Complete") \
-                        == "true"
-                    next_token = int(
-                        resp.headers.get("X-Presto-Next-Token", self.token))
-                    body = resp.read()
+                with self._lock:
+                    base, token = self.base_url, self.token
+                try:
+                    resp = self.http.request_once(
+                        f"{base}/{token}", headers=dict(self.headers),
+                        timeout=120)
+                except Exception as e:  # noqa: BLE001 - classified
+                    # raises RemoteRequestError when fatal or the error
+                    # budget is exhausted; else backs off and we retry
+                    # (possibly against a repointed base_url)
+                    self._tracker.failed(e)
+                    continue
+                self._tracker.succeeded()
+                complete = resp.headers.get(
+                    "X-Presto-Buffer-Complete") == "true"
+                next_token = int(resp.headers.get(
+                    "X-Presto-Next-Token", token))
+                body = resp.body
+                with self._lock:
+                    if self.base_url != base:
+                        continue   # repointed mid-flight: discard
                 off = 0
                 while off < len(body):
                     size = frame_size(body, off)
                     self.client.on_page(body[off:off + size])
                     off += size
-                self.token = next_token
+                    with self._lock:
+                        self.pages_delivered += 1
+                with self._lock:
+                    if self.base_url == base:
+                        self.token = next_token
                 if complete:
                     break
         except Exception as e:  # noqa: BLE001 - surfaces to the driver
-            self.client.on_error(e)
+            self.client.on_source_error(self, e)
             return
         self.client.on_client_finished()
 
@@ -208,7 +254,9 @@ class ExchangeClient:
 
     def __init__(self, locations: Sequence[str],
                  max_buffered_bytes: int = 64 << 20,
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None,
+                 http: Optional[RetryingHttpClient] = None,
+                 task_id: Optional[str] = None):
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._pages: List[bytes] = []
@@ -216,11 +264,30 @@ class ExchangeClient:
         self._max_buffered_bytes = max(1, max_buffered_bytes)
         self._closed = False
         self._error: Optional[Exception] = None
-        self._clients = [HttpPageClient(loc, self, headers=headers)
+        self.task_id = task_id
+        self._clients = [HttpPageClient(loc, self, headers=headers,
+                                        http=http, task_id=task_id)
                          for loc in locations]
         self._remaining = len(self._clients)
         for c in self._clients:
             c.start()
+
+    def repoint(self, old_prefix: str, new_prefix: str) -> str:
+        """Redirect every fetcher polling under ``old_prefix`` at the
+        replacement task's results under ``new_prefix`` (mid-query task
+        recovery).  Returns 'repointed', 'delivered' (pages from the old
+        producer were already consumed — not recoverable), or
+        'not-found'."""
+        status = "not-found"
+        for c in self._clients:
+            if not c.base_url.startswith(old_prefix.rstrip("/")):
+                continue
+            suffix = c.base_url[len(old_prefix.rstrip("/")):]
+            if c.repoint(new_prefix.rstrip("/") + suffix):
+                status = "repointed" if status != "delivered" else status
+            else:
+                return "delivered"
+        return status
 
     def on_page(self, page: bytes) -> None:
         with self._lock:
@@ -237,6 +304,17 @@ class ExchangeClient:
             self._error = e
             self._remaining = 0
             self._drained.notify_all()
+
+    def on_source_error(self, source: "HttpPageClient",
+                        e: Exception) -> None:
+        """A fetcher gave up: attach the task + producer context so the
+        failure names the exact hop instead of a bare urllib error."""
+        if isinstance(e, RemoteRequestError):
+            self.on_error(e)   # tracker already attached the context
+            return
+        who = f"task {self.task_id}: " if self.task_id else ""
+        self.on_error(RuntimeError(
+            f"{who}exchange fetch from {source.base_url} failed: {e}"))
 
     def on_client_finished(self) -> None:
         with self._lock:
@@ -304,17 +382,42 @@ class ExchangeOperator(Operator):
         super().close()
 
 
+def _repoint_locations(locations: List[str], old_prefix: str,
+                       new_prefix: str) -> str:
+    """Rewrite not-yet-fetched producer locations (the pre-create half
+    of mid-query recovery: the exchange client does not exist yet, so
+    nothing was delivered and a plain rewrite is always safe)."""
+    old, new = old_prefix.rstrip("/"), new_prefix.rstrip("/")
+    hit = False
+    for i, loc in enumerate(locations):
+        if loc.startswith(old):
+            locations[i] = new + loc[len(old):]
+            hit = True
+    return "repointed" if hit else "not-found"
+
+
 class ExchangeOperatorFactory(OperatorFactory):
     def __init__(self, locations: Sequence[str],
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None,
+                 http: Optional[RetryingHttpClient] = None,
+                 task_id: Optional[str] = None):
         self.locations = list(locations)
         self.headers = headers
+        self.http = http
+        self.task_id = task_id
         self._client: Optional[ExchangeClient] = None
+
+    def repoint(self, old_prefix: str, new_prefix: str) -> str:
+        if self._client is not None:
+            return self._client.repoint(old_prefix, new_prefix)
+        return _repoint_locations(self.locations, old_prefix, new_prefix)
 
     def create(self, ctx: OperatorContext):
         if self._client is None:
             self._client = ExchangeClient(self.locations,
-                                          headers=self.headers)
+                                          headers=self.headers,
+                                          http=self.http,
+                                          task_id=self.task_id)
         return ExchangeOperator(ctx, self._client)
 
 
@@ -329,9 +432,12 @@ class MergeExchangeOperator(Operator):
 
     def __init__(self, ctx: OperatorContext, locations: Sequence[str],
                  sort_keys, types, limit: Optional[int] = None,
-                 batch_rows: int = 8192, headers: Optional[dict] = None):
+                 batch_rows: int = 8192, headers: Optional[dict] = None,
+                 http: Optional[RetryingHttpClient] = None,
+                 task_id: Optional[str] = None):
         super().__init__(ctx)
-        self.clients = [ExchangeClient([loc], headers=headers)
+        self.clients = [ExchangeClient([loc], headers=headers,
+                                       http=http, task_id=task_id)
                         for loc in locations]
         self.sort_keys = list(sort_keys)   # (channel, ascending, nulls_first)
         self.types = list(types)
@@ -451,14 +557,31 @@ class MergeExchangeOperator(Operator):
 class MergeExchangeOperatorFactory(OperatorFactory):
     def __init__(self, locations: Sequence[str], sort_keys, types,
                  limit: Optional[int] = None,
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None,
+                 http: Optional[RetryingHttpClient] = None,
+                 task_id: Optional[str] = None):
         self.locations = list(locations)
         self.sort_keys = list(sort_keys)
         self.types = list(types)
         self.limit = limit
         self.headers = headers
+        self.http = http
+        self.task_id = task_id
+        self._live_clients: List[ExchangeClient] = []
+
+    def repoint(self, old_prefix: str, new_prefix: str) -> str:
+        statuses = [c.repoint(old_prefix, new_prefix)
+                    for c in self._live_clients]
+        if "delivered" in statuses:
+            return "delivered"
+        if "repointed" in statuses:
+            return "repointed"
+        return _repoint_locations(self.locations, old_prefix, new_prefix)
 
     def create(self, ctx: OperatorContext):
-        return MergeExchangeOperator(ctx, self.locations, self.sort_keys,
-                                     self.types, self.limit,
-                                     headers=self.headers)
+        op = MergeExchangeOperator(ctx, self.locations, self.sort_keys,
+                                   self.types, self.limit,
+                                   headers=self.headers, http=self.http,
+                                   task_id=self.task_id)
+        self._live_clients.extend(op.clients)
+        return op

@@ -1,0 +1,144 @@
+"""Deterministic fault injection for the distributed tier.
+
+The chaos substrate every distributed test reuses: an injector keyed by
+(endpoint-pattern, method) with policies
+
+    fail-n-times     first N matching requests fail (drop by default)
+    http-503         answer 503 Service Unavailable
+    drop-connection  close the socket without an HTTP response
+    delay            hold the request for ``delay_s`` before serving
+
+It hooks BOTH ends of a request:
+
+- server side — the worker HTTP handler consults ``apply_server`` before
+  dispatch and enacts the returned action;
+- client side — ``RetryingHttpClient`` consults ``apply_client`` before
+  issuing, so coordinator-originated requests can be failed without any
+  server cooperation.
+
+Everything is driven from tests; no rule means zero overhead beyond one
+attribute check.  The injector records every injection so tests can
+assert the fault actually fired.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import urllib.error
+from typing import Callable, List, Optional, Tuple
+
+#: policy names (kept as strings so rules serialize trivially)
+FAIL_N_TIMES = "fail-n-times"
+HTTP_503 = "http-503"
+DROP_CONNECTION = "drop-connection"
+DELAY = "delay"
+
+
+class FaultRule:
+    def __init__(self, pattern: str, method: str, policy: str, *,
+                 times: Optional[int] = None, delay_s: float = 0.0,
+                 status: int = 503):
+        if policy not in (FAIL_N_TIMES, HTTP_503, DROP_CONNECTION, DELAY):
+            raise ValueError(f"unknown fault policy {policy!r}")
+        self.pattern = pattern
+        self.regex = re.compile(pattern)
+        self.method = method.upper()
+        self.policy = policy
+        # fail-n-times defaults to 1 shot; other policies fire until
+        # removed unless a count is given
+        self.remaining = (times if times is not None
+                          else (1 if policy == FAIL_N_TIMES else None))
+        self.delay_s = delay_s
+        self.status = status
+
+    def matches(self, path: str, method: str) -> bool:
+        return (self.method in ("*", method.upper())
+                and self.regex.search(path) is not None)
+
+    def __repr__(self):
+        return (f"FaultRule({self.pattern!r}, {self.method}, "
+                f"{self.policy}, remaining={self.remaining})")
+
+
+class InjectedFault(urllib.error.URLError):
+    """Client-side simulated transport failure (classified retryable)."""
+
+    def __init__(self, rule: FaultRule, url: str):
+        super().__init__(ConnectionResetError(
+            f"injected {rule.policy} on {url}"))
+        self.rule = rule
+
+
+class FaultInjector:
+    def __init__(self, sleeper: Callable[[float], None] = time.sleep):
+        self._lock = threading.Lock()
+        self.rules: List[FaultRule] = []
+        self.sleeper = sleeper
+        #: (path, method, policy) per injection, for test assertions
+        self.injections: List[Tuple[str, str, str]] = []
+
+    def add_rule(self, pattern: str, method: str = "*",
+                 policy: str = DROP_CONNECTION, *,
+                 times: Optional[int] = None, delay_s: float = 0.0,
+                 status: int = 503) -> FaultRule:
+        rule = FaultRule(pattern, method, policy, times=times,
+                         delay_s=delay_s, status=status)
+        with self._lock:
+            self.rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        with self._lock:
+            self.rules.clear()
+
+    def _next_action(self, path: str, method: str
+                     ) -> Optional[Tuple[FaultRule, str]]:
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(path, method):
+                    continue
+                if rule.remaining is not None:
+                    if rule.remaining <= 0:
+                        continue
+                    rule.remaining -= 1
+                self.injections.append((path, method, rule.policy))
+                policy = (DROP_CONNECTION if rule.policy == FAIL_N_TIMES
+                          else rule.policy)
+                return rule, policy
+        return None
+
+    # -- client side ----------------------------------------------------
+    def apply_client(self, url: str, method: str) -> None:
+        """Raise the simulated failure (or delay) for a request the
+        local node is about to issue."""
+        hit = self._next_action(url, method)
+        if hit is None:
+            return
+        rule, policy = hit
+        if policy == DELAY:
+            self.sleeper(rule.delay_s)
+            return
+        if policy == HTTP_503:
+            import io
+
+            raise urllib.error.HTTPError(
+                url, rule.status, "injected fault", {},
+                io.BytesIO(b'{"error": "injected fault"}'))
+        raise InjectedFault(rule, url)
+
+    # -- server side ----------------------------------------------------
+    def apply_server(self, path: str, method: str
+                     ) -> Optional[Tuple[str, FaultRule]]:
+        """Returns None (serve normally) or (policy, rule) for the
+        handler to enact: 'http-503' | 'drop-connection'; 'delay' is
+        applied here and then served normally."""
+        hit = self._next_action(path, method)
+        if hit is None:
+            return None
+        rule, policy = hit
+        if policy == DELAY:
+            self.sleeper(rule.delay_s)
+            return None
+        return policy, rule

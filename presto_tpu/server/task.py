@@ -35,7 +35,8 @@ class SqlTask:
                  n_output_partitions: int, broadcast_output: bool,
                  registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT,
-                 fetch_headers: Optional[Dict[str, str]] = None):
+                 fetch_headers: Optional[Dict[str, str]] = None,
+                 http_client=None):
         self.task_id = task_id
         self.fragment = fragment
         self.state = "RUNNING"
@@ -44,11 +45,19 @@ class SqlTask:
             n_output_partitions, broadcast=broadcast_output)
         self._stats: Optional[TaskContext] = None
         self._live: Optional[TaskContext] = None  # set when execution starts
+        # every exchange source factory of this task's remote sources,
+        # so the coordinator can repoint them at replacement producers
+        # (mid-query task recovery) whether or not fetching has started
+        self.exchange_sources: List = []
 
         planner = PhysicalPlanner(registry, config,
                                   scan_shard=scan_shard,
                                   remote_sources=remote_sources,
-                                  fetch_headers=fetch_headers)
+                                  fetch_headers=fetch_headers,
+                                  http_client=http_client,
+                                  task_id=task_id,
+                                  exchange_register=(
+                                      self.exchange_sources.append))
         kind, channels = fragment.output_partitioning
         if kind == "hash" and n_output_partitions > 1:
             sink = PartitionedOutputOperatorFactory(
@@ -104,6 +113,21 @@ class SqlTask:
         return {"reserved": ctx.memory.reserved if running else 0,
                 "peak": ctx.memory.peak}
 
+    def repoint_remote_source(self, old_prefix: str,
+                              new_prefix: str) -> str:
+        """Redirect remote-source fetches from a dead producer at its
+        replacement.  'repointed' | 'delivered' (pages from the old
+        producer were already consumed — not recoverable) |
+        'not-found'."""
+        status = "not-found"
+        for source in self.exchange_sources:
+            got = source.repoint(old_prefix, new_prefix)
+            if got == "delivered":
+                return "delivered"
+            if got == "repointed":
+                status = "repointed"
+        return status
+
     def cancel(self) -> None:
         if self.state == "RUNNING":
             self.state = "CANCELED"
@@ -120,11 +144,14 @@ class SqlTaskManager:
 
     def __init__(self, registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT,
-                 fetch_headers: Optional[Dict[str, str]] = None):
+                 fetch_headers: Optional[Dict[str, str]] = None,
+                 http_client=None):
         self.registry = registry
         self.config = config
         # intra-cluster auth headers this node's exchange fetches carry
         self.fetch_headers = fetch_headers
+        # node-wide error-tracked HTTP client for remote-source fetches
+        self.http_client = http_client
         self.tasks: Dict[str, SqlTask] = {}
         self._lock = threading.Lock()
 
@@ -151,7 +178,8 @@ class SqlTaskManager:
             task = SqlTask(task_id, fragment, scan_shard, remote_sources,
                            n_output_partitions, broadcast_output,
                            self.registry, config,
-                           fetch_headers=self.fetch_headers)
+                           fetch_headers=self.fetch_headers,
+                           http_client=self.http_client)
             self.tasks[task_id] = task
             return task
 

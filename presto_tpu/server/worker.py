@@ -32,7 +32,9 @@ class WorkerServer:
                  config: EngineConfig = DEFAULT, port: int = 0,
                  node_id: str = "worker",
                  internal_secret: Optional[str] = None,
-                 location: str = ""):
+                 location: str = "",
+                 fault_injector=None, http_client=None):
+        from presto_tpu.server.errortracker import RetryingHttpClient
         from presto_tpu.server.security import InternalAuthenticator
 
         self.node_id = node_id
@@ -41,10 +43,20 @@ class WorkerServer:
         self.location = location
         self.internal_auth = (InternalAuthenticator(internal_secret)
                               if internal_secret else None)
+        # chaos substrate hook (server/faults.py): consulted before every
+        # request is dispatched; None in production
+        self.fault_injector = fault_injector
+        # node-wide error-tracked HTTP client: this worker's remote-source
+        # fetches retry transient producer failures with backoff
+        self.http = http_client or RetryingHttpClient(
+            max_error_duration_s=config.remote_request_max_error_duration_s,
+            min_backoff_s=config.remote_request_min_backoff_s,
+            max_backoff_s=config.remote_request_max_backoff_s)
         self.task_manager = SqlTaskManager(
             registry, config,
             fetch_headers=(self.internal_auth.header()
-                           if self.internal_auth else None))
+                           if self.internal_auth else None),
+            http_client=self.http)
         # graceful shutdown (GracefulShutdownHandler.java role): once
         # draining, new tasks are refused, /v1/info advertises
         # SHUTTING_DOWN so the coordinator stops scheduling here, and
@@ -66,6 +78,22 @@ class WorkerServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _fault(self, method: str) -> bool:
+                """True when the injector consumed this request (the
+                chaos hook: http-503 answered or connection dropped)."""
+                inj = worker.fault_injector
+                if inj is None:
+                    return False
+                hit = inj.apply_server(self.path, method)
+                if hit is None:
+                    return False
+                policy, rule = hit
+                if policy == "http-503":
+                    self._json(rule.status, {"error": "injected fault"})
+                else:  # drop-connection: no response bytes at all
+                    self.close_connection = True
+                return True
+
             def _internal_ok(self, parts) -> bool:
                 """Everything under /v1/task and /v1/query (create,
                 status, results, cancel) requires the cluster token when
@@ -85,6 +113,8 @@ class WorkerServer:
                 return False
 
             def do_GET(self):  # noqa: N802
+                if self._fault("GET"):
+                    return
                 parts = self.path.strip("/").split("/")
                 if parts[:2] == ["v1", "info"]:
                     self._json(200, {
@@ -139,11 +169,34 @@ class WorkerServer:
                 self.wfile.write(body)
 
             def do_POST(self):  # noqa: N802
+                if self._fault("POST"):
+                    return
                 parts = self.path.strip("/").split("/")
                 # intra-cluster auth: a worker only executes plans from
                 # peers holding the shared-secret token
                 # (InternalAuthenticationManager role)
                 if not self._internal_ok(parts):
+                    return
+                if (parts[:2] == ["v1", "task"] and len(parts) == 4
+                        and parts[3] == "remote-sources"):
+                    # mid-query task recovery: repoint this task's
+                    # remote-source fetches at a replacement producer.
+                    # Allowed while draining — it keeps queries already
+                    # running here alive.
+                    task = worker.task_manager.get(parts[2])
+                    if task is None:
+                        self._json(404, {"error": "no such task"})
+                        return
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        req = json.loads(self.rfile.read(n))
+                        old = str(req["old_prefix"])
+                        new = str(req["new_prefix"])
+                    except (KeyError, TypeError, ValueError) as e:
+                        self._json(400, {"error": f"bad repoint: {e}"})
+                        return
+                    status = task.repoint_remote_source(old, new)
+                    self._json(200, {"status": status})
                     return
                 if parts[:2] == ["v1", "task"] and worker.draining:
                     self._json(503, {"error": "worker is shutting down"})
@@ -185,6 +238,8 @@ class WorkerServer:
                 self._json(404, {"error": f"bad path {self.path}"})
 
             def do_PUT(self):  # noqa: N802
+                if self._fault("PUT"):
+                    return
                 parts = self.path.strip("/").split("/")
                 if not self._internal_ok(["v1", "task"]):
                     return
@@ -202,6 +257,8 @@ class WorkerServer:
                 self._json(404, {"error": f"bad path {self.path}"})
 
             def do_DELETE(self):  # noqa: N802
+                if self._fault("DELETE"):
+                    return
                 parts = self.path.strip("/").split("/")
                 if not self._internal_ok(parts):
                     return

@@ -67,11 +67,17 @@ class PhysicalPlanner:
                  config: EngineConfig = DEFAULT,
                  scan_shard: Optional[Tuple[int, int]] = None,
                  remote_sources: Optional[dict] = None,
-                 fetch_headers: Optional[dict] = None):
+                 fetch_headers: Optional[dict] = None,
+                 http_client=None, task_id: Optional[str] = None,
+                 exchange_register=None):
         """``scan_shard=(task_index, task_count)`` makes scans generate only
         this task's deterministic share of splits (distributed source
         stages, P5); ``remote_sources`` maps fragment id -> producer buffer
-        URLs for RemoteSourceNode lowering."""
+        URLs for RemoteSourceNode lowering.  ``http_client`` (a
+        RetryingHttpClient) carries the node's error-tracking/backoff
+        policy into exchange fetches; ``task_id`` labels their failures;
+        ``exchange_register`` receives each created ExchangeClient so the
+        owning task can repoint remote sources (mid-query recovery)."""
         self.registry = registry
         self.config = config
         self.scan_shard = scan_shard
@@ -79,6 +85,9 @@ class PhysicalPlanner:
         # intra-cluster auth headers for exchange fetches (per cluster,
         # not process-global: one process may host several clusters)
         self.fetch_headers = fetch_headers or {}
+        self.http_client = http_client
+        self.task_id = task_id
+        self.exchange_register = exchange_register
         self._done_pipelines: List[Pipeline] = []
         self._counter = 0
 
@@ -135,8 +144,12 @@ class PhysicalPlanner:
             locations: List[str] = []
             for fid in node.fragment_ids:
                 locations.extend(self.remote_sources.get(fid, ()))
-            return ([ExchangeOperatorFactory(
-                locations, headers=self.fetch_headers)], [])
+            fac = ExchangeOperatorFactory(
+                locations, headers=self.fetch_headers,
+                http=self.http_client, task_id=self.task_id)
+            if self.exchange_register is not None:
+                self.exchange_register(fac)
+            return ([fac], [])
         if isinstance(node, RemoteMergeNode):
             from presto_tpu.server.exchangeop import (
                 MergeExchangeOperatorFactory,
@@ -145,10 +158,14 @@ class PhysicalPlanner:
             locations = []
             for fid in node.fragment_ids:
                 locations.extend(self.remote_sources.get(fid, ()))
-            return ([MergeExchangeOperatorFactory(
+            fac = MergeExchangeOperatorFactory(
                 locations, node.sort_keys,
                 [t for _, t in node.columns], node.limit,
-                headers=self.fetch_headers)], [])
+                headers=self.fetch_headers, http=self.http_client,
+                task_id=self.task_id)
+            if self.exchange_register is not None:
+                self.exchange_register(fac)
+            return ([fac], [])
         if isinstance(node, ValuesNode):
             from presto_tpu.batch import batch_from_pylist
 

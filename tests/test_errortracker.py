@@ -1,0 +1,240 @@
+"""RequestErrorTracker / RetryingHttpClient / FaultInjector unit tier.
+
+Everything here runs on an injectable clock + sleeper: the whole backoff
+schedule and error budget are exercised without a single real delay
+(the reference's TestingTicker pattern for RequestErrorTracker)."""
+
+import io
+import urllib.error
+
+import pytest
+
+from presto_tpu.server.errortracker import (
+    RemoteRequestError, RequestErrorTracker, RetryingHttpClient,
+    is_retryable,
+)
+from presto_tpu.server.faults import FaultInjector, InjectedFault
+
+
+class FakeClock:
+    """Manual clock; sleeping advances it (so backoff time is counted
+    against the error budget exactly as wall time would be)."""
+
+    def __init__(self):
+        self.now = 1000.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+
+def _conn_refused():
+    return urllib.error.URLError(ConnectionRefusedError("refused"))
+
+
+def _http_error(code, body=b"boom"):
+    return urllib.error.HTTPError("http://x/y", code, "err", {},
+                                  io.BytesIO(body))
+
+
+def test_classification():
+    assert is_retryable(_conn_refused())
+    assert is_retryable(_http_error(503))
+    assert is_retryable(_http_error(502))
+    assert is_retryable(_http_error(504))
+    assert is_retryable(TimeoutError())
+    assert is_retryable(ConnectionResetError())
+    import http.client
+
+    assert is_retryable(http.client.RemoteDisconnected())
+    assert not is_retryable(_http_error(400))
+    assert not is_retryable(_http_error(500))
+    assert not is_retryable(_http_error(404))
+
+
+def test_backoff_schedule_deterministic():
+    clk = FakeClock()
+    t = RequestErrorTracker("http://w/v1/task/t1", task_id="q.0.1",
+                            max_error_duration_s=100.0,
+                            min_backoff_s=0.05, max_backoff_s=2.0,
+                            clock=clk, sleeper=clk.sleep)
+    for _ in range(8):
+        t.failed(_conn_refused())
+    # 0.05 * 2^n capped at 2.0
+    assert clk.sleeps == [0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 2.0, 2.0]
+
+
+def test_success_resets_budget():
+    clk = FakeClock()
+    t = RequestErrorTracker("http://w", max_error_duration_s=1.0,
+                            min_backoff_s=0.4, max_backoff_s=10.0,
+                            clock=clk, sleeper=clk.sleep)
+    t.failed(_conn_refused())
+    t.failed(_conn_refused())          # elapsed 0.4 < 1.0
+    t.succeeded()
+    # budget and backoff start over after a success
+    t.failed(_conn_refused())
+    assert clk.sleeps[-1] == 0.4
+    assert t.error_count == 1
+
+
+def test_budget_exhaustion_names_task_and_endpoint():
+    clk = FakeClock()
+    t = RequestErrorTracker("http://worker:1/v1/task/q.0.1/results/0",
+                            task_id="q.1.0",
+                            description="exchange fetch",
+                            max_error_duration_s=1.0,
+                            min_backoff_s=0.3, max_backoff_s=0.3,
+                            clock=clk, sleeper=clk.sleep)
+    with pytest.raises(RemoteRequestError) as ei:
+        for _ in range(10):
+            t.failed(_conn_refused())
+    e = ei.value
+    assert e.retryable
+    assert "q.1.0" in str(e)
+    assert "http://worker:1/v1/task/q.0.1/results/0" in str(e)
+    assert "error budget" in str(e)
+    # failures land at t=0, .3, .6, .9, 1.2 — the fifth crosses the
+    # 1.0s budget
+    assert e.error_count == 5
+
+
+def test_fatal_error_raises_immediately_with_body():
+    clk = FakeClock()
+    t = RequestErrorTracker("http://w/v1/task/t", task_id="q.0.0",
+                            clock=clk, sleeper=clk.sleep)
+    with pytest.raises(RemoteRequestError) as ei:
+        t.failed(_http_error(400, b'{"error": "bad task update"}'))
+    assert not ei.value.retryable
+    assert ei.value.status == 400
+    assert "bad task update" in str(ei.value)
+    assert clk.sleeps == []            # no backoff on fatal errors
+
+
+class FakeOpener:
+    """Scripted urlopen: pops the next outcome per call."""
+
+    def __init__(self, outcomes):
+        self.outcomes = list(outcomes)
+        self.calls = []
+
+    def __call__(self, req, timeout=None):
+        self.calls.append(req.full_url)
+        out = self.outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+
+        class Resp:
+            status = 200
+            headers = {}
+
+            def read(self_):
+                return out
+
+            def __enter__(self_):
+                return self_
+
+            def __exit__(self_, *a):
+                return False
+
+        return Resp()
+
+
+def _client(outcomes, clk, **kw):
+    return RetryingHttpClient(clock=clk, sleeper=clk.sleep,
+                              opener=FakeOpener(outcomes), **kw)
+
+
+def test_client_retries_transient_then_succeeds():
+    clk = FakeClock()
+    c = _client([_conn_refused(), _http_error(503), b"ok"], clk,
+                max_error_duration_s=60.0)
+    resp = c.request("http://w/v1/task/t", task_id="q.0.0")
+    assert resp.body == b"ok"
+    assert len(clk.sleeps) == 2        # two backoffs, no real time
+
+
+def test_client_budget_zero_single_attempt():
+    clk = FakeClock()
+    c = _client([_conn_refused(), b"never"], clk)
+    with pytest.raises(RemoteRequestError) as ei:
+        c.request("http://w/v1/task/t", max_error_duration_s=0.0)
+    assert ei.value.retryable
+    assert clk.sleeps == []
+
+
+def test_client_retry_cb_relocates_and_resets_budget():
+    clk = FakeClock()
+    c = _client([_conn_refused(), _conn_refused(), b"moved"], clk,
+                max_error_duration_s=600.0)
+
+    def relocate(exc):
+        return "http://replacement/v1/task/t/results/0/0"
+
+    resp = c.request("http://dead/v1/task/t/results/0/0",
+                     retry_cb=relocate)
+    assert resp.body == b"moved"
+    # second attempt already goes to the replacement
+    assert c.opener.calls[1].startswith("http://replacement/")
+
+
+def test_client_retry_cb_can_abort():
+    clk = FakeClock()
+    c = _client([_conn_refused()] * 5, clk, max_error_duration_s=600.0)
+
+    def abort(exc):
+        raise RuntimeError("Query killed")
+
+    with pytest.raises(RuntimeError, match="Query killed"):
+        c.request("http://w/x", retry_cb=abort)
+
+
+# ---------------------------------------------------------------------------
+# fault injector (client side; the server side is exercised in
+# tests/test_chaos.py against a real worker handler)
+# ---------------------------------------------------------------------------
+
+def test_injector_fail_n_times_then_clean():
+    clk = FakeClock()
+    inj = FaultInjector(sleeper=clk.sleep)
+    inj.add_rule(r"/results/", method="GET", policy="fail-n-times",
+                 times=2)
+    c = _client([b"page"], clk, injector=inj, max_error_duration_s=60.0)
+    resp = c.request("http://w/v1/task/t/results/0/0")
+    assert resp.body == b"page"
+    assert [p for _, _, p in inj.injections] == ["fail-n-times"] * 2
+
+
+def test_injector_http_503_is_retryable():
+    inj = FaultInjector()
+    inj.add_rule(r"/v1/task", method="POST", policy="http-503", times=1)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        inj.apply_client("http://w/v1/task/t", "POST")
+    assert ei.value.code == 503
+    assert is_retryable(ei.value)
+    # consumed: second request passes
+    inj.apply_client("http://w/v1/task/t", "POST")
+
+
+def test_injector_method_and_pattern_keying():
+    inj = FaultInjector()
+    inj.add_rule(r"/v1/task/[^/]+$", method="DELETE",
+                 policy="drop-connection")
+    inj.apply_client("http://w/v1/task/t/results/0/0", "GET")  # no match
+    inj.apply_client("http://w/v1/task/t", "GET")              # method
+    with pytest.raises(InjectedFault) as ei:
+        inj.apply_client("http://w/v1/task/t", "DELETE")
+    # injected drops classify exactly like real transport failures
+    assert is_retryable(ei.value)
+
+
+def test_injector_delay_uses_injected_sleeper():
+    clk = FakeClock()
+    inj = FaultInjector(sleeper=clk.sleep)
+    inj.add_rule(r"/results/", policy="delay", delay_s=7.5, times=1)
+    inj.apply_client("http://w/v1/task/t/results/0/0", "GET")
+    assert clk.sleeps == [7.5]

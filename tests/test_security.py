@@ -327,7 +327,11 @@ def test_jwt_bearer_against_live_coordinator():
         r.register("tpch", TpchConnector(scale=0.001))
         return r
 
-    w = WorkerServer(reg2(), co.uri, internal_secret="cs")
+    # (co.uri was accidentally passed as the ``config`` positional here;
+    # harmless while config attributes were only read lazily, an
+    # AttributeError now that WorkerServer builds its HTTP client from
+    # config at construction)
+    w = WorkerServer(reg2(), internal_secret="cs")
     try:
         def post(headers):
             req = urllib.request.Request(

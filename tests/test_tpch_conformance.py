@@ -216,6 +216,51 @@ def _strip_union_parens(sql: str) -> str:
     return sql
 
 
+_DECIMAL_CAST_TAIL = re.compile(
+    r"(?i)\bas\s+decimal\s*(?:\(\s*\d+\s*(?:,\s*\d+\s*)?\))?\s*$")
+
+
+def _decimal_division_casts_to_real(sql: str) -> str:
+    """Rewrite ``CAST(x AS DECIMAL(p, s))`` to ``CAST(x AS REAL)`` only
+    when the cast is an operand of ``/`` (the one context where sqlite's
+    integer division diverges from decimal division).  Other decimal
+    casts are left intact (ROADMAP #9: the global rewrite masked
+    fixed-point semantics everywhere)."""
+    def match_fwd(s, open_):
+        depth = 0
+        for i in range(open_, len(s)):
+            if s[i] == "(":
+                depth += 1
+            elif s[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    return i
+        return -1
+
+    casts = []                       # (open paren idx, close paren idx)
+    for m in re.finditer(r"(?i)\bcast\s*\(", sql):
+        close = match_fwd(sql, m.end() - 1)
+        if close >= 0:
+            casts.append((m.start(), m.end() - 1, close))
+    # rewrite right-to-left so earlier offsets stay valid
+    for start, op, close in reversed(casts):
+        inner = sql[op + 1:close]
+        tail = _DECIMAL_CAST_TAIL.search(inner)
+        if tail is None:
+            continue
+        j = start - 1                # char before CAST, skipping spaces
+        while j >= 0 and sql[j].isspace():
+            j -= 1
+        k = close + 1                # char after ')', skipping spaces
+        while k < len(sql) and sql[k].isspace():
+            k += 1
+        if (j < 0 or sql[j] != "/") and (k >= len(sql) or sql[k] != "/"):
+            continue                 # not a division operand: keep
+        new_inner = inner[:tail.start()] + "as real"
+        sql = sql[:op + 1] + new_inner + sql[close:]
+    return sql
+
+
 def to_sqlite_sql(sql: str) -> str:
     # quoted function names ("sum"(...) in the benchto texts) are
     # identifiers to sqlite — unquote them
@@ -223,13 +268,15 @@ def to_sqlite_sql(sql: str) -> str:
     sql = _strip_union_parens(sql)
     # DECIMAL '1.2' typed literals -> plain numeric literal
     sql = re.sub(r"(?i)\bdecimal\s+'(-?[0-9.]+)'", r"\1", sql)
-    # CAST(x AS DECIMAL(p, s)) -> CAST(x AS REAL): sqlite NUMERIC
-    # affinity keeps integers integral, so q75's
+    # CAST(x AS DECIMAL(p, s)) -> CAST(x AS REAL), division contexts
+    # only: sqlite NUMERIC affinity keeps integers integral, so q75's
     # cast(cnt as decimal)/cast(cnt as decimal) would integer-divide
     # (61/62 = 0) and wrongly pass the < 0.9 filter the engine's real
-    # decimal division correctly rejects
-    sql = re.sub(r"(?i)\bas\s+decimal\s*\(\s*\d+\s*(?:,\s*\d+\s*)?\)",
-                 "as real", sql)
+    # decimal division correctly rejects.  Elsewhere (q05's typed zero
+    # columns, q18's avg inputs) the decimal cast keeps its NUMERIC
+    # affinity so the oracle exercises the same fixed-point semantics
+    # as the engine instead of drifting through binary floats.
+    sql = _decimal_division_casts_to_real(sql)
     sql = _DATE_ARITH.sub(
         lambda m: "'" + _shift_date(m.group(1), m.group(2),
                                     int(m.group(3)), m.group(4)) + "'",
