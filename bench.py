@@ -19,6 +19,12 @@ reference's CPU operator pipeline — its codegen also reduces to tight
 CPU loops over columnar arrays; the reference publishes no absolute
 numbers, BASELINE.md).
 
+Config 6 (``bench_engine_q1q6``) measures the SHIPPED engine: TPC-H Q1 +
+Q6 SQL through LocalQueryRunner (planner + operator tier + pipeline
+fusion), reported in ``extras`` next to the hand-kernel configs so the
+artifact tracks what the engine executes, not just what hand-built
+kernels can reach (ROADMAP #10).
+
 Timing methodology (axon tunnel quirks): run K dependence-chained
 iterations INSIDE one jitted fori_loop and take the slope between two K
 values, so RPC overhead and sync-polling granularity cancel.
@@ -827,6 +833,90 @@ def bench_q3_chunked(scale: float, chunk_orders: int = 1 << 24):
     }
 
 
+# ---------------------------------------------------------------------------
+# Config 6: the SHIPPED ENGINE path (SQL text -> planner -> operator tier)
+# ---------------------------------------------------------------------------
+
+ENGINE_Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+       avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+       avg(l_discount) as avg_disc, count(*) as count_order
+from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus
+"""
+
+ENGINE_Q6 = """
+select sum(l_extendedprice * l_discount) as revenue from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.05 and 0.07 and l_quantity < 24
+"""
+
+
+def bench_engine_q1q6(scale: float):
+    """TPC-H Q1 + Q6 through the SHIPPED SQL runner (parser -> optimizer
+    -> operator tier with pipeline fusion), so the artifact measures what
+    the engine actually executes — not hand-built kernels.  Reports warm
+    rows/s per query, the fused-vs-unfused wall ratio, and the jit
+    dispatch counters the fusion tier halves (ROADMAP #10)."""
+    import dataclasses as dc
+
+    from presto_tpu.config import EngineConfig
+    from presto_tpu.localrunner import LocalQueryRunner
+
+    runner = LocalQueryRunner.tpch(scale=scale)
+    runner_off = LocalQueryRunner.tpch(scale=scale, config=dc.replace(
+        EngineConfig(), pipeline_fusion=False))
+    n_rows = runner.execute(
+        "select count(*) from lineitem").rows[0][0]
+
+    def timed(r, sql):
+        r.execute(sql)                      # compile + warm caches
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res = r.execute(sql)
+            best = min(best, time.perf_counter() - t0)
+        return best, res, r._last_task.jit_counters()
+
+    q1_s, q1_res, q1_jit = timed(runner, ENGINE_Q1)
+    q6_s, q6_res, q6_jit = timed(runner, ENGINE_Q6)
+    q1_off_s, q1_off_res, q1_off_jit = timed(runner_off, ENGINE_Q1)
+    q6_off_s, q6_off_res, _ = timed(runner_off, ENGINE_Q6)
+
+    def close(a, b):
+        if len(a) != len(b):
+            return False
+        for ra, rb in zip(sorted(a, key=repr), sorted(b, key=repr)):
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float):
+                    if not np.isclose(va, vb, rtol=1e-6):
+                        return False
+                elif va != vb:
+                    return False
+        return True
+
+    parity = close(q1_res.rows, q1_off_res.rows) and \
+        close(q6_res.rows, q6_off_res.rows)
+    return {
+        "metric": f"tpch_sf{scale:g}_q1_engine_rows_per_sec",
+        "value": round(n_rows / q1_s, 1), "unit": "rows/s",
+        # baseline for the engine path = the same engine with pipeline
+        # fusion off (per-operator dispatch, the pre-fusion engine)
+        "vs_baseline": round(q1_off_s / q1_s, 3),
+        "engine_path": True,
+        "q6_rows_per_sec": round(n_rows / q6_s, 1),
+        "q6_speedup_vs_unfused": round(q6_off_s / q6_s, 3),
+        "jit_dispatches": {"q1_fused": q1_jit["dispatches"],
+                           "q1_unfused": q1_off_jit["dispatches"],
+                           "q6_fused": q6_jit["dispatches"]},
+        "parity": parity,
+    }
+
+
 def bench_sqlite_baseline(scale: float):
     """External (non-self-authored) CPU baseline: the sqlite3 engine over
     IDENTICAL generated data, per BASELINE.md's measurement note — the
@@ -989,6 +1079,7 @@ def main() -> None:
         jobs = [(bench_q6, 0.1, 0.0), (bench_q3, 0.1, 0.0),
                 (bench_q9, 0.1, 0.0), (bench_q17, 0.1, 0.0),
                 (bench_q3_chunked, 0.2, 0.0),
+                (bench_engine_q1q6, 0.05, 0.0),
                 (bench_sqlite_baseline, 0.05, 0.0)]
         _emit(_run_jobs(headline, jobs, budget_s))
         return
@@ -1006,6 +1097,7 @@ def main() -> None:
     # SF100 configs (BASELINE.json) are measured either way.
     jobs = [(bench_q6, 10.0, 0.0), (bench_q3, 1.0, 0.0),
             (bench_q9, 1.0, 0.0), (bench_q17, 1.0, 0.0),
+            (bench_engine_q1q6, 1.0, 0.0),
             (bench_whole_query_q3, 0.1, 0.0),
             (bench_sqlite_baseline, 0.2, 0.0),
             (bench_q3, 10.0, 0.65),

@@ -45,20 +45,32 @@ def next_bucket(n: int, minimum: int = 1024) -> int:
     return 1 << (cap - 1).bit_length()
 
 
+import itertools as _itertools
+
+# process-unique monotonic dictionary identities: kernel caches key
+# compiled programs by the dictionary BINDING, and keying on id() is
+# unsound — a GC'd dictionary's address can be reused by a new one,
+# silently hitting a kernel compiled against the old dictionary's codes.
+# next() on an itertools.count is atomic under the GIL.
+_DICT_TOKENS = _itertools.count(1)
+
+
 class Dictionary:
     """A host-side value dictionary for string-ish columns.
 
     Append-only interning table: code -> value and value -> code.  Shared by
     reference between columns; never mutated through a Column (codes remain
-    stable), so sharing is safe.
+    stable), so sharing is safe.  ``token`` is a process-unique monotonic
+    identity for cache keying (never reused, unlike id()).
     """
 
-    __slots__ = ("values", "_index", "_lock")
+    __slots__ = ("values", "token", "_index", "_lock")
 
     def __init__(self, values: Sequence[str] = ()):  # noqa: D401
         import threading
 
         self.values: List[str] = list(values)
+        self.token: int = next(_DICT_TOKENS)
         self._index = {v: i for i, v in enumerate(self.values)}
         # concurrent feed drivers (LocalExchange tier) may intern into a
         # shared dictionary; appends must stay code-stable

@@ -158,6 +158,21 @@ class EngineConfig:
     # Build-side key domains prune probe rows before the join kernel
     # (DynamicFilterSourceOperator role, SURVEY §2.6).
     dynamic_filtering_enabled: bool = True
+    # Pipeline fusion (exec/fusion.py): compile maximal runs of adjacent
+    # row-local operators (chained FilterProjects, dynamic-filter
+    # application, the partition-id hash feeding PartitionedOutput) into
+    # ONE jitted segment program per batch — the cross-operator
+    # generalization of the reference's generated PageProcessor loop.
+    # Scan-adjacent segments additionally coalesce small per-split scan
+    # batches up to scan_batch_rows before dispatching (the
+    # ScanFilterAndProjectOperator role).  OFF restores today's
+    # per-operator dispatch exactly.
+    pipeline_fusion: bool = True
+    # LRU capacity for the shared compiled-kernel caches (filter/project,
+    # fused segments, dynamic filter, aggregation...).  Caches are
+    # process-global; this is applied as the process default when a query
+    # starts (kernelcache.set_default_capacity).
+    kernel_cache_capacity: int = 256
     # Whole-query execution: compile supported queries into ONE XLA
     # program (the parallel/sqlmesh lowering on a single-device mesh)
     # instead of per-operator dispatches — repeat executions are a

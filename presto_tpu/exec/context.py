@@ -76,6 +76,13 @@ class OperatorStats:
     output_rows: int = 0
     wall_ns: int = 0
     finish_wall_ns: int = 0
+    # row-pipeline-tier device program accounting (FilterProject,
+    # DynamicFilter, FusedSegment): one dispatch per jitted-program
+    # launch, one compile per kernel-cache miss that built a program.
+    # Tests assert pipeline fusion's launch-count reduction on these
+    # instead of eyeballing traces.
+    jit_dispatches: int = 0
+    jit_compiles: int = 0
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -97,6 +104,14 @@ class TaskContext:
         self.memory = MemoryContext(query.memory, f"task:{task_id}")
         self.operator_stats: List[OperatorStats] = []
         self._cleanups: List = []
+
+    def jit_counters(self) -> Dict[str, int]:
+        """Task-level rollup of row-pipeline jit dispatch/compile counts
+        (the launch-count surface the fusion tests pin)."""
+        return {
+            "dispatches": sum(s.jit_dispatches for s in self.operator_stats),
+            "compiles": sum(s.jit_compiles for s in self.operator_stats),
+        }
 
     def register_cleanup(self, fn) -> None:
         """Register an idempotent resource-release callback to run at task

@@ -33,7 +33,7 @@ from presto_tpu.kernelcache import cache_get, cache_put, new_cache
 
 # jitted dynamic-filter programs, shared across queries (values are
 # arguments, not constants — see _kernel_for)
-_DF_KERNELS = new_cache()
+_DF_KERNELS = new_cache("dynamic_filter")
 
 # exact-set filtering only below this many distinct build keys
 MAX_DISTINCT_SET = 4096
@@ -122,6 +122,7 @@ class DynamicFilterOperator(Operator):
         hit = cache_get(_DF_KERNELS, key)
         if hit is not None:
             return hit
+        self.ctx.stats.jit_compiles += 1
         import jax.numpy as jnp
 
         from presto_tpu.ops.filter import selected_positions
@@ -170,6 +171,7 @@ class DynamicFilterOperator(Operator):
         kernel = self._kernel_for(batch, filters)
         from presto_tpu.exec.operator import column_pairs
 
+        self.ctx.stats.jit_dispatches += 1
         bounds = tuple((mn, mx) for _, mn, mx, _ in filters)
         tables = tuple(st for _, _, _, st in filters if st is not None)
         outs, count = kernel(tuple(column_pairs(batch)), batch.num_rows,

@@ -1,0 +1,518 @@
+"""Pipeline fusion: one jitted XLA program per run of row-local operators.
+
+The reference's performance tier is runtime code generation — its
+``ExpressionCompiler``/``PageProcessor`` fuse a filter and all its
+projections into one generated loop per page (survey §2.7).  The engine
+already matches the intra-operator half (``FilterProjectOperator`` jits
+filter+projections together), but a fragment still executed as a chain of
+independently-jitted dispatches with a Python driver hop between every
+adjacent operator pair, so intermediates round-tripped through HBM (and
+sometimes host) at each hop.
+
+This module is the cross-operator generalization: at fragment-lowering
+time ``fuse_pipelines`` identifies maximal runs of adjacent row-local,
+jit-able operator factories —
+
+- chained ``FilterProject``s (stacked optimizer Projects, join residuals,
+  aggregation finalize projections),
+- dynamic-filter application (``DynamicFilterOperator``),
+- the partial-aggregation input projection (an ordinary FilterProject),
+- the hash/partition-id computation feeding ``PartitionedOutputOperator``
+
+— and compiles each run into ONE jitted segment program executed once per
+batch.  Inside a segment, consecutive filters combine into one
+accumulated mask with a single gather at the end, projection
+intermediates never materialize (XLA fuses the elementwise chains), and
+the exchange sink's partition ids ride along as one extra output.
+
+Scan-adjacent segments additionally take over the scan staging (the
+``ScanFilterAndProjectOperator`` role): the scan hands over raw host
+batches and the segment coalesces them up to ``scan_batch_rows`` before
+staging + dispatching once, so many tiny per-split batches cost one
+launch instead of one each.  Dictionary columns are re-coded into a
+per-operator target dictionary so coalesced flushes share one compiled
+program.
+
+Segment programs are cached globally (``kernelcache``) keyed by segment
+expression keys + capacity bucket + dictionary binding (token, length) +
+the dynamic-filter value shape — the same keying discipline as
+``_FP_KERNELS``.  Gated by ``EngineConfig.pipeline_fusion`` (default on;
+off restores per-operator dispatch exactly).
+
+What breaks a segment: any non-row-local operator (aggregation, join,
+sort, exchange, limit), expressions that need the host path (nested
+types, row-wise string fallbacks), and nested input/output types.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, Dictionary, next_bucket
+from presto_tpu.exec.context import OperatorContext
+from presto_tpu.exec.dynamicfilter import (
+    DynamicFilter, DynamicFilterOperatorFactory,
+)
+from presto_tpu.exec.operator import Operator, OperatorFactory, column_pairs
+from presto_tpu.exec.operators import (
+    FilterProjectOperatorFactory, TableScanOperatorFactory,
+    dictionary_binding_key,
+)
+from presto_tpu.expr.compile import ExprCompiler, needs_host_path
+from presto_tpu.expr.ir import RowExpression
+from presto_tpu.kernelcache import cache_get, cache_put, new_cache
+
+# compiled segment programs, shared globally across queries/operators
+_SEG_KERNELS = new_cache("fused_segment")
+
+
+# ---------------------------------------------------------------------------
+# segment stages
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FPStage:
+    """One filter+projections step (a FilterProjectOperator's work)."""
+
+    filter_expr: Optional[RowExpression]
+    projections: Tuple[RowExpression, ...]
+    input_types: Tuple[T.Type, ...]
+
+    def key(self) -> tuple:
+        return ("fp", self.filter_expr, self.projections, self.input_types)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class DFStage:
+    """Dynamic-filter application over the current channel space.
+
+    The filter VALUES (bounds, IN-set tables) are runtime kernel
+    arguments, never trace constants; only the value *shape* (which
+    channels are bounded, which have exact sets) keys the program.
+    Adaptive shutoff is intentionally absent: it existed to avoid an
+    extra per-batch dispatch, and inside a fused segment the filter
+    costs no extra launch.
+    """
+
+    dyn: DynamicFilter
+    key_channels: Tuple[int, ...]
+
+    def key(self) -> tuple:
+        return ("df", self.key_channels)
+
+
+def _stage_of(factory) -> object:
+    if isinstance(factory, FilterProjectOperatorFactory):
+        return FPStage(factory.filter_expr, tuple(factory.projections),
+                       tuple(factory.input_types))
+    if isinstance(factory, DynamicFilterOperatorFactory):
+        return DFStage(factory.dyn, tuple(factory.key_channels))
+    raise TypeError(f"not a fusable factory: {type(factory).__name__}")
+
+
+def _fp_jitable(f: FilterProjectOperatorFactory) -> bool:
+    """True when the stage can run inside a jitted segment (mirrors the
+    FilterProjectOperator host-path eligibility, decided statically)."""
+    if needs_host_path([f.filter_expr] + list(f.projections)):
+        return False
+    if any(t.is_nested for t in f.input_types):
+        return False
+    if any(p.type.is_nested for p in f.projections):
+        return False
+    return True
+
+
+def _fusable(f) -> bool:
+    if isinstance(f, DynamicFilterOperatorFactory):
+        return True
+    if isinstance(f, FilterProjectOperatorFactory):
+        return _fp_jitable(f)
+    return False
+
+
+def _partition_spec(sink) -> Optional[Tuple[Tuple[int, ...], int]]:
+    """(channels, n_partitions) when ``sink`` is a hash-partitioned
+    output whose partition ids a segment can precompute."""
+    try:
+        from presto_tpu.server.exchangeop import (
+            PartitionedOutputOperatorFactory,
+        )
+    except Exception:  # noqa: BLE001 - server tier absent in slim envs
+        return None
+    if (isinstance(sink, PartitionedOutputOperatorFactory)
+            and sink.n_partitions > 1 and sink.channels):
+        return (tuple(sink.channels), sink.n_partitions)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the fusion pass
+# ---------------------------------------------------------------------------
+
+def fuse_chain(factories: List[OperatorFactory], config
+               ) -> List[OperatorFactory]:
+    """Replace maximal runs of fusable factories with FusedSegment
+    factories.  A run fuses when it is ≥ 2 operators, or rides directly
+    on a device-staging TableScan (scan coalescing), or feeds a
+    hash-partitioned output (partition-id fusion); it must contain at
+    least one FilterProject stage (the segment's type anchor)."""
+    result: List[OperatorFactory] = []
+    n = len(factories)
+    i = 0
+    while i < n:
+        if not _fusable(factories[i]):
+            result.append(factories[i])
+            i += 1
+            continue
+        j = i
+        while j < n and _fusable(factories[j]):
+            j += 1
+        run = factories[i:j]
+        stages = [_stage_of(f) for f in run]
+        has_fp = any(isinstance(s, FPStage) for s in stages)
+        scan = (result[-1] if result
+                and isinstance(result[-1], TableScanOperatorFactory)
+                and result[-1].to_device else None)
+        partition = _partition_spec(factories[j]) if j < n else None
+        if not has_fp or (len(run) < 2 and scan is None
+                          and partition is None):
+            result.extend(run)
+            i = j
+            continue
+        coalesce_rows = 0
+        if scan is not None:
+            # the segment takes over staging: the scan now hands over
+            # raw host batches (ScanFilterAndProjectOperator role)
+            result[-1] = TableScanOperatorFactory(
+                scan.connector, scan.columns, scan.batch_rows,
+                to_device=False, table=scan.table)
+            coalesce_rows = config.scan_batch_rows
+        if partition is not None:
+            factories[j].precomputed = True
+        result.append(FusedSegmentOperatorFactory(
+            stages, coalesce_rows=coalesce_rows, partition_spec=partition,
+            min_batch_capacity=config.min_batch_capacity))
+        i = j
+    return result
+
+
+def fuse_pipelines(pipelines: Sequence, config) -> None:
+    """Apply the fusion pass to every lowered pipeline, in place.  Runs
+    after all lowering decisions (streaming-agg eligibility, grouped
+    execution, dynamic-filter placement) were made on the unfused
+    chains."""
+    for p in pipelines:
+        p.factories = fuse_chain(p.factories, config)
+
+
+# ---------------------------------------------------------------------------
+# the fused operator
+# ---------------------------------------------------------------------------
+
+class _ColView:
+    """values/valid/type/dictionary holder for ops.hashing inside a
+    traced segment program."""
+
+    __slots__ = ("values", "valid", "type", "dictionary")
+
+    def __init__(self, values, valid, typ, dictionary):
+        self.values = values
+        self.valid = valid
+        self.type = typ
+        self.dictionary = dictionary
+
+
+class FusedSegmentOperator(Operator):
+    """Executes a fused run of row-local stages as one jitted program per
+    batch; optionally coalesces host scan batches first."""
+
+    def __init__(self, ctx: OperatorContext, stages: Sequence,
+                 coalesce_rows: int, partition_spec, min_batch_capacity):
+        super().__init__(ctx)
+        self.stages = list(stages)
+        self.partition_spec = partition_spec
+        self._expr_key = tuple(s.key() for s in stages)
+        self._coalesce = int(coalesce_rows)
+        self._min_capacity = int(min_batch_capacity)
+        self._pending: Optional[Batch] = None     # device-batch path
+        # host-coalescing path state
+        self._acc: List[List[tuple]] = []          # per-flush batch parts
+        self._acc_rows = 0
+        self._targets: Optional[List[Optional[Dictionary]]] = None
+        self._col_types: Optional[List[T.Type]] = None
+
+    # -- protocol --------------------------------------------------------
+    def needs_input(self) -> bool:
+        if self._finishing:
+            return False
+        if self._coalesce:
+            return self._acc_rows < self._coalesce
+        return self._pending is None
+
+    def add_input(self, batch: Batch) -> None:
+        self.ctx.stats.input_batches += 1
+        self.ctx.stats.input_rows += batch.num_rows
+        if not self._coalesce:
+            self._pending = batch
+            return
+        self._accumulate(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        if self._coalesce:
+            if self._acc_rows >= self._coalesce or (
+                    self._finishing and self._acc_rows > 0):
+                return self._emit(self._dispatch(self._flush()))
+            return None
+        if self._pending is None:
+            return None
+        batch, self._pending = self._pending, None
+        return self._emit(self._dispatch(batch))
+
+    def _emit(self, out: Optional[Batch]) -> Optional[Batch]:
+        if out is None:
+            return None
+        self.ctx.stats.output_batches += 1
+        self.ctx.stats.output_rows += out.num_rows
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None \
+            and self._acc_rows == 0
+
+    # -- host coalescing (scan-adjacent segments) ------------------------
+    def _accumulate(self, batch: Batch) -> None:
+        batch = batch.to_numpy()
+        n = batch.num_rows
+        if self._targets is None:
+            # adopt the first batch's dictionaries as the per-operator
+            # interning targets (append-only, so codes stay stable)
+            self._targets = [c.dictionary for c in batch.columns]
+            self._col_types = [c.type for c in batch.columns]
+        parts = []
+        for ci, c in enumerate(batch.columns):
+            vals = np.asarray(c.values)[:n]
+            target = self._targets[ci]
+            if c.dictionary is not None and c.dictionary is not target:
+                remap = c.dictionary.remap_into(target)
+                if len(remap):
+                    vals = remap[vals]
+            valid = None if c.valid is None else np.asarray(c.valid)[:n]
+            parts.append((vals, valid))
+        self._acc.append(parts)
+        self._acc_rows += n
+        self.ctx.memory.set_bytes(
+            sum(v.nbytes for p in self._acc for v, _ in p))
+
+    def _flush(self) -> Batch:
+        ncols = len(self._col_types)
+        rows = self._acc_rows
+        cols = []
+        for ci in range(ncols):
+            vals = np.concatenate([p[ci][0] for p in self._acc]) \
+                if len(self._acc) > 1 else self._acc[0][ci][0]
+            valids = [p[ci][1] for p in self._acc]
+            if any(v is not None for v in valids):
+                valid = np.concatenate([
+                    v if v is not None
+                    else np.ones(p[ci][0].shape[0], bool)
+                    for p, v in zip(self._acc, valids)])
+            else:
+                valid = None
+            cols.append(Column(self._col_types[ci], vals, valid,
+                               self._targets[ci]))
+        self._acc = []
+        self._acc_rows = 0
+        self.ctx.memory.set_bytes(0)
+        batch = Batch(tuple(cols), rows)
+        return batch.pad_rows(next_bucket(rows, self._min_capacity))
+
+    # -- dispatch --------------------------------------------------------
+    def _df_snapshot(self):
+        """Per-DF-stage (shape, args): shape keys the program, args carry
+        the values.  Returns None when an empty build makes the whole
+        segment output empty (inner-join semantics)."""
+        shapes, args = [], []
+        for s in self.stages:
+            if not isinstance(s, DFStage):
+                continue
+            dyn = s.dyn
+            if not dyn.ready or dyn.disabled:
+                shapes.append(("off",))
+                args.append(((), ()))
+                continue
+            if dyn.build_empty:
+                return None
+            chans, has_set, bounds, tables = [], [], [], []
+            for i, ch in enumerate(s.key_channels):
+                if dyn.mins[i] is None:
+                    continue
+                chans.append(ch)
+                st = dyn.sets[i]
+                has_set.append(st is not None)
+                bounds.append((np.asarray(dyn.mins[i]),
+                               np.asarray(dyn.maxs[i])))
+                if st is not None:
+                    tables.append(st)
+            shapes.append((tuple(chans), tuple(has_set)))
+            args.append((tuple(bounds), tuple(tables)))
+        return tuple(shapes), tuple(args)
+
+    def _dispatch(self, batch: Batch) -> Optional[Batch]:
+        snap = self._df_snapshot()
+        if snap is None:
+            return None      # empty build: nothing can survive the join
+        df_shapes, df_args = snap
+        part_n = self.partition_spec[1] if self.partition_spec else 0
+        key = (self._expr_key, batch.capacity,
+               dictionary_binding_key(batch.columns), df_shapes, part_n)
+        entry = cache_get(_SEG_KERNELS, key)
+        if entry is None:
+            entry = self._compile(batch, df_shapes)
+            cache_put(_SEG_KERNELS, key, entry)
+            self.ctx.stats.jit_compiles += 1
+        fn, out_meta = entry
+        self.ctx.stats.jit_dispatches += 1
+        outs, count, parts = fn(tuple(column_pairs(batch)),
+                                batch.num_rows, df_args)
+        n = int(count)
+        if n == 0:
+            return None
+        cols = tuple(Column(typ, v, valid, d)
+                     for (typ, d), (v, valid) in zip(out_meta, outs))
+        if parts is not None:
+            cols = cols + (Column(T.INTEGER, parts),)
+        return Batch(cols, n)
+
+    def _compile(self, batch: Batch, df_shapes):
+        import jax
+
+        # stage-by-stage expression compilation: each stage's dictionary
+        # bindings are the previous stage's projection output
+        # dictionaries (stage 0 binds the batch's columns)
+        dicts = {i: c.dictionary for i, c in enumerate(batch.columns)
+                 if c.dictionary is not None}
+        progs = []
+        out_meta = [(c.type, c.dictionary) for c in batch.columns]
+        di = 0
+        for stage in self.stages:
+            if isinstance(stage, FPStage):
+                compiler = ExprCompiler(dicts)
+                cfilter = (compiler.compile(stage.filter_expr)
+                           if stage.filter_expr is not None else None)
+                cprojs = [compiler.compile(p) for p in stage.projections]
+                progs.append(("fp", cfilter, cprojs))
+                dicts = {i: cp.dictionary for i, cp in enumerate(cprojs)
+                         if cp.dictionary is not None}
+                out_meta = [(cp.type, cp.dictionary) for cp in cprojs]
+            else:
+                progs.append(("df", df_shapes[di]))
+                di += 1
+        cap = batch.capacity
+        partition = self.partition_spec
+
+        def kernel(cols, num_rows, df_args):
+            import jax.numpy as jnp
+
+            from presto_tpu.ops.filter import selected_positions
+
+            mask = None
+            cur = tuple(cols)
+            dfi = 0
+            for prog in progs:
+                if prog[0] == "fp":
+                    _, cfilter, cprojs = prog
+                    if cfilter is not None:
+                        fv, fvalid = cfilter.run(cur, num_rows, jnp)
+                        m = fv if fvalid is None else fv & fvalid
+                        mask = m if mask is None else mask & m
+                    cur = tuple(p.run(cur, num_rows, jnp) for p in cprojs)
+                else:
+                    shape = prog[1]
+                    bounds, tables = df_args[dfi]
+                    dfi += 1
+                    if shape == ("off",) or not shape[0]:
+                        continue
+                    chans, has_set = shape
+                    ti = 0
+                    for k, ch in enumerate(chans):
+                        v, valid = cur[ch]
+                        mn, mx = bounds[k]
+                        m = ((v >= mn.astype(v.dtype))
+                             & (v <= mx.astype(v.dtype)))
+                        if has_set[k]:
+                            table = tables[ti].astype(v.dtype)
+                            ti += 1
+                            idx = jnp.clip(jnp.searchsorted(table, v), 0,
+                                           table.shape[0] - 1)
+                            m = m & (table[idx] == v)
+                        if valid is not None:
+                            m = m & valid
+                        mask = m if mask is None else mask & m
+            if mask is not None:
+                # ONE compaction for the whole segment: every stage's
+                # filter landed in the accumulated mask, so unselected
+                # rows were computed over (harmless, like padding rows)
+                # but never gathered or materialized
+                idx, count = selected_positions(mask, None, num_rows, cap)
+                cur = tuple(
+                    (v[idx], None if valid is None else valid[idx])
+                    for v, valid in cur)
+            else:
+                count = num_rows
+            parts = None
+            if partition is not None:
+                from presto_tpu.ops.hashing import (
+                    partition_of, row_hash, value_hash_triple,
+                )
+
+                channels, nparts = partition
+                triples = []
+                for ch in channels:
+                    v, valid = cur[ch]
+                    typ, d = out_meta[ch]
+                    triples.append(value_hash_triple(
+                        _ColView(v, valid, typ, d)))
+                parts = partition_of(row_hash(triples), nparts)
+            return cur, count, parts
+
+        return jax.jit(kernel), list(out_meta)
+
+
+class FusedSegmentOperatorFactory(OperatorFactory):
+    parallel_safe = True
+
+    def __init__(self, stages: Sequence, coalesce_rows: int = 0,
+                 partition_spec=None, min_batch_capacity: int = 1024):
+        self.stages = list(stages)
+        self.coalesce_rows = coalesce_rows
+        self.partition_spec = partition_spec
+        self.min_batch_capacity = min_batch_capacity
+
+    def create(self, ctx: OperatorContext) -> FusedSegmentOperator:
+        return FusedSegmentOperator(ctx, self.stages, self.coalesce_rows,
+                                    self.partition_spec,
+                                    self.min_batch_capacity)
+
+    def describe(self) -> str:
+        """Human-readable stage summary (tools/fusion_report.py)."""
+        parts = []
+        for s in self.stages:
+            if isinstance(s, FPStage):
+                parts.append(
+                    "fp(filter=%s, %d proj)" % (
+                        "yes" if s.filter_expr is not None else "no",
+                        len(s.projections)))
+            else:
+                parts.append("df(keys=%s)" % (list(s.key_channels),))
+        extra = []
+        if self.coalesce_rows:
+            extra.append(f"coalesce={self.coalesce_rows}")
+        if self.partition_spec:
+            extra.append("partition=%dx%s" % (
+                self.partition_spec[1], list(self.partition_spec[0])))
+        tail = (" [" + ", ".join(extra) + "]") if extra else ""
+        return "FusedSegment{" + " -> ".join(parts) + "}" + tail

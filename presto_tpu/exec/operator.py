@@ -137,9 +137,9 @@ def device_concat(batches: Sequence[Batch], min_capacity: int = 1024) -> Batch:
     return pad_batch(merged, min_capacity)
 
 
-from collections import OrderedDict as _OrderedDict
+from presto_tpu.kernelcache import new_cache as _new_cache
 
-_CONCAT_PROGRAMS: "_OrderedDict[tuple, object]" = _OrderedDict()
+_CONCAT_PROGRAMS = _new_cache("device_concat")
 
 
 def _device_concat_fast(live: Sequence[Batch],

@@ -115,18 +115,31 @@ class ValuesOperatorFactory(OperatorFactory):
 
 from presto_tpu.kernelcache import cache_get as _cache_get
 from presto_tpu.kernelcache import cache_put as _cache_put
-
+from presto_tpu.kernelcache import new_cache as _new_cache
 
 # Compiled filter/project kernels shared GLOBALLY across operator
 # instances and queries (the reference's ExpressionCompiler/
 # PageFunctionCompiler Guava caches, JoinCompiler-style): RowExpressions
-# hash structurally and dictionaries are append-only with stable ids, so
-# a repeated query shape reuses the jitted program instead of re-tracing
-# — on the TPU tunnel a retrace costs seconds per operator.
-from collections import OrderedDict  # noqa: E402
+# hash structurally and dictionaries are append-only with monotonic
+# tokens, so a repeated query shape reuses the jitted program instead of
+# re-tracing — on the TPU tunnel a retrace costs seconds per operator.
 
-_FP_KERNELS: "OrderedDict[tuple, object]" = OrderedDict()
-_FP_HOST: "OrderedDict[tuple, object]" = OrderedDict()
+_FP_KERNELS = _new_cache("filter_project")
+_FP_HOST = _new_cache("filter_project_host")
+
+
+def dictionary_binding_key(columns) -> tuple:
+    """Per-column dictionary-binding component of a kernel cache key.
+
+    (token, len) per dictionary column: the token is a never-reused
+    monotonic identity (id() can alias after GC), and the length guards
+    compiled per-entry lookup tables against an append-only dictionary
+    growing after the program was traced.
+    """
+    return tuple(
+        None if c.dictionary is None
+        else (c.dictionary.token, len(c.dictionary))
+        for c in columns)
 
 
 class FilterProjectOperator(Operator):
@@ -167,11 +180,12 @@ class FilterProjectOperator(Operator):
     def _kernel_for(self, batch: Batch):
         import jax
 
-        dict_key = tuple(id(c.dictionary) for c in batch.columns)
+        dict_key = dictionary_binding_key(batch.columns)
         key = (self._expr_key, batch.capacity, dict_key)
         hit = _cache_get(_FP_KERNELS, key)
         if hit is not None:
             return hit
+        self.ctx.stats.jit_compiles += 1
         compiler = ExprCompiler({i: c.dictionary
                                  for i, c in enumerate(batch.columns)
                                  if c.dictionary is not None})
@@ -212,8 +226,7 @@ class FilterProjectOperator(Operator):
         # cache per dictionary binding (same policy as the jit kernels);
         # dictionaries are append-only so the binding stays valid and
         # per-call-site output dictionaries keep stable codes
-        key = (self._expr_key,
-               tuple(id(c.dictionary) for c in batch.columns))
+        key = (self._expr_key, dictionary_binding_key(batch.columns))
         hit = _cache_get(_FP_HOST, key)
         if hit is None:
             compiler = ExprCompiler({i: c.dictionary
@@ -248,6 +261,7 @@ class FilterProjectOperator(Operator):
             n = out.num_rows
         else:
             jitted, cprojs = self._kernel_for(batch)
+            self.ctx.stats.jit_dispatches += 1
             outs, count = jitted(tuple(column_pairs(batch)), batch.num_rows)
             n = int(count)
             cols = tuple(

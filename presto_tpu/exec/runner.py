@@ -99,6 +99,12 @@ def execute_pipelines(pipelines: Sequence[Pipeline],
     """
     import time as _time
 
+    from presto_tpu import kernelcache
+
+    # apply the configured compiled-kernel cache capacity (caches are
+    # process-global; this sets the process default, cheap + idempotent)
+    kernelcache.set_default_capacity(
+        getattr(config, "kernel_cache_capacity", 0))
     query = QueryContext(config, memory_limit)
     task = TaskContext(query)
     deadline = (_time.monotonic() + config.query_max_run_time_s

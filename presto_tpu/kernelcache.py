@@ -1,21 +1,66 @@
 """Shared locked-LRU cache for compiled device programs.
 
 One implementation for every kernel cache in the engine (filter/project,
-dynamic filter, aggregation, concat): the reference keeps its generated
-classes in Guava caches the same way (ExpressionCompiler /
-AccumulatorCompiler / JoinCompiler caches).
+dynamic filter, fused pipeline segments, aggregation, concat): the
+reference keeps its generated classes in Guava caches the same way
+(ExpressionCompiler / AccumulatorCompiler / JoinCompiler caches).
+
+Caches are *named* and registered so operators and EXPLAIN ANALYZE can
+surface hit/miss/eviction counters (the CacheStatsMBean role), and the
+default capacity is configurable through ``EngineConfig
+.kernel_cache_capacity`` (applied by ``execute_pipelines`` at query
+start; caches are process-global so the knob is a process default, not a
+per-query isolation boundary).
 """
 
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
+from typing import Dict
 
 _LOCK = threading.Lock()
 
+# process default for cache_put(cap=None); EngineConfig.kernel_cache_capacity
+# lands here via set_default_capacity()
+_DEFAULT_CAPACITY = 256
 
-def new_cache() -> "OrderedDict[tuple, object]":
-    return OrderedDict()
+_REGISTRY: Dict[str, "KernelCache"] = {}
+
+
+class KernelCache(OrderedDict):
+    """An OrderedDict with hit/miss/eviction counters and a name.
+
+    Plain OrderedDicts also work with cache_get/cache_put (stats are
+    skipped) so hand-built caches in tests keep functioning.
+    """
+
+    def __init__(self, name: str):
+        super().__init__()
+        self.name = name
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+
+def new_cache(name: str = "") -> "KernelCache":
+    cache = KernelCache(name or f"cache{len(_REGISTRY)}")
+    with _LOCK:
+        # last creation wins the registry slot (module reloads in tests)
+        _REGISTRY[cache.name] = cache
+    return cache
+
+
+def set_default_capacity(cap: int) -> None:
+    """Set the process-wide default capacity for caches that do not pass
+    an explicit cap (EngineConfig.kernel_cache_capacity)."""
+    global _DEFAULT_CAPACITY
+    if cap and cap > 0:
+        _DEFAULT_CAPACITY = int(cap)
+
+
+def default_capacity() -> int:
+    return _DEFAULT_CAPACITY
 
 
 def cache_get(cache: "OrderedDict[tuple, object]", key):
@@ -23,12 +68,28 @@ def cache_get(cache: "OrderedDict[tuple, object]", key):
         hit = cache.get(key)
         if hit is not None:
             cache.move_to_end(key)
+            if isinstance(cache, KernelCache):
+                cache.hits += 1
+        elif isinstance(cache, KernelCache):
+            cache.misses += 1
         return hit
 
 
 def cache_put(cache: "OrderedDict[tuple, object]", key, val,
-              cap: int = 256):
+              cap: int = None):
     with _LOCK:
         cache[key] = val
-        if len(cache) > cap:
+        limit = cap if cap is not None else _DEFAULT_CAPACITY
+        while len(cache) > limit:
             cache.popitem(last=False)
+            if isinstance(cache, KernelCache):
+                cache.evictions += 1
+
+
+def cache_stats() -> Dict[str, Dict[str, int]]:
+    """Hit/miss/size counters for every registered cache (task info /
+    EXPLAIN ANALYZE surface)."""
+    with _LOCK:
+        return {name: {"size": len(c), "hits": c.hits, "misses": c.misses,
+                       "evictions": c.evictions}
+                for name, c in sorted(_REGISTRY.items())}

@@ -674,14 +674,28 @@ class LocalQueryRunner:
         task = execute_pipelines(phys.pipelines, self.config)
         lines = [format_plan(optimized).rstrip(), "", "Operator stats:"]
         header = (f"{'operator':<40} {'in rows':>10} {'out rows':>10} "
-                  f"{'wall ms':>9} {'finish ms':>9}")
+                  f"{'wall ms':>9} {'finish ms':>9} {'jit disp':>8} "
+                  f"{'jit comp':>8}")
         lines += [header, "-" * len(header)]
         for s in task.operator_stats:
             lines.append(
                 f"{s.operator:<40} {s.input_rows:>10} {s.output_rows:>10} "
-                f"{s.wall_ns / 1e6:>9.1f} {s.finish_wall_ns / 1e6:>9.1f}")
+                f"{s.wall_ns / 1e6:>9.1f} {s.finish_wall_ns / 1e6:>9.1f} "
+                f"{s.jit_dispatches:>8} {s.jit_compiles:>8}")
+        jc = task.jit_counters()
         lines.append(
-            f"peak memory: {task.memory.peak / (1 << 20):.1f} MiB")
+            f"peak memory: {task.memory.peak / (1 << 20):.1f} MiB; "
+            f"jit dispatches: {jc['dispatches']}, "
+            f"compiles: {jc['compiles']}")
+        from presto_tpu.kernelcache import cache_stats
+
+        stats = {n: s for n, s in cache_stats().items()
+                 if s["hits"] or s["misses"] or s["size"]}
+        if stats:
+            lines.append("kernel caches (process-wide): " + "; ".join(
+                f"{n}: size={s['size']} hits={s['hits']} "
+                f"misses={s['misses']} evictions={s['evictions']}"
+                for n, s in stats.items()))
         return "\n".join(lines)
 
     def _check_scans(self, node) -> None:

@@ -437,7 +437,7 @@ def global_aggregate(aggs: Sequence[AggIn], num_rows: jax.Array,
 
 from presto_tpu.kernelcache import cache_get, cache_put, new_cache
 
-_AGG_PROGRAMS = new_cache()
+_AGG_PROGRAMS = new_cache("aggregation")
 
 
 def _program(key, build):

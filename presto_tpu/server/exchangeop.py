@@ -31,14 +31,22 @@ from presto_tpu.server.errortracker import (
 
 
 class PartitionedOutputOperator(Operator):
-    """Hash-partition rows on ``channels`` into n output partitions."""
+    """Hash-partition rows on ``channels`` into n output partitions.
+
+    When a fused upstream segment precomputed the partition ids
+    (``precomputed``, exec/fusion.py), the ids arrive as an extra final
+    int32 column and the per-batch hash dispatches are skipped — the
+    segment program already fused them.
+    """
 
     def __init__(self, ctx: OperatorContext, buffers: OutputBufferManager,
-                 channels: Sequence[int], n_partitions: int):
+                 channels: Sequence[int], n_partitions: int,
+                 precomputed: bool = False):
         super().__init__(ctx)
         self.buffers = buffers
         self.channels = list(channels)
         self.n = n_partitions
+        self.precomputed = precomputed
 
     def add_input(self, batch: Batch) -> None:
         import jax.numpy as jnp
@@ -47,16 +55,25 @@ class PartitionedOutputOperator(Operator):
             partition_of, row_hash, value_hash_triple,
         )
 
+        if self.precomputed and self.n > 1:
+            # strip the segment-computed partition-id column first so
+            # row accounting and serialization see the logical schema
+            parts_col = batch.columns[-1]
+            batch = Batch(batch.columns[:-1], batch.num_rows)
         self.ctx.stats.input_rows += batch.num_rows
         if self.n == 1:
             self.buffers.enqueue(0, serialize_batch(batch))
             self.ctx.stats.output_rows += batch.num_rows
             return
-        batch = batch.compact()
-        key_cols = [value_hash_triple(batch.columns[c])
-                    for c in self.channels]
-        hashes = row_hash(key_cols)
-        parts = np.asarray(partition_of(hashes, self.n))
+        if self.precomputed:
+            parts = np.asarray(parts_col.values)[:batch.num_rows]
+            batch = batch.compact()
+        else:
+            batch = batch.compact()
+            key_cols = [value_hash_triple(batch.columns[c])
+                        for c in self.channels]
+            hashes = row_hash(key_cols)
+            parts = np.asarray(partition_of(hashes, self.n))
         for p in range(self.n):
             idx = np.nonzero(parts == p)[0]
             if idx.size == 0:
@@ -132,10 +149,14 @@ class PartitionedOutputOperatorFactory(OperatorFactory):
         self.buffers = buffers
         self.channels = list(channels)
         self.n_partitions = n_partitions
+        # set by the fusion pass when an upstream segment appends the
+        # partition-id column (exec/fusion.py)
+        self.precomputed = False
 
     def create(self, ctx: OperatorContext):
         return PartitionedOutputOperator(ctx, self.buffers, self.channels,
-                                         self.n_partitions)
+                                         self.n_partitions,
+                                         precomputed=self.precomputed)
 
 
 class RoundRobinOutputOperatorFactory(OperatorFactory):

@@ -93,11 +93,16 @@ class SqlTask:
         """TaskInfo with the per-operator stats rollup the coordinator's
         distributed EXPLAIN ANALYZE aggregates (TaskStatus + TaskStats,
         presto-main/.../execution/TaskInfo.java role)."""
+        from presto_tpu.kernelcache import cache_stats
+
         ctx = self._stats or self._live
         stats = ([s.as_dict() for s in ctx.operator_stats]
                  if ctx is not None else [])
         return {"taskId": self.task_id, "state": self.state,
                 "error": self.error, "operatorStats": stats,
+                "jitCounters": (ctx.jit_counters() if ctx is not None
+                                else {"dispatches": 0, "compiles": 0}),
+                "kernelCaches": cache_stats(),
                 "peakMemory": ctx.memory.peak if ctx is not None else 0}
 
     def memory_info(self) -> Dict:

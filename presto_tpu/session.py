@@ -58,6 +58,9 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "dynamic_filtering_enabled": ("dynamic_filtering_enabled",
                                   lambda v: v.lower() in ("true", "1",
                                                           "on")),
+    "pipeline_fusion": ("pipeline_fusion",
+                        lambda v: v.lower() in ("true", "1", "on")),
+    "kernel_cache_capacity": ("kernel_cache_capacity", int),
     "whole_query_execution": ("whole_query_execution",
                               lambda v: v.lower() in ("true", "1", "on")),
     "streaming_aggregation_enabled": (

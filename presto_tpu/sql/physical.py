@@ -97,6 +97,7 @@ class PhysicalPlanner:
         factories.append(collector)
         self._done_pipelines.append(
             Pipeline(factories, splits, name="output"))
+        self._fuse()
         return PhysicalPlan(self._done_pipelines, collector,
                             [n for n, _ in root.columns],
                             [t for _, t in root.columns])
@@ -109,7 +110,20 @@ class PhysicalPlanner:
         factories.append(sink_factory)
         self._done_pipelines.append(
             Pipeline(factories, splits, name="fragment"))
+        self._fuse()
         return self._done_pipelines
+
+    def _fuse(self) -> None:
+        """Pipeline-fusion post-pass (exec/fusion.py): rewrite each
+        lowered chain's runs of row-local operators into fused segment
+        programs.  Runs after every lowering decision that inspects the
+        raw chains (streaming-agg eligibility, grouped execution,
+        dynamic-filter placement)."""
+        if not getattr(self.config, "pipeline_fusion", False):
+            return
+        from presto_tpu.exec.fusion import fuse_pipelines
+
+        fuse_pipelines(self._done_pipelines, self.config)
 
     # -- lowering -----------------------------------------------------------
     def _lower(self, node: PlanNode):

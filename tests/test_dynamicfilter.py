@@ -79,25 +79,33 @@ def test_filter_actually_prunes(on_runner):
 def test_filter_placed_at_scan(on_runner):
     """The runtime filter must sit directly after the probe TableScan
     (channel provenance through FilterProject), not just before the join
-    (LocalDynamicFilter pushes to the scan in the reference)."""
+    (LocalDynamicFilter pushes to the scan in the reference).  With
+    pipeline fusion on (the default) the filter is the first stage of a
+    fused segment riding on the scan — same placement, one dispatch."""
     from presto_tpu.exec.dynamicfilter import DynamicFilterOperatorFactory
+    from presto_tpu.exec.fusion import DFStage, FusedSegmentOperatorFactory
     from presto_tpu.exec.operators import TableScanOperatorFactory
     from presto_tpu.sql.optimizer import optimize
     from presto_tpu.sql.parser import parse_statement
     from presto_tpu.sql.physical import PhysicalPlanner
     from presto_tpu.sql.planner import Metadata, Planner
 
+    def holds_df(f):
+        if isinstance(f, DynamicFilterOperatorFactory):
+            return True
+        return isinstance(f, FusedSegmentOperatorFactory) and \
+            isinstance(f.stages[0], DFStage)
+
     md = Metadata(on_runner.registry, "tpch")
     sql = ("select o_orderpriority, l_quantity from orders join lineitem "
            "on o_orderkey = l_orderkey where l_quantity > 45")
     plan = optimize(Planner(md).plan(parse_statement(sql)), md)
     phys = PhysicalPlanner(on_runner.registry).plan(plan)
-    probe = [p for p in phys.pipelines if any(
-        isinstance(f, DynamicFilterOperatorFactory) for f in p.factories)]
+    probe = [p for p in phys.pipelines
+             if any(holds_df(f) for f in p.factories)]
     assert probe, "no dynamic filter in any pipeline"
     factories = probe[0].factories
-    i = next(idx for idx, f in enumerate(factories)
-             if isinstance(f, DynamicFilterOperatorFactory))
+    i = next(idx for idx, f in enumerate(factories) if holds_df(f))
     assert isinstance(factories[i - 1], TableScanOperatorFactory)
 
 
