@@ -25,6 +25,14 @@ fusion), reported in ``extras`` next to the hand-kernel configs so the
 artifact tracks what the engine executes, not just what hand-built
 kernels can reach (ROADMAP #10).
 
+Config 7 (``bench_mesh_q1q6``) pushes the same two queries through the
+DISTRIBUTED tier — a real 2-worker DistributedQueryRunner cluster
+(coordinator + workers on ephemeral HTTP ports, serde'd pages on the
+exchange wire, partial/final aggregation split across fragments) — the
+engine-path depth ROADMAP #10 still wanted.  ``vs_baseline`` is the
+single-process engine wall ratio, so the line prices the distribution
+overhead directly.
+
 Timing methodology (axon tunnel quirks): run K dependence-chained
 iterations INSIDE one jitted fori_loop and take the slope between two K
 values, so RPC overhead and sync-polling granularity cancel.
@@ -917,6 +925,71 @@ def bench_engine_q1q6(scale: float):
     }
 
 
+def bench_mesh_q1q6(scale: float):
+    """TPC-H Q1 + Q6 through the DISTRIBUTED tier: a real 2-worker
+    cluster (DistributedQueryRunner — coordinator + workers over HTTP,
+    real exchange pages, partial aggregation pre-reduced inside the
+    worker scan segments) vs the single-process engine on the same
+    data.  Closes ROADMAP #10's remaining depth: the artifact now
+    measures the sqlmesh-tier distributed path end to end."""
+    from presto_tpu.localrunner import LocalQueryRunner
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    def close(a, b):
+        if len(a) != len(b):
+            return False
+        for ra, rb in zip(sorted(a, key=repr), sorted(b, key=repr)):
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float) and isinstance(vb, float):
+                    if not np.isclose(va, vb, rtol=1e-6):
+                        return False
+                elif va != vb:
+                    return False
+        return True
+
+    local = LocalQueryRunner.tpch(scale=scale)
+    n_rows = local.execute("select count(*) from lineitem").rows[0][0]
+
+    def timed_local(sql):
+        local.execute(sql)
+        best = float("inf")
+        res = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            res = local.execute(sql)
+            best = min(best, time.perf_counter() - t0)
+        return best, res
+
+    with DistributedQueryRunner.tpch(scale=scale, n_workers=2) as dqr:
+        def timed(sql):
+            dqr.execute(sql)                  # compile + warm caches
+            best = float("inf")
+            res = None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                res = dqr.execute(sql)
+                best = min(best, time.perf_counter() - t0)
+            return best, res
+
+        q1_s, q1_res = timed(ENGINE_Q1)
+        q6_s, q6_res = timed(ENGINE_Q6)
+    q1_local_s, q1_local = timed_local(ENGINE_Q1)
+    q6_local_s, q6_local = timed_local(ENGINE_Q6)
+    parity = close(q1_res.rows, q1_local.rows) and \
+        close(q6_res.rows, q6_local.rows)
+    return {
+        "metric": f"tpch_sf{scale:g}_q1_mesh_2worker_rows_per_sec",
+        "value": round(n_rows / q1_s, 1), "unit": "rows/s",
+        # baseline = the single-process engine on the same data: the
+        # ratio prices coordinator/exchange overhead at this scale
+        "vs_baseline": round(q1_local_s / q1_s, 3),
+        "engine_path": True, "distributed": True, "workers": 2,
+        "q6_rows_per_sec": round(n_rows / q6_s, 1),
+        "q6_vs_local": round(q6_local_s / q6_s, 3),
+        "parity": parity,
+    }
+
+
 def bench_sqlite_baseline(scale: float):
     """External (non-self-authored) CPU baseline: the sqlite3 engine over
     IDENTICAL generated data, per BASELINE.md's measurement note — the
@@ -1080,6 +1153,7 @@ def main() -> None:
                 (bench_q9, 0.1, 0.0), (bench_q17, 0.1, 0.0),
                 (bench_q3_chunked, 0.2, 0.0),
                 (bench_engine_q1q6, 0.05, 0.0),
+                (bench_mesh_q1q6, 0.05, 0.0),
                 (bench_sqlite_baseline, 0.05, 0.0)]
         _emit(_run_jobs(headline, jobs, budget_s))
         return
@@ -1098,6 +1172,7 @@ def main() -> None:
     jobs = [(bench_q6, 10.0, 0.0), (bench_q3, 1.0, 0.0),
             (bench_q9, 1.0, 0.0), (bench_q17, 1.0, 0.0),
             (bench_engine_q1q6, 1.0, 0.0),
+            (bench_mesh_q1q6, 0.2, 0.0),
             (bench_whole_query_q3, 0.1, 0.0),
             (bench_sqlite_baseline, 0.2, 0.0),
             (bench_q3, 10.0, 0.65),

@@ -168,6 +168,19 @@ class EngineConfig:
     # ScanFilterAndProjectOperator role).  OFF restores today's
     # per-operator dispatch exactly.
     pipeline_fusion: bool = True
+    # Fusion II (requires pipeline_fusion): segments feeding a partial
+    # or single-step aggregation pre-reduce inside the jitted program —
+    # the per-batch group-accumulate (device group-by kernels) runs
+    # before anything materializes, so the segment emits partial-state
+    # batches (keys + component columns) instead of row batches, the
+    # downstream aggregation merges tiny partials, and its filter-less
+    # finalize projection folds into the aggregation finish.  Also
+    # gates exchange-adjacent segment coalescing (remote-exchange-fed
+    # segments batch pages up to scan_batch_rows before dispatching)
+    # and the runner's consumer-side placement of coalescing segments
+    # (one dispatch across all LocalExchange feeders).  OFF restores
+    # PR 3 lowering exactly.
+    fusion_partial_agg: bool = True
     # LRU capacity for the shared compiled-kernel caches (filter/project,
     # fused segments, dynamic filter, aggregation...).  Caches are
     # process-global; this is applied as the process default when a query

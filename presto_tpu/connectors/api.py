@@ -89,6 +89,47 @@ class TableStatistics:
     data_size: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
+class DictionaryPool:
+    """Per-table shared interning tables: one append-only ``Dictionary``
+    per (table, column), handed to every split's page source.
+
+    Kernel caches key compiled programs by dictionary binding
+    (token, length): a connector that interns each split's strings into
+    a FRESH dictionary forces one re-trace per split for every
+    expression over that column.  Splits sharing one interning table
+    instead compile once per (table, expression) — the per-split
+    compile-amplification fix ROADMAP #12 names.  Thread-safe: feed
+    drivers decode splits concurrently, and ``Dictionary.intern`` is
+    itself code-stable under concurrency.
+    """
+
+    def __init__(self):
+        import threading
+
+        from presto_tpu.batch import Dictionary as _D
+
+        self._dict_cls = _D
+        self._lock = threading.Lock()
+        self._dicts: Dict[Tuple[str, str], Any] = {}
+
+    def get(self, table: str, column: str, values=None):
+        """The shared dictionary for (table, column), created on first
+        use (pre-seeded with ``values`` when given)."""
+        key = (table, column)
+        with self._lock:
+            d = self._dicts.get(key)
+            if d is None:
+                d = self._dict_cls(values or ())
+                self._dicts[key] = d
+            return d
+
+    def drop(self, table: str) -> None:
+        """Forget a table's dictionaries (DROP/RENAME invalidation)."""
+        with self._lock:
+            for key in [k for k in self._dicts if k[0] == table]:
+                del self._dicts[key]
+
+
 class PageSource:
     """Iterator of Batches for one split
     (ConnectorPageSource.getNextPage analogue)."""

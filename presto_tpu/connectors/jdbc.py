@@ -28,10 +28,10 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from presto_tpu import types as T
-from presto_tpu.batch import Batch, batch_from_pylist
+from presto_tpu.batch import Batch, batch_from_pylist, column_from_pylist
 from presto_tpu.connectors.api import (
-    ColumnMetadata, Connector, PageSink, PageSource, Split, TableHandle,
-    TableSchema, coerce_value,
+    ColumnMetadata, Connector, DictionaryPool, PageSink, PageSource, Split,
+    TableHandle, TableSchema, coerce_value,
 )
 
 _OPS = {"eq": "=", "ne": "<>", "lt": "<", "le": "<=", "gt": ">",
@@ -59,6 +59,11 @@ class JdbcConnector(Connector):
         # (external DDL is picked up on the next invalidation, the
         # reference's per-transaction metadata-cache behavior)
         self._schema_cache: Dict[str, TableSchema] = {}
+        # per-(table, column) shared interning tables: every fetchmany
+        # chunk of every scan re-uses one Dictionary per varchar column,
+        # so repeat scans hit the compiled-kernel caches instead of
+        # re-tracing per chunk (fresh dictionaries re-key every kernel)
+        self._dict_pool = DictionaryPool()
 
     # -- driver surface (subclasses specialize) -------------------------
     def _list_tables_sql(self) -> str:
@@ -176,6 +181,16 @@ class JdbcConnector(Connector):
         if where:
             sql += f" WHERE {where}"
         conn = self
+        table = split.handle.table
+        shared = [conn._dict_pool.get(table, c) if t.is_dictionary else None
+                  for c, t in zip(columns, types)]
+
+        def build_batch(pyrows) -> Batch:
+            cols = tuple(
+                column_from_pylist(t, [r[ci] for r in pyrows],
+                                   dictionary=shared[ci])
+                for ci, t in enumerate(types))
+            return Batch(cols, len(pyrows))
 
         class _Source(PageSource):
             def __iter__(self):
@@ -197,9 +212,9 @@ class JdbcConnector(Connector):
                         pyrows = [tuple(conn._from_remote(t, v)
                                         for t, v in zip(types, r))
                                   for r in chunk]
-                        yield batch_from_pylist(types, pyrows)
+                        yield build_batch(pyrows)
                     if empty:
-                        yield batch_from_pylist(types, [])
+                        yield build_batch([])
                 finally:
                     cur.close()
 
@@ -220,12 +235,15 @@ class JdbcConnector(Connector):
     def drop_table(self, name: str) -> None:
         self._run(f"DROP TABLE {self._quote(name)}")
         self._schema_cache.pop(name, None)
+        self._dict_pool.drop(name)
 
     def rename_table(self, name: str, new_name: str) -> None:
         self._run(f"ALTER TABLE {self._quote(name)} RENAME TO "
                   f"{self._quote(new_name)}")
         self._schema_cache.pop(name, None)
         self._schema_cache.pop(new_name, None)
+        self._dict_pool.drop(name)
+        self._dict_pool.drop(new_name)
 
     def page_sink(self, handle: TableHandle) -> PageSink:
         schema = self.table_schema(handle)

@@ -50,11 +50,43 @@ class _MemTable:
         self.schema = schema
         self.batches: List[Batch] = []
         self.stats: Optional[TableStatistics] = None  # set by ANALYZE
+        # per-column shared interning tables: every stored batch's
+        # dictionary columns re-code into these at insert, so scans
+        # serve ONE dictionary per column and downstream kernel caches
+        # ((token, length) binding keys) compile once per (table,
+        # expression) instead of once per stored batch
+        self._dicts: List = [None] * len(schema.columns)
         self._lock = threading.Lock()
+
+    def _intern_shared(self, batch: Batch) -> Batch:
+        import numpy as np
+
+        from presto_tpu.batch import Batch as _B
+        from presto_tpu.batch import Column, Dictionary
+
+        cols = []
+        changed = False
+        for ci, c in enumerate(batch.columns):
+            if c.dictionary is None:
+                cols.append(c)
+                continue
+            target = self._dicts[ci]
+            if target is None:
+                target = self._dicts[ci] = Dictionary()
+            if c.dictionary is target:
+                cols.append(c)
+                continue
+            remap = c.dictionary.remap_into(target)
+            codes = np.asarray(c.values)
+            cols.append(Column(c.type,
+                               remap[codes] if len(remap) else codes,
+                               c.valid, target))
+            changed = True
+        return _B(tuple(cols), batch.num_rows) if changed else batch
 
     def append_all(self, batches: List[Batch]) -> None:
         with self._lock:
-            self.batches.extend(batches)
+            self.batches.extend(self._intern_shared(b) for b in batches)
 
     @property
     def row_count(self) -> int:

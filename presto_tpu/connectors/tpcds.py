@@ -78,15 +78,33 @@ def _money(stream: int, keys: np.ndarray, lo: float, hi: float
     return cents.astype(np.float64) / 100.0
 
 
+# one Dictionary per vocabulary, shared by every split of every table:
+# kernel caches key on the dictionary binding (token, length), so a
+# fresh object per generated batch would re-trace every string kernel
+# once per split (the tpch connector's _ENUM_CACHE discipline)
+_DICT_CACHE: Dict[tuple, Dictionary] = {}
+
+
+def _dict(values: List[str]) -> Dictionary:
+    key = tuple(values)
+    d = _DICT_CACHE.get(key)
+    if d is None:
+        d = _DICT_CACHE.setdefault(key, Dictionary(values))
+    return d
+
+
 def _pick(stream: int, keys: np.ndarray, vocab: List[str]
           ) -> Tuple[np.ndarray, Dictionary]:
     codes = u_int(stream, keys, 0, len(vocab) - 1).astype(np.int32)
-    return codes, Dictionary(vocab)
+    return codes, _dict(vocab)
 
 
 class TpcdsGenerator:
     def __init__(self, scale: float = 1.0):
         self.scale = scale
+        # full-domain id dictionaries shared by every split (stable
+        # (token, length) kernel-cache bindings across splits)
+        self._id_dicts: Dict[str, Dictionary] = {}
         f = max(scale, 1e-4)
         self.n_store_sales = max(int(2_880_000 * f), 1000)
         self.n_catalog_sales = max(int(1_440_000 * f), 500)
@@ -117,6 +135,14 @@ class TpcdsGenerator:
         self.inv_items = max(int((self.n_item // 4) * min(1.0, f) ** 0.5),
                              10)
         self.n_inventory = self.n_weeks * self.n_warehouse * self.inv_items
+
+
+    def _id_dict(self, name: str, fmt: str, domain: int) -> Dictionary:
+        d = self._id_dicts.get(name)
+        if d is None:
+            d = self._id_dicts.setdefault(
+                name, Dictionary([fmt.format(k) for k in range(domain)]))
+        return d
 
     # -- dimension generators -------------------------------------------
     def gen_date_dim(self, columns: Sequence[str], lo: int, hi: int
@@ -152,7 +178,7 @@ class TpcdsGenerator:
                 # 1990-01-01 was a Monday
                 codes = (idx % 7).astype(np.int32)
                 cols.append(Column(T.VARCHAR, codes,
-                                   None, Dictionary(DAY_NAMES)))
+                                   None, _dict(DAY_NAMES)))
             elif c == "d_dow":
                 cols.append(Column(T.INTEGER,
                                    ((idx + 1) % 7).astype(np.int32)))
@@ -162,7 +188,7 @@ class TpcdsGenerator:
                          for i in range(1, 5)]
                 codes = ((year - 1990) * 4 + q - 1).astype(np.int32)
                 cols.append(Column(T.VARCHAR, codes, None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(idx))
@@ -174,16 +200,18 @@ class TpcdsGenerator:
             if c == "i_item_sk":
                 cols.append(Column(T.BIGINT, keys + 1))
             elif c == "i_item_id":
-                codes = np.arange(lo, hi, dtype=np.int32)
-                d = Dictionary([f"AAAAAAAA{k:08d}" for k in range(lo, hi)])
-                cols.append(Column(T.VARCHAR, codes - lo, None, d))
+                d = self._id_dict("i_item_id", "AAAAAAAA{:08d}",
+                                  self.n_item)
+                cols.append(Column(T.VARCHAR,
+                                   np.arange(lo, hi, dtype=np.int32),
+                                   None, d))
             elif c == "i_item_desc":
                 w1, _ = _pick(301, keys, DESC_WORDS)
                 vocab = [f"{a} {b}" for a in DESC_WORDS[:8]
                          for b in DESC_WORDS]
                 codes = u_int(302, keys, 0, len(vocab) - 1).astype(np.int32)
                 cols.append(Column(T.VARCHAR, codes, None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             elif c == "i_current_price":
                 cols.append(Column(T.DOUBLE, _money(303, keys, 0.09, 99.99)))
             elif c == "i_wholesale_cost":
@@ -252,9 +280,10 @@ class TpcdsGenerator:
             if c == "s_store_sk":
                 cols.append(Column(T.BIGINT, keys + 1))
             elif c == "s_store_id":
-                d = Dictionary([f"AAAAAAAA{k:04d}" for k in range(lo, hi)])
+                d = self._id_dict("s_store_id", "AAAAAAAA{:04d}",
+                                  self.n_store)
                 cols.append(Column(
-                    T.VARCHAR, np.arange(hi - lo, dtype=np.int32), None, d))
+                    T.VARCHAR, np.arange(lo, hi, dtype=np.int32), None, d))
             elif c == "s_store_name":
                 vocab = ["ought", "able", "pri", "ese", "anti", "cally",
                          "ation", "eing", "n st", "bar"]
@@ -280,7 +309,7 @@ class TpcdsGenerator:
             elif c == "s_company_name":
                 cols.append(Column(T.VARCHAR,
                                    np.zeros(len(keys), np.int32), None,
-                                   Dictionary(["Unknown"])))
+                                   _dict(["Unknown"])))
             elif c == "s_market_id":
                 cols.append(Column(T.INTEGER,
                                    u_int(406, keys, 1, 10)
@@ -290,7 +319,7 @@ class TpcdsGenerator:
                                    u_int(407, keys, 200, 300)
                                    .astype(np.int32)))
             elif c == "s_street_number":
-                d = Dictionary([str(n) for n in range(1, 1001)])
+                d = _dict([str(n) for n in range(1, 1001)])
                 cols.append(Column(T.VARCHAR,
                                    u_int(408, keys, 0, 999)
                                    .astype(np.int32), None, d))
@@ -304,12 +333,12 @@ class TpcdsGenerator:
                 codes, d = _pick(410, keys, vocab)
                 cols.append(Column(T.VARCHAR, codes, None, d))
             elif c == "s_suite_number":
-                d = Dictionary([f"Suite {n}" for n in range(0, 100, 10)])
+                d = _dict([f"Suite {n}" for n in range(0, 100, 10)])
                 cols.append(Column(T.VARCHAR,
                                    u_int(411, keys, 0, 9)
                                    .astype(np.int32), None, d))
             elif c == "s_zip":
-                d = Dictionary([f"{z:05d}" for z in range(10000, 10200)])
+                d = _dict([f"{z:05d}" for z in range(10000, 10200)])
                 cols.append(Column(T.VARCHAR,
                                    u_int(412, keys, 0, 199)
                                    .astype(np.int32), None, d))
@@ -327,7 +356,7 @@ class TpcdsGenerator:
                 names = ["Conventional childr", "Important issues liv",
                          "Doors canno", "Bad cards must make.",
                          "Operations wou"]
-                d = Dictionary(names)
+                d = _dict(names)
                 cols.append(Column(T.VARCHAR,
                                    (keys % len(names)).astype(np.int32),
                                    None, d))
@@ -349,22 +378,23 @@ class TpcdsGenerator:
             elif c == "w_country":
                 cols.append(Column(
                     T.VARCHAR, np.zeros(len(keys), np.int32), None,
-                    Dictionary(["United States"])))
+                    _dict(["United States"])))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
 
     def gen_promotion(self, columns, lo, hi) -> Batch:
         keys = np.arange(lo, hi, dtype=np.int64)
-        yn = Dictionary(["N", "Y"])
+        yn = _dict(["N", "Y"])
         cols = []
         for c in columns:
             if c == "p_promo_sk":
                 cols.append(Column(T.BIGINT, keys + 1))
             elif c == "p_promo_id":
-                d = Dictionary([f"AAAAAAAA{k:04d}" for k in range(lo, hi)])
+                d = self._id_dict("p_promo_id", "AAAAAAAA{:04d}",
+                                  self.n_promo)
                 cols.append(Column(
-                    T.VARCHAR, np.arange(hi - lo, dtype=np.int32), None, d))
+                    T.VARCHAR, np.arange(lo, hi, dtype=np.int32), None, d))
             elif c in ("p_channel_dmail", "p_channel_email",
                        "p_channel_tv", "p_channel_event"):
                 stream = 601 + hash(c) % 97
@@ -386,9 +416,10 @@ class TpcdsGenerator:
             if c == "c_customer_sk":
                 cols.append(Column(T.BIGINT, keys + 1))
             elif c == "c_customer_id":
-                d = Dictionary([f"AAAAAAAA{k:08d}" for k in range(lo, hi)])
+                d = self._id_dict("c_customer_id", "AAAAAAAA{:08d}",
+                                  self.n_customer)
                 cols.append(Column(
-                    T.VARCHAR, np.arange(hi - lo, dtype=np.int32), None, d))
+                    T.VARCHAR, np.arange(lo, hi, dtype=np.int32), None, d))
             elif c == "c_current_cdemo_sk":
                 cols.append(Column(T.BIGINT,
                                    u_int(701, keys, 1, self.n_cdemo)))
@@ -420,7 +451,7 @@ class TpcdsGenerator:
             elif c == "c_preferred_cust_flag":
                 cols.append(Column(
                     T.VARCHAR, u_int(708, keys, 0, 1).astype(np.int32),
-                    None, Dictionary(["N", "Y"])))
+                    None, _dict(["N", "Y"])))
             elif c == "c_birth_day":
                 cols.append(Column(T.INTEGER,
                                    u_int(709, keys, 1, 28)
@@ -434,13 +465,13 @@ class TpcdsGenerator:
                                    u_int(711, keys, 1924, 1992)
                                    .astype(np.int32)))
             elif c == "c_email_address":
-                d = Dictionary([f"user{k}@example.com"
-                                for k in range(200)])
+                d = _dict([f"user{k}@example.com"
+                           for k in range(200)])
                 cols.append(Column(T.VARCHAR,
                                    u_int(712, keys, 0, 199)
                                    .astype(np.int32), None, d))
             elif c == "c_login":
-                d = Dictionary([f"login{k}" for k in range(200)])
+                d = _dict([f"login{k}" for k in range(200)])
                 cols.append(Column(T.VARCHAR,
                                    u_int(713, keys, 0, 199)
                                    .astype(np.int32), None, d))
@@ -470,19 +501,19 @@ class TpcdsGenerator:
                 codes, d = _pick(802, keys, COUNTIES)
                 cols.append(Column(T.VARCHAR, codes, None, d))
             elif c == "ca_zip":
-                d = Dictionary([f"{z:05d}" for z in range(10000, 10200)])
+                d = _dict([f"{z:05d}" for z in range(10000, 10200)])
                 cols.append(Column(
                     T.VARCHAR, u_int(803, keys, 0, 199).astype(np.int32),
                     None, d))
             elif c == "ca_country":
                 cols.append(Column(
                     T.VARCHAR, np.zeros(len(keys), np.int32), None,
-                    Dictionary(["United States"])))
+                    _dict(["United States"])))
             elif c == "ca_gmt_offset":
                 cols.append(Column(T.DOUBLE, -5.0 - u_int(
                     804, keys, 0, 3).astype(np.float64)))
             elif c == "ca_street_number":
-                d = Dictionary([str(n) for n in range(1, 1001)])
+                d = _dict([str(n) for n in range(1, 1001)])
                 cols.append(Column(T.VARCHAR,
                                    u_int(805, keys, 0, 999)
                                    .astype(np.int32), None, d))
@@ -497,7 +528,7 @@ class TpcdsGenerator:
                 codes, d = _pick(807, keys, vocab)
                 cols.append(Column(T.VARCHAR, codes, None, d))
             elif c == "ca_suite_number":
-                d = Dictionary([f"Suite {n}" for n in range(0, 100, 10)])
+                d = _dict([f"Suite {n}" for n in range(0, 100, 10)])
                 cols.append(Column(T.VARCHAR,
                                    u_int(808, keys, 0, 9)
                                    .astype(np.int32), None, d))
@@ -525,22 +556,22 @@ class TpcdsGenerator:
                 # demographics are a cross-product in the spec: derive
                 # attributes positionally so each combination exists
                 cols.append(Column(T.VARCHAR, (keys % 2).astype(np.int32),
-                                   None, Dictionary(GENDERS)))
+                                   None, _dict(GENDERS)))
             elif c == "cd_marital_status":
                 cols.append(Column(T.VARCHAR,
                                    ((keys // 2) % 5).astype(np.int32),
-                                   None, Dictionary(MARITAL)))
+                                   None, _dict(MARITAL)))
             elif c == "cd_education_status":
                 cols.append(Column(T.VARCHAR,
                                    ((keys // 10) % 7).astype(np.int32),
-                                   None, Dictionary(EDUCATION)))
+                                   None, _dict(EDUCATION)))
             elif c == "cd_purchase_estimate":
                 cols.append(Column(T.INTEGER, (
                     500 + ((keys // 70) % 20) * 500).astype(np.int32)))
             elif c == "cd_credit_rating":
                 cols.append(Column(T.VARCHAR,
                                    ((keys // 1400) % 4).astype(np.int32),
-                                   None, Dictionary(CREDIT)))
+                                   None, _dict(CREDIT)))
             elif c == "cd_dep_count":
                 cols.append(Column(T.INTEGER,
                                    ((keys // 5600) % 7).astype(np.int32)))
@@ -565,7 +596,7 @@ class TpcdsGenerator:
             elif c == "hd_buy_potential":
                 cols.append(Column(T.VARCHAR,
                                    ((keys // 20) % 6).astype(np.int32),
-                                   None, Dictionary(BUY_POTENTIAL)))
+                                   None, _dict(BUY_POTENTIAL)))
             elif c == "hd_dep_count":
                 cols.append(Column(T.INTEGER,
                                    ((keys // 120) % 10).astype(np.int32)))
@@ -583,9 +614,10 @@ class TpcdsGenerator:
             if c == "web_site_sk":
                 cols.append(Column(T.BIGINT, keys + 1))
             elif c == "web_site_id":
-                d = Dictionary([f"AAAAAAAA{k:04d}" for k in range(lo, hi)])
+                d = self._id_dict("web_site_id", "AAAAAAAA{:04d}",
+                                  self.n_web_site)
                 cols.append(Column(
-                    T.VARCHAR, np.arange(hi - lo, dtype=np.int32), None, d))
+                    T.VARCHAR, np.arange(lo, hi, dtype=np.int32), None, d))
             elif c == "web_name":
                 vocab = [f"site_{i}" for i in range(6)]
                 codes, d = _pick(901, keys, vocab)
@@ -593,7 +625,7 @@ class TpcdsGenerator:
             elif c == "web_company_name":
                 cols.append(Column(T.VARCHAR,
                                    (keys % len(COMPANIES)).astype(np.int32),
-                                   None, Dictionary(COMPANIES)))
+                                   None, _dict(COMPANIES)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(keys))
@@ -925,12 +957,12 @@ class TpcdsGenerator:
                          | ((hour >= 11) & (hour < 13))
                          | ((hour >= 17) & (hour < 20)))
                 cols.append(Column(T.VARCHAR, code.astype(np.int32),
-                                   valid, Dictionary(vocab)))
+                                   valid, _dict(vocab)))
             elif c == "t_am_pm":
                 vocab = ["AM", "PM"]
                 cols.append(Column(T.VARCHAR,
                                    (idx // 43200).astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(idx))
@@ -944,13 +976,13 @@ class TpcdsGenerator:
             elif c == "r_reason_id":
                 vocab = [f"reason_id_{i}" for i in range(self.n_reason)]
                 cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             elif c == "r_reason_desc":
                 vocab = [f"reason {w}" for w in DESC_WORDS[:self.n_reason]]
                 while len(vocab) < self.n_reason:
                     vocab.append(f"reason {len(vocab)}")
                 cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(idx))
@@ -967,20 +999,20 @@ class TpcdsGenerator:
             elif c == "sm_ship_mode_id":
                 vocab = [f"ship_mode_{i}" for i in range(self.n_ship_mode)]
                 cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             elif c == "sm_type":
                 cols.append(Column(T.VARCHAR,
                                    (idx % len(types)).astype(np.int32),
-                                   None, Dictionary(types)))
+                                   None, _dict(types)))
             elif c == "sm_carrier":
                 cols.append(Column(T.VARCHAR,
                                    (idx % len(carriers)).astype(np.int32),
-                                   None, Dictionary(carriers)))
+                                   None, _dict(carriers)))
             elif c == "sm_code":
                 vocab = ["AIR", "SURFACE", "SEA"]
                 cols.append(Column(T.VARCHAR,
                                    (idx % 3).astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(idx))
@@ -1011,17 +1043,17 @@ class TpcdsGenerator:
             elif c == "cc_call_center_id":
                 vocab = [f"cc_id_{i}" for i in range(n)]
                 cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             elif c == "cc_name":
                 vocab = ["NY Metro", "Mid Atlantic", "Midwest",
                          "North Midwest", "California", "Pacific NW"]
                 cols.append(Column(T.VARCHAR,
                                    (idx % len(vocab)).astype(np.int32),
-                                   None, Dictionary(vocab)))
+                                   None, _dict(vocab)))
             elif c == "cc_manager":
                 vocab = [f"Manager {i}" for i in range(n)]
                 cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             elif c == "cc_county":
                 codes, d = _pick(440, idx, COUNTIES)
                 cols.append(Column(T.VARCHAR, codes, None, d))
@@ -1038,7 +1070,7 @@ class TpcdsGenerator:
             elif c == "cp_catalog_page_id":
                 vocab = [f"cp_id_{i}" for i in range(self.n_catalog_page)]
                 cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             else:
                 raise KeyError(c)
         return Batch(tuple(cols), len(idx))
@@ -1052,7 +1084,7 @@ class TpcdsGenerator:
             elif c == "wp_web_page_id":
                 vocab = [f"wp_id_{i}" for i in range(self.n_web_page)]
                 cols.append(Column(T.VARCHAR, idx.astype(np.int32), None,
-                                   Dictionary(vocab)))
+                                   _dict(vocab)))
             elif c == "wp_char_count":
                 cols.append(Column(T.INTEGER,
                                    u_int(450, idx, 100, 8000)

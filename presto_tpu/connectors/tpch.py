@@ -213,10 +213,19 @@ _S_NATION, _S_REGION, _S_SUPP, _S_CUST, _S_PART, _S_PSUPP, _S_ORD, _S_LINE = (
     1000, 2000, 3000, 4000, 5000, 6000, 7000, 8000)
 
 
+# Largest full-domain interning table the generator pre-builds for a
+# per-row-distinct column (names, phones): above this, the host-string
+# cost of the whole domain outweighs the per-split retrace it prevents
+# and generation falls back to per-split dictionaries.
+_SHARED_DICT_MAX = 1 << 20
+
+
 class TpchGenerator:
     """Vectorized per-range column generation for all eight tables."""
 
     def __init__(self, scale: float = 1.0, money: str = "double"):
+        import threading
+
         self.scale = scale
         self.money_type: T.Type = (
             T.DecimalType("decimal", 15, 2) if money == "decimal" else T.DOUBLE)
@@ -225,6 +234,77 @@ class TpchGenerator:
         self.n_part = max(int(200_000 * scale), 1)
         self.n_orders = max(int(1_500_000 * scale), 1)
         self.n_clerks = max(int(1_000 * scale), 1)
+        # per-(table, column) full-domain interning tables shared by
+        # every split (stable (token, length) -> the unfused tier
+        # compiles each expression once per table, not once per split)
+        self._dict_cache: Dict[str, Dictionary] = {}
+        self._dict_lock = threading.Lock()
+
+    def _shared_dict(self, name: str, domain: int,
+                     build) -> Optional[Dictionary]:
+        """The full-domain dictionary for ``name``, built once under a
+        lock (concurrent feed drivers race on first use); None when the
+        domain is too large to pre-build."""
+        if domain > _SHARED_DICT_MAX:
+            return None
+        d = self._dict_cache.get(name)
+        if d is not None:
+            return d
+        with self._dict_lock:
+            d = self._dict_cache.get(name)
+            if d is None:
+                d = Dictionary(build())
+                self._dict_cache[name] = d
+        return d
+
+    def _fmt_shared(self, name: str, prefix: str, keys: np.ndarray,
+                    lo: int, hi: int) -> Column:
+        """Per-row-distinct formatted identifier over the table's full
+        key domain: codes are ``key - lo`` so every split indexes one
+        shared dictionary."""
+        d = self._shared_dict(
+            name, hi - lo,
+            lambda: [f"{prefix}#{k:09d}" for k in range(lo, hi)])
+        if d is None:
+            return _fmt_column(prefix, keys)
+        return Column(T.VARCHAR, (keys - lo).astype(np.int32), None, d)
+
+    def _phone_shared(self, name: str, stream: int, nk_stream: int,
+                      keys: np.ndarray, nationkey: np.ndarray,
+                      lo: int, hi: int) -> Column:
+        def build():
+            ks = np.arange(lo, hi, dtype=np.int64)
+            nk = u_int(nk_stream, ks, 0, 24)
+            a = u_int(stream + 1, ks, 100, 999)
+            b = u_int(stream + 2, ks, 100, 999)
+            c = u_int(stream + 3, ks, 1000, 9999)
+            cc = nk + 10
+            return [f"{int(cc[i]):02d}-{int(a[i])}-{int(b[i])}-{int(c[i])}"
+                    for i in range(len(ks))]
+
+        d = self._shared_dict(name, hi - lo, build)
+        if d is None:
+            return _phone_column(stream, keys, nationkey)
+        return Column(T.VARCHAR, (keys - lo).astype(np.int32), None, d)
+
+    def _pname_column(self, keys: np.ndarray) -> Column:
+        """P_NAME: five color words per part key (spec's P_NAME), over
+        the table's full key domain so every split shares one
+        dictionary."""
+        def words(ks: np.ndarray) -> list:
+            ids = [u_int(_S_PART + 10 + i, ks, 0, len(COLORS) - 1)
+                   for i in range(5)]
+            return [" ".join(COLORS[int(ids[i][j])] for i in range(5))
+                    for j in range(len(ks))]
+
+        d = self._shared_dict(
+            "part:p_name", self.n_part,
+            lambda: words(np.arange(1, self.n_part + 1, dtype=np.int64)))
+        if d is None:
+            return Column(T.VARCHAR,
+                          np.arange(len(keys), dtype=np.int32), None,
+                          Dictionary(words(keys)))
+        return Column(T.VARCHAR, (keys - 1).astype(np.int32), None, d)
 
     # -- tiny fixed tables ----------------------------------------------
     def gen_region(self, columns: Sequence[str]) -> Batch:
@@ -269,13 +349,16 @@ class TpchGenerator:
             if c == "s_suppkey":
                 cols.append(Column(T.BIGINT, keys))
             elif c == "s_name":
-                cols.append(_fmt_column("Supplier", keys))
+                cols.append(self._fmt_shared("supplier:s_name", "Supplier",
+                                             keys, 1, self.n_supplier + 1))
             elif c == "s_address":
                 cols.append(_address_column(_S_SUPP + 2, keys))
             elif c == "s_nationkey":
                 cols.append(Column(T.BIGINT, nationkey))
             elif c == "s_phone":
-                cols.append(_phone_column(_S_SUPP + 4, keys, nationkey))
+                cols.append(self._phone_shared(
+                    "supplier:s_phone", _S_SUPP + 4, _S_SUPP + 3, keys,
+                    nationkey, 1, self.n_supplier + 1))
             elif c == "s_acctbal":
                 cols.append(_money(u_int(_S_SUPP + 5, keys, -99_999, 999_999),
                                    self.money_type))
@@ -293,13 +376,16 @@ class TpchGenerator:
             if c == "c_custkey":
                 cols.append(Column(T.BIGINT, keys))
             elif c == "c_name":
-                cols.append(_fmt_column("Customer", keys))
+                cols.append(self._fmt_shared("customer:c_name", "Customer",
+                                             keys, 1, self.n_customer + 1))
             elif c == "c_address":
                 cols.append(_address_column(_S_CUST + 2, keys))
             elif c == "c_nationkey":
                 cols.append(Column(T.BIGINT, nationkey))
             elif c == "c_phone":
-                cols.append(_phone_column(_S_CUST + 4, keys, nationkey))
+                cols.append(self._phone_shared(
+                    "customer:c_phone", _S_CUST + 4, _S_CUST + 3, keys,
+                    nationkey, 1, self.n_customer + 1))
             elif c == "c_acctbal":
                 cols.append(_money(u_int(_S_CUST + 5, keys, -99_999, 999_999),
                                    self.money_type))
@@ -318,13 +404,7 @@ class TpchGenerator:
             if c == "p_partkey":
                 cols.append(Column(T.BIGINT, keys))
             elif c == "p_name":
-                # five color words, as in the spec's P_NAME
-                ids = [u_int(_S_PART + 10 + i, keys, 0, len(COLORS) - 1)
-                       for i in range(5)]
-                d = Dictionary([" ".join(COLORS[int(ids[i][j])] for i in range(5))
-                                for j in range(len(keys))])
-                cols.append(Column(T.VARCHAR, np.arange(len(keys), dtype=np.int32),
-                                   None, d))
+                cols.append(self._pname_column(keys))
             elif c == "p_mfgr":
                 m = u_int(_S_PART + 2, keys, 1, 5)
                 d = Dictionary([f"Manufacturer#{i}" for i in range(1, 6)])
@@ -462,8 +542,13 @@ class TpchGenerator:
                 cols.append(_enum_column(_S_ORD + 6, okey, PRIORITIES))
             elif c == "o_clerk":
                 clerk = u_int(_S_ORD + 7, okey, 1, self.n_clerks)
-                d = Dictionary([f"Clerk#{i:09d}"
-                                for i in range(1, self.n_clerks + 1)])
+                d = self._shared_dict(
+                    "orders:o_clerk", self.n_clerks,
+                    lambda: [f"Clerk#{i:09d}"
+                             for i in range(1, self.n_clerks + 1)])
+                if d is None:
+                    d = Dictionary([f"Clerk#{i:09d}"
+                                    for i in range(1, self.n_clerks + 1)])
                 cols.append(Column(T.VARCHAR, (clerk - 1).astype(np.int32),
                                    None, d))
             elif c == "o_shippriority":

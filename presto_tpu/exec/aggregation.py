@@ -29,6 +29,41 @@ class AggChannel:
     out_type: T.Type
 
 
+# merge primitive per partial-state component (the Step.FINAL half of
+# HashAggregationOperator.Step:61 for the device prims): re-aggregating a
+# pre-reduced partial state with these yields the same answer as
+# aggregating the raw rows.  Shared by the fusion pass (exec/fusion.py)
+# when it pushes the partial accumulate into a scan segment.
+MERGE_PRIM = {"count": "sum", "sum": "sum", "min": "min", "max": "max"}
+
+
+def _apply_post_projections(batch: Batch, stages) -> Batch:
+    """Apply absorbed finalize projections to an aggregation's output
+    (exec/fusion.py folds the filter-less post-aggregation FilterProject
+    run into the aggregation finish).  ``stages`` is a list of
+    projection lists, applied in order.  Aggregation outputs are
+    group-sized, so vectorized host evaluation costs less than one more
+    device program launch per stage."""
+    import numpy as np
+
+    from presto_tpu.expr.compile import (
+        ExprCompiler, batch_pairs, result_column,
+    )
+
+    batch = batch.compact().to_numpy()
+    for projections in stages:
+        compiler = ExprCompiler({i: c.dictionary
+                                 for i, c in enumerate(batch.columns)
+                                 if c.dictionary is not None})
+        cprojs = [compiler.compile(p) for p in projections]
+        pairs = batch_pairs(batch)
+        n = batch.num_rows
+        cols = tuple(result_column(p, *p.run(pairs, n, np))
+                     for p in cprojs)
+        batch = Batch(cols, n)
+    return batch
+
+
 def _minmax_dict_input(a: "AggChannel", col):
     """min/max over a dictionary column reduce *lexicographic ranks* (codes
     are interning order, not sort order); the returned postprocess maps the
@@ -189,11 +224,14 @@ def host_aggregate(batches: List[Batch], group_channels: Sequence[int],
 
 class HashAggregationOperator(Operator):
     def __init__(self, ctx: OperatorContext, group_channels: Sequence[int],
-                 aggs: Sequence[AggChannel], input_types: Sequence[T.Type]):
+                 aggs: Sequence[AggChannel], input_types: Sequence[T.Type],
+                 post_projections=None):
         super().__init__(ctx)
         self.group_channels = list(group_channels)
         self.aggs = list(aggs)
         self.input_types = list(input_types)
+        self.post_projections = (list(post_projections)
+                                 if post_projections else None)
         self._batches: List[Batch] = []
         self._outputs: List[Batch] = []
         self._done = False
@@ -385,31 +423,43 @@ class HashAggregationOperator(Operator):
         if not self._outputs:
             return None
         self._done = True
-        return self._outputs.pop(0)
+        out = self._outputs.pop(0)
+        if self.post_projections is not None and out.num_rows:
+            out = _apply_post_projections(out, self.post_projections)
+        return out
 
     def is_finished(self) -> bool:
         return self._finishing and not self._outputs
 
 
 class HashAggregationOperatorFactory(OperatorFactory):
-    def __init__(self, group_channels, aggs, input_types):
+    def __init__(self, group_channels, aggs, input_types,
+                 post_projections=None):
         self.group_channels = list(group_channels)
         self.aggs = list(aggs)
         self.input_types = list(input_types)
+        # absorbed filter-less finalize projection (exec/fusion.py)
+        self.post_projections = post_projections
+        # aggregation step this factory lowers ("single"/"partial"/
+        # "final"), set by the physical planner for the fusion pass
+        self.step = "single"
 
     def create(self, ctx: OperatorContext) -> HashAggregationOperator:
         return HashAggregationOperator(ctx, self.group_channels, self.aggs,
-                                       self.input_types)
+                                       self.input_types,
+                                       post_projections=self.post_projections)
 
 
 class GlobalAggregationOperator(Operator):
     """Ungrouped aggregation: exactly one output row, even on empty input."""
 
     def __init__(self, ctx: OperatorContext, aggs: Sequence[AggChannel],
-                 input_types: Sequence[T.Type]):
+                 input_types: Sequence[T.Type], post_projections=None):
         super().__init__(ctx)
         self.aggs = list(aggs)
         self.input_types = list(input_types)
+        self.post_projections = (list(post_projections)
+                                 if post_projections else None)
         self._batches: List[Batch] = []
         self._output: Optional[Batch] = None
 
@@ -480,6 +530,8 @@ class GlobalAggregationOperator(Operator):
 
     def get_output(self) -> Optional[Batch]:
         out, self._output = self._output, None
+        if out is not None and self.post_projections is not None:
+            out = _apply_post_projections(out, self.post_projections)
         return out
 
     def is_finished(self) -> bool:
@@ -487,9 +539,13 @@ class GlobalAggregationOperator(Operator):
 
 
 class GlobalAggregationOperatorFactory(OperatorFactory):
-    def __init__(self, aggs, input_types):
+    def __init__(self, aggs, input_types, post_projections=None):
         self.aggs = list(aggs)
         self.input_types = list(input_types)
+        self.post_projections = post_projections
+        self.step = "single"
 
     def create(self, ctx: OperatorContext) -> GlobalAggregationOperator:
-        return GlobalAggregationOperator(ctx, self.aggs, self.input_types)
+        return GlobalAggregationOperator(
+            ctx, self.aggs, self.input_types,
+            post_projections=self.post_projections)

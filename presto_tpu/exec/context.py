@@ -83,6 +83,11 @@ class OperatorStats:
     # instead of eyeballing traces.
     jit_dispatches: int = 0
     jit_compiles: int = 0
+    # rows folded into in-segment partial-aggregation pre-reduce
+    # (exec/fusion.py Fusion II): nonzero proves the scan->agg pipeline
+    # emitted partial states, not row batches — tests pin on this
+    # instead of eyeballing operator chains.
+    prereduce_rows: int = 0
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -111,6 +116,8 @@ class TaskContext:
         return {
             "dispatches": sum(s.jit_dispatches for s in self.operator_stats),
             "compiles": sum(s.jit_compiles for s in self.operator_stats),
+            "prereduce_rows": sum(s.prereduce_rows
+                                  for s in self.operator_stats),
         }
 
     def register_cleanup(self, fn) -> None:

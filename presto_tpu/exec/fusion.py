@@ -31,7 +31,27 @@ batches and the segment coalesces them up to ``scan_batch_rows`` before
 staging + dispatching once, so many tiny per-split batches cost one
 launch instead of one each.  Dictionary columns are re-coded into a
 per-operator target dictionary so coalesced flushes share one compiled
-program.
+program.  Segments fed by a remote exchange coalesce the same way
+(pages arrive host-side and small), so exchange-fed probe sides stop
+dispatching once per tiny page.
+
+Fusion II — in-segment partial-aggregation pre-reduce: a segment that
+feeds a partial or single-step ``HashAggregationOperator`` /
+``GlobalAggregationOperator`` (device prims only, bounded-domain group
+keys) absorbs the per-batch accumulate into the program itself: the
+jitted kernel masks, projects, and group-accumulates (via
+ops.groupby's segment kernels, no compaction — the filter rides as the
+live mask) before anything materializes, emitting partial-state
+batches (keys + component columns) instead of row batches.  The
+reference avoids the same materialization by pushing the partial
+``HashAggregationOperator.Step`` into the generated scan loop
+(HashAggregationOperator.java:48).  Downstream, a single-step
+aggregation is replaced by its merge form (MERGE_PRIM re-aggregation
+of the tiny partials, filter-less finalize projection folded into the
+aggregation finish); a partial-step aggregation is dropped outright —
+the FINAL stage's merge already accepts partials at any granularity.
+Gated by ``EngineConfig.fusion_partial_agg`` (default on; off restores
+the PR 3 lowering exactly).
 
 Segment programs are cached globally (``kernelcache``) keyed by segment
 expression keys + capacity bucket + dictionary binding (token, length) +
@@ -53,6 +73,10 @@ import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.batch import Batch, Column, Dictionary, next_bucket
+from presto_tpu.exec.aggregation import (
+    MERGE_PRIM, AggChannel, GlobalAggregationOperatorFactory,
+    HashAggregationOperatorFactory,
+)
 from presto_tpu.exec.context import OperatorContext
 from presto_tpu.exec.dynamicfilter import (
     DynamicFilter, DynamicFilterOperatorFactory,
@@ -134,6 +158,117 @@ def _fusable(f) -> bool:
     return False
 
 
+@dataclasses.dataclass(frozen=True)
+class PreReduceSpec:
+    """In-segment partial-aggregation pre-reduce (Fusion II).
+
+    ``group_channels``/``aggs`` index the SEGMENT's output channel
+    space (== the absorbed aggregation's input space); the segment then
+    emits the partial schema [key columns..., one state column per
+    aggregation].  ``key_types`` are the group-key output types (kept
+    for describe()); ``global_`` marks the ungrouped form, which emits
+    exactly one partial row per dispatched batch plus a default row at
+    finish when nothing was dispatched (a task must never contribute
+    zero partial rows — the merge's count-sum would yield NULL where
+    COUNT over empty input is 0).
+    """
+
+    group_channels: Tuple[int, ...]
+    aggs: Tuple[AggChannel, ...]
+    key_types: Tuple[T.Type, ...]
+    global_: bool
+
+    def key(self) -> tuple:
+        return ("prereduce", self.group_channels, self.global_,
+                tuple((a.prim, a.channel, a.out_type) for a in self.aggs))
+
+
+def _segment_out_types(stages) -> Optional[List[T.Type]]:
+    """The segment's output channel types: the last FP stage's
+    projection types (DF stages filter rows, never remap channels)."""
+    for s in reversed(stages):
+        if isinstance(s, FPStage):
+            return [p.type for p in s.projections]
+    return None
+
+
+def _try_pre_reduce(stages, factory, config):
+    """When ``factory`` (the operator the run feeds) is an eligible
+    aggregation, return ``(spec, replacement)``: the pre-reduce spec the
+    segment absorbs and the downstream factory that replaces the
+    aggregation — a merge-form aggregation for single/final steps, or
+    None for the partial step (the FINAL stage's merge accepts partials
+    at any granularity, so the partial operator is dropped outright).
+
+    Eligibility: device prims only (sum/count/min/max — collect-style
+    accumulators need the host path), no min/max over dictionary inputs
+    (their partial state would be interning codes, not values), and
+    every group key dictionary-coded or boolean so the per-batch
+    reduction can take the bounded-domain direct path (unbounded keys
+    would make per-batch pre-reduce a pessimization: as many groups as
+    rows, nothing reduced).  Returns (None, None) when ineligible.
+    """
+    if not getattr(config, "fusion_partial_agg", False):
+        return None, None
+    is_hash = isinstance(factory, HashAggregationOperatorFactory)
+    is_global = isinstance(factory, GlobalAggregationOperatorFactory)
+    if not (is_hash or is_global):
+        return None, None
+    out_types = _segment_out_types(stages)
+    if out_types is None or len(out_types) != len(factory.input_types):
+        return None, None
+    for a in factory.aggs:
+        if a.prim not in MERGE_PRIM:
+            return None, None
+        if a.channel is not None:
+            if a.channel >= len(out_types):
+                return None, None
+            if out_types[a.channel].is_nested:
+                return None, None
+            if a.prim in ("min", "max") \
+                    and out_types[a.channel].is_dictionary:
+                return None, None
+    groups = tuple(factory.group_channels) if is_hash else ()
+    if is_hash:
+        if not groups:
+            return None, None
+        for g in groups:
+            t = out_types[g]
+            if not (t.is_dictionary or t.name == "boolean"):
+                return None, None
+    spec = PreReduceSpec(groups, tuple(factory.aggs),
+                         tuple(out_types[g] for g in groups), is_global)
+    step = getattr(factory, "step", "single")
+    if step == "partial":
+        return spec, None
+    k = len(groups)
+    partial_types = ([out_types[g] for g in groups]
+                     + [a.out_type for a in factory.aggs])
+    merge_aggs = [AggChannel(MERGE_PRIM[a.prim], k + i, a.out_type)
+                  for i, a in enumerate(factory.aggs)]
+    if is_hash:
+        replacement = HashAggregationOperatorFactory(
+            list(range(k)), merge_aggs, partial_types)
+    else:
+        replacement = GlobalAggregationOperatorFactory(
+            merge_aggs, partial_types)
+    replacement.step = step
+    return spec, replacement
+
+
+def _exchange_adjacent(prev) -> bool:
+    """True when ``prev`` is a remote-exchange source whose pages the
+    segment should coalesce (they arrive host-side and page-sized)."""
+    try:
+        from presto_tpu.server.exchangeop import (
+            ExchangeOperatorFactory, MergeExchangeOperatorFactory,
+        )
+    except Exception:  # noqa: BLE001 - server tier absent in slim envs
+        return False
+    return isinstance(prev, (ExchangeOperatorFactory,
+                             MergeExchangeOperatorFactory))
+
+
 def _partition_spec(sink) -> Optional[Tuple[Tuple[int, ...], int]]:
     """(channels, n_partitions) when ``sink`` is a hash-partitioned
     output whose partition ids a segment can precompute."""
@@ -156,10 +291,12 @@ def _partition_spec(sink) -> Optional[Tuple[Tuple[int, ...], int]]:
 def fuse_chain(factories: List[OperatorFactory], config
                ) -> List[OperatorFactory]:
     """Replace maximal runs of fusable factories with FusedSegment
-    factories.  A run fuses when it is ≥ 2 operators, or rides directly
-    on a device-staging TableScan (scan coalescing), or feeds a
-    hash-partitioned output (partition-id fusion); it must contain at
-    least one FilterProject stage (the segment's type anchor)."""
+    factories.  A run fuses when it is ≥ 2 operators, rides directly on
+    a device-staging TableScan (scan coalescing) or a remote exchange
+    (page coalescing), feeds a hash-partitioned output (partition-id
+    fusion), or feeds an eligible aggregation (partial-agg pre-reduce);
+    it must contain at least one FilterProject stage (the segment's
+    type anchor)."""
     result: List[OperatorFactory] = []
     n = len(factories)
     i = 0
@@ -177,9 +314,43 @@ def fuse_chain(factories: List[OperatorFactory], config
         scan = (result[-1] if result
                 and isinstance(result[-1], TableScanOperatorFactory)
                 and result[-1].to_device else None)
-        partition = _partition_spec(factories[j]) if j < n else None
-        if not has_fp or (len(run) < 2 and scan is None
-                          and partition is None):
+        exch = (getattr(config, "fusion_partial_agg", False) and result
+                and _exchange_adjacent(result[-1]))
+        # in-segment partial-aggregation pre-reduce: the run's output
+        # feeds an eligible aggregation -> absorb its per-batch
+        # accumulate; the aggregation becomes its merge form (or, for
+        # the partial step, disappears — the FINAL merge takes over)
+        spec = replacement = None
+        consumed = j
+        if has_fp and j < n:
+            spec, replacement = _try_pre_reduce(stages, factories[j],
+                                                config)
+            if spec is not None:
+                consumed = j + 1
+                post_stages = []
+                while (replacement is not None and consumed < n
+                        and isinstance(factories[consumed],
+                                       FilterProjectOperatorFactory)
+                        and factories[consumed].filter_expr is None):
+                    # fold the finalize projection run into the merge
+                    # aggregation's finish: group-sized output, host
+                    # vector math beats one more program launch per
+                    # stacked projection
+                    post_stages.append(
+                        list(factories[consumed].projections))
+                    consumed += 1
+                if post_stages:
+                    replacement.post_projections = post_stages
+        partition = None
+        if spec is None or replacement is None:
+            # the segment's own output reaches the next factory (no
+            # merge aggregation in between): partition-id fusion may
+            # apply — including over pre-reduced partial rows feeding a
+            # partial fragment's exchange sink
+            partition = (_partition_spec(factories[consumed])
+                         if consumed < n else None)
+        if not has_fp or (len(run) < 2 and scan is None and not exch
+                          and partition is None and spec is None):
             result.extend(run)
             i = j
             continue
@@ -191,12 +362,17 @@ def fuse_chain(factories: List[OperatorFactory], config
                 scan.connector, scan.columns, scan.batch_rows,
                 to_device=False, table=scan.table)
             coalesce_rows = config.scan_batch_rows
+        elif exch:
+            coalesce_rows = config.scan_batch_rows
         if partition is not None:
-            factories[j].precomputed = True
+            factories[consumed].precomputed = True
         result.append(FusedSegmentOperatorFactory(
             stages, coalesce_rows=coalesce_rows, partition_spec=partition,
-            min_batch_capacity=config.min_batch_capacity))
-        i = j
+            min_batch_capacity=config.min_batch_capacity,
+            agg_spec=spec))
+        if replacement is not None:
+            result.append(replacement)
+        i = consumed if spec is not None else j
     return result
 
 
@@ -231,14 +407,25 @@ class FusedSegmentOperator(Operator):
     batch; optionally coalesces host scan batches first."""
 
     def __init__(self, ctx: OperatorContext, stages: Sequence,
-                 coalesce_rows: int, partition_spec, min_batch_capacity):
+                 coalesce_rows: int, partition_spec, min_batch_capacity,
+                 agg_spec: Optional[PreReduceSpec] = None):
         super().__init__(ctx)
         self.stages = list(stages)
         self.partition_spec = partition_spec
-        self._expr_key = tuple(s.key() for s in stages)
+        self.agg_spec = agg_spec
+        # the bounded-domain direct-vs-sort decision is made at trace
+        # time against this threshold; programs are shared globally, so
+        # the threshold is part of the cache key
+        self._max_domain = int(getattr(
+            ctx.config, "direct_groupby_max_domain", 1 << 12))
+        key_parts: tuple = tuple(s.key() for s in stages)
+        if agg_spec is not None:
+            key_parts = key_parts + (agg_spec.key(), self._max_domain)
+        self._expr_key = key_parts
         self._coalesce = int(coalesce_rows)
         self._min_capacity = int(min_batch_capacity)
         self._pending: Optional[Batch] = None     # device-batch path
+        self._emitted_any = False
         # host-coalescing path state
         self._acc: List[List[tuple]] = []          # per-flush batch parts
         self._acc_rows = 0
@@ -266,8 +453,12 @@ class FusedSegmentOperator(Operator):
             if self._acc_rows >= self._coalesce or (
                     self._finishing and self._acc_rows > 0):
                 return self._emit(self._dispatch(self._flush()))
+            if self._finishing and self._needs_default_row():
+                return self._emit(self._default_partial_batch())
             return None
         if self._pending is None:
+            if self._finishing and self._needs_default_row():
+                return self._emit(self._default_partial_batch())
             return None
         batch, self._pending = self._pending, None
         return self._emit(self._dispatch(batch))
@@ -275,13 +466,35 @@ class FusedSegmentOperator(Operator):
     def _emit(self, out: Optional[Batch]) -> Optional[Batch]:
         if out is None:
             return None
+        self._emitted_any = True
         self.ctx.stats.output_batches += 1
         self.ctx.stats.output_rows += out.num_rows
         return out
 
+    def _needs_default_row(self) -> bool:
+        """A global pre-reduce segment that dispatched nothing still owes
+        one default partial row (count=0, other states NULL): the merge
+        aggregation's count components re-aggregate with 'sum', and SUM
+        over zero partial rows is NULL where COUNT over empty is 0."""
+        return (self.agg_spec is not None and self.agg_spec.global_
+                and not self._emitted_any)
+
+    def _default_partial_batch(self) -> Batch:
+        cols = []
+        for a in self.agg_spec.aggs:
+            if a.prim == "count":
+                cols.append(Column(a.out_type, np.zeros(1, np.int64)))
+            else:
+                dictionary = (Dictionary()
+                              if a.out_type.is_dictionary else None)
+                cols.append(Column(a.out_type,
+                                   np.zeros(1, a.out_type.np_dtype),
+                                   np.zeros(1, bool), dictionary))
+        return Batch(tuple(cols), 1)
+
     def is_finished(self) -> bool:
         return self._finishing and self._pending is None \
-            and self._acc_rows == 0
+            and self._acc_rows == 0 and not self._needs_default_row()
 
     # -- host coalescing (scan-adjacent segments) ------------------------
     def _accumulate(self, batch: Batch) -> None:
@@ -376,6 +589,8 @@ class FusedSegmentOperator(Operator):
             self.ctx.stats.jit_compiles += 1
         fn, out_meta = entry
         self.ctx.stats.jit_dispatches += 1
+        if self.agg_spec is not None:
+            self.ctx.stats.prereduce_rows += batch.num_rows
         outs, count, parts = fn(tuple(column_pairs(batch)),
                                 batch.num_rows, df_args)
         n = int(count)
@@ -413,6 +628,16 @@ class FusedSegmentOperator(Operator):
                 di += 1
         cap = batch.capacity
         partition = self.partition_spec
+        agg = self.agg_spec
+        max_domain = self._max_domain
+        if agg is not None:
+            # partial schema: [key columns..., one state col per agg]
+            key_meta = [out_meta[g] for g in agg.group_channels]
+            final_meta = key_meta + [(a.out_type, None) for a in agg.aggs]
+            agg_prims = [(a.prim, a.channel) for a in agg.aggs]
+            out_dtypes = [a.out_type.np_dtype for a in agg.aggs]
+        else:
+            final_meta = out_meta
 
         def kernel(cols, num_rows, df_args):
             import jax.numpy as jnp
@@ -452,16 +677,63 @@ class FusedSegmentOperator(Operator):
                         if valid is not None:
                             m = m & valid
                         mask = m if mask is None else mask & m
-            if mask is not None:
+            if agg is not None:
+                # pre-reduce: NO compaction — the accumulated mask rides
+                # into the group kernels as the live mask, and the
+                # segment emits per-batch partial group states instead
+                # of rows (HashAggregationOperator.java:48 partial step,
+                # fused into the scan program)
+                from presto_tpu.ops.groupby import (
+                    global_pre_reduce, segment_pre_reduce,
+                )
+
+                agg_ins = []
+                for prim, ch in agg_prims:
+                    if ch is None:
+                        agg_ins.append(("count", None, None))
+                    else:
+                        v, valid = cur[ch]
+                        agg_ins.append((prim, v, valid))
+                if agg.global_:
+                    outs = tuple(global_pre_reduce(
+                        agg_ins, out_dtypes, num_rows, mask))
+                    count = 1
+                else:
+                    keys = []
+                    doms = []
+                    bounded = True
+                    total = 1
+                    for g, (typ, d) in zip(agg.group_channels, key_meta):
+                        v, valid = cur[g]
+                        keys.append((v, valid, typ))
+                        if d is not None:
+                            dom = len(d)
+                        elif typ.name == "boolean":
+                            dom = 2
+                        else:
+                            bounded = False
+                            dom = 0
+                        doms.append(dom)
+                        total *= dom + (1 if valid is not None else 0)
+                    # direct (bounded-domain) vs sort path, decided at
+                    # trace time: the sort fallback runs at the batch
+                    # capacity, so per-batch groups can never overflow
+                    use_direct = bounded and 0 < total <= max_domain
+                    key_outs, agg_outs, count = segment_pre_reduce(
+                        keys, agg_ins, out_dtypes, num_rows, mask,
+                        doms if use_direct else None, cap)
+                    outs = tuple(key_outs) + tuple(agg_outs)
+            elif mask is not None:
                 # ONE compaction for the whole segment: every stage's
                 # filter landed in the accumulated mask, so unselected
                 # rows were computed over (harmless, like padding rows)
                 # but never gathered or materialized
                 idx, count = selected_positions(mask, None, num_rows, cap)
-                cur = tuple(
+                outs = tuple(
                     (v[idx], None if valid is None else valid[idx])
                     for v, valid in cur)
             else:
+                outs = cur
                 count = num_rows
             parts = None
             if partition is not None:
@@ -472,30 +744,33 @@ class FusedSegmentOperator(Operator):
                 channels, nparts = partition
                 triples = []
                 for ch in channels:
-                    v, valid = cur[ch]
-                    typ, d = out_meta[ch]
+                    v, valid = outs[ch]
+                    typ, d = final_meta[ch]
                     triples.append(value_hash_triple(
                         _ColView(v, valid, typ, d)))
                 parts = partition_of(row_hash(triples), nparts)
-            return cur, count, parts
+            return outs, count, parts
 
-        return jax.jit(kernel), list(out_meta)
+        return jax.jit(kernel), list(final_meta)
 
 
 class FusedSegmentOperatorFactory(OperatorFactory):
     parallel_safe = True
 
     def __init__(self, stages: Sequence, coalesce_rows: int = 0,
-                 partition_spec=None, min_batch_capacity: int = 1024):
+                 partition_spec=None, min_batch_capacity: int = 1024,
+                 agg_spec: Optional[PreReduceSpec] = None):
         self.stages = list(stages)
         self.coalesce_rows = coalesce_rows
         self.partition_spec = partition_spec
         self.min_batch_capacity = min_batch_capacity
+        self.agg_spec = agg_spec
 
     def create(self, ctx: OperatorContext) -> FusedSegmentOperator:
         return FusedSegmentOperator(ctx, self.stages, self.coalesce_rows,
                                     self.partition_spec,
-                                    self.min_batch_capacity)
+                                    self.min_batch_capacity,
+                                    agg_spec=self.agg_spec)
 
     def describe(self) -> str:
         """Human-readable stage summary (tools/fusion_report.py)."""
@@ -508,6 +783,11 @@ class FusedSegmentOperatorFactory(OperatorFactory):
                         len(s.projections)))
             else:
                 parts.append("df(keys=%s)" % (list(s.key_channels),))
+        if self.agg_spec is not None:
+            parts.append("prereduce(%s, %d aggs)" % (
+                "global" if self.agg_spec.global_
+                else "keys=%s" % (list(self.agg_spec.group_channels),),
+                len(self.agg_spec.aggs)))
         extra = []
         if self.coalesce_rows:
             extra.append(f"coalesce={self.coalesce_rows}")

@@ -40,7 +40,21 @@ def _parallel_prefix(p: Pipeline, config: EngineConfig) -> int:
             break
     # the whole chain being safe means there is no consumer stage left
     # to protect — still split before the terminal sink
-    return min(k, len(p.factories) - 1)
+    k = min(k, len(p.factories) - 1)
+    if k > 1 and getattr(config, "fusion_partial_agg", False):
+        from presto_tpu.exec.fusion import FusedSegmentOperatorFactory
+
+        last = p.factories[k - 1]
+        if isinstance(last, FusedSegmentOperatorFactory) \
+                and last.coalesce_rows:
+            # a coalescing segment batches everything it sees anyway, so
+            # place it CONSUMER-side: one operator coalesces across all
+            # feed drivers and dispatches once per coalesced batch,
+            # instead of one flush per feeder.  Feeders keep the
+            # parallel half that actually scales on the host (split
+            # decode); the device program was serialized regardless.
+            k -= 1
+    return k
 
 
 def _run_parallel(p: Pipeline, task: TaskContext, prefix: int,

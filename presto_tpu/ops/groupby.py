@@ -389,6 +389,91 @@ def decode_direct_keys(slots: jax.Array,
     return out[::-1]
 
 
+def segment_pre_reduce(
+    key_columns: Sequence[Tuple[jax.Array, Optional[jax.Array], T.Type]],
+    aggs: Sequence[Tuple[str, Optional[jax.Array], Optional[jax.Array]]],
+    out_dtypes: Sequence,
+    num_rows: jax.Array,
+    live_mask: Optional[jax.Array],
+    doms: Optional[Sequence[int]],
+    group_capacity: int,
+):
+    """Per-batch partial-aggregation pre-reduce for fused scan segments
+    (exec/fusion.py): the in-program analogue of the reference pushing
+    the partial ``HashAggregationOperator`` step into the generated scan
+    loop (HashAggregationOperator.java:48).  Runs INSIDE a traced
+    segment program, after the accumulated filter mask, with no
+    compaction: ``live_mask`` carries the filter.
+
+    ``doms`` non-None selects the gather-free direct path (bounded key
+    domains: dictionary codes / booleans — decided at trace time from
+    the segment's output dictionaries); None falls back to the sort
+    path at ``group_capacity`` (== the batch capacity, so per-batch
+    group counts can never overflow and no host retry loop is needed).
+
+    Returns ``(key_outs, agg_outs, num_groups)``: per key column a
+    ``(codes, valid)`` pair in the input dtype/dictionary space, per
+    aggregation a ``(values, valid)`` partial-state pair (count states
+    are always-valid int64; sum/min/max states are valid iff the group
+    saw a non-null input — exactly what the merge primitives of the
+    FINAL step expect).
+    """
+    if doms is not None:
+        key_codes = [(v, valid) for v, valid, _t in key_columns]
+        present, results = direct_grouped_aggregate(
+            key_codes, doms, aggs, num_rows, live_mask=live_mask)
+        domain = present.shape[0]
+        slots = jnp.nonzero(present, size=domain, fill_value=0)[0]
+        num_groups = present.sum()
+        decoded = decode_direct_keys(
+            slots, [valid is not None for _v, valid, _t in key_columns],
+            doms)
+        key_outs = []
+        for (src, _valid, _t), (codes, valid) in zip(key_columns, decoded):
+            key_outs.append((codes.astype(src.dtype), valid))
+    else:
+        group_index, num_groups, results = grouped_aggregate(
+            key_columns, aggs, num_rows, group_capacity,
+            live_mask=live_mask)
+        key_outs = []
+        for v, valid, _t in key_columns:
+            key_outs.append((v[group_index],
+                             None if valid is None else valid[group_index]))
+        slots = None
+    agg_outs = []
+    for (prim, _values, _valid), dtype, (values, cnt) in zip(
+            aggs, out_dtypes, results):
+        if slots is not None:
+            values = values[slots]
+            cnt = cnt[slots]
+        if prim == "count":
+            agg_outs.append((values.astype(jnp.int64), None))
+        else:
+            agg_outs.append((values.astype(dtype), cnt > 0))
+    return key_outs, agg_outs, num_groups
+
+
+def global_pre_reduce(
+    aggs: Sequence[Tuple[str, Optional[jax.Array], Optional[jax.Array]]],
+    out_dtypes: Sequence,
+    num_rows: jax.Array,
+    live_mask: Optional[jax.Array],
+):
+    """Ungrouped counterpart of segment_pre_reduce: one partial-state
+    row per batch (AggregationOperator partial step in-program)."""
+    results = global_aggregate(aggs, num_rows, live_mask=live_mask)
+    agg_outs = []
+    for (prim, _values, _valid), dtype, (value, cnt) in zip(
+            aggs, out_dtypes, results):
+        if prim == "count":
+            agg_outs.append((jnp.reshape(value, (1,)).astype(jnp.int64),
+                             None))
+        else:
+            agg_outs.append((jnp.reshape(value, (1,)).astype(dtype),
+                             jnp.reshape(cnt > 0, (1,))))
+    return agg_outs
+
+
 def global_aggregate(aggs: Sequence[AggIn], num_rows: jax.Array,
                      live_mask: Optional[jax.Array] = None):
     """Ungrouped aggregation (AggregationOperator analogue): one output row

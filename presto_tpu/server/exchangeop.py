@@ -74,11 +74,17 @@ class PartitionedOutputOperator(Operator):
                         for c in self.channels]
             hashes = row_hash(key_cols)
             parts = np.asarray(partition_of(hashes, self.n))
+        # one stable argsort-by-partition + boundary slicing instead of
+        # one np.nonzero pass per partition: a single O(n log n) pass
+        # regardless of fan-out, and rows stay in input order within a
+        # partition (stable sort), exactly like the nonzero loop
+        order = np.argsort(parts, kind="stable")
+        bounds = np.searchsorted(parts[order], np.arange(self.n + 1))
         for p in range(self.n):
-            idx = np.nonzero(parts == p)[0]
-            if idx.size == 0:
+            lo, hi = int(bounds[p]), int(bounds[p + 1])
+            if lo == hi:
                 continue
-            sub = batch.take(jnp.asarray(idx))
+            sub = batch.take(jnp.asarray(order[lo:hi]))
             self.buffers.enqueue(p, serialize_batch(sub))
             self.ctx.stats.output_rows += sub.num_rows
 
@@ -280,6 +286,11 @@ class ExchangeClient:
                  task_id: Optional[str] = None):
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
+        # signaled on page arrival / stream completion / error so an
+        # exchange-bound driver can park in wait_for_page instead of
+        # sleep-polling (the reference blocks the driver on the
+        # ExchangeClient's isBlocked future the same way)
+        self._arrived = threading.Condition(self._lock)
         self._pages: List[bytes] = []
         self._buffered_bytes = 0
         self._max_buffered_bytes = max(1, max_buffered_bytes)
@@ -319,12 +330,14 @@ class ExchangeClient:
                 return
             self._pages.append(page)
             self._buffered_bytes += len(page)
+            self._arrived.notify_all()
 
     def on_error(self, e: Exception) -> None:
         with self._lock:
             self._error = e
             self._remaining = 0
             self._drained.notify_all()
+            self._arrived.notify_all()
 
     def on_source_error(self, source: "HttpPageClient",
                         e: Exception) -> None:
@@ -340,6 +353,7 @@ class ExchangeClient:
     def on_client_finished(self) -> None:
         with self._lock:
             self._remaining -= 1
+            self._arrived.notify_all()
 
     def close(self) -> None:
         """Stop accepting pages and unblock fetcher threads."""
@@ -348,6 +362,18 @@ class ExchangeClient:
             self._pages = []
             self._buffered_bytes = 0
             self._drained.notify_all()
+            self._arrived.notify_all()
+
+    def wait_for_page(self, timeout_s: float = 0.05) -> None:
+        """Park until a page arrives, a stream finishes, or an error
+        lands — bounded by ``timeout_s``.  Replaces the driver-side
+        2 ms sleep-poll: exchange-bound drivers wake ON page arrival
+        instead of on a timer."""
+        with self._lock:
+            if (self._pages or self._error is not None or self._closed
+                    or self._remaining == 0):
+                return
+            self._arrived.wait(timeout=timeout_s)
 
     def poll_page(self) -> Optional[bytes]:
         with self._lock:
@@ -385,9 +411,9 @@ class ExchangeOperator(Operator):
         page = self.client.poll_page()
         if page is None:
             if not self.client.finished:
-                import time
-
-                time.sleep(0.002)  # cooperative wait; driver re-polls
+                # condition-variable timed wait: wakes on page arrival
+                # instead of a fixed 2 ms timer (driver re-polls after)
+                self.client.wait_for_page()
             return None
         batch = deserialize_batch(page)
         self.ctx.stats.input_rows += batch.num_rows
@@ -514,13 +540,16 @@ class MergeExchangeOperator(Operator):
         if self.done:
             return None
         ready = True
+        stalled = None
         for i in range(len(self.clients)):
             if not self._refill(i):
                 ready = False
+                if stalled is None:
+                    stalled = i
         if not ready:
-            import time
-
-            time.sleep(0.002)  # cooperative wait; driver re-polls
+            # park on the first stalled stream's arrival condition
+            # instead of a fixed 2 ms sleep; driver re-polls after
+            self.clients[stalled].wait_for_page()
             return None
         out: List[tuple] = []
         while len(out) < self.batch_rows:

@@ -60,6 +60,8 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
                                                           "on")),
     "pipeline_fusion": ("pipeline_fusion",
                         lambda v: v.lower() in ("true", "1", "on")),
+    "fusion_partial_agg": ("fusion_partial_agg",
+                           lambda v: v.lower() in ("true", "1", "on")),
     "kernel_cache_capacity": ("kernel_cache_capacity", int),
     "whole_query_execution": ("whole_query_execution",
                               lambda v: v.lower() in ("true", "1", "on")),

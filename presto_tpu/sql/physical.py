@@ -357,11 +357,15 @@ class PhysicalPlanner:
                 chain.append(StreamingAggregationOperatorFactory(
                     list(node.group_channels), agg_channels, input_types))
             else:
-                chain.append(HashAggregationOperatorFactory(
-                    list(node.group_channels), agg_channels, input_types))
+                agg_fac = HashAggregationOperatorFactory(
+                    list(node.group_channels), agg_channels, input_types)
+                agg_fac.step = node.step
+                chain.append(agg_fac)
         else:
-            chain.append(GlobalAggregationOperatorFactory(
-                agg_channels, input_types))
+            agg_fac = GlobalAggregationOperatorFactory(
+                agg_channels, input_types)
+            agg_fac.step = node.step
+            chain.append(agg_fac)
 
         if node.step == "partial":
             # distributed PARTIAL: emit raw component columns (keys first);
@@ -440,11 +444,13 @@ class PhysicalPlanner:
             node.aggregates, ngroups)
 
         if ngroups:
-            chain.append(HashAggregationOperatorFactory(
-                list(node.group_channels), agg_channels, input_types))
+            agg_fac = HashAggregationOperatorFactory(
+                list(node.group_channels), agg_channels, input_types)
         else:
-            chain.append(GlobalAggregationOperatorFactory(
-                agg_channels, input_types))
+            agg_fac = GlobalAggregationOperatorFactory(
+                agg_channels, input_types)
+        agg_fac.step = "final"
+        chain.append(agg_fac)
 
         key_types = [input_types[c] for c in node.group_channels]
         post_in = key_types + [a.out_type for a in agg_channels]

@@ -243,6 +243,288 @@ def test_tpch_fusion_parity(qnum, runner_on, runner_off):
 
 
 # ---------------------------------------------------------------------------
+# in-segment partial-aggregation pre-reduce (Fusion II)
+# ---------------------------------------------------------------------------
+
+def _agg_chain(aggs, group_channels=(0,)):
+    """values -> filter(b < 90) -> HashAgg over a dict key with nulls in
+    both the key and the aggregated columns."""
+    from presto_tpu.exec.aggregation import HashAggregationOperatorFactory
+
+    rows = []
+    for i in range(40):
+        key = None if i % 13 == 0 else f"k{i % 3}"
+        b = None if i % 7 == 0 else i
+        d = None if i % 11 == 0 else float(i) * 1.5
+        rows.append((key, b, d))
+    batch = batch_from_pylist([T.VARCHAR, T.BIGINT, T.DOUBLE], rows)
+    types = [batch.columns[0].type, T.BIGINT, T.DOUBLE]
+    fp = FilterProjectOperatorFactory(
+        B.comparison("<", B.ref(1, T.BIGINT), B.const(90, T.BIGINT)),
+        [B.ref(0, types[0]), B.ref(1, T.BIGINT), B.ref(2, T.DOUBLE)],
+        types)
+    agg = HashAggregationOperatorFactory(list(group_channels), aggs, types)
+    return batch, [fp, agg]
+
+
+def _run_chain(batch, factories, cfg):
+    collector = OutputCollectorFactory()
+    chain = fuse_chain(
+        [ValuesOperatorFactory([batch.to_device()])] + list(factories),
+        cfg)
+    execute_pipelines([Pipeline(chain + [collector], name="t")], cfg)
+    return chain, sorted(collector.rows(), key=repr)
+
+
+def test_prereduce_hash_chain_parity():
+    """Hand-built chain: the pre-reduced segment + merge aggregation
+    must reproduce the unfused aggregation exactly — nullable dict key
+    (null group included), sum/count/count(*)/min/max with nulls."""
+    from presto_tpu.exec.aggregation import AggChannel
+    from presto_tpu.exec.fusion import FusedSegmentOperatorFactory
+
+    aggs = [AggChannel("sum", 1, T.BIGINT),
+            AggChannel("count", 1, T.BIGINT),
+            AggChannel("count", None, T.BIGINT),
+            AggChannel("min", 2, T.DOUBLE),
+            AggChannel("max", 2, T.DOUBLE)]
+    batch, factories = _agg_chain(aggs)
+    chain_on, rows_on = _run_chain(batch, factories, _cfg())
+    batch, factories = _agg_chain(aggs)
+    chain_off, rows_off = _run_chain(
+        batch, factories, _cfg(fusion_partial_agg=False))
+    assert rows_on == rows_off
+    seg_on = [f for f in chain_on
+              if isinstance(f, FusedSegmentOperatorFactory)]
+    assert seg_on and seg_on[0].agg_spec is not None
+    assert all(f.agg_spec is None for f in chain_off
+               if isinstance(f, FusedSegmentOperatorFactory))
+
+
+def test_prereduce_sort_path_fallback():
+    """A dictionary key whose domain exceeds direct_groupby_max_domain
+    still pre-reduces (sort path at batch capacity) with exact results."""
+    from presto_tpu.exec.aggregation import AggChannel
+
+    aggs = [AggChannel("sum", 1, T.BIGINT),
+            AggChannel("count", None, T.BIGINT)]
+    batch, factories = _agg_chain(aggs)
+    on = _run_chain(batch, factories, _cfg(direct_groupby_max_domain=1))
+    batch, factories = _agg_chain(aggs)
+    off = _run_chain(batch, factories, _cfg(fusion_partial_agg=False))
+    assert on[1] == off[1]
+
+
+def test_prereduce_global_empty_scan(runner_on):
+    """Global pre-reduce over a scan whose filter kills every row: the
+    per-batch partial row carries count=0, and the merge produces the
+    SQL empty-input defaults (count 0, sum NULL)."""
+    res = runner_on.execute(
+        "select count(*), sum(l_quantity), min(l_quantity) "
+        "from lineitem where l_quantity < 0")
+    assert res.rows == [(0, None, None)]
+
+
+def test_prereduce_global_default_row(runner_on):
+    """A global pre-reduce segment that never dispatched (zero input
+    batches) still owes its default partial row — COUNT over an empty
+    table is 0, not NULL."""
+    runner_on.execute(
+        "create table memory.fusion_empty_t (x bigint)")
+    res = runner_on.execute(
+        "select count(*), sum(x), max(x) from memory.fusion_empty_t "
+        "where x > 0")
+    assert res.rows == [(0, None, None)]
+    jc = runner_on._last_task.jit_counters()
+    assert jc["prereduce_rows"] == 0
+
+
+def test_q1_prereduce_dispatch_pin(runner_on):
+    """The acceptance pin: TPC-H Q1 at SF0.01 with fusion_partial_agg on
+    runs with strictly fewer jit dispatches than PR 3's 5, the scan rows
+    fold into in-segment partial states, and the downstream aggregation
+    consumes group-sized partials instead of row batches."""
+    runner_on.execute(QUERIES[1])
+    task = runner_on._last_task
+    jc = task.jit_counters()
+    assert 0 < jc["dispatches"] < 5, jc
+    assert jc["prereduce_rows"] > 50_000, jc
+    agg_in = sum(s.input_rows for s in task.operator_stats
+                 if "HashAggregation" in s.operator)
+    assert 0 < agg_in <= 64, agg_in   # partial states, not 60k rows
+
+
+def test_q6_prereduce_single_dispatch(runner_on):
+    """Q6-class scan->global-agg pipelines collapse to ONE dispatch per
+    coalesced batch: at SF0.01 the whole query is a single launch."""
+    runner_on.execute(QUERIES[6])
+    jc = runner_on._last_task.jit_counters()
+    assert jc["dispatches"] == 1, jc
+    assert jc["prereduce_rows"] > 50_000, jc
+
+
+def test_partial_agg_off_restores_pr3_lowering(runner_on):
+    """fusion_partial_agg=false must reproduce the PR 3 lowering
+    exactly: same factory chain (segment without agg_spec, standard
+    aggregation, separate finalize FilterProjects)."""
+    from presto_tpu.exec.aggregation import (
+        GlobalAggregationOperatorFactory, HashAggregationOperatorFactory,
+    )
+    from presto_tpu.sql.optimizer import optimize
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.physical import PhysicalPlanner
+    from presto_tpu.sql.planner import Planner
+
+    cfg = _cfg(fusion_partial_agg=False)
+    plan = optimize(
+        Planner(runner_on.metadata).plan(parse_statement(QUERIES[1])),
+        runner_on.metadata, cfg)
+    phys = PhysicalPlanner(runner_on.registry, cfg).plan(plan)
+    kinds = [type(f).__name__ for f in phys.pipelines[0].factories]
+    # the PR 3 shape: a plain segment feeds a standard aggregation, and
+    # the two finalize FilterProjects fuse into their own segment
+    assert kinds == [
+        "TableScanOperatorFactory", "FusedSegmentOperatorFactory",
+        "HashAggregationOperatorFactory", "FusedSegmentOperatorFactory",
+        "OrderByOperatorFactory", "OutputCollectorFactory"], kinds
+    for p in phys.pipelines:
+        for f in p.factories:
+            if isinstance(f, FusedSegmentOperatorFactory):
+                assert f.agg_spec is None
+            if isinstance(f, (HashAggregationOperatorFactory,
+                              GlobalAggregationOperatorFactory)):
+                assert f.post_projections is None
+
+
+def test_partial_agg_on_q1_lowering(runner_on):
+    """With the gate on, Q1's pipeline is scan -> pre-reducing segment
+    -> merge aggregation with the finalize projections folded in."""
+    from presto_tpu.exec.aggregation import HashAggregationOperatorFactory
+    from presto_tpu.sql.optimizer import optimize
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.physical import PhysicalPlanner
+    from presto_tpu.sql.planner import Planner
+
+    plan = optimize(
+        Planner(runner_on.metadata).plan(parse_statement(QUERIES[1])),
+        runner_on.metadata, runner_on.config)
+    phys = PhysicalPlanner(runner_on.registry,
+                           runner_on.config).plan(plan)
+    chain = phys.pipelines[0].factories
+    kinds = [type(f).__name__ for f in chain]
+    assert kinds == [
+        "TableScanOperatorFactory", "FusedSegmentOperatorFactory",
+        "HashAggregationOperatorFactory", "OrderByOperatorFactory",
+        "OutputCollectorFactory"], kinds
+    seg, agg = chain[1], chain[2]
+    assert seg.agg_spec is not None and not seg.agg_spec.global_
+    assert "prereduce" in seg.describe()
+    assert agg.post_projections and len(agg.post_projections) == 2
+    # merge prims re-aggregate the partial states
+    assert {a.prim for a in agg.aggs} <= {"sum", "min", "max"}
+
+
+def test_session_property_toggles_partial_agg():
+    r = LocalQueryRunner.tpch(scale=0.01)
+    r.execute("set session fusion_partial_agg = false")
+    r.execute(QUERIES[6])
+    off = r._last_task.jit_counters()
+    r.execute("set session fusion_partial_agg = true")
+    r.execute(QUERIES[6])
+    on = r._last_task.jit_counters()
+    assert off["prereduce_rows"] == 0
+    assert on["prereduce_rows"] > 0
+    assert on["dispatches"] < off["dispatches"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_partial_agg_parity(qnum, runner_on):
+    """fusion_partial_agg on vs off result parity across the full TPC-H
+    suite (partial sums merge in a different association order, so the
+    comparison is approximate like the conformance oracle's)."""
+    r_off = _PAGG_OFF_RUNNERS.setdefault(
+        "tpch", LocalQueryRunner.tpch(
+            scale=0.01, config=_cfg(fusion_partial_agg=False)))
+    ra = runner_on.execute(QUERIES[qnum])
+    rb = r_off.execute(QUERIES[qnum])
+    assert ra.column_names == rb.column_names
+    assert_rows_close(ra.rows, rb.rows)
+
+
+_PAGG_OFF_RUNNERS = {}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qnum", sorted(__import__(
+    "tpcds_queries").QUERIES))
+def test_tpcds_partial_agg_parity(qnum, runner_on):
+    """fusion_partial_agg on/off parity across the TPC-DS suite."""
+    from tpcds_queries import QUERIES as DSQ
+
+    r_off = _PAGG_OFF_RUNNERS.setdefault(
+        "tpcds", LocalQueryRunner.tpch(
+            scale=0.003, config=_cfg(fusion_partial_agg=False)))
+    r_on = _PAGG_OFF_RUNNERS.setdefault(
+        "tpcds_on", LocalQueryRunner.tpch(scale=0.003))
+    for r in (r_off, r_on):
+        r.metadata.default_catalog = "tpcds"
+    ra = r_on.execute(DSQ[qnum])
+    rb = r_off.execute(DSQ[qnum])
+    assert ra.column_names == rb.column_names
+    assert_rows_close(ra.rows, rb.rows)
+
+
+# ---------------------------------------------------------------------------
+# shared dictionary interning (one compile per (table, expr))
+# ---------------------------------------------------------------------------
+
+def test_shared_interning_compiles_once():
+    """Multi-split scan of one table compiles each unfused expression
+    kernel exactly once: every split serves the SAME per-table interning
+    dictionaries, so the kernel-cache (token, length) binding is stable
+    across splits (pre-PR4: one re-trace per split)."""
+    from presto_tpu.connectors.tpch import TpchConnector
+
+    conn = TpchConnector(scale=0.01)
+    handle = conn.get_table("customer")
+    splits = conn.get_splits(handle, 8)
+    assert len(splits) >= 4
+    vt = conn.table_schema(handle).column_type("c_name")
+    scan = TableScanOperatorFactory(
+        conn, ["c_custkey", "c_name", "c_phone"], table="customer")
+    fp = FilterProjectOperatorFactory(
+        B.comparison(">", B.ref(0, T.BIGINT), B.const(5, T.BIGINT)),
+        [B.ref(1, vt), B.ref(2, vt)], [T.BIGINT, vt, vt])
+    collector = OutputCollectorFactory()
+    cfg = _cfg(pipeline_fusion=False, task_concurrency=1)
+    task = execute_pipelines(
+        [Pipeline([scan, fp, collector], splits, name="t")], cfg)
+    jc = task.jit_counters()
+    assert jc["dispatches"] == len(splits)
+    assert jc["compiles"] == 1, jc
+    assert len(collector.rows()) == 1500 - 5
+
+
+def test_memory_interning_shares_table_dictionaries():
+    """Inserted batches re-code dictionary columns into per-table shared
+    interning tables, so multi-batch scans compile once per expression."""
+    r = LocalQueryRunner.tpch(
+        scale=0.01, config=_cfg(pipeline_fusion=False, task_concurrency=1))
+    r.execute("create table memory.interning_t (k bigint, s varchar)")
+    for i in range(3):
+        r.execute(f"insert into memory.interning_t values "
+                  f"({i}, 'v{i}'), ({i + 10}, 'w{i}')")
+    conn = r.registry.get("memory")
+    dicts = {id(b.columns[1].dictionary)
+             for b in conn.tables["interning_t"].batches}
+    assert len(dicts) == 1
+    res = r.execute("select s from memory.interning_t where k >= 0")
+    assert len(res.rows) == 6
+    assert r._last_task.jit_counters()["compiles"] == 1
+
+
+# ---------------------------------------------------------------------------
 # partition-id fusion (exchange sink)
 # ---------------------------------------------------------------------------
 

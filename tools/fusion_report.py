@@ -14,7 +14,9 @@ Usage:
         any parity miss or any query where fusion does not reduce launches
 
 ``--check --execute`` is the CI smoke mode: it fails when fused execution
-loses parity with unfused, or when no query fused at all.
+loses parity with unfused, when no query fused at all, or when TPC-H Q1
+at the default scale regresses past the partial-agg pre-reduce pin
+(PR 4: fewer than 5 jit dispatches, PR 3's count).
 """
 
 import argparse
@@ -119,8 +121,9 @@ def main(argv=None) -> int:
         segs = [f for p in pipelines for f in p.factories
                 if isinstance(f, FusedSegmentOperatorFactory)]
         total_segments += len(segs)
+        prereduced = sum(1 for s in segs if s.agg_spec is not None)
         print(f"=== {label}: {len(pipelines)} pipelines, "
-              f"{len(segs)} fused segments")
+              f"{len(segs)} fused segments, {prereduced} pre-reduced")
         for p in pipelines:
             print(f"  [{p.name}] " + " -> ".join(
                 describe(f) for f in p.factories))
@@ -139,11 +142,20 @@ def main(argv=None) -> int:
         print(f"  dispatches fused={jit_on['dispatches']} "
               f"unfused={jit_off['dispatches']} "
               f"compiles fused={jit_on['compiles']} "
-              f"unfused={jit_off['compiles']} parity={parity}")
+              f"unfused={jit_off['compiles']} "
+              f"prereduce_rows={jit_on.get('prereduce_rows', 0)} "
+              f"parity={parity}")
         if not parity:
             failures.append((label, "parity"))
         if jit_on["dispatches"] > jit_off["dispatches"]:
             print(f"  WARNING: fusion increased launches on {label}")
+        if (catalog, num) == ("tpch", 1) and args.scale == 0.01 \
+                and jit_on["dispatches"] >= 5:
+            # the PR 4 acceptance pin: pre-reduce must keep Q1 below
+            # PR 3's 5 dispatches at the default report scale
+            print(f"  FAIL: Q1 dispatch pin regressed "
+                  f"({jit_on['dispatches']} >= 5)")
+            failures.append((label, "q1-dispatch-pin"))
     print(f"total fused segments: {total_segments}; "
           f"failures: {failures or 'none'}")
     if args.check and (failures or total_segments == 0):
