@@ -990,6 +990,58 @@ def bench_mesh_q1q6(scale: float):
     }
 
 
+def bench_tpcds_mesh_q72q95(scale: float):
+    """TPC-DS Q72 + Q95 — the BASELINE.md multi-chip configs — through
+    the DISTRIBUTED tier: a real 2-worker cluster with HTTP exchanges,
+    parity-checked against the single-process engine on identical data
+    (ROADMAP #3: the multi-chip proof beyond TPC-H, measured)."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from tpcds_queries import QUERIES as DS
+
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+    from presto_tpu.localrunner import LocalQueryRunner
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    reg = ConnectorRegistry()
+    reg.register("tpcds", TpcdsConnector(scale=scale))
+    local = LocalQueryRunner(reg, "tpcds")
+    n_rows = local.execute(
+        "select count(*) from tpcds.catalog_sales").rows[0][0] + \
+        local.execute("select count(*) from tpcds.web_sales").rows[0][0]
+
+    def norm(rows):
+        return sorted(tuple(round(v, 4) if isinstance(v, float) else v
+                            for v in r) for r in rows)
+
+    out = {}
+    with DistributedQueryRunner.tpcds(scale=scale, n_workers=2) as dqr:
+        for qn in (72, 95):
+            t0 = time.perf_counter()
+            want = local.execute(DS[qn]).rows
+            t_local = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            got = dqr.execute(DS[qn]).rows
+            t_mesh = time.perf_counter() - t0
+            out[qn] = {"mesh_s": round(t_mesh, 3),
+                       "local_s": round(t_local, 3),
+                       "parity": norm(got) == norm(want)}
+    return {
+        "metric": f"tpcds_sf{scale:g}_q72q95_mesh_2worker_fact_rows_per_sec",
+        "value": round(n_rows / (out[72]["mesh_s"] + out[95]["mesh_s"]),
+                       1),
+        "unit": "rows/s", "vs_baseline": round(
+            (out[72]["local_s"] + out[95]["local_s"])
+            / (out[72]["mesh_s"] + out[95]["mesh_s"]), 3),
+        "engine_path": True, "distributed": True, "workers": 2,
+        "q72": out[72], "q95": out[95],
+        "parity": out[72]["parity"] and out[95]["parity"],
+    }
+
+
 def bench_sqlite_baseline(scale: float):
     """External (non-self-authored) CPU baseline: the sqlite3 engine over
     IDENTICAL generated data, per BASELINE.md's measurement note — the
@@ -1154,6 +1206,7 @@ def main() -> None:
                 (bench_q3_chunked, 0.2, 0.0),
                 (bench_engine_q1q6, 0.05, 0.0),
                 (bench_mesh_q1q6, 0.05, 0.0),
+                (bench_tpcds_mesh_q72q95, 0.003, 0.0),
                 (bench_sqlite_baseline, 0.05, 0.0)]
         _emit(_run_jobs(headline, jobs, budget_s))
         return
@@ -1173,6 +1226,7 @@ def main() -> None:
             (bench_q9, 1.0, 0.0), (bench_q17, 1.0, 0.0),
             (bench_engine_q1q6, 1.0, 0.0),
             (bench_mesh_q1q6, 0.2, 0.0),
+            (bench_tpcds_mesh_q72q95, 0.003, 0.0),
             (bench_whole_query_q3, 0.1, 0.0),
             (bench_sqlite_baseline, 0.2, 0.0),
             (bench_q3, 10.0, 0.65),

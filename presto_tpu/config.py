@@ -254,6 +254,30 @@ class EngineConfig:
     # how often the per-query monitor checks the failure detector's view
     # of the workers hosting this query's tasks
     task_recovery_interval_s: float = 0.25
+    # whole-stage retry (the Presto-on-Spark stance): when a dead worker
+    # owned a NON-leaf task, the minimal producer subtree is cancelled and
+    # re-created under fresh attempt ids instead of failing the query.
+    # This is the maximum number of re-creation rounds any single stage
+    # may consume before the query fails with the retry history attached;
+    # rounds back off on the errortracker schedule
+    # (remote_request_min/max_backoff_s).  0 = fail fast (PR 2 behavior).
+    stage_retry_limit: int = 2
+    # wall-clock bound for the cancel/DELETE fan-out at query end: each
+    # endpoint gets at most this error budget so one hung worker cannot
+    # stall cleanup (was a hardcoded ~2s)
+    cancel_fanout_budget_s: float = 2.0
+    # speculative re-execution of stragglers: a leaf task whose stage has
+    # >= speculation_quantile of its peers already finished-and-drained,
+    # and whose elapsed time exceeds speculation_lag_factor x the median
+    # finished elapsed (and speculation_min_runtime_s), gets a clone on
+    # another worker under a new attempt id; whichever attempt the
+    # consumer first drains from wins, the loser is cancelled (exactness
+    # via the attempt-aware exchange dedup).  Off by default, like the
+    # reference's speculative execution.
+    speculative_execution_enabled: bool = False
+    speculation_quantile: float = 0.5
+    speculation_lag_factor: float = 4.0
+    speculation_min_runtime_s: float = 1.0
 
 
 DEFAULT = EngineConfig()

@@ -161,6 +161,21 @@ class MeshQueryRunner:
         reg.register("tpcds", TpcdsConnector(scale=scale))
         return cls(reg, "tpch", n_devices, config)
 
+    @classmethod
+    def tpcds(cls, scale: float = 0.003, n_devices: int = 8,
+              config: EngineConfig = DEFAULT) -> "MeshQueryRunner":
+        """TPC-DS default catalog — the BASELINE.md Q72/Q95 multi-chip
+        configs on the SPMD mesh tier (shapes outside the mesh subset,
+        e.g. Q95's COUNT(DISTINCT), raise MeshUnsupported and fall back
+        to the operator tier like every other caller)."""
+        from presto_tpu.connectors.tpcds import TpcdsConnector
+        from presto_tpu.connectors.tpch import TpchConnector
+
+        reg = ConnectorRegistry()
+        reg.register("tpcds", TpcdsConnector(scale=scale))
+        reg.register("tpch", TpchConnector(scale=scale))
+        return cls(reg, "tpcds", n_devices, config)
+
     def plan_distributed(self, sql: str):
         from presto_tpu.sql.parser import parse_statement
 

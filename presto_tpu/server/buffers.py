@@ -46,6 +46,15 @@ class OutputBufferManager:
         self._bytes = 0
         self._lock = threading.Condition()
         self._failed: Optional[Exception] = None
+        # monotonic producer-progress counter (logical pages enqueued),
+        # reported in task info so the coordinator's straggler detector
+        # can rank per-stage task progress from status polls
+        self.pages_enqueued = 0
+        # partitions whose final page was served with complete=true: the
+        # consumer stops fetching at that point, so the implicit
+        # token-ack for the last page never arrives — this marker is how
+        # "the consumer got everything" is observable mid-query
+        self._served_complete: set = set()
 
     # -- producer side --------------------------------------------------
     def enqueue(self, partition: int, page: bytes) -> None:
@@ -64,6 +73,7 @@ class OutputBufferManager:
             else:
                 self.buffers[partition].pages.append(page)
                 self._bytes += len(page)
+            self.pages_enqueued += 1
             self._lock.notify_all()
 
     def set_no_more_pages(self) -> None:
@@ -79,6 +89,18 @@ class OutputBufferManager:
             if self._failed is not None:
                 return True
             return all(not buf.pages for buf in self.buffers.values())
+
+    def is_fully_served(self) -> bool:
+        """True when every partition's stream was served to its end
+        (complete=true went out) or the buffer can serve nothing more —
+        the consumer-side notion of 'done' the straggler detector ranks
+        tasks by (is_drained alone misses the never-acked final page)."""
+        with self._lock:
+            if self._failed is not None:
+                return True
+            return all(buf.no_more_pages and
+                       (i in self._served_complete or not buf.pages)
+                       for i, buf in self.buffers.items())
 
     def fail(self, error: Exception) -> None:
         with self._lock:
@@ -128,6 +150,8 @@ class OutputBufferManager:
                 complete = (buf.no_more_pages
                             and token + len(out) >= buf.end_token)
                 if out or complete or wait_s <= 0:
+                    if complete:
+                        self._served_complete.add(partition)
                     return out, token + len(out), complete
                 if deadline is None:
                     deadline = time.monotonic() + wait_s

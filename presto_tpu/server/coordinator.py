@@ -37,7 +37,9 @@ from presto_tpu import types as T
 from presto_tpu.config import DEFAULT, EngineConfig
 from presto_tpu.connectors.api import ConnectorRegistry
 from presto_tpu.serde import deserialize_batch, frame_size
-from presto_tpu.server.errortracker import RemoteRequestError
+from presto_tpu.server.errortracker import (
+    RemoteRequestError, RequestErrorTracker,
+)
 from presto_tpu.server.fragmenter import DistributedPlan, Fragmenter
 from presto_tpu.sql import tree as t
 from presto_tpu.sql.optimizer import optimize
@@ -144,6 +146,12 @@ class NodeManager:
         self._stop.set()
 
 
+class _DrainRestart(Exception):
+    """Internal drain control flow: a whole-stage restart superseded the
+    location being pulled; abandon the in-flight request and re-enter
+    the drain loop (which consumes the restart marker)."""
+
+
 class QueryExecution:
     """One query's lifecycle (QueryStateMachine + SqlQueryExecution)."""
 
@@ -183,6 +191,26 @@ class QueryExecution:
         self._recovered_uris: set = set()        # workers already handled
         self._recovery_lock = threading.Lock()
         self._monitor_stop = threading.Event()
+        # -- whole-stage retry / speculation state ------------------------
+        # fid -> current attempt task ids by task index
+        self._frag_tasks: Dict[int, List[str]] = {}
+        # fid -> result-uri templates ('{part}' placeholder) by index;
+        # the lists are SHARED with the remote-source dicts recorded in
+        # _task_specs, so in-place updates keep every recreate recipe
+        # pointing at the live attempts
+        self._task_uris: Dict[int, List[str]] = {}
+        self._attempts: Dict[str, int] = {}      # base task id -> attempt
+        self._stage_retries: Dict[int, int] = {} # fid -> rounds consumed
+        self.stage_retry_rounds = 0              # observability (tests)
+        self.recovery_rounds = 0
+        # root-drain whole-stage restarts: original location -> restarted
+        # location; the drain DISCARDS that location's rows and re-pulls
+        # from token 0 (unlike _relocations, which only follow at token 0)
+        self._restarts: Dict[str, str] = {}
+        self._root_orig: Dict[str, str] = {}     # orig loc -> current loc
+        # straggler tid -> {'fid','clone','clone_uri','orig_uri','state'}
+        self._speculations: Dict[str, Dict] = {}
+        self._task_seen: Dict[str, Dict] = {}    # tid -> progress polls
         self.column_names: List[str] = []
         self.column_types: List[T.Type] = []
         self.result_rows: List[tuple] = []
@@ -402,18 +430,21 @@ class QueryExecution:
 
     def _cancel_worker_tasks(self) -> None:
         """DELETE fan-out over every responsive node.  Best-effort, but
-        no longer silent: per-endpoint failures are logged, and retries
-        are bounded by a small error budget so one hung worker cannot
-        stall the fan-out for the full transport budget."""
+        no longer silent: per-endpoint failures are logged through the
+        error tracker, and retries are bounded by the
+        ``cancel_fanout_budget_s`` error budget (config/session knob) so
+        one hung worker cannot stall the fan-out for the full transport
+        budget."""
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        budget = min(cfg.cancel_fanout_budget_s,
+                     cfg.remote_request_max_error_duration_s)
         for _nid, uri in self.co.nodes.responsive_nodes():
             try:
                 self.co.http.request(
                     f"{uri}/v1/query/{self.query_id}", method="DELETE",
                     headers=self._internal_headers(), timeout=5,
                     description="cancel fan-out",
-                    max_error_duration_s=min(
-                        2.0,
-                        self.co.config.remote_request_max_error_duration_s))
+                    max_error_duration_s=budget)
             except Exception as e:  # noqa: BLE001 - best-effort cleanup
                 self.co.log(f"cancel fan-out for {self.query_id} to "
                             f"{uri} failed: {e}")
@@ -502,57 +533,94 @@ class QueryExecution:
                     f"{wuri}/v1/task/{task_id}/results/{{part}}")
                 self._placements.append(
                     (frag.fragment_id, task_id, wuri))
-                # the recreate recipe for mid-query recovery (leaf
-                # fragments only ever need it, but recording all is
-                # cheap and keeps the monitor simple)
+                # the recreate recipe for mid-query recovery — leaf
+                # reschedule, whole-stage retry, and speculation all
+                # re-create from this
                 self._task_specs[task_id] = {
                     "frag": frag, "scan_shard": (i, n_tasks),
                     "remote": remote, "n_out": n_out,
-                    "broadcast": broadcast, "consumer_index": i}
+                    "broadcast": broadcast, "consumer_index": i,
+                    "base": task_id, "index": i,
+                    "created_at": time.monotonic()}
+                self._attempts[task_id] = 0
             task_uris[frag.fragment_id] = uris
+            self._frag_tasks[frag.fragment_id] = [
+                t for f, t, _ in self._placements
+                if f == frag.fragment_id]
+            self._task_uris[frag.fragment_id] = uris
+        roots = [u.format(part=0)
+                 for u in task_uris[dplan.root_fragment_id]]
+        self._root_orig = {loc: loc for loc in roots}
         self._start_recovery_monitor()
-        return [u.format(part=0)
-                for u in task_uris[dplan.root_fragment_id]]
+        return roots
 
     # -- mid-query task recovery ----------------------------------------
     def _start_recovery_monitor(self) -> None:
         """Watch the failure detector for workers hosting this query's
-        tasks; reschedule leaf tasks of a dead worker onto a survivor
-        (the one recovery shape that is always safe: no remote sources,
-        deterministic scan shard) and repoint consumers."""
+        tasks, and per-stage task progress for stragglers.  A dead
+        worker's leaf tasks are rescheduled in place; its non-leaf tasks
+        trigger whole-stage retry (the producer subtree is re-created
+        under fresh attempt ids); stragglers get speculative clones."""
         cfg = getattr(self, "_cfg", None) or self.co.config
-        if not cfg.task_recovery_enabled:
+        if not (cfg.task_recovery_enabled
+                or cfg.speculative_execution_enabled):
             return
         threading.Thread(
-            target=self._recovery_loop,
+            target=self._monitor_loop,
             args=(max(cfg.task_recovery_interval_s, 0.05),),
             daemon=True, name=f"recovery-{self.query_id}").start()
 
-    def _recovery_loop(self, interval_s: float) -> None:
+    def _monitor_loop(self, interval_s: float) -> None:
+        cfg = getattr(self, "_cfg", None) or self.co.config
         while not self._monitor_stop.wait(interval_s):
             if self.state not in ("SCHEDULING", "RUNNING"):
                 return
-            dead = self.co.nodes.dead_uris()
-            with self._recovery_lock:
-                targets = sorted(
-                    {uri for _, _, uri in self._placements
-                     if uri in dead and uri not in self._recovered_uris})
-            for uri in targets:
-                try:
-                    self._recover_worker(uri)
-                except Exception as e:  # noqa: BLE001 - fail fast
-                    self.error = self.error or f"{e}"
-                    self.co.log(f"task recovery for {self.query_id} "
-                                f"failed: {e}")
-                    self.cancel()   # unblocks the drain
-                    return
+            try:
+                if cfg.task_recovery_enabled:
+                    self._recovery_tick()
+                if cfg.speculative_execution_enabled:
+                    self._speculation_tick()
+            except Exception as e:  # noqa: BLE001 - fail fast
+                self.error = self.error or f"{e}"
+                self.co.log(f"task recovery for {self.query_id} "
+                            f"failed: {e}")
+                self.cancel()   # unblocks the drain
+                return
+
+    def _probe_alive(self, uri: str) -> bool:
+        """One direct health probe, outside the failure detector."""
+        try:
+            with urllib.request.urlopen(f"{uri}/v1/info",
+                                        timeout=1.5) as resp:
+                return resp.status == 200
+        except Exception:  # noqa: BLE001 - probe is the question
+            return False
+
+    def _recovery_tick(self) -> None:
+        dead = self.co.nodes.dead_uris()
+        with self._recovery_lock:
+            targets = sorted(
+                {uri for _, _, uri in self._placements
+                 if uri in dead and uri not in self._recovered_uris})
+        for uri in targets:
+            # flap guard: heartbeats blip on an overloaded host without
+            # the worker being gone.  Recovery cancels and re-creates
+            # whole subtrees, so it only starts once a direct probe
+            # confirms the node is really unreachable; a worker whose
+            # heartbeat resumes leaves dead_uris() on the next beat and
+            # is never recovered at all.
+            if self._probe_alive(uri):
+                continue
+            self._recover_worker(uri)
 
     def _recover_worker(self, dead_uri: str) -> None:
-        """Reschedule every task this query had on ``dead_uri``.  Only
-        leaf fragments (no remote sources) are recoverable — their
-        replacement regenerates the same deterministic output from its
-        scan shard; anything downstream fails fast with the task id and
-        endpoint attached."""
+        """Reschedule every task this query had on ``dead_uri``.
+
+        Leaf fragments (no remote sources) whose consumers have not yet
+        consumed their pages are re-created in place: the replacement
+        regenerates the same deterministic output from its scan shard.
+        Everything else — non-leaf tasks, and leaf tasks whose consumers
+        already consumed pages — goes through whole-stage retry."""
         with self._recovery_lock:
             if dead_uri in self._recovered_uris:
                 return
@@ -561,13 +629,28 @@ class QueryExecution:
                         if uri == dead_uri]
         if not affected or self._dplan is None:
             return
+        self.recovery_rounds += 1
         frag_by_id = {f.fragment_id: f for f in self._dplan.fragments}
-        for fid, tid in affected:
+        retry_fids = sorted({fid for fid, _ in affected
+                             if frag_by_id[fid].consumed_fragments})
+        # root-fragment leaves also go through stage retry: the drain can
+        # discard and re-pull a restarted location from token 0, which
+        # the token-0-only relocation path cannot once pages flowed
+        for fid, _tid in affected:
             if frag_by_id[fid].consumed_fragments:
-                raise RuntimeError(
-                    f"Worker died mid-query and task {tid} "
-                    f"({dead_uri}/v1/task/{tid}) consumes remote "
-                    f"sources: stage {fid} is not reschedulable")
+                continue
+            if self._consumers.get(fid) is None:
+                retry_fids.append(fid)
+        restarted: set = set()
+        if retry_fids:
+            restarted = self._retry_stages(set(retry_fids), dead_uri)
+        leaf = [(fid, tid) for fid, tid in affected
+                if not frag_by_id[fid].consumed_fragments
+                and fid not in restarted]
+        if leaf:
+            self._reschedule_leaf_tasks(leaf, dead_uri)
+
+    def _reschedule_leaf_tasks(self, affected, dead_uri: str) -> None:
         dead = self.co.nodes.dead_uris() | {dead_uri}
         survivors = [uri for _, uri in self.co.nodes.alive_nodes()
                      if uri not in dead]
@@ -582,12 +665,14 @@ class QueryExecution:
                 new_uri, tid, spec["frag"], spec["scan_shard"],
                 spec["remote"], spec["n_out"], spec["broadcast"],
                 consumer_index=spec["consumer_index"])
+            old_prefix = f"{dead_uri}/v1/task/{tid}/results/"
+            new_prefix = f"{new_uri}/v1/task/{tid}/results/"
             with self._recovery_lock:
                 self._placements = [
                     (f, t, new_uri if t == tid else u)
                     for f, t, u in self._placements]
-            old_prefix = f"{dead_uri}/v1/task/{tid}/results/"
-            new_prefix = f"{new_uri}/v1/task/{tid}/results/"
+                self._task_uris[fid][spec["index"]] = \
+                    new_prefix + "{part}"
             self.co.log(f"recovery: rescheduled {tid} from {dead_uri} "
                         f"to {new_uri}")
             self._repoint_consumers(fid, tid, dead_uri,
@@ -598,7 +683,11 @@ class QueryExecution:
         cons_fid = self._consumers.get(fid)
         if cons_fid is None:
             # root fragment: the coordinator's own drain is the consumer
-            self._relocations[old_prefix + "0"] = new_prefix + "0"
+            with self._recovery_lock:
+                self._relocations[old_prefix + "0"] = new_prefix + "0"
+                for orig, cur in self._root_orig.items():
+                    if cur == old_prefix + "0":
+                        self._root_orig[orig] = new_prefix + "0"
             return
         headers = {"Content-Type": "application/json"}
         headers.update(self._internal_headers())
@@ -614,10 +703,432 @@ class QueryExecution:
                 description="remote-source repoint")
             status = resp.json().get("status")
             if status == "delivered":
-                raise RuntimeError(
-                    f"Task {tid} on dead worker {dead_uri} already "
-                    f"delivered pages to consumer {ctid}: not "
-                    f"recoverable without restarting the query")
+                # the consumer already consumed the dead producer's
+                # pages: an in-place replacement would double-count, so
+                # restart the consumer stage (whole-stage retry) — its
+                # new attempt re-pulls every producer from token 0
+                self.co.log(
+                    f"recovery: consumer {ctid} already consumed pages "
+                    f"from {tid}; escalating stage {cons_fid} to "
+                    f"whole-stage retry")
+                self._retry_stages({cons_fid}, dead_uri)
+                return
+
+    # -- whole-stage retry (Presto-on-Spark stance) ---------------------
+    def _retry_stages(self, frags0: set, dead_uri: str) -> set:
+        """Cancel and re-create the minimal producer subtree of the lost
+        stage(s) under fresh attempt ids, repoint consumers, and escalate
+        (restart the consumer too) wherever a consumer already consumed
+        superseded pages — the attempt-aware dedup in the exchange layer
+        guarantees every consumed stream comes wholly from one attempt,
+        so nothing double-counts.  Returns the re-created fragment set.
+        Bounded by ``stage_retry_limit`` per stage, with the
+        deterministic errortracker backoff schedule between rounds."""
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        dplan = self._dplan
+        frag_by_id = {f.fragment_id: f for f in dplan.fragments}
+        if cfg.stage_retry_limit <= 0:
+            tids = [tid for fid, tid, _ in self._placements
+                    if fid in frags0]
+            raise RuntimeError(
+                f"Worker {dead_uri} died mid-query owning task(s) "
+                f"{tids} of non-leaf stage(s) {sorted(frags0)} and "
+                f"stage_retry_limit=0: whole-stage retry disabled, "
+                f"query is not recoverable")
+        S: set = set()
+        for f in frags0:
+            S.add(f)
+            S.update(frag_by_id[f].producer_subtree)
+        self.stage_retry_rounds += 1
+
+        def charge(fids) -> int:
+            worst = 0
+            for f in sorted(fids):
+                n = self._stage_retries.get(f, 0) + 1
+                if n > cfg.stage_retry_limit:
+                    raise RuntimeError(
+                        f"stage {f} of query {self.query_id} exhausted "
+                        f"stage_retry_limit={cfg.stage_retry_limit} "
+                        f"after {n - 1} whole-stage retr"
+                        f"{'y' if n - 1 == 1 else 'ies'}; last trigger: "
+                        f"worker {dead_uri} lost stage(s) "
+                        f"{sorted(frags0)}")
+                self._stage_retries[f] = n
+                worst = max(worst, n)
+            return worst
+
+        round_n = charge(S)
+        # deterministic backoff between retry rounds — the errortracker
+        # schedule (min * 2^(n-1), capped), same knobs as transport
+        backoff = RequestErrorTracker(
+            f"stage-retry:{self.query_id}", description="stage retry",
+            min_backoff_s=cfg.remote_request_min_backoff_s,
+            max_backoff_s=cfg.remote_request_max_backoff_s)
+        backoff.error_count = round_n - 1
+        if backoff.backoff_delay() > 0:
+            time.sleep(backoff.backoff_delay())
+        superseded: List[Tuple[str, str]] = []
+        for _ in range(len(dplan.fragments) + 1):
+            moves = self._recreate_fragments(S, dead_uri, superseded)
+            esc = self._repoint_after_retry(S, moves, dead_uri)
+            if not esc:
+                break
+            grown = set()
+            for c in esc:
+                for f in (c,) + frag_by_id[c].producer_subtree:
+                    if f not in S:
+                        grown.add(f)
+            charge(grown)
+            S.update(grown)
+            # escalated consumers force yet another attempt of their
+            # whole producer subtrees: the attempts just created may
+            # already be partially acked by the consumers' old tasks
+            S.update(esc)
+        self._cancel_tasks(superseded)
+        self.co.log(f"stage retry: re-created stages {sorted(S)} "
+                    f"(round {round_n}) after losing {dead_uri}")
+        return S
+
+    def _recreate_fragments(self, S: set, dead_uri: str,
+                            superseded) -> Dict[int, List[Tuple[str,
+                                                                str]]]:
+        """Create fresh attempts (new task ids, fresh output buffers)
+        for every task of every fragment in ``S``, bottom-up.  Returns
+        per-fragment (old_prefix, new_prefix) result-location moves."""
+        dead = self.co.nodes.dead_uris() | {dead_uri}
+        workers = [uri for _, uri in self.co.nodes.topology_ordered(
+            self.co.nodes.alive_nodes()) if uri not in dead]
+        if not workers:
+            raise RuntimeError(
+                f"Worker {dead_uri} died mid-query and no surviving "
+                f"worker remains for whole-stage retry")
+        moves: Dict[int, List[Tuple[str, str]]] = {}
+        for frag in self._dplan.fragments:   # topological: producers 1st
+            fid = frag.fragment_id
+            if fid not in S:
+                continue
+            self._drop_speculations(fid)
+            frag_moves: List[Tuple[str, str]] = []
+            tids = self._frag_tasks[fid]
+            for i, old_tid in enumerate(list(tids)):
+                spec = self._task_specs[old_tid]
+                base = spec["base"]
+                attempt = self._attempts.get(base, 0) + 1
+                new_tid = f"{base}a{attempt}"
+                with self._recovery_lock:
+                    old_uri = next(u for _f, t, u in self._placements
+                                   if t == old_tid)
+                # producers of this fragment re-created earlier in this
+                # topological pass are already current in _task_uris
+                remote = {pfid: list(self._task_uris[pfid])
+                          for pfid in spec["remote"]}
+                last_error = None
+                new_host = None
+                for shift in range(len(workers)):
+                    w = workers[(i + attempt + shift) % len(workers)]
+                    try:
+                        self._create_remote_task(
+                            w, new_tid, spec["frag"], spec["scan_shard"],
+                            remote, spec["n_out"], spec["broadcast"],
+                            consumer_index=spec["consumer_index"])
+                        new_host = w
+                        break
+                    except RemoteRequestError as e:
+                        if e.retryable:
+                            last_error = e
+                            continue
+                        raise
+                if new_host is None:
+                    raise RuntimeError(
+                        f"no worker accepted stage-retry task "
+                        f"{new_tid}: {last_error}")
+                new_spec = dict(spec)
+                new_spec["remote"] = remote
+                new_spec["created_at"] = time.monotonic()
+                self._task_specs[new_tid] = new_spec
+                self._attempts[base] = attempt
+                old_prefix = f"{old_uri}/v1/task/{old_tid}/results/"
+                new_prefix = f"{new_host}/v1/task/{new_tid}/results/"
+                frag_moves.append((old_prefix, new_prefix))
+                with self._recovery_lock:
+                    self._placements = [
+                        (f, new_tid if t == old_tid else t,
+                         new_host if t == old_tid else u)
+                        for f, t, u in self._placements]
+                    tids[i] = new_tid
+                    self._task_uris[fid][i] = new_prefix + "{part}"
+                superseded.append((old_tid, old_uri))
+            moves[fid] = frag_moves
+        return moves
+
+    def _repoint_after_retry(self, S: set, moves, dead_uri: str) -> set:
+        """Point every consumer OUTSIDE the restart set at the fresh
+        attempts.  Returns consumer fragment ids that must escalate into
+        the restart set ('delivered': they already consumed superseded
+        pages, or they are unreachable)."""
+        esc: set = set()
+        headers = {"Content-Type": "application/json"}
+        headers.update(self._internal_headers())
+        for fid in sorted(S):
+            cons_fid = self._consumers.get(fid)
+            if cons_fid is None:
+                # root stage restarted: the coordinator drain discards
+                # that location's rows and re-pulls the new attempt
+                with self._recovery_lock:
+                    for old_p, new_p in moves[fid]:
+                        old_loc, new_loc = old_p + "0", new_p + "0"
+                        for orig, cur in self._root_orig.items():
+                            if cur == old_loc:
+                                self._root_orig[orig] = new_loc
+                                self._restarts[orig] = new_loc
+                continue
+            if cons_fid in S or cons_fid in esc:
+                continue   # restarted itself; its create saw fresh uris
+            with self._recovery_lock:
+                ctasks = [(t, u) for f, t, u in self._placements
+                          if f == cons_fid]
+            for ctid, curi in ctasks:
+                for old_p, new_p in moves[fid]:
+                    body = json.dumps({"old_prefix": old_p,
+                                       "new_prefix": new_p}).encode()
+                    try:
+                        resp = self.co.http.request(
+                            f"{curi}/v1/task/{ctid}/remote-sources",
+                            method="POST", data=body, headers=headers,
+                            timeout=10, task_id=ctid,
+                            description="remote-source repoint",
+                            max_error_duration_s=min(
+                                5.0,
+                                (getattr(self, "_cfg", None)
+                                 or self.co.config)
+                                .remote_request_max_error_duration_s))
+                        status = resp.json().get("status")
+                    except Exception as e:  # noqa: BLE001 - escalate
+                        self.co.log(f"stage retry: repoint of {ctid} on "
+                                    f"{curi} failed ({e}); restarting "
+                                    f"consumer stage {cons_fid}")
+                        status = "delivered"
+                    if status == "delivered":
+                        esc.add(cons_fid)
+                        break
+                if cons_fid in esc:
+                    break
+        return esc
+
+    def _cancel_tasks(self, pairs) -> None:
+        """Best-effort DELETE of superseded/losing task attempts."""
+        for tid, uri in pairs:
+            try:
+                self.co.http.request(
+                    f"{uri}/v1/task/{tid}", method="DELETE",
+                    headers=self._internal_headers(), timeout=5,
+                    description="superseded-task cancel",
+                    max_error_duration_s=0.0)
+            except Exception as e:  # noqa: BLE001 - best effort
+                self.co.log(f"cancel of superseded task {tid} on "
+                            f"{uri} failed: {e}")
+
+    # -- speculative re-execution of stragglers -------------------------
+    def _poll_task(self, tid: str, uri: str) -> Optional[Dict]:
+        try:
+            resp = self.co.http.request(
+                f"{uri}/v1/task/{tid}",
+                headers=self._internal_headers(), timeout=5,
+                task_id=tid, description="progress poll",
+                max_error_duration_s=0.0)
+            return resp.json()
+        except Exception:  # noqa: BLE001 - progress polls are advisory
+            return None
+
+    def _speculation_tick(self) -> None:
+        """Track per-stage task progress from status polls; clone a
+        straggler onto another worker; the attempt the consumer drains
+        first wins (the exchange's attempt-aware dedup arbitrates), the
+        loser is cancelled."""
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        if self._dplan is None:
+            return
+        now = time.monotonic()
+        frag_by_id = {f.fragment_id: f for f in self._dplan.fragments}
+        with self._recovery_lock:
+            placements = list(self._placements)
+        for fid, tid, uri in placements:
+            seen = self._task_seen.setdefault(tid, {"done_at": None})
+            if seen["done_at"] is not None:
+                continue
+            info = self._poll_task(tid, uri)
+            if info is None:
+                continue
+            seen["state"] = info.get("state")
+            seen["pages"] = info.get("pagesEnqueued", 0)
+            if info.get("state") == "FINISHED" and info.get("drained"):
+                seen["done_at"] = now
+        self._resolve_speculations()
+        by_stage: Dict[int, List[Tuple[str, str]]] = {}
+        for fid, tid, uri in placements:
+            by_stage.setdefault(fid, []).append((tid, uri))
+        for fid, tasks in by_stage.items():
+            frag = frag_by_id[fid]
+            if frag.consumed_fragments:
+                # only leaf tasks speculate: a clone re-derives its whole
+                # output from the deterministic scan shard, while a
+                # non-leaf clone would race the original for the same
+                # producer buffer tokens
+                continue
+            if fid == self._dplan.root_fragment_id or len(tasks) < 2:
+                continue
+            done_elapsed = []
+            for tid, _u in tasks:
+                seen = self._task_seen.get(tid) or {}
+                if seen.get("done_at") is None:
+                    continue
+                created = self._task_specs[tid].get(
+                    "created_at", seen["done_at"])
+                done_elapsed.append(max(seen["done_at"] - created, 1e-3))
+            need = max(1, int(round(cfg.speculation_quantile
+                                    * len(tasks))))
+            if len(done_elapsed) < need:
+                continue
+            done_elapsed.sort()
+            median = done_elapsed[len(done_elapsed) // 2]
+            for tid, uri in tasks:
+                seen = self._task_seen.get(tid) or {}
+                if seen.get("done_at") is not None \
+                        or tid in self._speculations:
+                    continue
+                created = self._task_specs[tid].get("created_at")
+                if created is None:
+                    continue
+                lag = now - created
+                if lag < max(cfg.speculation_min_runtime_s,
+                             cfg.speculation_lag_factor * median):
+                    continue
+                self._spawn_clone(fid, tid, uri)
+
+    def _spawn_clone(self, fid: int, tid: str, uri: str) -> None:
+        spec = self._task_specs[tid]
+        base = spec["base"]
+        attempt = self._attempts.get(base, 0) + 1
+        clone_tid = f"{base}a{attempt}"
+        dead = self.co.nodes.dead_uris()
+        workers = [u for _, u in self.co.nodes.topology_ordered(
+            self.co.nodes.alive_nodes())
+            if u not in dead and u != uri]
+        if not workers:   # nowhere else to run: keep waiting
+            return
+        w = workers[spec["index"] % len(workers)]
+        remote = {pfid: list(self._task_uris[pfid])
+                  for pfid in spec["remote"]}
+        try:
+            self._create_remote_task(
+                w, clone_tid, spec["frag"], spec["scan_shard"], remote,
+                spec["n_out"], spec["broadcast"],
+                consumer_index=spec["consumer_index"])
+        except Exception as e:  # noqa: BLE001 - speculation is optional
+            self.co.log(f"speculation: clone create for {tid} "
+                        f"failed: {e}")
+            return
+        self._attempts[base] = attempt
+        new_spec = dict(spec)
+        new_spec["remote"] = remote
+        new_spec["created_at"] = time.monotonic()
+        self._task_specs[clone_tid] = new_spec
+        self._speculations[tid] = {
+            "fid": fid, "clone": clone_tid, "clone_uri": w,
+            "orig_uri": uri, "state": "racing"}
+        self.co.log(f"speculation: straggler {tid} cloned as "
+                    f"{clone_tid} on {w}")
+
+    def _resolve_speculations(self) -> None:
+        """First-finisher-wins: when the clone finishes, repoint each
+        consumer that has not yet consumed original pages; consumers
+        that already did keep the original (attempt-aware dedup — a
+        partition never mixes attempts).  The fully-unused attempt is
+        cancelled."""
+        for orig_tid, sp in list(self._speculations.items()):
+            if sp["state"] != "racing":
+                continue
+            if (self._task_seen.get(orig_tid) or {}).get("done_at") \
+                    is not None:
+                # original finished AND was drained first: clone lost
+                sp["state"] = "lost"
+                self._cancel_tasks([(sp["clone"], sp["clone_uri"])])
+                self.co.log(f"speculation: original {orig_tid} won; "
+                            f"cancelled clone {sp['clone']}")
+                continue
+            info = self._poll_task(sp["clone"], sp["clone_uri"])
+            if info is None:
+                continue
+            if info.get("state") == "FAILED":
+                sp["state"] = "lost"
+                continue
+            if info.get("state") != "FINISHED":
+                continue
+            self._finish_speculation(orig_tid, sp)
+
+    def _finish_speculation(self, orig_tid: str, sp: Dict) -> None:
+        spec = self._task_specs[orig_tid]
+        fid = sp["fid"]
+        cons_fid = self._consumers.get(fid)
+        old_prefix = f"{sp['orig_uri']}/v1/task/{orig_tid}/results/"
+        new_prefix = f"{sp['clone_uri']}/v1/task/{sp['clone']}/results/"
+        headers = {"Content-Type": "application/json"}
+        headers.update(self._internal_headers())
+        body = json.dumps({"old_prefix": old_prefix,
+                           "new_prefix": new_prefix}).encode()
+        with self._recovery_lock:
+            ctasks = [(t, u) for f, t, u in self._placements
+                      if f == cons_fid]
+        delivered = 0
+        repointed = 0
+        for ctid, curi in ctasks:
+            try:
+                resp = self.co.http.request(
+                    f"{curi}/v1/task/{ctid}/remote-sources",
+                    method="POST", data=body, headers=headers,
+                    timeout=10, task_id=ctid,
+                    description="speculation repoint",
+                    max_error_duration_s=0.0)
+                status = resp.json().get("status")
+            except Exception:  # noqa: BLE001 - keep the original
+                status = "delivered"
+            if status == "delivered":
+                delivered += 1
+            elif status == "repointed":
+                repointed += 1
+        if delivered == 0 and repointed > 0:
+            sp["state"] = "won"
+            with self._recovery_lock:
+                self._placements = [
+                    (f, sp["clone"] if t == orig_tid else t,
+                     sp["clone_uri"] if t == orig_tid else u)
+                    for f, t, u in self._placements]
+                self._frag_tasks[fid][spec["index"]] = sp["clone"]
+                self._task_uris[fid][spec["index"]] = \
+                    new_prefix + "{part}"
+            self._cancel_tasks([(orig_tid, sp["orig_uri"])])
+            self.co.log(f"speculation: clone {sp['clone']} won over "
+                        f"straggler {orig_tid}")
+        elif repointed == 0:
+            sp["state"] = "lost"
+            self._cancel_tasks([(sp["clone"], sp["clone_uri"])])
+            self.co.log(f"speculation: clone {sp['clone']} lost "
+                        f"(original pages already consumed)")
+        else:
+            # split decision: some consumers drained the original first,
+            # others switched — each partition sticks with exactly one
+            # attempt (exact either way); both attempts stay alive until
+            # the end-of-query cancel fan-out
+            sp["state"] = "split"
+            self.co.log(f"speculation: {orig_tid} split across attempts "
+                        f"({repointed} repointed, {delivered} kept)")
+
+    def _drop_speculations(self, fid: int) -> None:
+        """Whole-stage retry supersedes any in-flight clone race."""
+        for tid, sp in list(self._speculations.items()):
+            if sp.get("fid") == fid and sp.get("state") == "racing":
+                sp["state"] = "lost"
+                self._cancel_tasks([(sp["clone"], sp["clone_uri"])])
 
     def _create_remote_task(self, worker_uri: str, task_id: str, frag,
                             scan_shard, remote, n_out, broadcast,
@@ -841,56 +1352,106 @@ class QueryExecution:
         self._cancel_worker_tasks()
 
     def _drain(self, locations: List[str]) -> None:
-        """Pull the root stage's pages.  Transport errors retry through
-        the error tracker (the token only advances on success, so a
-        retried GET re-fetches unacked pages); if the root producer was
-        rescheduled by task recovery, the drain follows the relocation —
-        but only from token 0, since a replacement regenerates its
-        stream from scratch."""
+        """Pull the root stage's pages, one location at a time.
+
+        Transport errors retry through the error tracker (the token only
+        advances on success, so a retried GET re-fetches unacked pages).
+        Two recovery shapes reach the drain:
+
+        - ``_relocations`` (leaf task recovery): follow the replacement,
+          but only from token 0 — a same-task replacement regenerates
+          its stream from scratch;
+        - ``_restarts`` (whole-stage retry of the root stage): DISCARD
+          the rows collected from that location and re-pull the fresh
+          attempt from token 0 — the coordinator is the consumer, so it
+          applies the attempt-aware dedup itself (a location's rows come
+          wholly from one attempt).  Restarts posted after a location
+          completed re-queue it."""
         cfg = getattr(self, "_cfg", None) or self.co.config
         deadline = (time.monotonic() + cfg.query_max_run_time_s
                     if cfg.query_max_run_time_s > 0 else None)
-        for orig_loc in locations:
-            loc = orig_loc
-            token = 0
-            while True:
+        rows_by_loc: Dict[str, List[tuple]] = {}
+        pending = list(locations)
+        done: set = set()
+        while pending:
+            orig = pending.pop(0)
+            rows_by_loc[orig] = self._drain_location(orig, deadline, cfg)
+            done.add(orig)
+            with self._recovery_lock:
+                redo = [o for o in self._restarts if o in done]
+            for o in redo:
+                done.discard(o)
+                if o not in pending:
+                    pending.append(o)
+        for orig in locations:
+            self.result_rows.extend(rows_by_loc[orig])
+
+    def _drain_location(self, orig: str, deadline, cfg) -> List[tuple]:
+        loc = orig
+        token = 0
+        rows: List[tuple] = []
+        while True:
+            if getattr(self, "canceled", False):
+                raise RuntimeError("Query killed")
+            if deadline is not None and time.monotonic() > deadline:
+                raise RuntimeError(
+                    "Query exceeded maximum run time "
+                    f"({cfg.query_max_run_time_s:g}s)")
+            with self._recovery_lock:
+                moved = self._restarts.pop(orig, None)
+            if moved is not None:
+                # whole-stage retry re-created the root producer: this
+                # location restarts from scratch on the fresh attempt
+                loc, token = moved, 0
+                rows = []
+
+            def _on_retry(exc, _loc=loc, _token=token, _orig=orig):
                 if getattr(self, "canceled", False):
                     raise RuntimeError("Query killed")
-                if deadline is not None and time.monotonic() > deadline:
+                with self._recovery_lock:
+                    if _orig in self._restarts:
+                        raise _DrainRestart() from exc
+                moved2 = self._relocations.get(_loc)
+                if moved2 is None:
+                    return None
+                if _token != 0:
                     raise RuntimeError(
-                        "Query exceeded maximum run time "
-                        f"({cfg.query_max_run_time_s:g}s)")
-
-                def _on_retry(exc, _loc=loc, _token=token):
-                    if getattr(self, "canceled", False):
-                        raise RuntimeError("Query killed")
-                    moved = self._relocations.get(_loc)
-                    if moved is None:
-                        return None
-                    if _token != 0:
-                        raise RuntimeError(
-                            f"root task output at {_loc} lost mid-drain "
-                            f"after {_token} page(s); replacement at "
-                            f"{moved} cannot resume") from exc
-                    return f"{moved}/{_token}"
+                        f"root task output at {_loc} lost mid-drain "
+                        f"after {_token} page(s); replacement at "
+                        f"{moved2} cannot resume") from exc
+                return f"{moved2}/{_token}"
+            try:
                 resp = self.co.http.request(
                     f"{loc}/{token}", headers=self._internal_headers(),
                     timeout=120, description="result drain",
                     endpoint=loc, retry_cb=_on_retry)
-                loc = self._relocations.get(orig_loc, loc)
-                complete = resp.headers.get(
-                    "X-Presto-Buffer-Complete") == "true"
-                token = int(resp.headers.get("X-Presto-Next-Token",
-                                             token))
-                body = resp.body
-                off = 0
-                while off < len(body):
-                    size = frame_size(body, off)
-                    batch = deserialize_batch(body[off:off + size])
-                    self.result_rows.extend(batch.to_pylist())
-                    off += size
-                if complete:
-                    break
+            except _DrainRestart:
+                continue
+            except RemoteRequestError:
+                # a fatal answer (e.g. 500 from a just-superseded
+                # attempt) with a restart pending is part of the retry
+                # choreography, not a query failure
+                with self._recovery_lock:
+                    pending_restart = orig in self._restarts
+                if pending_restart:
+                    continue
+                raise
+            loc = self._relocations.get(orig, loc)
+            complete = resp.headers.get(
+                "X-Presto-Buffer-Complete") == "true"
+            token = int(resp.headers.get("X-Presto-Next-Token", token))
+            body = resp.body
+            off = 0
+            while off < len(body):
+                size = frame_size(body, off)
+                batch = deserialize_batch(body[off:off + size])
+                rows.extend(batch.to_pylist())
+                off += size
+            if complete:
+                with self._recovery_lock:
+                    if orig in self._restarts:
+                        continue   # restarted right at the finish line
+                return rows
 
     # -- client protocol ------------------------------------------------
     def results_payload(self, base_uri: str) -> Dict:

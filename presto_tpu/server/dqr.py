@@ -146,6 +146,29 @@ class DistributedQueryRunner:
 
         return cls(factory, "tpch", n_workers, config, **kwargs)
 
+    @classmethod
+    def tpcds(cls, scale: float = 0.003, n_workers: int = 2,
+              config: EngineConfig = DEFAULT,
+              **kwargs) -> "DistributedQueryRunner":
+        """TPC-DS on the HTTP mesh — the BASELINE.md multi-chip configs
+        (Q72/Q95) run through real coordinator + workers + exchanges;
+        the chaos tier drives this cluster under the fault injector."""
+        from presto_tpu.connectors.memory import MemoryConnector
+
+        shared_memory = MemoryConnector()
+
+        def factory() -> ConnectorRegistry:
+            from presto_tpu.connectors.tpcds import TpcdsConnector
+            from presto_tpu.connectors.tpch import TpchConnector
+
+            reg = ConnectorRegistry()
+            reg.register("tpcds", TpcdsConnector(scale=scale))
+            reg.register("tpch", TpchConnector(scale=scale))
+            reg.register("memory", shared_memory)
+            return reg
+
+        return cls(factory, "tpcds", n_workers, config, **kwargs)
+
     def execute(self, sql: str) -> QueryResult:
         columns, data = self.client.execute(sql)
         names = [c["name"] for c in columns]

@@ -193,10 +193,13 @@ class HttpPageClient(threading.Thread):
     Transport errors retry through a ``RequestErrorTracker``: because
     the token only advances on success, a retried GET simply re-fetches
     the unacked pages (at-least-once delivery with token dedup — the
-    HttpPageBufferClient.java:297 semantics).  ``repoint`` redirects the
-    poll at a replacement task mid-stream (mid-query task recovery);
-    only safe before any page was delivered, so the replacement's
-    regenerated stream cannot double-count.
+    HttpPageBufferClient.java:297 semantics).  The owning
+    ``ExchangeClient`` may redirect the poll at a replacement task
+    attempt mid-stream (whole-stage retry / speculative re-execution):
+    ``epoch`` increments on every repoint so a response in flight from
+    the previous attempt is discarded, and the ``base_url`` — which
+    carries the producer's attempt-qualified task id — keys the
+    attempt-aware page accounting.
     """
 
     def __init__(self, base_url: str, client: "ExchangeClient",
@@ -207,37 +210,30 @@ class HttpPageClient(threading.Thread):
         self.base_url = base_url.rstrip("/")
         self.client = client
         self.token = 0
+        self.epoch = 0
         # per-cluster intra-auth headers (one process can host clusters
         # with different secrets; never process-global state)
         self.headers = dict(headers or {})
         self.http = http or RetryingHttpClient()
         self.task_id = task_id
-        self.pages_delivered = 0
         self._lock = threading.Lock()
         self._tracker = self.http.new_tracker(
             self.base_url, task_id=task_id, description="exchange fetch")
-
-    def repoint(self, new_base_url: str) -> bool:
-        """Redirect at a replacement producer; False once pages from the
-        old producer were already delivered (not recoverable)."""
-        with self._lock:
-            if self.pages_delivered > 0:
-                return False
-            self.base_url = new_base_url.rstrip("/")
-            self.token = 0
-            self._tracker.reset(endpoint=self.base_url)
-            return True
 
     def run(self) -> None:
         try:
             while True:
                 with self._lock:
-                    base, token = self.base_url, self.token
+                    base, token, epoch = (self.base_url, self.token,
+                                          self.epoch)
                 try:
                     resp = self.http.request_once(
                         f"{base}/{token}", headers=dict(self.headers),
                         timeout=120)
                 except Exception as e:  # noqa: BLE001 - classified
+                    with self._lock:
+                        if self.epoch != epoch:
+                            continue   # repointed mid-flight: new source
                     # raises RemoteRequestError when fatal or the error
                     # budget is exhausted; else backs off and we retry
                     # (possibly against a repointed base_url)
@@ -249,19 +245,19 @@ class HttpPageClient(threading.Thread):
                 next_token = int(resp.headers.get(
                     "X-Presto-Next-Token", token))
                 body = resp.body
-                with self._lock:
-                    if self.base_url != base:
-                        continue   # repointed mid-flight: discard
                 off = 0
                 while off < len(body):
                     size = frame_size(body, off)
-                    self.client.on_page(body[off:off + size])
+                    # the exchange drops the page if this epoch is stale
+                    # (repointed while the response was in flight)
+                    self.client.on_page(body[off:off + size], self, epoch,
+                                        base)
                     off += size
-                    with self._lock:
-                        self.pages_delivered += 1
                 with self._lock:
-                    if self.base_url == base:
+                    if self.epoch == epoch:
                         self.token = next_token
+                    else:
+                        continue
                 if complete:
                     break
         except Exception as e:  # noqa: BLE001 - surfaces to the driver
@@ -291,12 +287,25 @@ class ExchangeClient:
         # sleep-polling (the reference blocks the driver on the
         # ExchangeClient's isBlocked future the same way)
         self._arrived = threading.Condition(self._lock)
-        self._pages: List[bytes] = []
+        # buffered pages tagged with their source url — the url carries
+        # the producer's attempt-qualified task id, so every page is
+        # identified by (task id, attempt, token) end to end and the
+        # dedup accounting below is per attempt
+        self._pages: List[Tuple[str, bytes]] = []
         self._buffered_bytes = 0
         self._max_buffered_bytes = max(1, max_buffered_bytes)
         self._closed = False
         self._error: Optional[Exception] = None
         self.task_id = task_id
+        self._headers = headers
+        self._http = http
+        # per-source-url dedup counters: 'fetched' pages buffered here,
+        # 'consumed' pages handed to the operator chain, 'purged' pages
+        # dropped on a repoint before the operator saw them.  The
+        # exactness invariant whole-stage retry and speculation rely on:
+        # for any producer task, at most ONE attempt ever has
+        # consumed > 0 — a repoint is refused ('delivered') otherwise.
+        self.source_stats: Dict[str, Dict[str, int]] = {}
         self._clients = [HttpPageClient(loc, self, headers=headers,
                                         http=http, task_id=task_id)
                          for loc in locations]
@@ -304,32 +313,103 @@ class ExchangeClient:
         for c in self._clients:
             c.start()
 
+    def _stat(self, url: str) -> Dict[str, int]:
+        s = self.source_stats.get(url)
+        if s is None:
+            s = {"fetched": 0, "consumed": 0, "purged": 0}
+            self.source_stats[url] = s
+        return s
+
+    def delivery_state(self, old_prefix: str) -> str:
+        """Probe (read-only): 'delivered' when pages from a source under
+        ``old_prefix`` already entered the operator chain, 'clean' when
+        the source matches but nothing was consumed (buffered pages can
+        still be purged), 'not-found' otherwise."""
+        old = old_prefix.rstrip("/")
+        state = "not-found"
+        with self._lock:
+            for c in self._clients:
+                if not c.base_url.startswith(old):
+                    continue
+                if self.source_stats.get(
+                        c.base_url, {}).get("consumed", 0) > 0:
+                    return "delivered"
+                state = "clean"
+        return state
+
     def repoint(self, old_prefix: str, new_prefix: str) -> str:
         """Redirect every fetcher polling under ``old_prefix`` at the
-        replacement task's results under ``new_prefix`` (mid-query task
-        recovery).  Returns 'repointed', 'delivered' (pages from the old
-        producer were already consumed — not recoverable), or
-        'not-found'."""
-        status = "not-found"
-        for c in self._clients:
-            if not c.base_url.startswith(old_prefix.rstrip("/")):
-                continue
-            suffix = c.base_url[len(old_prefix.rstrip("/")):]
-            if c.repoint(new_prefix.rstrip("/") + suffix):
-                status = "repointed" if status != "delivered" else status
-            else:
-                return "delivered"
-        return status
+        replacement attempt's results under ``new_prefix`` (whole-stage
+        retry / speculative re-execution / leaf task recovery).
 
-    def on_page(self, page: bytes) -> None:
+        Exactness: allowed only while ZERO pages of the old attempt were
+        consumed by the operator chain — buffered-but-unconsumed pages
+        are purged and the fetch restarts at token 0 of the new attempt,
+        so rows always come wholly from one attempt.  Returns
+        'repointed', 'delivered' (old-attempt pages already consumed —
+        the consumer itself must be restarted), or 'not-found'."""
+        old = old_prefix.rstrip("/")
+        new = new_prefix.rstrip("/")
         with self._lock:
+            matched = [c for c in self._clients
+                       if c.base_url.startswith(old)]
+            if not matched:
+                return "not-found"
+            for c in matched:
+                if self.source_stats.get(
+                        c.base_url, {}).get("consumed", 0) > 0:
+                    return "delivered"
+            for i, c in enumerate(list(self._clients)):
+                if c not in matched:
+                    continue
+                with c._lock:
+                    url = c.base_url
+                    # purge buffered pages of the superseded attempt so
+                    # they can never double-count against the new stream
+                    kept = []
+                    for (u, p) in self._pages:
+                        if u == url:
+                            self._buffered_bytes -= len(p)
+                            self._stat(u)["purged"] += 1
+                        else:
+                            kept.append((u, p))
+                    self._pages = kept
+                    c.base_url = new + url[len(old):]
+                    c.token = 0
+                    c.epoch += 1
+                    c._tracker.reset(endpoint=c.base_url)
+                    alive = c.is_alive()
+                    new_url = c.base_url
+                if not alive:
+                    # the old attempt's stream completed (thread exited)
+                    # with nothing consumed: fetch the replacement with a
+                    # fresh client — threads cannot restart
+                    repl = HttpPageClient(new_url, self,
+                                          headers=self._headers,
+                                          http=self._http,
+                                          task_id=self.task_id)
+                    self._clients[self._clients.index(c)] = repl
+                    self._remaining += 1
+                    repl.start()
+            self._drained.notify_all()
+            self._arrived.notify_all()
+        return "repointed"
+
+    def on_page(self, page: bytes, source: "HttpPageClient",
+                epoch: int, url: str) -> None:
+        with self._lock:
+            if source.epoch != epoch:
+                return   # stale attempt: repointed while in flight
             while (self._buffered_bytes >= self._max_buffered_bytes
                    and not self._closed and self._error is None):
                 self._drained.wait(timeout=1.0)
+                if source.epoch != epoch:
+                    return
             if self._closed or self._error is not None:
                 return
-            self._pages.append(page)
+            self._pages.append((url, page))
             self._buffered_bytes += len(page)
+            self._stat(url)["fetched"] += 1
             self._arrived.notify_all()
 
     def on_error(self, e: Exception) -> None:
@@ -381,8 +461,9 @@ class ExchangeClient:
                 raise RuntimeError(
                     f"exchange failed: {self._error}") from self._error
             if self._pages:
-                page = self._pages.pop(0)
+                url, page = self._pages.pop(0)
                 self._buffered_bytes -= len(page)
+                self._stat(url)["consumed"] += 1
                 self._drained.notify_all()
                 return page
             return None
@@ -443,6 +524,12 @@ def _repoint_locations(locations: List[str], old_prefix: str,
     return "repointed" if hit else "not-found"
 
 
+def _probe_locations(locations: Sequence[str], old_prefix: str) -> str:
+    old = old_prefix.rstrip("/")
+    return ("clean" if any(loc.startswith(old) for loc in locations)
+            else "not-found")
+
+
 class ExchangeOperatorFactory(OperatorFactory):
     def __init__(self, locations: Sequence[str],
                  headers: Optional[dict] = None,
@@ -458,6 +545,20 @@ class ExchangeOperatorFactory(OperatorFactory):
         if self._client is not None:
             return self._client.repoint(old_prefix, new_prefix)
         return _repoint_locations(self.locations, old_prefix, new_prefix)
+
+    def delivery_state(self, old_prefix: str) -> str:
+        """Probe half of the repoint protocol (read-only)."""
+        if self._client is not None:
+            return self._client.delivery_state(old_prefix)
+        return _probe_locations(self.locations, old_prefix)
+
+    def source_stats(self) -> dict:
+        """Attempt-aware dedup counters per source url (for task info)."""
+        if self._client is None:
+            return {}
+        with self._client._lock:
+            return {u: dict(s)
+                    for u, s in self._client.source_stats.items()}
 
     def create(self, ctx: OperatorContext):
         if self._client is None:
@@ -620,6 +721,12 @@ class MergeExchangeOperatorFactory(OperatorFactory):
         self._live_clients: List[ExchangeClient] = []
 
     def repoint(self, old_prefix: str, new_prefix: str) -> str:
+        # probe every stream first: a partially-consumed one anywhere
+        # makes the whole repoint unsafe, and must not leave the other
+        # streams half-redirected
+        states = [c.delivery_state(old_prefix) for c in self._live_clients]
+        if "delivered" in states:
+            return "delivered"
         statuses = [c.repoint(old_prefix, new_prefix)
                     for c in self._live_clients]
         if "delivered" in statuses:
@@ -627,6 +734,22 @@ class MergeExchangeOperatorFactory(OperatorFactory):
         if "repointed" in statuses:
             return "repointed"
         return _repoint_locations(self.locations, old_prefix, new_prefix)
+
+    def delivery_state(self, old_prefix: str) -> str:
+        states = [c.delivery_state(old_prefix) for c in self._live_clients]
+        if "delivered" in states:
+            return "delivered"
+        if "clean" in states:
+            return "clean"
+        return _probe_locations(self.locations, old_prefix)
+
+    def source_stats(self) -> dict:
+        out: dict = {}
+        for c in self._live_clients:
+            with c._lock:
+                for u, s in c.source_stats.items():
+                    out[u] = dict(s)
+        return out
 
     def create(self, ctx: OperatorContext):
         op = MergeExchangeOperator(ctx, self.locations, self.sort_keys,

@@ -34,13 +34,19 @@ FAIL_N_TIMES = "fail-n-times"
 HTTP_503 = "http-503"
 DROP_CONNECTION = "drop-connection"
 DELAY = "delay"
+#: hold ONLY the task-results drain of a matching task until the test
+#: releases the rule (or ``delay_s`` elapses) — the deterministic
+#: straggler: the task executes normally, its consumers just cannot
+#: pull its pages, which is exactly what speculation must beat
+SLOW_TASK = "slow-task"
 
 
 class FaultRule:
     def __init__(self, pattern: str, method: str, policy: str, *,
                  times: Optional[int] = None, delay_s: float = 0.0,
                  status: int = 503):
-        if policy not in (FAIL_N_TIMES, HTTP_503, DROP_CONNECTION, DELAY):
+        if policy not in (FAIL_N_TIMES, HTTP_503, DROP_CONNECTION, DELAY,
+                          SLOW_TASK):
             raise ValueError(f"unknown fault policy {policy!r}")
         self.pattern = pattern
         self.regex = re.compile(pattern)
@@ -52,6 +58,21 @@ class FaultRule:
                           else (1 if policy == FAIL_N_TIMES else None))
         self.delay_s = delay_s
         self.status = status
+        # slow-task: requests block on this event rather than a timer,
+        # so straggler tests are deterministic (release when ready);
+        # ``delay_s`` > 0 doubles as a safety cap
+        self.released = threading.Event()
+
+    def release(self) -> None:
+        """Unblock every request held by a slow-task rule."""
+        self.released.set()
+
+    def hold(self) -> None:
+        # block on the event, not a sleep: deterministic release, with a
+        # cap (delay_s when given, else 60s) so a forgotten release can
+        # never hang CI
+        self.released.wait(timeout=self.delay_s if self.delay_s > 0
+                           else 60.0)
 
     def matches(self, path: str, method: str) -> bool:
         return (self.method in ("*", method.upper())
@@ -89,6 +110,23 @@ class FaultInjector:
             self.rules.append(rule)
         return rule
 
+    def add_slow_task(self, task_pattern: str, *,
+                      delay_s: float = 0.0) -> FaultRule:
+        """Straggler policy: hold ONLY the task-results drain
+        (``GET /v1/task/{id}/results/...``) of tasks matching
+        ``task_pattern`` until ``rule.release()`` (or ``delay_s``).
+        Task create, status polls, and every other endpoint stay fast —
+        the task runs and finishes normally but its consumers starve,
+        which is the shape speculative re-execution must beat."""
+        return self.add_rule(
+            rf"/v1/task/[^/]*{task_pattern}[^/]*/results/",
+            method="GET", policy=SLOW_TASK, delay_s=delay_s)
+
+    def release_all(self) -> None:
+        with self._lock:
+            for rule in self.rules:
+                rule.release()
+
     def clear(self) -> None:
         with self._lock:
             self.rules.clear()
@@ -120,6 +158,9 @@ class FaultInjector:
         if policy == DELAY:
             self.sleeper(rule.delay_s)
             return
+        if policy == SLOW_TASK:
+            rule.hold()
+            return
         if policy == HTTP_503:
             import io
 
@@ -140,5 +181,8 @@ class FaultInjector:
         rule, policy = hit
         if policy == DELAY:
             self.sleeper(rule.delay_s)
+            return None
+        if policy == SLOW_TASK:
+            rule.hold()
             return None
         return policy, rule

@@ -51,6 +51,10 @@ class PlanFragment:
     # size the writer-task count to the data volume
     # (ScaledWriterScheduler role, statically decided)
     scale_rows: Optional[float] = None
+    # every fragment this one TRANSITIVELY consumes — the producer
+    # subtree whole-stage retry re-creates when a non-leaf task of this
+    # fragment is lost (the Presto-on-Spark re-run unit)
+    producer_subtree: Tuple[int, ...] = ()
 
 
 @dataclasses.dataclass
@@ -88,8 +92,16 @@ class Fragmenter:
              output_partitioning: Tuple[str, Tuple[int, ...]],
              consumed: Sequence[int]) -> int:
         fid = len(self.fragments)
+        # fragments list is topological (producers first), so every
+        # consumed fragment's subtree is already final
+        subtree: List[int] = []
+        for c in consumed:
+            for p in self.fragments[c].producer_subtree + (c,):
+                if p not in subtree:
+                    subtree.append(p)
         self.fragments.append(PlanFragment(
-            fid, root, partitioning, output_partitioning, tuple(consumed)))
+            fid, root, partitioning, output_partitioning, tuple(consumed),
+            producer_subtree=tuple(sorted(subtree))))
         return fid
 
     # ------------------------------------------------------------------

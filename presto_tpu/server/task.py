@@ -98,11 +98,23 @@ class SqlTask:
         ctx = self._stats or self._live
         stats = ([s.as_dict() for s in ctx.operator_stats]
                  if ctx is not None else [])
+        exchange_stats: Dict[str, Dict] = {}
+        for source in self.exchange_sources:
+            if hasattr(source, "source_stats"):
+                exchange_stats.update(source.source_stats())
         return {"taskId": self.task_id, "state": self.state,
                 "error": self.error, "operatorStats": stats,
                 "jitCounters": (ctx.jit_counters() if ctx is not None
                                 else {"dispatches": 0, "compiles": 0}),
                 "kernelCaches": cache_stats(),
+                # producer progress + drain state for the coordinator's
+                # straggler detector, and the attempt-aware exchange
+                # dedup counters (whole-stage retry observability)
+                "pagesEnqueued": self.buffers.pages_enqueued,
+                "drained": (self.state != "RUNNING"
+                            and (self.buffers.is_drained()
+                                 or self.buffers.is_fully_served())),
+                "exchangeSources": exchange_stats,
                 "peakMemory": ctx.memory.peak if ctx is not None else 0}
 
     def memory_info(self) -> Dict:
@@ -120,10 +132,10 @@ class SqlTask:
 
     def repoint_remote_source(self, old_prefix: str,
                               new_prefix: str) -> str:
-        """Redirect remote-source fetches from a dead producer at its
-        replacement.  'repointed' | 'delivered' (pages from the old
-        producer were already consumed — not recoverable) |
-        'not-found'."""
+        """Redirect remote-source fetches from a superseded producer
+        attempt at its replacement.  'repointed' | 'delivered' (pages
+        from the old attempt already entered the operator chain — this
+        task must be restarted instead) | 'not-found'."""
         status = "not-found"
         for source in self.exchange_sources:
             got = source.repoint(old_prefix, new_prefix)
@@ -131,6 +143,23 @@ class SqlTask:
                 return "delivered"
             if got == "repointed":
                 status = "repointed"
+        return status
+
+    def probe_remote_source(self, old_prefix: str) -> str:
+        """Read-only half of the repoint protocol: report whether pages
+        from a producer under ``old_prefix`` were already consumed
+        ('delivered'), merely fetched/unseen ('clean'), or unknown here
+        ('not-found') — whole-stage retry uses this to size the restart
+        cascade before mutating anything."""
+        status = "not-found"
+        for source in self.exchange_sources:
+            if not hasattr(source, "delivery_state"):
+                continue
+            got = source.delivery_state(old_prefix)
+            if got == "delivered":
+                return "delivered"
+            if got == "clean":
+                status = "clean"
         return status
 
     def cancel(self) -> None:

@@ -191,11 +191,18 @@ class WorkerServer:
                     try:
                         req = json.loads(self.rfile.read(n))
                         old = str(req["old_prefix"])
-                        new = str(req["new_prefix"])
+                        probe = bool(req.get("probe", False))
+                        new = "" if probe else str(req["new_prefix"])
                     except (KeyError, TypeError, ValueError) as e:
                         self._json(400, {"error": f"bad repoint: {e}"})
                         return
-                    status = task.repoint_remote_source(old, new)
+                    if probe:
+                        # read-only delivery probe: whole-stage retry
+                        # sizes its restart cascade with this before
+                        # mutating any source
+                        status = task.probe_remote_source(old)
+                    else:
+                        status = task.repoint_remote_source(old, new)
                     self._json(200, {"status": status})
                     return
                 if parts[:2] == ["v1", "task"] and worker.draining:

@@ -85,6 +85,14 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "hash_partition_count": ("hash_partition_count", int),
     "query_max_memory_bytes": ("query_max_memory_bytes", int),
     "query_max_run_time_s": ("query_max_run_time_s", float),
+    "stage_retry_limit": ("stage_retry_limit", int),
+    "cancel_fanout_budget_s": ("cancel_fanout_budget_s", float),
+    "speculative_execution_enabled": (
+        "speculative_execution_enabled",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "speculation_quantile": ("speculation_quantile", float),
+    "speculation_lag_factor": ("speculation_lag_factor", float),
+    "speculation_min_runtime_s": ("speculation_min_runtime_s", float),
 }
 
 

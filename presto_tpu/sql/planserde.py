@@ -375,7 +375,8 @@ def fragment_to_json(f: PlanFragment) -> Dict[str, Any]:
             "partitioning": f.partitioning,
             "output_partitioning": [kind, list(channels)],
             "consumed_fragments": list(f.consumed_fragments),
-            "scale_rows": f.scale_rows}
+            "scale_rows": f.scale_rows,
+            "producer_subtree": list(f.producer_subtree)}
 
 
 def fragment_from_json(d: Dict[str, Any]) -> PlanFragment:
@@ -383,4 +384,6 @@ def fragment_from_json(d: Dict[str, Any]) -> PlanFragment:
     return PlanFragment(int(d["fragment_id"]), node_from_json(d["root"]),
                         str(d["partitioning"]), (str(kind), tuple(channels)),
                         tuple(d["consumed_fragments"]),
-                        d.get("scale_rows"))
+                        d.get("scale_rows"),
+                        producer_subtree=tuple(
+                            d.get("producer_subtree") or ()))

@@ -67,6 +67,31 @@ def test_fragment_roundtrip(metadata, sql):
         assert json.dumps(fragment_to_json(back)) == wire
 
 
+@pytest.mark.parametrize("sql", QUERIES)
+def test_producer_subtree_is_transitive_closure(metadata, sql):
+    """The whole-stage-retry annotation: every fragment's
+    producer_subtree is exactly the transitive closure of its consumed
+    fragments (the re-run unit when one of its tasks is lost)."""
+    stmt = parse_statement(sql)
+    dplan = Fragmenter(metadata=metadata).fragment(
+        optimize(Planner(metadata).plan(stmt), metadata))
+    by_id = {f.fragment_id: f for f in dplan.fragments}
+
+    def closure(fid):
+        out = set()
+        stack = list(by_id[fid].consumed_fragments)
+        while stack:
+            c = stack.pop()
+            if c not in out:
+                out.add(c)
+                stack.extend(by_id[c].consumed_fragments)
+        return out
+
+    for f in dplan.fragments:
+        assert set(f.producer_subtree) == closure(f.fragment_id), \
+            f.fragment_id
+
+
 def test_expr_roundtrip_rebinds_functions(metadata):
     sql = ("select l_extendedprice * (1 - l_discount) from lineitem "
            "where l_shipdate between date '1994-01-01' "

@@ -198,3 +198,22 @@ def test_union_all_distributes(runners):
           "select count(*), sum(x) from ("
           "select o_totalprice x from orders "
           "union all select l_extendedprice x from lineitem)")
+
+
+@pytest.mark.parametrize("qn", [72, 95])
+def test_tpcds_baseline_configs_on_mesh(qn):
+    """The BASELINE.md multi-chip configs (TPC-DS Q72/Q95) through the
+    SPMD mesh tier — the whole skewed multi-join / semijoin plan as one
+    shard_mapped program — pinned against the operator tier (ROADMAP
+    #3's 'no TPC-DS query has ever run on the mesh')."""
+    import tests.tpcds_queries as DS
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+
+    scale = 0.001   # the join tower is heavy on the 1-core CI host
+    mesh = MeshQueryRunner.tpcds(scale=scale, n_devices=2)
+    reg = ConnectorRegistry()
+    reg.register("tpcds", TpcdsConnector(scale=scale))
+    local = LocalQueryRunner(reg, "tpcds")
+    assert_same(mesh.execute(DS.QUERIES[qn]),
+                local.execute(DS.QUERIES[qn]))

@@ -7,17 +7,48 @@ sanity-check the fault-tolerance layer on a box (CI or dev) without the
 pytest harness:
 
     JAX_PLATFORMS=cpu python tools/chaos_run.py --workers 3 --scale 0.01
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --mode stage
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --check
 
-Exit code 0 = the killed worker's leaf tasks were rescheduled and the
-chaos result matched the clean run; non-zero = recovery failed.
+``--mode leaf`` (default) kills a worker holding leaf tasks; ``--mode
+stage`` runs a broadcast-join plan and kills the worker holding the
+NON-leaf probe fragment, proving whole-stage retry.  ``--check`` is the
+CI smoke tier: it runs the whole ``chaos`` pytest marker headless and
+exits nonzero on any inexact result.
+
+Exit code 0 = recovery reproduced the clean run exactly; non-zero =
+recovery failed.
 """
 
 import argparse
 import dataclasses
 import json
+import os
+import subprocess
 import sys
 import threading
 import time
+
+# runnable from anywhere: `python tools/chaos_run.py` puts tools/ on the
+# path, not the repo root (same shim as fusion_report.py)
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def run_check() -> int:
+    """CI smoke: the chaos marker tier, headless (quick signal — the
+    TPC-DS mesh cases are additionally marked slow and excluded)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-m", "chaos and not slow",
+         "-p", "no:cacheprovider", os.path.join(repo, "tests",
+                                                "test_chaos.py")],
+        cwd=repo, env=env)
+    print(json.dumps({"check": "chaos marker tier",
+                      "ok": r.returncode == 0}))
+    return r.returncode
 
 
 def main() -> int:
@@ -27,7 +58,19 @@ def main() -> int:
     ap.add_argument("--query", default="select count(*) from lineitem")
     ap.add_argument("--kill-index", type=int, default=None,
                     help="worker to kill (default: last)")
+    ap.add_argument("--mode", choices=["leaf", "stage"], default="leaf",
+                    help="leaf = kill a scan-task worker; stage = kill "
+                         "a worker holding a non-leaf fragment "
+                         "(whole-stage retry)")
+    ap.add_argument("--check", action="store_true",
+                    help="run the chaos pytest tier headless; exit "
+                         "nonzero on any inexact result")
     args = ap.parse_args()
+    if args.check:
+        return run_check()
+    if args.mode == "stage":
+        args.query = ("select n_name, count(*) from nation join region "
+                      "on n_regionkey = r_regionkey group by n_name")
 
     from presto_tpu.config import DEFAULT
     from presto_tpu.server.dqr import DistributedQueryRunner
@@ -68,14 +111,20 @@ def main() -> int:
         deadline = time.monotonic() + 15.0
         while time.monotonic() < deadline:
             qs = list(co.queries.values())
-            if qs and any(u == victim_uri
-                          for _, _, u in qs[0]._placements):
+            if qs and any(
+                    u == victim_uri and (
+                        args.mode == "leaf"
+                        or (qs[0]._dplan is not None and qs[0]._dplan
+                            .fragments[f].consumed_fragments))
+                    for f, _, u in qs[0]._placements):
                 break
             time.sleep(0.02)
         q = list(co.queries.values())[0]
         dqr.kill_worker(victim_idx)
         t.join(timeout=120)
         report["wall_s"] = round(time.monotonic() - t0, 3)
+        report["mode"] = args.mode
+        report["stage_retry_rounds"] = q.stage_retry_rounds
         report["recovered_placements"] = [
             (fid, tid, uri) for fid, tid, uri in q._placements]
         if t.is_alive():
