@@ -22,7 +22,10 @@ from presto_tpu.connectors.api import (
 class _RowsPageSource(PageSource):
     def __init__(self, types, rows, channels):
         self.types = [types[c] for c in channels]
-        self.rows = [tuple(r[c] for c in channels) for r in rows]
+        # tolerate rows narrower than the schema (an engine-context
+        # callable predating a column addition): missing cells are NULL
+        self.rows = [tuple(r[c] if c < len(r) else None
+                           for c in channels) for r in rows]
 
     def __iter__(self):
         yield batch_from_pylist(self.types, self.rows)
@@ -78,12 +81,23 @@ class SystemConnector(_VirtualConnector):
             ("node_id", T.VARCHAR), ("http_uri", T.VARCHAR),
             ("node_version", T.VARCHAR), ("coordinator", T.BOOLEAN),
             ("state", T.VARCHAR)], nodes_fn)
+        # queries/tasks carry the live stats rollup (QueryStats /
+        # TaskStats surfaced through system.runtime, SURVEY §5.5); a
+        # rows_fn built before the widening may still yield short
+        # tuples, so _RowsPageSource pads with NULLs
         self.add_table("queries", [
             ("query_id", T.VARCHAR), ("state", T.VARCHAR),
-            ("query", T.VARCHAR)], queries_fn)
+            ("user", T.VARCHAR), ("query", T.VARCHAR),
+            ("output_rows", T.BIGINT), ("wall_s", T.DOUBLE),
+            ("peak_memory_bytes", T.BIGINT),
+            ("stage_retry_rounds", T.BIGINT),
+            ("recovery_rounds", T.BIGINT),
+            ("trace_token", T.VARCHAR)], queries_fn)
         self.add_table("tasks", [
             ("task_id", T.VARCHAR), ("state", T.VARCHAR),
-            ("query_id", T.VARCHAR)], tasks_fn)
+            ("query_id", T.VARCHAR), ("output_rows", T.BIGINT),
+            ("wall_ms", T.DOUBLE),
+            ("peak_memory_bytes", T.BIGINT)], tasks_fn)
 
 
 class InformationSchemaConnector(_VirtualConnector):

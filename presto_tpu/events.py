@@ -6,11 +6,23 @@ queryCreated / queryCompleted / splitCompleted events to pluggable
 listeners (audit, metrics shipping, query logs).  Listeners here receive
 typed dataclasses; exceptions in listeners are swallowed (an observer must
 never fail a query), matching the reference's isolation stance.
+
+The distributed tier (server/coordinator.py) additionally emits the
+fault-tolerance lifecycle: ``StageRetryEvent`` when whole-stage retry
+re-creates a producer subtree, ``TaskRecoveryEvent`` when a dead worker's
+leaf tasks are rescheduled, and ``SpeculationEvent`` for each straggler
+clone outcome.  Every event carries the query's trace token
+(``X-Presto-Trace-Token``) so log lines, errors, and events of one query
+correlate across the mesh.  ``JsonLinesEventListener`` is the bundled
+``query.json`` role: one JSON object per line, replayable by
+``tools/query_profile.py``.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -21,6 +33,7 @@ class QueryCreatedEvent:
     user: str
     sql: str
     create_time: float
+    trace_token: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,6 +48,12 @@ class QueryCompletedEvent:
     output_rows: int
     peak_memory_bytes: int
     operator_stats: List[Dict[str, Any]]
+    trace_token: str = ""
+    # per-stage rollup (StageStats.as_dict() per fragment) — the
+    # distributed tier fills this from real remote task info; the local
+    # tier reports its single task as one stage
+    stage_stats: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
 
     @property
     def wall_s(self) -> float:
@@ -49,6 +68,44 @@ class SplitCompletedEvent:
     wall_ns: int
 
 
+@dataclasses.dataclass(frozen=True)
+class StageRetryEvent:
+    """Whole-stage retry re-created the producer subtree of a lost
+    stage (server/coordinator.py _retry_stages)."""
+
+    query_id: str
+    trace_token: str
+    fragment_ids: tuple            # every fragment re-created this round
+    round: int                     # worst per-stage round consumed
+    reason: str                    # e.g. the dead worker URI
+    time: float
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskRecoveryEvent:
+    """Leaf tasks of a dead worker were rescheduled in place."""
+
+    query_id: str
+    trace_token: str
+    dead_uri: str
+    task_ids: tuple
+    time: float
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculationEvent:
+    """A straggler clone's lifecycle: outcome is 'cloned' when the
+    clone is spawned, then 'won' | 'lost' | 'split' when the race
+    resolves (first-finisher-wins, arbitration per consumer)."""
+
+    query_id: str
+    trace_token: str
+    task_id: str
+    clone_id: str
+    outcome: str
+    time: float
+
+
 class EventListener:
     """Implement any subset (EventListener SPI surface)."""
 
@@ -59,6 +116,15 @@ class EventListener:
         pass
 
     def split_completed(self, event: SplitCompletedEvent) -> None:
+        pass
+
+    def stage_retry(self, event: StageRetryEvent) -> None:
+        pass
+
+    def task_recovery(self, event: TaskRecoveryEvent) -> None:
+        pass
+
+    def speculation(self, event: SpeculationEvent) -> None:
         pass
 
 
@@ -84,6 +150,56 @@ class EventBus:
 
     def split_completed(self, event: SplitCompletedEvent) -> None:
         self._fire("split_completed", event)
+
+    def stage_retry(self, event: StageRetryEvent) -> None:
+        self._fire("stage_retry", event)
+
+    def task_recovery(self, event: TaskRecoveryEvent) -> None:
+        self._fire("task_recovery", event)
+
+    def speculation(self, event: SpeculationEvent) -> None:
+        self._fire("speculation", event)
+
+
+class JsonLinesEventListener(EventListener):
+    """The bundled ``query.json`` event log (the reference ships the
+    same as an http-event-listener / file query log): every event is
+    appended as one JSON object per line, ``{"event": <type>, ...}``.
+    Append + flush per event so a crashed coordinator still leaves a
+    readable log; writes serialize on a lock (events fire from query,
+    monitor, and handler threads)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def _write(self, event) -> None:
+        rec = {"event": type(event).__name__}
+        rec.update(dataclasses.asdict(event))
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+                f.flush()
+
+    query_created = _write
+    query_completed = _write
+    split_completed = _write
+    stage_retry = _write
+    task_recovery = _write
+    speculation = _write
+
+
+def read_event_log(path: str) -> List[Dict[str, Any]]:
+    """Parse a JsonLinesEventListener log back into dicts (the replay
+    half used by tools/query_profile.py and tests)."""
+    out: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
 
 
 def now() -> float:

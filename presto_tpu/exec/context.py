@@ -93,6 +93,150 @@ class OperatorStats:
         return dataclasses.asdict(self)
 
 
+@dataclasses.dataclass
+class DriverStats:
+    """One driver run (one instantiated pipeline) — the DriverStats
+    rollup level between OperatorStats and TaskStats (SURVEY §5.1)."""
+
+    pipeline: str = ""
+    operators: int = 0
+    input_rows: int = 0
+    output_rows: int = 0
+    wall_ns: int = 0
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class TaskStats:
+    """Task-level rollup of every operator the task ran, plus the
+    memory/exchange/buffer counters the worker owns.  This is the shape
+    serialized into the ``/v1/task/{id}`` info payload (``taskStats``)
+    and aggregated into StageStats by the coordinator."""
+
+    task_id: str = ""
+    state: str = ""
+    # wall-clock span (epoch seconds) of the task's execution — the
+    # span-timeline surface tools/query_profile.py renders
+    start_time: float = 0.0
+    end_time: float = 0.0
+    elapsed_s: float = 0.0
+    # sums over operator stats
+    wall_ns: int = 0
+    input_rows: int = 0
+    input_batches: int = 0
+    output_rows: int = 0
+    output_batches: int = 0
+    jit_dispatches: int = 0
+    jit_compiles: int = 0
+    prereduce_rows: int = 0
+    peak_memory_bytes: int = 0
+    # attempt-aware exchange dedup counters (sums across this task's
+    # remote sources) + producer-side page accounting
+    exchange_fetched: int = 0
+    exchange_consumed: int = 0
+    exchange_purged: int = 0
+    pages_enqueued: int = 0
+
+    def add_operator(self, s: OperatorStats) -> None:
+        self.wall_ns += s.wall_ns + s.finish_wall_ns
+        self.input_rows += s.input_rows
+        self.input_batches += s.input_batches
+        self.output_rows += s.output_rows
+        self.output_batches += s.output_batches
+        self.jit_dispatches += s.jit_dispatches
+        self.jit_compiles += s.jit_compiles
+        self.prereduce_rows += s.prereduce_rows
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "TaskStats":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in (d or {}).items() if k in known})
+
+
+@dataclasses.dataclass
+class StageStats:
+    """Per-fragment aggregation across that stage's tasks: additive
+    counters sum, wall is the slowest task (the stage critical path),
+    peak memory is the largest task (StageStats rollup role)."""
+
+    fragment_id: int = -1
+    tasks: int = 0          # tasks placed
+    reporting: int = 0      # tasks whose info was actually fetched
+    input_rows: int = 0
+    output_rows: int = 0
+    wall_ns: int = 0        # max over tasks
+    total_wall_ns: int = 0  # sum over tasks
+    jit_dispatches: int = 0
+    jit_compiles: int = 0
+    prereduce_rows: int = 0
+    peak_memory_bytes: int = 0
+    exchange_fetched: int = 0
+    exchange_consumed: int = 0
+    exchange_purged: int = 0
+    pages_enqueued: int = 0
+
+    def add_task(self, ts: TaskStats) -> None:
+        self.reporting += 1
+        self.input_rows += ts.input_rows
+        self.output_rows += ts.output_rows
+        self.wall_ns = max(self.wall_ns, ts.wall_ns)
+        self.total_wall_ns += ts.wall_ns
+        self.jit_dispatches += ts.jit_dispatches
+        self.jit_compiles += ts.jit_compiles
+        self.prereduce_rows += ts.prereduce_rows
+        self.peak_memory_bytes = max(self.peak_memory_bytes,
+                                     ts.peak_memory_bytes)
+        self.exchange_fetched += ts.exchange_fetched
+        self.exchange_consumed += ts.exchange_consumed
+        self.exchange_purged += ts.exchange_purged
+        self.pages_enqueued += ts.pages_enqueued
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class QueryStats:
+    """Whole-query rollup over stages (QueryStats role): the shape the
+    ``/v1/query/{id}`` detail payload and QueryCompletedEvent carry."""
+
+    query_id: str = ""
+    elapsed_s: float = 0.0
+    total_wall_ns: int = 0
+    input_rows: int = 0
+    output_rows: int = 0
+    jit_dispatches: int = 0
+    jit_compiles: int = 0
+    prereduce_rows: int = 0
+    peak_memory_bytes: int = 0   # max single-task peak across the query
+    exchange_fetched: int = 0
+    exchange_consumed: int = 0
+    exchange_purged: int = 0
+    stages: int = 0
+
+    def add_stage(self, st: StageStats) -> None:
+        self.stages += 1
+        self.total_wall_ns += st.total_wall_ns
+        self.input_rows += st.input_rows
+        self.output_rows += st.output_rows
+        self.jit_dispatches += st.jit_dispatches
+        self.jit_compiles += st.jit_compiles
+        self.prereduce_rows += st.prereduce_rows
+        self.peak_memory_bytes = max(self.peak_memory_bytes,
+                                     st.peak_memory_bytes)
+        self.exchange_fetched += st.exchange_fetched
+        self.exchange_consumed += st.exchange_consumed
+        self.exchange_purged += st.exchange_purged
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
 class QueryContext:
     def __init__(self, config: EngineConfig = DEFAULT,
                  memory_limit: Optional[int] = None):
@@ -108,7 +252,19 @@ class TaskContext:
         self.config = query.config
         self.memory = MemoryContext(query.memory, f"task:{task_id}")
         self.operator_stats: List[OperatorStats] = []
+        self.driver_stats: List[DriverStats] = []
+        self.start_time = time.time()
         self._cleanups: List = []
+
+    def task_stats(self) -> TaskStats:
+        """Roll every operator's stats up into one TaskStats (exchange
+        and buffer counters are merged in by the owning SqlTask, which
+        owns those objects)."""
+        ts = TaskStats(task_id=self.task_id, start_time=self.start_time)
+        for s in list(self.operator_stats):
+            ts.add_operator(s)
+        ts.peak_memory_bytes = self.memory.peak
+        return ts
 
     def jit_counters(self) -> Dict[str, int]:
         """Task-level rollup of row-pipeline jit dispatch/compile counts

@@ -24,8 +24,10 @@ from presto_tpu.exec.operator import Operator, OperatorFactory, SourceOperator
 
 
 class Driver:
-    def __init__(self, operators: Sequence[Operator]):
+    def __init__(self, operators: Sequence[Operator],
+                 pipeline_name: str = ""):
         self.operators = list(operators)
+        self.pipeline_name = pipeline_name
 
     @property
     def source(self) -> Optional[SourceOperator]:
@@ -79,6 +81,25 @@ class Driver:
                     op.close()
                 except Exception:  # noqa: BLE001 - close is best-effort
                     pass
+            self._record_driver_stats()
+
+    def _record_driver_stats(self) -> None:
+        """Append this run's DriverStats rollup to the TaskContext (the
+        OperatorStats -> DriverStats -> TaskStats chain, SURVEY §5.1).
+        Rows in = the source operator's output (what entered the chain);
+        rows out = the terminal operator's output."""
+        if not self.operators:
+            return
+        from presto_tpu.exec.context import DriverStats
+
+        ops = self.operators
+        ds = DriverStats(
+            pipeline=self.pipeline_name, operators=len(ops),
+            input_rows=ops[0].ctx.stats.output_rows,
+            output_rows=ops[-1].ctx.stats.output_rows,
+            wall_ns=sum(o.ctx.stats.wall_ns + o.ctx.stats.finish_wall_ns
+                        for o in ops))
+        ops[0].ctx.task.driver_stats.append(ds)
 
 
 class Pipeline:
@@ -95,7 +116,7 @@ class Pipeline:
         for i, f in enumerate(self.factories):
             ctx = OperatorContext(task, f"{self.name}.{i}.{f.name}")
             ops.append(f.create(ctx))
-        driver = Driver(ops)
+        driver = Driver(ops, pipeline_name=self.name)
         src = driver.source
         if src is not None:
             for s in self.splits:

@@ -127,28 +127,43 @@ class LocalQueryRunner:
 
     # --- statements --------------------------------------------------------
     def execute(self, sql: str) -> QueryResult:
+        import uuid
+
         from presto_tpu import events as ev
 
         self._query_seq += 1
         qid = f"local-{self._query_seq}"
+        trace = f"tt-{uuid.uuid4().hex[:12]}"
         created = ev.now()
         self.event_bus.query_created(ev.QueryCreatedEvent(
-            qid, self.session.user, sql, created))
+            qid, self.session.user, sql, created, trace_token=trace))
         self._last_task = None
         try:
             result = self._execute_statement(sql)
         except Exception as e:
             self.event_bus.query_completed(ev.QueryCompletedEvent(
                 qid, self.session.user, sql, "FAILED", str(e), created,
-                ev.now(), 0, 0, []))
+                ev.now(), 0, 0, [], trace_token=trace))
             raise
         task = self._last_task
+        # the single-process tier reports its one task as one stage, so
+        # local and distributed QueryCompletedEvents share a shape
+        stage_stats = []
+        if task is not None:
+            from presto_tpu.exec.context import StageStats
+
+            st = StageStats(fragment_id=0, tasks=1)
+            ts = task.task_stats()
+            ts.elapsed_s = ev.now() - created
+            st.add_task(ts)
+            stage_stats = [st.as_dict()]
         self.event_bus.query_completed(ev.QueryCompletedEvent(
             qid, self.session.user, sql, "FINISHED", None, created,
             ev.now(), len(result.rows),
             task.memory.peak if task is not None else 0,
             [s.as_dict() for s in task.operator_stats]
-            if task is not None else []))
+            if task is not None else [],
+            trace_token=trace, stage_stats=stage_stats))
         return result
 
     def _execute_statement(self, sql: str) -> QueryResult:
@@ -673,20 +688,30 @@ class LocalQueryRunner:
         phys = PhysicalPlanner(self.registry, self.config).plan(optimized)
         task = execute_pipelines(phys.pipelines, self.config)
         lines = [format_plan(optimized).rstrip(), "", "Operator stats:"]
+        # same counter set as the distributed tier's _render_analyze
+        # (jit dispatch/compile, pre-reduce rows, peak memory) so the
+        # two EXPLAIN ANALYZE surfaces stay diffable
         header = (f"{'operator':<40} {'in rows':>10} {'out rows':>10} "
                   f"{'wall ms':>9} {'finish ms':>9} {'jit disp':>8} "
-                  f"{'jit comp':>8}")
+                  f"{'jit comp':>8} {'prereduce':>9}")
         lines += [header, "-" * len(header)]
         for s in task.operator_stats:
             lines.append(
                 f"{s.operator:<40} {s.input_rows:>10} {s.output_rows:>10} "
                 f"{s.wall_ns / 1e6:>9.1f} {s.finish_wall_ns / 1e6:>9.1f} "
-                f"{s.jit_dispatches:>8} {s.jit_compiles:>8}")
+                f"{s.jit_dispatches:>8} {s.jit_compiles:>8} "
+                f"{s.prereduce_rows:>9}")
         jc = task.jit_counters()
         lines.append(
             f"peak memory: {task.memory.peak / (1 << 20):.1f} MiB; "
             f"jit dispatches: {jc['dispatches']}, "
-            f"compiles: {jc['compiles']}")
+            f"compiles: {jc['compiles']}; "
+            f"prereduce rows: {jc['prereduce_rows']}")
+        for d in task.driver_stats:
+            lines.append(
+                f"driver {d.pipeline}: {d.operators} operators, "
+                f"{d.input_rows} -> {d.output_rows} rows, "
+                f"{d.wall_ns / 1e6:.1f} ms")
         from presto_tpu.kernelcache import cache_stats
 
         stats = {n: s for n, s in cache_stats().items()

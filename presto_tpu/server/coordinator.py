@@ -33,6 +33,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from presto_tpu import events as ev
 from presto_tpu import types as T
 from presto_tpu.config import DEFAULT, EngineConfig
 from presto_tpu.connectors.api import ConnectorRegistry
@@ -159,11 +160,19 @@ class QueryExecution:
                  coordinator: "CoordinatorServer", user: str = "user",
                  session_properties: Optional[Dict[str, str]] = None,
                  catalog: Optional[str] = None,
-                 prepared: Optional[Dict[str, str]] = None):
+                 prepared: Optional[Dict[str, str]] = None,
+                 trace_token: Optional[str] = None):
         self.query_id = query_id
         self.sql = sql
         self.co = coordinator
         self.user = user
+        # query-scoped trace token (airlift TraceTokenModule role): the
+        # client may supply one on X-Presto-Trace-Token; otherwise it is
+        # generated at dispatch and rides EVERY internal request of this
+        # query so worker logs, task errors, and events correlate
+        self.trace_token = trace_token or f"tt-{uuid.uuid4().hex[:12]}"
+        self.create_time = ev.now()
+        self.end_time: Optional[float] = None
         # client-session state carried on the request headers
         # (StatementClientV1 / Session roles)
         self.session_properties = dict(session_properties or {})
@@ -215,6 +224,20 @@ class QueryExecution:
         self.column_types: List[T.Type] = []
         self.result_rows: List[tuple] = []
         self.rows_done = threading.Event()
+        # -- mesh observability (stats rollup + event stream) --------------
+        # fragment id -> StageStats dict, aggregated once post-drain from
+        # real remote task info; query_stats is the whole-query rollup
+        self.stage_stats: Dict[int, Dict] = {}
+        self.query_stats: Dict = {}
+        # fragment id -> [TaskStats dict] (span timeline for the
+        # query_profile tool) and raw task infos (EXPLAIN ANALYZE)
+        self.task_stats: Dict[int, List[Dict]] = {}
+        self._task_infos: Dict[int, List[Dict]] = {}
+        self._stats_collected = False
+        self._completed_fired = False
+        self.co.event_bus.query_created(ev.QueryCreatedEvent(
+            self.query_id, self.user, self.sql, self.create_time,
+            trace_token=self.trace_token))
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"query-{query_id}")
         self._thread.start()
@@ -230,11 +253,29 @@ class QueryExecution:
             self.error = str(e)
             self.state = "FAILED"
             self.rows_done.set()
+            self._fire_completed()
             return
         try:
             self._run_admitted()
         finally:
             group.release()
+            self._fire_completed()
+
+    def _fire_completed(self) -> None:
+        """QueryCompletedEvent enriched with the stage-stats rollup
+        (QueryMonitor.queryCompletedEvent role).  Fired exactly once."""
+        if self._completed_fired:
+            return
+        self._completed_fired = True
+        self.end_time = ev.now()
+        qs = self.query_stats or {}
+        self.co.event_bus.query_completed(ev.QueryCompletedEvent(
+            self.query_id, self.user, self.sql, self.state,
+            self.error, self.create_time, self.end_time,
+            len(self.result_rows), int(qs.get("peak_memory_bytes", 0)),
+            [], trace_token=self.trace_token,
+            stage_stats=[self.stage_stats[fid]
+                         for fid in sorted(self.stage_stats)]))
 
     def _run_admitted(self) -> None:
         try:
@@ -275,6 +316,7 @@ class QueryExecution:
                         root_locations = self._schedule(dplan)
                         self.state = "RUNNING"
                         self._drain(root_locations)
+                        self._collect_stats()
                     except Exception:
                         abort()
                         raise
@@ -303,6 +345,7 @@ class QueryExecution:
 
             self.state = "RUNNING"
             self._drain(root_locations)
+            self._collect_stats()
             if analyze:
                 text = self._render_analyze(dplan)
                 self.column_names = ["Query Plan"]
@@ -324,6 +367,17 @@ class QueryExecution:
             # (SqlQueryScheduler abort/cancel role).  The client is
             # unblocked first and the fan-out only runs when worker
             # tasks were actually created.
+            # observability settles BEFORE the client is unblocked: the
+            # stats rollup is grabbed while worker-side state still
+            # exists (failed queries report too) and the completion
+            # event hits every listener, so anything that observed the
+            # query finish can read its stats/events immediately
+            if self._tasks_scheduled:
+                try:
+                    self._collect_stats()
+                except Exception:  # noqa: BLE001 - stats are best-effort
+                    pass
+            self._fire_completed()
             self.rows_done.set()
             self._monitor_stop.set()
             if self._tasks_scheduled:
@@ -345,28 +399,105 @@ class QueryExecution:
                 lines.append("    " + ln)
         return "\n".join(lines)
 
-    def _fetch_task_info(self, task_id: str, wuri: str) -> Dict:
+    def _fetch_task_info(self, task_id: str, wuri: str,
+                         max_error_duration_s: Optional[float] = None
+                         ) -> Dict:
         resp = self.co.http.request(
             f"{wuri}/v1/task/{task_id}", headers=self._internal_headers(),
-            timeout=10, task_id=task_id, description="task status")
+            timeout=10, task_id=task_id, description="task status",
+            trace_token=self.trace_token,
+            max_error_duration_s=max_error_duration_s)
         return resp.json()
+
+    def _collect_stats(self) -> None:
+        """Fetch every placement's task info ONCE and roll it up:
+        TaskStats -> StageStats (per fragment) -> QueryStats.  Runs
+        right after the drain, before the cancel fan-out can tear the
+        tasks down; best-effort per task (a dead worker's tasks simply
+        do not report).  Feeds distributed EXPLAIN ANALYZE, the
+        /v1/query detail payload, QueryCompletedEvent, system.runtime,
+        and tools/query_profile.py."""
+        from presto_tpu.exec.context import (
+            QueryStats, StageStats, TaskStats,
+        )
+
+        if self._stats_collected or not self._tasks_scheduled:
+            return
+        self._stats_collected = True
+        with self._recovery_lock:
+            placements = list(self._placements)
+        by_uri: Dict[str, List[Tuple[int, str]]] = {}
+        for fid, tid, uri in placements:
+            by_uri.setdefault(uri, []).append((fid, tid))
+        results: List[Tuple[int, Dict]] = []
+        results_lock = threading.Lock()
+
+        def fetch_worker(uri: str, tasks) -> None:
+            for fid, tid in tasks:
+                try:
+                    info = self._fetch_task_info(
+                        tid, uri, max_error_duration_s=0.0)
+                except Exception:  # noqa: BLE001 - worker may be gone
+                    return   # same host: further fetches will hang too
+                with results_lock:
+                    results.append((fid, info))
+
+        threads = [threading.Thread(target=fetch_worker, args=(u, ts),
+                                    daemon=True,
+                                    name=f"stats-{self.query_id}")
+                   for u, ts in by_uri.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        infos: Dict[int, List[Dict]] = {}
+        with results_lock:
+            for fid, info in results:
+                infos.setdefault(fid, []).append(info)
+        self._task_infos = infos
+        n_tasks = {}
+        for fid, _tid, _uri in placements:
+            n_tasks[fid] = n_tasks.get(fid, 0) + 1
+        stage_stats: Dict[int, Dict] = {}
+        task_stats: Dict[int, List[Dict]] = {}
+        qs = QueryStats(query_id=self.query_id,
+                        elapsed_s=ev.now() - self.create_time)
+        for fid in sorted(infos):
+            st = StageStats(fragment_id=fid, tasks=n_tasks.get(fid, 0))
+            for info in infos[fid]:
+                ts_dict = info.get("taskStats") or {}
+                task_stats.setdefault(fid, []).append(ts_dict)
+                st.add_task(TaskStats.from_dict(ts_dict))
+            stage_stats[fid] = st.as_dict()
+            qs.add_stage(st)
+        self.stage_stats = stage_stats
+        self.task_stats = task_stats
+        self.query_stats = qs.as_dict()
 
     def _render_analyze(self, dplan: DistributedPlan) -> str:
         """Fragment plan + per-operator stats aggregated across each
-        fragment's tasks: rows summed, wall = slowest task (the
-        StageStats/PlanPrinter textDistributedPlan-with-stats role)."""
+        fragment's tasks from the collected rollup: rows summed, wall =
+        slowest task (the StageStats / PlanPrinter
+        textDistributedPlan-with-stats role).  Renders the SAME counter
+        set as the local tier's explain_analyze_text — jit dispatches/
+        compiles, pre-reduce rows, peak memory — so the two tiers stay
+        diffable."""
         from presto_tpu.sql.plan import format_plan
 
+        self._collect_stats()
         lines: List[str] = []
         header = (f"{'operator':<36} {'tasks':>5} {'in rows':>11} "
-                  f"{'out rows':>11} {'wall ms':>9} {'peak MiB':>9}")
+                  f"{'out rows':>11} {'wall ms':>9} {'jit disp':>8} "
+                  f"{'jit comp':>8} {'prereduce':>9}")
         for f in dplan.fragments:
-            tasks = [(tid, uri) for fid, tid, uri in self._placements
-                     if fid == f.fragment_id]
+            fid = f.fragment_id
+            with self._recovery_lock:
+                n_tasks = sum(1 for pf, _, _ in self._placements
+                              if pf == fid)
             out_kind, out_ch = f.output_partitioning
             lines.append(
-                f"Fragment {f.fragment_id} [{f.partitioning}] "
-                f"x{len(tasks)} tasks => output "
+                f"Fragment {fid} [{f.partitioning}] "
+                f"x{n_tasks} tasks => output "
                 f"{out_kind}{list(out_ch) if out_ch else ''}")
             for ln in format_plan(f.root).splitlines():
                 lines.append("    " + ln)
@@ -374,15 +505,9 @@ class QueryExecution:
             # feed drivers append stats in nondeterministic order, so
             # list position is not comparable across tasks
             agg: Dict[str, Dict] = {}
-            peak = 0
             n_reporting = 0
-            for tid, uri in tasks:
-                try:
-                    info = self._fetch_task_info(tid, uri)
-                except Exception:  # noqa: BLE001 - worker may be gone
-                    continue
+            for info in self._task_infos.get(fid, []):
                 stats = info.get("operatorStats") or []
-                peak = max(peak, int(info.get("peakMemory", 0)))
                 if stats:
                     n_reporting += 1
                 for s in stats:
@@ -396,6 +521,9 @@ class QueryExecution:
                         a["input_rows"] += s["input_rows"]
                         a["output_rows"] += s["output_rows"]
                         a["wall_ns"] = max(a["wall_ns"], wall)
+                        a["jit_dispatches"] += s.get("jit_dispatches", 0)
+                        a["jit_compiles"] += s.get("jit_compiles", 0)
+                        a["prereduce_rows"] += s.get("prereduce_rows", 0)
             lines.append("    " + header)
             lines.append("    " + "-" * len(header))
             for a in agg.values():
@@ -403,7 +531,31 @@ class QueryExecution:
                 lines.append(
                     f"    {a['operator']:<36} {n_reporting:>5} "
                     f"{a['input_rows']:>11} {a['output_rows']:>11} "
-                    f"{wall_ms:>9.1f} {peak / (1 << 20):>9.1f}")
+                    f"{wall_ms:>9.1f} {a.get('jit_dispatches', 0):>8} "
+                    f"{a.get('jit_compiles', 0):>8} "
+                    f"{a.get('prereduce_rows', 0):>9}")
+            st = self.stage_stats.get(fid)
+            if st:
+                lines.append(
+                    f"    stage: wall {st['wall_ns'] / 1e6:.1f} ms "
+                    f"(sum {st['total_wall_ns'] / 1e6:.1f}), peak memory "
+                    f"{st['peak_memory_bytes'] / (1 << 20):.1f} MiB, "
+                    f"jit dispatches: {st['jit_dispatches']}, "
+                    f"compiles: {st['jit_compiles']}, "
+                    f"prereduce rows: {st['prereduce_rows']}, "
+                    f"exchange pages "
+                    f"{st['exchange_fetched']}f/"
+                    f"{st['exchange_consumed']}c/"
+                    f"{st['exchange_purged']}p")
+        qs = self.query_stats
+        if qs:
+            lines.append(
+                f"query: peak memory "
+                f"{qs['peak_memory_bytes'] / (1 << 20):.1f} MiB; "
+                f"jit dispatches: {qs['jit_dispatches']}, "
+                f"compiles: {qs['jit_compiles']}; "
+                f"prereduce rows: {qs['prereduce_rows']}; "
+                f"trace token: {self.trace_token}")
         return "\n".join(lines)
 
     def _wait_for_workers(self) -> List[Tuple[str, str]]:
@@ -425,8 +577,10 @@ class QueryExecution:
             time.sleep(0.05)
 
     def _internal_headers(self) -> Dict[str, str]:
-        return (self.co.internal_auth.header()
-                if self.co.internal_auth is not None else {})
+        h = (dict(self.co.internal_auth.header())
+             if self.co.internal_auth is not None else {})
+        h["X-Presto-Trace-Token"] = self.trace_token
+        return h
 
     def _cancel_worker_tasks(self) -> None:
         """DELETE fan-out over every responsive node.  Best-effort, but
@@ -630,6 +784,9 @@ class QueryExecution:
         if not affected or self._dplan is None:
             return
         self.recovery_rounds += 1
+        self.co.event_bus.task_recovery(ev.TaskRecoveryEvent(
+            self.query_id, self.trace_token, dead_uri,
+            tuple(tid for _, tid in affected), ev.now()))
         frag_by_id = {f.fragment_id: f for f in self._dplan.fragments}
         retry_fids = sorted({fid for fid, _ in affected
                              if frag_by_id[fid].consumed_fragments})
@@ -740,6 +897,10 @@ class QueryExecution:
             S.add(f)
             S.update(frag_by_id[f].producer_subtree)
         self.stage_retry_rounds += 1
+        self.co.event_bus.stage_retry(ev.StageRetryEvent(
+            self.query_id, self.trace_token, tuple(sorted(S)),
+            self.stage_retry_rounds, f"lost worker {dead_uri}",
+            ev.now()))
 
         def charge(fids) -> int:
             worst = 0
@@ -1036,6 +1197,9 @@ class QueryExecution:
         self._speculations[tid] = {
             "fid": fid, "clone": clone_tid, "clone_uri": w,
             "orig_uri": uri, "state": "racing"}
+        self.co.event_bus.speculation(ev.SpeculationEvent(
+            self.query_id, self.trace_token, tid, clone_tid, "cloned",
+            ev.now()))
         self.co.log(f"speculation: straggler {tid} cloned as "
                     f"{clone_tid} on {w}")
 
@@ -1053,6 +1217,7 @@ class QueryExecution:
                 # original finished AND was drained first: clone lost
                 sp["state"] = "lost"
                 self._cancel_tasks([(sp["clone"], sp["clone_uri"])])
+                self._fire_speculation(orig_tid, sp)
                 self.co.log(f"speculation: original {orig_tid} won; "
                             f"cancelled clone {sp['clone']}")
                 continue
@@ -1061,10 +1226,17 @@ class QueryExecution:
                 continue
             if info.get("state") == "FAILED":
                 sp["state"] = "lost"
+                self._fire_speculation(orig_tid, sp)
                 continue
             if info.get("state") != "FINISHED":
                 continue
             self._finish_speculation(orig_tid, sp)
+
+    def _fire_speculation(self, orig_tid: str, sp: Dict) -> None:
+        """One SpeculationEvent per race resolution (won/lost/split)."""
+        self.co.event_bus.speculation(ev.SpeculationEvent(
+            self.query_id, self.trace_token, orig_tid, sp["clone"],
+            sp["state"], ev.now()))
 
     def _finish_speculation(self, orig_tid: str, sp: Dict) -> None:
         spec = self._task_specs[orig_tid]
@@ -1107,11 +1279,13 @@ class QueryExecution:
                 self._task_uris[fid][spec["index"]] = \
                     new_prefix + "{part}"
             self._cancel_tasks([(orig_tid, sp["orig_uri"])])
+            self._fire_speculation(orig_tid, sp)
             self.co.log(f"speculation: clone {sp['clone']} won over "
                         f"straggler {orig_tid}")
         elif repointed == 0:
             sp["state"] = "lost"
             self._cancel_tasks([(sp["clone"], sp["clone_uri"])])
+            self._fire_speculation(orig_tid, sp)
             self.co.log(f"speculation: clone {sp['clone']} lost "
                         f"(original pages already consumed)")
         else:
@@ -1120,6 +1294,7 @@ class QueryExecution:
             # attempt (exact either way); both attempts stay alive until
             # the end-of-query cancel fan-out
             sp["state"] = "split"
+            self._fire_speculation(orig_tid, sp)
             self.co.log(f"speculation: {orig_tid} split across attempts "
                         f"({repointed} repointed, {delivered} kept)")
 
@@ -1129,6 +1304,7 @@ class QueryExecution:
             if sp.get("fid") == fid and sp.get("state") == "racing":
                 sp["state"] = "lost"
                 self._cancel_tasks([(sp["clone"], sp["clone_uri"])])
+                self._fire_speculation(tid, sp)
 
     def _create_remote_task(self, worker_uri: str, task_id: str, frag,
                             scan_shard, remote, n_out, broadcast,
@@ -1151,10 +1327,12 @@ class QueryExecution:
             # them over its base EngineConfig (SET SESSION reaching
             # distributed execution, SystemSessionProperties role)
             "session_properties": self.session_properties,
+            # the query's trace token: the worker stamps it into its
+            # log lines, task errors, and worker->worker fetches
+            "trace_token": self.trace_token,
         }).encode("utf-8")
         headers = {"Content-Type": "application/json"}
-        if self.co.internal_auth is not None:
-            headers.update(self.co.internal_auth.header())
+        headers.update(self._internal_headers())
         self._tasks_scheduled = True
         # budget 0: a single classified attempt — transport failures
         # surface as retryable RemoteRequestError so the scheduler falls
@@ -1163,7 +1341,8 @@ class QueryExecution:
         resp = self.co.http.request(
             f"{worker_uri}/v1/task/{task_id}", method="POST", data=body,
             headers=headers, timeout=30, task_id=task_id,
-            description="task create", max_error_duration_s=0.0)
+            description="task create", max_error_duration_s=0.0,
+            trace_token=self.trace_token)
         info = resp.json()
         if info.get("state") == "FAILED":
             raise RuntimeError(f"task create failed: {info}")
@@ -1424,7 +1603,8 @@ class QueryExecution:
                 resp = self.co.http.request(
                     f"{loc}/{token}", headers=self._internal_headers(),
                     timeout=120, description="result drain",
-                    endpoint=loc, retry_cb=_on_retry)
+                    endpoint=loc, retry_cb=_on_retry,
+                    trace_token=self.trace_token)
             except _DrainRestart:
                 continue
             except RemoteRequestError:
@@ -1455,7 +1635,8 @@ class QueryExecution:
 
     # -- client protocol ------------------------------------------------
     def results_payload(self, base_uri: str) -> Dict:
-        out: Dict = {"id": self.query_id, "stats": {"state": self.state}}
+        out: Dict = {"id": self.query_id, "stats": {"state": self.state},
+                     "traceToken": self.trace_token}
         if self.state == "FAILED":
             out["error"] = {"message": self.error or "query failed"}
             return out
@@ -1545,12 +1726,34 @@ async function refresh() {
 async function showDetail(id) {
   const q = await (await fetch('/v1/query/' + id)).json();
   document.getElementById('dtitle').style.display = '';
+  const qs = q.queryStats || {};
+  const mib = b => ((b || 0) / 1048576).toFixed(1) + ' MiB';
+  let stages = '';
+  for (const [fid, st] of Object.entries(q.stageStats || {})) {
+    stages += 'stage ' + fid + ': tasks=' + st.tasks +
+      ' rows ' + st.input_rows + '->' + st.output_rows +
+      ' wall=' + (st.wall_ns / 1e6).toFixed(1) + 'ms' +
+      ' jit=' + st.jit_dispatches + '/' + st.jit_compiles +
+      ' prereduce=' + st.prereduce_rows +
+      ' peak=' + mib(st.peak_memory_bytes) +
+      ' xchg=' + st.exchange_fetched + 'f/' +
+      st.exchange_consumed + 'c/' + st.exchange_purged + 'p\n';
+  }
+  let spec = (q.speculations || []).map(
+    s => s.task + ' -> ' + s.clone + ' [' + s.state + ']').join(', ');
   // textContent only: SQL/plan/error are untrusted
   document.getElementById('detail').textContent =
     'query: ' + (q.query || '') + '\n' +
     'state: ' + q.state + (q.error ? '\nerror: ' + q.error : '') +
+    '\ntrace token: ' + (q.traceToken || '') +
     '\noutput rows: ' + q.outputRows +
-    '\n\n-- distributed plan --\n' + (q.plan || '(none)');
+    '\npeak memory: ' + mib(qs.peak_memory_bytes) +
+    '  jit dispatches: ' + (qs.jit_dispatches || 0) +
+    '\nstage retry rounds: ' + (q.stageRetryRounds || 0) +
+    '  recovery rounds: ' + (q.recoveryRounds || 0) +
+    '\nspeculations: ' + (spec || '(none)') +
+    '\n\n-- stage stats --\n' + (stages || '(none)\n') +
+    '\n-- distributed plan --\n' + (q.plan || '(none)');
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
@@ -1568,7 +1771,8 @@ class CoordinatorServer:
                  min_workers_wait_s: float = 10.0,
                  http_client=None, fault_injector=None,
                  heartbeat_interval_s: float = 0.5,
-                 heartbeat_max_missed: int = 3):
+                 heartbeat_max_missed: int = 3,
+                 event_log_path: Optional[str] = None):
         from presto_tpu.server.errortracker import RetryingHttpClient
         from presto_tpu.server.security import InternalAuthenticator
         from presto_tpu.session import ResourceGroupManager
@@ -1591,6 +1795,13 @@ class CoordinatorServer:
         self.nodes = NodeManager(max_missed=heartbeat_max_missed,
                                  interval_s=heartbeat_interval_s)
         self.queries: Dict[str, QueryExecution] = {}
+        # mesh-wide event stream (EventListener SPI / QueryMonitor role):
+        # the coordinator fires query lifecycle + fault-tolerance events;
+        # ``event_log_path`` bundles the query.json JSON-lines listener
+        self.event_bus = ev.EventBus()
+        if event_log_path:
+            self.event_bus.register(
+                ev.JsonLinesEventListener(event_log_path))
         self.resource_groups = ResourceGroupManager()
         self.grants = GrantStore()
         self.authenticator = authenticator
@@ -1690,7 +1901,9 @@ class CoordinatorServer:
                         session_properties=_kv_header("X-Presto-Session"),
                         catalog=self.headers.get("X-Presto-Catalog"),
                         prepared=_kv_header(
-                            "X-Presto-Prepared-Statements"))
+                            "X-Presto-Prepared-Statements"),
+                        trace_token=self.headers.get(
+                            "X-Presto-Trace-Token"))
                     co.queries[qid] = q
                     self._json(200, {
                         "id": qid,
@@ -1751,6 +1964,20 @@ class CoordinatorServer:
                     self._json(200, {"coordinator": True,
                                      "nodes": co.nodes.alive_nodes()})
                     return
+                if parts == ["metrics"]:
+                    from presto_tpu.server.metrics import (
+                        coordinator_metrics,
+                    )
+
+                    body = coordinator_metrics(co).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if parts == ["ui"] or parts == [""]:
                     body = _UI_HTML.encode()
                     self.send_response(200)
@@ -1765,7 +1992,17 @@ class CoordinatorServer:
                     self._json(200, [
                         {"queryId": q.query_id, "state": q.state,
                          "user": q.user,
-                         "query": q.sql[:200]}
+                         "query": q.sql[:200],
+                         "traceToken": q.trace_token,
+                         "outputRows": len(q.result_rows),
+                         "wallS": round((q.query_stats or {}).get(
+                             "elapsed_s",
+                             (q.end_time or ev.now()) - q.create_time),
+                             3),
+                         "peakMemoryBytes": (q.query_stats or {}).get(
+                             "peak_memory_bytes", 0),
+                         "stageRetryRounds": q.stage_retry_rounds,
+                         "recoveryRounds": q.recovery_rounds}
                         for q in co.queries.values()])
                     return
                 if parts == ["v1", "tasks"]:
@@ -1793,13 +2030,29 @@ class CoordinatorServer:
                     if q is None:
                         self._json(404, {"error": "no such query"})
                         return
+                    with q._recovery_lock:
+                        speculations = [
+                            {"task": tid, "clone": sp.get("clone"),
+                             "state": sp.get("state")}
+                            for tid, sp in q._speculations.items()]
                     self._json(200, {
                         "queryId": q.query_id, "state": q.state,
                         "user": q.user, "query": q.sql,
                         "error": q.error,
                         "plan": q.plan_text,
                         "columns": q.column_names,
-                        "outputRows": len(q.result_rows)})
+                        "outputRows": len(q.result_rows),
+                        "traceToken": q.trace_token,
+                        # PR 5 recovery machinery, previously visible
+                        # only as test-probed coordinator attributes
+                        "stageRetryRounds": q.stage_retry_rounds,
+                        "recoveryRounds": q.recovery_rounds,
+                        "speculations": speculations,
+                        "stageStats": {str(fid): st for fid, st
+                                       in q.stage_stats.items()},
+                        "taskStats": {str(fid): ts for fid, ts
+                                      in q.task_stats.items()},
+                        "queryStats": q.query_stats})
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
 

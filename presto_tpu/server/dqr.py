@@ -29,7 +29,8 @@ class DistributedQueryRunner:
                  internal_secret: Optional[str] = None,
                  coordinator_injector=None, worker_injectors=None,
                  heartbeat_interval_s: float = 0.5,
-                 heartbeat_max_missed: int = 3):
+                 heartbeat_max_missed: int = 3,
+                 event_log_path: Optional[str] = None):
         # each node builds its own registry, as each reference node loads
         # its own connector instances from catalog config
         # ``coordinator_injector`` fails coordinator-originated requests
@@ -41,7 +42,11 @@ class DistributedQueryRunner:
             internal_secret=internal_secret,
             fault_injector=coordinator_injector,
             heartbeat_interval_s=heartbeat_interval_s,
-            heartbeat_max_missed=heartbeat_max_missed)
+            heartbeat_max_missed=heartbeat_max_missed,
+            event_log_path=event_log_path)
+        # the coordinator's event stream (EventListener SPI): register
+        # listeners here to observe query/retry/speculation events
+        self.event_bus = self.coordinator.event_bus
 
         def cluster_registry() -> ConnectorRegistry:
             # system.runtime.* backed by live coordinator state, fetched
@@ -65,13 +70,26 @@ class DistributedQueryRunner:
                         for nid, uri in info.get("nodes", [])]
 
             def queries_fn():
-                return [(q["queryId"], q["state"], q["query"])
+                # fed live from the coordinator's stats rollup
+                return [(q["queryId"], q["state"], q.get("user"),
+                         q["query"], q.get("outputRows", 0),
+                         q.get("wallS", 0.0),
+                         q.get("peakMemoryBytes", 0),
+                         q.get("stageRetryRounds", 0),
+                         q.get("recoveryRounds", 0),
+                         q.get("traceToken"))
                         for q in fetch("/v1/query")]
 
             def tasks_fn():
-                return [(t["taskId"], t["state"],
-                         t["taskId"].rsplit(".", 2)[0])
-                        for t in fetch("/v1/tasks")]
+                out = []
+                for t in fetch("/v1/tasks"):
+                    ts = t.get("taskStats") or {}
+                    out.append((t["taskId"], t["state"],
+                                t["taskId"].rsplit(".", 2)[0],
+                                ts.get("output_rows", 0),
+                                round(ts.get("wall_ns", 0) / 1e6, 3),
+                                ts.get("peak_memory_bytes", 0)))
+                return out
 
             reg.register("system", SystemConnector(
                 nodes_fn=nodes_fn, queries_fn=queries_fn,

@@ -101,9 +101,11 @@ class RequestErrorTracker:
                  max_error_duration_s: float = 30.0,
                  min_backoff_s: float = 0.05, max_backoff_s: float = 2.0,
                  clock: Callable[[], float] = time.monotonic,
-                 sleeper: Callable[[float], None] = time.sleep):
+                 sleeper: Callable[[float], None] = time.sleep,
+                 trace_token: Optional[str] = None):
         self.endpoint = endpoint
         self.task_id = task_id
+        self.trace_token = trace_token
         self.description = description
         self.max_error_duration_s = max_error_duration_s
         self.min_backoff_s = min_backoff_s
@@ -136,6 +138,10 @@ class RequestErrorTracker:
     def _fail(self, exc: BaseException, retryable: bool,
               elapsed: float) -> "RemoteRequestError":
         who = f" for task {self.task_id}" if self.task_id else ""
+        if self.trace_token:
+            # every mesh-side failure names its query (TraceTokenModule
+            # role): greppable across coordinator + worker logs
+            who += f" [trace:{self.trace_token}]"
         detail = describe_error(exc)
         if retryable:
             msg = (f"{self.description}{who} to {self.endpoint} failed "
@@ -207,11 +213,18 @@ class RetryingHttpClient:
         self.injector = injector          # FaultInjector (client side)
         self.opener = opener
         self._trackers: Dict[Tuple[str, str], RequestErrorTracker] = {}
+        # cumulative node-wide transport counters for the /metrics
+        # plane: requests issued, transient errors retried, failures
+        # raised after classification (budget exhausted vs fatal)
+        self.stats: Dict[str, int] = {
+            "requests": 0, "retries": 0, "budget_exhausted": 0,
+            "fatal": 0}
 
     def new_tracker(self, endpoint: str, *,
                     task_id: Optional[str] = None,
                     description: str = "request",
-                    max_error_duration_s: Optional[float] = None
+                    max_error_duration_s: Optional[float] = None,
+                    trace_token: Optional[str] = None
                     ) -> RequestErrorTracker:
         budget = (self.max_error_duration_s if max_error_duration_s
                   is None else max_error_duration_s)
@@ -220,7 +233,8 @@ class RetryingHttpClient:
             max_error_duration_s=budget,
             min_backoff_s=self.min_backoff_s,
             max_backoff_s=self.max_backoff_s,
-            clock=self.clock, sleeper=self.sleeper)
+            clock=self.clock, sleeper=self.sleeper,
+            trace_token=trace_token)
 
     def request_once(self, url: str, *, method: str = "GET",
                      data: Optional[bytes] = None,
@@ -241,6 +255,7 @@ class RetryingHttpClient:
                 description: str = "request",
                 endpoint: Optional[str] = None,
                 max_error_duration_s: Optional[float] = None,
+                trace_token: Optional[str] = None,
                 retry_cb: Optional[Callable[[BaseException],
                                             Optional[str]]] = None
                 ) -> HttpResponse:
@@ -251,7 +266,8 @@ class RetryingHttpClient:
         token-free prefix for paged fetches so the budget spans the
         stream).  ``retry_cb`` runs before each retry; it may raise to
         abort, or return a replacement URL (mid-query task recovery
-        repointing) which also resets the budget.
+        repointing) which also resets the budget.  ``trace_token``
+        stamps any failure message with the owning query's token.
         """
         key = (method, endpoint or url)
         tracker = self._trackers.get(key)
@@ -263,16 +279,27 @@ class RetryingHttpClient:
                 self._trackers.clear()
             tracker = self.new_tracker(
                 endpoint or url, task_id=task_id, description=description,
-                max_error_duration_s=max_error_duration_s)
+                max_error_duration_s=max_error_duration_s,
+                trace_token=trace_token)
             self._trackers[key] = tracker
-        elif max_error_duration_s is not None:
-            tracker.max_error_duration_s = max_error_duration_s
+        else:
+            if max_error_duration_s is not None:
+                tracker.max_error_duration_s = max_error_duration_s
+            if trace_token is not None:
+                tracker.trace_token = trace_token
+        self.stats["requests"] += 1
         while True:
             try:
                 resp = self.request_once(url, method=method, data=data,
                                          headers=headers, timeout=timeout)
             except Exception as e:  # noqa: BLE001 - classified below
-                tracker.failed(e)   # raises when fatal/budget exhausted
+                try:
+                    tracker.failed(e)   # raises when fatal/budget gone
+                except RemoteRequestError as rre:
+                    self.stats["budget_exhausted" if rre.retryable
+                               else "fatal"] += 1
+                    raise
+                self.stats["retries"] += 1
                 if retry_cb is not None:
                     moved = retry_cb(e)
                     if moved:

@@ -205,7 +205,8 @@ class HttpPageClient(threading.Thread):
     def __init__(self, base_url: str, client: "ExchangeClient",
                  headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
-                 task_id: Optional[str] = None):
+                 task_id: Optional[str] = None,
+                 trace_token: Optional[str] = None):
         super().__init__(daemon=True)
         self.base_url = base_url.rstrip("/")
         self.client = client
@@ -216,9 +217,11 @@ class HttpPageClient(threading.Thread):
         self.headers = dict(headers or {})
         self.http = http or RetryingHttpClient()
         self.task_id = task_id
+        self.trace_token = trace_token
         self._lock = threading.Lock()
         self._tracker = self.http.new_tracker(
-            self.base_url, task_id=task_id, description="exchange fetch")
+            self.base_url, task_id=task_id, description="exchange fetch",
+            trace_token=trace_token)
 
     def run(self) -> None:
         try:
@@ -279,7 +282,8 @@ class ExchangeClient:
                  max_buffered_bytes: int = 64 << 20,
                  headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
-                 task_id: Optional[str] = None):
+                 task_id: Optional[str] = None,
+                 trace_token: Optional[str] = None):
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         # signaled on page arrival / stream completion / error so an
@@ -297,6 +301,7 @@ class ExchangeClient:
         self._closed = False
         self._error: Optional[Exception] = None
         self.task_id = task_id
+        self.trace_token = trace_token
         self._headers = headers
         self._http = http
         # per-source-url dedup counters: 'fetched' pages buffered here,
@@ -307,7 +312,8 @@ class ExchangeClient:
         # consumed > 0 — a repoint is refused ('delivered') otherwise.
         self.source_stats: Dict[str, Dict[str, int]] = {}
         self._clients = [HttpPageClient(loc, self, headers=headers,
-                                        http=http, task_id=task_id)
+                                        http=http, task_id=task_id,
+                                        trace_token=trace_token)
                          for loc in locations]
         self._remaining = len(self._clients)
         for c in self._clients:
@@ -387,7 +393,8 @@ class ExchangeClient:
                     repl = HttpPageClient(new_url, self,
                                           headers=self._headers,
                                           http=self._http,
-                                          task_id=self.task_id)
+                                          task_id=self.task_id,
+                                          trace_token=self.trace_token)
                     self._clients[self._clients.index(c)] = repl
                     self._remaining += 1
                     repl.start()
@@ -426,9 +433,11 @@ class ExchangeClient:
         if isinstance(e, RemoteRequestError):
             self.on_error(e)   # tracker already attached the context
             return
-        who = f"task {self.task_id}: " if self.task_id else ""
+        who = f"task {self.task_id}" if self.task_id else "exchange"
+        if self.trace_token:
+            who += f" [trace:{self.trace_token}]"
         self.on_error(RuntimeError(
-            f"{who}exchange fetch from {source.base_url} failed: {e}"))
+            f"{who}: exchange fetch from {source.base_url} failed: {e}"))
 
     def on_client_finished(self) -> None:
         with self._lock:
@@ -534,11 +543,13 @@ class ExchangeOperatorFactory(OperatorFactory):
     def __init__(self, locations: Sequence[str],
                  headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
-                 task_id: Optional[str] = None):
+                 task_id: Optional[str] = None,
+                 trace_token: Optional[str] = None):
         self.locations = list(locations)
         self.headers = headers
         self.http = http
         self.task_id = task_id
+        self.trace_token = trace_token
         self._client: Optional[ExchangeClient] = None
 
     def repoint(self, old_prefix: str, new_prefix: str) -> str:
@@ -565,7 +576,8 @@ class ExchangeOperatorFactory(OperatorFactory):
             self._client = ExchangeClient(self.locations,
                                           headers=self.headers,
                                           http=self.http,
-                                          task_id=self.task_id)
+                                          task_id=self.task_id,
+                                          trace_token=self.trace_token)
         return ExchangeOperator(ctx, self._client)
 
 
@@ -582,10 +594,12 @@ class MergeExchangeOperator(Operator):
                  sort_keys, types, limit: Optional[int] = None,
                  batch_rows: int = 8192, headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
-                 task_id: Optional[str] = None):
+                 task_id: Optional[str] = None,
+                 trace_token: Optional[str] = None):
         super().__init__(ctx)
         self.clients = [ExchangeClient([loc], headers=headers,
-                                       http=http, task_id=task_id)
+                                       http=http, task_id=task_id,
+                                       trace_token=trace_token)
                         for loc in locations]
         self.sort_keys = list(sort_keys)   # (channel, ascending, nulls_first)
         self.types = list(types)
@@ -710,7 +724,8 @@ class MergeExchangeOperatorFactory(OperatorFactory):
                  limit: Optional[int] = None,
                  headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
-                 task_id: Optional[str] = None):
+                 task_id: Optional[str] = None,
+                 trace_token: Optional[str] = None):
         self.locations = list(locations)
         self.sort_keys = list(sort_keys)
         self.types = list(types)
@@ -718,6 +733,7 @@ class MergeExchangeOperatorFactory(OperatorFactory):
         self.headers = headers
         self.http = http
         self.task_id = task_id
+        self.trace_token = trace_token
         self._live_clients: List[ExchangeClient] = []
 
     def repoint(self, old_prefix: str, new_prefix: str) -> str:
@@ -755,6 +771,7 @@ class MergeExchangeOperatorFactory(OperatorFactory):
         op = MergeExchangeOperator(ctx, self.locations, self.sort_keys,
                                    self.types, self.limit,
                                    headers=self.headers, http=self.http,
-                                   task_id=self.task_id)
+                                   task_id=self.task_id,
+                                   trace_token=self.trace_token)
         self._live_clients.extend(op.clients)
         return op

@@ -1,0 +1,173 @@
+"""Prometheus-text ``/metrics`` plane for coordinator and workers.
+
+The reference exposes JMX beans scraped via jmx_exporter / the
+``system.jmx`` catalog; here the same operational surface renders
+directly in the Prometheus text exposition format (version 0.0.4) so a
+scrape target needs nothing but HTTP GET /metrics:
+
+- coordinator: query-state counts, whole-stage retry / leaf recovery /
+  speculation counters (the PR 5 fault-tolerance machinery, previously
+  test-private attributes), cluster memory, kernel caches, node counts;
+- worker: task-state counts, memory reserved/peak, output pages,
+  exchange dedup page counters (fetched/consumed/purged), jit
+  dispatch/compile counters, kernel caches.
+
+Families are built as plain (name, type, help, samples) tuples so the
+renderer stays dependency-free and the builders are unit-testable
+without HTTP.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+#: one family: (name, 'gauge'|'counter', help, [(labels, value), ...])
+Family = Tuple[str, str, str, List[Tuple[Dict[str, str], float]]]
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(families: Sequence[Family]) -> str:
+    lines: List[str] = []
+    for name, mtype, help_, samples in families:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            if labels:
+                lab = ",".join(f'{k}="{_escape(v)}"'
+                               for k, v in sorted(labels.items()))
+                lines.append(f"{name}{{{lab}}} {_fmt(value)}")
+            else:
+                lines.append(f"{name} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def _http_client_family(prefix: str, http) -> Family:
+    stats = getattr(http, "stats", None) or {}
+    return (f"{prefix}_http_client_total", "counter",
+            "error-tracked transport requests by disposition "
+            "(retries = transient errors retried with backoff; "
+            "budget_exhausted/fatal = RemoteRequestError raised)",
+            [({"kind": k}, v) for k, v in sorted(stats.items())])
+
+
+def _kernel_cache_families(prefix: str) -> List[Family]:
+    from presto_tpu.kernelcache import cache_stats
+
+    stats = cache_stats()
+    fams: List[Family] = []
+    for key in ("size", "hits", "misses", "evictions"):
+        fams.append((
+            f"{prefix}_kernel_cache_{key}",
+            "gauge" if key == "size" else "counter",
+            f"compiled-kernel cache {key} per named cache",
+            [({"cache": name}, s.get(key, 0))
+             for name, s in sorted(stats.items())]))
+    return fams
+
+
+def coordinator_metrics(co) -> str:
+    """Render the coordinator's /metrics payload from live state."""
+    by_state: Dict[str, int] = {}
+    retry_rounds = 0
+    recovery_rounds = 0
+    spec_outcomes: Dict[str, int] = {}
+    for q in list(co.queries.values()):
+        by_state[q.state] = by_state.get(q.state, 0) + 1
+        retry_rounds += q.stage_retry_rounds
+        recovery_rounds += q.recovery_rounds
+        for sp in list(getattr(q, "_speculations", {}).values()):
+            state = sp.get("state", "racing")
+            spec_outcomes[state] = spec_outcomes.get(state, 0) + 1
+    mem_infos = list(co.memory_info.values())   # snapshot vs heartbeat
+    mem_reserved = sum(int(i.get("reserved", 0)) for i in mem_infos)
+    mem_peak = sum(int(i.get("peak", 0)) for i in mem_infos)
+    fams: List[Family] = [
+        ("presto_queries", "gauge",
+         "queries known to this coordinator by state",
+         [({"state": s}, n) for s, n in sorted(by_state.items())]),
+        ("presto_stage_retry_rounds_total", "counter",
+         "whole-stage retry rounds across all queries",
+         [({}, retry_rounds)]),
+        ("presto_task_recovery_rounds_total", "counter",
+         "leaf task recovery rounds across all queries",
+         [({}, recovery_rounds)]),
+        ("presto_speculation_total", "counter",
+         "speculative straggler clones by race outcome",
+         [({"outcome": o}, n) for o, n in sorted(spec_outcomes.items())]
+         or [({"outcome": "racing"}, 0)]),
+        ("presto_cluster_nodes", "gauge",
+         "workers by scheduling eligibility",
+         [({"state": "active"}, len(co.nodes.alive_nodes())),
+          ({"state": "responsive"}, len(co.nodes.responsive_nodes()))]),
+        ("presto_cluster_memory_bytes", "gauge",
+         "sum of worker-reported reservation bytes",
+         [({"kind": "reserved"}, mem_reserved),
+          ({"kind": "peak"}, mem_peak)]),
+        _http_client_family("presto", co.http),
+    ]
+    fams.extend(_kernel_cache_families("presto"))
+    return prometheus_text(fams)
+
+
+def worker_metrics(worker) -> str:
+    """Render one worker's /metrics payload from its task manager."""
+    tm = worker.task_manager
+    with tm._lock:
+        tasks = list(tm.tasks.values())
+    by_state: Dict[str, int] = {}
+    pages = 0
+    exchange = {"fetched": 0, "consumed": 0, "purged": 0}
+    jit = {"dispatches": 0, "compiles": 0}
+    prereduce = 0
+    reserved = 0
+    peak = 0
+    for t in tasks:
+        by_state[t.state] = by_state.get(t.state, 0) + 1
+        # one source of truth for per-task counters: the same TaskStats
+        # rollup the coordinator aggregates (server/task.py)
+        ts = t.task_stats()
+        pages += ts["pages_enqueued"]
+        for k in exchange:
+            exchange[k] += ts[f"exchange_{k}"]
+        jit["dispatches"] += ts["jit_dispatches"]
+        jit["compiles"] += ts["jit_compiles"]
+        prereduce += ts["prereduce_rows"]
+        mi = t.memory_info()
+        reserved += mi["reserved"]
+        peak = max(peak, mi["peak"])
+    fams: List[Family] = [
+        ("presto_worker_tasks", "gauge", "tasks on this worker by state",
+         [({"state": s}, n) for s, n in sorted(by_state.items())]),
+        ("presto_worker_memory_bytes", "gauge",
+         "task memory on this worker",
+         [({"kind": "reserved"}, reserved),
+          ({"kind": "peak_task"}, peak)]),
+        ("presto_worker_output_pages_total", "counter",
+         "pages enqueued into output buffers", [({}, pages)]),
+        ("presto_worker_exchange_pages_total", "counter",
+         "exchange pages by attempt-dedup disposition",
+         [({"kind": k}, v) for k, v in sorted(exchange.items())]),
+        ("presto_worker_jit_total", "counter",
+         "jitted-program launches and kernel-cache-miss compiles",
+         [({"kind": k}, v) for k, v in sorted(jit.items())]),
+        ("presto_worker_prereduce_rows_total", "counter",
+         "rows folded by in-segment partial-aggregation pre-reduce",
+         [({}, prereduce)]),
+        ("presto_worker_draining", "gauge",
+         "1 while the worker is shutting down gracefully",
+         [({}, 1 if worker.draining else 0)]),
+        _http_client_family("presto_worker", worker.http),
+    ]
+    fams.extend(_kernel_cache_families("presto_worker"))
+    return prometheus_text(fams)

@@ -12,7 +12,9 @@ Task states mirror TaskState.java: RUNNING -> FINISHED | FAILED | CANCELED.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 import traceback
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -27,6 +29,11 @@ from presto_tpu.server.exchangeop import (
 from presto_tpu.server.fragmenter import PlanFragment
 from presto_tpu.sql.physical import PhysicalPlanner
 
+#: worker-side task lifecycle log; every line names the query's trace
+#: token so any mesh-side event is greppable back to its query
+#: (airlift TraceTokenModule role)
+log = logging.getLogger("presto_tpu.worker")
+
 
 class SqlTask:
     def __init__(self, task_id: str, fragment: PlanFragment,
@@ -36,11 +43,14 @@ class SqlTask:
                  registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT,
                  fetch_headers: Optional[Dict[str, str]] = None,
-                 http_client=None):
+                 http_client=None, trace_token: str = ""):
         self.task_id = task_id
         self.fragment = fragment
+        self.trace_token = trace_token
         self.state = "RUNNING"
         self.error: Optional[str] = None
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
         self.buffers = OutputBufferManager(
             n_output_partitions, broadcast=broadcast_output)
         self._stats: Optional[TaskContext] = None
@@ -50,6 +60,11 @@ class SqlTask:
         # (mid-query task recovery) whether or not fetching has started
         self.exchange_sources: List = []
 
+        # worker->worker exchange fetches carry the query's trace token
+        # alongside the intra-cluster auth header
+        fetch_headers = dict(fetch_headers or {})
+        if trace_token:
+            fetch_headers["X-Presto-Trace-Token"] = trace_token
         planner = PhysicalPlanner(registry, config,
                                   scan_shard=scan_shard,
                                   remote_sources=remote_sources,
@@ -57,7 +72,8 @@ class SqlTask:
                                   http_client=http_client,
                                   task_id=task_id,
                                   exchange_register=(
-                                      self.exchange_sources.append))
+                                      self.exchange_sources.append),
+                                  trace_token=trace_token or None)
         kind, channels = fragment.output_partitioning
         if kind == "hash" and n_output_partitions > 1:
             sink = PartitionedOutputOperatorFactory(
@@ -80,14 +96,24 @@ class SqlTask:
         def observe(task_ctx):
             self._live = task_ctx
 
+        trace = f" [trace:{self.trace_token}]" if self.trace_token else ""
+        log.info("task %s%s started", self.task_id, trace)
         try:
             self._stats = execute_pipelines(self._pipelines,
                                             on_task_context=observe)
             self.state = "FINISHED"
+            log.info("task %s%s finished", self.task_id, trace)
         except Exception as e:  # noqa: BLE001 - task failure surface
-            self.error = f"{e}\n{traceback.format_exc()}"
+            # the trace token rides the stored error AND the buffer
+            # failure, so a consumer-side 500 body and the client-facing
+            # query error both name the query
+            self.error = f"{e}{trace}\n{traceback.format_exc()}"
             self.state = "FAILED"
-            self.buffers.fail(RuntimeError(f"task {self.task_id}: {e}"))
+            log.warning("task %s%s failed: %s", self.task_id, trace, e)
+            self.buffers.fail(RuntimeError(
+                f"task {self.task_id}{trace}: {e}"))
+        finally:
+            self.end_time = time.time()
 
     def info(self) -> Dict:
         """TaskInfo with the per-operator stats rollup the coordinator's
@@ -104,6 +130,7 @@ class SqlTask:
                 exchange_stats.update(source.source_stats())
         return {"taskId": self.task_id, "state": self.state,
                 "error": self.error, "operatorStats": stats,
+                "traceToken": self.trace_token,
                 "jitCounters": (ctx.jit_counters() if ctx is not None
                                 else {"dispatches": 0, "compiles": 0}),
                 "kernelCaches": cache_stats(),
@@ -115,7 +142,37 @@ class SqlTask:
                             and (self.buffers.is_drained()
                                  or self.buffers.is_fully_served())),
                 "exchangeSources": exchange_stats,
+                # the TaskStats rollup the coordinator aggregates into
+                # StageStats/QueryStats (distributed EXPLAIN ANALYZE,
+                # /v1/query detail, events, system.runtime), plus the
+                # per-pipeline DriverStats level below it
+                "taskStats": self.task_stats(),
+                "driverStats": ([d.as_dict() for d in ctx.driver_stats]
+                                if ctx is not None else []),
                 "peakMemory": ctx.memory.peak if ctx is not None else 0}
+
+    def task_stats(self) -> Dict:
+        """TaskStats rollup as a JSON-ready dict: operator sums from the
+        TaskContext plus the exchange/buffer counters this task owns."""
+        from presto_tpu.exec.context import TaskStats
+
+        ctx = self._stats or self._live
+        ts = ctx.task_stats() if ctx is not None else TaskStats()
+        ts.task_id = self.task_id
+        ts.state = self.state
+        ts.start_time = self.start_time
+        end = self.end_time if self.end_time is not None else time.time()
+        ts.end_time = end
+        ts.elapsed_s = max(end - self.start_time, 0.0)
+        ts.pages_enqueued = self.buffers.pages_enqueued
+        for source in self.exchange_sources:
+            if not hasattr(source, "source_stats"):
+                continue
+            for s in source.source_stats().values():
+                ts.exchange_fetched += s.get("fetched", 0)
+                ts.exchange_consumed += s.get("consumed", 0)
+                ts.exchange_purged += s.get("purged", 0)
+        return ts.as_dict()
 
     def memory_info(self) -> Dict:
         """Live reservation/peak bytes (MemoryPool per-task view)."""
@@ -194,7 +251,8 @@ class SqlTaskManager:
                     remote_sources: Dict[int, List[str]],
                     n_output_partitions: int,
                     broadcast_output: bool,
-                    session_properties: Optional[Dict[str, str]] = None
+                    session_properties: Optional[Dict[str, str]] = None,
+                    trace_token: str = ""
                     ) -> SqlTask:
         config = self.config
         if session_properties:
@@ -213,7 +271,8 @@ class SqlTaskManager:
                            n_output_partitions, broadcast_output,
                            self.registry, config,
                            fetch_headers=self.fetch_headers,
-                           http_client=self.http_client)
+                           http_client=self.http_client,
+                           trace_token=trace_token)
             self.tasks[task_id] = task
             return task
 

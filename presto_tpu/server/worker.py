@@ -122,6 +122,21 @@ class WorkerServer:
                         "state": ("SHUTTING_DOWN" if worker.draining
                                   else "ACTIVE")})
                     return
+                if parts == ["metrics"]:
+                    # Prometheus text plane (server/metrics.py); open
+                    # like /v1/info — it exposes counters, never SQL,
+                    # plans, or rows
+                    from presto_tpu.server.metrics import worker_metrics
+
+                    body = worker_metrics(worker).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if parts == ["v1", "memory"]:
                     if not self._internal_ok(["v1", "task"]):
                         return
@@ -224,6 +239,12 @@ class WorkerServer:
                         broadcast = bool(req["broadcast_output"])
                         session_props = dict(
                             req.get("session_properties") or {})
+                        # query trace token: body field, with the header
+                        # as fallback (TraceTokenModule role)
+                        trace_token = str(
+                            req.get("trace_token")
+                            or self.headers.get("X-Presto-Trace-Token")
+                            or "")
                     except (PlanSerdeError, KeyError, TypeError,
                             AttributeError, ValueError) as e:
                         self._json(400, {"error": f"bad task update: {e}"})
@@ -236,7 +257,8 @@ class WorkerServer:
                             remote_sources=remote_sources,
                             n_output_partitions=n_out,
                             broadcast_output=broadcast,
-                            session_properties=session_props)
+                            session_properties=session_props,
+                            trace_token=trace_token)
                     except Exception as e:  # noqa: BLE001 - bad props
                         self._json(400, {"error": f"bad task update: {e}"})
                         return

@@ -69,7 +69,8 @@ class PhysicalPlanner:
                  remote_sources: Optional[dict] = None,
                  fetch_headers: Optional[dict] = None,
                  http_client=None, task_id: Optional[str] = None,
-                 exchange_register=None):
+                 exchange_register=None,
+                 trace_token: Optional[str] = None):
         """``scan_shard=(task_index, task_count)`` makes scans generate only
         this task's deterministic share of splits (distributed source
         stages, P5); ``remote_sources`` maps fragment id -> producer buffer
@@ -87,6 +88,7 @@ class PhysicalPlanner:
         self.fetch_headers = fetch_headers or {}
         self.http_client = http_client
         self.task_id = task_id
+        self.trace_token = trace_token
         self.exchange_register = exchange_register
         self._done_pipelines: List[Pipeline] = []
         self._counter = 0
@@ -160,7 +162,8 @@ class PhysicalPlanner:
                 locations.extend(self.remote_sources.get(fid, ()))
             fac = ExchangeOperatorFactory(
                 locations, headers=self.fetch_headers,
-                http=self.http_client, task_id=self.task_id)
+                http=self.http_client, task_id=self.task_id,
+                trace_token=self.trace_token)
             if self.exchange_register is not None:
                 self.exchange_register(fac)
             return ([fac], [])
@@ -176,7 +179,7 @@ class PhysicalPlanner:
                 locations, node.sort_keys,
                 [t for _, t in node.columns], node.limit,
                 headers=self.fetch_headers, http=self.http_client,
-                task_id=self.task_id)
+                task_id=self.task_id, trace_token=self.trace_token)
             if self.exchange_register is not None:
                 self.exchange_register(fac)
             return ([fac], [])

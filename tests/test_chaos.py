@@ -361,7 +361,16 @@ def test_speculative_clone_beats_straggler_first_finisher_wins():
                 scale=0.01, n_workers=2, config=cfg,
                 worker_injectors={0: inj},
                 heartbeat_interval_s=0.05) as dqr:
+            from presto_tpu.events import EventListener
+
+            class SpecRecorder(EventListener):
+                events = []
+
+                def speculation(self, e):
+                    self.events.append(e)
+
             co = dqr.coordinator
+            dqr.event_bus.register(SpecRecorder())
             _wait_nodes(co, 2)
             res = {}
 
@@ -400,6 +409,13 @@ def test_speculative_clone_beats_straggler_first_finisher_wins():
             assert clone.endswith("a1")
             assert any(tid == clone for _, tid, _ in q._placements)
             _assert_attempt_dedup(q)
+            # the event stream saw the clone spawn AND the race resolve,
+            # stamped with the query's trace token (observability PR)
+            outcomes = [e.outcome for e in SpecRecorder.events]
+            assert "cloned" in outcomes and "won" in outcomes, outcomes
+            assert all(e.trace_token == q.trace_token
+                       for e in SpecRecorder.events)
+            assert SpecRecorder.events[0].clone_id == clone
     finally:
         inj.release_all()
 

@@ -1,5 +1,14 @@
-"""EventListener SPI / QueryMonitor (SURVEY §5.5)."""
+"""EventListener SPI / QueryMonitor (SURVEY §5.5) — local tier, the
+distributed event stream (coordinator EventBus, query.json listener,
+retry/speculation events), and trace-token propagation."""
 
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from presto_tpu.config import DEFAULT
 from presto_tpu.events import EventListener
 from presto_tpu.localrunner import LocalQueryRunner
 
@@ -8,12 +17,24 @@ class Recorder(EventListener):
     def __init__(self):
         self.created = []
         self.completed = []
+        self.stage_retries = []
+        self.recoveries = []
+        self.speculations = []
 
     def query_created(self, e):
         self.created.append(e)
 
     def query_completed(self, e):
         self.completed.append(e)
+
+    def stage_retry(self, e):
+        self.stage_retries.append(e)
+
+    def task_recovery(self, e):
+        self.recoveries.append(e)
+
+    def speculation(self, e):
+        self.speculations.append(e)
 
 
 def test_events_fire_on_success():
@@ -50,3 +71,266 @@ def test_broken_listener_never_fails_query():
     r = LocalQueryRunner.tpch(scale=0.001)
     r.event_bus.register(Broken())
     assert r.execute("select 1").rows == [(1,)]
+
+
+def test_local_events_carry_trace_token_and_stage_stats():
+    """The local tier reports its one task as one stage, so local and
+    distributed QueryCompletedEvents share a shape."""
+    r = LocalQueryRunner.tpch(scale=0.001)
+    rec = Recorder()
+    r.event_bus.register(rec)
+    r.execute("select count(*) from nation")
+    created, done = rec.created[0], rec.completed[0]
+    assert created.trace_token.startswith("tt-")
+    assert done.trace_token == created.trace_token
+    assert len(done.stage_stats) == 1
+    st = done.stage_stats[0]
+    assert st["tasks"] == 1 and st["input_rows"] > 0
+    assert st["wall_ns"] > 0
+    # the DriverStats level below TaskStats was recorded per pipeline
+    assert r._last_task.driver_stats
+    assert all(d.operators >= 1 for d in r._last_task.driver_stats)
+
+
+# ---------------------------------------------------------------------------
+# distributed event stream
+# ---------------------------------------------------------------------------
+
+def _wait_nodes(co, n, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if len(co.nodes.alive_nodes()) == n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"cluster never reached {n} nodes")
+
+
+def test_distributed_events_fire_from_dqr_run():
+    """QueryCreated/QueryCompleted fire on the coordinator's EventBus
+    for a 2-worker DQR run, with matching trace tokens and the
+    stage-stats rollup aggregated from real remote task info."""
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    rec = Recorder()
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2) as dqr:
+        dqr.event_bus.register(rec)
+        rows = dqr.execute(
+            "select l_returnflag, count(*) from lineitem "
+            "group by l_returnflag").rows
+        assert len(rows) == 3
+    assert rec.created and rec.completed
+    done = rec.completed[0]
+    assert done.state == "FINISHED" and done.error is None
+    assert done.trace_token == rec.created[0].trace_token
+    assert done.trace_token.startswith("tt-")
+    assert done.output_rows == 3
+    # rollup from REAL remote tasks: the leaf stage scanned lineitem
+    # across 2 workers, the single stage merged it
+    assert len(done.stage_stats) >= 2
+    leaf = done.stage_stats[0]
+    assert leaf["tasks"] == 2 and leaf["reporting"] == 2
+    assert leaf["input_rows"] > 0 and leaf["wall_ns"] > 0
+    assert done.peak_memory_bytes > 0
+
+
+def test_distributed_events_fire_on_worker_failure():
+    """A failed distributed query still completes the event stream:
+    state FAILED, the error carries the trace token."""
+    from presto_tpu.client import QueryFailed
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    rec = Recorder()
+    with DistributedQueryRunner.tpch(scale=0.001, n_workers=2) as dqr:
+        dqr.event_bus.register(rec)
+        with pytest.raises(QueryFailed):
+            # the cast fails batch-side, i.e. on a worker task
+            dqr.execute("select cast(n_name as bigint) from nation")
+    done = [e for e in rec.completed if e.state == "FAILED"]
+    assert done
+    assert done[0].error and done[0].trace_token.startswith("tt-")
+
+
+def test_trace_token_in_worker_error_surfaced_to_client(caplog):
+    """Trace-token propagation (TraceTokenModule role): a worker-side
+    task failure surfaces to the statement-protocol client stamped with
+    the query's trace token, the same token is on the coordinator's
+    query object and detail payload, and worker task-lifecycle log
+    lines carry it."""
+    import json
+    import logging
+    import urllib.request
+
+    from presto_tpu.client import QueryFailed
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    caplog.set_level(logging.INFO, logger="presto_tpu.worker")
+    with DistributedQueryRunner.tpch(scale=0.001, n_workers=2) as dqr:
+        with pytest.raises(QueryFailed) as exc_info:
+            # the cast fails batch-side, i.e. on a worker task
+            dqr.execute("select cast(n_name as bigint) from nation")
+        q = list(dqr.coordinator.queries.values())[0]
+        assert q.trace_token.startswith("tt-")
+        # the worker stamped the token into the task error, which rode
+        # the 500 body -> drain failure -> client-facing message
+        assert f"[trace:{q.trace_token}]" in str(exc_info.value)
+        with urllib.request.urlopen(
+                f"{dqr.coordinator.uri}/v1/query/{q.query_id}",
+                timeout=10) as resp:
+            detail = json.loads(resp.read())
+        assert detail["traceToken"] == q.trace_token
+        assert f"[trace:{q.trace_token}]" in (detail["error"] or "")
+        # worker task-lifecycle log lines are stamped with the token
+        worker_lines = [r.getMessage() for r in caplog.records
+                        if r.name == "presto_tpu.worker"]
+        assert any(f"[trace:{q.trace_token}]" in ln
+                   for ln in worker_lines), worker_lines
+
+
+def test_client_supplied_trace_token_is_honored():
+    """X-Presto-Trace-Token on POST /v1/statement wins over the
+    generated token (the airlift behavior: use the caller's token when
+    present so cross-system traces correlate)."""
+    import json
+    import urllib.request
+
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    with DistributedQueryRunner.tpch(scale=0.001, n_workers=2) as dqr:
+        req = urllib.request.Request(
+            f"{dqr.coordinator.uri}/v1/statement",
+            data=b"select 1", method="POST",
+            headers={"X-Presto-Trace-Token": "caller-token-42"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            qid = json.loads(resp.read())["id"]
+        q = dqr.coordinator.queries[qid]
+        assert q.trace_token == "caller-token-42"
+        q.rows_done.wait(timeout=30)
+
+
+@pytest.mark.chaos
+def test_stage_retry_event_in_query_json_and_metrics():
+    """The acceptance pin: a chaos run (non-leaf worker kill) produces
+    a query.json event log containing a StageRetryEvent whose trace
+    token matches the query's, and /metrics on the coordinator reports
+    the retry counter."""
+    import urllib.request
+
+    from presto_tpu.events import read_event_log
+    from presto_tpu.server.dqr import DistributedQueryRunner
+    from presto_tpu.server.faults import FaultInjector
+
+    cfg = dataclasses.replace(DEFAULT, task_recovery_interval_s=0.05)
+    inj = FaultInjector()
+    inj.add_rule(r"/results/", method="GET", policy="drop-connection")
+    import tempfile
+
+    log_path = tempfile.mktemp(suffix="-query.json")
+    rec = Recorder()
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            worker_injectors={1: inj},
+            heartbeat_interval_s=0.05, heartbeat_max_missed=2,
+            event_log_path=log_path) as dqr:
+        co = dqr.coordinator
+        dqr.event_bus.register(rec)
+        _wait_nodes(co, 2)
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = dqr.execute(
+                    "select n_name, count(*) from nation join region "
+                    "on n_regionkey = r_regionkey group by n_name").rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        # wait until a NON-leaf task lands on the victim, then kill it
+        victim_uri = dqr.workers[1].uri
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            qs = list(co.queries.values())
+            if qs and qs[0]._dplan is not None and any(
+                    u == victim_uri
+                    and qs[0]._dplan.fragments[f].consumed_fragments
+                    for f, _, u in qs[0]._placements):
+                break
+            time.sleep(0.02)
+        dqr.kill_worker(1)
+        q = list(co.queries.values())[0]
+        t.join(timeout=120)
+        assert not t.is_alive() and "err" not in res, res
+        assert q.stage_retry_rounds >= 1
+        # in-process listener saw the retry with the query's token
+        assert rec.stage_retries
+        assert rec.stage_retries[0].trace_token == q.trace_token
+        assert rec.stage_retries[0].fragment_ids
+        # the query.json log has the same event, replayable
+        events = read_event_log(log_path)
+        retries = [e for e in events if e["event"] == "StageRetryEvent"]
+        assert retries, [e["event"] for e in events]
+        assert retries[0]["trace_token"] == q.trace_token
+        assert retries[0]["query_id"] == q.query_id
+        done = [e for e in events
+                if e["event"] == "QueryCompletedEvent"]
+        assert done and done[0]["trace_token"] == q.trace_token
+        # /metrics reports the retry counter (Prometheus text plane)
+        with urllib.request.urlopen(f"{co.uri}/metrics",
+                                    timeout=5) as resp:
+            metrics = resp.read().decode()
+        line = next(ln for ln in metrics.splitlines()
+                    if ln.startswith("presto_stage_retry_rounds_total "))
+        assert float(line.split()[-1]) >= 1
+        assert "presto_queries" in metrics
+    import os
+
+    os.remove(log_path)
+
+
+def test_worker_metrics_endpoint():
+    """Worker /metrics: task states, exchange page counters, memory."""
+    import urllib.request
+
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2) as dqr:
+        assert dqr.execute(
+            "select count(*) from lineitem").rows == [(59785,)]
+        texts = []
+        for w in dqr.workers:
+            with urllib.request.urlopen(f"{w.uri}/metrics",
+                                        timeout=5) as resp:
+                assert resp.status == 200
+                assert "text/plain" in resp.headers["Content-Type"]
+                texts.append(resp.read().decode())
+    joined = "\n".join(texts)
+    assert 'presto_worker_tasks{state="FINISHED"}' in joined
+    assert "presto_worker_output_pages_total" in joined
+    # the single-stage consumer fetched real exchange pages
+    import re
+
+    consumed = [
+        float(m.group(1)) for m in re.finditer(
+            r'presto_worker_exchange_pages_total\{kind="consumed"\} '
+            r'([0-9.]+)', joined)]
+    assert sum(consumed) > 0, joined
+    assert "presto_worker_jit_total" in joined
+
+
+def test_json_lines_listener_swallows_bad_path():
+    """An unwritable event log must never fail a query (observers are
+    isolated, the EventBus contract)."""
+    from presto_tpu.events import (
+        JsonLinesEventListener, QueryCreatedEvent,
+    )
+
+    r = LocalQueryRunner.tpch(scale=0.001)
+    r.event_bus.register(
+        JsonLinesEventListener("/nonexistent-dir/query.json"))
+    assert r.execute("select 1").rows == [(1,)]
+    # direct call also swallows nothing — the bus does the isolation;
+    # the listener itself raises
+    lst = JsonLinesEventListener("/nonexistent-dir/query.json")
+    with pytest.raises(OSError):
+        lst.query_created(QueryCreatedEvent("q", "u", "s", 0.0))

@@ -130,3 +130,35 @@ class TestFusionReport:
         assert "fused segments" in out
         assert "parity=True" in out
         assert "FusedSegment{" in out
+
+
+class TestQueryProfile:
+    def test_live_profile_check_mode(self, capsys, tmp_path):
+        """tools/query_profile.py --check: runs a statement on a real
+        2-worker DQR and renders the per-stage stats table + task span
+        timeline from the coordinator's rollup."""
+        import importlib
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        query_profile = importlib.import_module("query_profile")
+        log = str(tmp_path / "query.json")
+        rc = query_profile.main(
+            ["--scale", "0.002", "--check", "--event-log", log])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "task span timeline" in out
+        assert "profile rollup complete" in out
+        assert "trace=tt-" in out
+        # stage table rendered both fragments with real rows
+        assert "xchg f/c/p" in out
+
+        # replay mode renders the log the live run just wrote
+        rc = query_profile.main(["--replay", log])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "QueryCreatedEvent" in out
+        assert "QueryCompletedEvent" in out
+        assert "stage stats for" in out

@@ -65,6 +65,9 @@ def main() -> int:
     ap.add_argument("--check", action="store_true",
                     help="run the chaos pytest tier headless; exit "
                          "nonzero on any inexact result")
+    ap.add_argument("--event-log", default="query.json",
+                    help="write the coordinator's query.json event "
+                         "log here (JSON lines; '' disables)")
     args = ap.parse_args()
     if args.check:
         return run_check()
@@ -89,11 +92,14 @@ def main() -> int:
     report = {"query": args.query, "workers": args.workers,
               "scale": args.scale, "killed_worker": victim_idx}
     t0 = time.monotonic()
+    if args.event_log and os.path.exists(args.event_log):
+        os.remove(args.event_log)
     with DistributedQueryRunner.tpch(
             scale=args.scale, n_workers=args.workers, config=cfg,
             worker_injectors={victim_idx: inj},
             heartbeat_interval_s=0.05,
-            heartbeat_max_missed=2) as dqr:
+            heartbeat_max_missed=2,
+            event_log_path=args.event_log or None) as dqr:
         co = dqr.coordinator
         while len(co.nodes.alive_nodes()) != args.workers:
             time.sleep(0.02)
@@ -125,6 +131,22 @@ def main() -> int:
         report["wall_s"] = round(time.monotonic() - t0, 3)
         report["mode"] = args.mode
         report["stage_retry_rounds"] = q.stage_retry_rounds
+        report["trace_token"] = q.trace_token
+        # the /metrics plane must agree with the coordinator's counters
+        # (the Prometheus scrape an operator would alert on)
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(f"{co.uri}/metrics",
+                                        timeout=5) as resp:
+                metrics = resp.read().decode()
+            line = next(
+                (ln for ln in metrics.splitlines()
+                 if ln.startswith("presto_stage_retry_rounds_total ")),
+                "presto_stage_retry_rounds_total 0")
+            report["metrics_stage_retry_rounds"] = float(line.split()[-1])
+        except Exception as e:  # noqa: BLE001 - report must still emit
+            report["metrics_stage_retry_rounds"] = f"error: {e}"
         report["recovered_placements"] = [
             (fid, tid, uri) for fid, tid, uri in q._placements]
         if t.is_alive():
@@ -142,6 +164,19 @@ def main() -> int:
             report["reason"] = "placements still on the dead worker"
         else:
             report["ok"] = True
+    if args.event_log:
+        # summarize the event log: the StageRetryEvent (stage mode) and
+        # the completion event land here with the query's trace token
+        from presto_tpu.events import read_event_log
+
+        try:
+            events = read_event_log(args.event_log)
+        except Exception:  # noqa: BLE001 - log may be disabled
+            events = []
+        report["event_log"] = args.event_log
+        report["events"] = sorted({e["event"] for e in events})
+        report["stage_retry_events"] = sum(
+            1 for e in events if e["event"] == "StageRetryEvent")
     print(json.dumps(report, indent=2))
     return 0 if report["ok"] else 1
 

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Per-query profile: stage span timeline + stats rollup table.
+
+Two modes, one report shape:
+
+- **live**: boot an in-process DistributedQueryRunner, execute one
+  statement through the real statement protocol, and render the
+  coordinator's StageStats/TaskStats rollup — per-stage stats table and
+  a per-task span timeline (when each task ran relative to the query's
+  wall clock);
+- **replay** (``--replay query.json``): read a JsonLinesEventListener
+  log (events.py, the bundled query.json role) and render each query's
+  event timeline + the stage-stats table carried on its
+  QueryCompletedEvent.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/query_profile.py \
+        --sql "select count(*) from lineitem" --workers 2
+    JAX_PLATFORMS=cpu python tools/query_profile.py --replay query.json
+    JAX_PLATFORMS=cpu python tools/query_profile.py --check   # CI smoke
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+TIMELINE_WIDTH = 40
+
+
+def _fmt_bytes(b) -> str:
+    return f"{(b or 0) / (1 << 20):.1f}MiB"
+
+
+def stage_table(stage_stats) -> list:
+    """Render {fid: StageStats dict} as aligned text lines."""
+    header = (f"{'stage':>5} {'tasks':>5} {'rep':>4} {'in rows':>11} "
+              f"{'out rows':>11} {'wall ms':>9} {'jit':>9} "
+              f"{'prereduce':>9} {'peak':>9} {'xchg f/c/p':>14}")
+    lines = [header, "-" * len(header)]
+    for fid in sorted(stage_stats, key=lambda k: int(k)):
+        st = stage_stats[fid]
+        jit = f"{st['jit_dispatches']}/{st['jit_compiles']}"
+        xchg = (f"{st['exchange_fetched']}/{st['exchange_consumed']}/"
+                f"{st['exchange_purged']}")
+        lines.append(
+            f"{fid:>5} {st['tasks']:>5} {st['reporting']:>4} "
+            f"{st['input_rows']:>11} {st['output_rows']:>11} "
+            f"{st['wall_ns'] / 1e6:>9.1f} {jit:>9} "
+            f"{st['prereduce_rows']:>9} "
+            f"{_fmt_bytes(st['peak_memory_bytes']):>9} {xchg:>14}")
+    return lines
+
+
+def span_timeline(task_stats, width: int = TIMELINE_WIDTH) -> list:
+    """ASCII span per task: position/extent of [start_time, end_time]
+    within the query's [min start, max end] window."""
+    spans = []
+    for fid in sorted(task_stats, key=lambda k: int(k)):
+        for ts in task_stats[fid]:
+            if ts.get("start_time"):
+                spans.append((fid, ts))
+    if not spans:
+        return ["(no task spans reported)"]
+    t0 = min(ts["start_time"] for _, ts in spans)
+    t1 = max(ts.get("end_time") or ts["start_time"] for _, ts in spans)
+    total = max(t1 - t0, 1e-6)
+    lines = [f"task span timeline ({total * 1000:.1f} ms total)"]
+    for fid, ts in spans:
+        lo = int((ts["start_time"] - t0) / total * width)
+        hi = int(((ts.get("end_time") or t1) - t0) / total * width)
+        hi = max(hi, lo + 1)
+        bar = " " * lo + "=" * (hi - lo) + " " * (width - hi)
+        lines.append(
+            f"  F{fid} {ts.get('task_id', '?'):<28} |{bar}| "
+            f"{ts.get('elapsed_s', 0) * 1000:>8.1f} ms "
+            f"{ts.get('output_rows', 0):>9} rows")
+    return lines
+
+
+def profile_live(args) -> int:
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    boot = (DistributedQueryRunner.tpcds if args.catalog == "tpcds"
+            else DistributedQueryRunner.tpch)
+    with boot(scale=args.scale, n_workers=args.workers,
+              event_log_path=args.event_log) as dqr:
+        res = dqr.execute(args.sql)
+        q = list(dqr.coordinator.queries.values())[-1]
+        print(f"query {q.query_id} [{q.state}] trace={q.trace_token}")
+        print(f"sql: {args.sql}")
+        print(f"rows: {len(res.rows)}")
+        qs = q.query_stats or {}
+        print(f"elapsed: {qs.get('elapsed_s', 0):.3f}s  "
+              f"peak memory: {_fmt_bytes(qs.get('peak_memory_bytes'))}  "
+              f"jit: {qs.get('jit_dispatches', 0)} dispatches / "
+              f"{qs.get('jit_compiles', 0)} compiles  "
+              f"retries: {q.stage_retry_rounds} stage / "
+              f"{q.recovery_rounds} leaf")
+        print()
+        for line in stage_table(q.stage_stats):
+            print(line)
+        print()
+        for line in span_timeline(q.task_stats):
+            print(line)
+        if args.check:
+            ok = (q.state == "FINISHED" and q.stage_stats
+                  and all(st["reporting"] >= 1
+                          for st in q.stage_stats.values())
+                  and any(st["input_rows"] > 0
+                          for st in q.stage_stats.values())
+                  and any(ts.get("elapsed_s", 0) > 0
+                          for tss in q.task_stats.values()
+                          for ts in tss))
+            print(f"\ncheck: profile rollup "
+                  f"{'complete' if ok else 'INCOMPLETE'}")
+            return 0 if ok else 1
+    return 0
+
+
+def profile_replay(args) -> int:
+    from presto_tpu.events import read_event_log
+
+    events = read_event_log(args.replay)
+    if not events:
+        print("empty event log")
+        return 1
+    t0 = min(e.get("create_time") or e.get("time") or 0 for e in events)
+    for e in events:
+        at = (e.get("time") or e.get("end_time") or
+              e.get("create_time") or t0) - t0
+        kind = e["event"]
+        extra = ""
+        if kind == "QueryCreatedEvent":
+            extra = f"sql={e.get('sql', '')[:60]!r}"
+        elif kind == "QueryCompletedEvent":
+            extra = (f"state={e.get('state')} rows={e.get('output_rows')} "
+                     f"wall={e.get('end_time', 0) - e.get('create_time', 0):.3f}s")
+        elif kind == "StageRetryEvent":
+            extra = (f"fragments={e.get('fragment_ids')} "
+                     f"round={e.get('round')} reason={e.get('reason')!r}")
+        elif kind == "TaskRecoveryEvent":
+            extra = f"dead={e.get('dead_uri')} tasks={e.get('task_ids')}"
+        elif kind == "SpeculationEvent":
+            extra = (f"{e.get('task_id')} -> {e.get('clone_id')} "
+                     f"[{e.get('outcome')}]")
+        print(f"+{at:8.3f}s {kind:<22} query={e.get('query_id')} "
+              f"trace={e.get('trace_token')} {extra}")
+    for e in events:
+        if e["event"] == "QueryCompletedEvent" and e.get("stage_stats"):
+            print(f"\nstage stats for {e['query_id']}:")
+            for line in stage_table(
+                    {str(st["fragment_id"]): st
+                     for st in e["stage_stats"]}):
+                print(line)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sql", default="select l_returnflag, count(*), "
+                    "sum(l_extendedprice) from lineitem "
+                    "group by l_returnflag")
+    ap.add_argument("--catalog", choices=["tpch", "tpcds"],
+                    default="tpch")
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--event-log", default=None,
+                    help="also write a query.json event log here")
+    ap.add_argument("--replay", default=None,
+                    help="render a query.json event log instead of "
+                         "running a statement")
+    ap.add_argument("--check", action="store_true",
+                    help="CI smoke: exit nonzero unless every stage "
+                         "reported stats and spans")
+    args = ap.parse_args(argv)
+    if args.replay:
+        return profile_replay(args)
+    return profile_live(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
