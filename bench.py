@@ -990,17 +990,15 @@ def bench_mesh_q1q6(scale: float):
     }
 
 
-def bench_tpcds_mesh_q72q95(scale: float):
-    """TPC-DS Q72 + Q95 — the BASELINE.md multi-chip configs — through
-    the DISTRIBUTED tier: a real 2-worker cluster with HTTP exchanges,
-    parity-checked against the single-process engine on identical data
-    (ROADMAP #3: the multi-chip proof beyond TPC-H, measured)."""
+def _bench_tpcds_mesh(scale: float, spooling: bool):
+    import dataclasses as _dc
     import sys as _sys
 
     _sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "tests"))
     from tpcds_queries import QUERIES as DS
 
+    from presto_tpu.config import DEFAULT
     from presto_tpu.connectors.api import ConnectorRegistry
     from presto_tpu.connectors.tpcds import TpcdsConnector
     from presto_tpu.localrunner import LocalQueryRunner
@@ -1017,8 +1015,10 @@ def bench_tpcds_mesh_q72q95(scale: float):
         return sorted(tuple(round(v, 4) if isinstance(v, float) else v
                             for v in r) for r in rows)
 
+    cfg = _dc.replace(DEFAULT, exchange_spooling_enabled=spooling)
     out = {}
-    with DistributedQueryRunner.tpcds(scale=scale, n_workers=2) as dqr:
+    with DistributedQueryRunner.tpcds(scale=scale, n_workers=2,
+                                      config=cfg) as dqr:
         for qn in (72, 95):
             t0 = time.perf_counter()
             want = local.execute(DS[qn]).rows
@@ -1029,17 +1029,38 @@ def bench_tpcds_mesh_q72q95(scale: float):
             out[qn] = {"mesh_s": round(t_mesh, 3),
                        "local_s": round(t_local, 3),
                        "parity": norm(got) == norm(want)}
+    suffix = "_spooled" if spooling else ""
     return {
-        "metric": f"tpcds_sf{scale:g}_q72q95_mesh_2worker_fact_rows_per_sec",
+        "metric": f"tpcds_sf{scale:g}_q72q95_mesh_2worker"
+                  f"{suffix}_fact_rows_per_sec",
         "value": round(n_rows / (out[72]["mesh_s"] + out[95]["mesh_s"]),
                        1),
         "unit": "rows/s", "vs_baseline": round(
             (out[72]["local_s"] + out[95]["local_s"])
             / (out[72]["mesh_s"] + out[95]["mesh_s"]), 3),
         "engine_path": True, "distributed": True, "workers": 2,
+        "exchange_spooling": spooling,
         "q72": out[72], "q95": out[95],
         "parity": out[72]["parity"] and out[95]["parity"],
     }
+
+
+def bench_tpcds_mesh_q72q95(scale: float):
+    """TPC-DS Q72 + Q95 — the BASELINE.md multi-chip configs — through
+    the DISTRIBUTED tier: a real 2-worker cluster with HTTP exchanges,
+    parity-checked against the single-process engine on identical data
+    (ROADMAP #3: the multi-chip proof beyond TPC-H, measured).
+    Exchange spooling OFF: this row keeps measuring the PR 5-era
+    in-memory data plane, so its trend stays comparable."""
+    return _bench_tpcds_mesh(scale, spooling=False)
+
+
+def bench_tpcds_mesh_q72q95_spooled(scale: float):
+    """The same mesh configs with the spooled exchange ON (write-through
+    to the local-FS spool store): the delta against
+    ``bench_tpcds_mesh_q72q95`` IS the spooling overhead, tracked as a
+    number per round."""
+    return _bench_tpcds_mesh(scale, spooling=True)
 
 
 def bench_sqlite_baseline(scale: float):
@@ -1207,6 +1228,7 @@ def main() -> None:
                 (bench_engine_q1q6, 0.05, 0.0),
                 (bench_mesh_q1q6, 0.05, 0.0),
                 (bench_tpcds_mesh_q72q95, 0.003, 0.0),
+                (bench_tpcds_mesh_q72q95_spooled, 0.003, 0.0),
                 (bench_sqlite_baseline, 0.05, 0.0)]
         _emit(_run_jobs(headline, jobs, budget_s))
         return
@@ -1227,6 +1249,7 @@ def main() -> None:
             (bench_engine_q1q6, 1.0, 0.0),
             (bench_mesh_q1q6, 0.2, 0.0),
             (bench_tpcds_mesh_q72q95, 0.003, 0.0),
+            (bench_tpcds_mesh_q72q95_spooled, 0.003, 0.0),
             (bench_whole_query_q3, 0.1, 0.0),
             (bench_sqlite_baseline, 0.2, 0.0),
             (bench_q3, 10.0, 0.65),

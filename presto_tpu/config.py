@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import tempfile
 
 import jax
 
@@ -278,6 +279,32 @@ class EngineConfig:
     speculation_quantile: float = 0.5
     speculation_lag_factor: float = 4.0
     speculation_min_runtime_s: float = 1.0
+    # --- spooled exchange (server/spool.py, SURVEY §2.8 Presto-on-Spark
+    # / Tardigrade stance) ------------------------------------------------
+    # Write exchange output through to a shared spool store as pages are
+    # enqueued, making every producer stream durably re-pullable: stage
+    # retry repoints consumers at the spool instead of re-running the
+    # producer subtree, non-leaf stages may speculate (clones read their
+    # producers from the spool), and workers can drain out of a running
+    # query.  OFF restores the PR 5 cascading retry exactly.
+    exchange_spooling_enabled: bool = True
+    # shared spool root (every node of a cluster must see the same
+    # storage; the local-FS tier assumes one host or shared mounts)
+    exchange_spool_path: str = os.environ.get(
+        "PRESTO_TPU_EXCHANGE_SPOOL",
+        os.path.join(tempfile.gettempdir(), "presto_tpu_exchange"))
+    # output-buffer memory ceiling per task; with spooling on, acked or
+    # spooled pages are EVICTED from memory (re-served from the spool on
+    # a late re-fetch) instead of blocking the producer
+    exchange_max_buffer_bytes: int = 256 << 20
+    # a spool stream with no new pages and no COMPLETE marker for this
+    # long is declared stalled (the producer died without a failure
+    # channel through the spool); consumers raise instead of hanging
+    exchange_spool_stall_s: float = 60.0
+    # coordinator-start orphan sweep: spool query dirs older than this
+    # are removed (crashed-coordinator leftovers); the age guard keeps a
+    # shared spool root safe across concurrent clusters
+    exchange_spool_orphan_age_s: float = 3600.0
 
 
 DEFAULT = EngineConfig()

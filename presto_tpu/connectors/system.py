@@ -92,7 +92,12 @@ class SystemConnector(_VirtualConnector):
             ("peak_memory_bytes", T.BIGINT),
             ("stage_retry_rounds", T.BIGINT),
             ("recovery_rounds", T.BIGINT),
-            ("trace_token", T.VARCHAR)], queries_fn)
+            ("trace_token", T.VARCHAR),
+            # spooled exchange (server/spool.py): pages written through
+            # to the spool, and producer tasks re-executed by stage
+            # retry (0 with spooling on — the cascade-free guarantee)
+            ("spooled_pages", T.BIGINT),
+            ("producer_reruns", T.BIGINT)], queries_fn)
         self.add_table("tasks", [
             ("task_id", T.VARCHAR), ("state", T.VARCHAR),
             ("query_id", T.VARCHAR), ("output_rows", T.BIGINT),

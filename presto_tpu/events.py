@@ -79,6 +79,12 @@ class StageRetryEvent:
     round: int                     # worst per-stage round consumed
     reason: str                    # e.g. the dead worker URI
     time: float
+    # tasks re-executed that belong to the lost stage's PRODUCER subtree
+    # (not the lost stage itself, not escalated consumers).  The spooled
+    # exchange's acceptance number: with spooling on this is always 0 —
+    # producers' output is re-pulled from the spool, never re-computed.
+    producer_reruns: int = 0
+    spooled: bool = False          # retry ran through the spool tier
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,6 +95,19 @@ class TaskRecoveryEvent:
     trace_token: str
     dead_uri: str
     task_ids: tuple
+    time: float
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerDrainEvent:
+    """A draining worker's finished tasks were repointed at their
+    spooled output, letting the worker leave the cluster mid-query
+    without failing it (graceful drain, spooled exchange tier)."""
+
+    query_id: str
+    trace_token: str
+    worker_uri: str
+    task_ids: tuple                # tasks moved to spool-read
     time: float
 
 
@@ -124,6 +143,9 @@ class EventListener:
     def task_recovery(self, event: TaskRecoveryEvent) -> None:
         pass
 
+    def worker_drain(self, event: WorkerDrainEvent) -> None:
+        pass
+
     def speculation(self, event: SpeculationEvent) -> None:
         pass
 
@@ -157,6 +179,9 @@ class EventBus:
     def task_recovery(self, event: TaskRecoveryEvent) -> None:
         self._fire("task_recovery", event)
 
+    def worker_drain(self, event: WorkerDrainEvent) -> None:
+        self._fire("worker_drain", event)
+
     def speculation(self, event: SpeculationEvent) -> None:
         self._fire("speculation", event)
 
@@ -187,6 +212,7 @@ class JsonLinesEventListener(EventListener):
     split_completed = _write
     stage_retry = _write
     task_recovery = _write
+    worker_drain = _write
     speculation = _write
 
 

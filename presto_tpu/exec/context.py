@@ -138,6 +138,12 @@ class TaskStats:
     exchange_consumed: int = 0
     exchange_purged: int = 0
     pages_enqueued: int = 0
+    # spooled exchange (server/spool.py): pages written through to the
+    # spool, and pages/bytes evicted from the in-memory buffer under
+    # max_buffer_bytes pressure (re-servable from the spool)
+    pages_spooled: int = 0
+    pages_evicted: int = 0
+    bytes_evicted: int = 0
 
     def add_operator(self, s: OperatorStats) -> None:
         self.wall_ns += s.wall_ns + s.finish_wall_ns
@@ -179,6 +185,9 @@ class StageStats:
     exchange_consumed: int = 0
     exchange_purged: int = 0
     pages_enqueued: int = 0
+    pages_spooled: int = 0
+    pages_evicted: int = 0
+    bytes_evicted: int = 0
 
     def add_task(self, ts: TaskStats) -> None:
         self.reporting += 1
@@ -195,6 +204,9 @@ class StageStats:
         self.exchange_consumed += ts.exchange_consumed
         self.exchange_purged += ts.exchange_purged
         self.pages_enqueued += ts.pages_enqueued
+        self.pages_spooled += ts.pages_spooled
+        self.pages_evicted += ts.pages_evicted
+        self.bytes_evicted += ts.bytes_evicted
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -217,6 +229,8 @@ class QueryStats:
     exchange_fetched: int = 0
     exchange_consumed: int = 0
     exchange_purged: int = 0
+    pages_spooled: int = 0
+    pages_evicted: int = 0
     stages: int = 0
 
     def add_stage(self, st: StageStats) -> None:
@@ -232,6 +246,8 @@ class QueryStats:
         self.exchange_fetched += st.exchange_fetched
         self.exchange_consumed += st.exchange_consumed
         self.exchange_purged += st.exchange_purged
+        self.pages_spooled += st.pages_spooled
+        self.pages_evicted += st.pages_evicted
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)

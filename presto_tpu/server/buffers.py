@@ -10,6 +10,20 @@ consumer partition; pages are wire-serialized Batches (presto_tpu.serde).
 
 Broadcast buffers enqueue every page to every partition (BroadcastOutput
 Buffer.java:51 role).
+
+**Spooled exchange** (server/spool.py, SURVEY §2.8): when a ``SpoolStore``
+is attached, every page is written through to the spool as it is enqueued
+and the COMPLETE marker lands with ``set_no_more_pages`` — output survives
+the task.  Two behaviors change:
+
+- under ``max_buffer_bytes`` pressure the manager EVICTS spooled pages
+  from memory (front of the buffer, acked or not) instead of blocking the
+  producer — ``base`` becomes "lowest token still in memory" and anything
+  below it re-serves from the spool on a late re-fetch (the root-drain
+  DISCARD/re-pull path, or a restarted consumer pulling from token 0);
+- ``spooled_complete()`` reports when the whole output is durable, which
+  is the graceful-drain condition: a worker may exit once its tasks'
+  output is spooled, without waiting for consumers to fetch.
 """
 
 from __future__ import annotations
@@ -25,8 +39,11 @@ class ClientBuffer:
 
     def __init__(self):
         self.pages: List[bytes] = []   # pages[token - base] = wire bytes
-        self.base = 0                  # token of pages[0]
+        self.base = 0                  # token of pages[0]: everything
+        #                                below was acked OR evicted (and
+        #                                is then re-servable from spool)
         self.no_more_pages = False
+        self.spooled_to = 0            # tokens < this are in the spool
 
     @property
     def end_token(self) -> int:
@@ -38,11 +55,16 @@ class OutputBufferManager:
     — number of partitions, broadcast or not — is set at task create)."""
 
     def __init__(self, n_partitions: int, broadcast: bool = False,
-                 max_buffer_bytes: int = 256 << 20):
+                 max_buffer_bytes: int = 256 << 20,
+                 spool=None, task_id: str = ""):
         self.broadcast = broadcast
         self.buffers: Dict[int, ClientBuffer] = {
             i: ClientBuffer() for i in range(n_partitions)}
         self.max_buffer_bytes = max_buffer_bytes
+        # write-through spool tier (server/spool.py); None = PR 5
+        # in-memory-only buffers, restored exactly
+        self.spool = spool
+        self.task_id = task_id
         self._bytes = 0
         self._lock = threading.Condition()
         self._failed: Optional[Exception] = None
@@ -50,6 +72,10 @@ class OutputBufferManager:
         # reported in task info so the coordinator's straggler detector
         # can rank per-stage task progress from status polls
         self.pages_enqueued = 0
+        # spool/eviction observability (rolled into TaskStats)
+        self.pages_spooled = 0
+        self.pages_evicted = 0
+        self.bytes_evicted = 0
         # partitions whose final page was served with complete=true: the
         # consumer stops fetching at that point, so the implicit
         # token-ack for the last page never arrives — this marker is how
@@ -60,26 +86,64 @@ class OutputBufferManager:
     def enqueue(self, partition: int, page: bytes) -> None:
         with self._lock:
             # backpressure: block the producing driver while full
-            # (OutputBufferMemoryManager role)
+            # (OutputBufferMemoryManager role).  With a spool attached,
+            # evict spooled pages from memory first — the producer only
+            # blocks when nothing is evictable (nothing spooled yet).
             while (self._bytes + len(page) > self.max_buffer_bytes
                    and not self._failed):
+                if self.spool is not None and self._evict_locked(
+                        len(page)):
+                    continue
                 self._lock.wait(timeout=1.0)
             if self._failed:
                 raise self._failed
-            if self.broadcast:
-                for buf in self.buffers.values():
-                    buf.pages.append(page)
-                    self._bytes += len(page)
-            else:
-                self.buffers[partition].pages.append(page)
+            targets = (list(self.buffers.items()) if self.broadcast
+                       else [(partition, self.buffers[partition])])
+            for p, buf in targets:
+                token = buf.end_token
+                buf.pages.append(page)
                 self._bytes += len(page)
+                if self.spool is not None:
+                    # write-through: the page is durable the moment it
+                    # is enqueued (local-FS tier; an object-store tier
+                    # would batch, same contract)
+                    self.spool.write_page(self.task_id, p, token, page)
+                    buf.spooled_to = token + 1
+                    self.pages_spooled += 1
             self.pages_enqueued += 1
             self._lock.notify_all()
 
+    def _evict_locked(self, need: int) -> bool:
+        """Drop spooled pages from the front of the fullest buffers until
+        ``need`` more bytes fit.  True if anything was evicted."""
+        evicted = False
+        while self._bytes + need > self.max_buffer_bytes:
+            victim = None
+            for buf in self.buffers.values():
+                if buf.pages and buf.base < buf.spooled_to and (
+                        victim is None
+                        or len(buf.pages) > len(victim.pages)):
+                    victim = buf
+            if victim is None:
+                return evicted
+            page = victim.pages.pop(0)
+            victim.base += 1
+            self._bytes -= len(page)
+            self.pages_evicted += 1
+            self.bytes_evicted += len(page)
+            evicted = True
+        return evicted
+
     def set_no_more_pages(self) -> None:
         with self._lock:
-            for buf in self.buffers.values():
+            for i, buf in self.buffers.items():
                 buf.no_more_pages = True
+                if self.spool is not None:
+                    # stream terminator + completeness proof: the
+                    # coordinator repoints consumers at the spool only
+                    # when every partition carries this marker
+                    self.spool.set_complete(self.task_id, i,
+                                            buf.end_token)
             self._lock.notify_all()
 
     def is_drained(self) -> bool:
@@ -89,6 +153,18 @@ class OutputBufferManager:
             if self._failed is not None:
                 return True
             return all(not buf.pages for buf in self.buffers.values())
+
+    def spooled_complete(self) -> bool:
+        """True when the task's ENTIRE output is durable in the spool
+        (terminated streams, every page written through) — the spooled
+        graceful-drain condition: consumers can re-pull from the spool,
+        so the worker need not wait for them."""
+        with self._lock:
+            if self.spool is None or self._failed is not None:
+                return False
+            return all(buf.no_more_pages
+                       and buf.spooled_to >= buf.end_token
+                       for buf in self.buffers.values())
 
     def is_fully_served(self) -> bool:
         """True when every partition's stream was served to its end
@@ -122,7 +198,9 @@ class OutputBufferManager:
                   wait_s: float = 0.0) -> Tuple[List[bytes], int, bool]:
         """Returns (pages from ``token``, next token, complete).  Acks (and
         frees) everything below ``token``.  Blocks up to ``wait_s`` when
-        nothing is available yet (long-poll)."""
+        nothing is available yet (long-poll).  A request below ``base``
+        (acked or evicted from memory) re-serves from the spool when one
+        is attached — the late re-fetch path."""
         deadline = None
         with self._lock:
             if self._failed:
@@ -137,6 +215,13 @@ class OutputBufferManager:
                 buf.base += drop
                 self._lock.notify_all()
             while True:
+                if token < buf.base and self.spool is not None:
+                    out, next_token, complete = self.spool.get_pages(
+                        self.task_id, partition, token,
+                        max_bytes=max_bytes)
+                    if complete:
+                        self._served_complete.add(partition)
+                    return out, next_token, complete
                 start = token - buf.base
                 avail = buf.pages[start:] if 0 <= start <= len(buf.pages) \
                     else []

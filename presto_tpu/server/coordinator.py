@@ -121,6 +121,14 @@ class NodeManager:
             return {uri for nid, uri in self.nodes.items()
                     if self.missed.get(nid, 0) >= self.max_missed}
 
+    def draining_uris(self) -> set:
+        """Responsive workers advertising SHUTTING_DOWN — the set the
+        graceful-drain tick hands over to the spool."""
+        with self._lock:
+            return {uri for nid, uri in self.nodes.items()
+                    if self.missed.get(nid, 0) < self.max_missed
+                    and self.states.get(nid) == "SHUTTING_DOWN"}
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             with self._lock:
@@ -151,6 +159,12 @@ class _DrainRestart(Exception):
     """Internal drain control flow: a whole-stage restart superseded the
     location being pulled; abandon the in-flight request and re-enter
     the drain loop (which consumes the restart marker)."""
+
+
+class _SpoolUnavailable(Exception):
+    """Spool verification failed (missing object / read error): the
+    spooled recovery path cannot proceed; fall back to PR 5 cascading
+    retry."""
 
 
 class QueryExecution:
@@ -217,6 +231,24 @@ class QueryExecution:
         # from token 0 (unlike _relocations, which only follow at token 0)
         self._restarts: Dict[str, str] = {}
         self._root_orig: Dict[str, str] = {}     # orig loc -> current loc
+        # -- spooled exchange state (server/spool.py) ----------------------
+        # root-drain moves to the SAME attempt's spooled output: original
+        # location -> spool:// location.  Unlike _relocations/_restarts
+        # these resume at the CURRENT token with rows kept — the spool
+        # serves the identical stream
+        self._spool_moves: Dict[str, str] = {}
+        # workers whose tasks were fully handed to the spool by the
+        # graceful-drain tick (one WorkerDrainEvent each)
+        self._drained_uris: set = set()
+        # FAILED-on-live-worker tasks already restarted from the spool,
+        # and the ones seen failed once (restart needs two consecutive
+        # scans, so a racing worker-death is detected/recovered first)
+        self._failed_handled: set = set()
+        self._failed_seen: set = set()
+        self._failed_scan_at = 0.0
+        # producer-subtree tasks re-executed by stage retry; the spooled
+        # exchange's headline: 0 with spooling on
+        self.producer_reruns_total = 0
         # straggler tid -> {'fid','clone','clone_uri','orig_uri','state'}
         self._speculations: Dict[str, Dict] = {}
         self._task_seen: Dict[str, Dict] = {}    # tid -> progress polls
@@ -382,6 +414,15 @@ class QueryExecution:
             self._monitor_stop.set()
             if self._tasks_scheduled:
                 self._cancel_worker_tasks()
+            # spool GC: this query's pages are dead weight the moment
+            # the drain settled (completion, failure, and cancel alike);
+            # leftovers from unreachable workers fall to the
+            # coordinator-start orphan sweep
+            if self._tasks_scheduled and self.co.spool is not None:
+                try:
+                    self.co.spool.delete_query(self.query_id)
+                except Exception:  # noqa: BLE001 - GC is best-effort
+                    pass
 
     @staticmethod
     def _format_dplan(dplan: DistributedPlan) -> str:
@@ -724,6 +765,10 @@ class QueryExecution:
             args=(max(cfg.task_recovery_interval_s, 0.05),),
             daemon=True, name=f"recovery-{self.query_id}").start()
 
+    def _spool_enabled(self) -> bool:
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        return cfg.exchange_spooling_enabled and self.co.spool is not None
+
     def _monitor_loop(self, interval_s: float) -> None:
         cfg = getattr(self, "_cfg", None) or self.co.config
         while not self._monitor_stop.wait(interval_s):
@@ -732,6 +777,9 @@ class QueryExecution:
             try:
                 if cfg.task_recovery_enabled:
                     self._recovery_tick()
+                    if self._spool_enabled():
+                        self._drain_worker_tick()
+                        self._failed_task_tick()
                 if cfg.speculative_execution_enabled:
                     self._speculation_tick()
             except Exception as e:  # noqa: BLE001 - fail fast
@@ -770,11 +818,21 @@ class QueryExecution:
     def _recover_worker(self, dead_uri: str) -> None:
         """Reschedule every task this query had on ``dead_uri``.
 
-        Leaf fragments (no remote sources) whose consumers have not yet
-        consumed their pages are re-created in place: the replacement
-        regenerates the same deterministic output from its scan shard.
-        Everything else — non-leaf tasks, and leaf tasks whose consumers
-        already consumed pages — goes through whole-stage retry."""
+        **Spooled exchange** (exchange_spooling_enabled): output buffers
+        survive their task in the spool, so nothing upstream re-runs —
+        a lost task whose output is complete in the spool is replaced by
+        repointing its consumers at the spool (zero re-execution), and a
+        task lost mid-production re-runs ALONE, reading its producers
+        back from the spool.  Spool verification failures fall back to
+        the cascading path below.
+
+        **Cascading** (spooling off, the PR 5 stance): leaf fragments
+        (no remote sources) whose consumers have not yet consumed their
+        pages are re-created in place — the replacement regenerates the
+        same deterministic output from its scan shard.  Everything else
+        — non-leaf tasks, and leaf tasks whose consumers already
+        consumed pages — goes through whole-stage retry of the producer
+        subtree."""
         with self._recovery_lock:
             if dead_uri in self._recovered_uris:
                 return
@@ -787,6 +845,21 @@ class QueryExecution:
         self.co.event_bus.task_recovery(ev.TaskRecoveryEvent(
             self.query_id, self.trace_token, dead_uri,
             tuple(tid for _, tid in affected), ev.now()))
+        if self._spool_enabled():
+            try:
+                self._recover_worker_spooled(dead_uri, affected)
+                return
+            except _SpoolUnavailable as e:
+                # spool verification failed (missing object, read
+                # error): the durable copy cannot be trusted, so fall
+                # back to PR 5 cascading retry — correctness over the
+                # zero-re-run guarantee
+                self.co.log(f"spool recovery for {dead_uri} failed "
+                            f"({e}); falling back to cascading retry")
+        self._recover_worker_cascading(dead_uri, affected)
+
+    def _recover_worker_cascading(self, dead_uri: str,
+                                  affected) -> None:
         frag_by_id = {f.fragment_id: f for f in self._dplan.fragments}
         retry_fids = sorted({fid for fid, _ in affected
                              if frag_by_id[fid].consumed_fragments})
@@ -871,6 +944,406 @@ class QueryExecution:
                 self._retry_stages({cons_fid}, dead_uri)
                 return
 
+    # -- spooled recovery (cascade-free: output outlives the task) ------
+    def _spool_remote(self, spec: Dict) -> Dict[int, List[str]]:
+        """Remote-source templates reading every producer stream from
+        the spool.  Always safe under write-through spooling: a live
+        producer's stream fills progressively, a finished producer's is
+        complete, and an already-acked page is still there — so a fresh
+        attempt can re-pull from token 0 with zero producer re-runs."""
+        from presto_tpu.server.spool import spool_location
+
+        return {pfid: [spool_location(ptid)
+                       for ptid in self._frag_tasks[pfid]]
+                for pfid in spec["remote"]}
+
+    def _spool_complete(self, tid: str, spec: Dict) -> bool:
+        """Completeness proof before any spool repoint; verification
+        errors (injected or real) abort the spooled path."""
+        try:
+            return self.co.spool.is_complete(tid, spec["n_out"])
+        except Exception as e:  # noqa: BLE001 - store-specific errors
+            raise _SpoolUnavailable(f"verifying {tid}: {e}") from e
+
+    def _recover_worker_spooled(self, dead_uri: str, affected) -> None:
+        """Cascade-free recovery: tasks whose output is complete in the
+        spool are 'replaced' by the spool itself (consumers repoint,
+        token preserved, NOTHING re-runs); tasks lost mid-production
+        re-run alone with spool-backed remote sources."""
+        incomplete: List[Tuple[int, str]] = []
+        for fid, tid in affected:
+            spec = self._task_specs[tid]
+            if self._spool_complete(tid, spec):
+                self._repoint_to_spool(fid, tid, dead_uri, spec)
+            else:
+                incomplete.append((fid, tid))
+        if incomplete:
+            self._retry_stages_spooled(incomplete, dead_uri)
+
+    def _repoint_to_spool(self, fid: int, tid: str, old_uri: str,
+                          spec: Dict) -> bool:
+        """Swap a finished task's result location for its spooled
+        output: same attempt, same tokens, different backing store.
+        Consumers resume at their current token — no delivered guard,
+        no restart, no re-execution anywhere.  Returns True when every
+        reachable consumer acknowledged the repoint (the graceful-drain
+        tick only releases the worker then)."""
+        from presto_tpu.server.spool import spool_location, spool_prefix
+
+        old_prefix = f"{old_uri}/v1/task/{tid}/results/"
+        new_prefix = spool_prefix(tid)
+        with self._recovery_lock:
+            self._placements = [
+                (f, t, new_prefix.rstrip("/") if t == tid else u)
+                for f, t, u in self._placements]
+            self._task_uris[fid][spec["index"]] = spool_location(tid)
+        # the task's full output exists: it IS done for straggler
+        # ranking and must never be cloned
+        self._task_seen.setdefault(tid, {})["done_at"] = time.monotonic()
+        cons_fid = self._consumers.get(fid)
+        if cons_fid is None:
+            # root fragment: the coordinator drain follows the move at
+            # its current token (rows kept — same attempt's stream)
+            with self._recovery_lock:
+                old_loc, new_loc = old_prefix + "0", new_prefix + "0"
+                for orig, cur in self._root_orig.items():
+                    if cur == old_loc:
+                        self._root_orig[orig] = new_loc
+                        self._spool_moves[orig] = new_loc
+            self.co.log(f"spool: root task {tid} now drains from spool")
+            return True
+        headers = {"Content-Type": "application/json"}
+        headers.update(self._internal_headers())
+        body = json.dumps({"old_prefix": old_prefix,
+                           "new_prefix": new_prefix,
+                           "spool": True}).encode()
+        # consumers on dead nodes are being recovered themselves; a
+        # DRAINING (alive) old_uri still gets its consumers repointed
+        dead_now = self.co.nodes.dead_uris()
+        with self._recovery_lock:
+            consumers = [(t, u) for f, t, u in self._placements
+                         if f == cons_fid and u not in dead_now
+                         and not u.startswith("spool://")]
+        ok = True
+        for ctid, curi in consumers:
+            try:
+                self.co.http.request(
+                    f"{curi}/v1/task/{ctid}/remote-sources",
+                    method="POST", data=body, headers=headers,
+                    timeout=10, task_id=ctid,
+                    description="spool repoint",
+                    max_error_duration_s=min(
+                        5.0, (getattr(self, "_cfg", None)
+                              or self.co.config)
+                        .remote_request_max_error_duration_s))
+            except Exception as e:  # noqa: BLE001 - consumer may be dead
+                # an unreachable consumer is handled by its own
+                # recovery round (which re-creates it reading from the
+                # spool); nothing to escalate here
+                self.co.log(f"spool repoint of {ctid} on {curi} "
+                            f"failed: {e}")
+                ok = False
+        self.co.log(f"spool: consumers of {tid} repointed at its "
+                    f"spooled output (zero re-runs)")
+        return ok
+
+    def _retry_stages_spooled(self, incomplete, dead_uri: str) -> None:
+        """Re-run ONLY the tasks that died mid-production, each under a
+        fresh attempt id with spool-backed remote sources — the producer
+        subtree is never touched.  A consumer that already consumed the
+        dead attempt's partial output restarts the same way (its own
+        producers come from the spool), cascading up to the root drain's
+        DISCARD/re-pull.  Bounded by stage_retry_limit per stage with
+        the errortracker backoff, exactly like the cascading path."""
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        frags0 = sorted({fid for fid, _ in incomplete})
+        if cfg.stage_retry_limit <= 0:
+            tids = [tid for _, tid in incomplete]
+            raise RuntimeError(
+                f"Worker {dead_uri} died mid-query owning unfinished "
+                f"task(s) {tids} of stage(s) {frags0} and "
+                f"stage_retry_limit=0: whole-stage retry disabled, "
+                f"query is not recoverable")
+        rounds = []
+        for f in frags0:
+            n = self._stage_retries.get(f, 0) + 1
+            if n > cfg.stage_retry_limit:
+                raise RuntimeError(
+                    f"stage {f} of query {self.query_id} exhausted "
+                    f"stage_retry_limit={cfg.stage_retry_limit} after "
+                    f"{n - 1} spooled stage retr"
+                    f"{'y' if n - 1 == 1 else 'ies'}; last trigger: "
+                    f"worker {dead_uri} lost task(s) of stage(s) "
+                    f"{frags0}")
+            self._stage_retries[f] = n
+            rounds.append(n)
+        round_n = max(rounds)
+        self.stage_retry_rounds += 1
+        backoff = RequestErrorTracker(
+            f"stage-retry:{self.query_id}", description="stage retry",
+            min_backoff_s=cfg.remote_request_min_backoff_s,
+            max_backoff_s=cfg.remote_request_max_backoff_s)
+        backoff.error_count = round_n - 1
+        if backoff.backoff_delay() > 0:
+            time.sleep(backoff.backoff_delay())
+        superseded: List[Tuple[str, str]] = []
+        # topological (producer-first) restart order: a consumer's new
+        # attempt must read the spool of its producer's NEW attempt
+        # when both died (fragment ids are assigned producers-first)
+        queue: List[Tuple[int, str]] = sorted(incomplete)
+        restarted: set = set()
+        touched_fids: set = set(frags0)
+        charged: set = set(frags0)
+        # each restart can escalate its consumers; the chain is bounded
+        # by the fragment count (a consumer restarts at most once here —
+        # further rounds come back through _recover_worker)
+        guard = 0
+        while queue:
+            guard += 1
+            if guard > 10 * len(self._dplan.fragments) + 16:
+                raise RuntimeError(
+                    f"spooled stage retry of {frags0} did not converge")
+            fid, old_tid = queue.pop(0)
+            if old_tid in restarted:
+                continue
+            if fid not in charged:
+                # escalated consumer stage: one retry charge per stage
+                # per round, same budget as the cascading path
+                n = self._stage_retries.get(fid, 0) + 1
+                if n > cfg.stage_retry_limit:
+                    raise RuntimeError(
+                        f"stage {fid} of query {self.query_id} "
+                        f"exhausted stage_retry_limit="
+                        f"{cfg.stage_retry_limit} escalating from the "
+                        f"spooled restart of stage(s) {frags0}")
+                self._stage_retries[fid] = n
+                charged.add(fid)
+            restarted.add(old_tid)
+            touched_fids.add(fid)
+            esc = self._restart_task_spooled(fid, old_tid, dead_uri,
+                                             superseded)
+            queue.extend(esc)
+        self._cancel_tasks(superseded)
+        self.co.event_bus.stage_retry(ev.StageRetryEvent(
+            self.query_id, self.trace_token,
+            tuple(sorted(touched_fids)), round_n,
+            f"lost worker {dead_uri}", ev.now(),
+            producer_reruns=0, spooled=True))
+        self.co.log(f"spooled stage retry: re-ran {len(restarted)} "
+                    f"task(s) of stage(s) {sorted(touched_fids)} "
+                    f"(round {round_n}, zero producer re-runs) after "
+                    f"losing {dead_uri}")
+
+    def _restart_task_spooled(self, fid: int, old_tid: str,
+                              dead_uri: str, superseded
+                              ) -> List[Tuple[int, str]]:
+        """One fresh attempt of one task, remote sources on the spool.
+        Returns consumer (fid, tid) pairs that must restart too because
+        they already consumed the superseded attempt's pages."""
+        spec = self._task_specs[old_tid]
+        base = spec["base"]
+        attempt = self._attempts.get(base, 0) + 1
+        new_tid = f"{base}a{attempt}"
+        with self._recovery_lock:
+            old_uri = next(u for _f, t, u in self._placements
+                           if t == old_tid)
+        # genuinely dead nodes are excluded; ``dead_uri`` itself is NOT
+        # singled out — the failed-task tick restarts tasks that failed
+        # on a perfectly healthy worker (their producer died, their
+        # budget drained), and on a 2-node cluster that worker is the
+        # only host left
+        dead = self.co.nodes.dead_uris()
+        workers = [uri for _, uri in self.co.nodes.topology_ordered(
+            self.co.nodes.alive_nodes()) if uri not in dead]
+        if not workers:
+            raise RuntimeError(
+                f"Worker {dead_uri} died mid-query and no surviving "
+                f"worker remains for spooled stage retry")
+        remote = self._spool_remote(spec)
+        last_error = None
+        new_host = None
+        for shift in range(len(workers)):
+            w = workers[(spec["index"] + attempt + shift) % len(workers)]
+            try:
+                self._create_remote_task(
+                    w, new_tid, spec["frag"], spec["scan_shard"],
+                    remote, spec["n_out"], spec["broadcast"],
+                    consumer_index=spec["consumer_index"])
+                new_host = w
+                break
+            except RemoteRequestError as e:
+                if e.retryable:
+                    last_error = e
+                    continue
+                raise
+        if new_host is None:
+            raise RuntimeError(
+                f"no worker accepted spooled stage-retry task "
+                f"{new_tid}: {last_error}")
+        new_spec = dict(spec)
+        new_spec["remote"] = remote
+        new_spec["created_at"] = time.monotonic()
+        self._task_specs[new_tid] = new_spec
+        self._attempts[base] = attempt
+        old_prefix = f"{old_uri}/v1/task/{old_tid}/results/"
+        new_prefix = f"{new_host}/v1/task/{new_tid}/results/"
+        with self._recovery_lock:
+            self._placements = [
+                (f, new_tid if t == old_tid else t,
+                 new_host if t == old_tid else u)
+                for f, t, u in self._placements]
+            self._frag_tasks[fid][spec["index"]] = new_tid
+            self._task_uris[fid][spec["index"]] = new_prefix + "{part}"
+        superseded.append((old_tid, old_uri))
+        self._drop_speculations(fid)
+        # repoint consumers at the new attempt; 'delivered' consumers
+        # restart themselves (their producers read from the spool)
+        esc: List[Tuple[int, str]] = []
+        cons_fid = self._consumers.get(fid)
+        if cons_fid is None:
+            with self._recovery_lock:
+                old_loc, new_loc = old_prefix + "0", new_prefix + "0"
+                for orig, cur in self._root_orig.items():
+                    if cur == old_loc:
+                        self._root_orig[orig] = new_loc
+                        self._restarts[orig] = new_loc
+                        self._spool_moves.pop(orig, None)
+            return esc
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        headers = {"Content-Type": "application/json"}
+        headers.update(self._internal_headers())
+        from presto_tpu.server.spool import spool_prefix
+
+        # a consumer may be fetching the old attempt over HTTP *or*
+        # reading its spool stream (it was itself restarted earlier):
+        # both source shapes must move to the new attempt, or the
+        # spool reader stalls forever on a stream that will never
+        # complete.  Both are attempt changes (delivered guard applies).
+        old_prefixes = [old_prefix, spool_prefix(old_tid)]
+        # skip consumers on GENUINELY dead nodes only (they are being
+        # restarted by this same recovery) — ``dead_uri`` may be a live
+        # worker when the failed-task tick triggered this restart, and
+        # its consumers absolutely need the repoint
+        dead_now = self.co.nodes.dead_uris()
+        with self._recovery_lock:
+            ctasks = [(t, u) for f, t, u in self._placements
+                      if f == cons_fid]
+        for ctid, curi in ctasks:
+            if curi.startswith("spool://"):
+                continue   # already served wholly from the spool
+            if curi in dead_now:
+                continue
+            for old_p in old_prefixes:
+                body = json.dumps({"old_prefix": old_p,
+                                   "new_prefix": new_prefix}).encode()
+                try:
+                    resp = self.co.http.request(
+                        f"{curi}/v1/task/{ctid}/remote-sources",
+                        method="POST", data=body, headers=headers,
+                        timeout=10, task_id=ctid,
+                        description="remote-source repoint",
+                        max_error_duration_s=min(
+                            5.0,
+                            cfg.remote_request_max_error_duration_s))
+                    status = resp.json().get("status")
+                except Exception as e:  # noqa: BLE001 - escalate
+                    self.co.log(f"spooled retry: repoint of {ctid} on "
+                                f"{curi} failed ({e}); restarting it")
+                    status = "delivered"
+                if status == "delivered":
+                    esc.append((cons_fid, ctid))
+                    break
+        return esc
+
+    def _failed_task_tick(self) -> None:
+        """Spool-enabled second line of defense: a task that FAILED on
+        a live worker (e.g. its exchange budget drained against a dead
+        producer before recovery repointed it) is itself restartable —
+        its new attempt reads every producer from the spool.  PR 5 had
+        no answer to consumer-task failure; the spool makes it just
+        another restart.  Scanned at ~1s cadence to keep the status-poll
+        load off the workers."""
+        now = time.monotonic()
+        if now - self._failed_scan_at < 1.0:
+            return
+        self._failed_scan_at = now
+        with self._recovery_lock:
+            placements = list(self._placements)
+        # a worker death explains (and fixes) most consumer failures:
+        # let the dead-worker recovery settle before restarting anyone
+        dead = self.co.nodes.dead_uris()
+        if any(u in dead and u not in self._recovered_uris
+               for _, _, u in placements):
+            return
+        for fid, tid, uri in placements:
+            if uri.startswith("spool://") or tid in self._failed_handled:
+                continue
+            info = self._poll_task(tid, uri)
+            if info is None or info.get("state") != "FAILED":
+                continue
+            # only transport-shaped failures restart (a drained error
+            # budget against a lost producer); genuine application
+            # errors — bad data, resource limits — keep failing fast
+            # with their original message
+            if "exchange" not in (info.get("error") or ""):
+                continue
+            if tid not in self._failed_seen:
+                # confirm across two scans: a failure observed the
+                # instant a worker dies must wait for the failure
+                # detector to catch up, or the restart races onto the
+                # dying node
+                self._failed_seen.add(tid)
+                continue
+            self._failed_handled.add(tid)
+            self.co.log(f"spool: task {tid} FAILED on live worker "
+                        f"{uri}; restarting it from the spool")
+            self._retry_stages_spooled([(fid, tid)], uri)
+
+    def _drain_worker_tick(self) -> None:
+        """Graceful worker drain (the elasticity story): a worker
+        advertising SHUTTING_DOWN finishes its running tasks, their
+        output is already write-through in the spool, and this tick
+        repoints consumers at the spool so the worker can leave the
+        cluster mid-query — no kill, no retry, no re-run."""
+        draining = self.co.nodes.draining_uris()
+        if not draining:
+            return
+        with self._recovery_lock:
+            by_uri: Dict[str, List[Tuple[int, str]]] = {}
+            for fid, tid, uri in self._placements:
+                if uri in draining:
+                    by_uri.setdefault(uri, []).append((fid, tid))
+        for uri, tasks in by_uri.items():
+            moved = []
+            for fid, tid in tasks:
+                spec = self._task_specs[tid]
+                info = self._poll_task(tid, uri)
+                if info is None or info.get("state") != "FINISHED":
+                    continue   # still running: let it finish
+                try:
+                    if not self._spool_complete(tid, spec):
+                        continue
+                except _SpoolUnavailable:
+                    continue   # dead-worker recovery will handle it
+                if not self._repoint_to_spool(fid, tid, uri, spec):
+                    continue   # retry any failed repoint next tick
+                # release: cancel the task on the draining worker so
+                # its buffers free and the worker's drain completes —
+                # every consumer is already reading from the spool
+                self._cancel_tasks([(tid, uri)])
+                moved.append(tid)
+            with self._recovery_lock:
+                remaining = [t for _, t, u in self._placements
+                             if u == uri]
+            if moved and not remaining and uri not in self._drained_uris:
+                self._drained_uris.add(uri)
+                self.co.event_bus.worker_drain(ev.WorkerDrainEvent(
+                    self.query_id, self.trace_token, uri,
+                    tuple(moved), ev.now()))
+                self.co.log(f"drain: worker {uri} released from query "
+                            f"{self.query_id} ({len(moved)} task(s) "
+                            f"now served from spool)")
+
     # -- whole-stage retry (Presto-on-Spark stance) ---------------------
     def _retry_stages(self, frags0: set, dead_uri: str) -> set:
         """Cancel and re-create the minimal producer subtree of the lost
@@ -897,10 +1370,6 @@ class QueryExecution:
             S.add(f)
             S.update(frag_by_id[f].producer_subtree)
         self.stage_retry_rounds += 1
-        self.co.event_bus.stage_retry(ev.StageRetryEvent(
-            self.query_id, self.trace_token, tuple(sorted(S)),
-            self.stage_retry_rounds, f"lost worker {dead_uri}",
-            ev.now()))
 
         def charge(fids) -> int:
             worst = 0
@@ -929,8 +1398,10 @@ class QueryExecution:
         if backoff.backoff_delay() > 0:
             time.sleep(backoff.backoff_delay())
         superseded: List[Tuple[str, str]] = []
+        rerun_counts: Dict[int, int] = {}
         for _ in range(len(dplan.fragments) + 1):
-            moves = self._recreate_fragments(S, dead_uri, superseded)
+            moves = self._recreate_fragments(S, dead_uri, superseded,
+                                             rerun_counts)
             esc = self._repoint_after_retry(S, moves, dead_uri)
             if not esc:
                 break
@@ -946,16 +1417,36 @@ class QueryExecution:
             # already be partially acked by the consumers' old tasks
             S.update(esc)
         self._cancel_tasks(superseded)
+        # producer re-runs: re-executed tasks strictly BELOW a triggering
+        # stage (the cascade cost the spooled exchange eliminates).
+        # Escalated consumers are consumer-side restarts, not re-runs of
+        # producer work, so only each consumer's producer subtree counts.
+        producer_fids: set = set()
+        for f in frags0:
+            producer_fids.update(frag_by_id[f].producer_subtree)
+        for c in S - set(frags0):
+            producer_fids.update(frag_by_id[c].producer_subtree)
+        producer_fids -= set(frags0)
+        reruns = sum(n for fid, n in rerun_counts.items()
+                     if fid in producer_fids)
+        self.producer_reruns_total += reruns
+        self.co.event_bus.stage_retry(ev.StageRetryEvent(
+            self.query_id, self.trace_token, tuple(sorted(S)),
+            round_n, f"lost worker {dead_uri}", ev.now(),
+            producer_reruns=reruns, spooled=False))
         self.co.log(f"stage retry: re-created stages {sorted(S)} "
-                    f"(round {round_n}) after losing {dead_uri}")
+                    f"(round {round_n}, {reruns} producer re-runs) "
+                    f"after losing {dead_uri}")
         return S
 
-    def _recreate_fragments(self, S: set, dead_uri: str,
-                            superseded) -> Dict[int, List[Tuple[str,
-                                                                str]]]:
+    def _recreate_fragments(self, S: set, dead_uri: str, superseded,
+                            rerun_counts: Optional[Dict[int, int]] = None
+                            ) -> Dict[int, List[Tuple[str, str]]]:
         """Create fresh attempts (new task ids, fresh output buffers)
         for every task of every fragment in ``S``, bottom-up.  Returns
-        per-fragment (old_prefix, new_prefix) result-location moves."""
+        per-fragment (old_prefix, new_prefix) result-location moves;
+        ``rerun_counts`` accumulates re-created task counts per fragment
+        (the producer-re-run accounting)."""
         dead = self.co.nodes.dead_uris() | {dead_uri}
         workers = [uri for _, uri in self.co.nodes.topology_ordered(
             self.co.nodes.alive_nodes()) if uri not in dead]
@@ -1019,6 +1510,8 @@ class QueryExecution:
                     tids[i] = new_tid
                     self._task_uris[fid][i] = new_prefix + "{part}"
                 superseded.append((old_tid, old_uri))
+                if rerun_counts is not None:
+                    rerun_counts[fid] = rerun_counts.get(fid, 0) + 1
             moves[fid] = frag_moves
         return moves
 
@@ -1050,25 +1543,41 @@ class QueryExecution:
                           if f == cons_fid]
             for ctid, curi in ctasks:
                 for old_p, new_p in moves[fid]:
-                    body = json.dumps({"old_prefix": old_p,
-                                       "new_prefix": new_p}).encode()
-                    try:
-                        resp = self.co.http.request(
-                            f"{curi}/v1/task/{ctid}/remote-sources",
-                            method="POST", data=body, headers=headers,
-                            timeout=10, task_id=ctid,
-                            description="remote-source repoint",
-                            max_error_duration_s=min(
-                                5.0,
-                                (getattr(self, "_cfg", None)
-                                 or self.co.config)
-                                .remote_request_max_error_duration_s))
-                        status = resp.json().get("status")
-                    except Exception as e:  # noqa: BLE001 - escalate
-                        self.co.log(f"stage retry: repoint of {ctid} on "
-                                    f"{curi} failed ({e}); restarting "
-                                    f"consumer stage {cons_fid}")
-                        status = "delivered"
+                    # with spooling, the consumer may be reading the
+                    # superseded attempt's SPOOL stream (a fallback
+                    # after partial spooled recovery): move that source
+                    # shape too, or it stalls on a dead stream
+                    olds = [old_p]
+                    if self._spool_enabled():
+                        i = old_p.find("/v1/task/")
+                        if i >= 0:
+                            olds.append("spool://" + old_p[i + 1:])
+                    status = "not-found"
+                    for one_old in olds:
+                        body = json.dumps(
+                            {"old_prefix": one_old,
+                             "new_prefix": new_p}).encode()
+                        try:
+                            resp = self.co.http.request(
+                                f"{curi}/v1/task/{ctid}/remote-sources",
+                                method="POST", data=body,
+                                headers=headers,
+                                timeout=10, task_id=ctid,
+                                description="remote-source repoint",
+                                max_error_duration_s=min(
+                                    5.0,
+                                    (getattr(self, "_cfg", None)
+                                     or self.co.config)
+                                    .remote_request_max_error_duration_s))
+                            status = resp.json().get("status")
+                        except Exception as e:  # noqa: BLE001
+                            self.co.log(
+                                f"stage retry: repoint of {ctid} on "
+                                f"{curi} failed ({e}); restarting "
+                                f"consumer stage {cons_fid}")
+                            status = "delivered"
+                        if status == "delivered":
+                            break
                     if status == "delivered":
                         esc.add(cons_fid)
                         break
@@ -1130,11 +1639,14 @@ class QueryExecution:
             by_stage.setdefault(fid, []).append((tid, uri))
         for fid, tasks in by_stage.items():
             frag = frag_by_id[fid]
-            if frag.consumed_fragments:
-                # only leaf tasks speculate: a clone re-derives its whole
-                # output from the deterministic scan shard, while a
-                # non-leaf clone would race the original for the same
-                # producer buffer tokens
+            if frag.consumed_fragments and not self._spool_enabled():
+                # without spooling only leaf tasks speculate: a clone
+                # re-derives its whole output from the deterministic
+                # scan shard, while a non-leaf clone would race the
+                # original for the same producer buffer tokens.  With
+                # the spooled exchange, a non-leaf clone reads its
+                # producers from the spool (token 0, no buffer race) —
+                # non-leaf speculation becomes legal
                 continue
             if fid == self._dplan.root_fragment_id or len(tasks) < 2:
                 continue
@@ -1178,8 +1690,14 @@ class QueryExecution:
         if not workers:   # nowhere else to run: keep waiting
             return
         w = workers[spec["index"] % len(workers)]
-        remote = {pfid: list(self._task_uris[pfid])
-                  for pfid in spec["remote"]}
+        if spec["remote"] and self._spool_enabled():
+            # non-leaf clone: read every producer stream back from the
+            # spool so the clone never races the original for buffer
+            # tokens (the legality condition for non-leaf speculation)
+            remote = self._spool_remote(spec)
+        else:
+            remote = {pfid: list(self._task_uris[pfid])
+                      for pfid in spec["remote"]}
         try:
             self._create_remote_task(
                 w, clone_tid, spec["frag"], spec["scan_shard"], remote,
@@ -1565,10 +2083,19 @@ class QueryExecution:
         for orig in locations:
             self.result_rows.extend(rows_by_loc[orig])
 
+    def _drain_spool(self, loc: str, token: int):
+        """One spool poll for the root drain: the coordinator is the
+        consumer, reading the root task's spooled stream directly."""
+        from presto_tpu.server.spool import parse_spool_url
+
+        tid, part = parse_spool_url(loc)
+        return self.co.spool.get_pages(tid, part, token, wait_s=1.0)
+
     def _drain_location(self, orig: str, deadline, cfg) -> List[tuple]:
         loc = orig
         token = 0
         rows: List[tuple] = []
+        spool_errors = 0
         while True:
             if getattr(self, "canceled", False):
                 raise RuntimeError("Query killed")
@@ -1578,17 +2105,48 @@ class QueryExecution:
                     f"({cfg.query_max_run_time_s:g}s)")
             with self._recovery_lock:
                 moved = self._restarts.pop(orig, None)
+                spool_loc = self._spool_moves.get(orig)
             if moved is not None:
                 # whole-stage retry re-created the root producer: this
                 # location restarts from scratch on the fresh attempt
                 loc, token = moved, 0
                 rows = []
+            elif spool_loc is not None and loc != spool_loc:
+                # the root producer's output moved to the spool (dead or
+                # drained worker, output complete): SAME attempt, same
+                # stream — resume at the current token, rows kept
+                loc = spool_loc
+            if loc.startswith("spool://"):
+                try:
+                    pages, token, complete = self._drain_spool(loc,
+                                                               token)
+                except Exception as e:  # noqa: BLE001 - store errors
+                    # transient spool errors retry on the same budget
+                    # discipline as transport errors
+                    spool_errors += 1
+                    if spool_errors * 0.1 > \
+                            cfg.remote_request_max_error_duration_s:
+                        raise RuntimeError(
+                            f"result drain from spool {loc} failed "
+                            f"past the error budget: {e}") from e
+                    time.sleep(0.1)
+                    continue
+                spool_errors = 0
+                for page in pages:
+                    rows.extend(deserialize_batch(page).to_pylist())
+                if complete:
+                    with self._recovery_lock:
+                        if orig in self._restarts:
+                            continue
+                    return rows
+                continue
 
             def _on_retry(exc, _loc=loc, _token=token, _orig=orig):
                 if getattr(self, "canceled", False):
                     raise RuntimeError("Query killed")
                 with self._recovery_lock:
-                    if _orig in self._restarts:
+                    if _orig in self._restarts or \
+                            _orig in self._spool_moves:
                         raise _DrainRestart() from exc
                 moved2 = self._relocations.get(_loc)
                 if moved2 is None:
@@ -1609,11 +2167,26 @@ class QueryExecution:
                 continue
             except RemoteRequestError:
                 # a fatal answer (e.g. 500 from a just-superseded
-                # attempt) with a restart pending is part of the retry
-                # choreography, not a query failure
+                # attempt) with a restart or spool move pending is part
+                # of the retry choreography, not a query failure
                 with self._recovery_lock:
-                    pending_restart = orig in self._restarts
-                if pending_restart:
+                    pending = (orig in self._restarts
+                               or orig in self._spool_moves)
+                if not pending and self._spool_enabled() \
+                        and not self.canceled:
+                    # spooled tier: a dying root worker can answer one
+                    # fatal 500 before the failure detector sees it —
+                    # give recovery a beat to post the spool move or
+                    # restart before declaring the query dead
+                    grace = time.monotonic() + 3.0
+                    while time.monotonic() < grace:
+                        time.sleep(0.05)
+                        with self._recovery_lock:
+                            if orig in self._restarts or \
+                                    orig in self._spool_moves:
+                                pending = True
+                                break
+                if pending:
                     continue
                 raise
             loc = self._relocations.get(orig, loc)
@@ -1751,6 +2324,10 @@ async function showDetail(id) {
     '  jit dispatches: ' + (qs.jit_dispatches || 0) +
     '\nstage retry rounds: ' + (q.stageRetryRounds || 0) +
     '  recovery rounds: ' + (q.recoveryRounds || 0) +
+    '\nproducer re-runs: ' + (q.producerReruns || 0) +
+    '  spooled pages: ' + ((q.queryStats || {}).pages_spooled || 0) +
+    '  drained workers: ' + ((q.drainedWorkers || []).join(', ') ||
+                             '(none)') +
     '\nspeculations: ' + (spec || '(none)') +
     '\n\n-- stage stats --\n' + (stages || '(none)\n') +
     '\n-- distributed plan --\n' + (q.plan || '(none)');
@@ -1794,6 +2371,22 @@ class CoordinatorServer:
             injector=fault_injector)
         self.nodes = NodeManager(max_missed=heartbeat_max_missed,
                                  interval_s=heartbeat_interval_s)
+        # spooled exchange tier (server/spool.py): the coordinator reads
+        # the spool for root-drain moves and completeness verification,
+        # GCs each query's spool directory, and sweeps orphans left by a
+        # crashed predecessor at start.  Always constructed (dirs are
+        # lazy) so per-session toggles work; exchange_spooling_enabled
+        # gates every use.
+        from presto_tpu.server.spool import FileSystemSpoolStore
+
+        self.spool = FileSystemSpoolStore(config.exchange_spool_path,
+                                          injector=fault_injector)
+        if config.exchange_spooling_enabled:
+            try:
+                self.spool.sweep_orphans(
+                    config.exchange_spool_orphan_age_s)
+            except Exception:  # noqa: BLE001 - sweep is best-effort
+                pass
         self.queries: Dict[str, QueryExecution] = {}
         # mesh-wide event stream (EventListener SPI / QueryMonitor role):
         # the coordinator fires query lifecycle + fault-tolerance events;
@@ -2002,7 +2595,10 @@ class CoordinatorServer:
                          "peakMemoryBytes": (q.query_stats or {}).get(
                              "peak_memory_bytes", 0),
                          "stageRetryRounds": q.stage_retry_rounds,
-                         "recoveryRounds": q.recovery_rounds}
+                         "recoveryRounds": q.recovery_rounds,
+                         "producerReruns": q.producer_reruns_total,
+                         "spooledPages": (q.query_stats or {}).get(
+                             "pages_spooled", 0)}
                         for q in co.queries.values()])
                     return
                 if parts == ["v1", "tasks"]:
@@ -2047,6 +2643,11 @@ class CoordinatorServer:
                         # only as test-probed coordinator attributes
                         "stageRetryRounds": q.stage_retry_rounds,
                         "recoveryRounds": q.recovery_rounds,
+                        # spooled-exchange observability: producer
+                        # re-runs (0 with spooling on) and workers
+                        # gracefully drained out of this query
+                        "producerReruns": q.producer_reruns_total,
+                        "drainedWorkers": sorted(q._drained_uris),
                         "speculations": speculations,
                         "stageStats": {str(fid): st for fid, st
                                        in q.stage_stats.items()},

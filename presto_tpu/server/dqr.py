@@ -77,7 +77,9 @@ class DistributedQueryRunner:
                          q.get("peakMemoryBytes", 0),
                          q.get("stageRetryRounds", 0),
                          q.get("recoveryRounds", 0),
-                         q.get("traceToken"))
+                         q.get("traceToken"),
+                         q.get("spooledPages", 0),
+                         q.get("producerReruns", 0))
                         for q in fetch("/v1/query")]
 
             def tasks_fn():

@@ -16,7 +16,8 @@ Reference models:
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -200,6 +201,14 @@ class HttpPageClient(threading.Thread):
     the previous attempt is discarded, and the ``base_url`` — which
     carries the producer's attempt-qualified task id — keys the
     attempt-aware page accounting.
+
+    Second source kind — **spool-read**: a ``spool://v1/task/{id}/
+    results/{part}`` base url pulls the same token-addressed stream from
+    the shared ``SpoolStore`` instead of the producer's HTTP buffer.
+    Identical contract (pages, next token, complete), so a fetcher can
+    be repointed from a dead producer's HTTP buffer at its spooled
+    output MID-STREAM and resume at the current token: the spool is the
+    same attempt, just a different backing store.
     """
 
     def __init__(self, base_url: str, client: "ExchangeClient",
@@ -212,6 +221,9 @@ class HttpPageClient(threading.Thread):
         self.client = client
         self.token = 0
         self.epoch = 0
+        # set once the stream's final page arrived (complete=true) — a
+        # finished fetcher needs no replacement on repoint
+        self.finished_stream = False
         # per-cluster intra-auth headers (one process can host clusters
         # with different secrets; never process-global state)
         self.headers = dict(headers or {})
@@ -219,9 +231,36 @@ class HttpPageClient(threading.Thread):
         self.task_id = task_id
         self.trace_token = trace_token
         self._lock = threading.Lock()
+        self._stall_started: Optional[float] = None
         self._tracker = self.http.new_tracker(
             self.base_url, task_id=task_id, description="exchange fetch",
             trace_token=trace_token)
+
+    def _fetch_spool(self, base: str, token: int):
+        """One spool poll: (pages, next_token, complete).  A stream with
+        no progress for ``spool_stall_s`` raises — the producer died
+        without a failure channel through the store."""
+        from presto_tpu.server.spool import parse_spool_url
+
+        spool = self.client.spool
+        if spool is None:
+            raise RuntimeError(
+                f"spool source {base} but no spool store configured")
+        tid, part = parse_spool_url(base)
+        pages, next_token, complete = spool.get_pages(
+            tid, part, token, wait_s=1.0)
+        if not pages and not complete:
+            if self._stall_started is None:
+                self._stall_started = time.monotonic()
+            elif (time.monotonic() - self._stall_started
+                    > self.client.spool_stall_s):
+                raise RuntimeError(
+                    f"spool stream {base} stalled: no pages and no "
+                    f"COMPLETE marker for {self.client.spool_stall_s:g}s "
+                    f"(producer lost before finishing?)")
+        else:
+            self._stall_started = None
+        return pages, next_token, complete
 
     def run(self) -> None:
         try:
@@ -230,9 +269,24 @@ class HttpPageClient(threading.Thread):
                     base, token, epoch = (self.base_url, self.token,
                                           self.epoch)
                 try:
-                    resp = self.http.request_once(
-                        f"{base}/{token}", headers=dict(self.headers),
-                        timeout=120)
+                    if base.startswith("spool://"):
+                        pages, next_token, complete = \
+                            self._fetch_spool(base, token)
+                    else:
+                        resp = self.http.request_once(
+                            f"{base}/{token}",
+                            headers=dict(self.headers), timeout=120)
+                        complete = resp.headers.get(
+                            "X-Presto-Buffer-Complete") == "true"
+                        next_token = int(resp.headers.get(
+                            "X-Presto-Next-Token", token))
+                        body = resp.body
+                        pages = []
+                        off = 0
+                        while off < len(body):
+                            size = frame_size(body, off)
+                            pages.append(body[off:off + size])
+                            off += size
                 except Exception as e:  # noqa: BLE001 - classified
                     with self._lock:
                         if self.epoch != epoch:
@@ -243,25 +297,18 @@ class HttpPageClient(threading.Thread):
                     self._tracker.failed(e)
                     continue
                 self._tracker.succeeded()
-                complete = resp.headers.get(
-                    "X-Presto-Buffer-Complete") == "true"
-                next_token = int(resp.headers.get(
-                    "X-Presto-Next-Token", token))
-                body = resp.body
-                off = 0
-                while off < len(body):
-                    size = frame_size(body, off)
+                for page in pages:
                     # the exchange drops the page if this epoch is stale
                     # (repointed while the response was in flight)
-                    self.client.on_page(body[off:off + size], self, epoch,
-                                        base)
-                    off += size
+                    self.client.on_page(page, self, epoch, base)
                 with self._lock:
                     if self.epoch == epoch:
                         self.token = next_token
                     else:
                         continue
                 if complete:
+                    with self._lock:
+                        self.finished_stream = True
                     break
         except Exception as e:  # noqa: BLE001 - surfaces to the driver
             self.client.on_source_error(self, e)
@@ -283,7 +330,12 @@ class ExchangeClient:
                  headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
                  task_id: Optional[str] = None,
-                 trace_token: Optional[str] = None):
+                 trace_token: Optional[str] = None,
+                 spool=None, spool_stall_s: float = 60.0):
+        # shared SpoolStore for spool:// source urls (the spooled
+        # exchange's consumer half); None when spooling is disabled
+        self.spool = spool
+        self.spool_stall_s = spool_stall_s
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         # signaled on page arrival / stream completion / error so an
@@ -383,6 +435,7 @@ class ExchangeClient:
                     c.base_url = new + url[len(old):]
                     c.token = 0
                     c.epoch += 1
+                    c._stall_started = None
                     c._tracker.reset(endpoint=c.base_url)
                     alive = c.is_alive()
                     new_url = c.base_url
@@ -395,6 +448,66 @@ class ExchangeClient:
                                           http=self._http,
                                           task_id=self.task_id,
                                           trace_token=self.trace_token)
+                    self._clients[self._clients.index(c)] = repl
+                    self._remaining += 1
+                    repl.start()
+            self._drained.notify_all()
+            self._arrived.notify_all()
+        return "repointed"
+
+    def repoint_spool(self, old_prefix: str, new_prefix: str) -> str:
+        """Redirect fetchers under ``old_prefix`` at the SAME attempt's
+        spooled output under ``new_prefix`` (a ``spool://`` prefix
+        carrying the same task id).
+
+        Unlike an attempt-change repoint there is no delivered guard and
+        no restart from token 0: the spool serves the identical
+        token-addressed stream, so the fetch RESUMES at exactly the
+        number of pages the operator chain already consumed from this
+        source — buffered-but-unconsumed pages are purged (they will be
+        re-read from the spool at the same tokens) and nothing can
+        double-count.  Returns 'repointed' or 'not-found'."""
+        old = old_prefix.rstrip("/")
+        new = new_prefix.rstrip("/")
+        with self._lock:
+            matched = [c for c in self._clients
+                       if c.base_url.startswith(old)]
+            if not matched:
+                return "not-found"
+            for c in matched:
+                with c._lock:
+                    url = c.base_url
+                    if c.finished_stream:
+                        continue   # fully served: nothing left to move
+                    # purge buffered-unconsumed pages of this source;
+                    # the resume token is then precisely the consumed
+                    # count (tokens are sequential page indices)
+                    kept = []
+                    for (u, p) in self._pages:
+                        if u == url:
+                            self._buffered_bytes -= len(p)
+                            self._stat(u)["purged"] += 1
+                        else:
+                            kept.append((u, p))
+                    self._pages = kept
+                    c.base_url = new + url[len(old):]
+                    c.token = self.source_stats.get(
+                        url, {}).get("consumed", 0)
+                    c.epoch += 1
+                    c._stall_started = None
+                    c._tracker.reset(endpoint=c.base_url)
+                    alive = c.is_alive()
+                    new_url = c.base_url
+                if not alive:
+                    # fetcher exited on a terminal transport error but
+                    # the exchange survives: resume the stream from the
+                    # spool with a fresh thread
+                    repl = HttpPageClient(new_url, self,
+                                          headers=self._headers,
+                                          http=self._http,
+                                          task_id=self.task_id,
+                                          trace_token=self.trace_token)
+                    repl.token = c.token
                     self._clients[self._clients.index(c)] = repl
                     self._remaining += 1
                     repl.start()
@@ -544,17 +657,26 @@ class ExchangeOperatorFactory(OperatorFactory):
                  headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
                  task_id: Optional[str] = None,
-                 trace_token: Optional[str] = None):
+                 trace_token: Optional[str] = None,
+                 spool=None, spool_stall_s: float = 60.0):
         self.locations = list(locations)
         self.headers = headers
         self.http = http
         self.task_id = task_id
         self.trace_token = trace_token
+        self.spool = spool
+        self.spool_stall_s = spool_stall_s
         self._client: Optional[ExchangeClient] = None
 
     def repoint(self, old_prefix: str, new_prefix: str) -> str:
         if self._client is not None:
             return self._client.repoint(old_prefix, new_prefix)
+        return _repoint_locations(self.locations, old_prefix, new_prefix)
+
+    def repoint_spool(self, old_prefix: str, new_prefix: str) -> str:
+        """Same-attempt spool repoint (no delivered guard, token kept)."""
+        if self._client is not None:
+            return self._client.repoint_spool(old_prefix, new_prefix)
         return _repoint_locations(self.locations, old_prefix, new_prefix)
 
     def delivery_state(self, old_prefix: str) -> str:
@@ -577,7 +699,9 @@ class ExchangeOperatorFactory(OperatorFactory):
                                           headers=self.headers,
                                           http=self.http,
                                           task_id=self.task_id,
-                                          trace_token=self.trace_token)
+                                          trace_token=self.trace_token,
+                                          spool=self.spool,
+                                          spool_stall_s=self.spool_stall_s)
         return ExchangeOperator(ctx, self._client)
 
 
@@ -595,11 +719,14 @@ class MergeExchangeOperator(Operator):
                  batch_rows: int = 8192, headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
                  task_id: Optional[str] = None,
-                 trace_token: Optional[str] = None):
+                 trace_token: Optional[str] = None,
+                 spool=None, spool_stall_s: float = 60.0):
         super().__init__(ctx)
         self.clients = [ExchangeClient([loc], headers=headers,
                                        http=http, task_id=task_id,
-                                       trace_token=trace_token)
+                                       trace_token=trace_token,
+                                       spool=spool,
+                                       spool_stall_s=spool_stall_s)
                         for loc in locations]
         self.sort_keys = list(sort_keys)   # (channel, ascending, nulls_first)
         self.types = list(types)
@@ -725,7 +852,8 @@ class MergeExchangeOperatorFactory(OperatorFactory):
                  headers: Optional[dict] = None,
                  http: Optional[RetryingHttpClient] = None,
                  task_id: Optional[str] = None,
-                 trace_token: Optional[str] = None):
+                 trace_token: Optional[str] = None,
+                 spool=None, spool_stall_s: float = 60.0):
         self.locations = list(locations)
         self.sort_keys = list(sort_keys)
         self.types = list(types)
@@ -734,6 +862,8 @@ class MergeExchangeOperatorFactory(OperatorFactory):
         self.http = http
         self.task_id = task_id
         self.trace_token = trace_token
+        self.spool = spool
+        self.spool_stall_s = spool_stall_s
         self._live_clients: List[ExchangeClient] = []
 
     def repoint(self, old_prefix: str, new_prefix: str) -> str:
@@ -747,6 +877,13 @@ class MergeExchangeOperatorFactory(OperatorFactory):
                     for c in self._live_clients]
         if "delivered" in statuses:
             return "delivered"
+        if "repointed" in statuses:
+            return "repointed"
+        return _repoint_locations(self.locations, old_prefix, new_prefix)
+
+    def repoint_spool(self, old_prefix: str, new_prefix: str) -> str:
+        statuses = [c.repoint_spool(old_prefix, new_prefix)
+                    for c in self._live_clients]
         if "repointed" in statuses:
             return "repointed"
         return _repoint_locations(self.locations, old_prefix, new_prefix)
@@ -772,6 +909,8 @@ class MergeExchangeOperatorFactory(OperatorFactory):
                                    self.types, self.limit,
                                    headers=self.headers, http=self.http,
                                    task_id=self.task_id,
-                                   trace_token=self.trace_token)
+                                   trace_token=self.trace_token,
+                                   spool=self.spool,
+                                   spool_stall_s=self.spool_stall_s)
         self._live_clients.extend(op.clients)
         return op

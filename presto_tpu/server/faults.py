@@ -39,6 +39,13 @@ DELAY = "delay"
 #: straggler: the task executes normally, its consumers just cannot
 #: pull its pages, which is exactly what speculation must beat
 SLOW_TASK = "slow-task"
+#: spool-store fault policies (server/spool.py reads consult
+#: ``apply_spool``): a read raises an OSError for the first N matching
+#: touches, a key is reported missing outright (FileNotFoundError), or
+#: the read is delayed — the chaos shapes the retry-to-spool path must
+#: survive (or fall back from, to PR 5 cascading retry)
+SPOOL_READ_ERROR = "spool-read-error"
+SPOOL_MISSING = "spool-missing"
 
 
 class FaultRule:
@@ -46,7 +53,7 @@ class FaultRule:
                  times: Optional[int] = None, delay_s: float = 0.0,
                  status: int = 503):
         if policy not in (FAIL_N_TIMES, HTTP_503, DROP_CONNECTION, DELAY,
-                          SLOW_TASK):
+                          SLOW_TASK, SPOOL_READ_ERROR, SPOOL_MISSING):
             raise ValueError(f"unknown fault policy {policy!r}")
         self.pattern = pattern
         self.regex = re.compile(pattern)
@@ -122,6 +129,18 @@ class FaultInjector:
             rf"/v1/task/[^/]*{task_pattern}[^/]*/results/",
             method="GET", policy=SLOW_TASK, delay_s=delay_s)
 
+    def add_spool_rule(self, pattern: str, policy: str = SPOOL_READ_ERROR,
+                       *, times: Optional[int] = None,
+                       delay_s: float = 0.0) -> FaultRule:
+        """Spool-path chaos: ``pattern`` matches the spool key
+        (``{task_id}/{partition}/{token}``), policy is one of
+        spool-read-error (OSError, default 1 shot), spool-missing
+        (FileNotFoundError until removed), or delay (slow read).  Spool
+        rules are keyed method='SPOOL' so HTTP rules never leak onto
+        the spool path and vice versa."""
+        return self.add_rule(pattern, method="SPOOL", policy=policy,
+                             times=times, delay_s=delay_s)
+
     def release_all(self) -> None:
         with self._lock:
             for rule in self.rules:
@@ -168,6 +187,32 @@ class FaultInjector:
                 url, rule.status, "injected fault", {},
                 io.BytesIO(b'{"error": "injected fault"}'))
         raise InjectedFault(rule, url)
+
+    # -- spool side -----------------------------------------------------
+    def apply_spool(self, key: str) -> None:
+        """Raise (or delay) for a spool-store read touching ``key``.
+        Only method='SPOOL' rules apply here — never HTTP rules."""
+        with self._lock:
+            hit = None
+            for rule in self.rules:
+                if rule.method != "SPOOL" or \
+                        rule.regex.search(key) is None:
+                    continue
+                if rule.remaining is not None:
+                    if rule.remaining <= 0:
+                        continue
+                    rule.remaining -= 1
+                self.injections.append((key, "SPOOL", rule.policy))
+                hit = rule
+                break
+        if hit is None:
+            return
+        if hit.policy == DELAY:
+            self.sleeper(hit.delay_s)
+            return
+        if hit.policy == SPOOL_MISSING:
+            raise FileNotFoundError(f"injected spool-missing on {key}")
+        raise OSError(f"injected spool read error on {key}")
 
     # -- server side ----------------------------------------------------
     def apply_server(self, path: str, method: str

@@ -76,16 +76,37 @@ def _kernel_cache_families(prefix: str) -> List[Family]:
     return fams
 
 
+def _spool_families(prefix: str, spool, bytes_evicted: int = 0
+                    ) -> List[Family]:
+    """presto_spool_bytes_written/read/evicted_total: the spooled
+    exchange's write-through volume, spool-read volume, and in-memory
+    buffer bytes evicted under pressure (re-served from the spool)."""
+    stats = getattr(spool, "stats", None) or {}
+    return [
+        (f"{prefix}_spool_bytes_written_total", "counter",
+         "exchange pages written through to the spool store, bytes",
+         [({}, stats.get("bytes_written", 0))]),
+        (f"{prefix}_spool_bytes_read_total", "counter",
+         "exchange pages read back from the spool store, bytes",
+         [({}, stats.get("bytes_read", 0))]),
+        (f"{prefix}_spool_bytes_evicted_total", "counter",
+         "spooled pages evicted from in-memory output buffers, bytes",
+         [({}, bytes_evicted)]),
+    ]
+
+
 def coordinator_metrics(co) -> str:
     """Render the coordinator's /metrics payload from live state."""
     by_state: Dict[str, int] = {}
     retry_rounds = 0
     recovery_rounds = 0
+    producer_reruns = 0
     spec_outcomes: Dict[str, int] = {}
     for q in list(co.queries.values()):
         by_state[q.state] = by_state.get(q.state, 0) + 1
         retry_rounds += q.stage_retry_rounds
         recovery_rounds += q.recovery_rounds
+        producer_reruns += getattr(q, "producer_reruns_total", 0)
         for sp in list(getattr(q, "_speculations", {}).values()):
             state = sp.get("state", "racing")
             spec_outcomes[state] = spec_outcomes.get(state, 0) + 1
@@ -102,6 +123,10 @@ def coordinator_metrics(co) -> str:
         ("presto_task_recovery_rounds_total", "counter",
          "leaf task recovery rounds across all queries",
          [({}, recovery_rounds)]),
+        ("presto_producer_reruns_total", "counter",
+         "producer-subtree tasks re-executed by stage retry "
+         "(0 with the spooled exchange on)",
+         [({}, producer_reruns)]),
         ("presto_speculation_total", "counter",
          "speculative straggler clones by race outcome",
          [({"outcome": o}, n) for o, n in sorted(spec_outcomes.items())]
@@ -116,6 +141,7 @@ def coordinator_metrics(co) -> str:
           ({"kind": "peak"}, mem_peak)]),
         _http_client_family("presto", co.http),
     ]
+    fams.extend(_spool_families("presto", getattr(co, "spool", None)))
     fams.extend(_kernel_cache_families("presto"))
     return prometheus_text(fams)
 
@@ -132,12 +158,14 @@ def worker_metrics(worker) -> str:
     prereduce = 0
     reserved = 0
     peak = 0
+    bytes_evicted = 0
     for t in tasks:
         by_state[t.state] = by_state.get(t.state, 0) + 1
         # one source of truth for per-task counters: the same TaskStats
         # rollup the coordinator aggregates (server/task.py)
         ts = t.task_stats()
         pages += ts["pages_enqueued"]
+        bytes_evicted += ts["bytes_evicted"]
         for k in exchange:
             exchange[k] += ts[f"exchange_{k}"]
         jit["dispatches"] += ts["jit_dispatches"]
@@ -169,5 +197,8 @@ def worker_metrics(worker) -> str:
          [({}, 1 if worker.draining else 0)]),
         _http_client_family("presto_worker", worker.http),
     ]
+    fams.extend(_spool_families("presto_worker",
+                                getattr(worker, "spool", None),
+                                bytes_evicted=bytes_evicted))
     fams.extend(_kernel_cache_families("presto_worker"))
     return prometheus_text(fams)

@@ -1,0 +1,252 @@
+"""Spooled exchange tier: disaggregate task output from task lifetime.
+
+The reference's fault-tolerant execution mode (Presto-on-Spark /
+Tardigrade, SURVEY §2.8) spools exchange output to a shared store so a
+consumer can re-pull a dead producer's pages without re-executing it —
+the buffer's backing store changes, the token-ack pull protocol
+(``OutputBuffers.java`` + ``HttpPageBufferClient.java`` semantics) does
+not.  ``SpoolStore`` is that backing store: pages land here write-through
+as ``OutputBufferManager`` enqueues them, keyed
+
+    (query, stage, task, attempt, partition, token)
+
+where query/stage/task/attempt are all carried by the attempt-qualified
+task id (``{query}.{fragment}.{index}[aN]``).  The wire format is the
+same self-delimiting LZ4 frame the exchange wire and ``exec/spill.py``'s
+``FileSpiller`` use (presto_tpu.serde) — a spooled page IS the serialized
+page, byte for byte.
+
+``FileSystemSpoolStore`` is the local-FS tier (every node of an
+in-process or single-host cluster shares the path; a real deployment
+points it at network storage).  Layout::
+
+    {root}/{query_id}/{task_id}/{partition}/{token:08d}.page
+    {root}/{query_id}/{task_id}/{partition}/COMPLETE   # text end_token
+
+Pages are written to a temp name and os.replace'd so a concurrent reader
+never observes a partial frame; the COMPLETE marker (written at
+``set_no_more_pages``) is both the stream terminator and the
+completeness proof the coordinator checks before repointing a consumer
+at the spool (a task that died mid-production has no marker and must
+re-run — but its producers still don't).
+
+Chaos hooks: reads consult the ``FaultInjector`` (server/faults.py)
+``apply_spool`` surface so tests can inject read errors, missing
+objects, and slow reads on the spool path specifically.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def query_id_of(task_id: str) -> str:
+    """Task ids are ``{query}.{fragment}.{index}[aN]``."""
+    return task_id.rsplit(".", 2)[0]
+
+
+class SpoolStore:
+    """Interface (and stats surface) every spool tier implements."""
+
+    def write_page(self, task_id: str, partition: int, token: int,
+                   page: bytes) -> None:
+        raise NotImplementedError
+
+    def set_complete(self, task_id: str, partition: int,
+                     end_token: int) -> None:
+        raise NotImplementedError
+
+    def get_pages(self, task_id: str, partition: int, token: int,
+                  max_bytes: int = 16 << 20,
+                  wait_s: float = 0.0) -> Tuple[List[bytes], int, bool]:
+        raise NotImplementedError
+
+    def is_complete(self, task_id: str, n_partitions: int) -> bool:
+        raise NotImplementedError
+
+    def delete_query(self, query_id: str) -> bool:
+        raise NotImplementedError
+
+    def sweep_orphans(self, max_age_s: float) -> int:
+        raise NotImplementedError
+
+
+class FileSystemSpoolStore(SpoolStore):
+    """Local-FS spool tier (the FileSpiller of the exchange plane)."""
+
+    def __init__(self, root: str, injector=None):
+        self.root = root
+        # chaos substrate hook: consulted on every read-path touch
+        self.injector = injector
+        self._lock = threading.Lock()
+        # node-local counters for /metrics
+        # (presto_spool_bytes_written/read_total)
+        self.stats: Dict[str, int] = {
+            "bytes_written": 0, "bytes_read": 0,
+            "pages_written": 0, "pages_read": 0}
+
+    # -- layout ---------------------------------------------------------
+    def _partition_dir(self, task_id: str, partition: int) -> str:
+        return os.path.join(self.root, query_id_of(task_id), task_id,
+                            str(partition))
+
+    @staticmethod
+    def _page_name(token: int) -> str:
+        return f"{token:08d}.page"
+
+    def _count(self, key: str, n: int) -> None:
+        with self._lock:
+            self.stats[key] += n
+
+    # -- producer side (write-through from OutputBufferManager) ---------
+    def write_page(self, task_id: str, partition: int, token: int,
+                   page: bytes) -> None:
+        d = self._partition_dir(task_id, partition)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, self._page_name(token))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(page)
+        # atomic publish: a reader sees the whole frame or nothing
+        os.replace(tmp, path)
+        self._count("bytes_written", len(page))
+        self._count("pages_written", 1)
+
+    def set_complete(self, task_id: str, partition: int,
+                     end_token: int) -> None:
+        d = self._partition_dir(task_id, partition)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, "COMPLETE.tmp")
+        with open(tmp, "w", encoding="ascii") as f:
+            f.write(str(end_token))
+        os.replace(tmp, os.path.join(d, "COMPLETE"))
+
+    # -- consumer side --------------------------------------------------
+    def _end_token(self, d: str) -> Optional[int]:
+        """The stream's final token count, or None while still open."""
+        try:
+            with open(os.path.join(d, "COMPLETE"), encoding="ascii") as f:
+                return int(f.read().strip())
+        except FileNotFoundError:
+            return None
+
+    def get_pages(self, task_id: str, partition: int, token: int,
+                  max_bytes: int = 16 << 20,
+                  wait_s: float = 0.0) -> Tuple[List[bytes], int, bool]:
+        """Same contract as ``OutputBufferManager.get_pages``: pages
+        from ``token``, the next token, and whether the stream is
+        complete.  Long-polls up to ``wait_s`` while the producer is
+        still writing through (the spool fills progressively)."""
+        d = self._partition_dir(task_id, partition)
+        deadline = (time.monotonic() + wait_s) if wait_s > 0 else None
+        while True:
+            if self.injector is not None:
+                # read-error / missing-object / slow-read chaos
+                self.injector.apply_spool(
+                    f"{task_id}/{partition}/{token}")
+            out: List[bytes] = []
+            size = 0
+            t = token
+            while True:
+                path = os.path.join(d, self._page_name(t))
+                try:
+                    with open(path, "rb") as f:
+                        page = f.read()
+                except FileNotFoundError:
+                    break
+                if out and size + len(page) > max_bytes:
+                    break
+                out.append(page)
+                size += len(page)
+                t += 1
+            end = self._end_token(d)
+            complete = end is not None and t >= end
+            if out or complete or deadline is None:
+                self._count("bytes_read", size)
+                self._count("pages_read", len(out))
+                return out, t, complete
+            if time.monotonic() >= deadline:
+                return out, t, False
+            time.sleep(0.005)
+
+    def is_complete(self, task_id: str, n_partitions: int) -> bool:
+        """True when every partition's stream is terminated AND every
+        page below its end token is present — the proof the coordinator
+        demands before swapping a consumer's source to the spool."""
+        for p in range(n_partitions):
+            d = self._partition_dir(task_id, p)
+            if self.injector is not None:
+                self.injector.apply_spool(f"{task_id}/{p}/COMPLETE")
+            end = self._end_token(d)
+            if end is None:
+                return False
+            for t in range(end):
+                if not os.path.exists(
+                        os.path.join(d, self._page_name(t))):
+                    return False
+        return True
+
+    # -- lifecycle ------------------------------------------------------
+    def delete_query(self, query_id: str) -> bool:
+        """Spool GC: a finished/failed/canceled query's pages are dead
+        weight the moment its drain settles."""
+        d = os.path.join(self.root, query_id)
+        if not os.path.isdir(d):
+            return False
+        shutil.rmtree(d, ignore_errors=True)
+        return True
+
+    def sweep_orphans(self, max_age_s: float = 3600.0) -> int:
+        """Coordinator-start sweep: remove query directories older than
+        ``max_age_s`` (queries a crashed coordinator never GC'd).  The
+        age guard keeps a shared spool root safe when several clusters
+        use it concurrently."""
+        removed = 0
+        try:
+            entries = os.listdir(self.root)
+        except FileNotFoundError:
+            return 0
+        cutoff = time.time() - max_age_s
+        for name in entries:
+            d = os.path.join(self.root, name)
+            try:
+                if os.path.isdir(d) and os.path.getmtime(d) <= cutoff:
+                    shutil.rmtree(d, ignore_errors=True)
+                    removed += 1
+            except OSError:
+                continue
+        return removed
+
+
+# -- spool source urls ------------------------------------------------------
+# Spool-read locations keep the exact ``/v1/task/{id}/results/{part}`` path
+# shape of HTTP result locations so every prefix-rewrite (repoint), the
+# ``{part}`` template resolution, and the attempt-aware dedup accounting
+# (which parses task id + attempt out of the source url) work unchanged.
+SPOOL_SCHEME = "spool://"
+
+
+def spool_location(task_id: str) -> str:
+    """Result-location template for a task's spooled output."""
+    return f"{SPOOL_SCHEME}v1/task/{task_id}/results/{{part}}"
+
+
+def spool_prefix(task_id: str) -> str:
+    return f"{SPOOL_SCHEME}v1/task/{task_id}/results/"
+
+
+def is_spool_url(url: str) -> bool:
+    return url.startswith(SPOOL_SCHEME)
+
+
+def parse_spool_url(url: str) -> Tuple[str, int]:
+    """``spool://v1/task/{tid}/results/{part}`` -> (task_id, partition)."""
+    parts = url[len(SPOOL_SCHEME):].strip("/").split("/")
+    if len(parts) < 5 or parts[:2] != ["v1", "task"] or \
+            parts[3] != "results":
+        raise ValueError(f"bad spool url {url!r}")
+    return parts[2], int(parts[4])

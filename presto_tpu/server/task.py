@@ -43,7 +43,8 @@ class SqlTask:
                  registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT,
                  fetch_headers: Optional[Dict[str, str]] = None,
-                 http_client=None, trace_token: str = ""):
+                 http_client=None, trace_token: str = "",
+                 spool=None):
         self.task_id = task_id
         self.fragment = fragment
         self.trace_token = trace_token
@@ -51,8 +52,15 @@ class SqlTask:
         self.error: Optional[str] = None
         self.start_time = time.time()
         self.end_time: Optional[float] = None
+        # spooled exchange (server/spool.py): output pages write through
+        # to the shared store as they are enqueued, and remote sources
+        # can read producer streams back from it (spool:// locations)
+        spool = spool if config.exchange_spooling_enabled else None
+        self.spool = spool
         self.buffers = OutputBufferManager(
-            n_output_partitions, broadcast=broadcast_output)
+            n_output_partitions, broadcast=broadcast_output,
+            max_buffer_bytes=config.exchange_max_buffer_bytes,
+            spool=spool, task_id=task_id)
         self._stats: Optional[TaskContext] = None
         self._live: Optional[TaskContext] = None  # set when execution starts
         # every exchange source factory of this task's remote sources,
@@ -73,7 +81,8 @@ class SqlTask:
                                   task_id=task_id,
                                   exchange_register=(
                                       self.exchange_sources.append),
-                                  trace_token=trace_token or None)
+                                  trace_token=trace_token or None,
+                                  spool=spool)
         kind, channels = fragment.output_partitioning
         if kind == "hash" and n_output_partitions > 1:
             sink = PartitionedOutputOperatorFactory(
@@ -138,6 +147,8 @@ class SqlTask:
                 # straggler detector, and the attempt-aware exchange
                 # dedup counters (whole-stage retry observability)
                 "pagesEnqueued": self.buffers.pages_enqueued,
+                "pagesSpooled": self.buffers.pages_spooled,
+                "spooledComplete": self.buffers.spooled_complete(),
                 "drained": (self.state != "RUNNING"
                             and (self.buffers.is_drained()
                                  or self.buffers.is_fully_served())),
@@ -165,6 +176,9 @@ class SqlTask:
         ts.end_time = end
         ts.elapsed_s = max(end - self.start_time, 0.0)
         ts.pages_enqueued = self.buffers.pages_enqueued
+        ts.pages_spooled = self.buffers.pages_spooled
+        ts.pages_evicted = self.buffers.pages_evicted
+        ts.bytes_evicted = self.buffers.bytes_evicted
         for source in self.exchange_sources:
             if not hasattr(source, "source_stats"):
                 continue
@@ -187,15 +201,23 @@ class SqlTask:
         return {"reserved": ctx.memory.reserved if running else 0,
                 "peak": ctx.memory.peak}
 
-    def repoint_remote_source(self, old_prefix: str,
-                              new_prefix: str) -> str:
+    def repoint_remote_source(self, old_prefix: str, new_prefix: str,
+                              spool: bool = False) -> str:
         """Redirect remote-source fetches from a superseded producer
         attempt at its replacement.  'repointed' | 'delivered' (pages
         from the old attempt already entered the operator chain — this
-        task must be restarted instead) | 'not-found'."""
+        task must be restarted instead) | 'not-found'.
+
+        ``spool=True`` is the same-attempt variant: the new prefix is
+        the SAME task's spooled output, the fetch resumes at the current
+        token, and the delivered guard does not apply (nothing can
+        double-count — same stream, different backing store)."""
         status = "not-found"
         for source in self.exchange_sources:
-            got = source.repoint(old_prefix, new_prefix)
+            if spool:
+                got = source.repoint_spool(old_prefix, new_prefix)
+            else:
+                got = source.repoint(old_prefix, new_prefix)
             if got == "delivered":
                 return "delivered"
             if got == "repointed":
@@ -236,13 +258,16 @@ class SqlTaskManager:
     def __init__(self, registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT,
                  fetch_headers: Optional[Dict[str, str]] = None,
-                 http_client=None):
+                 http_client=None, spool=None):
         self.registry = registry
         self.config = config
         # intra-cluster auth headers this node's exchange fetches carry
         self.fetch_headers = fetch_headers
         # node-wide error-tracked HTTP client for remote-source fetches
         self.http_client = http_client
+        # node-wide spool store (spooled exchange tier); the per-task
+        # exchange_spooling_enabled knob gates its use per query
+        self.spool = spool
         self.tasks: Dict[str, SqlTask] = {}
         self._lock = threading.Lock()
 
@@ -272,7 +297,8 @@ class SqlTaskManager:
                            self.registry, config,
                            fetch_headers=self.fetch_headers,
                            http_client=self.http_client,
-                           trace_token=trace_token)
+                           trace_token=trace_token,
+                           spool=self.spool)
             self.tasks[task_id] = task
             return task
 
@@ -328,7 +354,11 @@ class SqlTaskManager:
 
     def undrained_count(self) -> int:
         """Tasks still running OR holding pages a consumer has not yet
-        fetched — the set a graceful drain must wait for."""
+        fetched — the set a graceful drain must wait for.  With the
+        spooled exchange the coordinator RELEASES a draining worker's
+        finished tasks (repoint consumers at the spool, then DELETE the
+        task, which fails-and-frees its buffers), so this count reaches
+        zero without consumers ever fetching the rest."""
         with self._lock:
             return sum(1 for t in self.tasks.values()
                        if t.state == "RUNNING"

@@ -33,9 +33,11 @@ class WorkerServer:
                  node_id: str = "worker",
                  internal_secret: Optional[str] = None,
                  location: str = "",
-                 fault_injector=None, http_client=None):
+                 fault_injector=None, http_client=None,
+                 drain_grace_s: float = 2.0):
         from presto_tpu.server.errortracker import RetryingHttpClient
         from presto_tpu.server.security import InternalAuthenticator
+        from presto_tpu.server.spool import FileSystemSpoolStore
 
         self.node_id = node_id
         # topology label (rack/zone) announced to the
@@ -52,16 +54,27 @@ class WorkerServer:
             max_error_duration_s=config.remote_request_max_error_duration_s,
             min_backoff_s=config.remote_request_min_backoff_s,
             max_backoff_s=config.remote_request_max_backoff_s)
+        # spooled exchange tier: the store is always constructed (dirs
+        # are created lazily on first write) so a SET SESSION toggle can
+        # enable spooling per query; exchange_spooling_enabled gates use
+        self.spool = FileSystemSpoolStore(config.exchange_spool_path,
+                                          injector=fault_injector)
         self.task_manager = SqlTaskManager(
             registry, config,
             fetch_headers=(self.internal_auth.header()
                            if self.internal_auth else None),
-            http_client=self.http)
+            http_client=self.http, spool=self.spool)
         # graceful shutdown (GracefulShutdownHandler.java role): once
         # draining, new tasks are refused, /v1/info advertises
         # SHUTTING_DOWN so the coordinator stops scheduling here, and
-        # close() waits for running tasks to finish
+        # close() waits for running tasks to finish.  PUT /v1/info/state
+        # additionally starts the drain-and-remove sequence after a
+        # grace period (the reference sleeps its gracePeriod twice) —
+        # with spooling on, finished tasks' output is durable in the
+        # spool, so the worker exits without waiting for consumers.
         self.draining = False
+        self.drain_grace_s = drain_grace_s
+        self._drain_thread: Optional[threading.Thread] = None
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -207,6 +220,10 @@ class WorkerServer:
                         req = json.loads(self.rfile.read(n))
                         old = str(req["old_prefix"])
                         probe = bool(req.get("probe", False))
+                        # spool=true: same-attempt repoint at the dead
+                        # producer's spooled output (token preserved,
+                        # no delivered guard)
+                        spool = bool(req.get("spool", False))
                         new = "" if probe else str(req["new_prefix"])
                     except (KeyError, TypeError, ValueError) as e:
                         self._json(400, {"error": f"bad repoint: {e}"})
@@ -217,7 +234,8 @@ class WorkerServer:
                         # mutating any source
                         status = task.probe_remote_source(old)
                     else:
-                        status = task.repoint_remote_source(old, new)
+                        status = task.repoint_remote_source(
+                            old, new, spool=spool)
                     self._json(200, {"status": status})
                     return
                 if parts[:2] == ["v1", "task"] and worker.draining:
@@ -274,13 +292,18 @@ class WorkerServer:
                     return
                 if parts == ["v1", "info", "state"]:
                     # PUT "SHUTTING_DOWN" starts a graceful drain
-                    # (the reference's /v1/info/state shutdown trigger)
+                    # (the reference's /v1/info/state shutdown trigger):
+                    # refuse new tasks immediately, then — after a grace
+                    # period that lets the coordinator observe the state
+                    # and repoint consumers at the spool — wait out
+                    # running tasks and leave the cluster
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n).decode().strip().strip('"')
                     if body != "SHUTTING_DOWN":
                         self._json(400, {"error": f"bad state {body!r}"})
                         return
                     worker.draining = True
+                    worker._start_drain()
                     self._json(200, {"state": "SHUTTING_DOWN"})
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
@@ -311,6 +334,23 @@ class WorkerServer:
             name=f"worker-http-{self.port}")
         self._thread.start()
 
+    def _start_drain(self) -> None:
+        """Background drain-and-remove (the PUT /v1/info/state role):
+        grace sleep, then the full graceful shutdown."""
+        import time
+
+        if self._drain_thread is not None:
+            return
+
+        def drain():
+            time.sleep(self.drain_grace_s)
+            self.shutdown_gracefully()
+
+        self._drain_thread = threading.Thread(
+            target=drain, daemon=True,
+            name=f"drain-{self.node_id}")
+        self._drain_thread.start()
+
     def shutdown_gracefully(self, drain_timeout_s: float = 30.0) -> None:
         """Stop accepting tasks, wait for running ones, then close
         (GracefulShutdownHandler drain sequence)."""
@@ -318,9 +358,11 @@ class WorkerServer:
 
         self.draining = True
         deadline = time.monotonic() + drain_timeout_s
-        # wait for tasks to finish AND for consumers to fetch their
-        # buffered output — closing earlier would destroy pages a
-        # downstream stage still needs
+        # wait for tasks to finish AND for their output to be safe:
+        # either consumers fetched it, or (spooled exchange) the whole
+        # output is durable in the spool and consumers re-pull it from
+        # there — closing earlier would destroy pages a downstream
+        # stage still needs
         while (self.task_manager.undrained_count() > 0
                and time.monotonic() < deadline):
             time.sleep(0.05)

@@ -93,6 +93,11 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "speculation_quantile": ("speculation_quantile", float),
     "speculation_lag_factor": ("speculation_lag_factor", float),
     "speculation_min_runtime_s": ("speculation_min_runtime_s", float),
+    "exchange_spooling_enabled": (
+        "exchange_spooling_enabled",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "exchange_max_buffer_bytes": ("exchange_max_buffer_bytes", int),
+    "exchange_spool_stall_s": ("exchange_spool_stall_s", float),
 }
 
 

@@ -70,7 +70,8 @@ class PhysicalPlanner:
                  fetch_headers: Optional[dict] = None,
                  http_client=None, task_id: Optional[str] = None,
                  exchange_register=None,
-                 trace_token: Optional[str] = None):
+                 trace_token: Optional[str] = None,
+                 spool=None):
         """``scan_shard=(task_index, task_count)`` makes scans generate only
         this task's deterministic share of splits (distributed source
         stages, P5); ``remote_sources`` maps fragment id -> producer buffer
@@ -90,6 +91,9 @@ class PhysicalPlanner:
         self.task_id = task_id
         self.trace_token = trace_token
         self.exchange_register = exchange_register
+        # shared SpoolStore for spool:// remote-source locations (the
+        # spooled exchange tier); None when spooling is disabled
+        self.spool = spool
         self._done_pipelines: List[Pipeline] = []
         self._counter = 0
 
@@ -163,7 +167,8 @@ class PhysicalPlanner:
             fac = ExchangeOperatorFactory(
                 locations, headers=self.fetch_headers,
                 http=self.http_client, task_id=self.task_id,
-                trace_token=self.trace_token)
+                trace_token=self.trace_token, spool=self.spool,
+                spool_stall_s=self.config.exchange_spool_stall_s)
             if self.exchange_register is not None:
                 self.exchange_register(fac)
             return ([fac], [])
@@ -179,7 +184,9 @@ class PhysicalPlanner:
                 locations, node.sort_keys,
                 [t for _, t in node.columns], node.limit,
                 headers=self.fetch_headers, http=self.http_client,
-                task_id=self.task_id, trace_token=self.trace_token)
+                task_id=self.task_id, trace_token=self.trace_token,
+                spool=self.spool,
+                spool_stall_s=self.config.exchange_spool_stall_s)
             if self.exchange_register is not None:
                 self.exchange_register(fac)
             return ([fac], [])

@@ -59,8 +59,10 @@ def test_worker_killed_mid_query_leaf_task_rescheduled():
     """Kill a worker whose results are being withheld: the failure
     detector declares it dead, the scheduler re-creates its leaf task on
     the survivor, the consumer's exchange client is repointed, and the
-    query returns the exact count."""
-    cfg = dataclasses.replace(DEFAULT, task_recovery_interval_s=0.05)
+    query returns the exact count.  (Pins the PR 5 cascading tier:
+    spooling off.)"""
+    cfg = dataclasses.replace(DEFAULT, task_recovery_interval_s=0.05,
+                              exchange_spooling_enabled=False)
     inj = FaultInjector()   # victim never serves its result pages
     inj.add_rule(r"/results/", method="GET", policy="drop-connection")
     with DistributedQueryRunner.tpch(
@@ -102,9 +104,13 @@ def test_worker_killed_mid_query_leaf_task_rescheduled():
 
 def test_exhausted_budget_fails_with_task_id_and_endpoint():
     """Persistent drops past the error budget: the failure must name the
-    fetching task and the producer endpoint, not a bare urllib error."""
+    fetching task and the producer endpoint, not a bare urllib error.
+    (Spooling off: with the spooled exchange on, this very scenario is
+    RECOVERED instead — the failed-task tick restarts the consumer
+    reading from the spool, bypassing the faulted HTTP data plane.)"""
     cfg = dataclasses.replace(
-        DEFAULT, remote_request_max_error_duration_s=0.2)
+        DEFAULT, remote_request_max_error_duration_s=0.2,
+        exchange_spooling_enabled=False)
     inj = FaultInjector()
     inj.add_rule(r"/results/", method="GET", policy="drop-connection")
     with DistributedQueryRunner.tpch(
@@ -182,13 +188,16 @@ def _assert_attempt_dedup(q) -> None:
 
 
 def test_worker_killed_nonleaf_stage_retry_exact_rows():
-    """The tentpole: a dead worker owning a NON-leaf task (the probe
-    fragment of a broadcast join) no longer fails the query — the
-    recovery monitor cancels and re-creates the minimal producer
-    subtree under fresh attempt ids, repoints/restarts consumers, and
-    the query returns exact oracle rows with no double-counted pages
-    (pinned by the attempt-aware dedup counters)."""
-    cfg = dataclasses.replace(DEFAULT, task_recovery_interval_s=0.05)
+    """PR 5's tentpole, pinned with spooling OFF (the acceptance pin
+    that ``exchange_spooling_enabled=false`` restores cascading retry
+    exactly): a dead worker owning a NON-leaf task (the probe fragment
+    of a broadcast join) no longer fails the query — the recovery
+    monitor cancels and re-creates the minimal producer subtree under
+    fresh attempt ids, repoints/restarts consumers, and the query
+    returns exact oracle rows with no double-counted pages (pinned by
+    the attempt-aware dedup counters)."""
+    cfg = dataclasses.replace(DEFAULT, task_recovery_interval_s=0.05,
+                              exchange_spooling_enabled=False)
     inj = FaultInjector()   # only the victim withholds its pages
     inj.add_rule(r"/results/", method="GET", policy="drop-connection")
     with DistributedQueryRunner.tpch(
@@ -223,6 +232,10 @@ def test_worker_killed_nonleaf_stage_retry_exact_rows():
                 "select n_name from nation").rows)
         assert len(res["rows"]) == 25
         assert q.stage_retry_rounds >= 1
+        # cascading retry re-ran the producer subtree — the cost the
+        # spooled exchange eliminates (contrast: zero in the spooled
+        # tests below)
+        assert q.producer_reruns_total >= 1
         # the whole subtree moved off the dead worker, on new attempts
         assert all(u != victim_uri for _, _, u in q._placements)
         assert any(tid.rsplit(".", 1)[-1].count("a")
@@ -235,7 +248,8 @@ def test_stage_retry_limit_exhausted_error_context():
     fails the query promptly, naming the stage, the knob, and the lost
     task."""
     cfg = dataclasses.replace(DEFAULT, task_recovery_interval_s=0.05,
-                              stage_retry_limit=0)
+                              stage_retry_limit=0,
+                              exchange_spooling_enabled=False)
     inj = FaultInjector()
     inj.add_rule(r"/results/", method="GET", policy="drop-connection")
     with DistributedQueryRunner.tpch(
@@ -554,7 +568,8 @@ def test_tpcds_q95_worker_kill_stage_retry_exact_rows():
     from tests.tpcds_queries import QUERIES
 
     want = _tpcds_oracle(95)
-    cfg = dataclasses.replace(DEFAULT, task_recovery_interval_s=0.05)
+    cfg = dataclasses.replace(DEFAULT, task_recovery_interval_s=0.05,
+                              exchange_spooling_enabled=False)
     inj = FaultInjector()   # victim withholds results => query in flight
     inj.add_rule(r"/results/", method="GET", policy="drop-connection")
     with DistributedQueryRunner.tpcds(

@@ -318,6 +318,60 @@ def test_worker_metrics_endpoint():
     assert "presto_worker_jit_total" in joined
 
 
+def test_spool_counters_in_stats_rollup_and_metrics():
+    """Spooled-exchange observability: with write-through spooling on
+    (the default) a mesh query reports per-stage spooled-page counts in
+    the PR 6 stats rollup (/v1/query/{id} stageStats + queryStats),
+    system.runtime.queries carries the spooled_pages column, and both
+    metrics planes export presto_spool_bytes_written/read/evicted_total."""
+    import json
+    import urllib.request
+
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2) as dqr:
+        assert dqr.execute(
+            "select count(*) from lineitem").rows == [(59785,)]
+        co = dqr.coordinator
+        qid = list(co.queries)[0]
+        with urllib.request.urlopen(f"{co.uri}/v1/query/{qid}",
+                                    timeout=5) as resp:
+            detail = json.loads(resp.read())
+        # every producing stage wrote its pages through to the spool
+        stage_spooled = {fid: st["pages_spooled"]
+                         for fid, st in detail["stageStats"].items()}
+        assert sum(stage_spooled.values()) > 0, detail["stageStats"]
+        assert detail["queryStats"]["pages_spooled"] == \
+            sum(stage_spooled.values())
+        assert detail["producerReruns"] == 0
+        # system.runtime.queries surfaces the same rollup as SQL
+        rows = dqr.execute(
+            "select spooled_pages, producer_reruns from "
+            "system.runtime.queries where query_id = "
+            f"'{qid}'").rows
+        assert rows and rows[0][0] >= sum(stage_spooled.values())
+        assert rows[0][1] == 0
+        # worker /metrics: write-through bytes counted
+        wrote = 0.0
+        for w in dqr.workers:
+            with urllib.request.urlopen(f"{w.uri}/metrics",
+                                        timeout=5) as resp:
+                text = resp.read().decode()
+            assert "presto_worker_spool_bytes_evicted_total" in text
+            line = next(ln for ln in text.splitlines() if ln.startswith(
+                "presto_worker_spool_bytes_written_total "))
+            wrote += float(line.split()[-1])
+        assert wrote > 0
+        # coordinator /metrics: spool + producer-rerun families present
+        with urllib.request.urlopen(f"{co.uri}/metrics",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        assert "presto_spool_bytes_read_total" in text
+        line = next(ln for ln in text.splitlines() if ln.startswith(
+            "presto_producer_reruns_total "))
+        assert float(line.split()[-1]) == 0
+
+
 def test_json_lines_listener_swallows_bad_path():
     """An unwritable event log must never fail a query (observers are
     isolated, the EventBus contract)."""

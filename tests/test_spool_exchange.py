@@ -1,0 +1,618 @@
+"""Spooled exchange tier (server/spool.py): cascade-free stage retry,
+graceful worker drain, non-leaf speculation, eviction, GC, and the
+fault-policy fallbacks.
+
+The acceptance proofs:
+
+- a worker lost AFTER its tasks finished costs ZERO re-execution:
+  consumers (including the coordinator's root drain) repoint at the
+  spool and resume at their current token;
+- a worker lost MID-RUN re-runs only its own unfinished tasks — the
+  producer subtree is read back from the spool, never re-computed
+  (``producer_reruns_total == 0``);
+- a worker drained mid-query exits the cluster without failing the
+  query, pinned by exact rows + a WorkerDrainEvent;
+- acked+spooled pages evicted under ``max_buffer_bytes`` pressure
+  re-serve from the spool byte-exact on a late re-fetch;
+- spool chaos (read-error / missing-object) retries or falls back to
+  PR 5 cascading retry;
+- a query's spool directory is GC'd at completion and orphans are swept
+  at coordinator start.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.config import DEFAULT
+from presto_tpu.server.dqr import DistributedQueryRunner
+from presto_tpu.server.faults import FaultInjector
+from presto_tpu.server.spool import FileSystemSpoolStore
+
+pytestmark = pytest.mark.chaos
+
+
+def _wait_nodes(co, n, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if len(co.nodes.alive_nodes()) == n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"cluster never reached {n} nodes")
+
+
+def _spool_cfg(tmp_path, **over):
+    return dataclasses.replace(
+        DEFAULT, exchange_spooling_enabled=True,
+        exchange_spool_path=str(tmp_path / "spool"),
+        task_recovery_interval_s=0.05, **over)
+
+
+# -- unit tier: the store and the buffer ------------------------------------
+
+def test_spool_store_roundtrip(tmp_path):
+    store = FileSystemSpoolStore(str(tmp_path / "s"))
+    tid = "q1.2.0a1"
+    store.write_page(tid, 0, 0, b"page-zero")
+    store.write_page(tid, 0, 1, b"page-one")
+    assert not store.is_complete(tid, 1)     # no COMPLETE marker yet
+    store.set_complete(tid, 0, 2)
+    assert store.is_complete(tid, 1)
+    pages, nxt, complete = store.get_pages(tid, 0, 0)
+    assert pages == [b"page-zero", b"page-one"]
+    assert (nxt, complete) == (2, True)
+    # resume mid-stream: same attempt, same tokens
+    pages, nxt, complete = store.get_pages(tid, 0, 1)
+    assert pages == [b"page-one"] and complete
+    # counters moved
+    assert store.stats["bytes_written"] == len(b"page-zero") + \
+        len(b"page-one")
+    assert store.stats["pages_read"] >= 3
+    # GC: the whole query directory goes at once
+    assert store.delete_query("q1")
+    assert store.get_pages(tid, 0, 0) == ([], 0, False)
+
+
+def test_spool_store_orphan_sweep_age_guard(tmp_path):
+    store = FileSystemSpoolStore(str(tmp_path / "s"))
+    store.write_page("old.0.0", 0, 0, b"x")
+    store.write_page("new.0.0", 0, 0, b"y")
+    old_dir = os.path.join(store.root, "old")
+    os.utime(old_dir, (time.time() - 7200, time.time() - 7200))
+    # only the stale query dir is swept; fresh ones (another cluster's
+    # live query on a shared root) survive
+    assert store.sweep_orphans(max_age_s=3600) == 1
+    assert not os.path.exists(old_dir)
+    assert os.path.exists(os.path.join(store.root, "new"))
+
+
+def test_buffer_eviction_respools_exact_bytes(tmp_path):
+    """Acked+spooled pages are evicted at max_buffer_bytes and re-served
+    from the spool on a late re-fetch (the root-drain DISCARD/re-pull
+    shape), byte-exact."""
+    from presto_tpu.server.buffers import OutputBufferManager
+
+    store = FileSystemSpoolStore(str(tmp_path / "s"))
+    pages = [bytes([i]) * 100 for i in range(10)]
+    mgr = OutputBufferManager(1, max_buffer_bytes=250, spool=store,
+                              task_id="q2.0.0")
+    for p in pages:
+        mgr.enqueue(0, p)          # never blocks: eviction makes room
+    mgr.set_no_more_pages()
+    assert mgr.pages_spooled == 10
+    assert mgr.pages_evicted >= 8          # memory held at most 2 pages
+    assert mgr.bytes_evicted == 100 * mgr.pages_evicted
+    assert mgr._bytes <= 250
+    # late re-fetch from token 0: the evicted prefix re-serves from the
+    # spool (and the spool holds the whole stream, so the re-fetch can
+    # run to completion without touching memory)
+    got, nxt, complete = mgr.get_pages(0, 0, max_bytes=1 << 20)
+    while not complete:
+        more, nxt, complete = mgr.get_pages(0, nxt, max_bytes=1 << 20)
+        got.extend(more)
+    assert got == pages and nxt == 10
+    # a bounded re-fetch of just the evicted prefix is byte-exact too
+    some, nxt2, _ = mgr.get_pages(0, 0, max_bytes=150)
+    assert some == pages[:1] and nxt2 == 1
+    # the whole output is durable: the spooled drain condition
+    assert mgr.spooled_complete()
+
+
+def test_spool_fault_policies(tmp_path):
+    """read-error-n-times raises then clears; missing-object persists;
+    HTTP rules never leak onto the spool path."""
+    inj = FaultInjector()
+    inj.add_rule(r"/results/", method="GET", policy="drop-connection")
+    store = FileSystemSpoolStore(str(tmp_path / "s"), injector=inj)
+    store.write_page("q3.0.0", 0, 0, b"z")
+    store.set_complete("q3.0.0", 0, 1)
+    rule = inj.add_spool_rule(r"q3\.0\.0", policy="spool-read-error",
+                              times=2)
+    with pytest.raises(OSError):
+        store.get_pages("q3.0.0", 0, 0)
+    with pytest.raises(OSError):
+        store.get_pages("q3.0.0", 0, 0)
+    assert rule.remaining == 0
+    assert store.get_pages("q3.0.0", 0, 0)[0] == [b"z"]   # recovered
+    inj.add_spool_rule(r"q3\.0\.0", policy="spool-missing")
+    with pytest.raises(FileNotFoundError):
+        store.is_complete("q3.0.0", 1)
+    # the HTTP drop-connection rule fired zero times on the spool path
+    assert all(m != "SPOOL" or p != "drop-connection"
+               for _, m, p in inj.injections)
+
+
+# -- cluster tier -----------------------------------------------------------
+
+def _drain_hold_injector():
+    """Hold the coordinator's root-result drain (client-side) so worker
+    tasks finish while the query is still in flight — the deterministic
+    window every spool scenario below kills or drains a worker in."""
+    inj = FaultInjector()
+    rule = inj.add_rule(r"/results/", method="GET", policy="slow-task")
+    return inj, rule
+
+
+def _root_worker(q, dqr):
+    """(index, uri) of the worker hosting the root gather task."""
+    root_fid = q._dplan.root_fragment_id
+    uri = next(u for f, _, u in q._placements if f == root_fid)
+    idx = next(i for i, w in enumerate(dqr.workers) if w.uri == uri)
+    return idx, uri
+
+
+def _all_finished_and_spooled(worker, qid) -> bool:
+    tasks = [t for t in worker.task_manager.tasks.values()
+             if t.task_id.startswith(qid + ".")]
+    return bool(tasks) and all(
+        t.state == "FINISHED" and t.buffers.spooled_complete()
+        for t in tasks)
+
+
+def _wait_all_spooled(co, dqr, timeout_s=60.0) -> str:
+    """Block until every task of the (single) in-flight query finished
+    producing and its whole output is durable in the spool — the
+    deterministic precondition for every kill-after-finish scenario.
+    Asserts instead of racing on when the machine is loaded."""
+    deadline = time.monotonic() + timeout_s
+    qid = None
+    while time.monotonic() < deadline:
+        if co.queries and qid is None:
+            qid = list(co.queries)[0]
+        if qid and all(_all_finished_and_spooled(w, qid)
+                       for w in dqr.workers):
+            return qid
+        time.sleep(0.02)
+    raise AssertionError("tasks never reached finished+spooled")
+
+
+def _tpch_oracle(sql, scale=0.01):
+    from presto_tpu.localrunner import LocalQueryRunner
+
+    return LocalQueryRunner.tpch(scale=scale).execute(sql).rows
+
+
+def test_worker_killed_after_finish_zero_reruns(tmp_path):
+    """The headline: every task of the victim FINISHED and spooled
+    before the kill — recovery repoints consumers (including the root
+    drain, mid-stream) at the spool; NOTHING re-runs: no stage retry
+    round, no new attempt ids, producer_reruns == 0, exact rows."""
+    sql = ("select l_returnflag, count(*) from lineitem "
+           "group by l_returnflag")
+    want = _tpch_oracle(sql)
+    cfg = _spool_cfg(tmp_path)
+    inj, hold = _drain_hold_injector()
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            coordinator_injector=inj,
+            heartbeat_interval_s=0.05,
+            heartbeat_max_missed=2) as dqr:
+        co = dqr.coordinator
+        _wait_nodes(co, 2)
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = dqr.execute(sql).rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        # wait until EVERY task everywhere finished + spooled (the
+        # held drain keeps the query in flight)
+        qid = _wait_all_spooled(co, dqr)
+        q = co.queries[qid]
+        victim_idx, victim_uri = _root_worker(q, dqr)
+        dqr.kill_worker(victim_idx)
+        # recovery must move the root drain to the spool; then release
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and not q._spool_moves:
+            time.sleep(0.02)
+        assert q._spool_moves, "root drain never repointed at the spool"
+        hold.release()
+        t.join(timeout=60)
+        assert not t.is_alive(), "query hung after worker death"
+        assert "err" not in res, res
+        assert sorted(res["rows"]) == sorted(want)
+        # zero re-execution anywhere
+        assert q.stage_retry_rounds == 0
+        assert q.producer_reruns_total == 0
+        assert all("a" not in tid.rsplit(".", 1)[-1]
+                   for _, tid, _ in q._placements), q._placements
+        assert all(u != victim_uri for _, _, u in q._placements)
+
+
+def test_worker_killed_mid_run_restarts_alone_zero_producer_reruns(
+        tmp_path):
+    """Kill the victim while its tasks still run (results withheld, the
+    PR 5 scenario) with spooling ON: only the victim's own unfinished
+    tasks re-run — their producers are read back from the spool, so
+    producer_reruns stays 0 and rows stay exact."""
+    cfg = _spool_cfg(tmp_path)
+    inj = FaultInjector()   # victim withholds results => query in flight
+    inj.add_rule(r"/results/", method="GET", policy="drop-connection")
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            worker_injectors={1: inj},
+            heartbeat_interval_s=0.05,
+            heartbeat_max_missed=2) as dqr:
+        co = dqr.coordinator
+        _wait_nodes(co, 2)
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = dqr.execute(
+                    "select n_name, count(*) from nation join region "
+                    "on n_regionkey = r_regionkey group by n_name").rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        victim_uri = dqr.workers[1].uri
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            qs = list(co.queries.values())
+            if qs and any(u == victim_uri
+                          for _, _, u in qs[0]._placements):
+                break
+            time.sleep(0.02)
+        q = list(co.queries.values())[0]
+        dqr.kill_worker(1)
+        t.join(timeout=120)
+        assert not t.is_alive(), "query hung after worker death"
+        assert "err" not in res, res
+        assert sorted(res["rows"]) == sorted(
+            (n, 1) for n, in dqr.execute(
+                "select n_name from nation").rows)
+        # the cascade-free guarantee: whatever re-ran, it was never a
+        # producer of a lost stage
+        assert q.producer_reruns_total == 0
+        assert all(u != victim_uri for _, _, u in q._placements)
+
+
+def test_graceful_drain_mid_query_exact_rows_and_event(tmp_path):
+    """PUT /v1/info/state=SHUTTING_DOWN on the worker holding the root
+    task mid-query: its tasks finish, the coordinator repoints the
+    drain at the spool and releases the worker (WorkerDrainEvent), the
+    worker leaves the cluster, and the query stays exact."""
+    from presto_tpu.events import EventListener
+
+    cfg = _spool_cfg(tmp_path)
+    inj, hold = _drain_hold_injector()
+
+    class DrainRecorder(EventListener):
+        events = []
+
+        def worker_drain(self, e):
+            self.events.append(e)
+
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            coordinator_injector=inj,
+            heartbeat_interval_s=0.05,
+            heartbeat_max_missed=3) as dqr:
+        co = dqr.coordinator
+        dqr.event_bus.register(DrainRecorder())
+        _wait_nodes(co, 2)
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = dqr.execute(
+                    "select count(*) from lineitem").rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        # wait until EVERY task everywhere finished + spooled (the
+        # held drain keeps the query in flight)
+        qid = _wait_all_spooled(co, dqr)
+        q = co.queries[qid]
+        victim_idx, victim_uri = _root_worker(q, dqr)
+        victim = dqr.workers[victim_idx]
+        victim.drain_grace_s = 0.3
+        req = urllib.request.Request(
+            f"{victim.uri}/v1/info/state", data=b'"SHUTTING_DOWN"',
+            method="PUT")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read())["state"] == "SHUTTING_DOWN"
+        # the coordinator hands the victim's tasks to the spool and the
+        # worker's background drain closes it
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and \
+                victim_uri not in q._drained_uris:
+            time.sleep(0.02)
+        assert victim_uri in q._drained_uris, "drain tick never released"
+        hold.release()
+        t.join(timeout=60)
+        assert not t.is_alive(), "query hung during graceful drain"
+        assert "err" not in res, res
+        assert res["rows"] == [(59785,)]
+        assert q.producer_reruns_total == 0
+        # the drain event fired with the moved tasks + trace token
+        assert DrainRecorder.events
+        ev0 = DrainRecorder.events[0]
+        assert ev0.worker_uri == victim_uri
+        assert ev0.trace_token == q.trace_token
+        assert ev0.task_ids
+        # the worker really left: its HTTP plane goes dark
+        deadline = time.monotonic() + 20.0
+        gone = False
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(f"{victim_uri}/v1/info",
+                                       timeout=1)
+            except Exception:  # noqa: BLE001 - closed = unreachable
+                gone = True
+                break
+            time.sleep(0.05)
+        assert gone, "drained worker never shut down"
+        dqr.workers = [w for i, w in enumerate(dqr.workers)
+                       if i != victim_idx]
+
+
+def test_spool_missing_object_falls_back_to_cascading_retry(tmp_path):
+    """Spool verification faulted (missing-object on the coordinator's
+    store): recovery falls back to PR 5 cascading stage retry — the
+    query survives with exact rows, paying producer re-runs."""
+    cfg = _spool_cfg(tmp_path)
+    co_inj = FaultInjector()
+    co_inj.add_spool_rule(r".", policy="spool-missing")
+    inj = FaultInjector()
+    inj.add_rule(r"/results/", method="GET", policy="drop-connection")
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            coordinator_injector=co_inj,
+            worker_injectors={1: inj},
+            heartbeat_interval_s=0.05,
+            heartbeat_max_missed=2) as dqr:
+        co = dqr.coordinator
+        _wait_nodes(co, 2)
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = dqr.execute(
+                    "select n_name, count(*) from nation join region "
+                    "on n_regionkey = r_regionkey group by n_name").rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        victim_uri = dqr.workers[1].uri
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            qs = list(co.queries.values())
+            if qs and any(u == victim_uri
+                          for _, _, u in qs[0]._placements):
+                break
+            time.sleep(0.02)
+        q = list(co.queries.values())[0]
+        dqr.kill_worker(1)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert "err" not in res, res
+        assert len(res["rows"]) == 25
+        # the fallback really cascaded (and the fault really fired)
+        assert q.stage_retry_rounds >= 1
+        assert any(m == "SPOOL" for _, m, _ in co_inj.injections)
+
+
+def test_spool_read_error_retried_by_consumer(tmp_path):
+    """Transient spool read errors retry on the error-budget discipline
+    instead of failing the drain; rows stay exact."""
+    cfg = _spool_cfg(tmp_path)
+    co_inj, hold = _drain_hold_injector()
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            coordinator_injector=co_inj,
+            heartbeat_interval_s=0.05,
+            heartbeat_max_missed=2) as dqr:
+        co = dqr.coordinator
+        _wait_nodes(co, 2)
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = dqr.execute(
+                    "select count(*) from lineitem").rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        # wait until EVERY task everywhere finished + spooled (the
+        # held drain keeps the query in flight)
+        qid = _wait_all_spooled(co, dqr)
+        q = co.queries[qid]
+        victim_idx, _uri = _root_worker(q, dqr)
+        dqr.kill_worker(victim_idx)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and not q._spool_moves:
+            time.sleep(0.02)
+        assert q._spool_moves
+        # NOW fault the coordinator's spool reads: the root drain must
+        # retry through them (the faults fire on the first two reads)
+        rule = co_inj.add_spool_rule(r".", policy="spool-read-error",
+                                     times=2)
+        hold.release()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert "err" not in res, res
+        assert res["rows"] == [(59785,)]
+        assert rule.remaining == 0      # both faults really fired
+
+
+def test_nonleaf_speculation_with_spool(tmp_path):
+    """Non-leaf speculation, legal only with the spooled exchange: a
+    held PROBE task (fragment 1 of a broadcast join — it consumes the
+    broadcast build, so PR 5 refused to clone it) gets a clone that
+    reads the build back from the spool (token 0, no buffer race), wins
+    the race under a new attempt id, and the query stays exact."""
+    cfg = _spool_cfg(
+        tmp_path, speculative_execution_enabled=True,
+        speculation_min_runtime_s=0.3, speculation_lag_factor=2.0)
+    inj = FaultInjector()
+    # hold ONLY the non-leaf probe task {qid}.1.0's results drain —
+    # placed on worker 0 (first in topology order); its clone lands on
+    # worker 1, whose injector-free drain must win the race
+    rules = [inj.add_slow_task(r"\.1\.0")]
+    try:
+        with DistributedQueryRunner.tpch(
+                scale=0.01, n_workers=2, config=cfg,
+                worker_injectors={0: inj},
+                heartbeat_interval_s=0.05) as dqr:
+            co = dqr.coordinator
+            _wait_nodes(co, 2)
+            res = {}
+
+            sql = ("select n_name, count(*) from nation join region "
+                   "on n_regionkey = r_regionkey group by n_name")
+            want = _tpch_oracle(sql)
+
+            def run():
+                try:
+                    res["rows"] = dqr.execute(sql).rows
+                except Exception as e:  # noqa: BLE001
+                    res["err"] = e
+
+            t = threading.Thread(target=run)
+            t.start()
+            deadline = time.monotonic() + 30.0
+            q = None
+            won = None
+            while time.monotonic() < deadline:
+                qs = list(co.queries.values())
+                if qs:
+                    q = qs[0]
+                    won = [tid for tid, sp in q._speculations.items()
+                           if sp["state"] == "won"]
+                    if won:
+                        break
+                time.sleep(0.02)
+            assert won, (q._speculations if q else "no query")
+            # the winning clone is a NON-leaf task (final agg, frag 1)
+            assert won[0].split(".")[1] == "1", won
+            for r in rules:
+                r.release()
+            t.join(timeout=60)
+            assert not t.is_alive(), "query hung after speculation"
+            assert "err" not in res, res
+            assert sorted(res["rows"]) == sorted(want)
+            clone = q._speculations[won[0]]["clone"]
+            assert clone.endswith("a1")
+            assert any(tid == clone for _, tid, _ in q._placements)
+    finally:
+        inj.release_all()
+
+
+def test_spool_gc_on_completion_and_orphan_sweep(tmp_path):
+    """No leaked spool files: a finished query's directory is deleted,
+    and a stale orphan left behind is swept at coordinator start."""
+    cfg = _spool_cfg(tmp_path, exchange_spool_orphan_age_s=3600)
+    root = cfg.exchange_spool_path
+    # plant a stale orphan a crashed coordinator would have left
+    orphan = os.path.join(root, "deadbeef00000000", "deadbeef.0.0", "0")
+    os.makedirs(orphan)
+    with open(os.path.join(orphan, "00000000.page"), "wb") as f:
+        f.write(b"stale")
+    old = time.time() - 7200
+    os.utime(os.path.join(root, "deadbeef00000000"), (old, old))
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=cfg) as dqr:
+        assert not os.path.exists(
+            os.path.join(root, "deadbeef00000000")), "orphan not swept"
+        assert dqr.execute("select count(*) from nation").rows == [(25,)]
+        qid = list(dqr.coordinator.queries)[0]
+        # GC runs in the query thread's finally, just after the client
+        # unblocks — poll briefly
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                os.path.exists(os.path.join(root, qid)):
+            time.sleep(0.05)
+        assert not os.path.exists(os.path.join(root, qid)), \
+            "query spool dir leaked"
+
+
+@pytest.mark.slow
+def test_q72_kill_every_stage_zero_producer_reruns(tmp_path):
+    """The acceptance sweep: kill every stage of TPC-DS Q72 in turn
+    (SF0.003, 2-worker DQR, spooling on) — each run recovers with ZERO
+    producer re-runs and exact rows."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tools.chaos_run import run_spool_sweep
+
+    report = run_spool_sweep(
+        scale=0.003, spooling=True,
+        spool_path=str(tmp_path / "sweep-spool"), quiet=True)
+    assert report["ok"], report
+    assert report["total_producer_reruns"] == 0
+    assert all(s["recovery_rounds"] >= 1 for s in report["stages"])
+
+
+@pytest.mark.slow
+def test_q72_stage_kill_spooling_off_cascades(tmp_path):
+    """The contrast pin: the same kill on Q72's big mid-plan join
+    fragment with ``exchange_spooling_enabled=false`` restores PR 5
+    cascading retry exactly — the producer subtree re-runs."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tools.chaos_run import run_spool_sweep
+
+    # fragment 10 consumes Q72's nine leaf fragments: losing it must
+    # re-execute that whole subtree when there is no spool
+    report = run_spool_sweep(
+        scale=0.003, spooling=False, fragments=[10],
+        spool_path=str(tmp_path / "sweep-nospool"), quiet=True)
+    assert all(s["ok"] for s in report["stages"]), report
+    assert report["total_producer_reruns"] >= 1
+    assert report["stages"][0]["stage_retry_rounds"] >= 1
+
+
+def test_spooling_off_writes_nothing(tmp_path):
+    """The off switch really restores the PR 5 data plane: no spool
+    directory is ever created."""
+    cfg = dataclasses.replace(
+        DEFAULT, exchange_spooling_enabled=False,
+        exchange_spool_path=str(tmp_path / "spool-off"))
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=cfg) as dqr:
+        assert dqr.execute("select count(*) from nation").rows == [(25,)]
+        q = list(dqr.coordinator.queries.values())[0]
+        assert q.producer_reruns_total == 0
+    assert not os.path.exists(str(tmp_path / "spool-off"))

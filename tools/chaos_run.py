@@ -35,6 +35,140 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 
+def run_spool_sweep(scale: float = 0.003, spooling: bool = True,
+                    query_num: int = 72, fragments=None,
+                    spool_path=None, quiet: bool = False) -> dict:
+    """Kill-every-stage-in-turn sweep of a TPC-DS query on the 2-worker
+    mesh (the spooled-exchange acceptance proof): for each fragment of
+    the plan, run the query with the root drain held, kill the worker
+    hosting that fragment's first task while the query is in flight,
+    and record rows-exactness + producer re-runs.
+
+    ``spooling=True`` must recover every stage with ZERO producer
+    re-runs (output re-pulled from the spool); ``spooling=False``
+    restores the PR 5 cascading behavior (non-leaf kills re-run the
+    producer subtree)."""
+    import dataclasses as _dc
+    import tempfile
+    import threading as _th
+
+    from presto_tpu.config import DEFAULT
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+    from presto_tpu.localrunner import LocalQueryRunner
+    from presto_tpu.server.dqr import DistributedQueryRunner
+    from presto_tpu.server.faults import FaultInjector
+    from tests.tpcds_queries import QUERIES
+
+    sql = QUERIES[query_num]
+    reg = ConnectorRegistry()
+    reg.register("tpcds", TpcdsConnector(scale=scale))
+    want = sorted(LocalQueryRunner(reg, "tpcds").execute(sql).rows)
+    cfg = _dc.replace(
+        DEFAULT, task_recovery_interval_s=0.05,
+        exchange_spooling_enabled=spooling,
+        exchange_spool_path=(spool_path or os.path.join(
+            tempfile.mkdtemp(prefix="spool-sweep-"), "spool")))
+    # every fragment of the plan, killed in turn
+    if fragments is None:
+        from presto_tpu.server.fragmenter import Fragmenter
+        from presto_tpu.sql.optimizer import optimize
+        from presto_tpu.sql.parser import parse_statement
+        from presto_tpu.sql.planner import Metadata, Planner
+
+        md = Metadata(reg, "tpcds")
+        plan = optimize(Planner(md).plan(parse_statement(sql)), md, cfg)
+        fragments = [f.fragment_id for f in Fragmenter(
+            metadata=md, config=cfg).fragment(plan).fragments]
+    stages = []
+    for fid in fragments:
+        t0 = time.monotonic()
+        co_inj = FaultInjector()
+        hold = co_inj.add_rule(r"/results/", method="GET",
+                               policy="slow-task")
+        res = {}
+        with DistributedQueryRunner.tpcds(
+                scale=scale, n_workers=2, config=cfg,
+                coordinator_injector=co_inj,
+                heartbeat_interval_s=0.05,
+                heartbeat_max_missed=2) as dqr:
+            co = dqr.coordinator
+            while len(co.nodes.alive_nodes()) != 2:
+                time.sleep(0.02)
+
+            def run():
+                try:
+                    res["rows"] = dqr.execute(sql).rows
+                except Exception as e:  # noqa: BLE001
+                    res["err"] = str(e)
+
+            t = _th.Thread(target=run)
+            t.start()
+            # the victim is whichever worker hosts {fid}.0; the held
+            # drain guarantees the query is still in flight at the kill
+            victim_uri = None
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                qs = list(co.queries.values())
+                if qs:
+                    hit = [u for f, tid, u in qs[0]._placements
+                           if f == fid and tid.endswith(f".{fid}.0")]
+                    if hit:
+                        victim_uri = hit[0]
+                        break
+                time.sleep(0.01)
+            q = list(co.queries.values())[0]
+            victim_idx = next(i for i, w in enumerate(dqr.workers)
+                              if w.uri == victim_uri)
+            dqr.kill_worker(victim_idx)
+            # keep the drain held until the recovery monitor actually
+            # handled the dead worker, so every stage kill exercises
+            # recovery (not a lucky drain-first finish)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and \
+                    victim_uri not in q._recovered_uris:
+                time.sleep(0.02)
+            hold.release()
+            t.join(timeout=300)
+            stage = {
+                "fragment": fid, "killed_worker": victim_uri,
+                "wall_s": round(time.monotonic() - t0, 2),
+                "producer_reruns": q.producer_reruns_total,
+                "stage_retry_rounds": q.stage_retry_rounds,
+                "recovery_rounds": q.recovery_rounds,
+                "spool_repoints": len(q._spool_moves) + sum(
+                    1 for _, _, u in q._placements
+                    if str(u).startswith("spool://")),
+            }
+            if t.is_alive():
+                stage["ok"] = False
+                stage["reason"] = "query hung"
+            elif "err" in res:
+                stage["ok"] = False
+                stage["reason"] = res["err"][:300]
+            elif sorted(res["rows"]) != want:
+                stage["ok"] = False
+                stage["reason"] = "row mismatch"
+            elif q.recovery_rounds < 1:
+                stage["ok"] = False
+                stage["reason"] = "kill never triggered recovery"
+            else:
+                stage["ok"] = True
+            stages.append(stage)
+            if not quiet:
+                print(json.dumps(stage))
+    total_reruns = sum(s["producer_reruns"] for s in stages)
+    report = {
+        "mode": "spool", "query": f"tpcds q{query_num}",
+        "scale": scale, "spooling": spooling,
+        "stages": stages,
+        "total_producer_reruns": total_reruns,
+        "ok": all(s["ok"] for s in stages) and (
+            total_reruns == 0 if spooling else True),
+    }
+    return report
+
+
 def run_check() -> int:
     """CI smoke: the chaos marker tier, headless (quick signal — the
     TPC-DS mesh cases are additionally marked slow and excluded)."""
@@ -43,8 +177,9 @@ def run_check() -> int:
     env.setdefault("JAX_PLATFORMS", "cpu")
     r = subprocess.run(
         [sys.executable, "-m", "pytest", "-q", "-m", "chaos and not slow",
-         "-p", "no:cacheprovider", os.path.join(repo, "tests",
-                                                "test_chaos.py")],
+         "-p", "no:cacheprovider",
+         os.path.join(repo, "tests", "test_chaos.py"),
+         os.path.join(repo, "tests", "test_spool_exchange.py")],
         cwd=repo, env=env)
     print(json.dumps({"check": "chaos marker tier",
                       "ok": r.returncode == 0}))
@@ -58,10 +193,18 @@ def main() -> int:
     ap.add_argument("--query", default="select count(*) from lineitem")
     ap.add_argument("--kill-index", type=int, default=None,
                     help="worker to kill (default: last)")
-    ap.add_argument("--mode", choices=["leaf", "stage"], default="leaf",
+    ap.add_argument("--mode", choices=["leaf", "stage", "spool"],
+                    default="leaf",
                     help="leaf = kill a scan-task worker; stage = kill "
                          "a worker holding a non-leaf fragment "
-                         "(whole-stage retry)")
+                         "(whole-stage retry); spool = kill EVERY "
+                         "stage of TPC-DS Q72 in turn on the spooled "
+                         "exchange, reporting producer re-runs per "
+                         "stage (must be zero)")
+    ap.add_argument("--no-spooling", action="store_true",
+                    help="spool mode only: run the sweep with "
+                         "exchange spooling disabled (PR 5 cascading "
+                         "retry) for comparison")
     ap.add_argument("--check", action="store_true",
                     help="run the chaos pytest tier headless; exit "
                          "nonzero on any inexact result")
@@ -71,6 +214,12 @@ def main() -> int:
     args = ap.parse_args()
     if args.check:
         return run_check()
+    if args.mode == "spool":
+        report = run_spool_sweep(
+            scale=args.scale if args.scale != 0.01 else 0.003,
+            spooling=not args.no_spooling)
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
     if args.mode == "stage":
         args.query = ("select n_name, count(*) from nation join region "
                       "on n_regionkey = r_regionkey group by n_name")

@@ -143,9 +143,14 @@ def profile_replay(args) -> int:
                      f"wall={e.get('end_time', 0) - e.get('create_time', 0):.3f}s")
         elif kind == "StageRetryEvent":
             extra = (f"fragments={e.get('fragment_ids')} "
-                     f"round={e.get('round')} reason={e.get('reason')!r}")
+                     f"round={e.get('round')} reason={e.get('reason')!r} "
+                     f"producer_reruns={e.get('producer_reruns')} "
+                     f"spooled={e.get('spooled')}")
         elif kind == "TaskRecoveryEvent":
             extra = f"dead={e.get('dead_uri')} tasks={e.get('task_ids')}"
+        elif kind == "WorkerDrainEvent":
+            extra = (f"worker={e.get('worker_uri')} "
+                     f"tasks={e.get('task_ids')}")
         elif kind == "SpeculationEvent":
             extra = (f"{e.get('task_id')} -> {e.get('clone_id')} "
                      f"[{e.get('outcome')}]")
