@@ -33,6 +33,13 @@ engine-path depth ROADMAP #10 still wanted.  ``vs_baseline`` is the
 single-process engine wall ratio, so the line prices the distribution
 overhead directly.
 
+Config 10 (``bench_concurrent_qps``) measures the SERVING tier: N
+concurrent clients (tools/qps_run.py closed loop) against a live
+2-worker cluster with resource-group admission engaged — QPS and
+p50/p95/p99 latency at 4 concurrency levels, per-client exact-rows
+parity, plan-cache hit rate, and jit_compiles == 0 on the second
+execution of a cached plan (the dispatcher + plan-cache PR).
+
 Timing methodology (axon tunnel quirks): run K dependence-chained
 iterations INSIDE one jitted fori_loop and take the slope between two K
 values, so RPC overhead and sync-polling granularity cancel.
@@ -1063,6 +1070,46 @@ def bench_tpcds_mesh_q72q95_spooled(scale: float):
     return _bench_tpcds_mesh(scale, spooling=True)
 
 
+def bench_concurrent_qps(scale: float):
+    """Serving-tier sustained QPS (tools/qps_run.py): N concurrent
+    clients driving the mixed TPC-H/TPC-DS statement set against a live
+    2-worker DistributedQueryRunner with resource-group admission
+    engaged — QPS + p50/p95/p99 per concurrency level, exact-rows
+    parity per client, plan-cache hit rate, and the zero-jit-compile
+    proof for the second execution of a cached plan."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import qps_run
+
+    report = qps_run.run_qps(scale=scale, levels=(1, 2, 4, 8),
+                             requests_per_client=6, mode="closed",
+                             quiet=True)
+    peak = max(lv["qps"] for lv in report["levels"])
+    levels = []
+    for lv in report["levels"]:
+        row = {k: lv[k] for k in ("concurrency", "qps", "p50_ms",
+                                  "p95_ms", "p99_ms", "parity")}
+        row["plan_cache_hit_rate"] = lv["plan_cache"]["hit_rate"]
+        levels.append(row)
+    return {
+        "metric": f"tpcds_sf{scale:g}_concurrent_qps_peak",
+        "value": peak, "unit": "qps",
+        # scaling vs the single-client level: how much of the added
+        # concurrency the serving tier converts into throughput
+        "vs_baseline": round(peak / report["levels"][0]["qps"], 3)
+        if report["levels"][0]["qps"] else 0.0,
+        "engine_path": True, "distributed": True, "workers": 2,
+        "levels": levels,
+        "plan_cache_hit_rate": report["plan_cache_hit_rate"],
+        "second_run_jit_compiles": report["second_run_jit_compiles"],
+        "queries_queued": report["queries_queued"],
+        "resource_groups": report["resource_groups"],
+        "parity": report["parity"],
+    }
+
+
 def bench_sqlite_baseline(scale: float):
     """External (non-self-authored) CPU baseline: the sqlite3 engine over
     IDENTICAL generated data, per BASELINE.md's measurement note — the
@@ -1229,6 +1276,7 @@ def main() -> None:
                 (bench_mesh_q1q6, 0.05, 0.0),
                 (bench_tpcds_mesh_q72q95, 0.003, 0.0),
                 (bench_tpcds_mesh_q72q95_spooled, 0.003, 0.0),
+                (bench_concurrent_qps, 0.003, 0.0),
                 (bench_sqlite_baseline, 0.05, 0.0)]
         _emit(_run_jobs(headline, jobs, budget_s))
         return
@@ -1250,6 +1298,7 @@ def main() -> None:
             (bench_mesh_q1q6, 0.2, 0.0),
             (bench_tpcds_mesh_q72q95, 0.003, 0.0),
             (bench_tpcds_mesh_q72q95_spooled, 0.003, 0.0),
+            (bench_concurrent_qps, 0.003, 0.0),
             (bench_whole_query_q3, 0.1, 0.0),
             (bench_sqlite_baseline, 0.2, 0.0),
             (bench_q3, 10.0, 0.65),

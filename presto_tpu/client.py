@@ -17,7 +17,17 @@ from typing import List, Optional, Sequence, Tuple
 
 
 class QueryFailed(RuntimeError):
-    pass
+    """Query error surfaced through the statement protocol; carries the
+    reference's error shape when the server supplied one (errorName /
+    errorType / errorCode, e.g. QUERY_QUEUE_FULL rejections)."""
+
+    def __init__(self, message: str, error_name: Optional[str] = None,
+                 error_type: Optional[str] = None,
+                 error_code: Optional[int] = None):
+        super().__init__(message)
+        self.error_name = error_name
+        self.error_type = error_type
+        self.error_code = error_code
 
 
 class StatementClient:
@@ -27,18 +37,25 @@ class StatementClient:
     (X-Presto-Session / X-Presto-Catalog / X-Presto-Prepared-Statements)
     on every subsequent statement."""
 
-    def __init__(self, coordinator_uri: str, poll_interval_s: float = 0.05):
+    def __init__(self, coordinator_uri: str, poll_interval_s: float = 0.05,
+                 user: Optional[str] = None):
         self.base = coordinator_uri.rstrip("/")
         self.poll_interval_s = poll_interval_s
+        self.user = user
         self.session_properties: dict = {}
         self.catalog: Optional[str] = None
         self.schema: Optional[str] = None
         self.prepared_statements: dict = {}
+        # query id of the most recent execute() — lets harnesses fetch
+        # /v1/query/{id} detail (stats, plan-cache disposition) after
+        self.last_query_id: Optional[str] = None
 
     def _headers(self) -> dict:
         import urllib.parse
 
         h = {"Content-Type": "text/plain"}
+        if self.user:
+            h["X-Presto-User"] = self.user
         if self.session_properties:
             h["X-Presto-Session"] = ",".join(
                 f"{k}={urllib.parse.quote(str(v))}"
@@ -76,6 +93,7 @@ class StatementClient:
             method="POST", headers=self._headers())
         with urllib.request.urlopen(req, timeout=30) as resp:
             payload = json.loads(resp.read())
+        self.last_query_id = payload.get("id")
         deadline = time.monotonic() + timeout_s
         while True:
             state = payload.get("stats", {}).get("state")
@@ -87,8 +105,11 @@ class StatementClient:
                                             timeout=30) as resp:
                     payload = json.loads(resp.read())
             if state == "FAILED" or "error" in payload:
-                raise QueryFailed(
-                    payload.get("error", {}).get("message", "query failed"))
+                err = payload.get("error", {})
+                raise QueryFailed(err.get("message", "query failed"),
+                                  error_name=err.get("errorName"),
+                                  error_type=err.get("errorType"),
+                                  error_code=err.get("errorCode"))
             # only a results payload carries "columns"; the POST ack and
             # queued/running payloads carry just state+nextUri (a fast
             # statement can reach FINISHED before the first poll, so

@@ -305,6 +305,19 @@ class EngineConfig:
     # are removed (crashed-coordinator leftovers); the age guard keeps a
     # shared spool root safe across concurrent clusters
     exchange_spool_orphan_age_s: float = 3600.0
+    # --- serving tier (server/dispatcher.py + sql/plancache.py) ----------
+    # plan cache: repeated statements (same normalized SQL, catalog,
+    # session-property fingerprint, current per-catalog stats epochs)
+    # reuse the fragmented plan and skip parse/analyze/optimize; any
+    # DDL/DML against a catalog bumps its epoch and invalidates plans
+    # scanning it.  OFF restores inline planning exactly.
+    plan_cache_enabled: bool = True
+    # entries kept in the shared plan cache (LRU)
+    plan_cache_capacity: int = 128
+    # how long a dispatched query may wait for a resource-group slot
+    # before failing with the queue-timeout error (the reference's
+    # query.max-queued-time role)
+    query_queue_timeout_s: float = 300.0
 
 
 DEFAULT = EngineConfig()

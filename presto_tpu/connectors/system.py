@@ -97,7 +97,12 @@ class SystemConnector(_VirtualConnector):
             # to the spool, and producer tasks re-executed by stage
             # retry (0 with spooling on — the cascade-free guarantee)
             ("spooled_pages", T.BIGINT),
-            ("producer_reruns", T.BIGINT)], queries_fn)
+            ("producer_reruns", T.BIGINT),
+            # serving tier (server/dispatcher.py): admission wait,
+            # resource group, plan-cache disposition
+            ("queued_s", T.DOUBLE),
+            ("resource_group", T.VARCHAR),
+            ("plan_cached", T.BOOLEAN)], queries_fn)
         self.add_table("tasks", [
             ("task_id", T.VARCHAR), ("state", T.VARCHAR),
             ("query_id", T.VARCHAR), ("output_rows", T.BIGINT),

@@ -219,6 +219,11 @@ class QueryStats:
 
     query_id: str = ""
     elapsed_s: float = 0.0
+    # serving-tier split (server/dispatcher.py): seconds queued for
+    # resource-group admission vs executing (admission -> settled);
+    # the local tier reports queued 0
+    queued_s: float = 0.0
+    execution_s: float = 0.0
     total_wall_ns: int = 0
     input_rows: int = 0
     output_rows: int = 0

@@ -95,6 +95,8 @@ class LocalQueryRunner:
         self._last_task = None
         self._query_seq = 0
         self._whole_query = None   # lazy MeshQueryRunner (1-device)
+        # (key, epochs) while the in-flight statement is plan-cacheable
+        self._plan_cache_key = None
 
     @classmethod
     def tpch(cls, scale: float = 0.01,
@@ -167,10 +169,48 @@ class LocalQueryRunner:
         return result
 
     def _execute_statement(self, sql: str) -> QueryResult:
-        stmt = parse_statement(sql)
-        return self._execute_parsed(stmt)
+        from presto_tpu.sql import plancache
+
+        cfg = self.session.effective_config(self.config)
+        self._plan_cache_key = None
+        if cfg.plan_cache_enabled:
+            # serving-tier plan cache (sql/plancache.py): a repeated
+            # statement under the same catalog/schema/session
+            # fingerprint and live stats epochs reuses its optimized
+            # plan — parse/analyze/optimize all skipped
+            epochs = plancache.epochs_for(self.registry)
+            key = plancache.cache_key(
+                epochs, sql, self.metadata.default_catalog,
+                self.session.schema, self.session.properties)
+            hit = plancache.get(key, epochs)
+            if hit is not None:
+                optimized, label = hit
+                return self._execute_optimized(optimized, cfg, label)
+            self._plan_cache_key = (key, epochs)
+        try:
+            stmt = parse_statement(sql)
+            return self._execute_parsed(stmt)
+        finally:
+            self._plan_cache_key = None
 
     def _execute_parsed(self, stmt: t.Node) -> QueryResult:
+        # per-catalog stats-epoch bump: any statement that changes a
+        # catalog's data or metadata invalidates cached plans scanning
+        # it (bumped up front — a failed write costs one spurious miss,
+        # never a stale plan)
+        if isinstance(stmt, (t.CreateTable, t.CreateTableAs, t.Insert,
+                             t.Delete, t.DropTable, t.RenameTable,
+                             t.CreateView, t.DropView, t.Analyze)):
+            from presto_tpu.sql import plancache
+
+            name = getattr(stmt, "table", None) or \
+                getattr(stmt, "view", None)
+            try:
+                cat = (self.metadata.split_name(tuple(name))[0]
+                       if name else self.metadata.default_catalog)
+            except Exception:  # noqa: BLE001 - bad name errors later
+                cat = self.metadata.default_catalog
+            plancache.epochs_for(self.registry).bump(cat)
         if isinstance(stmt, t.CallProcedure):
             raise ValueError(
                 "procedures (kill_query) run on a coordinator; the "
@@ -266,6 +306,10 @@ class LocalQueryRunner:
         if isinstance(stmt, t.ExecutePrepared):
             prepared = self._get_prepared(stmt.name)
             bound = t.substitute_parameters(prepared, stmt.parameters)
+            # never cache under the raw EXECUTE text: a re-PREPARE of
+            # the same name would alias a stale plan (the coordinator
+            # tier keys EXECUTE on prepared text + bound parameters)
+            self._plan_cache_key = None
             return self._execute_parsed(bound)
         if isinstance(stmt, t.Deallocate):
             self._get_prepared(stmt.name)
@@ -681,12 +725,16 @@ class LocalQueryRunner:
         """EXPLAIN ANALYZE: run the query, render the plan plus the
         per-operator wall/row rollup the Driver recorded
         (ExplainAnalyzeOperator.java:34 + planPrinter role)."""
+        import time as _time
+
         if not isinstance(stmt, (t.Query, t.SetOperation)):
             raise ValueError("EXPLAIN ANALYZE requires a query")
+        t0 = _time.perf_counter()
         logical = Planner(self.metadata).plan(stmt)
         optimized = optimize(logical, self.metadata)
         phys = PhysicalPlanner(self.registry, self.config).plan(optimized)
         task = execute_pipelines(phys.pipelines, self.config)
+        execution_s = _time.perf_counter() - t0
         lines = [format_plan(optimized).rstrip(), "", "Operator stats:"]
         # same counter set as the distributed tier's _render_analyze
         # (jit dispatch/compile, pre-reduce rows, peak memory) so the
@@ -707,6 +755,11 @@ class LocalQueryRunner:
             f"jit dispatches: {jc['dispatches']}, "
             f"compiles: {jc['compiles']}; "
             f"prereduce rows: {jc['prereduce_rows']}")
+        # queued-vs-execution split: same footer shape as the
+        # distributed tier's _render_analyze (the single-process runner
+        # executes synchronously — queued is always 0)
+        lines.append(f"serving: queued 0.000 s, "
+                     f"execution {execution_s:.3f} s")
         for d in task.driver_stats:
             lines.append(
                 f"driver {d.pipeline}: {d.operators} operators, "
@@ -736,9 +789,25 @@ class LocalQueryRunner:
         cfg = self.session.effective_config(self.config)
         logical = Planner(self.metadata).plan(q)
         optimized = optimize(logical, self.metadata, cfg)
+        if self._plan_cache_key is not None:
+            from presto_tpu.sql import plancache
+
+            key, epochs = self._plan_cache_key
+            self._plan_cache_key = None
+            cats = plancache.scan_catalogs(optimized)
+            cats.add(self.metadata.default_catalog)
+            plancache.put(key, (optimized, repr(q)), epochs, cats,
+                          cfg.plan_cache_capacity)
+        return self._execute_optimized(optimized, cfg, repr(q))
+
+    def _execute_optimized(self, optimized, cfg,
+                           label: str) -> QueryResult:
+        """Run an already-optimized plan (fresh or plan-cache hit);
+        access control still runs per execution (the cache key carries
+        no identity)."""
         self._check_scans(optimized)
         if cfg.whole_query_execution:
-            result = self._try_whole_query(q, optimized)
+            result = self._try_whole_query(label, optimized)
             if result is not None:
                 return result
         phys = PhysicalPlanner(self.registry, cfg).plan(optimized)
@@ -748,7 +817,7 @@ class LocalQueryRunner:
         return QueryResult(phys.column_names, phys.column_types,
                            phys.collector.rows())
 
-    def _try_whole_query(self, q: t.Node,
+    def _try_whole_query(self, label: str,
                          optimized) -> Optional[QueryResult]:
         """Whole-query XLA execution: the mesh-SQL lowering on a
         single-device mesh compiles the ENTIRE query into one cached
@@ -767,7 +836,7 @@ class LocalQueryRunner:
         try:
             # the optimized plan is reused (no second plan+optimize);
             # access control already ran over its scans
-            return self._whole_query.execute_plan(optimized, repr(q))
+            return self._whole_query.execute_plan(optimized, label)
         except (MeshUnsupported, NotImplementedError):
             return None
         except ValueError:

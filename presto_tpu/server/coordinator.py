@@ -175,7 +175,8 @@ class QueryExecution:
                  session_properties: Optional[Dict[str, str]] = None,
                  catalog: Optional[str] = None,
                  prepared: Optional[Dict[str, str]] = None,
-                 trace_token: Optional[str] = None):
+                 trace_token: Optional[str] = None,
+                 auto_start: bool = True):
         self.query_id = query_id
         self.sql = sql
         self.co = coordinator
@@ -199,6 +200,23 @@ class QueryExecution:
         self.state = "QUEUED"
         self.canceled = False
         self.error: Optional[str] = None
+        # the reference's error shape (StandardErrorCode): set by the
+        # dispatcher for admission-layer failures (queue full, user
+        # cancel); None = generic failure, message-only
+        self.error_name: Optional[str] = None
+        self.error_type: Optional[str] = None
+        self.error_code: Optional[int] = None
+        # serving-tier time split: seconds spent queued for admission
+        # vs executing (planning through drain) — the queued-vs-execution
+        # split QueryStats, /v1/query/{id}, and EXPLAIN ANALYZE report
+        self.queued_s = 0.0
+        self.execution_s = 0.0
+        self.admit_time: Optional[float] = None
+        self.resource_group_name = ""
+        # EXECUTE-bound prepared statements cache under a derived key
+        # (prepared text + bound parameters), set by _session_statement
+        self._plan_key_sql: Optional[str] = None
+        self.plan_cached = False      # this run reused a cached plan
         self.plan_text: str = ""
         self._tasks_scheduled = False
         # (fragment_id, task_id, worker_uri) per scheduled task — the
@@ -270,8 +288,17 @@ class QueryExecution:
         self.co.event_bus.query_created(ev.QueryCreatedEvent(
             self.query_id, self.user, self.sql, self.create_time,
             trace_token=self.trace_token))
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self._start()
+
+    def _start(self) -> None:
+        """Start the per-query thread (the dispatcher defers this until
+        its loop picks the query up)."""
+        if self._thread is not None:
+            return
         self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"query-{query_id}")
+                                        name=f"query-{self.query_id}")
         self._thread.start()
 
     def _run(self) -> None:
@@ -309,9 +336,85 @@ class QueryExecution:
             stage_stats=[self.stage_stats[fid]
                          for fid in sorted(self.stage_stats)]))
 
+    def _execute_query_dplan(self, dplan: DistributedPlan,
+                             analyze: bool) -> None:
+        """Schedule + drain one fragmented query plan (shared by the
+        freshly-planned and plan-cache-hit paths)."""
+        self.column_names = dplan.column_names
+        self.column_types = dplan.column_types
+        self.state = "SCHEDULING"
+        root_locations = self._schedule(dplan)
+        self.state = "RUNNING"
+        self._drain(root_locations)
+        self._collect_stats()
+        if analyze:
+            text = self._render_analyze(dplan)
+            self.column_names = ["Query Plan"]
+            self.column_types = [T.VARCHAR]
+            self.result_rows = [(line,) for line in text.splitlines()]
+
+    def _lookup_plan_cache(self, key_sql: str):
+        """Plan-cache probe (sql/plancache.py): a hit returns
+        (DistributedPlan, plan text) and means parse/analyze/optimize
+        are skipped entirely for this execution."""
+        from presto_tpu.sql import plancache
+
+        cfg = self._session().effective_config(self.co.config)
+        if not cfg.plan_cache_enabled:
+            return None
+        self._cfg = cfg
+        epochs = plancache.epochs_for(self.co.registry)
+        key = plancache.cache_key(epochs, key_sql, self.catalog, None,
+                                  self.session_properties)
+        return plancache.get(key, epochs)
+
+    def _plan_query(self, stmt, metadata, cfg, cacheable: bool):
+        """parse-tree -> DistributedPlan, consulting/filling the plan
+        cache.  EXECUTE-bound statements key on (prepared text, bound
+        parameters) via ``_plan_key_sql``; plain statements key on their
+        raw SQL (so the pre-parse probe can hit next time)."""
+        from presto_tpu.sql import plancache
+
+        key = epochs = None
+        if cacheable and cfg.plan_cache_enabled:
+            epochs = plancache.epochs_for(self.co.registry)
+            key = plancache.cache_key(
+                epochs, self._plan_key_sql or self.sql, self.catalog,
+                None, self.session_properties)
+            hit = plancache.get(key, epochs)
+            if hit is not None:
+                dplan, self.plan_text = hit
+                self.plan_cached = True
+                return dplan
+        logical = Planner(metadata).plan(stmt)
+        optimized = optimize(logical, metadata, cfg)
+        dplan = Fragmenter(metadata=metadata,
+                           config=cfg).fragment(optimized)
+        self.plan_text = self._format_dplan(dplan)
+        if key is not None:
+            cats = {self.catalog}
+            for f in dplan.fragments:
+                cats |= plancache.scan_catalogs(f.root)
+            plancache.put(key, (dplan, self.plan_text), epochs, cats,
+                          cfg.plan_cache_capacity)
+        return dplan
+
     def _run_admitted(self) -> None:
         try:
             self.state = "PLANNING"
+            # pre-parse plan-cache probe: a repeated statement (same raw
+            # SQL, catalog, session fingerprint, live stats epochs) goes
+            # straight to scheduling — parse/analyze/optimize all
+            # skipped.  Only plain queries are inserted under their raw
+            # text (EXECUTE keys include the prepared text + parameters,
+            # so a re-PREPARE under the same name can never alias).
+            cached = self._lookup_plan_cache(self.sql)
+            if cached is not None:
+                dplan, self.plan_text = cached
+                self.plan_cached = True
+                self._execute_query_dplan(dplan, analyze=False)
+                self.state = "FINISHED"
+                return
             stmt = parse_statement(self.sql)
             stmt = self._session_statement(stmt)
             if stmt is None:
@@ -352,6 +455,13 @@ class QueryExecution:
                     except Exception:
                         abort()
                         raise
+                    # the write changed the target catalog's data: bump
+                    # its stats epoch so cached plans over it re-plan
+                    if getattr(self, "_write_catalog", None):
+                        from presto_tpu.sql import plancache
+
+                        plancache.epochs_for(self.co.registry).bump(
+                            self._write_catalog)
                     self.state = "FINISHED"
                     return
             if not isinstance(stmt, (t.Query, t.SetOperation)):
@@ -364,26 +474,9 @@ class QueryExecution:
             metadata = Metadata(self.co.registry, self.catalog)
             cfg = self._session().effective_config(self.co.config)
             self._cfg = cfg
-            logical = Planner(metadata).plan(stmt)
-            optimized = optimize(logical, metadata, cfg)
-            dplan = Fragmenter(metadata=metadata,
-                               config=cfg).fragment(optimized)
-            self.column_names = dplan.column_names
-            self.column_types = dplan.column_types
-            self.plan_text = self._format_dplan(dplan)
-
-            self.state = "SCHEDULING"
-            root_locations = self._schedule(dplan)
-
-            self.state = "RUNNING"
-            self._drain(root_locations)
-            self._collect_stats()
-            if analyze:
-                text = self._render_analyze(dplan)
-                self.column_names = ["Query Plan"]
-                self.column_types = [T.VARCHAR]
-                self.result_rows = [(line,)
-                                    for line in text.splitlines()]
+            dplan = self._plan_query(stmt, metadata, cfg,
+                                     cacheable=not analyze)
+            self._execute_query_dplan(dplan, analyze)
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - query failure surface
             # keep a more specific error set by a killer (low-memory,
@@ -511,6 +604,13 @@ class QueryExecution:
                 st.add_task(TaskStats.from_dict(ts_dict))
             stage_stats[fid] = st.as_dict()
             qs.add_stage(st)
+        # serving-tier split: time spent queued for admission vs
+        # executing (admission -> now); a non-dispatched query reports
+        # queued 0 and elapsed as execution
+        qs.queued_s = round(self.queued_s, 6)
+        qs.execution_s = round(
+            ev.now() - self.admit_time if self.admit_time is not None
+            else qs.elapsed_s, 6)
         self.stage_stats = stage_stats
         self.task_stats = task_stats
         self.query_stats = qs.as_dict()
@@ -597,6 +697,10 @@ class QueryExecution:
                 f"compiles: {qs['jit_compiles']}; "
                 f"prereduce rows: {qs['prereduce_rows']}; "
                 f"trace token: {self.trace_token}")
+            lines.append(
+                f"serving: queued {qs.get('queued_s', 0.0):.3f} s, "
+                f"execution {qs.get('execution_s', 0.0):.3f} s"
+                + (", plan cache hit" if self.plan_cached else ""))
         return "\n".join(lines)
 
     def _wait_for_workers(self) -> List[Tuple[str, str]]:
@@ -1927,6 +2031,12 @@ class QueryExecution:
                     f"prepared statement not found: {stmt.name}")
             bound = t.substitute_parameters(parse_statement(sql),
                                             stmt.parameters)
+            # plan-cache key for the bound statement: the prepared TEXT
+            # plus the literal parameters — re-preparing the same name
+            # with different SQL can never alias, and each distinct
+            # binding gets its own (cacheable) plan
+            self._plan_key_sql = (sql + "\0execute\0"
+                                  + repr(stmt.parameters))
             return bound
         return stmt
 
@@ -1955,6 +2065,9 @@ class QueryExecution:
             conn0 = self.co.registry.get(target_catalog)
         except Exception:  # noqa: BLE001 - let the utility path report it
             return None
+        # remembered for the post-commit stats-epoch bump (plan cache
+        # invalidation on INSERT/CTAS)
+        self._write_catalog = target_catalog
         if not getattr(conn0, "supports_distributed_write", False):
             return None
         if isinstance(stmt, t.Insert):
@@ -2211,7 +2324,14 @@ class QueryExecution:
         out: Dict = {"id": self.query_id, "stats": {"state": self.state},
                      "traceToken": self.trace_token}
         if self.state == "FAILED":
-            out["error"] = {"message": self.error or "query failed"}
+            err: Dict = {"message": self.error or "query failed"}
+            if self.error_name is not None:
+                # the reference's error shape (QueryError):
+                # name + type + numeric StandardErrorCode
+                err["errorName"] = self.error_name
+                err["errorType"] = self.error_type
+                err["errorCode"] = self.error_code
+            out["error"] = err
             return out
         if self.state != "FINISHED":
             out["nextUri"] = f"{base_uri}/v1/statement/executing/" \
@@ -2248,6 +2368,7 @@ h1 { color: #7fd4ff } table { border-collapse: collapse; margin: 1em 0 }
 td, th { border: 1px solid #444; padding: 4px 10px; text-align: left }
 th { background: #222 } .FINISHED { color: #7fff7f }
 .FAILED { color: #ff7f7f } .RUNNING, .PLANNING { color: #ffff7f }
+.QUEUED, .WAITING_FOR_RESOURCES { color: #7fd4ff }
 </style></head><body>
 <h1>tpu-sql cluster</h1>
 <h2>Nodes</h2><table id="nodes"><tr><th>node</th><th>uri</th></tr></table>
@@ -2258,7 +2379,8 @@ th { background: #222 } .FINISHED { color: #7fff7f }
 <script>
 // Cells are populated via textContent, never innerHTML: query SQL, the
 // X-Presto-User header, and announced node ids/URIs are all untrusted.
-const STATES = ['FINISHED', 'FAILED', 'RUNNING', 'PLANNING'];
+const STATES = ['FINISHED', 'FAILED', 'RUNNING', 'PLANNING',
+                'QUEUED', 'WAITING_FOR_RESOURCES'];
 function header(table, names) {
   table.textContent = '';
   const tr = document.createElement('tr');
@@ -2318,6 +2440,10 @@ async function showDetail(id) {
   document.getElementById('detail').textContent =
     'query: ' + (q.query || '') + '\n' +
     'state: ' + q.state + (q.error ? '\nerror: ' + q.error : '') +
+    '\nresource group: ' + (q.resourceGroup || '(none)') +
+    '  queued: ' + (q.queuedS || 0).toFixed(3) + 's' +
+    '  execution: ' + (q.executionS || 0).toFixed(3) + 's' +
+    '  plan cache: ' + (q.planCached ? 'hit' : 'miss') +
     '\ntrace token: ' + (q.traceToken || '') +
     '\noutput rows: ' + q.outputRows +
     '\npeak memory: ' + mib(qs.peak_memory_bytes) +
@@ -2349,7 +2475,8 @@ class CoordinatorServer:
                  http_client=None, fault_injector=None,
                  heartbeat_interval_s: float = 0.5,
                  heartbeat_max_missed: int = 3,
-                 event_log_path: Optional[str] = None):
+                 event_log_path: Optional[str] = None,
+                 resource_groups=None):
         from presto_tpu.server.errortracker import RetryingHttpClient
         from presto_tpu.server.security import InternalAuthenticator
         from presto_tpu.session import ResourceGroupManager
@@ -2395,7 +2522,13 @@ class CoordinatorServer:
         if event_log_path:
             self.event_bus.register(
                 ev.JsonLinesEventListener(event_log_path))
-        self.resource_groups = ResourceGroupManager()
+        # admission control tree; callers may hand in a configured
+        # manager (per-group limits/weights/policies) — the serving
+        # tier's dispatch loop arbitrates every statement through it
+        self.resource_groups = resource_groups or ResourceGroupManager()
+        from presto_tpu.server.dispatcher import DispatchManager
+
+        self.dispatcher = DispatchManager(self)
         self.grants = GrantStore()
         self.authenticator = authenticator
         self.internal_auth = (InternalAuthenticator(internal_secret)
@@ -2488,20 +2621,22 @@ class CoordinatorServer:
                                 out[k.strip()] = _up.unquote(v)
                         return out
 
-                    qid = uuid.uuid4().hex[:16]
-                    q = QueryExecution(
-                        qid, sql, co, user=user,
+                    # serving tier (server/dispatcher.py): the handler
+                    # only enqueues — admission, planning, and execution
+                    # all happen off this thread (QUEUED ->
+                    # WAITING_FOR_RESOURCES -> RUNNING lifecycle)
+                    q = co.dispatcher.submit(
+                        sql, user=user,
                         session_properties=_kv_header("X-Presto-Session"),
                         catalog=self.headers.get("X-Presto-Catalog"),
                         prepared=_kv_header(
                             "X-Presto-Prepared-Statements"),
                         trace_token=self.headers.get(
                             "X-Presto-Trace-Token"))
-                    co.queries[qid] = q
                     self._json(200, {
-                        "id": qid,
+                        "id": q.query_id,
                         "nextUri": f"{co.uri}/v1/statement/executing/"
-                                   f"{qid}/0",
+                                   f"{q.query_id}/0",
                         "stats": {"state": q.state}})
                     return
                 if parts == ["v1", "announcement"]:
@@ -2598,7 +2733,10 @@ class CoordinatorServer:
                          "recoveryRounds": q.recovery_rounds,
                          "producerReruns": q.producer_reruns_total,
                          "spooledPages": (q.query_stats or {}).get(
-                             "pages_spooled", 0)}
+                             "pages_spooled", 0),
+                         "queuedS": round(q.queued_s, 3),
+                         "resourceGroup": q.resource_group_name,
+                         "planCached": q.plan_cached}
                         for q in co.queries.values()])
                     return
                 if parts == ["v1", "tasks"]:
@@ -2635,6 +2773,15 @@ class CoordinatorServer:
                         "queryId": q.query_id, "state": q.state,
                         "user": q.user, "query": q.sql,
                         "error": q.error,
+                        "errorName": q.error_name,
+                        "errorType": q.error_type,
+                        "errorCode": q.error_code,
+                        # serving tier: admission group, queued-vs-
+                        # execution split, plan-cache disposition
+                        "resourceGroup": q.resource_group_name,
+                        "queuedS": round(q.queued_s, 6),
+                        "executionS": round(q.execution_s, 6),
+                        "planCached": q.plan_cached,
                         "plan": q.plan_text,
                         "columns": q.column_names,
                         "outputRows": len(q.result_rows),
@@ -2713,6 +2860,7 @@ class CoordinatorServer:
 
     def close(self) -> None:
         self._memory_stop.set()
+        self.dispatcher.close()
         self.nodes.close()
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -1,0 +1,198 @@
+"""Dispatcher: async admission for the concurrent serving tier.
+
+Role model: the reference's DispatchManager + queued statement resource
+(presto-main/.../dispatcher/DispatchManager.java:59,
+QueuedStatementResource.java:86): ``POST /v1/statement`` never plans or
+executes inline — it creates a ``DispatchQuery`` in state QUEUED and
+returns immediately; a dispatch loop hands the query to resource-group
+admission (WAITING_FOR_RESOURCES), and only an admitted query enters the
+planning/scheduling/running lifecycle.  Planning and admission therefore
+never serialize behind a running query: every statement thread is
+per-query, the HTTP handler does no work, and the number of concurrently
+*running* queries is exactly what the resource-group tree admits.
+
+Lifecycle (QueryStateMachine role)::
+
+    QUEUED -> WAITING_FOR_RESOURCES -> PLANNING -> SCHEDULING
+           -> RUNNING -> FINISHED | FAILED
+
+visible in ``/v1/query/{id}``, ``system.runtime.queries``, and the web
+UI.  Admission is arbitrated by ``session.ResourceGroupManager`` (fair /
+weighted_fair / query_priority dequeue, per-group ``max_queued`` +
+``hard_concurrency_limit``, soft-memory and hard-CPU accounting); a full
+queue rejects with the reference's error shape
+(``QUERY_QUEUE_FULL`` / ``INSUFFICIENT_RESOURCES``), and ``DELETE`` on a
+QUEUED query dequeues it without ever starting execution
+(``USER_CANCELED``), still firing ``QueryCompletedEvent``.
+
+Error codes follow the reference's StandardErrorCode layout:
+USER_ERROR codes are based at 0x0000_0000 and INSUFFICIENT_RESOURCES at
+0x0002_0000.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from typing import Dict, Optional, Tuple
+
+from presto_tpu import events as ev
+from presto_tpu.server.coordinator import QueryExecution
+
+#: (errorName, errorType, errorCode) triples — the reference's error
+#: shape carried in the client-protocol ``error`` object.
+USER_CANCELED = ("USER_CANCELED", "USER_ERROR", 0x0000_0003)
+QUERY_QUEUE_FULL = ("QUERY_QUEUE_FULL", "INSUFFICIENT_RESOURCES",
+                    0x0002_0002)
+
+
+class DispatchQuery(QueryExecution):
+    """One dispatched query: the QUEUED/WAITING_FOR_RESOURCES half of
+    the lifecycle wrapped around the inherited execution half.
+
+    Admission happens on this query's own thread (started by the
+    dispatch loop), so a statement waiting for a slot costs one parked
+    thread and zero planning work; cancellation while queued sets the
+    cancel event and wakes the resource-group wait, which dequeues the
+    ticket without consuming a slot."""
+
+    def __init__(self, query_id: str, sql: str, coordinator,
+                 user: str = "user",
+                 session_properties: Optional[Dict[str, str]] = None,
+                 catalog: Optional[str] = None,
+                 prepared: Optional[Dict[str, str]] = None,
+                 trace_token: Optional[str] = None):
+        self._cancel_event = threading.Event()
+        self._group = None
+        super().__init__(query_id, sql, coordinator, user=user,
+                         session_properties=session_properties,
+                         catalog=catalog, prepared=prepared,
+                         trace_token=trace_token, auto_start=False)
+
+    # -- lifecycle ------------------------------------------------------
+    def _fail_dispatch(self, message: str,
+                       shape: Tuple[str, str, int]) -> None:
+        """Terminal failure before execution ever started: no worker
+        tasks, no stats — just the error shape, the completion event,
+        and an unblocked client."""
+        self.error = self.error or message
+        self.error_name, self.error_type, self.error_code = shape
+        self.state = "FAILED"
+        self.rows_done.set()
+        self._fire_completed()
+
+    def finish_cancelled(self) -> None:
+        """Cancelled while still in the dispatch queue (before the
+        admission thread started)."""
+        self._fail_dispatch("Query was canceled by the user",
+                            USER_CANCELED)
+
+    def _run(self) -> None:
+        from presto_tpu.session import (
+            QueryCancelledError, QueryQueueFullError, Session,
+        )
+
+        if self._cancel_event.is_set():
+            self.finish_cancelled()
+            return
+        group = self.co.resource_groups.group_for(
+            Session(user=self.user, catalog=self.co.default_catalog))
+        self._group = group
+        self.resource_group_name = group.name
+        try:
+            cfg = self._session().effective_config(self.co.config)
+        except Exception:  # noqa: BLE001 - bad session property values
+            # surface through _run_admitted with its original message;
+            # admission itself runs on host defaults
+            cfg = self.co.config
+        self.state = "WAITING_FOR_RESOURCES"
+        try:
+            group.acquire(timeout_s=cfg.query_queue_timeout_s,
+                          cancel_event=self._cancel_event)
+        except QueryCancelledError:
+            self._fail_dispatch("Query was canceled by the user",
+                                USER_CANCELED)
+            return
+        except QueryQueueFullError as e:
+            self._fail_dispatch(str(e), QUERY_QUEUE_FULL)
+            return
+        self.admit_time = ev.now()
+        self.queued_s = max(self.admit_time - self.create_time, 0.0)
+        try:
+            if self._cancel_event.is_set():
+                self.error = self.error or "Query was canceled by the user"
+                self.error_name, self.error_type, self.error_code = \
+                    USER_CANCELED
+                self.state = "FAILED"
+                self.rows_done.set()
+                return
+            self._run_admitted()
+        finally:
+            self.execution_s = max(ev.now() - self.admit_time, 0.0)
+            group.release()
+            # CPU accounting: charge the cluster-side work actually done
+            # (sum of task wall across the mesh when the rollup reported,
+            # else the coordinator-side execution span)
+            total_wall_ns = (self.query_stats or {}).get("total_wall_ns", 0)
+            group.charge_cpu(total_wall_ns / 1e9 if total_wall_ns
+                             else self.execution_s)
+            self._fire_completed()
+
+    def cancel(self) -> None:
+        """Kill at any lifecycle point: a QUEUED/WAITING_FOR_RESOURCES
+        query dequeues without executing (its admission wait wakes and
+        raises); a running query gets the inherited worker-task
+        fan-out."""
+        self.canceled = True
+        self._cancel_event.set()
+        if self._group is not None:
+            self._group.wake()
+        if self._tasks_scheduled:
+            self._cancel_worker_tasks()
+
+
+class DispatchManager:
+    """The asynchronous dispatch loop: ``submit`` enqueues, the loop
+    starts each query's admission thread.  Submission is O(1) for the
+    HTTP handler regardless of what the cluster is doing."""
+
+    def __init__(self, coordinator):
+        self.co = coordinator
+        self._queue: "queue.Queue[Optional[DispatchQuery]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dispatcher")
+        self._thread.start()
+
+    def submit(self, sql: str, *, user: str = "user",
+               session_properties: Optional[Dict[str, str]] = None,
+               catalog: Optional[str] = None,
+               prepared: Optional[Dict[str, str]] = None,
+               trace_token: Optional[str] = None) -> DispatchQuery:
+        qid = uuid.uuid4().hex[:16]
+        q = DispatchQuery(qid, sql, self.co, user=user,
+                          session_properties=session_properties,
+                          catalog=catalog, prepared=prepared,
+                          trace_token=trace_token)
+        self.co.queries[qid] = q
+        self._queue.put(q)
+        return q
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                q = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if q is None:
+                return
+            if q.canceled or q._cancel_event.is_set():
+                # DELETE raced the dispatch loop: never start it
+                q.finish_cancelled()
+                continue
+            q._start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._queue.put(None)

@@ -30,7 +30,8 @@ class DistributedQueryRunner:
                  coordinator_injector=None, worker_injectors=None,
                  heartbeat_interval_s: float = 0.5,
                  heartbeat_max_missed: int = 3,
-                 event_log_path: Optional[str] = None):
+                 event_log_path: Optional[str] = None,
+                 resource_groups=None):
         # each node builds its own registry, as each reference node loads
         # its own connector instances from catalog config
         # ``coordinator_injector`` fails coordinator-originated requests
@@ -43,7 +44,8 @@ class DistributedQueryRunner:
             fault_injector=coordinator_injector,
             heartbeat_interval_s=heartbeat_interval_s,
             heartbeat_max_missed=heartbeat_max_missed,
-            event_log_path=event_log_path)
+            event_log_path=event_log_path,
+            resource_groups=resource_groups)
         # the coordinator's event stream (EventListener SPI): register
         # listeners here to observe query/retry/speculation events
         self.event_bus = self.coordinator.event_bus
@@ -79,7 +81,10 @@ class DistributedQueryRunner:
                          q.get("recoveryRounds", 0),
                          q.get("traceToken"),
                          q.get("spooledPages", 0),
-                         q.get("producerReruns", 0))
+                         q.get("producerReruns", 0),
+                         q.get("queuedS", 0.0),
+                         q.get("resourceGroup"),
+                         q.get("planCached", False))
                         for q in fetch("/v1/query")]
 
             def tasks_fn():
@@ -116,6 +121,13 @@ class DistributedQueryRunner:
             self.workers.append(w)
             self._announce(w)
         self.client = StatementClient(self.coordinator.uri)
+
+    def new_client(self, user: Optional[str] = None) -> StatementClient:
+        """A fresh StatementClient against this cluster's coordinator.
+        StatementClient carries per-connection session state, so every
+        concurrent load-generator thread needs its own (the serving-tier
+        qps harness / tests/test_serving.py)."""
+        return StatementClient(self.coordinator.uri, user=user)
 
     def kill_worker(self, i: int) -> WorkerServer:
         """Abruptly stop worker ``i`` (chaos: simulated node death — the

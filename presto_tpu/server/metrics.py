@@ -95,6 +95,41 @@ def _spool_families(prefix: str, spool, bytes_evicted: int = 0
     ]
 
 
+def _plan_cache_families(prefix: str) -> List[Family]:
+    """presto_plan_cache_{hits,misses,evictions}_total + size: the
+    serving tier's plan cache (sql/plancache.py)."""
+    from presto_tpu.sql import plancache
+
+    s = plancache.stats()
+    fams: List[Family] = [
+        (f"{prefix}_plan_cache_size", "gauge",
+         "cached plans currently held", [({}, s.get("size", 0))])]
+    for key in ("hits", "misses", "evictions"):
+        fams.append((
+            f"{prefix}_plan_cache_{key}_total", "counter",
+            f"plan cache {key} (evictions include stats-epoch "
+            "invalidations)",
+            [({}, s.get(key, 0))]))
+    return fams
+
+
+def _resource_group_families(manager) -> List[Family]:
+    """Per-group admission gauges (queue depth + running count), the
+    serving tier's contention surface."""
+    stats = manager.stats() if manager is not None else []
+    return [
+        ("presto_resource_group_queued", "gauge",
+         "queries waiting for admission per resource group",
+         [({"group": s["name"]}, s["queued"]) for s in stats]),
+        ("presto_resource_group_running", "gauge",
+         "admitted (running) queries per resource group",
+         [({"group": s["name"]}, s["running"]) for s in stats]),
+        ("presto_resource_group_cpu_usage_seconds", "gauge",
+         "charged CPU seconds per resource group (regenerating)",
+         [({"group": s["name"]}, s["cpu_usage_s"]) for s in stats]),
+    ]
+
+
 def coordinator_metrics(co) -> str:
     """Render the coordinator's /metrics payload from live state."""
     by_state: Dict[str, int] = {}
@@ -141,6 +176,9 @@ def coordinator_metrics(co) -> str:
           ({"kind": "peak"}, mem_peak)]),
         _http_client_family("presto", co.http),
     ]
+    fams.extend(_resource_group_families(
+        getattr(co, "resource_groups", None)))
+    fams.extend(_plan_cache_families("presto"))
     fams.extend(_spool_families("presto", getattr(co, "spool", None)))
     fams.extend(_kernel_cache_families("presto"))
     return prometheus_text(fams)

@@ -98,6 +98,10 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
         lambda v: v.lower() in ("true", "1", "on")),
     "exchange_max_buffer_bytes": ("exchange_max_buffer_bytes", int),
     "exchange_spool_stall_s": ("exchange_spool_stall_s", float),
+    "plan_cache_enabled": ("plan_cache_enabled",
+                           lambda v: v.lower() in ("true", "1", "on")),
+    "plan_cache_capacity": ("plan_cache_capacity", int),
+    "query_queue_timeout_s": ("query_queue_timeout_s", float),
 }
 
 
@@ -409,6 +413,11 @@ class QueryQueueFullError(RuntimeError):
     pass
 
 
+class QueryCancelledError(RuntimeError):
+    """A queued admission wait was cancelled (DELETE on a QUEUED query):
+    the waiter is dequeued without ever consuming a slot."""
+
+
 class _Ticket:
     """One queued admission request (ordering handle)."""
 
@@ -441,7 +450,11 @@ class ResourceGroup:
                  parent: Optional["ResourceGroup"] = None,
                  scheduling_weight: int = 1,
                  scheduling_policy: str = "fair",
-                 soft_memory_limit_bytes: Optional[int] = None):
+                 soft_memory_limit_bytes: Optional[int] = None,
+                 hard_cpu_limit_s: Optional[float] = None,
+                 cpu_quota_generation_s_per_s: float = 0.0):
+        import time as _time
+
         self.name = name
         self.hard_concurrency_limit = hard_concurrency_limit
         self.max_queued = max_queued
@@ -450,6 +463,15 @@ class ResourceGroup:
         self.scheduling_policy = scheduling_policy
         self.soft_memory_limit_bytes = soft_memory_limit_bytes
         self.memory_usage = 0
+        # CPU accounting (InternalResourceGroup cpuUsageMillis /
+        # hardCpuLimit / cpuQuotaGenerationMillisPerSecond role): queries
+        # charge their execution seconds at completion; a group over its
+        # hard CPU limit admits nothing until the regeneration rate pays
+        # the debt back down.  None = no CPU limit.
+        self.cpu_usage_s = 0.0
+        self.hard_cpu_limit_s = hard_cpu_limit_s
+        self.cpu_quota_generation_s_per_s = cpu_quota_generation_s_per_s
+        self._cpu_regen_at = _time.monotonic()
         self.running = 0
         self.queued = 0
         self.children: List["ResourceGroup"] = []
@@ -468,12 +490,30 @@ class ResourceGroup:
             self._seq = 0
 
     # -- selection (policy) ---------------------------------------------
+    def _regen_cpu_locked(self) -> None:
+        """Pay accumulated CPU debt back down at the configured
+        generation rate (lazy: applied whenever eligibility is checked
+        or usage is charged)."""
+        import time as _time
+
+        now = _time.monotonic()
+        if self.cpu_quota_generation_s_per_s > 0 and self.cpu_usage_s > 0:
+            self.cpu_usage_s = max(
+                0.0, self.cpu_usage_s
+                - (now - self._cpu_regen_at)
+                * self.cpu_quota_generation_s_per_s)
+        self._cpu_regen_at = now
+
     def _slot_free_locked(self) -> bool:
         if self.running >= self.hard_concurrency_limit:
             return False
         if (self.soft_memory_limit_bytes is not None
                 and self.memory_usage > self.soft_memory_limit_bytes):
             return False
+        if self.hard_cpu_limit_s is not None:
+            self._regen_cpu_locked()
+            if self.cpu_usage_s >= self.hard_cpu_limit_s:
+                return False
         return True
 
     def _select_locked(self) -> Optional[_Ticket]:
@@ -501,11 +541,18 @@ class ResourceGroup:
             return None
         return min(ranked)[2]
 
-    def acquire(self, timeout_s: Optional[float] = None) -> None:
+    def acquire(self, timeout_s: Optional[float] = None,
+                cancel_event: Optional[threading.Event] = None) -> None:
         """Block until this group's waiter is chosen by the root's policy
         walk AND every ancestor has a free slot; raise when the queue is
-        full."""
+        full.  ``cancel_event`` makes the wait cancellable: when set
+        (wake the waiter via :meth:`wake`), the ticket is dequeued
+        without consuming a slot and ``QueryCancelledError`` raises —
+        the queued-query DELETE path."""
         with self._cond:
+            if cancel_event is not None and cancel_event.is_set():
+                raise QueryCancelledError(
+                    f"admission wait for {self.name!r} cancelled")
             root = self._root
             if self._chain_free_locked() and root._select_locked() is None:
                 # capacity available and no eligible waiter to barge past
@@ -520,9 +567,14 @@ class ResourceGroup:
             self._queue.append(ticket)
             try:
                 ok = self._cond.wait_for(
-                    lambda: (root._select_locked() is ticket
-                             and self._chain_free_locked()),
+                    lambda: ((cancel_event is not None
+                              and cancel_event.is_set())
+                             or (root._select_locked() is ticket
+                                 and self._chain_free_locked())),
                     timeout=timeout_s)
+                if cancel_event is not None and cancel_event.is_set():
+                    raise QueryCancelledError(
+                        f"admission wait for {self.name!r} cancelled")
                 if not ok:
                     raise QueryQueueFullError(
                         f"queue wait timed out for {self.name!r}")
@@ -534,6 +586,16 @@ class ResourceGroup:
                 self.queued -= 1
                 if ticket in self._queue:
                     self._queue.remove(ticket)
+                # a removed waiter may unblock the policy walk for a
+                # sibling (it can no longer be selected)
+                self._cond.notify_all()
+
+    def wake(self) -> None:
+        """Wake every waiter on this group's tree (cancellation and
+        CPU-quota regeneration are externally-timed eligibility
+        changes the condition cannot observe by itself)."""
+        with self._cond:
+            self._cond.notify_all()
 
     def _chain_free_locked(self) -> bool:
         node: Optional[ResourceGroup] = self
@@ -563,6 +625,28 @@ class ResourceGroup:
         with self._cond:
             self.memory_usage = bytes_
             self._cond.notify_all()
+
+    def charge_cpu(self, seconds: float) -> None:
+        """Charge a completed query's execution seconds to this group
+        and every ancestor (the cpuUsageMillis accounting); the next
+        eligibility check regenerates at the configured rate."""
+        with self._cond:
+            node: Optional[ResourceGroup] = self
+            while node is not None:
+                node._regen_cpu_locked()
+                node.cpu_usage_s += max(float(seconds), 0.0)
+                node = node.parent
+
+    def stats_locked_snapshot(self) -> Dict[str, Any]:
+        """One group's admission counters (the /metrics and
+        system.runtime surface)."""
+        with self._cond:
+            return {"name": self.name, "running": self.running,
+                    "queued": self.queued,
+                    "hard_concurrency_limit": self.hard_concurrency_limit,
+                    "max_queued": self.max_queued,
+                    "cpu_usage_s": round(self.cpu_usage_s, 3),
+                    "memory_usage_bytes": self.memory_usage}
 
 
 class ResourceGroupManager:
@@ -607,6 +691,14 @@ class ResourceGroupManager:
             groups = dict(self._groups)
         for user, g in groups.items():
             g.set_memory_usage(per_user_bytes.get(user, 0))
+
+    def stats(self) -> List[Dict[str, Any]]:
+        """Admission counters for the root and every child group — the
+        per-group queue-depth / running-count gauges the coordinator's
+        /metrics plane renders."""
+        with self._lock:
+            groups = [self.root] + list(self._groups.values())
+        return [g.stats_locked_snapshot() for g in groups]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,204 @@
+"""Shared plan cache: repeated statements skip parse/analyze/optimize.
+
+Role model: the reference plans every statement from scratch but caches
+every *generated class* (ExpressionCompiler etc.); serving-tier forks
+(and the reference's own ``EXECUTE`` path) add a query-plan cache so a
+dashboard firing the same statement hundreds of times per minute pays
+the semantic-analysis + cost-based-optimization price once.  This module
+is that cache for both tiers:
+
+- the **coordinator** (server/dispatcher.py ``DispatchQuery``) caches
+  the fragmented ``DistributedPlan`` + output schema + plan text;
+- the **local runner** caches the optimized logical plan.
+
+Keys and invalidation
+---------------------
+An entry is keyed on ``(epoch-domain token, catalog, schema,
+session-property fingerprint, normalized SQL text)``:
+
+- *normalized SQL*: whitespace collapsed outside string literals, so
+  formatting differences between clients share one entry;
+- *session-property fingerprint*: any property change (planner knobs,
+  fusion toggles...) produces a different plan — different key;
+- *epoch-domain token*: a unique id per ``StatsEpochs`` domain (one per
+  ``ConnectorRegistry``), so two clusters in one process never share
+  entries.
+
+Invalidation is by **per-catalog stats epochs** (the reference's
+stats-based CBO makes plans a function of table statistics): every
+DDL/DML that changes data or metadata in a catalog bumps that catalog's
+epoch, and an entry records the epoch of every catalog its plan scans at
+insert time.  A lookup whose recorded epochs no longer match is a miss
+(the stale entry is dropped and counted as an eviction).
+
+The cache itself is a named ``kernelcache.KernelCache`` ("plan_cache"),
+so hit/miss/eviction counters surface through the same registry as the
+compiled-kernel caches (task info, EXPLAIN ANALYZE, /metrics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import uuid
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from presto_tpu import kernelcache
+
+# One process-wide cache (coordinator-lifetime by construction, like the
+# compiled-kernel caches); the epoch-domain token in every key isolates
+# independent registries sharing the process.
+_CACHE = kernelcache.new_cache("plan_cache")
+
+
+class StatsEpochs:
+    """Per-catalog statistics epochs for one connector registry.
+
+    ``bump(catalog)`` after any statement that changes the catalog's
+    data or metadata (INSERT/DELETE/CTAS/DDL/ANALYZE/view changes);
+    cached plans referencing that catalog stop validating.  Thread-safe;
+    epochs only grow."""
+
+    def __init__(self):
+        self.token = uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        self._epochs: Dict[str, int] = {}
+
+    def epoch(self, catalog: str) -> int:
+        with self._lock:
+            return self._epochs.get(catalog, 0)
+
+    def bump(self, catalog: str) -> int:
+        with self._lock:
+            self._epochs[catalog] = self._epochs.get(catalog, 0) + 1
+            return self._epochs[catalog]
+
+    def snapshot(self, catalogs: Iterable[str]) -> Dict[str, int]:
+        with self._lock:
+            return {c: self._epochs.get(c, 0) for c in catalogs}
+
+    def valid(self, snapshot: Dict[str, int]) -> bool:
+        with self._lock:
+            return all(self._epochs.get(c, 0) == e
+                       for c, e in snapshot.items())
+
+
+def epochs_for(registry) -> StatsEpochs:
+    """The StatsEpochs domain of a ConnectorRegistry (created on first
+    use and attached, so the coordinator and its embedded utility
+    runners — which share the registry — share one epoch space)."""
+    ep = getattr(registry, "_stats_epochs", None)
+    if ep is None:
+        ep = StatsEpochs()
+        registry._stats_epochs = ep
+    return ep
+
+
+def normalize_sql(sql: str) -> str:
+    """Collapse whitespace runs outside single-quoted string literals
+    and strip a trailing semicolon, so trivially-reformatted statements
+    share one cache entry.  Case is preserved (identifiers may be
+    delimited; string literals are significant)."""
+    out = []
+    in_string = False
+    pending_space = False
+    for ch in sql:
+        if in_string:
+            out.append(ch)
+            if ch == "'":
+                in_string = False
+            continue
+        if ch == "'":
+            if pending_space and out:
+                out.append(" ")
+            pending_space = False
+            out.append(ch)
+            in_string = True
+            continue
+        if ch.isspace():
+            pending_space = True
+            continue
+        if pending_space and out:
+            out.append(" ")
+        pending_space = False
+        out.append(ch)
+    text = "".join(out)
+    return text[:-1].rstrip() if text.endswith(";") else text
+
+
+def fingerprint(session_properties: Optional[Dict[str, Any]]) -> Tuple:
+    """Order-independent session-property fingerprint."""
+    return tuple(sorted((str(k), str(v))
+                        for k, v in (session_properties or {}).items()))
+
+
+def cache_key(epochs: StatsEpochs, sql: str, catalog: Optional[str],
+              schema: Optional[str],
+              session_properties: Optional[Dict[str, Any]] = None) -> Tuple:
+    return (epochs.token, catalog or "", schema or "",
+            fingerprint(session_properties), normalize_sql(sql))
+
+
+@dataclasses.dataclass
+class _Entry:
+    value: Any
+    epoch_snapshot: Dict[str, int]
+
+
+def scan_catalogs(node) -> set:
+    """Catalogs referenced by a plan's table scans (the entry's
+    invalidation scope)."""
+    from presto_tpu.sql.plan import TableScanNode
+
+    out: set = set()
+
+    def walk(n):
+        if isinstance(n, TableScanNode):
+            out.add(n.catalog)
+        for s in n.sources:
+            walk(s)
+
+    walk(node)
+    return out
+
+
+def get(key: Tuple, epochs: StatsEpochs):
+    """Cached plan value, or None.  A hit whose recorded catalog epochs
+    no longer match current epochs is dropped (counted as an eviction)
+    and reported as a miss — the DDL/INSERT invalidation path."""
+    entry = kernelcache.cache_get(_CACHE, key)
+    if entry is None:
+        return None
+    if not epochs.valid(entry.epoch_snapshot):
+        with kernelcache._LOCK:
+            if _CACHE.get(key) is entry:
+                del _CACHE[key]
+                _CACHE.evictions += 1
+            # the stale entry was counted as a hit by cache_get; it is
+            # a miss for the caller — rebalance the counters
+            _CACHE.hits -= 1
+            _CACHE.misses += 1
+        return None
+    return entry.value
+
+
+def put(key: Tuple, value: Any, epochs: StatsEpochs,
+        catalogs: Iterable[str], capacity: Optional[int] = None) -> None:
+    entry = _Entry(value, epochs.snapshot(catalogs))
+    kernelcache.cache_put(_CACHE, key, entry,
+                          cap=capacity if capacity and capacity > 0
+                          else None)
+
+
+def stats() -> Dict[str, int]:
+    """Hit/miss/eviction/size counters (the /metrics + bench surface)."""
+    with kernelcache._LOCK:
+        return {"size": len(_CACHE), "hits": _CACHE.hits,
+                "misses": _CACHE.misses, "evictions": _CACHE.evictions}
+
+
+def clear() -> None:
+    """Drop every entry and zero the counters (test isolation)."""
+    with kernelcache._LOCK:
+        _CACHE.clear()
+        _CACHE.hits = _CACHE.misses = _CACHE.evictions = 0

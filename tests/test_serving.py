@@ -1,0 +1,470 @@
+"""Serving tier: async dispatch, resource-group admission, the shared
+plan cache, and concurrent execution (server/dispatcher.py +
+sql/plancache.py — the DispatchManager / InternalResourceGroup /
+QueryStateMachine roles).
+
+Covers the PR 8 acceptance pins: N-thread mixed statement storm with
+exact-rows parity per client, plan-cache hit/invalidation semantics
+(DDL bumps the stats epoch; a session-property change misses;
+``plan_cache_enabled=false`` restores inline planning exactly),
+queue-full rejection with the reference's error shape, queued-query
+cancellation that never starts execution, zero jit compiles on the
+second execution of a cached plan, and a chaos case (worker kill with
+three queries in flight, recovered by the PR 5/7 machinery).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu import events as ev
+from presto_tpu.client import QueryFailed
+from presto_tpu.server.dqr import DistributedQueryRunner
+from presto_tpu.session import (
+    QueryQueueFullError, ResourceGroupManager, Session,
+)
+from presto_tpu.sql import plancache
+
+
+def _get_json(uri):
+    with urllib.request.urlopen(uri, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _norm(rows):
+    return sorted(tuple(round(v, 6) if isinstance(v, float) else v
+                        for v in r) for r in rows)
+
+
+@pytest.fixture(scope="module")
+def dqr():
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2) as runner:
+        yield runner
+
+
+class TestConcurrentServing:
+    STORM = [
+        "select count(*) as c from tpch.lineitem",
+        "select l_returnflag, count(*) as c, sum(l_quantity) as q "
+        "from tpch.lineitem group by l_returnflag order by l_returnflag",
+        "select n_name, count(*) as c from tpch.customer, tpch.nation "
+        "where c_nationkey = n_nationkey group by n_name "
+        "order by c desc, n_name",
+        "select o_orderpriority, count(*) as c from tpch.orders "
+        "group by o_orderpriority order by o_orderpriority",
+    ]
+
+    def test_statement_storm_exact_rows_per_client(self, dqr):
+        """4 clients x 4 mixed statements concurrently: every client
+        sees exactly the single-threaded rows (shared kernel caches,
+        shared plan cache, concurrent drivers — no cross-query bleed)."""
+        expected = {sql: _norm(dqr.execute(sql).rows)
+                    for sql in self.STORM}
+        failures = []
+
+        def client_loop(i):
+            client = dqr.new_client(user=f"storm{i}")
+            try:
+                for j in range(len(self.STORM)):
+                    sql = self.STORM[(i + j) % len(self.STORM)]
+                    _cols, data = client.execute(sql)
+                    if _norm([tuple(r) for r in data]) != expected[sql]:
+                        failures.append((i, sql))
+            except Exception as e:  # noqa: BLE001
+                failures.append((i, repr(e)))
+
+        threads = [threading.Thread(target=client_loop, args=(i,),
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not failures, failures
+
+    def test_lifecycle_states_and_queued_split(self, dqr):
+        """A query blocked on admission is visible as
+        WAITING_FOR_RESOURCES in /v1/query/{id}; once run, its detail
+        reports the queued-vs-execution split."""
+        co = dqr.coordinator
+        blocker = co.resource_groups.configure_group(
+            "split", hard_concurrency_limit=1)
+        blocker.acquire()
+        try:
+            req = urllib.request.Request(
+                f"{co.uri}/v1/statement",
+                data=b"select count(*) from tpch.region",
+                method="POST", headers={"X-Presto-User": "split"})
+            qid = _get_json_req(req)["id"]
+            state = _wait_for_state(
+                co.uri, qid, ("WAITING_FOR_RESOURCES",), timeout=10)
+            assert state == "WAITING_FOR_RESOURCES"
+            time.sleep(0.2)      # accrue measurable queued time
+        finally:
+            blocker.release()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            detail = _get_json(f"{co.uri}/v1/query/{qid}")
+            if detail["state"] in ("FINISHED", "FAILED"):
+                break
+            time.sleep(0.05)
+        assert detail["state"] == "FINISHED", detail.get("error")
+        assert detail["resourceGroup"] == "global.split"
+        assert detail["queuedS"] > 0.1
+        assert detail["executionS"] > 0
+        qs = detail["queryStats"]
+        assert qs["queued_s"] > 0.1 and qs["execution_s"] > 0
+        # the lifecycle is visible through system.runtime too
+        rows = dqr.execute(
+            "select state, queued_s, resource_group from "
+            "system.runtime.queries where query_id = '" + qid + "'").rows
+        assert rows and rows[0][0] == "FINISHED"
+        assert rows[0][1] > 0.1 and rows[0][2] == "global.split"
+
+    def test_chaos_worker_kill_with_three_in_flight(self):
+        """Worker dies while 3 concurrent queries are mid-flight: all
+        recover exactly via the PR 5/7 retry/spool machinery."""
+        sqls = [
+            "select l_returnflag, count(*) as c, sum(l_extendedprice) "
+            "as s from tpch.lineitem group by l_returnflag "
+            "order by l_returnflag",
+            "select n_name, count(*) as c from tpch.supplier, "
+            "tpch.nation where s_nationkey = n_nationkey "
+            "group by n_name order by c desc, n_name",
+            "select count(*) as c, sum(o_totalprice) as s "
+            "from tpch.orders",
+        ]
+        with DistributedQueryRunner.tpch(
+                scale=0.01, n_workers=3,
+                heartbeat_interval_s=0.1,
+                heartbeat_max_missed=2) as runner:
+            expected = [_norm(runner.execute(s).rows) for s in sqls]
+            results = [None] * len(sqls)
+            errors = []
+
+            def run(i):
+                client = runner.new_client(user=f"chaos{i}")
+                try:
+                    _cols, data = client.execute(sqls[i])
+                    results[i] = _norm([tuple(r) for r in data])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"{i}: {e}")
+
+            threads = [threading.Thread(target=run, args=(i,),
+                                        daemon=True)
+                       for i in range(len(sqls))]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            runner.kill_worker(1)
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+            for i, want in enumerate(expected):
+                assert results[i] == want, f"query {i} inexact"
+
+
+class TestAdmissionControl:
+    def test_queue_full_rejection_error_shape(self):
+        """A full queue rejects with the reference's error shape:
+        QUERY_QUEUE_FULL / INSUFFICIENT_RESOURCES / 0x0002_0002."""
+        groups = ResourceGroupManager(hard_concurrency_limit=4,
+                                      max_queued=0, per_user_limit=1)
+        with DistributedQueryRunner.tpch(
+                scale=0.001, n_workers=1,
+                resource_groups=groups) as runner:
+            blocker = groups.group_for(Session(user="alice"))
+            blocker.acquire()
+            try:
+                client = runner.new_client(user="alice")
+                with pytest.raises(QueryFailed) as ei:
+                    client.execute("select count(*) from tpch.region")
+                assert ei.value.error_name == "QUERY_QUEUE_FULL"
+                assert ei.value.error_type == "INSUFFICIENT_RESOURCES"
+                assert ei.value.error_code == 0x0002_0002
+                assert "Too many queued queries" in str(ei.value)
+            finally:
+                blocker.release()
+            # the slot was never leaked: alice can run again
+            assert runner.new_client(user="alice").execute(
+                "select count(*) from tpch.region")[1] == [[5]]
+
+    def test_queued_query_cancellation(self):
+        """DELETE on a queued query dequeues it without ever starting
+        execution, releases its resource-group slot, and still fires
+        QueryCompletedEvent (FAILED, USER_CANCELED)."""
+        groups = ResourceGroupManager(hard_concurrency_limit=4,
+                                      max_queued=8, per_user_limit=1)
+        completed = []
+
+        class Listener(ev.EventListener):
+            def query_completed(self, event):
+                completed.append(event)
+
+        with DistributedQueryRunner.tpch(
+                scale=0.001, n_workers=1,
+                resource_groups=groups) as runner:
+            runner.event_bus.register(Listener())
+            co = runner.coordinator
+            blocker = groups.group_for(Session(user="bob"))
+            blocker.acquire()
+            try:
+                req = urllib.request.Request(
+                    f"{co.uri}/v1/statement",
+                    data=b"select count(*) from tpch.lineitem",
+                    method="POST", headers={"X-Presto-User": "bob"})
+                qid = _get_json_req(req)["id"]
+                assert _wait_for_state(
+                    co.uri, qid, ("WAITING_FOR_RESOURCES",),
+                    timeout=10) == "WAITING_FOR_RESOURCES"
+                req = urllib.request.Request(
+                    f"{co.uri}/v1/query/{qid}", method="DELETE")
+                _get_json_req(req)
+                assert _wait_for_state(co.uri, qid, ("FAILED",),
+                                       timeout=10) == "FAILED"
+                q = co.queries[qid]
+                assert q.error_name == "USER_CANCELED"
+                assert q.error_type == "USER_ERROR"
+                assert q.error_code == 0x0000_0003
+                # execution never started: no tasks were ever created
+                assert q._tasks_scheduled is False
+                assert q.state == "FAILED"
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline and not any(
+                        e.query_id == qid for e in completed):
+                    time.sleep(0.02)
+                done = [e for e in completed if e.query_id == qid]
+                assert done and done[0].state == "FAILED"
+                # the group queue slot was released, not leaked
+                assert groups.group_for(
+                    Session(user="bob")).queued == 0
+            finally:
+                blocker.release()
+            # bob's group admits normally afterwards
+            assert runner.new_client(user="bob").execute(
+                "select count(*) from tpch.region")[1] == [[5]]
+
+    def test_cpu_accounting_gates_admission(self):
+        """A group over its hard CPU limit admits nothing until the
+        regeneration rate pays the debt down (cpuUsageMillis /
+        cpuQuotaGenerationMillisPerSecond role)."""
+        mgr = ResourceGroupManager(hard_concurrency_limit=8,
+                                   per_user_limit=8)
+        g = mgr.configure_group("cpu_user", hard_cpu_limit_s=1.0)
+        g.charge_cpu(5.0)
+        with pytest.raises(QueryQueueFullError):
+            g.acquire(timeout_s=0.2)       # no regeneration configured
+        g.cpu_quota_generation_s_per_s = 50.0
+        admitted = threading.Event()
+
+        def waiter():
+            g.acquire(timeout_s=10)
+            admitted.set()
+
+        threading.Thread(target=waiter, daemon=True).start()
+        # regeneration is checked lazily on wakeups — nudge the tree
+        deadline = time.monotonic() + 5
+        while not admitted.is_set() and time.monotonic() < deadline:
+            g.wake()
+            time.sleep(0.02)
+        assert admitted.is_set()
+        g.release()
+
+
+class TestPlanCache:
+    def test_repeat_statement_hits_and_skips_compiles(self, dqr):
+        """Second execution of a repeated statement reuses the cached
+        plan (planCached=true) and pays zero jit compiles (kernel cache
+        + DictionaryPool are coordinator-lifetime, shared cross-query)."""
+        sql = ("select count(*) as c_repeat, sum(l_tax) as t_repeat "
+               "from tpch.lineitem where l_linenumber = 1")
+        client = dqr.new_client(user="cache")
+        before = plancache.stats()
+        _cols, first = client.execute(sql)
+        qid1 = client.last_query_id
+        _cols, second = client.execute(sql)
+        qid2 = client.last_query_id
+        after = plancache.stats()
+        assert second == first
+        assert after["hits"] >= before["hits"] + 1
+        co = dqr.coordinator
+        d1 = _get_json(f"{co.uri}/v1/query/{qid1}")
+        d2 = _get_json(f"{co.uri}/v1/query/{qid2}")
+        assert d1["planCached"] is False
+        assert d2["planCached"] is True
+        # identical plan text: the cached plan IS the planned plan
+        assert d1["plan"] == d2["plan"]
+        # zero compiles on the cached re-execution (existing counters)
+        assert d2["queryStats"]["jit_compiles"] == 0
+
+    def test_ddl_insert_bumps_epoch_and_invalidates(self, dqr):
+        """INSERT bumps the target catalog's stats epoch: the cached
+        plan is invalidated (counted as eviction), re-planned, and the
+        query sees the new rows."""
+        client = dqr.new_client(user="cache")
+        client.execute("create table memory.serving_inv (x bigint)")
+        client.execute("insert into memory.serving_inv values (1), (2)")
+        sql = "select sum(x) as s from memory.serving_inv"
+        assert client.execute(sql)[1] == [[3]]
+        assert client.execute(sql)[1] == [[3]]          # cached hit
+        d = _get_json(f"{dqr.coordinator.uri}/v1/query/"
+                      f"{client.last_query_id}")
+        assert d["planCached"] is True
+        before = plancache.stats()
+        client.execute("insert into memory.serving_inv values (10)")
+        assert client.execute(sql)[1] == [[13]]         # fresh plan
+        d = _get_json(f"{dqr.coordinator.uri}/v1/query/"
+                      f"{client.last_query_id}")
+        assert d["planCached"] is False
+        after = plancache.stats()
+        assert after["evictions"] >= before["evictions"] + 1
+
+    def test_session_property_change_misses(self, dqr):
+        """A session-property change produces a different fingerprint —
+        the cached plan for other settings is not reused."""
+        sql = ("select count(*) as c_fp from tpch.orders "
+               "where o_shippriority = 0")
+        client = dqr.new_client(user="cache")
+        client.execute(sql)
+        client.execute(sql)
+        d = _get_json(f"{dqr.coordinator.uri}/v1/query/"
+                      f"{client.last_query_id}")
+        assert d["planCached"] is True
+        client.session_properties["scan_batch_rows"] = "32768"
+        client.execute(sql)
+        d = _get_json(f"{dqr.coordinator.uri}/v1/query/"
+                      f"{client.last_query_id}")
+        assert d["planCached"] is False
+
+    def test_disabled_restores_inline_planning(self, dqr):
+        """plan_cache_enabled=false restores inline planning exactly:
+        same rows, same plan text, no cache traffic — the single-client
+        one-query-at-a-time pin."""
+        sql = ("select count(*) as c_off, min(p_size) as m_off "
+               "from tpch.part")
+        on_client = dqr.new_client(user="cache")
+        _c, want = on_client.execute(sql)
+        plan_on = _get_json(f"{dqr.coordinator.uri}/v1/query/"
+                            f"{on_client.last_query_id}")["plan"]
+        off = dqr.new_client(user="cache")
+        off.session_properties["plan_cache_enabled"] = "false"
+        before = plancache.stats()
+        for _ in range(2):
+            _c, got = off.execute(sql)
+            assert got == want
+            d = _get_json(f"{dqr.coordinator.uri}/v1/query/"
+                          f"{off.last_query_id}")
+            assert d["planCached"] is False
+        after = plancache.stats()
+        # no hits and no inserts for the disabled session (misses may
+        # accrue from the pre-parse probe of OTHER sessions only)
+        assert after["hits"] == before["hits"]
+        assert d["plan"] == plan_on
+
+    def test_execute_prepared_binding_cached(self, dqr):
+        """EXECUTE-bound prepared statements cache per (prepared text,
+        parameters): a repeated binding hits, a different binding plans
+        fresh, and a re-PREPARE under the same name never aliases."""
+        client = dqr.new_client(user="cache")
+        client.execute("prepare sp from select count(*) as c from "
+                       "tpch.lineitem where l_quantity < ?")
+        assert client.execute("execute sp using 10")[1] == \
+            client.execute("execute sp using 10")[1]
+        d = _get_json(f"{dqr.coordinator.uri}/v1/query/"
+                      f"{client.last_query_id}")
+        assert d["planCached"] is True
+        r10 = client.execute("execute sp using 10")[1]
+        r2 = client.execute("execute sp using 2")[1]
+        assert r2 != r10                      # distinct binding, fresh plan
+        # re-PREPARE the same name with different SQL: must not alias
+        client.execute("prepare sp from select count(*) as c from "
+                       "tpch.orders where o_custkey < ?")
+        fresh = client.execute("execute sp using 10")[1]
+        assert fresh != r10
+
+    def test_metrics_expose_serving_counters(self, dqr):
+        """/metrics carries the per-group admission gauges and the
+        plan-cache counters."""
+        client = dqr.new_client(user="cache")
+        client.execute("select 1 as one_metrics from tpch.region")
+        with urllib.request.urlopen(
+                f"{dqr.coordinator.uri}/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "presto_resource_group_queued{" in text
+        assert "presto_resource_group_running{" in text
+        assert 'group="global"' in text
+        assert "presto_plan_cache_hits_total" in text
+        assert "presto_plan_cache_misses_total" in text
+        assert "presto_plan_cache_evictions_total" in text
+
+    def test_explain_analyze_surfaces_split(self, dqr):
+        """Both EXPLAIN ANALYZE surfaces report the queued-vs-execution
+        split."""
+        rows = dqr.execute("explain analyze select count(*) "
+                           "from tpch.region").rows
+        text = "\n".join(r[0] for r in rows)
+        assert "serving: queued" in text and "execution" in text
+        from presto_tpu.localrunner import LocalQueryRunner
+
+        local = LocalQueryRunner.tpch(scale=0.001)
+        out = local.execute("explain analyze select count(*) "
+                            "from region").rows
+        text = "\n".join(r[0] for r in out)
+        assert "serving: queued 0.000 s" in text
+
+
+class TestLocalPlanCache:
+    def test_local_runner_caches_and_invalidates(self):
+        """The single-process tier shares the same plan-cache semantics:
+        repeat statements skip plan/optimize, DDL bumps the epoch."""
+        from presto_tpu.localrunner import LocalQueryRunner
+
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        sql = "select count(*) as c_local from lineitem"
+        before = plancache.stats()
+        first = runner.execute(sql).rows
+        second = runner.execute(sql).rows
+        after = plancache.stats()
+        assert second == first
+        assert after["hits"] >= before["hits"] + 1
+        runner.execute("create table memory.lt (x bigint)")
+        msql = "select count(*) as c_local_m from memory.lt"
+        assert runner.execute(msql).rows == [(0,)]
+        assert runner.execute(msql).rows == [(0,)]      # cached
+        runner.execute("insert into memory.lt values (7)")
+        assert runner.execute(msql).rows == [(1,)]      # invalidated
+
+    def test_normalization_shares_entries(self):
+        """Whitespace-reformatted statements share one entry; string
+        literals are preserved."""
+        assert plancache.normalize_sql(
+            "select  1\n from   t;") == "select 1 from t"
+        assert plancache.normalize_sql(
+            "select 'a  b' from t") == "select 'a  b' from t"
+        from presto_tpu.localrunner import LocalQueryRunner
+
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        runner.execute("select max(n_nationkey) as m_norm from nation")
+        before = plancache.stats()
+        runner.execute("select   max(n_nationkey)  as m_norm\n"
+                       "from nation")
+        after = plancache.stats()
+        assert after["hits"] == before["hits"] + 1
+
+
+def _get_json_req(req):
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_for_state(base_uri, qid, states, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    state = None
+    while time.monotonic() < deadline:
+        state = _get_json(f"{base_uri}/v1/query/{qid}")["state"]
+        if state in states or state in ("FINISHED", "FAILED"):
+            return state
+        time.sleep(0.02)
+    return state

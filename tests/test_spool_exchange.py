@@ -184,9 +184,17 @@ def _wait_all_spooled(co, dqr, timeout_s=60.0) -> str:
     while time.monotonic() < deadline:
         if co.queries and qid is None:
             qid = list(co.queries)[0]
-        if qid and all(_all_finished_and_spooled(w, qid)
-                       for w in dqr.workers):
-            return qid
+        if qid:
+            # scheduling places producers first: the producer tasks can
+            # finish+spool before the ROOT task even exists, so require
+            # the root placement too or the caller races on it
+            q = co.queries[qid]
+            root_placed = q._dplan is not None and any(
+                f == q._dplan.root_fragment_id
+                for f, _, _ in q._placements)
+            if root_placed and all(_all_finished_and_spooled(w, qid)
+                                   for w in dqr.workers):
+                return qid
         time.sleep(0.02)
     raise AssertionError("tasks never reached finished+spooled")
 
@@ -387,6 +395,11 @@ def test_spool_missing_object_falls_back_to_cascading_retry(tmp_path):
     cfg = _spool_cfg(tmp_path)
     co_inj = FaultInjector()
     co_inj.add_spool_rule(r".", policy="spool-missing")
+    # hold the root drain so the kill deterministically lands while the
+    # query is in flight (under load, the killer thread can otherwise
+    # lose the race and the query completes without any recovery)
+    hold = co_inj.add_rule(r"/results/", method="GET",
+                           policy="slow-task")
     inj = FaultInjector()
     inj.add_rule(r"/results/", method="GET", policy="drop-connection")
     with DistributedQueryRunner.tpch(
@@ -419,6 +432,7 @@ def test_spool_missing_object_falls_back_to_cascading_retry(tmp_path):
             time.sleep(0.02)
         q = list(co.queries.values())[0]
         dqr.kill_worker(1)
+        hold.release()
         t.join(timeout=120)
         assert not t.is_alive()
         assert "err" not in res, res

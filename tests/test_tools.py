@@ -132,6 +132,35 @@ class TestFusionReport:
         assert "FusedSegment{" in out
 
 
+class TestQpsRun:
+    def test_check_mode(self, capsys):
+        """tools/qps_run.py --check: the serving-tier CI smoke — a tiny
+        closed-loop run at 2 concurrency levels against a live 2-worker
+        DQR asserting per-client exact-rows parity, nonzero plan-cache
+        hits, and zero jit compiles on the second execution of a cached
+        plan."""
+        import importlib
+        import json
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        qps_run = importlib.import_module("qps_run")
+        rc = qps_run.main(["--check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        payload = json.loads(out.strip().splitlines()[-1])
+        assert payload["check"] == {
+            "parity": True, "plan_cache_hits": True,
+            "zero_second_run_compiles": True,
+            "second_run_plan_cached": True}
+        levels = payload["report"]["levels"]
+        assert [lv["concurrency"] for lv in levels] == [1, 2]
+        for lv in levels:
+            assert lv["qps"] > 0 and lv["p99_ms"] >= lv["p50_ms"]
+
+
 class TestQueryProfile:
     def test_live_profile_check_mode(self, capsys, tmp_path):
         """tools/query_profile.py --check: runs a statement on a real

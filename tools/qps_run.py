@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""Sustained-QPS load plane: drive a live cluster with concurrent
+clients and measure latency under contention.
+
+The CLI face of the serving tier (server/dispatcher.py +
+sql/plancache.py): boots a real in-process DistributedQueryRunner
+(coordinator + workers + HTTP exchanges), then drives a mixed
+TPC-H/TPC-DS statement set from N concurrent clients — each with its
+own StatementClient and its own user (so resource-group admission is
+actually engaged) — and reports QPS, p50/p95/p99 latency, per-client
+exact-rows parity against a single-threaded oracle run, and the plan
+cache's hit rate:
+
+    JAX_PLATFORMS=cpu python tools/qps_run.py --levels 1,2,4,8
+    JAX_PLATFORMS=cpu python tools/qps_run.py --mode open --rate 20
+    JAX_PLATFORMS=cpu python tools/qps_run.py --check
+
+Modes:
+
+- ``closed`` (default): each client issues its next statement the
+  moment the previous one returns — N in-flight requests, throughput-
+  bound (the dashboard-fleet shape);
+- ``open``: statements arrive on a fixed schedule (``--rate`` per
+  second) regardless of completions, and latency is measured from
+  *arrival* — queueing delay under overload is visible (the
+  million-users shape).
+
+``--check`` is the CI smoke tier: tiny scale, 2 concurrency levels,
+exits nonzero unless every client saw exact rows AND the plan cache
+recorded hits AND the repeated statement's second execution compiled
+nothing.
+
+Exit code 0 = all levels parity-clean (and --check assertions hold).
+"""
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+import urllib.request
+
+# runnable from anywhere: `python tools/qps_run.py` puts tools/ on the
+# path, not the repo root (same shim as chaos_run.py)
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+#: the mixed statement set: TPC-H aggregations + joins and TPC-DS
+#: aggregations + joins, each cheap enough to repeat under load, plus a
+#: parameter-bound prepared statement (the EXECUTE plan-cache path).
+STATEMENTS = [
+    ("tpch_q6ish",
+     "select sum(l_extendedprice * l_discount) as revenue "
+     "from tpch.lineitem "
+     "where l_discount between 0.05 and 0.07 and l_quantity < 24"),
+    ("tpch_q1_lite",
+     "select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty, "
+     "count(*) as cnt from tpch.lineitem "
+     "group by l_returnflag, l_linestatus "
+     "order by l_returnflag, l_linestatus"),
+    ("tpch_nation_join",
+     "select n_name, count(*) as c from tpch.customer, tpch.nation "
+     "where c_nationkey = n_nationkey "
+     "group by n_name order by c desc, n_name"),
+    ("tpcds_store_agg",
+     "select ss_store_sk, count(*) as c, sum(ss_net_paid) as paid "
+     "from tpcds.store_sales group by ss_store_sk order by ss_store_sk"),
+    ("tpcds_item_join",
+     "select i_class, count(*) as c "
+     "from tpcds.store_sales, tpcds.item "
+     "where ss_item_sk = i_item_sk "
+     "group by i_class order by c desc, i_class"),
+]
+
+PREPARE_SQL = ("prepare qps_param from select count(*) as c "
+               "from tpch.lineitem where l_quantity < ?")
+EXECUTE_SQL = "execute qps_param using 10"
+
+
+def _norm_rows(rows):
+    """Order-insensitive, float-tolerant row normalization for the
+    exact-rows parity check."""
+    return sorted(tuple(round(v, 6) if isinstance(v, float) else v
+                        for v in r) for r in rows)
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _client_worklist(n_requests, offset):
+    """The statement sequence one client walks: the shared mix, rotated
+    per client so concurrent clients overlap on every statement (the
+    plan-cache contention case) without issuing in lockstep."""
+    names = [name for name, _ in STATEMENTS] + ["tpch_execute"]
+    return [names[(offset + j) % len(names)] for j in range(n_requests)]
+
+
+class _Oracle:
+    """Single-threaded expected rows per statement name."""
+
+    def __init__(self, dqr):
+        client = dqr.new_client(user="oracle")
+        client.execute(PREPARE_SQL)
+        self.rows = {}
+        for name, sql in STATEMENTS:
+            self.rows[name] = _norm_rows(dqr.execute(sql).rows)
+        cols, data = client.execute(EXECUTE_SQL)
+        self.rows["tpch_execute"] = _norm_rows([tuple(r) for r in data])
+        self.sql = dict(STATEMENTS)
+        self.sql["tpch_execute"] = EXECUTE_SQL
+
+
+def _run_one(client, oracle, name):
+    """Issue one statement; returns (latency_s, parity_ok)."""
+    t0 = time.perf_counter()
+    _cols, data = client.execute(oracle.sql[name])
+    lat = time.perf_counter() - t0
+    ok = _norm_rows([tuple(r) for r in data]) == oracle.rows[name]
+    return lat, ok
+
+
+def run_closed_level(dqr, oracle, concurrency, requests_per_client,
+                     n_users=2):
+    """Closed loop: N clients, each back-to-back through its worklist."""
+    lock = threading.Lock()
+    lats, mismatches, errors = [], [], []
+
+    def client_loop(i):
+        client = dqr.new_client(user=f"client{i % n_users}")
+        try:
+            client.execute(PREPARE_SQL)
+            for name in _client_worklist(requests_per_client, i):
+                lat, ok = _run_one(client, oracle, name)
+                with lock:
+                    lats.append(lat)
+                    if not ok:
+                        mismatches.append((i, name))
+        except Exception as e:  # noqa: BLE001 - reported in the result
+            with lock:
+                errors.append(f"client{i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client_loop, args=(i,),
+                                daemon=True, name=f"qps-client-{i}")
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return _level_report(concurrency, lats, wall, mismatches, errors,
+                         mode="closed")
+
+
+def run_open_level(dqr, oracle, concurrency, rate_per_s, n_requests,
+                   n_users=2):
+    """Open loop: arrivals on a fixed schedule; latency counts from
+    scheduled arrival (queueing under overload is visible).  A pool of
+    ``concurrency`` workers drains the arrival queue."""
+    lock = threading.Lock()
+    lats, mismatches, errors = [], [], []
+    work: "queue.Queue" = queue.Queue()
+    start = time.perf_counter() + 0.05
+    for j, name in enumerate(_client_worklist(n_requests, 0)):
+        work.put((start + j / rate_per_s, name))
+
+    def worker(i):
+        client = dqr.new_client(user=f"client{i % n_users}")
+        try:
+            client.execute(PREPARE_SQL)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"client{i}: {e}")
+            return
+        while True:
+            try:
+                arrival, name = work.get_nowait()
+            except queue.Empty:
+                return
+            now = time.perf_counter()
+            if now < arrival:
+                time.sleep(arrival - now)
+            try:
+                _lat, ok = _run_one(client, oracle, name)
+                done = time.perf_counter()
+                with lock:
+                    lats.append(done - arrival)   # includes queue wait
+                    if not ok:
+                        mismatches.append((i, name))
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"client{i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True,
+                                name=f"qps-open-{i}")
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    rep = _level_report(concurrency, lats, wall, mismatches, errors,
+                        mode="open")
+    rep["target_rate_per_s"] = rate_per_s
+    return rep
+
+
+def _level_report(concurrency, lats, wall, mismatches, errors, mode):
+    lats_sorted = sorted(lats)
+    return {
+        "mode": mode,
+        "concurrency": concurrency,
+        "requests": len(lats),
+        "wall_s": round(wall, 3),
+        "qps": round(len(lats) / wall, 2) if wall > 0 else 0.0,
+        "p50_ms": round(_percentile(lats_sorted, 0.50) * 1e3, 1),
+        "p95_ms": round(_percentile(lats_sorted, 0.95) * 1e3, 1),
+        "p99_ms": round(_percentile(lats_sorted, 0.99) * 1e3, 1),
+        "parity": not mismatches and not errors,
+        "mismatches": mismatches[:5],
+        "errors": errors[:5],
+    }
+
+
+def _second_run_jit_compiles(dqr, oracle):
+    """Execute an already-cached statement once more and read its
+    /v1/query detail: a warm plan-cache + kernel-cache run must show
+    jit_compiles == 0 (the cross-query compiled-tier reuse proof)."""
+    client = dqr.new_client(user="probe")
+    name = STATEMENTS[0][0]
+    client.execute(oracle.sql[name])          # belt-and-braces warm
+    client.execute(oracle.sql[name])
+    qid = client.last_query_id
+    with urllib.request.urlopen(
+            f"{dqr.coordinator.uri}/v1/query/{qid}", timeout=10) as resp:
+        detail = json.loads(resp.read())
+    return (int((detail.get("queryStats") or {}).get("jit_compiles", -1)),
+            bool(detail.get("planCached")))
+
+
+def run_qps(scale=0.003, levels=(1, 2, 4, 8), requests_per_client=4,
+            mode="closed", rate_per_s=10.0, n_workers=2,
+            hard_concurrency=8, per_user_limit=4, quiet=False):
+    """Boot the cluster, run every concurrency level, return the report
+    dict (the bench_concurrent_qps payload)."""
+    from presto_tpu.server.dqr import DistributedQueryRunner
+    from presto_tpu.session import ResourceGroupManager
+    from presto_tpu.sql import plancache
+
+    groups = ResourceGroupManager(
+        hard_concurrency_limit=hard_concurrency,
+        per_user_limit=per_user_limit)
+    report = {"scale": scale, "mode": mode, "n_workers": n_workers,
+              "resource_groups": {"hard_concurrency": hard_concurrency,
+                                  "per_user_limit": per_user_limit},
+              "levels": []}
+    with DistributedQueryRunner.tpcds(scale=scale, n_workers=n_workers,
+                                      resource_groups=groups) as dqr:
+        oracle = _Oracle(dqr)          # also warms scan + kernel caches
+        for conc in levels:
+            before = plancache.stats()
+            if mode == "open":
+                n_requests = max(requests_per_client * conc, conc)
+                level = run_open_level(dqr, oracle, conc, rate_per_s,
+                                       n_requests)
+            else:
+                level = run_closed_level(dqr, oracle, conc,
+                                         requests_per_client)
+            after = plancache.stats()
+            hits = after["hits"] - before["hits"]
+            misses = after["misses"] - before["misses"]
+            level["plan_cache"] = {
+                "hits": hits, "misses": misses,
+                "hit_rate": round(hits / (hits + misses), 3)
+                if hits + misses else 0.0}
+            report["levels"].append(level)
+            if not quiet:
+                print(json.dumps(level), flush=True)
+        jit, cached = _second_run_jit_compiles(dqr, oracle)
+        report["second_run_jit_compiles"] = jit
+        report["second_run_plan_cached"] = cached
+        # admission engagement: how many queries actually waited
+        with urllib.request.urlopen(
+                f"{dqr.coordinator.uri}/v1/query", timeout=10) as resp:
+            qs = json.loads(resp.read())
+        report["queries_total"] = len(qs)
+        report["queries_queued"] = sum(
+            1 for q in qs if q.get("queuedS", 0) > 0.0005)
+    report["parity"] = all(lv["parity"] for lv in report["levels"])
+    hits = sum(lv["plan_cache"]["hits"] for lv in report["levels"])
+    misses = sum(lv["plan_cache"]["misses"] for lv in report["levels"])
+    report["plan_cache_hit_rate"] = round(
+        hits / (hits + misses), 3) if hits + misses else 0.0
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scale", type=float, default=0.003)
+    ap.add_argument("--levels", default="1,2,4,8",
+                    help="comma-separated concurrency levels")
+    ap.add_argument("--requests", type=int, default=4,
+                    help="statements per client (closed) / per level "
+                         "x concurrency (open)")
+    ap.add_argument("--mode", choices=("closed", "open"),
+                    default="closed")
+    ap.add_argument("--rate", type=float, default=10.0,
+                    help="open-loop arrival rate, statements/s")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--check", action="store_true",
+                    help="CI smoke: tiny run, assert parity + plan-cache "
+                         "hits + zero second-run compiles")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        report = run_qps(scale=0.003, levels=(1, 2),
+                         requests_per_client=2, mode="closed",
+                         n_workers=2, quiet=True)
+        checks = {
+            "parity": report["parity"],
+            "plan_cache_hits": report["plan_cache_hit_rate"] > 0.0,
+            "zero_second_run_compiles":
+                report["second_run_jit_compiles"] == 0,
+            "second_run_plan_cached": report["second_run_plan_cached"],
+        }
+        print(json.dumps({"check": checks, "report": report}))
+        return 0 if all(checks.values()) else 1
+
+    levels = tuple(int(x) for x in args.levels.split(",") if x.strip())
+    report = run_qps(scale=args.scale, levels=levels,
+                     requests_per_client=args.requests, mode=args.mode,
+                     rate_per_s=args.rate, n_workers=args.workers)
+    print(json.dumps(report, indent=2))
+    return 0 if report["parity"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
