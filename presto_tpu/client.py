@@ -49,6 +49,12 @@ class StatementClient:
         # query id of the most recent execute() — lets harnesses fetch
         # /v1/query/{id} detail (stats, plan-cache disposition) after
         self.last_query_id: Optional[str] = None
+        # the reference-shaped ``stats`` object from the most recent
+        # poll (StatementStats role: state, split accounting, cumulative
+        # rows/bytes, progressPercent) and the per-poll history of the
+        # current execute() — progress is observable MID-query
+        self.last_stats: dict = {}
+        self.stats_history: list = []
 
     def _headers(self) -> dict:
         import urllib.parse
@@ -94,8 +100,12 @@ class StatementClient:
         with urllib.request.urlopen(req, timeout=30) as resp:
             payload = json.loads(resp.read())
         self.last_query_id = payload.get("id")
+        self.stats_history = []
         deadline = time.monotonic() + timeout_s
         while True:
+            if isinstance(payload.get("stats"), dict):
+                self.last_stats = payload["stats"]
+                self.stats_history.append(payload["stats"])
             state = payload.get("stats", {}).get("state")
             if state == "FAILED" and "error" not in payload \
                     and payload.get("nextUri"):

@@ -318,6 +318,22 @@ class EngineConfig:
     # before failing with the queue-timeout error (the reference's
     # query.max-queued-time role)
     query_queue_timeout_s: float = 300.0
+    # --- live query telemetry (the StatementStats/QueryProgressStats
+    # role: progress observable MID-query, not just post-mortem) --------
+    # coordinator sampler: while a query is RUNNING, poll every
+    # placement's task info at this cadence, fold each sweep into the
+    # live StageStats/QueryStats rollup, and append one sample to the
+    # bounded per-query time-series ring (/v1/query/{id}/timeseries).
+    # OFF restores the single post-drain stats collection exactly.
+    stats_sampling_enabled: bool = True
+    stats_sample_interval_s: float = 0.1
+    # samples kept in the per-query time-series ring (oldest dropped)
+    stats_timeseries_capacity: int = 512
+    # slow-query log: a query whose wall clock exceeds this threshold
+    # emits one structured log line + a SlowQueryEvent through the
+    # event bus (trace token, queued/execution split, top hot
+    # operator).  0 disables.
+    slow_query_log_threshold_s: float = 60.0
 
 
 DEFAULT = EngineConfig()

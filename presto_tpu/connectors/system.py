@@ -102,12 +102,19 @@ class SystemConnector(_VirtualConnector):
             # resource group, plan-cache disposition
             ("queued_s", T.DOUBLE),
             ("resource_group", T.VARCHAR),
-            ("plan_cached", T.BOOLEAN)], queries_fn)
+            ("plan_cached", T.BOOLEAN),
+            # live progress (sampler-fed): mid-query split accounting,
+            # visible while the query is still RUNNING
+            ("completed_splits", T.BIGINT),
+            ("total_splits", T.BIGINT),
+            ("progress_percent", T.DOUBLE)], queries_fn)
         self.add_table("tasks", [
             ("task_id", T.VARCHAR), ("state", T.VARCHAR),
             ("query_id", T.VARCHAR), ("output_rows", T.BIGINT),
             ("wall_ms", T.DOUBLE),
-            ("peak_memory_bytes", T.BIGINT)], tasks_fn)
+            ("peak_memory_bytes", T.BIGINT),
+            # live wall-clock span of the task (sampler-fed mid-query)
+            ("elapsed_s", T.DOUBLE)], tasks_fn)
 
 
 class InformationSchemaConnector(_VirtualConnector):

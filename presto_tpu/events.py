@@ -54,6 +54,11 @@ class QueryCompletedEvent:
     # tier reports its single task as one stage
     stage_stats: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list)
+    # the timed span tree (presto_tpu.spans.build_span_tree shape):
+    # query -> coordinator phases -> per-stage -> per-task-attempt,
+    # identical to the /v1/query/{id}/spans payload so query.json
+    # round-trips the same tree the live endpoint serves
+    spans: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def wall_s(self) -> float:
@@ -112,6 +117,25 @@ class WorkerDrainEvent:
 
 
 @dataclasses.dataclass(frozen=True)
+class SlowQueryEvent:
+    """A query's wall clock crossed ``slow_query_log_threshold_s``:
+    one structured event (and one log line) naming where the time went
+    — the queued/execution split plus the hottest operator by
+    exclusive wall."""
+
+    query_id: str
+    trace_token: str
+    user: str
+    sql: str
+    elapsed_s: float
+    queued_s: float
+    execution_s: float
+    threshold_s: float
+    top_operator: str
+    time: float
+
+
+@dataclasses.dataclass(frozen=True)
 class SpeculationEvent:
     """A straggler clone's lifecycle: outcome is 'cloned' when the
     clone is spawned, then 'won' | 'lost' | 'split' when the race
@@ -147,6 +171,9 @@ class EventListener:
         pass
 
     def speculation(self, event: SpeculationEvent) -> None:
+        pass
+
+    def slow_query(self, event: SlowQueryEvent) -> None:
         pass
 
 
@@ -185,6 +212,9 @@ class EventBus:
     def speculation(self, event: SpeculationEvent) -> None:
         self._fire("speculation", event)
 
+    def slow_query(self, event: SlowQueryEvent) -> None:
+        self._fire("slow_query", event)
+
 
 class JsonLinesEventListener(EventListener):
     """The bundled ``query.json`` event log (the reference ships the
@@ -214,6 +244,7 @@ class JsonLinesEventListener(EventListener):
     task_recovery = _write
     worker_drain = _write
     speculation = _write
+    slow_query = _write
 
 
 def read_event_log(path: str) -> List[Dict[str, Any]]:

@@ -83,6 +83,12 @@ class OperatorStats:
     # instead of eyeballing traces.
     jit_dispatches: int = 0
     jit_compiles: int = 0
+    # wall nanoseconds this operator spent BUILDING device programs
+    # (trace + lower + XLA compile, measured around the first dispatch
+    # of each freshly built kernel) — split out of execute wall so
+    # EXPLAIN ANALYZE and the span tree can attribute compile vs
+    # execute per operator (kernelcache.timed_first_call).
+    jit_compile_ns: int = 0
     # rows folded into in-segment partial-aggregation pre-reduce
     # (exec/fusion.py Fusion II): nonzero proves the scan->agg pipeline
     # emitted partial states, not row batches — tests pin on this
@@ -130,6 +136,7 @@ class TaskStats:
     output_batches: int = 0
     jit_dispatches: int = 0
     jit_compiles: int = 0
+    jit_compile_ns: int = 0
     prereduce_rows: int = 0
     peak_memory_bytes: int = 0
     # attempt-aware exchange dedup counters (sums across this task's
@@ -138,6 +145,9 @@ class TaskStats:
     exchange_consumed: int = 0
     exchange_purged: int = 0
     pages_enqueued: int = 0
+    # cumulative wire bytes this task's output buffers enqueued — the
+    # processedBytes surface of the live progress protocol
+    output_bytes: int = 0
     # spooled exchange (server/spool.py): pages written through to the
     # spool, and pages/bytes evicted from the in-memory buffer under
     # max_buffer_bytes pressure (re-servable from the spool)
@@ -153,6 +163,7 @@ class TaskStats:
         self.output_batches += s.output_batches
         self.jit_dispatches += s.jit_dispatches
         self.jit_compiles += s.jit_compiles
+        self.jit_compile_ns += s.jit_compile_ns
         self.prereduce_rows += s.prereduce_rows
 
     def as_dict(self) -> Dict:
@@ -179,12 +190,14 @@ class StageStats:
     total_wall_ns: int = 0  # sum over tasks
     jit_dispatches: int = 0
     jit_compiles: int = 0
+    jit_compile_ns: int = 0
     prereduce_rows: int = 0
     peak_memory_bytes: int = 0
     exchange_fetched: int = 0
     exchange_consumed: int = 0
     exchange_purged: int = 0
     pages_enqueued: int = 0
+    output_bytes: int = 0
     pages_spooled: int = 0
     pages_evicted: int = 0
     bytes_evicted: int = 0
@@ -197,6 +210,7 @@ class StageStats:
         self.total_wall_ns += ts.wall_ns
         self.jit_dispatches += ts.jit_dispatches
         self.jit_compiles += ts.jit_compiles
+        self.jit_compile_ns += ts.jit_compile_ns
         self.prereduce_rows += ts.prereduce_rows
         self.peak_memory_bytes = max(self.peak_memory_bytes,
                                      ts.peak_memory_bytes)
@@ -204,6 +218,7 @@ class StageStats:
         self.exchange_consumed += ts.exchange_consumed
         self.exchange_purged += ts.exchange_purged
         self.pages_enqueued += ts.pages_enqueued
+        self.output_bytes += ts.output_bytes
         self.pages_spooled += ts.pages_spooled
         self.pages_evicted += ts.pages_evicted
         self.bytes_evicted += ts.bytes_evicted
@@ -229,11 +244,14 @@ class QueryStats:
     output_rows: int = 0
     jit_dispatches: int = 0
     jit_compiles: int = 0
+    jit_compile_ns: int = 0
     prereduce_rows: int = 0
     peak_memory_bytes: int = 0   # max single-task peak across the query
     exchange_fetched: int = 0
     exchange_consumed: int = 0
     exchange_purged: int = 0
+    pages_enqueued: int = 0
+    output_bytes: int = 0
     pages_spooled: int = 0
     pages_evicted: int = 0
     stages: int = 0
@@ -245,17 +263,45 @@ class QueryStats:
         self.output_rows += st.output_rows
         self.jit_dispatches += st.jit_dispatches
         self.jit_compiles += st.jit_compiles
+        self.jit_compile_ns += st.jit_compile_ns
         self.prereduce_rows += st.prereduce_rows
         self.peak_memory_bytes = max(self.peak_memory_bytes,
                                      st.peak_memory_bytes)
         self.exchange_fetched += st.exchange_fetched
         self.exchange_consumed += st.exchange_consumed
         self.exchange_purged += st.exchange_purged
+        self.pages_enqueued += st.pages_enqueued
+        self.output_bytes += st.output_bytes
         self.pages_spooled += st.pages_spooled
         self.pages_evicted += st.pages_evicted
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)
+
+
+def hot_operator_lines(ops, top_n: int = 5) -> List[str]:
+    """The EXPLAIN ANALYZE "hot operators" footer: the top-N operators
+    by exclusive wall (``wall_ns`` already includes finish wall for
+    aggregated dicts), with the compile-vs-execute split per operator.
+    ``ops`` are operator-stats dicts; shared by the local and
+    distributed EXPLAIN ANALYZE renderers so the two surfaces stay
+    diffable."""
+    ranked = sorted((o for o in ops if o.get("wall_ns", 0) > 0),
+                    key=lambda o: o.get("wall_ns", 0), reverse=True)
+    if not ranked:
+        return []
+    lines = [f"hot operators (top {min(top_n, len(ranked))} "
+             f"by exclusive wall):"]
+    for o in ranked[:top_n]:
+        wall = o.get("wall_ns", 0)
+        compile_ns = min(o.get("jit_compile_ns", 0), wall)
+        lines.append(
+            f"  {o.get('operator', '?'):<36} "
+            f"{wall / 1e6:>9.1f} ms wall "
+            f"({compile_ns / 1e6:.1f} compile / "
+            f"{(wall - compile_ns) / 1e6:.1f} execute), "
+            f"{o.get('output_rows', 0)} rows out")
+    return lines
 
 
 class QueryContext:
@@ -293,6 +339,10 @@ class TaskContext:
         return {
             "dispatches": sum(s.jit_dispatches for s in self.operator_stats),
             "compiles": sum(s.jit_compiles for s in self.operator_stats),
+            # compile-vs-execute attribution: wall spent building device
+            # programs, split out of the operators' execute wall
+            "compile_ns": sum(s.jit_compile_ns
+                              for s in self.operator_stats),
             "prereduce_rows": sum(s.prereduce_rows
                                   for s in self.operator_stats),
         }

@@ -29,7 +29,9 @@ from presto_tpu import types as T
 from presto_tpu.batch import Batch, Column
 from presto_tpu.exec.context import OperatorContext
 from presto_tpu.exec.operator import Operator, OperatorFactory
-from presto_tpu.kernelcache import cache_get, cache_put, new_cache
+from presto_tpu.kernelcache import (
+    cache_get, cache_put, new_cache, record_compile, timed_first_call,
+)
 
 # jitted dynamic-filter programs, shared across queries (values are
 # arguments, not constants — see _kernel_for)
@@ -123,6 +125,9 @@ class DynamicFilterOperator(Operator):
         if hit is not None:
             return hit
         self.ctx.stats.jit_compiles += 1
+        import time as _time
+
+        _t0 = _time.perf_counter_ns()
         import jax.numpy as jnp
 
         from presto_tpu.ops.filter import selected_positions
@@ -149,7 +154,11 @@ class DynamicFilterOperator(Operator):
                 for v, valid in cols)
             return gathered, count
 
-        jitted = jax.jit(kernel)
+        build_ns = _time.perf_counter_ns() - _t0
+        self.ctx.stats.jit_compile_ns += build_ns
+        record_compile(_DF_KERNELS, build_ns)
+        jitted = timed_first_call(jax.jit(kernel), self.ctx.stats,
+                                  _DF_KERNELS)
         cache_put(_DF_KERNELS, key, jitted)
         return jitted
 

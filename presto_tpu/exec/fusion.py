@@ -584,7 +584,19 @@ class FusedSegmentOperator(Operator):
                dictionary_binding_key(batch.columns), df_shapes, part_n)
         entry = cache_get(_SEG_KERNELS, key)
         if entry is None:
-            entry = self._compile(batch, df_shapes)
+            import time as _time
+
+            from presto_tpu.kernelcache import (
+                record_compile, timed_first_call,
+            )
+
+            _t0 = _time.perf_counter_ns()
+            built_fn, built_meta = self._compile(batch, df_shapes)
+            build_ns = _time.perf_counter_ns() - _t0
+            self.ctx.stats.jit_compile_ns += build_ns
+            record_compile(_SEG_KERNELS, build_ns)
+            entry = (timed_first_call(built_fn, self.ctx.stats,
+                                      _SEG_KERNELS), built_meta)
             cache_put(_SEG_KERNELS, key, entry)
             self.ctx.stats.jit_compiles += 1
         fn, out_meta = entry

@@ -41,6 +41,11 @@ class KernelCache(OrderedDict):
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # cumulative wall nanoseconds spent building entries for this
+        # cache (trace + lower + XLA compile): the compile-time
+        # attribution surface EXPLAIN ANALYZE and /metrics report
+        self.compile_ns = 0
+        self.compiles = 0
 
 
 def new_cache(name: str = "") -> "KernelCache":
@@ -86,10 +91,48 @@ def cache_put(cache: "OrderedDict[tuple, object]", key, val,
                 cache.evictions += 1
 
 
+def record_compile(cache, duration_ns: int) -> None:
+    """Attribute one kernel build's wall time to its named cache (the
+    compile-time-attribution half of the CacheStatsMBean role); plain
+    OrderedDicts are silently skipped."""
+    if isinstance(cache, KernelCache):
+        with _LOCK:
+            cache.compile_ns += int(duration_ns)
+            cache.compiles += 1
+
+
+def timed_first_call(fn, stats, cache=None):
+    """Wrap a freshly jitted callable so its FIRST invocation — where
+    jax traces, lowers, and XLA-compiles before running — is timed and
+    attributed as compile time: to ``stats.jit_compile_ns`` (the
+    OperatorStats of the operator that built it) and to the named
+    cache's registry entry.  Later invocations (including cache hits
+    from other operators) pass straight through."""
+    import time
+
+    state = {"first": True}
+
+    def wrapper(*args, **kwargs):
+        if not state["first"]:
+            return fn(*args, **kwargs)
+        state["first"] = False
+        t0 = time.perf_counter_ns()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter_ns() - t0
+        if stats is not None:
+            stats.jit_compile_ns += dt
+        record_compile(cache, dt)
+        return out
+
+    return wrapper
+
+
 def cache_stats() -> Dict[str, Dict[str, int]]:
     """Hit/miss/size counters for every registered cache (task info /
     EXPLAIN ANALYZE surface)."""
     with _LOCK:
         return {name: {"size": len(c), "hits": c.hits, "misses": c.misses,
-                       "evictions": c.evictions}
+                       "evictions": c.evictions,
+                       "compiles": c.compiles,
+                       "compile_ns": c.compile_ns}
                 for name, c in sorted(_REGISTRY.items())}

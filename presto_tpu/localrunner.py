@@ -734,26 +734,35 @@ class LocalQueryRunner:
         optimized = optimize(logical, self.metadata)
         phys = PhysicalPlanner(self.registry, self.config).plan(optimized)
         task = execute_pipelines(phys.pipelines, self.config)
+        self._last_task = task   # EA ran a real task: report its stats
         execution_s = _time.perf_counter() - t0
         lines = [format_plan(optimized).rstrip(), "", "Operator stats:"]
         # same counter set as the distributed tier's _render_analyze
         # (jit dispatch/compile, pre-reduce rows, peak memory) so the
         # two EXPLAIN ANALYZE surfaces stay diffable
         header = (f"{'operator':<40} {'in rows':>10} {'out rows':>10} "
-                  f"{'wall ms':>9} {'finish ms':>9} {'jit disp':>8} "
-                  f"{'jit comp':>8} {'prereduce':>9}")
+                  f"{'wall ms':>9} {'finish ms':>9} {'compile ms':>10} "
+                  f"{'jit disp':>8} {'jit comp':>8} {'prereduce':>9}")
         lines += [header, "-" * len(header)]
         for s in task.operator_stats:
             lines.append(
                 f"{s.operator:<40} {s.input_rows:>10} {s.output_rows:>10} "
                 f"{s.wall_ns / 1e6:>9.1f} {s.finish_wall_ns / 1e6:>9.1f} "
+                f"{s.jit_compile_ns / 1e6:>10.1f} "
                 f"{s.jit_dispatches:>8} {s.jit_compiles:>8} "
                 f"{s.prereduce_rows:>9}")
+        from presto_tpu.exec.context import hot_operator_lines
+
+        lines.extend(hot_operator_lines([
+            dict(s.as_dict(),
+                 wall_ns=s.wall_ns + s.finish_wall_ns)
+            for s in task.operator_stats]))
         jc = task.jit_counters()
         lines.append(
             f"peak memory: {task.memory.peak / (1 << 20):.1f} MiB; "
             f"jit dispatches: {jc['dispatches']}, "
-            f"compiles: {jc['compiles']}; "
+            f"compiles: {jc['compiles']} "
+            f"({jc['compile_ns'] / 1e6:.1f} ms compile); "
             f"prereduce rows: {jc['prereduce_rows']}")
         # queued-vs-execution split: same footer shape as the
         # distributed tier's _render_analyze (the single-process runner

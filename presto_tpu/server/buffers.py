@@ -72,6 +72,10 @@ class OutputBufferManager:
         # reported in task info so the coordinator's straggler detector
         # can rank per-stage task progress from status polls
         self.pages_enqueued = 0
+        # cumulative wire bytes enqueued (never decremented on fetch or
+        # eviction): the processedBytes surface the live stats sampler
+        # and client-protocol progress report
+        self.bytes_enqueued = 0
         # spool/eviction observability (rolled into TaskStats)
         self.pages_spooled = 0
         self.pages_evicted = 0
@@ -111,6 +115,7 @@ class OutputBufferManager:
                     buf.spooled_to = token + 1
                     self.pages_spooled += 1
             self.pages_enqueued += 1
+            self.bytes_enqueued += len(page)
             self._lock.notify_all()
 
     def _evict_locked(self, need: int) -> bool:

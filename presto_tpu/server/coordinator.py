@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import datetime
 import json
+import logging
 import threading
 import time
 import traceback
@@ -284,6 +285,21 @@ class QueryExecution:
         self.task_stats: Dict[int, List[Dict]] = {}
         self._task_infos: Dict[int, List[Dict]] = {}
         self._stats_collected = False
+        # -- live telemetry (sampler-fed, StatementStats role) -------------
+        # bounded per-query time-series ring: one sample per sampler
+        # sweep while RUNNING, served at /v1/query/{id}/timeseries
+        self.timeseries: List[Dict] = []
+        # latest reference-shaped progress snapshot (totalSplits /
+        # runningSplits / completedSplits / processedRows / ...) carried
+        # on every client-protocol poll ("stats" object)
+        self._progress: Dict = {}
+        # serializes live-sample folds against the final post-drain
+        # collection (the final rollup always wins)
+        self._stats_lock = threading.Lock()
+        self._sampler_started = False
+        # phase marks for the timed span tree (presto_tpu.spans):
+        # name -> (start, end) epoch seconds, coordinator-owned
+        self._marks: Dict[str, Tuple[float, float]] = {}
         self._completed_fired = False
         self.co.event_bus.query_created(ev.QueryCreatedEvent(
             self.query_id, self.user, self.sql, self.create_time,
@@ -328,13 +344,48 @@ class QueryExecution:
         self._completed_fired = True
         self.end_time = ev.now()
         qs = self.query_stats or {}
+        try:
+            spans = self.spans()
+        except Exception:  # noqa: BLE001 - observability never fails
+            spans = {}
         self.co.event_bus.query_completed(ev.QueryCompletedEvent(
             self.query_id, self.user, self.sql, self.state,
             self.error, self.create_time, self.end_time,
             len(self.result_rows), int(qs.get("peak_memory_bytes", 0)),
             [], trace_token=self.trace_token,
             stage_stats=[self.stage_stats[fid]
-                         for fid in sorted(self.stage_stats)]))
+                         for fid in sorted(self.stage_stats)],
+            spans=spans))
+        elapsed = max(self.end_time - self.create_time, 0.0)
+        execution_s = self.execution_s or (
+            max(self.end_time - self.admit_time, 0.0)
+            if self.admit_time is not None else elapsed)
+        # dispatcher-lifecycle latency histograms (/metrics:
+        # presto_query_queued_seconds / presto_query_execution_seconds)
+        hists = getattr(self.co, "latency_histograms", None)
+        if hists is not None:
+            hists["queued"].observe(self.queued_s)
+            hists["execution"].observe(execution_s)
+        # slow-query log: one structured line + one SlowQueryEvent past
+        # the threshold (0 disables), naming the queued/execution split
+        # and the hottest operator so the log line alone says where the
+        # wall clock went
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        threshold = cfg.slow_query_log_threshold_s
+        if threshold > 0 and elapsed >= threshold:
+            top = self._top_operator()
+            logging.getLogger("presto_tpu.coordinator").warning(
+                "slow query %s [trace:%s] user=%s elapsed=%.3fs "
+                "(queued=%.3fs execution=%.3fs, threshold=%.3fs) "
+                "top_operator=%s sql=%r",
+                self.query_id, self.trace_token, self.user, elapsed,
+                self.queued_s, execution_s, threshold, top or "?",
+                self.sql[:200])
+            self.co.event_bus.slow_query(ev.SlowQueryEvent(
+                self.query_id, self.trace_token, self.user,
+                self.sql[:500], round(elapsed, 6),
+                round(self.queued_s, 6), round(execution_s, 6),
+                threshold, top, ev.now()))
 
     def _execute_query_dplan(self, dplan: DistributedPlan,
                              analyze: bool) -> None:
@@ -343,9 +394,12 @@ class QueryExecution:
         self.column_names = dplan.column_names
         self.column_types = dplan.column_types
         self.state = "SCHEDULING"
-        root_locations = self._schedule(dplan)
+        with self._mark("schedule"):
+            root_locations = self._schedule(dplan)
         self.state = "RUNNING"
-        self._drain(root_locations)
+        self._start_sampler()
+        with self._mark("execute"):
+            self._drain(root_locations)
         self._collect_stats()
         if analyze:
             text = self._render_analyze(dplan)
@@ -386,10 +440,13 @@ class QueryExecution:
                 dplan, self.plan_text = hit
                 self.plan_cached = True
                 return dplan
-        logical = Planner(metadata).plan(stmt)
-        optimized = optimize(logical, metadata, cfg)
-        dplan = Fragmenter(metadata=metadata,
-                           config=cfg).fragment(optimized)
+        with self._mark("analyze"):
+            logical = Planner(metadata).plan(stmt)
+        with self._mark("optimize"):
+            optimized = optimize(logical, metadata, cfg)
+        with self._mark("fragment"):
+            dplan = Fragmenter(metadata=metadata,
+                               config=cfg).fragment(optimized)
         self.plan_text = self._format_dplan(dplan)
         if key is not None:
             cats = {self.catalog}
@@ -415,7 +472,8 @@ class QueryExecution:
                 self._execute_query_dplan(dplan, analyze=False)
                 self.state = "FINISHED"
                 return
-            stmt = parse_statement(self.sql)
+            with self._mark("parse"):
+                stmt = parse_statement(self.sql)
             stmt = self._session_statement(stmt)
             if stmt is None:
                 self.state = "FINISHED"
@@ -448,9 +506,12 @@ class QueryExecution:
                     self.plan_text = self._format_dplan(dplan)
                     self.state = "SCHEDULING"
                     try:
-                        root_locations = self._schedule(dplan)
+                        with self._mark("schedule"):
+                            root_locations = self._schedule(dplan)
                         self.state = "RUNNING"
-                        self._drain(root_locations)
+                        self._start_sampler()
+                        with self._mark("execute"):
+                            self._drain(root_locations)
                         self._collect_stats()
                     except Exception:
                         abort()
@@ -543,25 +604,20 @@ class QueryExecution:
             max_error_duration_s=max_error_duration_s)
         return resp.json()
 
-    def _collect_stats(self) -> None:
-        """Fetch every placement's task info ONCE and roll it up:
-        TaskStats -> StageStats (per fragment) -> QueryStats.  Runs
-        right after the drain, before the cancel fan-out can tear the
-        tasks down; best-effort per task (a dead worker's tasks simply
-        do not report).  Feeds distributed EXPLAIN ANALYZE, the
-        /v1/query detail payload, QueryCompletedEvent, system.runtime,
-        and tools/query_profile.py."""
-        from presto_tpu.exec.context import (
-            QueryStats, StageStats, TaskStats,
-        )
-
-        if self._stats_collected or not self._tasks_scheduled:
-            return
-        self._stats_collected = True
-        with self._recovery_lock:
-            placements = list(self._placements)
+    def _fetch_task_infos(self, placements,
+                          join_timeout_s: float = 15.0,
+                          request_timeout_s: float = 10.0
+                          ) -> Dict[int, List[Dict]]:
+        """Fetch task info for every placement, one thread per worker so
+        one hung worker costs exactly one timeout (never the whole
+        sweep); budget 0 per request, best-effort per task.  Shared by
+        the final post-drain collection and the live sampler (which
+        passes a tighter timeout so one hung worker costs one sample).
+        spool:// placements have no task to report."""
         by_uri: Dict[str, List[Tuple[int, str]]] = {}
         for fid, tid, uri in placements:
+            if uri.startswith("spool://"):
+                continue
             by_uri.setdefault(uri, []).append((fid, tid))
         results: List[Tuple[int, Dict]] = []
         results_lock = threading.Lock()
@@ -569,8 +625,14 @@ class QueryExecution:
         def fetch_worker(uri: str, tasks) -> None:
             for fid, tid in tasks:
                 try:
-                    info = self._fetch_task_info(
-                        tid, uri, max_error_duration_s=0.0)
+                    resp = self.co.http.request(
+                        f"{uri}/v1/task/{tid}",
+                        headers=self._internal_headers(),
+                        timeout=request_timeout_s, task_id=tid,
+                        description="task status",
+                        trace_token=self.trace_token,
+                        max_error_duration_s=0.0)
+                    info = resp.json()
                 except Exception:  # noqa: BLE001 - worker may be gone
                     return   # same host: further fetches will hang too
                 with results_lock:
@@ -583,13 +645,23 @@ class QueryExecution:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=15)
+            t.join(timeout=join_timeout_s)
         infos: Dict[int, List[Dict]] = {}
         with results_lock:
             for fid, info in results:
                 infos.setdefault(fid, []).append(info)
-        self._task_infos = infos
-        n_tasks = {}
+        return infos
+
+    def _rollup_stats(self, infos: Dict[int, List[Dict]], placements
+                      ) -> Tuple[Dict, Dict, Dict]:
+        """TaskStats -> StageStats (per fragment) -> QueryStats from one
+        sweep of task infos; pure aggregation, shared by the final
+        collection and every live-sampler fold."""
+        from presto_tpu.exec.context import (
+            QueryStats, StageStats, TaskStats,
+        )
+
+        n_tasks: Dict[int, int] = {}
         for fid, _tid, _uri in placements:
             n_tasks[fid] = n_tasks.get(fid, 0) + 1
         stage_stats: Dict[int, Dict] = {}
@@ -611,9 +683,182 @@ class QueryExecution:
         qs.execution_s = round(
             ev.now() - self.admit_time if self.admit_time is not None
             else qs.elapsed_s, 6)
-        self.stage_stats = stage_stats
-        self.task_stats = task_stats
-        self.query_stats = qs.as_dict()
+        return stage_stats, task_stats, qs.as_dict()
+
+    def _collect_stats(self) -> None:
+        """Fetch every placement's task info ONCE and roll it up:
+        TaskStats -> StageStats (per fragment) -> QueryStats.  Runs
+        right after the drain, before the cancel fan-out can tear the
+        tasks down; best-effort per task (a dead worker's tasks simply
+        do not report).  Feeds distributed EXPLAIN ANALYZE, the
+        /v1/query detail payload, QueryCompletedEvent, system.runtime,
+        and tools/query_profile.py.  The live sampler folds the same
+        rollup mid-query; this final collection supersedes it."""
+        if self._stats_collected or not self._tasks_scheduled:
+            return
+        self._stats_collected = True
+        with self._recovery_lock:
+            placements = list(self._placements)
+        infos = self._fetch_task_infos(placements)
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        with self._stats_lock:
+            self._task_infos = infos
+            (self.stage_stats, self.task_stats,
+             self.query_stats) = self._rollup_stats(infos, placements)
+            if cfg.stats_sampling_enabled:
+                # settle the progress surfaces on the final rollup: the
+                # last mid-query sample can predate the root task's
+                # finish, and a fast query may never have been sampled
+                self._append_sample(infos, placements,
+                                    self.query_stats, cfg)
+
+    # -- live stats sampling (StatementStats/QueryProgressStats role) ---
+    def _start_sampler(self) -> None:
+        """Poll every placement's task info at ``stats_sample_interval_s``
+        while the query is RUNNING, folding each sweep into the live
+        StageStats/QueryStats rollup and appending one sample to the
+        bounded time-series ring — progress becomes observable
+        MID-query (timeseries endpoint, client-protocol stats object,
+        system.runtime, web UI).  Disabled =
+        PR 8's single post-drain collection, exactly."""
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        if (not cfg.stats_sampling_enabled or self._sampler_started
+                or not self._tasks_scheduled):
+            return
+        self._sampler_started = True
+        threading.Thread(
+            target=self._sampler_loop,
+            args=(max(cfg.stats_sample_interval_s, 0.02), cfg),
+            daemon=True,
+            name=f"stats-sampler-{self.query_id}").start()
+
+    def _sampler_loop(self, interval_s: float, cfg) -> None:
+        while not self._monitor_stop.wait(interval_s):
+            if self._stats_collected or self.state != "RUNNING":
+                return
+            try:
+                self._sample_tick(cfg)
+            except Exception:  # noqa: BLE001 - sampling is advisory
+                pass
+
+    def _sample_tick(self, cfg) -> None:
+        with self._recovery_lock:
+            placements = list(self._placements)
+        if not placements:
+            return
+        # per-worker bounded timeout: one hung worker costs one sample,
+        # never the sampler cadence of every other worker
+        infos = self._fetch_task_infos(placements, join_timeout_s=2.5,
+                                       request_timeout_s=2.0)
+        if not infos:
+            return
+        stage_stats, task_stats, qs = self._rollup_stats(infos,
+                                                         placements)
+        with self._stats_lock:
+            if self._stats_collected:
+                return   # final collection already superseded sampling
+            self.stage_stats = stage_stats
+            self.task_stats = task_stats
+            self.query_stats = qs
+            self._task_infos = infos
+            self._append_sample(infos, placements, qs, cfg)
+
+    def _append_sample(self, infos, placements, qs: Dict, cfg) -> None:
+        """One time-series sample + the latest client-protocol progress
+        snapshot.  Cumulative counters are clamped monotonic against the
+        previous sample: a worker missing one sweep must read as stale,
+        never as regressing progress."""
+        flat = [i for lst in infos.values() for i in lst]
+        total = len(placements)
+        completed = sum(1 for i in flat
+                        if i.get("state") == "FINISHED")
+        running = sum(1 for i in flat if i.get("state") == "RUNNING")
+        in_rows = qs.get("input_rows", 0)
+        out_rows = qs.get("output_rows", 0)
+        out_bytes = qs.get("output_bytes", 0)
+        prev = self.timeseries[-1] if self.timeseries else None
+        if prev is not None:
+            completed = max(completed, prev["splits_completed"])
+            in_rows = max(in_rows, prev["input_rows"])
+            out_rows = max(out_rows, prev["output_rows"])
+            out_bytes = max(out_bytes, prev["output_bytes"])
+        sample = {
+            "t": round(ev.now(), 6),
+            "state": self.state,
+            "splits_total": total,
+            "splits_queued": max(total - running - completed, 0),
+            "splits_running": running,
+            "splits_completed": completed,
+            "input_rows": in_rows,
+            "output_rows": out_rows,
+            "output_bytes": out_bytes,
+            "peak_memory_bytes": qs.get("peak_memory_bytes", 0),
+            "exchange_backlog": max(
+                qs.get("exchange_fetched", 0)
+                - qs.get("exchange_consumed", 0), 0),
+            "pages_enqueued": qs.get("pages_enqueued", 0),
+            "pages_spooled": qs.get("pages_spooled", 0),
+            "jit_dispatches": qs.get("jit_dispatches", 0),
+        }
+        self.timeseries.append(sample)
+        cap = max(int(cfg.stats_timeseries_capacity), 1)
+        if len(self.timeseries) > cap:
+            del self.timeseries[:len(self.timeseries) - cap]
+        self._progress = {
+            "totalSplits": total,
+            "queuedSplits": sample["splits_queued"],
+            "runningSplits": running,
+            "completedSplits": completed,
+            "processedRows": out_rows,
+            "processedBytes": out_bytes,
+            "peakMemoryBytes": sample["peak_memory_bytes"],
+            "progressPercent": (round(100.0 * completed / total, 2)
+                                if total else 0.0),
+        }
+
+    def _mark(self, name: str):
+        """Record one coordinator phase span (presto_tpu.spans) around a
+        ``with`` block; marks feed the /v1/query/{id}/spans tree."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            t0 = ev.now()
+            try:
+                yield
+            finally:
+                self._marks[name] = (t0, ev.now())
+
+        return cm()
+
+    def spans(self) -> Dict:
+        """The timed span tree: query -> coordinator phases -> per-stage
+        -> per-task-attempt, from coordinator-owned timestamps plus the
+        task-info start/end lifecycle (live sampler mid-query, final
+        rollup after)."""
+        from presto_tpu.spans import build_span_tree
+
+        with self._stats_lock:
+            task_stats = {fid: [dict(ts) for ts in lst]
+                          for fid, lst in self.task_stats.items()}
+            marks = dict(self._marks)
+        return build_span_tree(
+            self.query_id, self.trace_token, self.create_time,
+            self.end_time, marks, task_stats,
+            admit_time=self.admit_time)
+
+    def _top_operator(self) -> str:
+        """Name of the hottest operator by exclusive wall across every
+        reporting task (the slow-query log's one-line attribution)."""
+        best, best_wall = "", -1
+        with self._stats_lock:
+            infos = [i for lst in self._task_infos.values() for i in lst]
+        for info in infos:
+            for s in info.get("operatorStats") or []:
+                wall = s.get("wall_ns", 0) + s.get("finish_wall_ns", 0)
+                if wall > best_wall:
+                    best, best_wall = s.get("operator", ""), wall
+        return best
 
     def _render_analyze(self, dplan: DistributedPlan) -> str:
         """Fragment plan + per-operator stats aggregated across each
@@ -623,13 +868,18 @@ class QueryExecution:
         set as the local tier's explain_analyze_text — jit dispatches/
         compiles, pre-reduce rows, peak memory — so the two tiers stay
         diffable."""
+        from presto_tpu.exec.context import hot_operator_lines as \
+            _hot_operator_lines
         from presto_tpu.sql.plan import format_plan
 
         self._collect_stats()
         lines: List[str] = []
+        # every aggregated operator across fragments, for the
+        # hot-operator footer (ranked by exclusive wall)
+        hot: List[Dict] = []
         header = (f"{'operator':<36} {'tasks':>5} {'in rows':>11} "
-                  f"{'out rows':>11} {'wall ms':>9} {'jit disp':>8} "
-                  f"{'jit comp':>8} {'prereduce':>9}")
+                  f"{'out rows':>11} {'wall ms':>9} {'compile ms':>10} "
+                  f"{'jit disp':>8} {'jit comp':>8} {'prereduce':>9}")
         for f in dplan.fragments:
             fid = f.fragment_id
             with self._recovery_lock:
@@ -657,6 +907,7 @@ class QueryExecution:
                     if a is None:
                         a = dict(s)
                         a["wall_ns"] = wall
+                        a.setdefault("jit_compile_ns", 0)
                         agg[s["operator"]] = a
                     else:
                         a["input_rows"] += s["input_rows"]
@@ -664,6 +915,7 @@ class QueryExecution:
                         a["wall_ns"] = max(a["wall_ns"], wall)
                         a["jit_dispatches"] += s.get("jit_dispatches", 0)
                         a["jit_compiles"] += s.get("jit_compiles", 0)
+                        a["jit_compile_ns"] += s.get("jit_compile_ns", 0)
                         a["prereduce_rows"] += s.get("prereduce_rows", 0)
             lines.append("    " + header)
             lines.append("    " + "-" * len(header))
@@ -672,9 +924,12 @@ class QueryExecution:
                 lines.append(
                     f"    {a['operator']:<36} {n_reporting:>5} "
                     f"{a['input_rows']:>11} {a['output_rows']:>11} "
-                    f"{wall_ms:>9.1f} {a.get('jit_dispatches', 0):>8} "
+                    f"{wall_ms:>9.1f} "
+                    f"{a.get('jit_compile_ns', 0) / 1e6:>10.1f} "
+                    f"{a.get('jit_dispatches', 0):>8} "
                     f"{a.get('jit_compiles', 0):>8} "
                     f"{a.get('prereduce_rows', 0):>9}")
+                hot.append(a)
             st = self.stage_stats.get(fid)
             if st:
                 lines.append(
@@ -688,13 +943,17 @@ class QueryExecution:
                     f"{st['exchange_fetched']}f/"
                     f"{st['exchange_consumed']}c/"
                     f"{st['exchange_purged']}p")
+        lines.extend(_hot_operator_lines(hot))
         qs = self.query_stats
         if qs:
             lines.append(
                 f"query: peak memory "
                 f"{qs['peak_memory_bytes'] / (1 << 20):.1f} MiB; "
                 f"jit dispatches: {qs['jit_dispatches']}, "
-                f"compiles: {qs['jit_compiles']}; "
+                f"compiles: {qs['jit_compiles']} "
+                f"({qs.get('jit_compile_ns', 0) / 1e6:.1f} ms compile, "
+                f"{max(qs.get('total_wall_ns', 0) - qs.get('jit_compile_ns', 0), 0) / 1e6:.1f}"
+                f" ms execute); "
                 f"prereduce rows: {qs['prereduce_rows']}; "
                 f"trace token: {self.trace_token}")
             lines.append(
@@ -2320,8 +2579,26 @@ class QueryExecution:
                 return rows
 
     # -- client protocol ------------------------------------------------
+    def protocol_stats(self) -> Dict:
+        """The reference-shaped ``stats`` object carried on every
+        client-protocol poll (StatementStats role): state plus — once
+        the live sampler has swept — split accounting and cumulative
+        progress, so a client observes progress MID-query instead of a
+        bare state string."""
+        end = self.end_time if self.end_time is not None else ev.now()
+        stats: Dict = {
+            "state": self.state,
+            "queued": self.state in ("QUEUED", "WAITING_FOR_RESOURCES"),
+            "scheduled": self._tasks_scheduled,
+            "queuedTimeMillis": int(self.queued_s * 1000),
+            "elapsedTimeMillis": int(
+                max(end - self.create_time, 0.0) * 1000),
+        }
+        stats.update(self._progress)
+        return stats
+
     def results_payload(self, base_uri: str) -> Dict:
-        out: Dict = {"id": self.query_id, "stats": {"state": self.state},
+        out: Dict = {"id": self.query_id, "stats": self.protocol_stats(),
                      "traceToken": self.trace_token}
         if self.state == "FAILED":
             err: Dict = {"message": self.error or "query failed"}
@@ -2437,9 +2714,15 @@ async function showDetail(id) {
   let spec = (q.speculations || []).map(
     s => s.task + ' -> ' + s.clone + ' [' + s.state + ']').join(', ');
   // textContent only: SQL/plan/error are untrusted
+  const prog = q.progress || {};
   document.getElementById('detail').textContent =
     'query: ' + (q.query || '') + '\n' +
     'state: ' + q.state + (q.error ? '\nerror: ' + q.error : '') +
+    '\nprogress: ' + (prog.completedSplits || 0) + '/' +
+    (prog.totalSplits || 0) + ' splits (' +
+    (prog.progressPercent || 0) + '%), rows ' +
+    (prog.processedRows || 0) +
+    '  [' + (q.timeseriesSamples || 0) + ' samples]' +
     '\nresource group: ' + (q.resourceGroup || '(none)') +
     '  queued: ' + (q.queuedS || 0).toFixed(3) + 's' +
     '  execution: ' + (q.executionS || 0).toFixed(3) + 's' +
@@ -2515,6 +2798,13 @@ class CoordinatorServer:
             except Exception:  # noqa: BLE001 - sweep is best-effort
                 pass
         self.queries: Dict[str, QueryExecution] = {}
+        # dispatcher-lifecycle latency histograms (/metrics:
+        # presto_query_queued_seconds / presto_query_execution_seconds),
+        # observed once per query at completion
+        from presto_tpu.server.metrics import Histogram
+
+        self.latency_histograms = {"queued": Histogram(),
+                                   "execution": Histogram()}
         # mesh-wide event stream (EventListener SPI / QueryMonitor role):
         # the coordinator fires query lifecycle + fault-tolerance events;
         # ``event_log_path`` bundles the query.json JSON-lines listener
@@ -2736,13 +3026,41 @@ class CoordinatorServer:
                              "pages_spooled", 0),
                          "queuedS": round(q.queued_s, 3),
                          "resourceGroup": q.resource_group_name,
-                         "planCached": q.plan_cached}
+                         "planCached": q.plan_cached,
+                         # live progress (sampler-fed, mid-query)
+                         "totalSplits": q._progress.get(
+                             "totalSplits", 0),
+                         "completedSplits": q._progress.get(
+                             "completedSplits", 0),
+                         "progressPercent": q._progress.get(
+                             "progressPercent", 0.0)}
                         for q in co.queries.values()])
                     return
                 if parts == ["v1", "tasks"]:
-                    # aggregate live task state from every worker
-                    # (system.runtime.tasks)
+                    # live task state for system.runtime.tasks, fed
+                    # from each query's sampler rollup (updated
+                    # mid-query at the sample cadence; the final
+                    # post-drain collection supersedes it) so a hung
+                    # worker costs bounded staleness, never a dropped
+                    # listing.  Tasks the rollup has not seen yet —
+                    # sampling disabled, or polled before the first
+                    # sweep — still come from the worker fan-out.
                     out = []
+                    seen = set()
+                    for q in list(co.queries.values()):
+                        with q._stats_lock:
+                            tss = [dict(ts)
+                                   for lst in q.task_stats.values()
+                                   for ts in lst]
+                        for ts in tss:
+                            tid = ts.get("task_id")
+                            if not tid:
+                                continue
+                            seen.add(tid)
+                            out.append({"taskId": tid,
+                                        "state": ts.get("state", ""),
+                                        "nodeId": "",
+                                        "taskStats": ts})
                     for nid, uri in co.nodes.responsive_nodes():
                         try:
                             hdrs = (co.internal_auth.header()
@@ -2753,11 +3071,38 @@ class CoordinatorServer:
                                 timeout=5, description="task listing",
                                 max_error_duration_s=0.0)
                             for t in resp.json():
+                                if t.get("taskId") in seen:
+                                    continue
                                 t["nodeId"] = nid
                                 out.append(t)
                         except Exception:  # noqa: BLE001 - node flaky
                             pass
                     self._json(200, out)
+                    return
+                if parts[:2] == ["v1", "query"] and len(parts) == 4 \
+                        and parts[3] == "timeseries":
+                    # the live sampler's bounded per-query ring: one
+                    # sample per sweep while the query was RUNNING
+                    q = co.queries.get(parts[2])
+                    if q is None:
+                        self._json(404, {"error": "no such query"})
+                        return
+                    with q._stats_lock:
+                        samples = list(q.timeseries)
+                    self._json(200, {"queryId": q.query_id,
+                                     "state": q.state,
+                                     "traceToken": q.trace_token,
+                                     "samples": samples})
+                    return
+                if parts[:2] == ["v1", "query"] and len(parts) == 4 \
+                        and parts[3] == "spans":
+                    # the timed span tree (same shape query.json carries
+                    # on QueryCompletedEvent — the two must round-trip)
+                    q = co.queries.get(parts[2])
+                    if q is None:
+                        self._json(404, {"error": "no such query"})
+                        return
+                    self._json(200, q.spans())
                     return
                 if parts[:2] == ["v1", "query"] and len(parts) == 3:
                     q = co.queries.get(parts[2])
@@ -2800,7 +3145,11 @@ class CoordinatorServer:
                                        in q.stage_stats.items()},
                         "taskStats": {str(fid): ts for fid, ts
                                       in q.task_stats.items()},
-                        "queryStats": q.query_stats})
+                        "queryStats": q.query_stats,
+                        # live progress + time-series depth (the web UI
+                        # detail page shows mid-query movement)
+                        "progress": dict(q._progress),
+                        "timeseriesSamples": len(q.timeseries)})
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
 

@@ -84,7 +84,10 @@ class DistributedQueryRunner:
                          q.get("producerReruns", 0),
                          q.get("queuedS", 0.0),
                          q.get("resourceGroup"),
-                         q.get("planCached", False))
+                         q.get("planCached", False),
+                         q.get("completedSplits", 0),
+                         q.get("totalSplits", 0),
+                         q.get("progressPercent", 0.0))
                         for q in fetch("/v1/query")]
 
             def tasks_fn():
@@ -95,7 +98,8 @@ class DistributedQueryRunner:
                                 t["taskId"].rsplit(".", 2)[0],
                                 ts.get("output_rows", 0),
                                 round(ts.get("wall_ns", 0) / 1e6, 3),
-                                ts.get("peak_memory_bytes", 0)))
+                                ts.get("peak_memory_bytes", 0),
+                                round(ts.get("elapsed_s", 0.0), 6)))
                 return out
 
             reg.register("system", SystemConnector(

@@ -19,10 +19,58 @@ without HTTP.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Sequence, Tuple
 
 #: one family: (name, 'gauge'|'counter', help, [(labels, value), ...])
 Family = Tuple[str, str, str, List[Tuple[Dict[str, str], float]]]
+
+#: fixed latency buckets (seconds) for the query-lifecycle histograms —
+#: stable across scrapes so rate()/histogram_quantile() work
+LATENCY_BUCKETS_S = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+class Histogram:
+    """A fixed-bucket Prometheus histogram (cumulative bucket counts +
+    _sum + _count).  Observations come from the dispatcher lifecycle
+    (queued / execution seconds per query); thread-safe because queries
+    complete on their own threads."""
+
+    def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS_S):
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * len(self.buckets)
+        self.total = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = max(float(value), 0.0)
+        with self._lock:
+            self.total += 1
+            self.sum += v
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    self.counts[i] += 1
+
+    def snapshot(self) -> Tuple[List[Tuple[float, int]], int, float]:
+        """(cumulative (le, count) pairs, count, sum) — cumulative
+        counts as the exposition format requires."""
+        with self._lock:
+            return (list(zip(self.buckets, self.counts)), self.total,
+                    self.sum)
+
+
+def histogram_text(name: str, help_: str, hist: Histogram) -> str:
+    """Render one histogram family in the text exposition format."""
+    pairs, total, sum_ = hist.snapshot()
+    lines = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+    for le, n in pairs:
+        lines.append(f'{name}_bucket{{le="{_fmt(le)}"}} {n}')
+    lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
+    lines.append(f"{name}_sum {repr(float(sum_))}")
+    lines.append(f"{name}_count {total}")
+    return "\n".join(lines) + "\n"
 
 
 def _escape(v: str) -> str:
@@ -66,13 +114,19 @@ def _kernel_cache_families(prefix: str) -> List[Family]:
 
     stats = cache_stats()
     fams: List[Family] = []
-    for key in ("size", "hits", "misses", "evictions"):
+    for key in ("size", "hits", "misses", "evictions", "compiles"):
         fams.append((
             f"{prefix}_kernel_cache_{key}",
             "gauge" if key == "size" else "counter",
             f"compiled-kernel cache {key} per named cache",
             [({"cache": name}, s.get(key, 0))
              for name, s in sorted(stats.items())]))
+    # per-cache compile-time attribution (kernelcache.record_compile)
+    fams.append((
+        f"{prefix}_kernel_cache_compile_seconds_total", "counter",
+        "wall seconds spent building entries per named cache",
+        [({"cache": name}, s.get("compile_ns", 0) / 1e9)
+         for name, s in sorted(stats.items())]))
     return fams
 
 
@@ -181,7 +235,20 @@ def coordinator_metrics(co) -> str:
     fams.extend(_plan_cache_families("presto"))
     fams.extend(_spool_families("presto", getattr(co, "spool", None)))
     fams.extend(_kernel_cache_families("presto"))
-    return prometheus_text(fams)
+    text = prometheus_text(fams)
+    # dispatcher-lifecycle latency histograms: the scrape-side
+    # cross-check for tools/qps_run.py's client-side latency numbers
+    hists = getattr(co, "latency_histograms", None)
+    if hists is not None:
+        text += histogram_text(
+            "presto_query_queued_seconds",
+            "seconds queries spent queued for admission",
+            hists["queued"])
+        text += histogram_text(
+            "presto_query_execution_seconds",
+            "seconds queries spent executing (admission to settled)",
+            hists["execution"])
+    return text
 
 
 def worker_metrics(worker) -> str:

@@ -176,6 +176,7 @@ class SqlTask:
         ts.end_time = end
         ts.elapsed_s = max(end - self.start_time, 0.0)
         ts.pages_enqueued = self.buffers.pages_enqueued
+        ts.output_bytes = self.buffers.bytes_enqueued
         ts.pages_spooled = self.buffers.pages_spooled
         ts.pages_evicted = self.buffers.pages_evicted
         ts.bytes_evicted = self.buffers.bytes_evicted

@@ -102,6 +102,11 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
                            lambda v: v.lower() in ("true", "1", "on")),
     "plan_cache_capacity": ("plan_cache_capacity", int),
     "query_queue_timeout_s": ("query_queue_timeout_s", float),
+    "stats_sampling_enabled": (
+        "stats_sampling_enabled",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "stats_sample_interval_s": ("stats_sample_interval_s", float),
+    "slow_query_log_threshold_s": ("slow_query_log_threshold_s", float),
 }
 
 

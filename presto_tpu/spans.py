@@ -1,0 +1,171 @@
+"""Timed query spans: where a query's wall clock went.
+
+The airlift stage-timing role (the reference attributes wall time to
+dispatch/queue/planning/scheduling phases on the coordinator and to
+per-stage/task execution on the workers; the web UI renders it as the
+query timeline).  Here the coordinator records a ``QuerySpan`` tree from
+timestamps it already owns:
+
+    query
+    ├── queue            (create -> admission)
+    ├── parse / analyze / optimize / fragment / schedule
+    ├── execute          (drain span)
+    └── stage-{fid}
+        └── task {task_id} (attempt aN)   one span per task attempt
+
+Every span carries the query's trace token as its trace id, wall-clock
+``start``/``end`` (epoch seconds), and nests inside its parent (the
+builder clamps children into the query window, so ``end >= start``
+always holds).  The tree is served at ``/v1/query/{id}/spans``,
+serialized into ``QueryCompletedEvent``/query.json, and rendered by
+``tools/query_profile.py`` as the ASCII timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class QuerySpan:
+    """One timed span; ``kind`` is query | phase | stage | task."""
+
+    name: str
+    kind: str
+    start: float
+    end: float
+    trace_token: str = ""
+    attributes: Dict = dataclasses.field(default_factory=dict)
+    children: List["QuerySpan"] = dataclasses.field(default_factory=list)
+
+    def as_dict(self) -> Dict:
+        return {
+            "name": self.name, "kind": self.kind,
+            "start": round(self.start, 6), "end": round(self.end, 6),
+            "durationS": round(max(self.end - self.start, 0.0), 6),
+            "traceToken": self.trace_token,
+            "attributes": dict(self.attributes),
+            "children": [c.as_dict() for c in self.children],
+        }
+
+
+#: coordinator phase order in the rendered timeline
+PHASES = ("queue", "parse", "analyze", "optimize", "fragment", "schedule",
+          "execute")
+
+
+def _clamp(start: float, end: float, lo: float, hi: float
+           ) -> Tuple[float, float]:
+    start = min(max(start, lo), hi)
+    end = min(max(end, start), hi)
+    return start, end
+
+
+def _attempt_of(task_id: str) -> int:
+    """Attempt number from a task id (``{base}aN`` suffix; 0 if none)."""
+    tail = task_id.rsplit(".", 1)[-1]
+    if "a" in tail:
+        try:
+            return int(tail.rsplit("a", 1)[1])
+        except ValueError:
+            return 0
+    return 0
+
+
+def build_span_tree(query_id: str, trace_token: str,
+                    create_time: float, end_time: Optional[float],
+                    marks: Dict[str, Tuple[float, float]],
+                    task_stats: Dict, admit_time: Optional[float] = None,
+                    now: Optional[float] = None) -> Dict:
+    """Assemble the span tree from coordinator-owned timestamps.
+
+    ``marks`` holds per-phase (start, end) recorded by the query thread;
+    ``task_stats`` is the {fid: [TaskStats dict]} rollup (live sampler
+    mid-query, final collection after) whose per-task start/end times
+    become the stage/task-attempt spans."""
+    import time as _time
+
+    t_now = now if now is not None else _time.time()
+    q_end = end_time if end_time is not None else t_now
+    q_end = max(q_end, create_time)
+    root = QuerySpan(query_id, "query", create_time, q_end, trace_token)
+    if admit_time is not None and admit_time > create_time:
+        s, e = _clamp(create_time, admit_time, create_time, q_end)
+        root.children.append(
+            QuerySpan("queue", "phase", s, e, trace_token))
+    for name in PHASES:
+        if name not in marks:
+            continue
+        s, e = _clamp(*marks[name], create_time, q_end)
+        root.children.append(QuerySpan(name, "phase", s, e, trace_token))
+    for fid in sorted(task_stats, key=lambda k: int(k)):
+        tss = [ts for ts in task_stats[fid] if ts.get("start_time")]
+        if not tss:
+            continue
+        s0 = min(ts["start_time"] for ts in tss)
+        e0 = max(ts.get("end_time") or t_now for ts in tss)
+        s0, e0 = _clamp(s0, e0, create_time, q_end)
+        stage = QuerySpan(f"stage-{fid}", "stage", s0, e0, trace_token,
+                          attributes={"fragmentId": int(fid),
+                                      "tasks": len(tss)})
+        for ts in tss:
+            s, e = _clamp(ts["start_time"],
+                          ts.get("end_time") or t_now,
+                          s0, e0)
+            tid = ts.get("task_id", "?")
+            stage.children.append(QuerySpan(
+                tid, "task", s, e, trace_token,
+                attributes={"attempt": _attempt_of(tid),
+                            "state": ts.get("state", ""),
+                            "outputRows": ts.get("output_rows", 0),
+                            "jitCompileNs": ts.get("jit_compile_ns", 0)}))
+        root.children.append(stage)
+    return root.as_dict()
+
+
+def validate_span_tree(tree: Dict) -> List[str]:
+    """Structural checks (tests + query_profile --check): every child
+    nests inside its parent and every span has end >= start.  Returns a
+    list of violations (empty = valid)."""
+    errors: List[str] = []
+
+    def walk(node: Dict, lo: float, hi: float) -> None:
+        s, e = node["start"], node["end"]
+        if e < s:
+            errors.append(f"{node['name']}: end {e} < start {s}")
+        if s < lo - 1e-6 or e > hi + 1e-6:
+            errors.append(
+                f"{node['name']}: [{s}, {e}] outside parent [{lo}, {hi}]")
+        for c in node.get("children", []):
+            walk(c, s, e)
+
+    walk(tree, tree["start"], tree["end"])
+    return errors
+
+
+def render_span_tree(tree: Dict, width: int = 40) -> List[str]:
+    """ASCII timeline of the span tree (tools/query_profile.py): one
+    bar per span, positioned within the query window."""
+    t0, t1 = tree["start"], tree["end"]
+    total = max(t1 - t0, 1e-6)
+    lines = [f"span timeline ({total * 1000:.1f} ms total, "
+             f"trace={tree.get('traceToken', '')})"]
+
+    def bar(s: float, e: float) -> str:
+        lo = int((s - t0) / total * width)
+        hi = max(int((e - t0) / total * width), lo + 1)
+        hi = min(hi, width)
+        lo = min(lo, hi - 1)
+        return " " * lo + "=" * (hi - lo) + " " * (width - hi)
+
+    def walk(node: Dict, depth: int) -> None:
+        label = ("  " * depth + node["name"])[:30]
+        lines.append(
+            f"  {label:<30} |{bar(node['start'], node['end'])}| "
+            f"{node['durationS'] * 1000:>9.1f} ms")
+        for c in node.get("children", []):
+            walk(c, depth + 1)
+
+    walk(tree, 0)
+    return lines

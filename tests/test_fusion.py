@@ -630,4 +630,7 @@ def test_task_info_reports_kernel_caches():
     stats = cache_stats()
     assert "filter_project" in stats and "fused_segment" in stats
     for s in stats.values():
-        assert set(s) == {"size", "hits", "misses", "evictions"}
+        # compiles/compile_ns: per-cache compile-time attribution
+        # (kernelcache.record_compile) surfaced alongside hit/miss
+        assert set(s) == {"size", "hits", "misses", "evictions",
+                          "compiles", "compile_ns"}

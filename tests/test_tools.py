@@ -175,19 +175,105 @@ class TestQueryProfile:
         query_profile = importlib.import_module("query_profile")
         log = str(tmp_path / "query.json")
         rc = query_profile.main(
-            ["--scale", "0.002", "--check", "--event-log", log])
+            ["--scale", "0.002", "--check", "--live",
+             "--event-log", log])
         out = capsys.readouterr().out
         assert rc == 0, out
-        assert "task span timeline" in out
+        # the timed span tree replaced the ad-hoc task reconstruction:
+        # coordinator phases + per-stage spans render in the timeline
+        assert "span timeline" in out
+        assert "schedule" in out and "execute" in out
+        assert "stage-0" in out
         assert "profile rollup complete" in out
         assert "trace=tt-" in out
         # stage table rendered both fragments with real rows
         assert "xchg f/c/p" in out
+        # --live followed the timeseries endpoint
+        assert "time series (" in out
+        assert "splits q/r/c" in out
 
-        # replay mode renders the log the live run just wrote
+        # replay mode renders the log the live run just wrote,
+        # including the span tree carried on QueryCompletedEvent
         rc = query_profile.main(["--replay", log])
         out = capsys.readouterr().out
         assert rc == 0, out
         assert "QueryCreatedEvent" in out
         assert "QueryCompletedEvent" in out
         assert "stage stats for" in out
+        assert "spans for" in out
+
+
+class TestPerfRegress:
+    """tools/perf_regress.py: the bench trajectory as an enforced gate."""
+
+    def _tool(self):
+        import importlib
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        return importlib.import_module("perf_regress")
+
+    def _artifact(self, path, headline, extras=()):
+        import json
+
+        doc = {"metric": "tpch_sf0.1_q1_rows_per_sec_per_chip",
+               "value": headline, "unit": "rows/s",
+               "extras": [{"metric": m, "value": v, "unit": "rows/s"}
+                          for m, v in extras]}
+        path.write_text(json.dumps(doc))
+        return str(path)
+
+    def test_committed_pr7_pr8_pair_passes(self, capsys):
+        """The acceptance pin: the committed BENCH_PR7 -> BENCH_PR8
+        artifact pair is within tolerance (worst matched config is the
+        -3.4%% headline), so --check exits 0."""
+        import os
+
+        root = os.path.join(os.path.dirname(__file__), "..")
+        rc = self._tool().main(
+            ["--check",
+             os.path.join(root, "BENCH_PR7_20260805.json"),
+             os.path.join(root, "BENCH_PR8_20260805.json")])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "no regressions past tolerance" in out
+        # configs matched by name, per-config delta reported
+        assert "tpch_sf0.1_q1_rows_per_sec_per_chip" in out
+        assert "OK" in out
+
+    def test_injected_regression_fails_check(self, capsys, tmp_path):
+        """A synthetic 2x regression on a matched config must fail
+        --check; unmatched configs (NEW/DROPPED) never gate."""
+        old = self._artifact(tmp_path / "old.json", 1_000_000.0,
+                             [("mesh_q1", 300_000.0),
+                              ("dropped_only", 42.0)])
+        new = self._artifact(tmp_path / "new.json", 980_000.0,
+                             [("mesh_q1", 150_000.0),   # 2x regression
+                              ("new_only", 7.0)])
+        rc = self._tool().main(["--check", old, new])
+        out = capsys.readouterr().out
+        assert rc == 1, out
+        assert "REGRESSED" in out and "mesh_q1" in out
+        assert "REGRESSION: 1 config(s)" in out
+        assert "NEW" in out and "DROPPED" in out
+
+    def test_within_tolerance_pair_passes(self, capsys, tmp_path):
+        old = self._artifact(tmp_path / "a.json", 1_000_000.0,
+                             [("mesh_q1", 300_000.0)])
+        new = self._artifact(tmp_path / "b.json", 950_000.0,
+                             [("mesh_q1", 295_000.0)])
+        rc = self._tool().main(["--check", old, new])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "no regressions past tolerance" in out
+
+    def test_tolerance_flag(self, capsys, tmp_path):
+        """--tolerance tightens the band: a -5%% drop fails at 2%%."""
+        old = self._artifact(tmp_path / "a.json", 1_000_000.0)
+        new = self._artifact(tmp_path / "b.json", 950_000.0)
+        rc = self._tool().main(["--check", "--tolerance", "0.02",
+                                old, new])
+        assert rc == 1
+        capsys.readouterr()

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Perf-regression gate over committed BENCH_*.json artifacts.
+
+The bench trajectory (BENCH_PR2..PR8 and later) is a pile of JSON unless
+something reads it: this tool loads two or more artifacts, matches
+configs BY METRIC NAME (the top-level headline plus every ``extras``
+entry), and reports per-config deltas between consecutive artifacts with
+a tolerance band.  All bench metrics are throughput-shaped (rows/s,
+qps): higher is better, so a regression is a drop past ``--tolerance``.
+
+``--check`` turns the report into a gate: exit nonzero when any matched
+config regressed past the tolerance — the committed artifact pair
+becomes an enforced floor instead of an unread number.
+
+Usage:
+    python tools/perf_regress.py BENCH_PR7_*.json BENCH_PR8_*.json
+    python tools/perf_regress.py --check --tolerance 0.10 OLD.json NEW.json
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_metrics(path: str) -> dict:
+    """{metric name: value} from one artifact: the headline metric plus
+    every extras entry carrying a (metric, value) pair."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    out = {}
+    if doc.get("metric") is not None and doc.get("value") is not None:
+        out[doc["metric"]] = float(doc["value"])
+    for extra in doc.get("extras", []) or []:
+        if extra.get("metric") is not None \
+                and extra.get("value") is not None:
+            out[extra["metric"]] = float(extra["value"])
+    return out
+
+
+def compare(old: dict, new: dict, tolerance: float) -> list:
+    """Per-config rows for one artifact pair: (metric, old, new,
+    delta fraction or None, status).  Configs only one side has are
+    reported (NEW/DROPPED) but never gate."""
+    rows = []
+    for name in sorted(set(old) | set(new)):
+        if name not in old:
+            rows.append((name, None, new[name], None, "NEW"))
+            continue
+        if name not in new:
+            rows.append((name, old[name], None, None, "DROPPED"))
+            continue
+        o, n = old[name], new[name]
+        delta = (n / o - 1.0) if o else 0.0
+        status = "REGRESSED" if delta < -tolerance else "OK"
+        rows.append((name, o, n, delta, status))
+    return rows
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v:,.1f}"
+
+
+def report(paths: list, tolerance: float) -> tuple:
+    """Render every consecutive pair; returns (lines, regressed)."""
+    lines = []
+    regressed = []
+    metrics = [(p, load_metrics(p)) for p in paths]
+    for (old_path, old), (new_path, new) in zip(metrics, metrics[1:]):
+        lines.append(f"{old_path} -> {new_path} "
+                     f"(tolerance {tolerance:.0%})")
+        header = (f"  {'config':<56} {'old':>14} {'new':>14} "
+                  f"{'delta':>8}  status")
+        lines.append(header)
+        lines.append("  " + "-" * (len(header) - 2))
+        for name, o, n, delta, status in compare(old, new, tolerance):
+            d = f"{delta:+.1%}" if delta is not None else "-"
+            lines.append(f"  {name:<56} {_fmt(o):>14} {_fmt(n):>14} "
+                         f"{d:>8}  {status}")
+            if status == "REGRESSED":
+                regressed.append((new_path, name, delta))
+    return lines, regressed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifacts", nargs="+",
+                    help="two or more BENCH_*.json artifacts, oldest "
+                         "first")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed fractional drop per config "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero when any matched config "
+                         "regressed past the tolerance")
+    args = ap.parse_args(argv)
+    if len(args.artifacts) < 2:
+        print("need at least two artifacts to compare")
+        return 2
+    lines, regressed = report(args.artifacts, args.tolerance)
+    for line in lines:
+        print(line)
+    if regressed:
+        print(f"\nREGRESSION: {len(regressed)} config(s) past "
+              f"tolerance {args.tolerance:.0%}:")
+        for path, name, delta in regressed:
+            print(f"  {name} {delta:+.1%} ({path})")
+    else:
+        print("\nno regressions past tolerance")
+    if args.check:
+        return 1 if regressed else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,17 +5,20 @@ Two modes, one report shape:
 
 - **live**: boot an in-process DistributedQueryRunner, execute one
   statement through the real statement protocol, and render the
-  coordinator's StageStats/TaskStats rollup — per-stage stats table and
-  a per-task span timeline (when each task ran relative to the query's
-  wall clock);
+  coordinator's StageStats rollup plus the timed span tree from
+  ``/v1/query/{id}/spans`` (query -> coordinator phases -> per-stage ->
+  per-task-attempt, the presto_tpu.spans shape).  ``--live``
+  additionally follows ``/v1/query/{id}/timeseries`` while the
+  statement runs and renders the sampler's progress ring;
 - **replay** (``--replay query.json``): read a JsonLinesEventListener
   log (events.py, the bundled query.json role) and render each query's
-  event timeline + the stage-stats table carried on its
-  QueryCompletedEvent.
+  event timeline, the stage-stats table, and the span tree carried on
+  its QueryCompletedEvent.
 
 Usage:
     JAX_PLATFORMS=cpu python tools/query_profile.py \
         --sql "select count(*) from lineitem" --workers 2
+    JAX_PLATFORMS=cpu python tools/query_profile.py --live --sql "..."
     JAX_PLATFORMS=cpu python tools/query_profile.py --replay query.json
     JAX_PLATFORMS=cpu python tools/query_profile.py --check   # CI smoke
 """
@@ -57,40 +60,78 @@ def stage_table(stage_stats) -> list:
     return lines
 
 
-def span_timeline(task_stats, width: int = TIMELINE_WIDTH) -> list:
-    """ASCII span per task: position/extent of [start_time, end_time]
-    within the query's [min start, max end] window."""
-    spans = []
-    for fid in sorted(task_stats, key=lambda k: int(k)):
-        for ts in task_stats[fid]:
-            if ts.get("start_time"):
-                spans.append((fid, ts))
-    if not spans:
-        return ["(no task spans reported)"]
-    t0 = min(ts["start_time"] for _, ts in spans)
-    t1 = max(ts.get("end_time") or ts["start_time"] for _, ts in spans)
-    total = max(t1 - t0, 1e-6)
-    lines = [f"task span timeline ({total * 1000:.1f} ms total)"]
-    for fid, ts in spans:
-        lo = int((ts["start_time"] - t0) / total * width)
-        hi = int(((ts.get("end_time") or t1) - t0) / total * width)
-        hi = max(hi, lo + 1)
-        bar = " " * lo + "=" * (hi - lo) + " " * (width - hi)
+def _fetch_json(uri: str):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(uri, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def timeseries_table(samples) -> list:
+    """Render the /v1/query/{id}/timeseries ring: one line per sample
+    (live progress as the sampler saw it)."""
+    if not samples:
+        return ["(no time-series samples — query finished before the "
+                "first sweep)"]
+    t0 = samples[0]["t"]
+    header = (f"{'t+ms':>8} {'state':<9} {'splits q/r/c':>13} "
+              f"{'out rows':>11} {'bytes':>10} {'backlog':>8} "
+              f"{'peak':>9}")
+    lines = [header, "-" * len(header)]
+    for s in samples:
+        splits = (f"{s['splits_queued']}/{s['splits_running']}/"
+                  f"{s['splits_completed']}")
         lines.append(
-            f"  F{fid} {ts.get('task_id', '?'):<28} |{bar}| "
-            f"{ts.get('elapsed_s', 0) * 1000:>8.1f} ms "
-            f"{ts.get('output_rows', 0):>9} rows")
+            f"{(s['t'] - t0) * 1000:>8.0f} {s['state']:<9} "
+            f"{splits:>13} {s['output_rows']:>11} "
+            f"{s['output_bytes']:>10} {s['exchange_backlog']:>8} "
+            f"{_fmt_bytes(s['peak_memory_bytes']):>9}")
     return lines
 
 
 def profile_live(args) -> int:
+    import threading
+    import time
+
     from presto_tpu.server.dqr import DistributedQueryRunner
+    from presto_tpu.spans import render_span_tree, validate_span_tree
 
     boot = (DistributedQueryRunner.tpcds if args.catalog == "tpcds"
             else DistributedQueryRunner.tpch)
     with boot(scale=args.scale, n_workers=args.workers,
               event_log_path=args.event_log) as dqr:
-        res = dqr.execute(args.sql)
+        co_uri = dqr.coordinator.uri
+        live_polls = []
+        if args.live:
+            # --live: run the statement on a thread and follow the
+            # timeseries endpoint while the query is RUNNING
+            out = {}
+
+            def run():
+                try:
+                    out["res"] = dqr.execute(args.sql)
+                except Exception as e:  # noqa: BLE001
+                    out["err"] = e
+
+            t = threading.Thread(target=run)
+            t.start()
+            qid = None
+            while t.is_alive():
+                qid = qid or dqr.client.last_query_id
+                if qid:
+                    try:
+                        live_polls.append(_fetch_json(
+                            f"{co_uri}/v1/query/{qid}/timeseries"))
+                    except Exception:  # noqa: BLE001 - query racing
+                        pass
+                time.sleep(0.1)
+            t.join()
+            if "err" in out:
+                raise out["err"]
+            res = out["res"]
+        else:
+            res = dqr.execute(args.sql)
         q = list(dqr.coordinator.queries.values())[-1]
         print(f"query {q.query_id} [{q.state}] trace={q.trace_token}")
         print(f"sql: {args.sql}")
@@ -99,17 +140,34 @@ def profile_live(args) -> int:
         print(f"elapsed: {qs.get('elapsed_s', 0):.3f}s  "
               f"peak memory: {_fmt_bytes(qs.get('peak_memory_bytes'))}  "
               f"jit: {qs.get('jit_dispatches', 0)} dispatches / "
-              f"{qs.get('jit_compiles', 0)} compiles  "
+              f"{qs.get('jit_compiles', 0)} compiles "
+              f"({qs.get('jit_compile_ns', 0) / 1e6:.1f} ms compile)  "
               f"retries: {q.stage_retry_rounds} stage / "
               f"{q.recovery_rounds} leaf")
         print()
         for line in stage_table(q.stage_stats):
             print(line)
         print()
-        for line in span_timeline(q.task_stats):
+        # the timed span tree from the live endpoint (the same tree
+        # query.json carries on QueryCompletedEvent)
+        tree = _fetch_json(f"{co_uri}/v1/query/{q.query_id}/spans")
+        violations = validate_span_tree(tree)
+        for line in render_span_tree(tree):
             print(line)
+        if args.live:
+            print()
+            ring = _fetch_json(
+                f"{co_uri}/v1/query/{q.query_id}/timeseries")
+            mid = max((len(p.get("samples", [])) for p in live_polls),
+                      default=0)
+            print(f"time series ({len(ring['samples'])} samples, "
+                  f"{mid} observed mid-query):")
+            for line in timeseries_table(ring["samples"]):
+                print(line)
         if args.check:
             ok = (q.state == "FINISHED" and q.stage_stats
+                  and not violations
+                  and tree.get("children")
                   and all(st["reporting"] >= 1
                           for st in q.stage_stats.values())
                   and any(st["input_rows"] > 0
@@ -163,6 +221,14 @@ def profile_replay(args) -> int:
                     {str(st["fragment_id"]): st
                      for st in e["stage_stats"]}):
                 print(line)
+        if e["event"] == "QueryCompletedEvent" and e.get("spans"):
+            # the serialized span tree round-trips: query.json carries
+            # the same tree /v1/query/{id}/spans served live
+            from presto_tpu.spans import render_span_tree
+
+            print(f"\nspans for {e['query_id']}:")
+            for line in render_span_tree(e["spans"]):
+                print(line)
     return 0
 
 
@@ -180,6 +246,9 @@ def main(argv=None) -> int:
     ap.add_argument("--replay", default=None,
                     help="render a query.json event log instead of "
                          "running a statement")
+    ap.add_argument("--live", action="store_true",
+                    help="follow /v1/query/{id}/timeseries while the "
+                         "statement runs and render the sample ring")
     ap.add_argument("--check", action="store_true",
                     help="CI smoke: exit nonzero unless every stage "
                          "reported stats and spans")
