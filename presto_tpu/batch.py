@@ -64,7 +64,7 @@ class Dictionary:
     identity for cache keying (never reused, unlike id()).
     """
 
-    __slots__ = ("values", "token", "_index", "_lock")
+    __slots__ = ("values", "token", "_index", "_lock", "_content_key")
 
     def __init__(self, values: Sequence[str] = ()):  # noqa: D401
         import threading
@@ -75,9 +75,36 @@ class Dictionary:
         # concurrent feed drivers (LocalExchange tier) may intern into a
         # shared dictionary; appends must stay code-stable
         self._lock = threading.Lock()
+        # (length, fp128) cache for content_key(); recomputed on growth
+        self._content_key = None
 
     def __len__(self) -> int:
         return len(self.values)
+
+    def content_key(self) -> tuple:
+        """A 128-bit fingerprint of the entry list (values AND order).
+
+        Kernel caches key compiled programs on dictionary bindings;
+        keying by ``token`` (object identity) churns one recompile per
+        query wherever a dictionary is rebuilt per execution with
+        identical content — deserialized exchange pages, per-query
+        concat-merged build sides.  Equal content (same entries, same
+        order) implies identical code semantics, so equal fingerprints
+        may share programs.  Cached per length (append-only growth
+        invalidates); two independent xxh64 seeds make silent 64-bit
+        collisions a non-concern.
+        """
+        n = len(self.values)
+        ck = self._content_key
+        if ck is not None and ck[0] == n:
+            return ck[1]
+        from presto_tpu import native
+
+        blob = "\x00".join(self.values[:n]).encode("utf-8",
+                                                   "surrogatepass")
+        fp = (n, native.xxh64(blob, 0), native.xxh64(blob, 0x9E3779B9))
+        self._content_key = (n, fp)
+        return fp
 
     def code_of(self, value: str) -> Optional[int]:
         return self._index.get(value)

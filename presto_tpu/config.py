@@ -334,6 +334,65 @@ class EngineConfig:
     # event bus (trace token, queued/execution split, top hot
     # operator).  0 disables.
     slow_query_log_threshold_s: float = 60.0
+    # --- device-resident hash tier (ops/hashtable.py, SURVEY §3.4 "hot
+    # five" / §7 step 5) ------------------------------------------------
+    # GroupByHash: HashAggregationOperator accumulates into an
+    # open-addressing table resident ON DEVICE across batches (the
+    # MultiChannelGroupByHash role, 1-byte hash-prefix reject per
+    # PagesHash.java:49) instead of materializing every input batch and
+    # sorting once at finish.  Serves unbounded-key aggregations (the
+    # bounded-domain direct path and the clustered streaming path still
+    # win where they apply).  OFF restores the materialize+sort tier
+    # exactly.
+    hash_groupby_enabled: bool = True
+    # first table capacity (slots, power of two); the rehash ladder
+    # doubles from here while fill exceeds 1/2
+    hash_groupby_init_slots: int = 1 << 13
+    # rows below which an aggregation stays on the materialize+sort
+    # tier: per-batch claim-loop insertion has fixed round costs that
+    # only amortize on large many-batch inputs, while one sort of a
+    # small input is cheap.  The operator accumulates batches until the
+    # threshold crosses, then drains them into resident hash state and
+    # streams from there (memory stays bounded exactly where it
+    # matters).
+    hash_groupby_min_rows: int = 1 << 17
+    # rehash ceiling: above this many slots the operator stops growing
+    # the table, carries the accumulated on-device state over EXACTLY
+    # (merge-prim re-aggregation at finish) and falls back to the sort
+    # path for the remaining input — the "configured fraction of device
+    # memory" guard (4M slots ~ a few hundred MB of state at Q1 widths)
+    hash_groupby_max_slots: int = 1 << 22
+    # PagesHash: the join build side ALSO builds an open-addressing
+    # table over its raw normalized key words, and probes resolve
+    # match ranges through it (hash + prefix reject + one gather)
+    # instead of a ~20-step vectorized binary search; arbitrary
+    # multi-channel key types stream (equality needs no total order, so
+    # the canonical union-sort materialization disappears).  OFF
+    # restores the sorted-index probe exactly.
+    device_join_probe: bool = True
+    # build sides LARGER than this keep the sorted index when their
+    # keys could take the single/packed tiers: claim-loop insertion of
+    # a huge build side costs more than one argsort, while the
+    # dimension-build/fact-probe pattern (small build, big probe) is
+    # where the hash table wins.  Unpackable (canonical-class) keys
+    # always build the hash table — that is what lets them stream.
+    device_join_probe_max_build_rows: int = 1 << 17
+    # Fuse the FINAL-step merge aggregation into exchange-fed segments
+    # (PR 4's named remaining depth): the consumer fragment's merge
+    # accumulates inside the coalescing segment program, so distributed
+    # aggregations run one dispatch end-to-end per flush.  OFF restores
+    # the PR 9 lowering (separate merge aggregation operator) exactly.
+    fusion_final_merge: bool = True
+    # Cost-based pre-reduce: skip segment_pre_reduce (emit raw rows in
+    # partial-state schema) when the estimated OR observed group
+    # cardinality approaches the row count — per-batch grouping that
+    # does not reduce is pure overhead.  Plan-time estimate from the
+    # memo's stats tier; runtime confirmation from the observed
+    # groups/rows ratio of dispatched batches.  OFF restores the
+    # unconditional pre-reduce decision exactly.
+    prereduce_cost_based: bool = True
+    # groups/rows ratio above which pre-reduce is skipped
+    prereduce_max_group_fraction: float = 0.9
 
 
 DEFAULT = EngineConfig()

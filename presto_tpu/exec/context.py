@@ -94,6 +94,12 @@ class OperatorStats:
     # emitted partial states, not row batches — tests pin on this
     # instead of eyeballing operator chains.
     prereduce_rows: int = 0
+    # which kernel tier served this operator's group-by/join hot loop:
+    # "hash" (device-resident open-addressing, ops/hashtable.py),
+    # "direct" (bounded-domain), "sort" (sorted-index), "stream"
+    # (clustered), "hash+sort" (overflow seam crossed mid-query) —
+    # surfaced per segment/operator by tools/fusion_report.py
+    kernel_tier: str = ""
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)

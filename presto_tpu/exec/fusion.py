@@ -59,9 +59,20 @@ the dynamic-filter value shape — the same keying discipline as
 ``_FP_KERNELS``.  Gated by ``EngineConfig.pipeline_fusion`` (default on;
 off restores per-operator dispatch exactly).
 
-What breaks a segment: any non-row-local operator (aggregation, join,
-sort, exchange, limit), expressions that need the host path (nested
-types, row-wise string fallbacks), and nested input/output types.
+PR 10 extends the segment grammar three ways (see exec/README.md
+"Device-resident hash tier"): residual-free inner/semi/anti LookupJoin
+probes absorb as ``ProbeStage`` (gate ``device_join_probe``) so
+filter -> project -> probe -> partial-agg chains are one dispatch;
+grouped FINAL merges directly on a remote exchange absorb into
+empty-stage coalescing segments (gate ``fusion_final_merge``); and the
+pre-reduce decision is cost-based (gate ``prereduce_cost_based``) —
+plan-time NDV hints plus a runtime observed-ratio switch to raw
+partial-state emission when grouping stops reducing.
+
+What breaks a segment: any non-row-local operator (aggregation — except
+an absorbed one, join — except an absorbed probe, sort, exchange,
+limit), expressions that need the host path (nested types, row-wise
+string fallbacks), and nested input/output types.
 """
 
 from __future__ import annotations
@@ -92,6 +103,14 @@ from presto_tpu.kernelcache import cache_get, cache_put, new_cache
 
 # compiled segment programs, shared globally across queries/operators
 _SEG_KERNELS = new_cache("fused_segment")
+
+# learned inner-probe expansion buckets, shared ACROSS queries: keyed by
+# (segment expr key, probe stage index, input capacity), monotonic max.
+# A fresh operator re-learning its bucket per execution would oscillate
+# between capacity variants (arrival-order nondeterminism decides which
+# batch overflows first) and churn one compiled program per variant per
+# query; the sticky global bucket converges once and stays.
+_OUT_CAPS_LEARNED: dict = {}
 
 
 # ---------------------------------------------------------------------------
@@ -129,12 +148,42 @@ class DFStage:
         return ("df", self.key_channels)
 
 
+@dataclasses.dataclass(frozen=True, eq=False)
+class ProbeStage:
+    """An absorbed residual-free LookupJoin probe (device_join_probe):
+    the probe primitive runs INSIDE the segment program — the way
+    ``segment_pre_reduce`` absorbed partial aggregation — so
+    filter -> project -> probe -> partial-agg chains cost one dispatch.
+
+    The build side's table (PagesHash layout, ops/hashtable.py) and data
+    columns ride as RUNTIME kernel arguments, never trace constants;
+    the program is keyed by the build's shape/binding, so identical
+    queries share one executable.  semi/anti probes fold into the
+    accumulated mask (no expansion); inner probes expand the row space
+    (probe-gather + build-gather) under a static output capacity with
+    host retry on overflow — the same policy every expansion kernel in
+    ops/join.py uses.
+    """
+
+    factory: object                # LookupJoinOperatorFactory
+
+    def key(self) -> tuple:
+        f = self.factory
+        return ("probe", f.join_type, tuple(f.probe_key_channels),
+                f.null_aware, tuple(f.probe_types),
+                tuple(f.build.input_types))
+
+
 def _stage_of(factory) -> object:
     if isinstance(factory, FilterProjectOperatorFactory):
         return FPStage(factory.filter_expr, tuple(factory.projections),
                        tuple(factory.input_types))
     if isinstance(factory, DynamicFilterOperatorFactory):
         return DFStage(factory.dyn, tuple(factory.key_channels))
+    from presto_tpu.exec.joinop import LookupJoinOperatorFactory
+
+    if isinstance(factory, LookupJoinOperatorFactory):
+        return ProbeStage(factory)
     raise TypeError(f"not a fusable factory: {type(factory).__name__}")
 
 
@@ -150,11 +199,37 @@ def _fp_jitable(f: FilterProjectOperatorFactory) -> bool:
     return True
 
 
-def _fusable(f) -> bool:
+def _probe_absorbable(f, config) -> bool:
+    """May this LookupJoin probe run inside a segment?  Residual-free
+    inner/semi/anti only; left-outer keeps its operator (its unmatched
+    emission interacts with downstream outer-composition paths).
+    Grouped execution keeps per-bucket probe operators so Lifespan
+    memory retirement stays observable."""
+    if not getattr(config, "device_join_probe", False):
+        return False
+    if getattr(config, "grouped_execution_buckets", 1) > 1:
+        return False
+    if f.join_type not in ("inner", "semi", "anti"):
+        return False
+    if f.residual is not None:
+        return False
+    if any(t.is_nested for t in f.probe_types):
+        return False
+    if f.join_type == "inner" and any(t.is_nested
+                                      for t in f.build.input_types):
+        return False
+    return True
+
+
+def _fusable(f, config) -> bool:
     if isinstance(f, DynamicFilterOperatorFactory):
         return True
     if isinstance(f, FilterProjectOperatorFactory):
         return _fp_jitable(f)
+    from presto_tpu.exec.joinop import LookupJoinOperatorFactory
+
+    if isinstance(f, LookupJoinOperatorFactory):
+        return _probe_absorbable(f, config)
     return False
 
 
@@ -183,16 +258,34 @@ class PreReduceSpec:
                 tuple((a.prim, a.channel, a.out_type) for a in self.aggs))
 
 
+def _sort_groupable(t: T.Type) -> bool:
+    """Key types the in-segment sort-path pre-reduce can normalize to
+    int64 words (ops/keys.py); plain varchar (no dictionary) cannot."""
+    return bool(t.is_dictionary or T.is_integral(t)
+                or t.name in ("boolean", "double", "real", "date",
+                              "timestamp")
+                or isinstance(t, T.DecimalType))
+
+
 def _segment_out_types(stages) -> Optional[List[T.Type]]:
-    """The segment's output channel types: the last FP stage's
-    projection types (DF stages filter rows, never remap channels)."""
-    for s in reversed(stages):
+    """The segment's output channel types, walked through the stages:
+    FP stages remap channels to their projection types, inner probe
+    stages append the build channels, semi/anti probes keep the probe
+    space (DF stages filter rows, never remap channels)."""
+    types: Optional[List[T.Type]] = None
+    for s in stages:
         if isinstance(s, FPStage):
-            return [p.type for p in s.projections]
-    return None
+            types = [p.type for p in s.projections]
+        elif isinstance(s, ProbeStage):
+            f = s.factory
+            base = list(f.probe_types) if types is None else types
+            types = (base + list(f.build.input_types)
+                     if f.join_type == "inner" else base)
+    return types
 
 
-def _try_pre_reduce(stages, factory, config):
+def _try_pre_reduce(stages, factory, config, out_types=None,
+                    relax_keys=False):
     """When ``factory`` (the operator the run feeds) is an eligible
     aggregation, return ``(spec, replacement)``: the pre-reduce spec the
     segment absorbs and the downstream factory that replaces the
@@ -206,7 +299,13 @@ def _try_pre_reduce(stages, factory, config):
     every group key dictionary-coded or boolean so the per-batch
     reduction can take the bounded-domain direct path (unbounded keys
     would make per-batch pre-reduce a pessimization: as many groups as
-    rows, nothing reduced).  Returns (None, None) when ineligible.
+    rows, nothing reduced) — ``relax_keys`` lifts that last rule for
+    exchange-fed FINAL merges, whose input is already pre-reduced
+    (duplication factor = producer count) and which the cost-based
+    raw-emission switch protects at runtime.  A plan-time NDV estimate
+    (``factory.prereduce_ratio_hint`` from the memo's stats tier) skips
+    pre-reduce outright when estimated groups approach input rows.
+    Returns (None, None) when ineligible.
     """
     if not getattr(config, "fusion_partial_agg", False):
         return None, None
@@ -214,9 +313,15 @@ def _try_pre_reduce(stages, factory, config):
     is_global = isinstance(factory, GlobalAggregationOperatorFactory)
     if not (is_hash or is_global):
         return None, None
-    out_types = _segment_out_types(stages)
+    if out_types is None:
+        out_types = _segment_out_types(stages)
     if out_types is None or len(out_types) != len(factory.input_types):
         return None, None
+    if (getattr(config, "prereduce_cost_based", False) and is_hash):
+        hint = getattr(factory, "prereduce_ratio_hint", None)
+        if hint is not None and hint > getattr(
+                config, "prereduce_max_group_fraction", 0.9):
+            return None, None
     for a in factory.aggs:
         if a.prim not in MERGE_PRIM:
             return None, None
@@ -234,7 +339,12 @@ def _try_pre_reduce(stages, factory, config):
             return None, None
         for g in groups:
             t = out_types[g]
-            if not (t.is_dictionary or t.name == "boolean"):
+            if t.is_nested:
+                return None, None
+            if not relax_keys and not (t.is_dictionary
+                                       or t.name == "boolean"):
+                return None, None
+            if relax_keys and not _sort_groupable(t):
                 return None, None
     spec = PreReduceSpec(groups, tuple(factory.aggs),
                          tuple(out_types[g] for g in groups), is_global)
@@ -288,6 +398,27 @@ def _partition_spec(sink) -> Optional[Tuple[Tuple[int, ...], int]]:
 # the fusion pass
 # ---------------------------------------------------------------------------
 
+def _try_final_merge(factory, prev, config):
+    """FINAL-merge fusion (PR 4's named remaining depth, gated
+    ``fusion_final_merge``): a grouped merge aggregation fed DIRECTLY by
+    a remote exchange absorbs into an empty-stage coalescing segment —
+    partial pages batch up to scan_batch_rows and merge-accumulate in
+    ONE dispatch per flush, with the finalize projections folded into
+    the downstream merge's finish.  Global merges stay unfused: their
+    empty-input default row must come from the original prims, which
+    the merge form no longer names.  Returns (spec, replacement) or
+    (None, None)."""
+    if not getattr(config, "fusion_final_merge", False):
+        return None, None
+    if not isinstance(factory, HashAggregationOperatorFactory):
+        return None, None
+    if not _exchange_adjacent(prev):
+        return None, None
+    return _try_pre_reduce([], factory, config,
+                           out_types=list(factory.input_types),
+                           relax_keys=True)
+
+
 def fuse_chain(factories: List[OperatorFactory], config
                ) -> List[OperatorFactory]:
     """Replace maximal runs of fusable factories with FusedSegment
@@ -295,22 +426,47 @@ def fuse_chain(factories: List[OperatorFactory], config
     a device-staging TableScan (scan coalescing) or a remote exchange
     (page coalescing), feeds a hash-partitioned output (partition-id
     fusion), or feeds an eligible aggregation (partial-agg pre-reduce);
-    it must contain at least one FilterProject stage (the segment's
-    type anchor)."""
+    it must contain at least one FilterProject or absorbed-probe stage
+    (the segment's type anchor).  An eligible merge aggregation sitting
+    DIRECTLY on a remote exchange absorbs without any run at all (the
+    FINAL-merge segment)."""
     result: List[OperatorFactory] = []
     n = len(factories)
     i = 0
     while i < n:
-        if not _fusable(factories[i]):
+        if not _fusable(factories[i], config):
+            spec, replacement = _try_final_merge(
+                factories[i], result[-1] if result else None, config)
+            if spec is not None and replacement is not None:
+                consumed = i + 1
+                post_stages = []
+                while (consumed < n
+                        and isinstance(factories[consumed],
+                                       FilterProjectOperatorFactory)
+                        and factories[consumed].filter_expr is None):
+                    post_stages.append(
+                        list(factories[consumed].projections))
+                    consumed += 1
+                if post_stages:
+                    replacement.post_projections = post_stages
+                result.append(FusedSegmentOperatorFactory(
+                    [], coalesce_rows=config.scan_batch_rows,
+                    partition_spec=None,
+                    min_batch_capacity=config.min_batch_capacity,
+                    agg_spec=spec))
+                result.append(replacement)
+                i = consumed
+                continue
             result.append(factories[i])
             i += 1
             continue
         j = i
-        while j < n and _fusable(factories[j]):
+        while j < n and _fusable(factories[j], config):
             j += 1
         run = factories[i:j]
         stages = [_stage_of(f) for f in run]
-        has_fp = any(isinstance(s, FPStage) for s in stages)
+        has_fp = any(isinstance(s, (FPStage, ProbeStage))
+                     for s in stages)
         scan = (result[-1] if result
                 and isinstance(result[-1], TableScanOperatorFactory)
                 and result[-1].to_device else None)
@@ -354,6 +510,12 @@ def fuse_chain(factories: List[OperatorFactory], config
             result.extend(run)
             i = j
             continue
+        for s in stages:
+            if isinstance(s, ProbeStage):
+                # the resident build side must stay resident: a spilled
+                # build would take the probe out of the segment's reach
+                # mid-query (the broadcast-join stance)
+                s.factory.build.allow_spill = False
         coalesce_rows = 0
         if scan is not None:
             # the segment takes over staging: the scan now hands over
@@ -426,6 +588,17 @@ class FusedSegmentOperator(Operator):
         self._min_capacity = int(min_batch_capacity)
         self._pending: Optional[Batch] = None     # device-batch path
         self._emitted_any = False
+        # absorbed-probe state: build-source snapshots resolve lazily at
+        # first dispatch (the build pipeline has finished by then);
+        # learned expansion capacities per inner probe stage persist
+        # across batches (overflow bumps them once, then they stick)
+        self._probe_idx = [k for k, s in enumerate(stages)
+                           if isinstance(s, ProbeStage)]
+        self._probe_srcs: Optional[list] = None
+        self._out_caps: dict = {}
+        # cost-based pre-reduce: flipped True when the observed
+        # groups/rows ratio says per-batch grouping is not reducing
+        self._raw_emit = False
         # host-coalescing path state
         self._acc: List[List[tuple]] = []          # per-flush batch parts
         self._acc_rows = 0
@@ -452,6 +625,8 @@ class FusedSegmentOperator(Operator):
         if self._coalesce:
             if self._acc_rows >= self._coalesce or (
                     self._finishing and self._acc_rows > 0):
+                if self._passthrough_ok():
+                    return self._emit(self._flush().compact())
                 return self._emit(self._dispatch(self._flush()))
             if self._finishing and self._needs_default_row():
                 return self._emit(self._default_partial_batch())
@@ -462,6 +637,20 @@ class FusedSegmentOperator(Operator):
             return None
         batch, self._pending = self._pending, None
         return self._emit(self._dispatch(batch))
+
+    # a FINAL-merge segment flush below this many rows skips its own
+    # dispatch: the rows pass through AS partial states (identity — the
+    # segment has no stages and its input/output schemas coincide) and
+    # the downstream merge pays exactly what the unfused PR 9 path
+    # paid.  Pre-reducing a tiny flush costs a full program launch to
+    # save the merge almost nothing; at real exchange volumes the
+    # flush crosses the bound and the in-segment merge-accumulate wins.
+    _PASSTHROUGH_ROWS = 8192
+
+    def _passthrough_ok(self) -> bool:
+        return (not self.stages and self.agg_spec is not None
+                and not self.agg_spec.global_
+                and self._acc_rows < self._PASSTHROUGH_ROWS)
 
     def _emit(self, out: Optional[Batch]) -> Optional[Batch]:
         if out is None:
@@ -574,38 +763,139 @@ class FusedSegmentOperator(Operator):
             args.append((tuple(bounds), tuple(tables)))
         return tuple(shapes), tuple(args)
 
+    def _probe_snapshot(self):
+        """Resolve (and cache) each absorbed probe's build source.  The
+        program is keyed by the source's SHAPE (mode, capacities,
+        dictionary binding); the arrays themselves ride as runtime
+        kernel arguments, so identical queries share executables."""
+        import jax.numpy as jnp
+
+        if self._probe_srcs is None:
+            srcs = []
+            for k in self._probe_idx:
+                src = self.stages[k].factory.build.lookup.get()
+                if src.mode not in ("hash", "single", "packed"):
+                    raise RuntimeError(
+                        "absorbed join probe needs a streaming lookup "
+                        f"source, got mode={src.mode!r}; rerun with "
+                        "device_join_probe=false")
+                srcs.append(src)
+                self.ctx.stats.kernel_tier = (
+                    self.ctx.stats.kernel_tier or
+                    ("hash" if src.mode == "hash" else "sorted"))
+            self._probe_srcs = srcs
+        key_parts, args, metas = [], [], []
+        for k, src in zip(self._probe_idx, self._probe_srcs):
+            f = self.stages[k].factory
+            out_cap = self._out_caps.get(k, 0)
+            build_pairs = tuple(column_pairs(src.data))
+            if src.mode == "hash":
+                aux = (src.pages, src.perm)
+                table_cap = src.pages[2].shape[0]
+            elif src.mode == "single":
+                aux = (src.sorted_ids, src.perm, src.mins,
+                       jnp.zeros(1, jnp.int64), jnp.zeros(1, jnp.int64))
+                table_cap = 0
+            else:
+                aux = (src.sorted_ids, src.perm, jnp.asarray(src.mins),
+                       jnp.asarray(src.strides), jnp.asarray(src.maxs))
+                table_cap = 0
+            bstats = (jnp.asarray(src.n_build, jnp.int64),
+                      src.has_null_key if src.has_null_key is not None
+                      else jnp.zeros((), bool))
+            key_parts.append((src.mode, src.data.capacity, table_cap,
+                              dictionary_binding_key(src.data.columns),
+                              out_cap))
+            args.append((build_pairs, aux, bstats))
+            metas.append({
+                "mode": src.mode, "out_cap": out_cap,
+                "join_type": f.join_type,
+                "null_aware": f.null_aware,
+                "key_channels": tuple(f.probe_key_channels),
+                "key_types": src.key_types or (),
+                "build_meta": [(c.type, c.dictionary)
+                               for c in src.data.columns],
+            })
+        return tuple(key_parts), tuple(args), metas
+
+    def _default_out_cap(self, capacity: int) -> int:
+        """First expansion bucket for an inner probe: the probe space
+        itself (exact for FK->PK joins, where every probe row matches
+        at most one build row); duplicate-key builds overflow once,
+        learn the bucket, and keep it."""
+        return next_bucket(max(capacity, 1))
+
     def _dispatch(self, batch: Batch) -> Optional[Batch]:
         snap = self._df_snapshot()
         if snap is None:
             return None      # empty build: nothing can survive the join
         df_shapes, df_args = snap
         part_n = self.partition_spec[1] if self.partition_spec else 0
-        key = (self._expr_key, batch.capacity,
-               dictionary_binding_key(batch.columns), df_shapes, part_n)
-        entry = cache_get(_SEG_KERNELS, key)
-        if entry is None:
-            import time as _time
+        cap = batch.capacity
+        for k in self._probe_idx:
+            if k not in self._out_caps:
+                if self.stages[k].factory.join_type == "inner":
+                    cap = max(self._default_out_cap(cap),
+                              _OUT_CAPS_LEARNED.get(
+                                  (self._expr_key, k, batch.capacity),
+                                  0))
+                    self._out_caps[k] = cap
+                else:
+                    self._out_caps[k] = 0
+            else:
+                cap = max(cap, self._out_caps[k] or cap)
+        while True:
+            probe_keys, probe_args, probe_metas = ((), (), [])
+            if self._probe_idx:
+                probe_keys, probe_args, probe_metas = \
+                    self._probe_snapshot()
+            key = (self._expr_key, batch.capacity,
+                   dictionary_binding_key(batch.columns), df_shapes,
+                   part_n, probe_keys, self._raw_emit)
+            entry = cache_get(_SEG_KERNELS, key)
+            if entry is None:
+                import time as _time
 
-            from presto_tpu.kernelcache import (
-                record_compile, timed_first_call,
-            )
+                from presto_tpu.kernelcache import (
+                    record_compile, timed_first_call,
+                )
 
-            _t0 = _time.perf_counter_ns()
-            built_fn, built_meta = self._compile(batch, df_shapes)
-            build_ns = _time.perf_counter_ns() - _t0
-            self.ctx.stats.jit_compile_ns += build_ns
-            record_compile(_SEG_KERNELS, build_ns)
-            entry = (timed_first_call(built_fn, self.ctx.stats,
-                                      _SEG_KERNELS), built_meta)
-            cache_put(_SEG_KERNELS, key, entry)
-            self.ctx.stats.jit_compiles += 1
-        fn, out_meta = entry
-        self.ctx.stats.jit_dispatches += 1
-        if self.agg_spec is not None:
+                _t0 = _time.perf_counter_ns()
+                built_fn, built_meta = self._compile(batch, df_shapes,
+                                                     probe_metas)
+                build_ns = _time.perf_counter_ns() - _t0
+                self.ctx.stats.jit_compile_ns += build_ns
+                record_compile(_SEG_KERNELS, build_ns)
+                entry = (timed_first_call(built_fn, self.ctx.stats,
+                                          _SEG_KERNELS), built_meta)
+                cache_put(_SEG_KERNELS, key, entry)
+                self.ctx.stats.jit_compiles += 1
+            fn, out_meta = entry
+            self.ctx.stats.jit_dispatches += 1
+            outs, count, parts, etotals = fn(
+                tuple(column_pairs(batch)), batch.num_rows, df_args,
+                probe_args)
+            # expansion-overflow retry: bump the learned bucket for any
+            # inner probe whose exact total exceeded its capacity and
+            # re-dispatch (ops/join.py's host-retry policy, in-segment)
+            overflowed = False
+            for k, total in zip(
+                    (k for k in self._probe_idx
+                     if self.stages[k].factory.join_type == "inner"),
+                    etotals):
+                t = int(total)
+                if t > self._out_caps[k]:
+                    self._out_caps[k] = next_bucket(t)
+                    lk = (self._expr_key, k, batch.capacity)
+                    _OUT_CAPS_LEARNED[lk] = max(
+                        _OUT_CAPS_LEARNED.get(lk, 0), self._out_caps[k])
+                    overflowed = True
+            if not overflowed:
+                break
+        if self.agg_spec is not None and not self._raw_emit:
             self.ctx.stats.prereduce_rows += batch.num_rows
-        outs, count, parts = fn(tuple(column_pairs(batch)),
-                                batch.num_rows, df_args)
         n = int(count)
+        self._observe_reduction(batch.num_rows, n)
         if n == 0:
             return None
         cols = tuple(Column(typ, v, valid, d)
@@ -614,7 +904,24 @@ class FusedSegmentOperator(Operator):
             cols = cols + (Column(T.INTEGER, parts),)
         return Batch(cols, n)
 
-    def _compile(self, batch: Batch, df_shapes):
+    def _observe_reduction(self, rows_in: int, groups_out: int) -> None:
+        """Runtime half of the cost-based pre-reduce decision: when a
+        grouped pre-reduce emits nearly one group per input row, later
+        batches skip the group kernel and emit raw rows in the partial
+        schema (any granularity is legal for the downstream merge)."""
+        if (self.agg_spec is None or self.agg_spec.global_
+                or self._raw_emit):
+            return
+        cfg = self.ctx.config
+        if not getattr(cfg, "prereduce_cost_based", False):
+            return
+        if rows_in < 2048:      # tiny batches prove nothing
+            return
+        frac = getattr(cfg, "prereduce_max_group_fraction", 0.9)
+        if groups_out > frac * rows_in:
+            self._raw_emit = True
+
+    def _compile(self, batch: Batch, df_shapes, probe_metas=()):
         import jax
 
         # stage-by-stage expression compilation: each stage's dictionary
@@ -625,6 +932,7 @@ class FusedSegmentOperator(Operator):
         progs = []
         out_meta = [(c.type, c.dictionary) for c in batch.columns]
         di = 0
+        pi_meta = 0
         for stage in self.stages:
             if isinstance(stage, FPStage):
                 compiler = ExprCompiler(dicts)
@@ -635,13 +943,21 @@ class FusedSegmentOperator(Operator):
                 dicts = {i: cp.dictionary for i, cp in enumerate(cprojs)
                          if cp.dictionary is not None}
                 out_meta = [(cp.type, cp.dictionary) for cp in cprojs]
+            elif isinstance(stage, ProbeStage):
+                meta = probe_metas[pi_meta]
+                pi_meta += 1
+                progs.append(("probe", meta))
+                if meta["join_type"] == "inner":
+                    out_meta = list(out_meta) + list(meta["build_meta"])
+                dicts = {i: d for i, (_t, d) in enumerate(out_meta)
+                         if d is not None}
             else:
                 progs.append(("df", df_shapes[di]))
                 di += 1
-        cap = batch.capacity
         partition = self.partition_spec
         agg = self.agg_spec
         max_domain = self._max_domain
+        raw_emit = self._raw_emit
         if agg is not None:
             # partial schema: [key columns..., one state col per agg]
             key_meta = [out_meta[g] for g in agg.group_channels]
@@ -651,14 +967,17 @@ class FusedSegmentOperator(Operator):
         else:
             final_meta = out_meta
 
-        def kernel(cols, num_rows, df_args):
+        def kernel(cols, num_rows, df_args, probe_args):
             import jax.numpy as jnp
 
+            from presto_tpu.ops import join as J
             from presto_tpu.ops.filter import selected_positions
 
             mask = None
             cur = tuple(cols)
             dfi = 0
+            pri = 0
+            etotals = []
             for prog in progs:
                 if prog[0] == "fp":
                     _, cfilter, cprojs = prog
@@ -667,6 +986,63 @@ class FusedSegmentOperator(Operator):
                         m = fv if fvalid is None else fv & fvalid
                         mask = m if mask is None else mask & m
                     cur = tuple(p.run(cur, num_rows, jnp) for p in cprojs)
+                elif prog[0] == "probe":
+                    meta = prog[1]
+                    build_pairs, aux, bstats = probe_args[pri]
+                    pri += 1
+                    kc = meta["key_channels"]
+                    cap_now = cur[0][0].shape[0]
+                    if meta["mode"] == "hash":
+                        from presto_tpu.ops.hashtable import (
+                            pages_hash_probe,
+                        )
+
+                        pages, perm = aux
+                        kcols = [(cur[c][0], cur[c][1], kt)
+                                 for c, kt in zip(kc, meta["key_types"])]
+                        lo, counts, live = pages_hash_probe(
+                            pages, kcols, num_rows)
+                    else:
+                        from presto_tpu.exec.joinop import _ids_from_pairs
+
+                        sorted_ids, perm, mins, strides, maxs = aux
+                        ids = _ids_from_pairs(
+                            jnp, cur, kc, meta["mode"], mins, strides,
+                            maxs, num_rows)
+                        lo, counts = J.probe_counts(sorted_ids, perm, ids)
+                        live = ids >= 0
+                    alive = jnp.arange(cap_now) < num_rows
+                    if mask is not None:
+                        alive = alive & mask
+                    jt = meta["join_type"]
+                    if jt == "semi":
+                        mask = J.semi_mask(counts, live & alive,
+                                           anti=False)
+                    elif jt == "anti":
+                        n_build, has_null = bstats
+                        mask = J.anti_keep_from_parts(
+                            counts, live, alive, meta["null_aware"],
+                            [cur[c][1] for c in kc], n_build,
+                            build_has_null=has_null)
+                    else:
+                        out_cap = meta["out_cap"]
+                        cnts = jnp.where(alive, counts, 0)
+                        p_idx, b_idx, rv, _unm, total = J.expand_matches(
+                            lo, cnts, perm, out_cap)
+                        p32 = p_idx.astype(jnp.int32)
+                        b32 = b_idx.astype(jnp.int32)
+                        new_cur = [
+                            (v[p32],
+                             None if valid is None else valid[p32])
+                            for v, valid in cur]
+                        for v, valid in build_pairs:
+                            bvalid = (rv if valid is None
+                                      else (valid[b32] & rv))
+                            new_cur.append((v[b32], bvalid))
+                        cur = tuple(new_cur)
+                        mask = rv
+                        num_rows = total
+                        etotals.append(total)
                 else:
                     shape = prog[1]
                     bounds, tables = df_args[dfi]
@@ -689,7 +1065,39 @@ class FusedSegmentOperator(Operator):
                         if valid is not None:
                             m = m & valid
                         mask = m if mask is None else mask & m
-            if agg is not None:
+            cap = cur[0][0].shape[0]
+            if agg is not None and raw_emit and not agg.global_:
+                # cost-based raw emission: the observed groups/rows
+                # ratio said grouping is not reducing — compact the
+                # live rows once and emit them AS partial states (one
+                # row = one group of one; the downstream merge accepts
+                # any granularity)
+                m = (mask if mask is not None
+                     else jnp.ones(cap, bool))
+                idx, count = selected_positions(m, None, num_rows, cap)
+                idx = idx.astype(jnp.int32)
+                outs = []
+                for g in agg.group_channels:
+                    v, valid = cur[g]
+                    outs.append((v[idx],
+                                 None if valid is None else valid[idx]))
+                for (prim, ch), dtype in zip(agg_prims, out_dtypes):
+                    if ch is None:
+                        outs.append((jnp.ones(cap, jnp.int64)[idx],
+                                     None))
+                    elif prim == "count":
+                        v, valid = cur[ch]
+                        ones = (jnp.ones(cap, jnp.int64)
+                                if valid is None
+                                else valid.astype(jnp.int64))
+                        outs.append((ones[idx], None))
+                    else:
+                        v, valid = cur[ch]
+                        outs.append((v[idx].astype(dtype),
+                                     None if valid is None
+                                     else valid[idx]))
+                outs = tuple(outs)
+            elif agg is not None:
                 # pre-reduce: NO compaction — the accumulated mask rides
                 # into the group kernels as the live mask, and the
                 # segment emits per-batch partial group states instead
@@ -761,7 +1169,7 @@ class FusedSegmentOperator(Operator):
                     triples.append(value_hash_triple(
                         _ColView(v, valid, typ, d)))
                 parts = partition_of(row_hash(triples), nparts)
-            return outs, count, parts
+            return outs, count, parts, tuple(etotals)
 
         return jax.jit(kernel), list(final_meta)
 
@@ -793,6 +1201,10 @@ class FusedSegmentOperatorFactory(OperatorFactory):
                     "fp(filter=%s, %d proj)" % (
                         "yes" if s.filter_expr is not None else "no",
                         len(s.projections)))
+            elif isinstance(s, ProbeStage):
+                parts.append("probe(%s, keys=%s)" % (
+                    s.factory.join_type,
+                    list(s.factory.probe_key_channels)))
             else:
                 parts.append("df(keys=%s)" % (list(s.key_channels),))
         if self.agg_spec is not None:

@@ -50,6 +50,7 @@ class LookupSource:
     """Build-side product handed to probe operators."""
 
     mode: str                      # 'single' | 'packed' | 'canonical'
+                                   # | 'hash' (PagesHash table)
     sorted_ids: object             # int64 [cap_b] (single/packed)
     perm: object                   # int64 [cap_b]
     data: Batch                    # padded device build batch
@@ -60,6 +61,11 @@ class LookupSource:
     strides: Optional[np.ndarray] = None  # packed: per-channel stride
     maxs: Optional[np.ndarray] = None
     has_null_key: object = None           # device bool scalar (single/packed)
+    # device_join_probe tier (ops/hashtable.py): the open-addressing
+    # table (t_words tuple, t_prefix, t_used, starts, counts) whose
+    # (starts, counts) index ``perm`` — the PagesHash role proper
+    pages: Optional[tuple] = None
+    key_types: Optional[tuple] = None     # probe-normalization types
 
 
 class LookupSourceFactory:
@@ -163,6 +169,32 @@ def _build_index_packed(pairs, mins, strides, num_rows):
     return sb, perm, has_null
 
 
+from presto_tpu.kernelcache import cache_get, cache_put, new_cache
+
+_PAGES_BUILD = new_cache("pages_hash_build")
+
+
+def _pages_hash_build_jit(key_pairs, key_types, num_rows, table_cap: int):
+    """ops.hashtable.pages_hash_build as one cached jitted program (the
+    HashBuilderOperator finish -> PagesHash ctor, PagesHash.java:63)."""
+    cap_b = key_pairs[0][0].shape[0]
+    kvalid = tuple(v is not None for _, v in key_pairs)
+    key = ("pages_build", tuple(key_types), kvalid, cap_b, table_cap)
+    hit = cache_get(_PAGES_BUILD, key)
+    if hit is None:
+        def kernel(kvals, kvalids, n):
+            from presto_tpu.ops.hashtable import pages_hash_build
+
+            kc = [(kvals[i], kvalids[i], key_types[i])
+                  for i in range(len(key_types))]
+            return pages_hash_build(kc, n, table_cap)
+
+        hit = jax.jit(kernel)
+        cache_put(_PAGES_BUILD, key, hit)
+    return hit(tuple(v for v, _ in key_pairs),
+               tuple(v for _, v in key_pairs), num_rows)
+
+
 class HashBuildOperator(Operator):
     def __init__(self, ctx: OperatorContext, factory: "HashBuildOperatorFactory"):
         super().__init__(ctx)
@@ -246,6 +278,35 @@ class HashBuildOperator(Operator):
         n = jnp.asarray(n_build)
         key_pairs = tuple(
             (data.columns[c].values, data.columns[c].valid) for c in chans)
+        cfg = self.ctx.config
+        packable = all(_is_single_word_type(data.columns[c].type)
+                       for c in chans)
+        want_hash = False
+        if getattr(cfg, "device_join_probe", False):
+            if not packable:
+                # canonical-class multi-channel keys: the hash table is
+                # what lets the probe STREAM at all (the sorted tier
+                # would materialize the probe side for a union sort)
+                want_hash = True
+            elif (jax.default_backend() == "tpu"
+                    and n_build <= getattr(
+                        cfg, "device_join_probe_max_build_rows",
+                        1 << 17)):
+                # packable keys: platform economics decide.  On TPU,
+                # sorting is the expensive primitive and gathers run at
+                # device rate, so the table wins up to the build-size
+                # bound (claim-inserting a huge build still loses to
+                # one argsort).  On CPU the measured winner for
+                # integer-keyed builds is the existing sorted tier —
+                # its dense-histogram probe is two gathers — so the
+                # hash table is not engaged there; absorbed probes
+                # (exec/fusion.py) carry single/packed sources
+                # in-kernel either way, which is where the dispatch
+                # reduction lives.
+                want_hash = True
+        if want_hash and self._set_pages_hash(data, key_pairs, chans,
+                                              n, n_build):
+            return
         if len(chans) == 1 and _is_single_word_type(data.columns[chans[0]].type):
             # one scalar sync guards the id arithmetic: a live key spread
             # >= 2^62 would overflow the (value - min + 2) ids, silently
@@ -280,9 +341,39 @@ class HashBuildOperator(Operator):
                     mins=los, strides=strides_a, maxs=his,
                     has_null_key=has_null))
                 return
+        # key spans overflowed the single/packed id arithmetic: the
+        # hash table still streams such keys (equality needs no ids)
+        if (getattr(cfg, "device_join_probe", False) and not want_hash
+                and self._set_pages_hash(data, key_pairs, chans, n,
+                                         n_build)):
+            return
         # general path: probe side will materialize and union-sort
         self.f.lookup.set(LookupSource("canonical", None, None, data,
                                        n_build, chans))
+
+    def _set_pages_hash(self, data, key_pairs, chans, n,
+                        n_build) -> bool:
+        """Build + publish the PagesHash lookup source; False when the
+        bounded claim loop could not place the build keys (adversarial
+        chains — one retry at 4x capacity quarters the load first).
+        ok=False costs one scalar sync, the span_big guard's cost
+        class."""
+        table_cap = max(2 * data.capacity, 1024)
+        ktypes = tuple(data.columns[c].type for c in chans)
+        (tw, tp, tu, starts, counts, perm, has_null,
+         ok) = _pages_hash_build_jit(key_pairs, ktypes, n, table_cap)
+        if not bool(ok):
+            (tw, tp, tu, starts, counts, perm, has_null,
+             ok) = _pages_hash_build_jit(key_pairs, ktypes, n,
+                                         4 * table_cap)
+        if not bool(ok):
+            return False
+        self.ctx.stats.kernel_tier = "hash"
+        self.f.lookup.set(LookupSource(
+            "hash", None, perm, data, n_build, chans,
+            has_null_key=has_null, pages=(tw, tp, tu, starts, counts),
+            key_types=ktypes))
+        return True
 
     def get_output(self) -> Optional[Batch]:
         return None
@@ -353,18 +444,38 @@ class _StreamStatics:
     out_cap: int
     n_probe_cols: int
     null_aware: bool = False
+    # 'hash' mode: probe-key types for word normalization inside the
+    # kernel (the pages table is keyed on normalized words)
+    key_types: Tuple = ()
 
 
-@_partial(jax.jit, static_argnames=("key_channels", "mode", "join_type"))
+def _hash_lo_counts(probe_pairs, pages, key_channels, key_types,
+                    num_rows):
+    """(lo, counts, live) through the PagesHash table (probe half of
+    PagesHash.java:63-121; prefix reject before the word compare)."""
+    from presto_tpu.ops.hashtable import pages_hash_probe
+
+    kc = [(probe_pairs[c][0], probe_pairs[c][1], key_types[i])
+          for i, c in enumerate(key_channels)]
+    return pages_hash_probe(pages, kc, num_rows)
+
+
+@_partial(jax.jit, static_argnames=("key_channels", "mode", "join_type",
+                                    "key_types"))
 def _probe_expand_total(probe_pairs, sorted_ids, perm, mins, strides,
-                        maxs, num_rows, *, key_channels, mode, join_type):
+                        maxs, pages, num_rows, *, key_channels, mode,
+                        join_type, key_types=()):
     """Phase 1: exact expansion size for this batch (so phase 2 compiles
     at the right capacity bucket on the first try)."""
     from presto_tpu.ops import join as J
 
-    ids = _ids_from_pairs(jnp, probe_pairs, key_channels, mode, mins,
-                          strides, maxs, num_rows)
-    _, counts = J.probe_counts(sorted_ids, perm, ids)
+    if mode == "hash":
+        _, counts, _ = _hash_lo_counts(probe_pairs, pages, key_channels,
+                                       key_types, num_rows)
+    else:
+        ids = _ids_from_pairs(jnp, probe_pairs, key_channels, mode, mins,
+                              strides, maxs, num_rows)
+        _, counts = J.probe_counts(sorted_ids, perm, ids)
     if join_type == "left":
         cap = probe_pairs[0][0].shape[0]
         live_probe = jnp.arange(cap) < num_rows
@@ -374,7 +485,8 @@ def _probe_expand_total(probe_pairs, sorted_ids, perm, mins, strides,
 
 @_partial(jax.jit, static_argnames=("s",))
 def _stream_probe(probe_pairs, build_pairs, sorted_ids, perm, mins,
-                  strides, maxs, num_rows, bstats, *, s: _StreamStatics):
+                  strides, maxs, pages, num_rows, bstats, *,
+                  s: _StreamStatics):
     """Phase 2: the streaming probe kernel (inner/left expansion or
     semi/anti masks) as one XLA program.  All build-side data arrives as
     traced arguments: nothing is baked into the executable, so the
@@ -383,10 +495,14 @@ def _stream_probe(probe_pairs, build_pairs, sorted_ids, perm, mins,
     from presto_tpu.ops.filter import selected_positions
 
     cap = probe_pairs[0][0].shape[0]
-    ids = _ids_from_pairs(jnp, probe_pairs, s.key_channels, s.mode, mins,
-                          strides, maxs, num_rows)
-    lo, counts = J.probe_counts(sorted_ids, perm, ids)
-    live = ids >= 0
+    if s.mode == "hash":
+        lo, counts, live = _hash_lo_counts(
+            probe_pairs, pages, s.key_channels, s.key_types, num_rows)
+    else:
+        ids = _ids_from_pairs(jnp, probe_pairs, s.key_channels, s.mode,
+                              mins, strides, maxs, num_rows)
+        lo, counts = J.probe_counts(sorted_ids, perm, ids)
+        live = ids >= 0
     if s.join_type in ("semi", "anti"):
         if s.join_type == "anti":
             n_build, has_null = bstats
@@ -554,6 +670,10 @@ class LookupJoinOperator(Operator):
             strides = maxs = jnp.zeros(1, jnp.int64)
         else:
             mins = strides = maxs = jnp.zeros(1, jnp.int64)
+        key_types = src.key_types if src.mode == "hash" else ()
+        if not self.ctx.stats.kernel_tier:
+            self.ctx.stats.kernel_tier = (
+                "hash" if src.mode == "hash" else "sorted")
         probe_pairs = tuple(column_pairs(batch))
         build_pairs = tuple(column_pairs(src.data))
         if join_type in ("semi", "anti"):
@@ -561,16 +681,18 @@ class LookupJoinOperator(Operator):
         else:
             etotal = int(_probe_expand_total(
                 probe_pairs, src.sorted_ids, src.perm, mins, strides, maxs,
-                n, key_channels=kc, mode=src.mode, join_type=join_type))
+                src.pages, n, key_channels=kc, mode=src.mode,
+                join_type=join_type, key_types=key_types))
             out_cap = next_bucket(max(etotal, 1))
         s = _StreamStatics(src.mode, join_type, kc, out_cap,
-                           batch.num_columns, self.f.null_aware)
+                           batch.num_columns, self.f.null_aware,
+                           key_types)
         bstats = (jnp.asarray(src.n_build, jnp.int64),
                   src.has_null_key if src.has_null_key is not None
                   else jnp.zeros((), bool))
         outs, count, _ = _stream_probe(
             probe_pairs, build_pairs, src.sorted_ids, src.perm, mins,
-            strides, maxs, n, bstats, s=s)
+            strides, maxs, src.pages, n, bstats, s=s)
         # expansion joins already synced the exact total in phase 1; only
         # semi/anti need to read the selected count (host round-trips are
         # ~1s each on remote-attached devices)
@@ -608,10 +730,16 @@ class LookupJoinOperator(Operator):
         residual = None if cres is None else cres.run
 
         def kernel(probe_cols_pairs, build_cols_pairs, num_rows):
-            pb = _RebuiltBatch(probe_cols_pairs)
-            ids = probe_op._probe_ids(jnp, src, pb, num_rows)
-            lo, counts = J.probe_counts(src.sorted_ids, src.perm, ids)
-            live = ids >= 0
+            if src.mode == "hash":
+                lo, counts, live = _hash_lo_counts(
+                    probe_cols_pairs, src.pages,
+                    tuple(probe_op.f.probe_key_channels),
+                    src.key_types, num_rows)
+            else:
+                pb = _RebuiltBatch(probe_cols_pairs)
+                ids = probe_op._probe_ids(jnp, src, pb, num_rows)
+                lo, counts = J.probe_counts(src.sorted_ids, src.perm, ids)
+                live = ids >= 0
             zero = jnp.int64(0)
             if join_type in ("semi", "anti"):
                 if residual is not None:

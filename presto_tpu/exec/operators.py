@@ -133,14 +133,18 @@ _FP_HOST = _new_cache("filter_project_host")
 def dictionary_binding_key(columns) -> tuple:
     """Per-column dictionary-binding component of a kernel cache key.
 
-    (token, len) per dictionary column: the token is a never-reused
-    monotonic identity (id() can alias after GC), and the length guards
-    compiled per-entry lookup tables against an append-only dictionary
-    growing after the program was traced.
+    (content fingerprint, len) per dictionary column: equal CONTENT in
+    equal order implies identical code semantics, so per-execution
+    rebuilt dictionaries (deserialized exchange pages, concat-merged
+    build sides) share compiled programs instead of churning one
+    recompile per query — ``Dictionary.token`` remains the identity
+    surface (never reused, unlike id()), but programs key on what they
+    actually baked: entry content (per-entry lookup tables) and length
+    (append-only growth guard).
     """
     return tuple(
         None if c.dictionary is None
-        else (c.dictionary.token, len(c.dictionary))
+        else (c.dictionary.content_key(), len(c.dictionary))
         for c in columns)
 
 

@@ -23,6 +23,19 @@ semantics), encoded as distinct negative sentinels per side.
 
 Join variants mirror LookupJoinOperators.java:45-60: inner, probe-outer
 (left), semi, anti; build-side-outer composes from ``matched_build``.
+
+A second lookup tier now exists beside the sorted index: the
+**PagesHash** table proper (``ops/hashtable.py pages_hash_build`` /
+``pages_hash_probe``, gated ``EngineConfig.device_join_probe``) — an
+open-addressing table over the build side's raw normalized key words
+with the 1-byte hash-prefix reject of ``PagesHash.java:49``.  It probes
+by EQUALITY, not order, so arbitrary multi-channel key types stream
+without this module's canonical union-sort materialization, and a probe
+costs its hash-chain length instead of a ~20-step binary search.  Both
+tiers share the (lo, counts) -> ``expand_matches``/``semi_mask``
+contract below; duplicate build keys are grouped runs either way (by
+sort order here, by slot-grouped permutation there), filling the
+``PositionLinks`` role without chains.
 """
 
 from __future__ import annotations

@@ -1,0 +1,140 @@
+"""Pallas TPU kernel for the open-addressing probe-insert loop.
+
+``ops/hashtable.py`` ships the data-parallel claim-loop formulation
+(gathers + scatter-min + ``lax.while_loop``) that XLA schedules well on
+both CPU and TPU.  This module is the row-at-a-time Pallas rendering of
+the SAME table discipline — linear probing over a power-of-two table
+with the 1-byte hash-prefix reject of ``PagesHash.java:49`` — kept for
+two reasons, mirroring ``ops/pallas_groupby.py``:
+
+- it is the in-tree template for authoring stateful Pallas kernels
+  (input/output aliasing for resident table state, scalar dynamic
+  loads/stores, nested while/fori control flow, the x64-tracing
+  pitfall: key words arrive split into i32 hi/lo pairs so the kernel
+  traces x64-off);
+- CPU tests drive it under ``interpret=True`` as an independent oracle
+  for the claim-loop kernel: both must agree slot-for-slot on matches
+  (winner order may differ for first-insert ties, so tests compare
+  group SETS and accumulated state, not raw slot ids).
+
+Opt in on device with PRESTO_TPU_PALLAS=1 (same env gate as the
+groupby reduction template); the engine's shipping path never requires
+it.  Reference analogue: the probe loops of
+``MultiChannelGroupByHash.putIfAbsent`` (MultiChannelGroupByHash
+.java:273-286) and ``PagesHash.getAddressIndex`` (PagesHash.java:63).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover - environments without pallas
+    pl = None
+
+
+def available() -> bool:
+    return pl is not None
+
+
+def _insert_kernel(slot0_ref, prefix_ref, keys_ref, live_ref,
+                   _tw_in, _tp_in, _tu_in,
+                   out_ref, tw_ref, tp_ref, tu_ref, *, cap: int):
+    """Serial insert of one batch: rows resolve in index order, each via
+    a linear-probe walk (match -> reuse slot, empty -> install).
+
+    The table refs appear twice (input + aliased output); all reads and
+    writes go through the OUTPUT refs so installs are visible to later
+    rows within the same call (input_output_aliases makes them the same
+    buffer on device; interpret mode honors the aliasing too)."""
+    n = slot0_ref.shape[0]
+
+    def row(i, carry):
+        pref = prefix_ref[i]
+        alive = live_ref[i] != 0
+
+        def probe(st):
+            slot, resolved, out = st
+            used = tu_ref[slot] != 0
+            same_pref = used & (tp_ref[slot] == pref)
+            # full compare only where the 1-byte prefix agrees
+            eq = same_pref & jnp.all(tw_ref[slot, :] == keys_ref[i, :])
+            empty = ~used
+            done = eq | empty
+            nxt = jnp.where(done, slot, (slot + 1) & (cap - 1))
+            return nxt, done, jnp.where(done, slot, out)
+
+        slot, _, out = jax.lax.while_loop(
+            lambda st: ~st[1],
+            probe,
+            (slot0_ref[i], jnp.logical_not(alive), jnp.int32(cap)))
+
+        @pl.when(alive)
+        def _install():
+            tu_ref[slot] = jnp.int32(1)
+            tp_ref[slot] = pref
+            tw_ref[slot, :] = keys_ref[i, :]
+            out_ref[i] = slot
+
+        @pl.when(jnp.logical_not(alive))
+        def _dead():
+            out_ref[i] = jnp.int32(cap)
+
+        return carry
+
+    jax.lax.fori_loop(0, n, row, 0)
+
+
+def _split_words(words):
+    """int64 key words -> [N, 2*k] int32 hi/lo pairs (exact; keeps the
+    kernel free of 64-bit types, which Mosaic rejects under x64)."""
+    cols = []
+    for w in words:
+        u = w.astype(jnp.uint64)
+        cols.append((u >> jnp.uint64(32)).astype(jnp.uint32)
+                    .astype(jnp.int32))
+        cols.append((u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+                    .astype(jnp.int32))
+    return jnp.stack(cols, axis=1)
+
+
+def pallas_probe_insert(key_words, live, t_words_i32, t_prefix_i32,
+                        t_used_i32, interpret: bool = False):
+    """Insert every live row; returns (slot [N] i32, table arrays').
+
+    ``key_words``: list of int64 arrays (normalize_keys output).
+    ``t_words_i32``: [cap, 2*k] int32 table words (hi/lo split),
+    ``t_prefix_i32``/``t_used_i32``: [cap] int32.  Sequential-insert
+    semantics: deterministic slot per row regardless of duplicates.
+    """
+    from presto_tpu.ops.hashtable import hash_words, slot_and_prefix
+
+    cap = t_used_i32.shape[0]
+    h = hash_words(key_words)
+    slot0, prefix = slot_and_prefix(h, cap)
+    keys = _split_words(key_words)
+    with jax.enable_x64(False):
+        out, tw, tp, tu = pl.pallas_call(
+            functools.partial(_insert_kernel, cap=cap),
+            out_shape=[
+                jax.ShapeDtypeStruct((keys.shape[0],), jnp.int32),
+                jax.ShapeDtypeStruct(t_words_i32.shape, jnp.int32),
+                jax.ShapeDtypeStruct((cap,), jnp.int32),
+                jax.ShapeDtypeStruct((cap,), jnp.int32),
+            ],
+            input_output_aliases={4: 1, 5: 2, 6: 3},
+            interpret=interpret,
+        )(slot0, prefix.astype(jnp.int32), keys,
+          live.astype(jnp.int32), t_words_i32,
+          t_prefix_i32, t_used_i32)
+    return out, tw, tp, tu
+
+
+def empty_table_i32(cap: int, n_words: int):
+    """Fresh i32-layout table for the Pallas kernel."""
+    return (jnp.zeros((cap, 2 * n_words), jnp.int32),
+            jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.int32))

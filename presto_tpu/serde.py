@@ -39,6 +39,47 @@ from presto_tpu import native
 from presto_tpu import types as T
 from presto_tpu.batch import Batch, Column, Dictionary
 
+# Deserialized dictionaries interned process-wide by CONTENT: kernel
+# caches key programs on the dictionary binding (token, length), so a
+# fresh Dictionary per wire page would churn one compiled program per
+# exchange-fed segment per query (measured: ~60 s of re-compile per
+# warm distributed TPC-DS q72 once FINAL-merge/probe segments coalesce
+# exchange pages).  The key hashes the RAW dictionary section bytes —
+# one xxh64 over bytes, far cheaper than hashing thousands of decoded
+# strings — and equal bytes decode to equal entry lists, so sharing is
+# exact.  Bounded FIFO (identical discipline to the generator pools:
+# append-only Dictionary growth keeps codes stable for compiled
+# programs; the binding key carries the length).
+_WIRE_DICTS: "OrderedDict[tuple, Dictionary]" = __import__(
+    "collections").OrderedDict()
+_WIRE_DICTS_CAP = 1024
+_WIRE_DICTS_LOCK = __import__("threading").Lock()
+
+
+def _interned_wire_dict(section: bytes, count: int) -> Dictionary:
+    from presto_tpu import native
+
+    key = (count, len(section), native.xxh64(section))
+    with _WIRE_DICTS_LOCK:
+        hit = _WIRE_DICTS.get(key)
+        if hit is not None:
+            _WIRE_DICTS.move_to_end(key)
+            return hit
+    off = 4
+    entries = []
+    for _ in range(count):
+        (blen,) = struct.unpack_from("<I", section, off)
+        off += 4
+        entries.append(section[off:off + blen].decode("utf-8"))
+        off += blen
+    d = Dictionary(entries)
+    with _WIRE_DICTS_LOCK:
+        hit = _WIRE_DICTS.setdefault(key, d)
+        _WIRE_DICTS.move_to_end(key)
+        while len(_WIRE_DICTS) > _WIRE_DICTS_CAP:
+            _WIRE_DICTS.popitem(last=False)
+        return hit
+
 MAGIC = b"PTPG"
 VERSION = 1
 FLAG_LZ4 = 1
@@ -167,15 +208,13 @@ def _decode_column(payload: bytes, off: int, typ: T.Type,
         off += num_rows
     dictionary: Optional[Dictionary] = None
     if has_dict:
+        dict_start = off
         (count,) = struct.unpack_from("<I", payload, off)
         off += 4
-        entries = []
         for _ in range(count):
             (blen,) = struct.unpack_from("<I", payload, off)
-            off += 4
-            entries.append(payload[off:off + blen].decode("utf-8"))
-            off += blen
-        dictionary = Dictionary(entries)
+            off += 4 + blen
+        dictionary = _interned_wire_dict(payload[dict_start:off], count)
     if isinstance(typ, (T.ArrayType, T.MapType)):
         lengths = np.asarray(values, np.int64)
         if (lengths < 0).any():

@@ -102,6 +102,25 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
                            lambda v: v.lower() in ("true", "1", "on")),
     "plan_cache_capacity": ("plan_cache_capacity", int),
     "query_queue_timeout_s": ("query_queue_timeout_s", float),
+    "hash_groupby_enabled": (
+        "hash_groupby_enabled",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "hash_groupby_init_slots": ("hash_groupby_init_slots", int),
+    "hash_groupby_max_slots": ("hash_groupby_max_slots", int),
+    "hash_groupby_min_rows": ("hash_groupby_min_rows", int),
+    "device_join_probe": (
+        "device_join_probe",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "device_join_probe_max_build_rows": (
+        "device_join_probe_max_build_rows", int),
+    "fusion_final_merge": (
+        "fusion_final_merge",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "prereduce_cost_based": (
+        "prereduce_cost_based",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "prereduce_max_group_fraction": (
+        "prereduce_max_group_fraction", float),
     "stats_sampling_enabled": (
         "stats_sampling_enabled",
         lambda v: v.lower() in ("true", "1", "on")),

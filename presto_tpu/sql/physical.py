@@ -370,6 +370,8 @@ class PhysicalPlanner:
                 agg_fac = HashAggregationOperatorFactory(
                     list(node.group_channels), agg_channels, input_types)
                 agg_fac.step = node.step
+                agg_fac.prereduce_ratio_hint = self._group_ratio_hint(
+                    node)
                 chain.append(agg_fac)
         else:
             agg_fac = GlobalAggregationOperatorFactory(
@@ -397,6 +399,30 @@ class PhysicalPlanner:
             chain.append(FilterProjectOperatorFactory(
                 None, exprs, post_in))
         return chain, splits
+
+    def _group_ratio_hint(self, node: AggregationNode) -> Optional[float]:
+        """Estimated groups/rows ratio for this aggregation (the
+        plan-time half of the cost-based pre-reduce decision): derived
+        through the same stats tier the memo's cost model uses
+        (sql/stats.py NDV propagation).  None when unknown — the fusion
+        pass then decides from the runtime observed ratio alone."""
+        if not getattr(self.config, "prereduce_cost_based", False):
+            return None
+        try:
+            import types as _pytypes
+
+            from presto_tpu.sql.stats import StatsCalculator
+
+            sc = StatsCalculator(
+                _pytypes.SimpleNamespace(registry=self.registry))
+            src = sc.stats(node.source)
+            ag = sc.stats(node)
+            if (src.row_count and ag.row_count is not None
+                    and src.row_count > 0):
+                return float(ag.row_count) / float(src.row_count)
+        except Exception:  # noqa: BLE001 - stats must never fail a plan
+            return None
+        return None
 
     def _streaming_eligible(self, chain, group_channels,
                             agg_channels, input_types) -> bool:

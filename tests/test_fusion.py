@@ -634,3 +634,271 @@ def test_task_info_reports_kernel_caches():
         # (kernelcache.record_compile) surfaced alongside hit/miss
         assert set(s) == {"size", "hits", "misses", "evictions",
                           "compiles", "compile_ns"}
+
+
+# ---------------------------------------------------------------------------
+# device-resident hash tier (PR 10): probe-in-segment, FINAL-merge
+# fusion, cost-based pre-reduce, and the overflow seam
+# ---------------------------------------------------------------------------
+
+def _plan_chains(runner, sql, cfg):
+    from presto_tpu.sql.optimizer import optimize
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.physical import PhysicalPlanner
+    from presto_tpu.sql.planner import Planner
+
+    plan = optimize(Planner(runner.metadata).plan(parse_statement(sql)),
+                    runner.metadata, cfg)
+    return PhysicalPlanner(runner.registry, cfg).plan(plan).pipelines
+
+
+def test_q3_probe_absorbed_into_segment(runner_on):
+    """Q3's probe pipeline runs filter -> project -> probe inside ONE
+    fused segment (the filter/project/probe/partial-agg chain of the
+    tentpole), and the probe stages name their join type."""
+    from presto_tpu.exec.fusion import ProbeStage
+
+    pipelines = _plan_chains(runner_on, QUERIES[3], runner_on.config)
+    probes = [s for p in pipelines for f in p.factories
+              if isinstance(f, FusedSegmentOperatorFactory)
+              for s in f.stages if isinstance(s, ProbeStage)]
+    assert len(probes) >= 2
+    assert all(s.factory.join_type == "inner" for s in probes)
+
+
+def test_device_join_probe_off_restores_pr9_lowering(runner_on):
+    """device_join_probe=false must reproduce the PR 9 chains exactly:
+    no ProbeStage anywhere, probe operators back in the chain, and the
+    build side building the sorted index (mode != 'hash')."""
+    from presto_tpu.exec.fusion import ProbeStage
+    from presto_tpu.exec.joinop import LookupJoinOperatorFactory
+
+    cfg = _cfg(device_join_probe=False)
+    pipelines = _plan_chains(runner_on, QUERIES[3], cfg)
+    kinds = [type(f).__name__ for p in pipelines for f in p.factories]
+    assert "LookupJoinOperatorFactory" in kinds
+    for p in pipelines:
+        for f in p.factories:
+            if isinstance(f, FusedSegmentOperatorFactory):
+                assert not any(isinstance(s, ProbeStage)
+                               for s in f.stages)
+    r = LocalQueryRunner.tpch(scale=0.01, config=cfg)
+    r.execute(QUERIES[3])
+    join_tiers = [s.kernel_tier for s in r._last_task.operator_stats
+                  if s.kernel_tier and ("Build" in s.operator
+                                        or "LookupJoin" in s.operator)]
+    assert join_tiers and "hash" not in join_tiers
+
+
+def test_all_new_knobs_off_restores_pr9_chain_shapes(runner_on):
+    """The acceptance pin: hash_groupby_enabled=false +
+    device_join_probe=false + fusion_final_merge=false (+ the
+    cost-based gate off) leaves every lowered chain shaped exactly as
+    PR 9 left it, and results match the defaults-on engine."""
+    from presto_tpu.exec.fusion import ProbeStage
+
+    cfg = _cfg(hash_groupby_enabled=False, device_join_probe=False,
+               fusion_final_merge=False, prereduce_cost_based=False)
+    r_off = LocalQueryRunner.tpch(scale=0.01, config=cfg)
+    for qn in (1, 3, 6):
+        pipelines = _plan_chains(runner_on, QUERIES[qn], cfg)
+        for p in pipelines:
+            for f in p.factories:
+                if isinstance(f, FusedSegmentOperatorFactory):
+                    assert not any(isinstance(s, ProbeStage)
+                                   for s in f.stages)
+        ra = runner_on.execute(QUERIES[qn])
+        rb = r_off.execute(QUERIES[qn])
+        assert_rows_close(ra.rows, rb.rows)
+    # the PR 9 Q1 lowering pin still holds under the off-config
+    pipelines = _plan_chains(runner_on, QUERIES[1], cfg)
+    kinds = [type(f).__name__ for f in pipelines[0].factories]
+    assert kinds == [
+        "TableScanOperatorFactory", "FusedSegmentOperatorFactory",
+        "HashAggregationOperatorFactory", "OrderByOperatorFactory",
+        "OutputCollectorFactory"], kinds
+
+
+def test_final_merge_fuses_exchange_fed_grouped_merge():
+    """A grouped FINAL merge directly on a remote exchange absorbs into
+    an empty-stage coalescing segment with the finalize projections
+    folded into the merge finish; fusion_final_merge=false restores the
+    PR 9 chain exactly."""
+    from presto_tpu.exec.aggregation import (
+        AggChannel, HashAggregationOperatorFactory,
+    )
+    from presto_tpu.exec.fusion import fuse_chain
+    from presto_tpu.server.exchangeop import ExchangeOperatorFactory
+
+    types = [T.BIGINT, T.DOUBLE, T.BIGINT]
+    agg = HashAggregationOperatorFactory(
+        [0], [AggChannel("sum", 1, T.DOUBLE),
+              AggChannel("sum", 2, T.BIGINT)], types)
+    agg.step = "final"
+    fin = FilterProjectOperatorFactory(
+        None, [B.ref(0, T.BIGINT), B.ref(1, T.DOUBLE)], types)
+    exch = ExchangeOperatorFactory(["http://x/v1/task/t/results/0"])
+    chain = fuse_chain([exch, agg, fin], _cfg())
+    assert isinstance(chain[1], FusedSegmentOperatorFactory)
+    assert chain[1].agg_spec is not None
+    assert chain[1].coalesce_rows == _cfg().scan_batch_rows
+    assert chain[2].post_projections
+    off = fuse_chain([exch, agg, fin], _cfg(fusion_final_merge=False))
+    assert [type(f).__name__ for f in off] == [
+        "ExchangeOperatorFactory", "HashAggregationOperatorFactory",
+        "FilterProjectOperatorFactory"]
+
+
+def test_final_merge_skips_global_merges():
+    """Global merge aggregations stay unfused (their empty-input
+    default row needs the ORIGINAL prims)."""
+    from presto_tpu.exec.aggregation import (
+        AggChannel, GlobalAggregationOperatorFactory,
+    )
+    from presto_tpu.exec.fusion import fuse_chain
+    from presto_tpu.server.exchangeop import ExchangeOperatorFactory
+
+    agg = GlobalAggregationOperatorFactory(
+        [AggChannel("sum", 0, T.DOUBLE)], [T.DOUBLE])
+    agg.step = "final"
+    exch = ExchangeOperatorFactory(["http://x/v1/task/t/results/0"])
+    chain = fuse_chain([exch, agg], _cfg())
+    assert [type(f).__name__ for f in chain] == [
+        "ExchangeOperatorFactory", "GlobalAggregationOperatorFactory"]
+
+
+def test_cost_based_raw_emission_switch():
+    """A pre-reducing segment whose observed groups/rows ratio says
+    grouping is not reducing flips to raw partial-state emission after
+    the first batch — results stay exact, and prereduce_rows stops
+    accumulating once flipped."""
+    from presto_tpu.exec.aggregation import AggChannel
+    from presto_tpu.exec.aggregation import HashAggregationOperatorFactory
+
+    n = 4096
+    d = Dictionary([f"k{i}" for i in range(n)])
+    vt = None
+    rows1 = [(i, float(i)) for i in range(n)]          # all distinct
+    rows2 = [(i, float(2 * i)) for i in range(n)]
+    from presto_tpu import types as TT
+    from presto_tpu.batch import Batch, Column
+    import numpy as np
+
+    def mk(rows):
+        codes = np.asarray([r[0] for r in rows], np.int32)
+        vals = np.asarray([r[1] for r in rows])
+        kt = TT.VARCHAR
+        return Batch((Column(kt, codes, None, d),
+                      Column(TT.DOUBLE, vals)), len(rows))
+
+    types = [mk(rows1).columns[0].type, T.DOUBLE]
+    fp = FilterProjectOperatorFactory(
+        None, [B.ref(0, types[0]), B.ref(1, T.DOUBLE)], types)
+    agg = HashAggregationOperatorFactory(
+        [0], [AggChannel("sum", 1, T.DOUBLE),
+              AggChannel("count", None, T.BIGINT)], types)
+
+    def run(cfg):
+        collector = OutputCollectorFactory()
+        chain = fuse_chain(
+            [ValuesOperatorFactory([mk(rows1).to_device(),
+                                    mk(rows2).to_device()]),
+             fp, agg], cfg)
+        task = execute_pipelines(
+            [Pipeline(chain + [collector], name="t")], cfg)
+        return task, sorted(collector.rows())
+
+    cfg_on = _cfg(direct_groupby_max_domain=1 << 14)
+    task_on, rows_on = run(cfg_on)
+    cfg_off = _cfg(direct_groupby_max_domain=1 << 14,
+                   prereduce_cost_based=False)
+    task_off, rows_off = run(cfg_off)
+    assert rows_on == rows_off
+    # with the gate on, only the FIRST batch pre-reduced; off, both did
+    assert 0 < task_on.jit_counters()["prereduce_rows"] \
+        < task_off.jit_counters()["prereduce_rows"]
+
+
+def test_hash_groupby_overflow_seam_exact(runner_on):
+    """The unfused-fallback seam (satellite): a capacity bucket forced
+    to overflow mid-query carries the accumulated on-device state over
+    exactly — no double count, no dropped group — and the operator
+    reports the seam crossing."""
+    sql = ("select l_partkey, sum(l_extendedprice), count(*), "
+           "min(l_quantity), max(l_tax) from lineitem group by l_partkey")
+    want = runner_on.execute(sql).rows
+    r = LocalQueryRunner.tpch(scale=0.01, config=_cfg(
+        hash_groupby_init_slots=64, hash_groupby_max_slots=256,
+        hash_groupby_min_rows=0))
+    got = r.execute(sql).rows
+    assert_rows_close(got, want)
+    tiers = [s.kernel_tier for s in r._last_task.operator_stats
+             if s.kernel_tier]
+    assert "hash+sort" in tiers
+
+
+def test_hash_groupby_tier_engages_on_unbounded_keys(runner_on):
+    sql = "select l_partkey, count(*) from lineitem group by l_partkey"
+    r = LocalQueryRunner.tpch(scale=0.01,
+                              config=_cfg(hash_groupby_min_rows=0))
+    ra = r.execute(sql)
+    tiers = [s.kernel_tier for s in r._last_task.operator_stats
+             if s.kernel_tier]
+    assert "hash" in tiers
+    r_off = LocalQueryRunner.tpch(
+        scale=0.01, config=_cfg(hash_groupby_enabled=False))
+    rb = r_off.execute(sql)
+    assert_rows_close(ra.rows, rb.rows)
+    tiers = [s.kernel_tier for s in r_off._last_task.operator_stats
+             if s.kernel_tier]
+    assert "hash" not in tiers and "sort" in tiers
+
+
+def test_session_property_toggles_hash_tier():
+    r = LocalQueryRunner.tpch(scale=0.01)
+    sql = "select l_partkey, count(*) from lineitem group by l_partkey"
+    r.execute("set session hash_groupby_min_rows = 0")
+    r.execute("set session hash_groupby_enabled = false")
+    r.execute(sql)
+    assert not any(s.kernel_tier == "hash"
+                   for s in r._last_task.operator_stats)
+    r.execute("set session hash_groupby_enabled = true")
+    r.execute(sql)
+    assert any(s.kernel_tier == "hash"
+               for s in r._last_task.operator_stats)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_hash_tier_parity(qnum, runner_on):
+    """All new knobs off vs defaults on: result parity across the full
+    TPC-H suite (the per-knob acceptance sweep)."""
+    r_off = _PAGG_OFF_RUNNERS.setdefault(
+        "pr10_off", LocalQueryRunner.tpch(scale=0.01, config=_cfg(
+            hash_groupby_enabled=False, device_join_probe=False,
+            fusion_final_merge=False, prereduce_cost_based=False)))
+    ra = runner_on.execute(QUERIES[qnum])
+    rb = r_off.execute(QUERIES[qnum])
+    assert ra.column_names == rb.column_names
+    assert_rows_close(ra.rows, rb.rows)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qnum", sorted(__import__(
+    "tpcds_queries").QUERIES))
+def test_tpcds_hash_tier_parity(qnum):
+    """All new knobs off vs defaults on across the TPC-DS suite."""
+    from tpcds_queries import QUERIES as DSQ
+
+    r_off = _PAGG_OFF_RUNNERS.setdefault(
+        "pr10_ds_off", LocalQueryRunner.tpch(scale=0.003, config=_cfg(
+            hash_groupby_enabled=False, device_join_probe=False,
+            fusion_final_merge=False, prereduce_cost_based=False)))
+    r_on = _PAGG_OFF_RUNNERS.setdefault(
+        "pr10_ds_on", LocalQueryRunner.tpch(scale=0.003))
+    for r in (r_off, r_on):
+        r.metadata.default_catalog = "tpcds"
+    ra = r_on.execute(DSQ[qnum])
+    rb = r_off.execute(DSQ[qnum])
+    assert ra.column_names == rb.column_names
+    assert_rows_close(ra.rows, rb.rows)

@@ -243,6 +243,42 @@ class TestPerfRegress:
         assert "tpch_sf0.1_q1_rows_per_sec_per_chip" in out
         assert "OK" in out
 
+    def test_committed_pr9_pr10_pair_passes(self, capsys):
+        """The PR 10 acceptance gate: the committed BENCH_PR9 -> PR10
+        pair is green — the engine Q1 config improved >= 2x (the
+        device-resident hash tier + scan-dictionary interning), the new
+        join-heavy bench_engine_q3q9 config reports NEW (tracked from
+        here on), and no matched config regressed past tolerance."""
+        import json
+        import os
+
+        root = os.path.join(os.path.dirname(__file__), "..")
+        rc = self._tool().main(
+            ["--check",
+             os.path.join(root, "BENCH_PR9_20260805.json"),
+             os.path.join(root, "BENCH_PR10_20260805.json")])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "no regressions past tolerance" in out
+        assert "tpch_sf0.05_q3_engine_rows_per_sec" in out   # NEW config
+        with open(os.path.join(root, "BENCH_PR9_20260805.json")) as f:
+            old = json.load(f)
+        with open(os.path.join(root, "BENCH_PR10_20260805.json")) as f:
+            new = json.load(f)
+
+        def metric(doc, name):
+            for e in doc["extras"]:
+                if e.get("metric") == name:
+                    return e
+            return None
+
+        o = metric(old, "tpch_sf0.05_q1_engine_rows_per_sec")
+        n = metric(new, "tpch_sf0.05_q1_engine_rows_per_sec")
+        assert n["value"] >= 2 * o["value"], (o["value"], n["value"])
+        assert n["parity"] is True
+        q3q9 = metric(new, "tpch_sf0.05_q3_engine_rows_per_sec")
+        assert q3q9 is not None and q3q9["parity"] is True
+
     def test_injected_regression_fails_check(self, capsys, tmp_path):
         """A synthetic 2x regression on a matched config must fail
         --check; unmatched configs (NEW/DROPPED) never gate."""

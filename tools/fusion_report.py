@@ -14,9 +14,17 @@ Usage:
         any parity miss or any query where fusion does not reduce launches
 
 ``--check --execute`` is the CI smoke mode: it fails when fused execution
-loses parity with unfused, when no query fused at all, or when TPC-H Q1
+loses parity with unfused, when no query fused at all, when TPC-H Q1
 at the default scale regresses past the partial-agg pre-reduce pin
-(PR 4: fewer than 5 jit dispatches, PR 3's count).
+(PR 4: fewer than 5 jit dispatches, PR 3's count), or when TPC-H Q3
+loses its probe-in-segment lowering (PR 10: the probe stages absorbed
+into fused segments, with the dispatch count pinned below 10).
+
+With ``--execute`` each query also reports the **kernel-tier column**:
+which tier served every group-by/join hot loop (``hash`` =
+device-resident open-addressing, ``direct`` = bounded-domain,
+``sort``/``sorted`` = sorted-index, ``stream`` = clustered,
+``hash+sort`` = the overflow seam crossed mid-query).
 """
 
 import argparse
@@ -139,12 +147,17 @@ def main(argv=None) -> int:
             failures.append((label, "exec"))
             continue
         parity = rows_close(res_on.rows, res_off.rows)
+        tiers = sorted({(s.operator.rsplit(".", 1)[-1], s.kernel_tier)
+                        for s in runner_on._last_task.operator_stats
+                        if s.kernel_tier})
+        tier_col = ", ".join(f"{op}={t}" for op, t in tiers) or "-"
         print(f"  dispatches fused={jit_on['dispatches']} "
               f"unfused={jit_off['dispatches']} "
               f"compiles fused={jit_on['compiles']} "
               f"unfused={jit_off['compiles']} "
               f"prereduce_rows={jit_on.get('prereduce_rows', 0)} "
               f"parity={parity}")
+        print(f"  kernel tiers: {tier_col}")
         if not parity:
             failures.append((label, "parity"))
         if jit_on["dispatches"] > jit_off["dispatches"]:
@@ -156,6 +169,17 @@ def main(argv=None) -> int:
             print(f"  FAIL: Q1 dispatch pin regressed "
                   f"({jit_on['dispatches']} >= 5)")
             failures.append((label, "q1-dispatch-pin"))
+        if (catalog, num) == ("tpch", 3) and args.scale == 0.01:
+            # the PR 10 pin: Q3's probes run IN-SEGMENT (the
+            # filter->project->probe chain is one dispatch per batch)
+            if not any("probe(" in describe(f) for p in pipelines
+                       for f in p.factories):
+                print("  FAIL: Q3 probe-in-segment lowering lost")
+                failures.append((label, "q3-probe-pin"))
+            if jit_on["dispatches"] >= 10:
+                print(f"  FAIL: Q3 dispatch pin regressed "
+                      f"({jit_on['dispatches']} >= 10)")
+                failures.append((label, "q3-dispatch-pin"))
     print(f"total fused segments: {total_segments}; "
           f"failures: {failures or 'none'}")
     if args.check and (failures or total_segments == 0):
