@@ -1033,11 +1033,17 @@ def bench_engine_q3q9(scale: float):
 
 def bench_mesh_q1q6(scale: float):
     """TPC-H Q1 + Q6 through the DISTRIBUTED tier: a real 2-worker
-    cluster (DistributedQueryRunner — coordinator + workers over HTTP,
-    real exchange pages, partial aggregation pre-reduced inside the
-    worker scan segments) vs the single-process engine on the same
-    data.  Closes ROADMAP #10's remaining depth: the artifact now
-    measures the sqlmesh-tier distributed path end to end."""
+    cluster (DistributedQueryRunner — coordinator + workers over HTTP)
+    vs the single-process engine on the same data.  PR 11: the cluster
+    runs with ``mesh_device_exchange`` ON — co-resident fragments lower
+    to ONE SPMD program with in-program collectives instead of
+    serde+HTTP (ROADMAP #2 acceptance: mesh >= 1.0x the LOCAL engine
+    path; PR 10 measured 0.73x on the wire tier).  A second knobs-off
+    cluster keeps measuring the PR 10 HTTP plane so the wire-tier trend
+    stays visible."""
+    import dataclasses as _dc
+
+    from presto_tpu.config import DEFAULT
     from presto_tpu.localrunner import LocalQueryRunner
     from presto_tpu.server.dqr import DistributedQueryRunner
 
@@ -1066,19 +1072,26 @@ def bench_mesh_q1q6(scale: float):
             best = min(best, time.perf_counter() - t0)
         return best, res
 
-    with DistributedQueryRunner.tpch(scale=scale, n_workers=2) as dqr:
-        def timed(sql):
-            dqr.execute(sql)                  # compile + warm caches
-            best = float("inf")
-            res = None
-            for _ in range(2):
-                t0 = time.perf_counter()
-                res = dqr.execute(sql)
-                best = min(best, time.perf_counter() - t0)
-            return best, res
+    def timed_cluster(dqr, sql):
+        dqr.execute(sql)                  # compile + warm caches
+        best = float("inf")
+        res = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            res = dqr.execute(sql)
+            best = min(best, time.perf_counter() - t0)
+        return best, res
 
-        q1_s, q1_res = timed(ENGINE_Q1)
-        q6_s, q6_res = timed(ENGINE_Q6)
+    dev_cfg = _dc.replace(DEFAULT, mesh_device_exchange=True)
+    with DistributedQueryRunner.tpch(scale=scale, n_workers=2,
+                                     config=dev_cfg) as dqr:
+        q1_s, q1_res = timed_cluster(dqr, ENGINE_Q1)
+        q6_s, q6_res = timed_cluster(dqr, ENGINE_Q6)
+        last = list(dqr.coordinator.queries.values())[-1]
+        device_engaged = set(last.exchange_modes) == {"device"}
+    with DistributedQueryRunner.tpch(scale=scale, n_workers=2) as http:
+        h1_s, _h1 = timed_cluster(http, ENGINE_Q1)
+        h6_s, _h6 = timed_cluster(http, ENGINE_Q6)
     q1_local_s, q1_local = timed_local(ENGINE_Q1)
     q6_local_s, q6_local = timed_local(ENGINE_Q6)
     parity = close(q1_res.rows, q1_local.rows) and \
@@ -1086,14 +1099,184 @@ def bench_mesh_q1q6(scale: float):
     return {
         "metric": f"tpch_sf{scale:g}_q1_mesh_2worker_rows_per_sec",
         "value": round(n_rows / q1_s, 1), "unit": "rows/s",
-        # baseline = the single-process engine on the same data: the
-        # ratio prices coordinator/exchange overhead at this scale
+        # baseline = the single-process engine on the same data: >= 1.0
+        # means distribution now buys more than it costs
         "vs_baseline": round(q1_local_s / q1_s, 3),
         "engine_path": True, "distributed": True, "workers": 2,
+        "device_exchange": device_engaged,
         "q6_rows_per_sec": round(n_rows / q6_s, 1),
         "q6_vs_local": round(q6_local_s / q6_s, 3),
+        # the PR 10 wire tier on the same cluster shape (trend line)
+        "http_plane": {
+            "q1_vs_local": round(q1_local_s / h1_s, 3),
+            "q6_vs_local": round(q6_local_s / h6_s, 3),
+        },
         "parity": parity,
     }
+
+
+_SHARDED_JOIN_SQL = (
+    "select o_orderpriority, count(*) as c, sum(l_extendedprice) as s "
+    "from lineitem, orders where l_orderkey = o_orderkey "
+    "group by o_orderpriority order by o_orderpriority")
+
+
+def _sharded_join_model(n_probe: int, n_build: int, ncols: int,
+                        nparts: int, buckets: int):
+    """Modeled per-shard peak bytes of the mesh join, mirroring the
+    capacity formulas in parallel/sqlmesh.py (cap_scale=1): exchange
+    receive buffers (sharded sizing when nparts > 1), the per-shard
+    PagesHash table, the bucket-sequential working buffers, and the
+    match-expansion output.  9 bytes/column-row (8 value + 1 valid),
+    int64 index buffers.  ``nparts=buckets=1`` models the single-device
+    unbucketed build the P8+P9 path exists to break past."""
+    from presto_tpu.batch import next_bucket
+
+    if nparts > 1:
+        pcap = next_bucket(max(8, (2 * n_probe) // nparts))
+        bcap = next_bucket(max(8, (2 * n_build) // nparts))
+    else:
+        pcap = next_bucket(max(8, n_probe))
+        bcap = next_bucket(max(8, n_build))
+    table_cap = next_bucket(2 * bcap, minimum=16)
+    out_cap = next_bucket(max(pcap, bcap))
+    if buckets > 1:
+        wb = min(next_bucket(max(8, (2 * bcap) // buckets)), bcap)
+        wp = min(next_bucket(max(8, (2 * pcap) // buckets)), pcap)
+        we = min(next_bucket(max(8, (2 * max(pcap, bcap)) // buckets)),
+                 out_cap)
+    else:
+        wb, wp, we = bcap, pcap, out_cap
+    col = 9                      # value + valid bytes per row per column
+    idx = 8
+    exchange_bytes = (pcap + bcap) * ncols * col
+    table_bytes = table_cap * (2 * idx + 8 + 1 + 1)  # words+starts+cnt..
+    working_bytes = (wb + wp) * (ncols * col + idx) + we * 3 * idx
+    out_bytes = out_cap * (ncols * col + 2 * idx)
+    return {
+        "probe_cap": pcap, "build_cap": bcap, "table_cap": table_cap,
+        "bucket_caps": [wb, wp, we], "out_cap": out_cap,
+        "total_bytes": exchange_bytes + table_bytes + working_bytes
+        + out_bytes,
+    }
+
+
+def _sharded_join_inner(scale: float):
+    """Runs inside the 8-virtual-device subprocess: the P8+P9
+    acceptance config — lineitem JOIN orders with the build FORCED
+    partitioned (join_distribution_type), the PagesHash build table
+    sharded across 8 shards' HBM, probes routed by the hash-exchange
+    all_to_all, and 8 hash buckets run sequentially through the sharded
+    join."""
+    import dataclasses as _dc
+
+    from presto_tpu.config import DEFAULT
+    from presto_tpu.localrunner import LocalQueryRunner
+    from presto_tpu.parallel.sqlmesh import MeshQueryRunner
+
+    P, B = 8, 8
+    local = LocalQueryRunner.tpch(scale=scale)
+    n_probe = local.execute("select count(*) from lineitem").rows[0][0]
+    n_build = local.execute("select count(*) from orders").rows[0][0]
+    want = local.execute(_SHARDED_JOIN_SQL).rows
+    cfg = _dc.replace(
+        DEFAULT, partitioned_join_build=True, grouped_mesh_execution=B,
+        device_join_probe_max_build_rows=1,
+        join_distribution_type="partitioned")
+    mesh = MeshQueryRunner.tpch(scale=scale, n_devices=P, config=cfg)
+    mesh.execute(_SHARDED_JOIN_SQL)          # trace + compile
+    best = float("inf")
+    res = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        res = mesh.execute(_SHARDED_JOIN_SQL)
+        best = min(best, time.perf_counter() - t0)
+    info = mesh.last_run_info
+
+    def close(a, b):
+        if len(a) != len(b):
+            return False
+        for ra, rb in zip(sorted(a, key=repr), sorted(b, key=repr)):
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float) and isinstance(vb, float):
+                    if not np.isclose(va, vb, rtol=1e-6):
+                        return False
+                elif va != vb:
+                    return False
+        return True
+
+    # HBM overflow model (documented acceptance): capacity formulas
+    # mirror parallel/sqlmesh.py; bytes scale ~linearly with the scale
+    # factor, so dividing a real 16 GiB v5e HBM by the per-SF bytes
+    # gives each path's maximum holdable scale factor.  The run
+    # executes at a budget scaled to SF_CLAIM — a scale factor the
+    # model puts PAST the single-device limit and INSIDE the sharded
+    # one: the single-device build provably overflows it while the
+    # 8-shard x 8-bucket partitioned+grouped path fits.
+    ncols = 3                      # l_orderkey, l_extendedprice, o_* keys
+    single = _sharded_join_model(n_probe, n_build, ncols, 1, 1)
+    sharded = _sharded_join_model(n_probe, n_build, ncols, P, B)
+    hbm = 16 * (1 << 30)
+    sf_max_single = round(hbm / (single["total_bytes"] / scale), 1)
+    sf_max_sharded = round(hbm / (sharded["total_bytes"] / scale), 1)
+    sf_claim = 30.0
+    budget = int(hbm * scale / sf_claim)
+    tiers = info.get("kernel_tiers", [])
+    grouped_pages = sum(1 for t in tiers
+                        if t.startswith("grouped join")
+                        and t.endswith("pages_hash"))
+    return {
+        "metric": f"tpch_sf{scale:g}_sharded_join_rows_per_sec",
+        "value": round(n_probe / best, 1), "unit": "rows/s",
+        "vs_baseline": 1.0,
+        "engine_path": True, "distributed": True,
+        "nparts": P, "buckets": B,
+        "parity": close(res.rows, want),
+        "exchange_modes": info.get("exchange_modes", {}),
+        "grouped_pages_hash_buckets": grouped_pages,
+        "hbm_model": {
+            "note": (f"16 GiB v5e budget scaled to SF{sf_claim:g}: the "
+                     "single-device unbucketed build overflows it, the "
+                     "8-shard x 8-bucket path fits; sf_max_* = largest "
+                     "SF each path holds under a real 16 GiB HBM"),
+            "budget_bytes": budget,
+            "single_device_bytes": single["total_bytes"],
+            "single_device_overflows": single["total_bytes"] > budget,
+            "per_shard_bucketed_bytes": sharded["total_bytes"],
+            "sharded_fits": sharded["total_bytes"] < budget,
+            "sf_max_single_16gib": sf_max_single,
+            "sf_max_sharded_16gib": sf_max_sharded,
+            "single": single, "sharded": sharded,
+        },
+    }
+
+
+def bench_mesh_sharded_join(scale: float):
+    """P8 + P9 acceptance config (ROADMAP #2): the partitioned lookup
+    source (PagesHash build sharded across 8 shards' HBM, probes routed
+    by all_to_all) plus bucket-sequential grouped execution, at a scale
+    factor where the single-device unbucketed build provably overflows
+    the modeled per-device HBM budget (extras carry the model).  Runs
+    in a subprocess so the 8-virtual-device XLA host platform doesn't
+    perturb the other configs' device topology."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--sharded-join-inner", str(scale)],
+        env=env, capture_output=True, text=True, timeout=1200)
+    for ln in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(ln)
+        except ValueError:
+            continue
+    return {"metric": f"bench_mesh_sharded_join_sf{scale:g}_failed",
+            "error": (r.stderr or r.stdout)[-300:]}
 
 
 def _bench_tpcds_mesh(scale: float, spooling: bool):
@@ -1338,7 +1521,7 @@ def _cpu_fallback_line(probe_err: str) -> dict:
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__),
                             "0.05"], env=env, capture_output=True,
-                           text=True, timeout=1200)
+                           text=True, timeout=1800)
         for ln in reversed(r.stdout.strip().splitlines()):
             try:
                 inner = json.loads(ln)
@@ -1357,6 +1540,11 @@ def _cpu_fallback_line(probe_err: str) -> dict:
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--sharded-join-inner":
+        # subprocess entry for bench_mesh_sharded_join (8 virtual
+        # devices forced via XLA_FLAGS by the parent)
+        _emit(_sharded_join_inner(float(sys.argv[2])))
+        return
     q1_scale = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
     budget_s = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET_S", "1500"))
     cpu_only = os.environ.get("PRESTO_TPU_BENCH_CPU_ONLY") == "1"
@@ -1374,6 +1562,7 @@ def main() -> None:
                 (bench_engine_q1q6, 0.05, 0.0),
                 (bench_engine_q3q9, 0.05, 0.0),
                 (bench_mesh_q1q6, 0.05, 0.0),
+                (bench_mesh_sharded_join, 0.2, 0.0),
                 (bench_tpcds_mesh_q72q95, 0.003, 0.0),
                 (bench_tpcds_mesh_q72q95_spooled, 0.003, 0.0),
                 (bench_concurrent_qps, 0.003, 0.0),
@@ -1397,6 +1586,7 @@ def main() -> None:
             (bench_engine_q1q6, 1.0, 0.0),
             (bench_engine_q3q9, 0.2, 0.0),
             (bench_mesh_q1q6, 0.2, 0.0),
+            (bench_mesh_sharded_join, 1.0, 0.0),
             (bench_tpcds_mesh_q72q95, 0.003, 0.0),
             (bench_tpcds_mesh_q72q95_spooled, 0.003, 0.0),
             (bench_concurrent_qps, 0.003, 0.0),

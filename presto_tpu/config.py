@@ -393,6 +393,39 @@ class EngineConfig:
     prereduce_cost_based: bool = True
     # groups/rows ratio above which pre-reduce is skipped
     prereduce_max_group_fraction: float = 0.9
+    # --- collectives as the data plane (parallel/, SURVEY §5.8 / §2.13,
+    # roles P1/P2/P8/P9) -------------------------------------------------
+    # Device-sharded exchange: when every fragment of a query is
+    # co-resident on ONE jax.sharding.Mesh (all placements share a mesh
+    # fingerprint — same process, same device set), the whole fragment
+    # DAG lowers into a single shard_map'ped SPMD program and every
+    # fragment boundary becomes an in-program ICI collective
+    # (all_to_all for 'hash', all_gather for 'broadcast', gather for
+    # 'single') instead of PartitionedOutputOperator -> serde -> HTTP ->
+    # ExchangeOperator.  The HTTP plane stays the cross-slice / elastic
+    # / spool tier and the fallback for unsupported shapes.  OFF
+    # restores the PR 10 task-scheduled lowering exactly.  Off by
+    # default for the same reason whole_query_execution is: the
+    # task-scheduled operator tier remains the reference path (it is
+    # what the retry/spool/speculation/live-stats planes instrument);
+    # the mesh bench configs and the device-exchange parity tests turn
+    # it on per cluster/session.
+    mesh_device_exchange: bool = False
+    # Partitioned lookup source (P8): inside the mesh program, equi-join
+    # build sides use the PR 10 open-addressing PagesHash table built
+    # PER SHARD over the shard's key partition — the global build table
+    # is sharded across device HBM (probes were routed to the owning
+    # shard by the hash-exchange all_to_all), so a build exceeding one
+    # device's HBM is legal.  OFF restores the sorted-index mesh join
+    # exactly.
+    partitioned_join_build: bool = True
+    # Bucket-sequential grouped execution (P9, §5.7): mesh equi-joins
+    # hash-bucket both sides and run the buckets SEQUENTIALLY through
+    # the sharded join, so per-shard peak intermediate memory is ~1/K of
+    # the unbucketed join (SF10-100 builds fit HBM).  Value = bucket
+    # count; 1 = off (the PR 10 single-pass join exactly).  The
+    # capacity-bucket overflow/rerun policy applies per bucket.
+    grouped_mesh_execution: int = 1
 
 
 DEFAULT = EngineConfig()

@@ -1220,3 +1220,30 @@ class FusedSegmentOperatorFactory(OperatorFactory):
                 self.partition_spec[1], list(self.partition_spec[0])))
         tail = (" [" + ", ".join(extra) + "]") if extra else ""
         return "FusedSegment{" + " -> ".join(parts) + "}" + tail
+
+
+def boundary_roles(pipelines) -> List[Tuple[str, str, str]]:
+    """(pipeline name, segment description, role) for every fused
+    segment that touches a fragment boundary on the HTTP exchange tier:
+    'feeds-exchange' when the segment computes the partition ids
+    PartitionedOutput routes by (the producer side of a boundary),
+    'fed-by-exchange' when it coalesces pages arriving from a remote
+    exchange (the consumer side), 'feeds+fed' for both, '' for interior
+    segments.  On the device-sharded exchange tier neither side exists
+    — the boundary collective splices the exchange-feeding and
+    exchange-fed segment programs into ONE trace — so this report names
+    exactly the dispatch/serde work the collective tier removes
+    (tools/exchange_report.py renders it next to the per-boundary
+    exchange-mode column)."""
+    out = []
+    for p in pipelines:
+        for i, f in enumerate(p.factories):
+            if not isinstance(f, FusedSegmentOperatorFactory):
+                continue
+            feeds = f.partition_spec is not None
+            fed = i > 0 and _exchange_adjacent(p.factories[i - 1])
+            role = ("feeds+fed" if feeds and fed
+                    else "feeds-exchange" if feeds
+                    else "fed-by-exchange" if fed else "")
+            out.append((p.name, f.describe(), role))
+    return out

@@ -50,6 +50,13 @@ class GroupedJoinSourceOperatorFactory(OperatorFactory):
     def create(self, ctx: OperatorContext) -> "GroupedJoinSourceOperator":
         return GroupedJoinSourceOperator(ctx, self)
 
+    def reset_for_execution(self) -> None:
+        # forward into every bucket's build/probe factory chains (they
+        # hold the per-bucket lookup rendezvous)
+        for build_fs, _bs, probe_fs, _ps in self.buckets:
+            for f in list(build_fs) + list(probe_fs):
+                f.reset_for_execution()
+
 
 class GroupedJoinSourceOperator(Operator):
     def __init__(self, ctx: OperatorContext,

@@ -409,6 +409,12 @@ class HashBuildOperatorFactory(OperatorFactory):
         for ctx in ctxs:
             ctx.memory.free()
 
+    def reset_for_execution(self) -> None:
+        # a cached physical plan re-runs its build pipeline; the
+        # previous run's lookup source (normally released at probe
+        # finish — this is the backstop for error paths) must not leak
+        self.release()
+
 
 def _ids_from_pairs(jnp, pairs, key_channels, mode, mins, strides, maxs,
                     num_rows):

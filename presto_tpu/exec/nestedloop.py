@@ -62,6 +62,11 @@ class NestedLoopBuildOperatorFactory(OperatorFactory):
     def create(self, ctx: OperatorContext) -> NestedLoopBuildOperator:
         return NestedLoopBuildOperator(ctx, self)
 
+    def reset_for_execution(self) -> None:
+        # the build pipeline re-fills this next run; dropping it now
+        # releases the previous execution's build rows
+        self.data = None
+
 
 class NestedLoopJoinOperator(Operator):
     """Probe side: emits the cartesian product probe x build.  Output

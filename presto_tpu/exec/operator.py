@@ -70,6 +70,14 @@ class OperatorFactory:
     def create(self, ctx: OperatorContext) -> Operator:
         raise NotImplementedError
 
+    def reset_for_execution(self) -> None:
+        """Clear cross-execution factory state so a cached PhysicalPlan
+        can be re-executed (the plan-cache physical-factory sharing
+        path).  Most factories keep all runtime state in the Operators
+        they create and need nothing; factories that rendezvous ACROSS
+        pipelines (output collector, union buffer, build sides) override
+        to re-arm their shared state."""
+
     @property
     def name(self) -> str:
         return type(self).__name__.replace("Factory", "")

@@ -535,6 +535,11 @@ class OutputCollectorFactory(OperatorFactory):
         self.collectors.append(c)
         return c
 
+    def reset_for_execution(self) -> None:
+        # drop the previous execution's collected batches, or rows()
+        # would accumulate across runs of a cached physical plan
+        self.collectors = []
+
     def rows(self) -> List[tuple]:
         out = []
         for c in self.collectors:

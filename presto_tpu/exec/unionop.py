@@ -24,8 +24,17 @@ class UnionBuffer:
     """Shared rendezvous between sink pipelines and the source."""
 
     def __init__(self, n_sinks: int):
+        self.n_sinks = n_sinks
         self.batches: List[Batch] = []
         self.remaining_sinks = n_sinks
+
+    def reset(self) -> None:
+        """Re-arm for another execution of the same plan (cached
+        physical plans): remaining_sinks counted down to 0 last run and
+        must rewind or the source would see an exhausted-or-negative
+        sink count and never finish."""
+        self.batches = []
+        self.remaining_sinks = self.n_sinks
 
 
 class UnionSinkOperator(Operator):
@@ -53,6 +62,11 @@ class UnionSinkOperatorFactory(OperatorFactory):
     def create(self, ctx: OperatorContext) -> UnionSinkOperator:
         return UnionSinkOperator(ctx, self.buffer)
 
+    def reset_for_execution(self) -> None:
+        # idempotent: every sink factory and the source factory share
+        # one buffer; the first reset re-arms it for all of them
+        self.buffer.reset()
+
 
 class UnionSourceOperator(Operator):
     def __init__(self, ctx: OperatorContext, buffer: UnionBuffer):
@@ -79,3 +93,6 @@ class UnionSourceOperatorFactory(OperatorFactory):
 
     def create(self, ctx: OperatorContext) -> UnionSourceOperator:
         return UnionSourceOperator(ctx, self.buffer)
+
+    def reset_for_execution(self) -> None:
+        self.buffer.reset()

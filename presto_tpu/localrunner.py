@@ -184,8 +184,8 @@ class LocalQueryRunner:
                 self.session.schema, self.session.properties)
             hit = plancache.get(key, epochs)
             if hit is not None:
-                optimized, label = hit
-                return self._execute_optimized(optimized, cfg, label)
+                return self._execute_optimized(hit.optimized, cfg,
+                                               hit.label, cache_entry=hit)
             self._plan_cache_key = (key, epochs)
         try:
             stmt = parse_statement(sql)
@@ -798,6 +798,7 @@ class LocalQueryRunner:
         cfg = self.session.effective_config(self.config)
         logical = Planner(self.metadata).plan(q)
         optimized = optimize(logical, self.metadata, cfg)
+        entry = None
         if self._plan_cache_key is not None:
             from presto_tpu.sql import plancache
 
@@ -805,26 +806,50 @@ class LocalQueryRunner:
             self._plan_cache_key = None
             cats = plancache.scan_catalogs(optimized)
             cats.add(self.metadata.default_catalog)
-            plancache.put(key, (optimized, repr(q)), epochs, cats,
+            entry = plancache.CachedLocalPlan(optimized, repr(q))
+            plancache.put(key, entry, epochs, cats,
                           cfg.plan_cache_capacity)
-        return self._execute_optimized(optimized, cfg, repr(q))
+        return self._execute_optimized(optimized, cfg, repr(q),
+                                       cache_entry=entry)
 
-    def _execute_optimized(self, optimized, cfg,
-                           label: str) -> QueryResult:
+    def _execute_optimized(self, optimized, cfg, label: str,
+                           cache_entry=None) -> QueryResult:
         """Run an already-optimized plan (fresh or plan-cache hit);
         access control still runs per execution (the cache key carries
-        no identity)."""
+        no identity).  ``cache_entry`` (plancache.CachedLocalPlan)
+        shares the physical-planner output across executions: the first
+        run fills it, repeats reset-and-reuse the operator factory
+        chains instead of re-running the physical planner per
+        execution."""
         self._check_scans(optimized)
         if cfg.whole_query_execution:
             result = self._try_whole_query(label, optimized)
             if result is not None:
                 return result
-        phys = PhysicalPlanner(self.registry, cfg).plan(optimized)
-        self._last_task = execute_pipelines(
-            phys.pipelines, cfg,
-            memory_limit=cfg.query_max_memory_bytes or None)
-        return QueryResult(phys.column_names, phys.column_types,
-                           phys.collector.rows())
+        entry = cache_entry
+        phys = None
+        if entry is not None and entry.physical is not None \
+                and not entry.in_use:
+            phys = entry.physical
+            entry.in_use = True
+            phys.reset_for_execution()
+        if phys is None:
+            phys = PhysicalPlanner(self.registry, cfg).plan(optimized)
+            if entry is not None and entry.physical is None \
+                    and not entry.in_use:
+                entry.physical = phys
+                entry.in_use = True
+            else:
+                entry = None
+        try:
+            self._last_task = execute_pipelines(
+                phys.pipelines, cfg,
+                memory_limit=cfg.query_max_memory_bytes or None)
+            return QueryResult(phys.column_names, phys.column_types,
+                               phys.collector.rows())
+        finally:
+            if entry is not None:
+                entry.in_use = False
 
     def _try_whole_query(self, label: str,
                          optimized) -> Optional[QueryResult]:

@@ -93,6 +93,30 @@ def repartition(
     return outs, num_out, overflow
 
 
+def route_by_key(
+    arrays: Sequence[jax.Array],
+    live: jax.Array,
+    key_triples: Sequence[Tuple[jax.Array, jax.Array, object]],
+    slot_cap: int,
+    out_cap: int,
+    axis_name: str,
+) -> Tuple[List[jax.Array], jax.Array, jax.Array]:
+    """Hash-route rows to the shard OWNING their key partition (the P8
+    ``PartitionedLookupSource`` probe routing, and the P1 hash exchange):
+    destination = ``partition_of(row_hash(keys))`` — the SAME per-entry
+    value hash the HTTP data plane and partitioned spill use, so every
+    tier (wire pages, spool files, in-program collectives, sharded build
+    tables) agrees on which shard owns a key.  One ``all_to_all`` moves
+    the rows; equal keys land on equal shards, which is what makes a
+    shard-local PagesHash table over the received rows a partition of
+    the GLOBAL build table (sharded across device HBM)."""
+    from presto_tpu.ops.hashing import partition_of, row_hash
+
+    P = jax.lax.axis_size(axis_name)
+    dest = partition_of(row_hash(list(key_triples)), P)
+    return repartition(arrays, live, dest, slot_cap, out_cap, axis_name)
+
+
 def broadcast_rows(
     arrays: Sequence[jax.Array],
     num_rows: jax.Array,

@@ -19,6 +19,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS = "part"
 
 
+def mesh_fingerprint() -> str:
+    """Identity of THIS process's device mesh.  Nodes sharing a
+    fingerprint are co-resident on one ``jax.sharding.Mesh`` (same host,
+    same process, same device set), so fragment boundaries between tasks
+    placed on them can lower to in-program collectives instead of the
+    HTTP exchange (the mesh_device_exchange co-residency test).  Workers
+    announce it; the coordinator compares every placement's fingerprint
+    against its own before choosing the collective tier."""
+    import os
+    import socket
+
+    devs = jax.devices()
+    return (f"{socket.gethostname()}:{os.getpid()}:"
+            f"{devs[0].platform}:{len(devs)}")
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:

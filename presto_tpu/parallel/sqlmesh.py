@@ -149,6 +149,10 @@ class MeshQueryRunner:
         self.nparts = n_devices
         # sql text -> compiled _MeshProgram (trace/compile amortization)
         self._programs: Dict[str, "_MeshProgram"] = {}
+        # observability for the last successful execution: exchange-mode
+        # counters per fragment boundary + kernel-tier markers (the
+        # stats-rollup feed of the device-sharded exchange tier)
+        self.last_run_info: Dict = {}
 
     @classmethod
     def tpch(cls, scale: float = 0.01, n_devices: int = 8,
@@ -216,6 +220,14 @@ class MeshQueryRunner:
         return self._execute_planned(
             key, lambda: self.fragment_plan(optimized))
 
+    def execute_dplan(self, dplan, key: str):
+        """Execute an ALREADY-fragmented plan: the coordinator's
+        device-sharded exchange tier hands its DistributedPlan over, so
+        the collective tier and the HTTP tier run the IDENTICAL fragment
+        DAG — only the boundary transport differs (in-program collective
+        vs PartitionedOutput -> wire pages -> ExchangeOperator)."""
+        return self._execute_planned(key, lambda: dplan)
+
     def _execute_planned(self, sql: str, make_dplan):
         from presto_tpu.localrunner import QueryResult
 
@@ -227,6 +239,7 @@ class MeshQueryRunner:
             batch, overflowed = cached.run()
             if not overflowed:
                 dplan = cached.dplan
+                self.last_run_info = cached.run_info()
                 return QueryResult(dplan.column_names, dplan.column_types,
                                    batch.to_pylist())
             del self._programs[sql]
@@ -242,6 +255,7 @@ class MeshQueryRunner:
             if not overflowed:
                 if prog.cacheable:
                     self._programs[sql] = prog
+                self.last_run_info = prog.run_info()
                 return QueryResult(dplan.column_names, dplan.column_types,
                                    batch.to_pylist())
             last_err = f"overflow at cap_scale={1 << attempt}"
@@ -266,6 +280,11 @@ class _MeshProgram:
         self.config = runner.config
         self._jitted = None
         self._args = None
+        # trace-time observability, kept across cached re-runs: one
+        # (fragment id, collective kind) entry per fragment boundary and
+        # one (operator label, tier) marker per hot-loop lowering
+        self.exchange_log: List[Tuple[int, str]] = []
+        self.kernel_tiers: List[Tuple[str, str]] = []
         # a retry shares the prepared scans, so it must inherit their
         # mutability verdict (scan prep is the only place it is learned)
         self.cacheable = prepared.cacheable if prepared is not None \
@@ -367,6 +386,8 @@ class _MeshProgram:
             self._cache: Dict[int, MTable] = {}
             self._overflow: List[object] = []
             self._errors: List[object] = []
+            self.exchange_log = []
+            self.kernel_tiers = []
             table = self._lower_fragment(self.dplan.root_fragment_id)
             self._out_meta = [(c.type, c.dictionary) for c in table.cols]
             outs = []
@@ -472,6 +493,23 @@ class _MeshProgram:
                 host[i] = mat[row]
         return host
 
+    def run_info(self) -> Dict:
+        """Exchange-mode + kernel-tier counters for the stats rollup
+        (recorded at trace time; cached re-runs report the same values
+        because the compiled program IS the same lowering)."""
+        modes: Dict[str, int] = {}
+        for _fid, kind in self.exchange_log:
+            modes[kind] = modes.get(kind, 0) + 1
+        return {
+            "exchange_modes": modes,
+            "boundaries": [{"fragment": fid, "kind": kind}
+                           for fid, kind in self.exchange_log],
+            "kernel_tiers": [f"{label}:{tier}"
+                             for label, tier in self.kernel_tiers],
+            "nparts": self.nparts,
+            "cap_scale": self.cap_scale,
+        }
+
     # ---------------- traced lowering ----------------
     def _lower_fragment(self, fid: int) -> MTable:
         if fid in self._cache:
@@ -495,8 +533,9 @@ class _MeshProgram:
         to a gather; multi-task consumers see the producer's routing."""
         import jax.numpy as jnp
 
-        from presto_tpu.ops.hashing import partition_of, row_hash
-        from presto_tpu.parallel.exchange import broadcast_rows, repartition
+        from presto_tpu.parallel.exchange import (
+            broadcast_rows, repartition, route_by_key,
+        )
         from presto_tpu.parallel.mesh import AXIS
 
         import jax
@@ -512,14 +551,28 @@ class _MeshProgram:
         if table.replicated:
             if kind in ("broadcast", "single"):
                 # already the identical union on every shard — a gather
-                # here would multiply rows by the shard count
+                # here would multiply rows by the shard count (the
+                # boundary still counts: it lowered to an identity)
+                self.exchange_log.append((fid, kind))
                 return table
             # hash-split of a replicated table: only ONE copy may enter
             # the exchange, so mask all but shard 0's
             on_first = jax.lax.axis_index(AXIS) == 0
             table = MTable(table.cols, table.live & on_first, table.cap,
                            table.est, compacted=False)
-        out_cap = next_bucket(table.est, minimum=8)
+        if kind in ("hash", "arbitrary") \
+                and self.config.partitioned_join_build and self.nparts > 1:
+            # P8 sharded sizing: a key-routed receive buffer holds this
+            # shard's PARTITION of the rows, not the worst-case total —
+            # 2x the even share for skew head room, cap_scale doubling
+            # on overflow retry.  This is what makes per-shard state
+            # (and the build table sized from it) scale with 1/P, so a
+            # build exceeding one device's HBM becomes legal.  Knob off
+            # restores the PR 10 worst-case-total sizing exactly.
+            out_cap = next_bucket(
+                max(8, (2 * self.cap_scale * table.est) // self.nparts))
+        else:
+            out_cap = next_bucket(table.est, minimum=8)
 
         def col_arrays(t: MTable):
             out = []
@@ -534,17 +587,20 @@ class _MeshProgram:
             if kind == "hash":
                 triples = [self._hash_triple(table.cols[ch])
                            for ch in channels]
-                dest = partition_of(row_hash(triples), self.nparts)
+                recv, n_recv, of = route_by_key(
+                    arrays, table.live, triples,
+                    slot_cap=min(table.cap, out_cap), out_cap=out_cap,
+                    axis_name=AXIS)
             else:
                 # P3 round-robin: rotate rows across shards for balance
                 # (no key semantics downstream)
                 dest = ((jnp.arange(table.cap)
                          + jax.lax.axis_index(AXIS))
                         % self.nparts).astype(jnp.int32)
-            recv, n_recv, of = repartition(
-                arrays, table.live, dest,
-                slot_cap=min(table.cap, out_cap), out_cap=out_cap,
-                axis_name=AXIS)
+                recv, n_recv, of = repartition(
+                    arrays, table.live, dest,
+                    slot_cap=min(table.cap, out_cap), out_cap=out_cap,
+                    axis_name=AXIS)
         elif kind in ("broadcast", "single"):
             ct = _compact(table)
             recv, n_recv, of = broadcast_rows(col_arrays(ct), ct.num_rows,
@@ -552,6 +608,7 @@ class _MeshProgram:
         else:
             raise MeshUnsupported(f"output partitioning {kind}")
         self._overflow.append((f'exchange f{fid} {kind}', of))
+        self.exchange_log.append((fid, kind))
         cols = []
         for i, c in enumerate(table.cols):
             cols.append(MCol(recv[2 * i], recv[2 * i + 1], c.type,
@@ -819,8 +876,10 @@ class _MeshProgram:
             key_cols = [src.cols[c] for c in node.group_channels]
             direct = self._try_direct_agg(src, key_cols, aggs)
             if direct is not None:
+                self.kernel_tiers.append(('groupby', 'direct'))
                 out_cols, results, live, cap, est = direct
             else:
+                self.kernel_tiers.append(('groupby', 'sort'))
                 key_triples = [(c.values, c.valid, c.type) for c in key_cols]
                 group_cap = src.cap
                 gi, ng, results = grouped_aggregate(
@@ -936,6 +995,137 @@ class _MeshProgram:
             triples_b.append((vb, gb, cb.type))
         return triples_a, triples_b
 
+    def _probe_ranges(self, btrip, ptrip, bcap: int, pcap: int,
+                      single: bool, use_pages: bool, label: str):
+        """(lo, counts, perm) match ranges per probe row — the shared
+        ``(lo, counts)`` contract of ops/join.py, produced by one of the
+        three lookup tiers:
+
+        - ``pages_hash`` (P8, ``partitioned_join_build``): the PR 10
+          open-addressing table over the shard's key partition — the
+          ``PartitionedLookupSource`` role, no total order and no
+          key-span limit; a too-full table raises the overflow flag and
+          the host re-runs at the next capacity bucket;
+        - ``single``: dense ids for one packed integer word;
+        - ``sorted``: canonical union-sort ids + binary search.
+        """
+        from presto_tpu.ops import join as J
+
+        if use_pages:
+            from presto_tpu.ops import hashtable as H
+
+            table_cap = next_bucket(2 * self.cap_scale * bcap, minimum=16)
+            (words, prefix, used, starts, cnt_t, perm, _has_null,
+             ok) = H.pages_hash_build(list(btrip), bcap, table_cap)
+            self._overflow.append((f'{label} build table', ~ok))
+            lo, counts, _plive = H.pages_hash_probe(
+                (words, prefix, used, starts, cnt_t), list(ptrip), pcap)
+            self.kernel_tiers.append((label, 'pages_hash'))
+            return lo, counts, perm
+        if single:
+            # a >=2^62 key spread would overflow the dense-id
+            # arithmetic; flagging it as overflow makes the runner fail
+            # over to the operator tier's canonical path
+            self._overflow.append((
+                f'{label} key span',
+                J.single_word_span_too_big(btrip[0], bcap)))
+            bids, pids = J.single_word_ids(btrip[0], ptrip[0], bcap, pcap)
+            tier = 'single'
+        else:
+            bids, pids = J.canonical_ids(btrip, ptrip, bcap, pcap)
+            tier = 'sorted'
+        sorted_b, perm_b = J.build_index(bids)
+        lo, counts = J.probe_counts(sorted_b, perm_b, pids)
+        self.kernel_tiers.append((label, tier))
+        return lo, counts, perm_b
+
+    def _grouped_expand(self, node: JoinNode, left: MTable, right: MTable,
+                        btrip, ptrip, single: bool, use_pages: bool,
+                        out_cap: int, B: int):
+        """Bucket-sequential grouped execution (P9, §5.7): hash-bucket
+        both sides on the join key and run the buckets SEQUENTIALLY
+        through the shard-local join, so the per-shard peak intermediate
+        (ids / build table / expansion buffers) is ~1/B of the
+        single-pass join and SF10-100 builds fit HBM.  Every row belongs
+        to exactly one bucket (equal keys co-bucket), so inner and left
+        joins emit exactly their single-pass rows; the capacity-bucket
+        overflow/rerun policy applies PER BUCKET — a skewed bucket
+        raises its flag and the host re-runs at the next cap_scale."""
+        import jax.numpy as jnp
+
+        from presto_tpu.ops import join as J
+        from presto_tpu.ops.hashing import row_hash
+        from presto_tpu.ops.radix import stable_partition_perm
+
+        def bucket_of(triples):
+            # a DIFFERENT mix of the key hash than the exchange
+            # partition: after a hash exchange every row on this shard
+            # has hash % nparts == shard_index, so h % B (both powers
+            # of two) would leave most buckets empty
+            h = row_hash(list(triples))
+            h = ((h ^ jnp.uint64(0x94D049BB133111EB))
+                 * jnp.uint64(0x2545F4914F6CDD1D))
+            h = h ^ (h >> jnp.uint64(29))
+            return (h % jnp.uint64(B)).astype(jnp.int32)
+
+        bb = bucket_of(btrip)
+        pb = bucket_of(ptrip)
+        # per-bucket working capacities: ~2x the even share (skew head
+        # room), clamped to the side capacity — a bucket can never hold
+        # more rows than its side, and the clamp keeps gathered shapes
+        # consistent when B approaches the side capacity
+        bcap = min(next_bucket(
+            max(8, (2 * self.cap_scale * right.cap) // B)), right.cap)
+        pcap = min(next_bucket(
+            max(8, (2 * self.cap_scale * left.cap) // B)), left.cap)
+        ecap = min(next_bucket(
+            max(8, (2 * self.cap_scale * max(left.cap, right.cap)) // B)),
+            out_cap)
+        probe_idx = jnp.zeros(out_cap, jnp.int64)
+        build_idx = jnp.zeros(out_cap, jnp.int64)
+        unmatched = jnp.zeros(out_cap, bool)
+        offset = jnp.zeros((), jnp.int64)
+        side_overflow = jnp.zeros((), bool)
+        expand_overflow = jnp.zeros((), bool)
+        for b in range(B):
+            mb = right.live & (bb == b)
+            mp = left.live & (pb == b)
+            ob = stable_partition_perm(~mb)[:bcap].astype(jnp.int32)
+            op = stable_partition_perm(~mp)[:pcap].astype(jnp.int32)
+            nb = mb.sum()
+            np_ = mp.sum()
+            side_overflow = side_overflow | (nb > bcap) | (np_ > pcap)
+            in_b = jnp.arange(bcap) < nb
+            in_p = jnp.arange(pcap) < np_
+            btr = [(v[ob], g[ob] & in_b, t) for v, g, t in btrip]
+            ptr = [(v[op], g[op] & in_p, t) for v, g, t in ptrip]
+            lo, counts, perm = self._probe_ranges(
+                btr, ptr, bcap, pcap, single, use_pages,
+                label=f'grouped join b{b}')
+            if node.kind == "left":
+                pi, bi, rv, um, total = J.expand_matches_outer(
+                    lo, counts, in_p, perm, ecap)
+            else:
+                pi, bi, rv, um, total = J.expand_matches(
+                    lo, counts, perm, ecap)
+            expand_overflow = expand_overflow | (total > ecap)
+            # translate bucket-local rows back to shard rows and append
+            # this bucket's compacted prefix at the running offset
+            dst = jnp.where(rv, offset + jnp.arange(ecap), out_cap)
+            probe_idx = probe_idx.at[dst].set(
+                op[jnp.clip(pi, 0, pcap - 1)].astype(jnp.int64),
+                mode="drop")
+            build_idx = build_idx.at[dst].set(
+                ob[jnp.clip(bi, 0, bcap - 1)].astype(jnp.int64),
+                mode="drop")
+            unmatched = unmatched.at[dst].set(um, mode="drop")
+            offset = offset + jnp.minimum(total, ecap)
+        self._overflow.append(('grouped join side bucket', side_overflow))
+        self._overflow.append(('grouped join expand', expand_overflow))
+        self._overflow.append(('grouped join total', offset > out_cap))
+        row_valid = jnp.arange(out_cap) < offset
+        return probe_idx, build_idx, row_valid, unmatched
+
     def _lower_join(self, node: JoinNode) -> MTable:
         import jax.numpy as jnp
 
@@ -955,19 +1145,19 @@ class _MeshProgram:
         single = (len(btrip) == 1 and J.single_word_joinable(
             btrip[0][2],
             right.cols[node.right_keys[0]].dictionary is not None))
-        if single:
-            # a >=2^62 key spread would overflow the dense-id arithmetic;
-            # flagging it as overflow makes the runner fail over to the
-            # operator tier's canonical path
-            self._overflow.append((
-                'join key span',
-                J.single_word_span_too_big(btrip[0], right.cap)))
-            bids, pids = J.single_word_ids(btrip[0], ptrip[0],
-                                           right.cap, left.cap)
-        else:
-            bids, pids = J.canonical_ids(btrip, ptrip, right.cap, left.cap)
-        sorted_b, perm_b = J.build_index(bids)
-        lo, counts = J.probe_counts(sorted_b, perm_b, pids)
+        # Partitioned lookup source (P8): the PR 10 open-addressing
+        # PagesHash table built per shard over the shard's slice of the
+        # build — together the shard tables ARE the global build table
+        # sharded across device HBM (probe rows were routed to the
+        # owning shard by the hash-exchange all_to_all).  Canonical
+        # multi-word keys always take it (equality needs no total
+        # order, so the union-sort disappears); packable single-word
+        # keys keep the dense-id tier unless the build is large (the
+        # hash table has no key-span limit, so big spreads stop failing
+        # over to the operator tier).
+        use_pages = self.config.partitioned_join_build and (
+            not single
+            or right.est > self.config.device_join_probe_max_build_rows)
         # Per-shard match capacity: FK-shaped joins emit ~probe-count rows,
         # so the base bucket is max(cap) and cap_scale doubles on overflow
         # retry.  A fixed expansion multiplier would COMPOUND down a join
@@ -975,14 +1165,23 @@ class _MeshProgram:
         # query actually expands.
         out_cap = next_bucket(
             self.cap_scale * max(left.cap, right.cap), minimum=8)
-        if node.kind == "left":
-            probe_idx, build_idx, row_valid, unmatched, total = \
-                J.expand_matches_outer(lo, counts, left.live, perm_b,
-                                       out_cap)
+        B = max(1, int(self.config.grouped_mesh_execution))
+        if B > 1:
+            probe_idx, build_idx, row_valid, unmatched = \
+                self._grouped_expand(node, left, right, btrip, ptrip,
+                                     single, use_pages, out_cap, B)
         else:
-            probe_idx, build_idx, row_valid, unmatched, total = \
-                J.expand_matches(lo, counts, perm_b, out_cap)
-        self._overflow.append(('join', total > out_cap))
+            lo, counts, perm_b = self._probe_ranges(
+                btrip, ptrip, right.cap, left.cap, single, use_pages,
+                label='join')
+            if node.kind == "left":
+                probe_idx, build_idx, row_valid, unmatched, total = \
+                    J.expand_matches_outer(lo, counts, left.live, perm_b,
+                                           out_cap)
+            else:
+                probe_idx, build_idx, row_valid, unmatched, total = \
+                    J.expand_matches(lo, counts, perm_b, out_cap)
+            self._overflow.append(('join', total > out_cap))
         cols: List[MCol] = []
         for c in left.cols:
             valid = None if c.valid is None else c.valid[probe_idx]

@@ -57,6 +57,9 @@ class NodeManager:
         self.missed: Dict[str, int] = {}
         self.states: Dict[str, str] = {}      # node_id -> reported state
         self.locations: Dict[str, str] = {}   # node_id -> topology label
+        # node_id -> announced device-mesh identity (None when a node
+        # predates the field); the mesh_device_exchange co-residency test
+        self.mesh_fps: Dict[str, Optional[str]] = {}
         self.max_missed = max_missed
         self.interval_s = interval_s
         self._lock = threading.Lock()
@@ -66,12 +69,28 @@ class NodeManager:
         self._thread.start()
 
     def announce(self, node_id: str, uri: str,
-                 location: str = "") -> None:
+                 location: str = "",
+                 mesh_fingerprint: Optional[str] = None) -> None:
         with self._lock:
             self.nodes[node_id] = uri
             self.missed[node_id] = 0
             if location:
                 self.locations[node_id] = location
+            self.mesh_fps[node_id] = mesh_fingerprint
+
+    def common_mesh_fingerprint(self) -> Optional[str]:
+        """The ONE fingerprint every schedulable node announced, or None
+        when nodes span meshes / predate the field — the co-residency
+        gate of the device-sharded exchange tier (a mixed cluster keeps
+        the HTTP plane, which works across any topology)."""
+        nodes = self.alive_nodes()
+        if not nodes:
+            return None
+        with self._lock:
+            fps = {self.mesh_fps.get(nid) for nid, _uri in nodes}
+        if len(fps) == 1:
+            return fps.pop()
+        return None
 
     def topology_ordered(self, nodes: List[Tuple[str, str]]
                          ) -> List[Tuple[str, str]]:
@@ -280,6 +299,13 @@ class QueryExecution:
         # real remote task info; query_stats is the whole-query rollup
         self.stage_stats: Dict[int, Dict] = {}
         self.query_stats: Dict = {}
+        # exchange-mode counters: per fragment boundary, the transport
+        # that served it — 'device' (in-program collective), 'http'
+        # (wire pages, possibly spool-backed).  Folded into query_stats
+        # and the /v1/query detail; the device tier also records its
+        # kernel tiers + fallback reason here
+        self.exchange_modes: Dict[str, int] = {}
+        self.device_exchange_info: Dict = {}
         # fragment id -> [TaskStats dict] (span timeline for the
         # query_profile tool) and raw task infos (EXPLAIN ANALYZE)
         self.task_stats: Dict[int, List[Dict]] = {}
@@ -393,6 +419,11 @@ class QueryExecution:
         freshly-planned and plan-cache-hit paths)."""
         self.column_names = dplan.column_names
         self.column_types = dplan.column_types
+        if not analyze and self._try_device_exchange(dplan):
+            # the whole fragment DAG ran as ONE SPMD program; no tasks,
+            # no wire pages (EXPLAIN ANALYZE keeps the task plane: its
+            # contract is the per-task operator-stats rollup)
+            return
         self.state = "SCHEDULING"
         with self._mark("schedule"):
             root_locations = self._schedule(dplan)
@@ -406,6 +437,87 @@ class QueryExecution:
             self.column_names = ["Query Plan"]
             self.column_types = [T.VARCHAR]
             self.result_rows = [(line,) for line in text.splitlines()]
+
+    def _try_device_exchange(self, dplan: DistributedPlan) -> bool:
+        """Collectives as the data plane (mesh_device_exchange): when
+        every schedulable worker AND this coordinator share one device
+        mesh (mesh fingerprints equal — same process/device set) and
+        every fragment boundary is device-eligible, the whole fragment
+        DAG lowers into one shard_map'ped SPMD program: 'hash'
+        boundaries become all_to_all, 'broadcast' all_gather, 'single'
+        a gather — no PartitionedOutput, no serde, no HTTP pull.  Any
+        miss (mixed mesh, unsupported shape, runtime capacity
+        non-convergence) falls back to the task-scheduled HTTP plane,
+        which stays the elastic / fault-tolerant / cross-slice tier.
+        Returns True when the query was fully answered here."""
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        n_bound = sum(len(f.consumed_fragments) for f in dplan.fragments)
+        if not cfg.mesh_device_exchange:
+            return False
+        import jax
+
+        from presto_tpu.parallel.mesh import mesh_fingerprint
+        from presto_tpu.parallel.sqlmesh import MeshUnsupported
+        from presto_tpu.server.fragmenter import annotate_device_exchange
+
+        def fallback(reason: str) -> bool:
+            self.exchange_modes = {"http": n_bound}
+            self.device_exchange_info = {"fallback": reason[:200]}
+            return False
+
+        workers = self.co.nodes.alive_nodes()
+        shared_fp = self.co.nodes.common_mesh_fingerprint()
+        if not workers or shared_fp is None \
+                or shared_fp != mesh_fingerprint():
+            return fallback("placements not co-resident on one mesh")
+        try:
+            if not annotate_device_exchange(dplan):
+                return fallback("boundary outside the collective subset")
+        except Exception as e:  # noqa: BLE001 - annotation is advisory
+            return fallback(f"annotation failed: {e}")
+        nparts = max(1, min(len(workers), len(jax.devices())))
+        key = (f"{self.catalog}|{self._plan_key_sql or self.sql}")
+        self.state = "RUNNING"
+        try:
+            with self._mark("execute"):
+                with self.co.mesh_executor_lock:
+                    runner = self.co.mesh_executor(cfg, nparts)
+                    result = runner.execute_dplan(dplan, key)
+                    info = dict(runner.last_run_info)
+        except (MeshUnsupported, NotImplementedError) as e:
+            return fallback(f"mesh: {e}")
+        except ValueError:
+            # query-semantic errors surfaced during mesh execution
+            # ("scalar subquery returned more than one row") are the
+            # user's answer, not a lowering failure
+            raise
+        except Exception as e:  # noqa: BLE001 - HTTP tier can still run
+            self.co.log(f"device-exchange execution failed "
+                        f"({type(e).__name__}: {e}); falling back to the "
+                        f"task-scheduled plane")
+            return fallback(f"{type(e).__name__}: {e}")
+        self.result_rows = [tuple(r) for r in result.rows]
+        boundaries = info.get("boundaries", [])
+        self.exchange_modes = {"device": len(boundaries) or n_bound}
+        self.device_exchange_info = {
+            "nparts": info.get("nparts"),
+            "boundaries": boundaries,
+            "kernel_tiers": info.get("kernel_tiers", []),
+            "cap_scale": info.get("cap_scale", 1),
+        }
+        with self._stats_lock:
+            self.query_stats = {
+                "query_id": self.query_id,
+                "elapsed_s": round(ev.now() - self.create_time, 6),
+                "queued_s": round(self.queued_s, 6),
+                "execution_s": round(
+                    ev.now() - self.admit_time
+                    if self.admit_time is not None else 0.0, 6),
+                "output_rows": len(self.result_rows),
+                "exchange_modes": dict(self.exchange_modes),
+                "device_exchange": self.device_exchange_info,
+            }
+        return True
 
     def _lookup_plan_cache(self, key_sql: str):
         """Plan-cache probe (sql/plancache.py): a hit returns
@@ -683,7 +795,10 @@ class QueryExecution:
         qs.execution_s = round(
             ev.now() - self.admit_time if self.admit_time is not None
             else qs.elapsed_s, 6)
-        return stage_stats, task_stats, qs.as_dict()
+        qs_dict = qs.as_dict()
+        if self.exchange_modes:
+            qs_dict["exchange_modes"] = dict(self.exchange_modes)
+        return stage_stats, task_stats, qs_dict
 
     def _collect_stats(self) -> None:
         """Fetch every placement's task info ONCE and roll it up:
@@ -1029,6 +1144,11 @@ class QueryExecution:
     def _schedule(self, dplan: DistributedPlan) -> List[str]:
         workers = self._wait_for_workers()
         n_workers = len(workers)
+        if not self.exchange_modes:
+            # every boundary of a task-scheduled plan rides the HTTP
+            # data plane (spool-backed when spooling is on)
+            self.exchange_modes = {"http": sum(
+                len(f.consumed_fragments) for f in dplan.fragments)}
         counts = {f.fragment_id: self._task_count(f, n_workers)
                   for f in dplan.fragments}
         consumers: Dict[int, int] = {}  # producer fid -> consumer fid
@@ -2819,6 +2939,15 @@ class CoordinatorServer:
         from presto_tpu.server.dispatcher import DispatchManager
 
         self.dispatcher = DispatchManager(self)
+        # device-sharded exchange executors (mesh_device_exchange): one
+        # MeshQueryRunner per (shard count, lowering-knob fingerprint),
+        # shared across queries so compiled SPMD programs amortize like
+        # the plan cache amortizes plans.  The lock serializes runs: the
+        # runner's per-run counters (last_run_info) are read back under
+        # it, and concurrent collective programs on one device set gain
+        # nothing anyway.
+        self._mesh_executors: Dict[Tuple, object] = {}
+        self.mesh_executor_lock = threading.Lock()
         self.grants = GrantStore()
         self.authenticator = authenticator
         self.internal_auth = (InternalAuthenticator(internal_secret)
@@ -2942,7 +3071,8 @@ class CoordinatorServer:
                     n = int(self.headers.get("Content-Length", 0))
                     ann = json.loads(self.rfile.read(n))
                     co.nodes.announce(ann["nodeId"], ann["uri"],
-                                      ann.get("location", ""))
+                                      ann.get("location", ""),
+                                      ann.get("meshFingerprint"))
                     self._json(200, {"ok": True})
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
@@ -3146,6 +3276,11 @@ class CoordinatorServer:
                         "taskStats": {str(fid): ts for fid, ts
                                       in q.task_stats.items()},
                         "queryStats": q.query_stats,
+                        # device-sharded exchange tier: per-boundary
+                        # transport counters + collective-tier detail
+                        # (or the fallback reason)
+                        "exchangeModes": dict(q.exchange_modes),
+                        "deviceExchange": dict(q.device_exchange_info),
                         # live progress + time-series depth (the web UI
                         # detail page shows mid-query movement)
                         "progress": dict(q._progress),
@@ -3159,6 +3294,22 @@ class CoordinatorServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="coordinator-http")
         self._thread.start()
+
+    def mesh_executor(self, cfg, nparts: int):
+        """The shared mesh runner for one (shard count, lowering knobs)
+        shape.  Callers hold ``mesh_executor_lock`` around execute +
+        last_run_info readback."""
+        from presto_tpu.parallel.sqlmesh import MeshQueryRunner
+
+        key = (nparts, cfg.partitioned_join_build,
+               cfg.grouped_mesh_execution, cfg.direct_groupby_max_domain,
+               cfg.device_join_probe_max_build_rows)
+        runner = self._mesh_executors.get(key)
+        if runner is None:
+            runner = MeshQueryRunner(self.registry, self.default_catalog,
+                                     n_devices=nparts, config=cfg)
+            self._mesh_executors[key] = runner
+        return runner
 
     def _memory_loop(self, interval_s: float = 0.5) -> None:
         """Poll worker MemoryInfo; when the cluster total exceeds the

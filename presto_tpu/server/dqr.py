@@ -146,7 +146,9 @@ class DistributedQueryRunner:
 
         body = json.dumps({"nodeId": worker.node_id,
                            "uri": worker.uri,
-                           "location": worker.location}).encode()
+                           "location": worker.location,
+                           "meshFingerprint":
+                               worker.mesh_fingerprint}).encode()
         headers = {"Content-Type": "application/json"}
         if self.internal_secret:
             from presto_tpu.server.security import InternalAuthenticator

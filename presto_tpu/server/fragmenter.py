@@ -55,6 +55,13 @@ class PlanFragment:
     # subtree whole-stage retry re-creates when a non-leaf task of this
     # fragment is lost (the Presto-on-Spark re-run unit)
     producer_subtree: Tuple[int, ...] = ()
+    # device-sharded exchange annotation (mesh_device_exchange): can the
+    # boundary this fragment's output crosses lower to an in-program
+    # collective (all_to_all / all_gather / gather) when producer and
+    # consumer are co-resident on one device mesh?  None = not yet
+    # computed (annotate_device_exchange fills it); False boundaries
+    # keep the HTTP plane even on a co-resident mesh.
+    device_exchange_eligible: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -378,6 +385,59 @@ class Fragmenter:
         ffid = self._source_fragment(filt, fc, ("broadcast", ()))
         remote_f = RemoteSourceNode((ffid,), tuple(node.filtering.columns))
         return _replace_sources(node, [src, remote_f]), sc + [ffid]
+
+
+def annotate_device_exchange(dplan: "DistributedPlan") -> bool:
+    """Per-boundary device-exchange eligibility (the mesh_device_exchange
+    planning half): a fragment's output boundary can lower to an
+    in-program collective when its subtree is inside the mesh tier's
+    supported subset (parallel/sqlmesh._check_supported) AND its output
+    partitioning has a collective lowering ('hash' -> all_to_all,
+    'broadcast'/'single' -> all_gather/gather, 'arbitrary' -> rotated
+    all_to_all).  Scans of coordinator-local-only connectors (the
+    system catalog: live data exists only on the node serving it) are
+    never eligible.  Returns True when EVERY boundary qualifies — the
+    whole fragment DAG can then run as one SPMD program; any False
+    keeps the query on the HTTP plane (per-boundary mixing would leave
+    device arrays with no wire to cross).  Idempotent; annotations are
+    cached on the fragments (plan-cache hits keep them)."""
+    from presto_tpu.parallel.sqlmesh import MeshUnsupported, _check_supported
+
+    if dplan.fragments and dplan.fragments[0].device_exchange_eligible \
+            is not None:
+        return all(f.device_exchange_eligible for f in dplan.fragments)
+    ok_all = True
+    for f in dplan.fragments:
+        ok = f.output_partitioning[0] in ("hash", "broadcast", "single",
+                                          "arbitrary")
+        if ok:
+            try:
+                _check_supported(f.root)
+            except (MeshUnsupported, NotImplementedError):
+                ok = False
+        if ok and any(s.catalog == "system"
+                      for s in _scans(f.root)):
+            ok = False
+        if ok and _has_writer(f.root):
+            # DML fragments commit through worker-side TableWriter
+            # tasks; the collective tier is a query-only fast path
+            ok = False
+        f.device_exchange_eligible = ok
+        ok_all = ok_all and ok
+    return ok_all
+
+
+def _scans(node: PlanNode):
+    if isinstance(node, TableScanNode):
+        yield node
+    for s in node.sources:
+        yield from _scans(s)
+
+
+def _has_writer(node: PlanNode) -> bool:
+    if isinstance(node, (TableWriterNode, TableFinishNode)):
+        return True
+    return any(_has_writer(s) for s in node.sources)
 
 
 def _has_scan(node: PlanNode) -> bool:

@@ -43,6 +43,13 @@ class WorkerServer:
         # topology label (rack/zone) announced to the
         # coordinator for TopologyAwareNodeSelector placement
         self.location = location
+        # device-mesh identity announced to the coordinator: placements
+        # sharing a fingerprint (and the coordinator's own) are
+        # co-resident on one jax mesh, enabling the device-sharded
+        # exchange tier (mesh_device_exchange)
+        from presto_tpu.parallel.mesh import mesh_fingerprint
+
+        self.mesh_fingerprint = mesh_fingerprint()
         self.internal_auth = (InternalAuthenticator(internal_secret)
                               if internal_secret else None)
         # chaos substrate hook (server/faults.py): consulted before every

@@ -121,6 +121,13 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
         lambda v: v.lower() in ("true", "1", "on")),
     "prereduce_max_group_fraction": (
         "prereduce_max_group_fraction", float),
+    "mesh_device_exchange": (
+        "mesh_device_exchange",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "partitioned_join_build": (
+        "partitioned_join_build",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "grouped_mesh_execution": ("grouped_mesh_execution", int),
     "stats_sampling_enabled": (
         "stats_sampling_enabled",
         lambda v: v.lower() in ("true", "1", "on")),

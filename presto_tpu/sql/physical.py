@@ -54,12 +54,28 @@ from presto_tpu.sql.plan import (
 )
 
 
+# process-wide count of physical plans built (PhysicalPlanner.plan
+# calls) — the plan-cache physical-factory sharing pin: the SECOND
+# execution of a cached statement must not bump it
+PLANS_BUILT = 0
+
+
 @dataclasses.dataclass
 class PhysicalPlan:
     pipelines: List[Pipeline]
     collector: OutputCollectorFactory
     column_names: List[str]
     column_types: List[T.Type]
+
+    def reset_for_execution(self) -> None:
+        """Re-arm every factory's cross-execution state (collector
+        batches, union buffers, build rendezvous) so the SAME operator
+        factory chains execute again — what lets the plan cache share
+        the physical-planner output across repeat statements instead of
+        re-planning per execution."""
+        for p in self.pipelines:
+            for f in p.factories:
+                f.reset_for_execution()
 
 
 class PhysicalPlanner:
@@ -98,6 +114,8 @@ class PhysicalPlanner:
         self._counter = 0
 
     def plan(self, root: OutputNode) -> PhysicalPlan:
+        global PLANS_BUILT
+        PLANS_BUILT += 1
         factories, splits = self._lower(root.source)
         collector = OutputCollectorFactory()
         factories.append(collector)

@@ -145,6 +145,22 @@ class _Entry:
     epoch_snapshot: Dict[str, int]
 
 
+@dataclasses.dataclass
+class CachedLocalPlan:
+    """LocalQueryRunner cache entry: the optimized logical plan plus —
+    filled by the first execution — the physical-planner output, so a
+    repeat statement skips BOTH optimize AND the per-execution physical
+    re-plan (ROADMAP #3 named it the biggest per-query CPU line item).
+    ``in_use`` guards the factories' shared runtime state: a concurrent
+    execution of the same statement re-plans privately instead of
+    sharing mid-flight factories."""
+
+    optimized: Any
+    label: str
+    physical: Any = None
+    in_use: bool = False
+
+
 def scan_catalogs(node) -> set:
     """Catalogs referenced by a plan's table scans (the entry's
     invalidation scope)."""

@@ -376,7 +376,8 @@ def fragment_to_json(f: PlanFragment) -> Dict[str, Any]:
             "output_partitioning": [kind, list(channels)],
             "consumed_fragments": list(f.consumed_fragments),
             "scale_rows": f.scale_rows,
-            "producer_subtree": list(f.producer_subtree)}
+            "producer_subtree": list(f.producer_subtree),
+            "device_exchange_eligible": f.device_exchange_eligible}
 
 
 def fragment_from_json(d: Dict[str, Any]) -> PlanFragment:
@@ -386,4 +387,6 @@ def fragment_from_json(d: Dict[str, Any]) -> PlanFragment:
                         tuple(d["consumed_fragments"]),
                         d.get("scale_rows"),
                         producer_subtree=tuple(
-                            d.get("producer_subtree") or ()))
+                            d.get("producer_subtree") or ()),
+                        device_exchange_eligible=d.get(
+                            "device_exchange_eligible"))

@@ -1,0 +1,238 @@
+"""Device-sharded exchange tier (collectives as the data plane).
+
+Covers the PR 11 acceptance pins:
+
+- parity: the SAME queries through a mesh_device_exchange cluster (the
+  whole fragment DAG lowered to ONE SPMD program, boundaries as
+  in-program collectives) vs the operator-tier HTTP exchange cluster —
+  exact rows across TPC-H Q1/Q3/Q6/Q9 and a TPC-DS rollup query;
+- knobs-off restores PR 10: with the three knobs at their off values
+  the fragmenter emits byte-identical plans, queries schedule real
+  worker tasks, and every boundary rides the HTTP plane;
+- forced fallback: an unsupported shape (COUNT(DISTINCT)) on a
+  device-exchange cluster falls back to the HTTP plane mid-query with
+  exact rows and a recorded fallback reason;
+- the partitioned lookup source (P8) and bucket-sequential grouped
+  execution (P9) tiers hold parity on the mesh runner, and the
+  exchange-mode / kernel-tier counters land in the stats rollup.
+"""
+
+import dataclasses as dc
+import sys
+
+import numpy as np
+import pytest
+
+from presto_tpu.config import DEFAULT, EngineConfig
+from presto_tpu.server.dqr import DistributedQueryRunner
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from tpch_queries import QUERIES as TPCH  # noqa: E402
+
+DEV_CFG = dc.replace(DEFAULT, mesh_device_exchange=True)
+
+
+def _close(a, b):
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(sorted(a, key=repr), sorted(b, key=repr)):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if not (np.isclose(va, vb, rtol=1e-6)
+                        or (np.isnan(va) and np.isnan(vb))):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+@pytest.fixture(scope="module")
+def clusters():
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2) as http:
+        with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                         config=DEV_CFG) as dev:
+            yield http, dev
+
+
+def _last_query(runner):
+    return list(runner.coordinator.queries.values())[-1]
+
+
+class TestDeviceExchangeParity:
+    @pytest.mark.parametrize("qn", [1, 3, 6, 9])
+    def test_tpch_parity_device_vs_http(self, clusters, qn):
+        http, dev = clusters
+        sql = TPCH[qn]
+        want = http.execute(sql).rows
+        q_http = _last_query(http)
+        got = dev.execute(sql).rows
+        q_dev = _last_query(dev)
+        assert _close(got, want), f"q{qn} rows diverge across tiers"
+        # the control cluster rode the wire; the device cluster lowered
+        # every boundary to an in-program collective
+        assert set(q_http.exchange_modes) == {"http"}
+        assert set(q_dev.exchange_modes) == {"device"}
+        assert not q_dev._tasks_scheduled
+        assert q_dev.query_stats.get("exchange_modes", {}).get("device", 0) \
+            == q_dev.exchange_modes["device"]
+
+    def test_tpcds_rollup_parity(self):
+        """A TPC-DS ROLLUP config (q27, one of the ENGINE_ONLY rollups)
+        through both tiers: exact rows whichever tier the shape lands
+        on (rollup grouping falls back to the HTTP plane when outside
+        the collective subset)."""
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "tpcds_suite",
+                            "q27.sql")
+        with open(path) as f:
+            sql = f.read()
+        with DistributedQueryRunner.tpcds(scale=0.003,
+                                          n_workers=2) as http:
+            want = http.execute(sql).rows
+        with DistributedQueryRunner.tpcds(scale=0.003, n_workers=2,
+                                          config=DEV_CFG) as dev:
+            got = dev.execute(sql).rows
+            q_dev = _last_query(dev)
+        assert _close(got, want)
+        # whichever tier served it, the boundary accounting is complete
+        assert set(q_dev.exchange_modes) <= {"device", "http"}
+        assert q_dev.exchange_modes
+
+    def test_repeat_statement_reuses_compiled_program(self, clusters):
+        _http, dev = clusters
+        sql = TPCH[6]
+        first = dev.execute(sql).rows
+        second = dev.execute(sql).rows
+        assert _close(first, second)
+        assert set(_last_query(dev).exchange_modes) == {"device"}
+
+
+class TestKnobsOffRestoresPr10:
+    def test_defaults_are_off_values(self):
+        cfg = EngineConfig()
+        assert cfg.mesh_device_exchange is False
+        assert cfg.grouped_mesh_execution == 1
+
+    def test_fragmenter_plans_identical(self):
+        """The annotation pass never changes the structural plan: the
+        fragment DAG (ids, roots, partitionings, boundaries) and its
+        rendering are byte-identical with the knobs on and off."""
+        from presto_tpu.localrunner import LocalQueryRunner
+        from presto_tpu.server.coordinator import QueryExecution
+        from presto_tpu.server.fragmenter import (
+            Fragmenter, annotate_device_exchange,
+        )
+        from presto_tpu.sql.optimizer import optimize
+        from presto_tpu.sql.parser import parse_statement
+        from presto_tpu.sql.planner import Planner
+
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        for qn in (3, 6):
+            logical = Planner(runner.metadata).plan(
+                parse_statement(TPCH[qn]))
+            texts = {}
+            for label, cfg in (("off", DEFAULT), ("on", DEV_CFG)):
+                optimized = optimize(logical, runner.metadata, cfg)
+                dplan = Fragmenter(metadata=runner.metadata,
+                                   config=cfg).fragment(optimized)
+                if label == "on":
+                    annotate_device_exchange(dplan)
+                texts[label] = QueryExecution._format_dplan(dplan)
+            assert texts["on"] == texts["off"]
+
+    def test_knobs_off_schedules_tasks(self, clusters):
+        http, _dev = clusters
+        http.execute("select count(*) from tpch.region")
+        q = _last_query(http)
+        assert q._tasks_scheduled
+        assert q._placements
+        assert set(q.exchange_modes) == {"http"}
+
+
+class TestForcedFallback:
+    def test_unsupported_shape_falls_back_to_http(self, clusters):
+        """approx_percentile's sketch component is outside the mesh
+        primitive set: the device cluster must schedule real tasks (the
+        HTTP plane) and still return exact rows, recording why it fell
+        back."""
+        http, dev = clusters
+        sql = ("select approx_percentile(l_quantity, 0.5) as p, "
+               "count(*) as n from tpch.lineitem")
+        want = http.execute(sql).rows
+        got = dev.execute(sql).rows
+        q = _last_query(dev)
+        assert _close(got, want)
+        assert q._tasks_scheduled
+        assert set(q.exchange_modes) == {"http"}
+        assert q.device_exchange_info.get("fallback")
+
+    def test_session_knob_disables_per_query(self, clusters):
+        _http, dev = clusters
+        client = dev.new_client()
+        client.execute("set session mesh_device_exchange = false")
+        _cols, _data = client.execute(
+            "select count(*) from tpch.region")
+        q = _last_query(dev)
+        assert q._tasks_scheduled
+        assert set(q.exchange_modes) == {"http"}
+
+
+class TestMeshJoinTiers:
+    SQL = ("select o_orderpriority, count(*) as c, "
+           "sum(l_extendedprice) as s from lineitem, orders "
+           "where l_orderkey = o_orderkey "
+           "group by o_orderpriority order by o_orderpriority")
+    LEFT = ("select l_returnflag, count(*) as c, sum(l_quantity) as q "
+            "from lineitem left join orders on l_orderkey = o_orderkey "
+            "group by l_returnflag")
+
+    @pytest.fixture(scope="class")
+    def oracle(self):
+        from presto_tpu.localrunner import LocalQueryRunner
+
+        local = LocalQueryRunner.tpch(scale=0.01)
+        return {s: local.execute(s).rows for s in (self.SQL, self.LEFT)}
+
+    def _run(self, cfg, oracle):
+        from presto_tpu.parallel.sqlmesh import MeshQueryRunner
+
+        mesh = MeshQueryRunner.tpch(scale=0.01, n_devices=2, config=cfg)
+        for sql, want in oracle.items():
+            got = mesh.execute(sql)
+            assert _close(got.rows, want), f"mesh diverges: {sql[:40]}"
+        return mesh.last_run_info
+
+    def test_partitioned_lookup_source_parity(self, oracle):
+        """P8: the PagesHash build table sharded per shard, probes
+        resolved through the (lo, counts) contract."""
+        info = self._run(dc.replace(
+            DEFAULT, partitioned_join_build=True,
+            device_join_probe_max_build_rows=1), oracle)
+        assert any(t.endswith(":pages_hash")
+                   for t in info["kernel_tiers"])
+
+    def test_partitioned_build_off_restores_sorted_tier(self, oracle):
+        info = self._run(dc.replace(
+            DEFAULT, partitioned_join_build=False), oracle)
+        assert not any("pages_hash" in t for t in info["kernel_tiers"])
+
+    def test_grouped_mesh_execution_parity(self, oracle):
+        """P9: bucket-sequential grouped join — every bucket's tier
+        marker lands, rows exact."""
+        info = self._run(dc.replace(
+            DEFAULT, grouped_mesh_execution=4,
+            partitioned_join_build=True,
+            device_join_probe_max_build_rows=1), oracle)
+        buckets = {t for t in info["kernel_tiers"]
+                   if t.startswith("grouped join")}
+        assert len(buckets) == 4
+        assert all("pages_hash" in t for t in buckets)
+
+    def test_grouped_execution_off_is_single_pass(self, oracle):
+        info = self._run(dc.replace(
+            DEFAULT, grouped_mesh_execution=1), oracle)
+        assert not any(t.startswith("grouped join")
+                       for t in info["kernel_tiers"])

@@ -90,10 +90,21 @@ class TestLiveSampling:
                     polls.append(ts)
                     running = [s for s in ts["samples"]
                                if s["state"] == "RUNNING"]
-                    # stop once progress moved while still RUNNING
+                    # stop once progress moved while still RUNNING and
+                    # the CLIENT's own polling also caught a RUNNING
+                    # progress object — releasing the hold on the
+                    # timeseries condition alone raced the client's
+                    # poll cadence under full-suite load (the client
+                    # thread may not have landed a progress-carrying
+                    # poll yet)
+                    client_saw = any(
+                        s.get("state") == "RUNNING"
+                        and "completedSplits" in s
+                        for s in client.stats_history)
                     if (len(running) >= 2
                             and running[-1]["splits_completed"]
-                            > running[0]["splits_completed"]):
+                            > running[0]["splits_completed"]
+                            and client_saw):
                         break
                 time.sleep(0.05)
             rule.release()

@@ -157,7 +157,23 @@ class TestConcurrentServing:
                        for i in range(len(sqls))]
             for t in threads:
                 t.start()
-            time.sleep(0.05)
+            # wait for the CONDITION the kill is meant to hit — all 3
+            # queries actually mid-flight (tasks scheduled) — instead of
+            # assuming 50 ms of wall clock covers admission+planning
+            # (under a loaded full-suite run it does not, and the kill
+            # races scheduling into stage-retry exhaustion)
+            co = runner.coordinator
+            deadline = time.monotonic() + 30
+
+            def mid_flight():
+                qs = [q for q in list(co.queries.values())
+                      if q.user.startswith("chaos")]
+                return len(qs) == len(sqls) and all(
+                    q._tasks_scheduled
+                    or q.state in ("FINISHED", "FAILED") for q in qs)
+
+            while time.monotonic() < deadline and not mid_flight():
+                time.sleep(0.01)
             runner.kill_worker(1)
             for t in threads:
                 t.join(timeout=120)
@@ -435,6 +451,36 @@ class TestLocalPlanCache:
         assert runner.execute(msql).rows == [(0,)]      # cached
         runner.execute("insert into memory.lt values (7)")
         assert runner.execute(msql).rows == [(1,)]      # invalidated
+
+    def test_physical_plan_shared_on_second_run(self):
+        """Plan-cache physical-factory sharing (PR 11): the SECOND
+        execution of a cached statement must not re-run the physical
+        planner — the cached entry carries the operator factory chains,
+        reset per execution (ROADMAP #3's biggest per-query CPU line
+        item)."""
+        from presto_tpu.localrunner import LocalQueryRunner
+        from presto_tpu.sql import physical
+
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        sqls = [
+            "select l_returnflag, count(*) as c_phys from lineitem "
+            "group by l_returnflag order by l_returnflag",
+            # cross-pipeline rendezvous shapes (union buffer, build
+            # side) must re-arm on reuse
+            "select count(*) as u_phys from ("
+            "select o_orderkey k from orders union all "
+            "select l_orderkey k from lineitem)",
+            "select n_name, count(*) as j_phys from supplier, nation "
+            "where s_nationkey = n_nationkey group by n_name",
+        ]
+        for sql in sqls:
+            first = runner.execute(sql).rows
+            built = physical.PLANS_BUILT
+            second = runner.execute(sql).rows
+            third = runner.execute(sql).rows
+            assert second == first and third == first
+            assert physical.PLANS_BUILT == built, \
+                f"physical planner re-ran on repeat of {sql[:40]!r}"
 
     def test_normalization_shares_entries(self):
         """Whitespace-reformatted statements share one entry; string

@@ -132,6 +132,40 @@ class TestFusionReport:
         assert "FusedSegment{" in out
 
 
+class TestExchangeReport:
+    def test_boundary_modes_and_q3_collective_check(self, capsys):
+        """tools/exchange_report.py renders one row per fragment
+        boundary with its exchange mode, and --check pins TPC-H Q3's
+        boundaries lowering to the collective tier."""
+        import importlib
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        exchange_report = importlib.import_module("exchange_report")
+        rc = exchange_report.main(["q3", "q6", "--scale", "0.002",
+                                   "--check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "data plane: collective" in out
+        assert "hash" in out and "single" in out
+
+    def test_segments_column_names_boundary_roles(self, capsys):
+        import importlib
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        exchange_report = importlib.import_module("exchange_report")
+        rc = exchange_report.main(["q3", "--scale", "0.002",
+                                   "--segments"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "fed-by-exchange" in out or "feeds-exchange" in out
+
+
 class TestQpsRun:
     def test_check_mode(self, capsys):
         """tools/qps_run.py --check: the serving-tier CI smoke — a tiny
