@@ -1089,6 +1089,15 @@ def bench_mesh_q1q6(scale: float):
         q6_s, q6_res = timed_cluster(dqr, ENGINE_Q6)
         last = list(dqr.coordinator.queries.values())[-1]
         device_engaged = set(last.exchange_modes) == {"device"}
+        beacon_samples = len(last.timeseries)
+    # the SAME collective tier with progress beacons traced OUT of the
+    # program (PR 12 default ON): the on-vs-off delta IS the telemetry
+    # overhead, tracked so perf_regress can see it drift
+    nb_cfg = _dc.replace(dev_cfg, mesh_progress_beacons=False)
+    with DistributedQueryRunner.tpch(scale=scale, n_workers=2,
+                                     config=nb_cfg) as dqr_nb:
+        q1_nb_s, _r1 = timed_cluster(dqr_nb, ENGINE_Q1)
+        q6_nb_s, _r6 = timed_cluster(dqr_nb, ENGINE_Q6)
     with DistributedQueryRunner.tpch(scale=scale, n_workers=2) as http:
         h1_s, _h1 = timed_cluster(http, ENGINE_Q1)
         h6_s, _h6 = timed_cluster(http, ENGINE_Q6)
@@ -1110,6 +1119,18 @@ def bench_mesh_q1q6(scale: float):
         "http_plane": {
             "q1_vs_local": round(q1_local_s / h1_s, 3),
             "q6_vs_local": round(q6_local_s / h6_s, 3),
+        },
+        # PR 12 telemetry overhead: wall with progress beacons traced
+        # into the program (the shipped default) vs the beacon-free
+        # PR 11 program; ratio > 1 = beacons cost wall
+        "telemetry": {
+            "beacons_on_q1_ms": round(q1_s * 1000, 2),
+            "beacons_off_q1_ms": round(q1_nb_s * 1000, 2),
+            "beacons_on_q6_ms": round(q6_s * 1000, 2),
+            "beacons_off_q6_ms": round(q6_nb_s * 1000, 2),
+            "overhead_q1": round(q1_s / max(q1_nb_s, 1e-9), 3),
+            "overhead_q6": round(q6_s / max(q6_nb_s, 1e-9), 3),
+            "beacon_samples_q6": beacon_samples,
         },
         "parity": parity,
     }

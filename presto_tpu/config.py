@@ -426,6 +426,17 @@ class EngineConfig:
     # count; 1 = off (the PR 10 single-pass join exactly).  The
     # capacity-bucket overflow/rerun policy applies per bucket.
     grouped_mesh_execution: int = 1
+    # Mid-program progress beacons (parallel/beacons.py): a
+    # jax.debug.callback at every fragment boundary inside the SPMD
+    # program reports (fragment, shard, rows) to a host-side collector,
+    # which feeds the PR 9 sampler ring / client-poll progress object /
+    # progressPercent MID-program — the collective tier's analogue of
+    # the task-info sampler the HTTP plane already has.  Default on
+    # (only engages together with mesh_device_exchange); OFF traces a
+    # program with no callbacks and restores the PR 11 sampling
+    # behavior for device-exchange queries exactly (no mid-run samples,
+    # no progress object until the final rollup).
+    mesh_progress_beacons: bool = True
 
 
 DEFAULT = EngineConfig()

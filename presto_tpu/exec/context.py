@@ -160,6 +160,12 @@ class TaskStats:
     pages_spooled: int = 0
     pages_evicted: int = 0
     bytes_evicted: int = 0
+    # device-sharded exchange tier: bytes this shard received through
+    # in-program collectives (all_to_all / all_gather / gather) at the
+    # fragment boundaries it produced — read back as program outputs
+    # (parallel/sqlmesh.py per-shard stats) and folded into synthetic
+    # per-shard TaskStats; HTTP-plane tasks report 0
+    device_exchange_bytes: int = 0
 
     def add_operator(self, s: OperatorStats) -> None:
         self.wall_ns += s.wall_ns + s.finish_wall_ns
@@ -207,6 +213,7 @@ class StageStats:
     pages_spooled: int = 0
     pages_evicted: int = 0
     bytes_evicted: int = 0
+    device_exchange_bytes: int = 0
 
     def add_task(self, ts: TaskStats) -> None:
         self.reporting += 1
@@ -228,6 +235,7 @@ class StageStats:
         self.pages_spooled += ts.pages_spooled
         self.pages_evicted += ts.pages_evicted
         self.bytes_evicted += ts.bytes_evicted
+        self.device_exchange_bytes += ts.device_exchange_bytes
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -260,6 +268,7 @@ class QueryStats:
     output_bytes: int = 0
     pages_spooled: int = 0
     pages_evicted: int = 0
+    device_exchange_bytes: int = 0
     stages: int = 0
 
     def add_stage(self, st: StageStats) -> None:
@@ -280,6 +289,7 @@ class QueryStats:
         self.output_bytes += st.output_bytes
         self.pages_spooled += st.pages_spooled
         self.pages_evicted += st.pages_evicted
+        self.device_exchange_bytes += st.device_exchange_bytes
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)

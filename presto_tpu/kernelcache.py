@@ -91,6 +91,14 @@ def cache_put(cache: "OrderedDict[tuple, object]", key, val,
                 cache.evictions += 1
 
 
+def cache_pop(cache: "OrderedDict[tuple, object]", key) -> None:
+    """Drop one entry (no eviction counted: callers pop entries they
+    know are invalid — e.g. a mesh program whose capacity bucket
+    overflowed — which is correctness, not capacity pressure)."""
+    with _LOCK:
+        cache.pop(key, None)
+
+
 def record_compile(cache, duration_ns: int) -> None:
     """Attribute one kernel build's wall time to its named cache (the
     compile-time-attribution half of the CacheStatsMBean role); plain

@@ -1,0 +1,105 @@
+"""Host-side collector for in-program progress beacons.
+
+The HTTP data plane gets mid-query progress for free: the coordinator's
+sampler polls ``/v1/task/{id}`` while a query RUNs.  The collective tier
+has no tasks to poll — the whole fragment DAG is ONE ``shard_map``-ped
+XLA program — so progress must come OUT of the program: a
+``jax.debug.callback`` at every fragment boundary (gated by
+``mesh_progress_beacons``) reports (fragment id, shard, rows crossing
+the boundary) to whatever sink is installed here for the duration of
+the dispatch.
+
+Design constraints this module encodes:
+
+- the compiled program is CACHED across queries, so the callback closure
+  must not bind query identity — ``emit`` routes through a process-wide
+  "current sink" slot installed around each dispatch (the coordinator
+  serializes collective dispatches on ``mesh_executor_lock``, so one
+  sink at a time is the actual concurrency);
+- callbacks fire on XLA runtime threads, one per shard, possibly
+  concurrently — the sink must be thread-safe and ``emit`` must never
+  raise into the runtime (a beacon is observability, not control flow).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+_lock = threading.Lock()
+_current: Optional[Callable[[int, int, int], None]] = None
+
+
+@contextmanager
+def install(sink: Optional[Callable[[int, int, int], None]]):
+    """Route beacons to ``sink`` for the duration of the block (None =
+    drop them, the standalone MeshQueryRunner default)."""
+    global _current
+    with _lock:
+        prev = _current
+        _current = sink
+    try:
+        yield
+    finally:
+        with _lock:
+            _current = prev
+
+
+def emit(fragment_id, shard, rows) -> None:
+    """``jax.debug.callback`` target: one call per shard per fragment
+    boundary, with concrete (device-computed) values."""
+    with _lock:
+        sink = _current
+    if sink is None:
+        return
+    try:
+        sink(int(fragment_id), int(shard), int(rows))
+    except Exception:  # noqa: BLE001 - observability never fails the program
+        pass
+
+
+class ProgressCollector:
+    """Accumulates beacons into a progress snapshot.
+
+    ``units`` are (fragment, shard) pairs — a boundary beacon marks the
+    producing fragment complete on that shard, so distinct units only
+    grow and every derived surface (completed count, cumulative rows)
+    is monotonic by construction.  ``on_progress`` fires under no lock
+    with (completed_units, total_units, cumulative_rows) each time a
+    NEW unit lands; ``on_beacon`` (test hook) fires on EVERY beacon.
+    """
+
+    def __init__(self, total_units: int,
+                 on_progress: Optional[Callable[[int, int, int], None]] = None,
+                 on_beacon: Optional[Callable[[int, int, int], None]] = None):
+        self.total_units = max(int(total_units), 1)
+        self.on_progress = on_progress
+        self.on_beacon = on_beacon
+        self._seen: Set[Tuple[int, int]] = set()
+        self._rows: Dict[Tuple[int, int], int] = {}
+        self._mutex = threading.Lock()
+
+    def __call__(self, fragment_id: int, shard: int, rows: int) -> None:
+        if self.on_beacon is not None:
+            self.on_beacon(fragment_id, shard, rows)
+        key = (fragment_id, shard)
+        with self._mutex:
+            fresh = key not in self._seen
+            self._seen.add(key)
+            # a re-beaconed boundary (multi-consumer fragment) keeps the
+            # larger observation; rows never regress
+            self._rows[key] = max(self._rows.get(key, 0), rows)
+            completed = len(self._seen)
+            total_rows = sum(self._rows.values())
+        if fresh and self.on_progress is not None:
+            self.on_progress(completed, self.total_units, total_rows)
+
+    def snapshot(self) -> Tuple[int, int, int]:
+        with self._mutex:
+            return (len(self._seen), self.total_units,
+                    sum(self._rows.values()))
+
+    def events(self) -> List[Tuple[int, int, int]]:
+        with self._mutex:
+            return [(f, s, r) for (f, s), r in sorted(self._rows.items())]

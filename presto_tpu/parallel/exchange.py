@@ -25,6 +25,24 @@ import jax
 import jax.numpy as jnp
 
 
+def row_width_bytes(arrays: Sequence) -> int:
+    """Static per-row payload width (bytes) of a row-parallel array set
+    — the unit the per-shard telemetry multiplies by received-row counts
+    to report bytes moved through a collective boundary (the
+    device-plane analogue of the wire tier's serialized-page bytes;
+    device arrays move raw, so width is just dtype itemsize x trailing
+    extent, no serde framing)."""
+    import numpy as np
+
+    total = 0
+    for a in arrays:
+        tail = 1
+        for d in a.shape[1:]:
+            tail *= int(d)
+        total += np.dtype(a.dtype).itemsize * tail
+    return total
+
+
 def repartition(
     arrays: Sequence[jax.Array],
     live: jax.Array,

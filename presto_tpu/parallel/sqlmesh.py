@@ -29,6 +29,20 @@ Unsupported shapes (window functions, nested types, distinct aggregates,
 host-evaluated string paths) raise ``MeshUnsupported`` — callers fall back
 to the operator tier, mirroring how the reference falls back from grouped
 to ungrouped execution when a plan shape does not qualify.
+
+Telemetry is part of the traced program (PR 12): per-fragment, per-shard
+counters — scan input rows, fragment output rows, rows/bytes received
+through every boundary collective, and a peak live-intermediate estimate
+— ride OUT of the SPMD program as one extra int64 vector output, so the
+coordinator can fold a mesh query into the same ``TaskStats ->
+StageStats -> QueryStats`` rollup an HTTP query gets (run_info()
+["per_shard"]).  With ``mesh_progress_beacons`` on, every boundary also
+fires a ``jax.debug.callback`` beacon (parallel/beacons.py) so progress
+is observable MID-program; off traces a beacon-free program (PR 11
+exactly).  Compiled whole-query programs live in the shared
+``kernelcache`` registry ("mesh_program"), so cross-query hits/misses
+and build wall (trace+lower vs XLA compile, via ``timed_first_call``)
+surface on /metrics like every other kernel cache.
 """
 
 from __future__ import annotations
@@ -55,6 +69,14 @@ from presto_tpu.sql.plan import (
 )
 
 _MESH_PRIMS = ("sum", "count", "min", "max")
+
+# compiled whole-query SPMD programs, shared across runners and keyed
+# (runner serial, sql) — a named kernelcache so program-cache hits,
+# misses, and compile wall land on /metrics (the generated-class-cache
+# role at whole-query granularity)
+from presto_tpu import kernelcache as _kc  # noqa: E402
+
+_PROGRAM_CACHE = _kc.new_cache("mesh_program")
 
 
 class MeshUnsupported(NotImplementedError):
@@ -137,6 +159,8 @@ class MeshQueryRunner:
     """SQL in, rows out, over an n-device mesh (the distributed
     LocalQueryRunner: same front end, collective execution)."""
 
+    _serial_counter = 0
+
     def __init__(self, registry: ConnectorRegistry, default_catalog: str,
                  n_devices: int = 8, config: EngineConfig = DEFAULT):
         from presto_tpu.parallel.mesh import make_mesh
@@ -147,10 +171,14 @@ class MeshQueryRunner:
         self.config = config
         self.mesh = make_mesh(n_devices)
         self.nparts = n_devices
-        # sql text -> compiled _MeshProgram (trace/compile amortization)
-        self._programs: Dict[str, "_MeshProgram"] = {}
+        # program-cache identity: compiled _MeshPrograms live in the
+        # shared "mesh_program" kernelcache keyed (serial, sql), so the
+        # registry's hit/miss/compile counters cover every runner
+        MeshQueryRunner._serial_counter += 1
+        self._serial = MeshQueryRunner._serial_counter
         # observability for the last successful execution: exchange-mode
-        # counters per fragment boundary + kernel-tier markers (the
+        # counters per fragment boundary, per-shard stats read out of
+        # the program, kernel-tier markers, and compile attribution (the
         # stats-rollup feed of the device-sharded exchange tier)
         self.last_run_info: Dict = {}
 
@@ -231,18 +259,23 @@ class MeshQueryRunner:
     def _execute_planned(self, sql: str, make_dplan):
         from presto_tpu.localrunner import QueryResult
 
-        cached = self._programs.get(sql)
+        cache_key = (self._serial, sql)
+        cached = _kc.cache_get(_PROGRAM_CACHE, cache_key)
         if cached is not None:
             # repeat query: the compiled SPMD program and device-resident
             # scan inputs are reused — one dispatch per execution (the
-            # kernel-cache policy applied at whole-query granularity)
+            # kernel-cache policy applied at whole-query granularity).
+            # A cross-query cache hit reports compile_ns=0: the compile
+            # was paid (and attributed) by the run that built it.
             batch, overflowed = cached.run()
             if not overflowed:
                 dplan = cached.dplan
-                self.last_run_info = cached.run_info()
+                self.last_run_info = dict(cached.run_info(),
+                                          compile_ns=0,
+                                          program_cached=True)
                 return QueryResult(dplan.column_names, dplan.column_types,
                                    batch.to_pylist())
-            del self._programs[sql]
+            _kc.cache_pop(_PROGRAM_CACHE, cache_key)
         dplan = make_dplan()
         for frag in dplan.fragments:
             _check_supported(frag.root)
@@ -254,8 +287,11 @@ class MeshQueryRunner:
             batch, overflowed = prog.run()
             if not overflowed:
                 if prog.cacheable:
-                    self._programs[sql] = prog
-                self.last_run_info = prog.run_info()
+                    _kc.cache_put(_PROGRAM_CACHE, cache_key, prog)
+                self.last_run_info = dict(
+                    prog.run_info(), compile_ns=prog.compile_ns,
+                    program_cached=False,
+                    build_spans=dict(prog.build_spans))
                 return QueryResult(dplan.column_names, dplan.column_types,
                                    batch.to_pylist())
             last_err = f"overflow at cap_scale={1 << attempt}"
@@ -285,6 +321,13 @@ class _MeshProgram:
         # one (operator label, tier) marker per hot-loop lowering
         self.exchange_log: List[Tuple[int, str]] = []
         self.kernel_tiers: List[Tuple[str, str]] = []
+        # compile attribution: XLA-compile wall (timed_first_call over
+        # the AOT compile) + the lower/compile wall-clock windows the
+        # coordinator turns into span-tree phases; per-shard telemetry
+        # values read back from the LAST run's stats output
+        self.compile_ns = 0
+        self.build_spans: Dict[str, Tuple[float, float]] = {}
+        self._last_shard_stats: List[Tuple[tuple, List[int]]] = []
         # a retry shares the prepared scans, so it must inherit their
         # mutability verdict (scan prep is the only place it is learned)
         self.cacheable = prepared.cacheable if prepared is not None \
@@ -388,6 +431,11 @@ class _MeshProgram:
             self._errors: List[object] = []
             self.exchange_log = []
             self.kernel_tiers = []
+            # per-shard telemetry accumulated during lowering: (key,
+            # traced int64 scalar) pairs that become ONE stats vector
+            # output — the program's own StageStats feed
+            self._shard_stats: List[Tuple[tuple, object]] = []
+            self._peak_live = jnp.zeros((), jnp.int64)
             table = self._lower_fragment(self.dplan.root_fragment_id)
             self._out_meta = [(c.type, c.dictionary) for c in table.cols]
             outs = []
@@ -404,13 +452,24 @@ class _MeshProgram:
             err = jnp.zeros((), bool)
             for f in self._errors:
                 err = err | f
+            self._shard_stats.append(
+                (("program", "peak_live_bytes"), self._peak_live))
+            self._stat_keys = [k for k, _ in self._shard_stats]
+            stats = jnp.stack([jnp.asarray(v).astype(jnp.int64).reshape(())
+                               for _, v in self._shard_stats])
             return (tuple(outs) + (table.live, of.reshape(1),
                                    err.reshape(1),
                                    jnp.stack(flags).reshape(-1)
-                                   if flags else jnp.zeros(0, bool)))
+                                   if flags else jnp.zeros(0, bool),
+                                   stats))
 
-        n_out = 2 * ncols + 4
+        n_out = 2 * ncols + 5
         if self._jitted is None:
+            import time as _time
+
+            from presto_tpu.exec.context import OperatorStats
+            from presto_tpu.kernelcache import timed_first_call
+
             mapped = jax.shard_map(
                 program, mesh=self.runner.mesh,
                 in_specs=tuple(PS(AXIS) for _ in self.inputs),
@@ -423,24 +482,37 @@ class _MeshProgram:
             # jit dispatch path can lose the trace-time constant buffers
             # when several whole-query programs coexist in one process
             # (observed as "supplied N buffers but expected N+consts");
-            # the AOT executable binds its constants explicitly
-            self._jitted = jax.jit(mapped).lower(*self._args).compile()
+            # the AOT executable binds its constants explicitly.  The
+            # trace+lower and XLA-compile walls are split so the span
+            # tree can attribute them separately; compile wall is
+            # attributed to the shared "mesh_program" cache through
+            # timed_first_call (the CacheStatsMBean role).
+            t0 = _time.time()
+            lowered = jax.jit(mapped).lower(*self._args)
+            t1 = _time.time()
+            cstats = OperatorStats(operator="mesh_program")
+            self._jitted = timed_first_call(
+                lowered.compile, cstats, _PROGRAM_CACHE)()
+            t2 = _time.time()
+            self.compile_ns += cstats.jit_compile_ns
+            self.build_spans = {"lower": (t0, t1), "compile": (t1, t2)}
         out = self._jitted(*self._args)
         # Read only the control outputs eagerly — on a remote-attached
         # TPU every host transfer costs a tunnel round trip, and the
         # content arrays are full static capacity regardless of how few
         # rows are live.
-        of = bool(np.asarray(out[-3]).any())
+        of = bool(np.asarray(out[-4]).any())
         if of:
-            flags = np.asarray(out[-1]).reshape(self.nparts, -1)
+            flags = np.asarray(out[-2]).reshape(self.nparts, -1)
             self.overflow_labels = [
                 lbl for i, lbl in enumerate(self._flag_labels)
                 if flags[:, i].any()]
             return Batch((), 0), True
-        if bool(np.asarray(out[-2]).any()):
+        if bool(np.asarray(out[-3]).any()):
             raise ValueError(
                 "scalar subquery returned more than one row")
-        live_g = np.asarray(out[-4])
+        self._read_shard_stats(out[-1])
+        live_g = np.asarray(out[-5])
         cap = live_g.shape[0] // self.nparts
         live = live_g[:cap]
         n_live = int(live.sum())
@@ -486,28 +558,71 @@ class _MeshProgram:
 
             fn = jax.jit(slicer)
             self._slicers[(bucket, layout)] = fn
-        stacked = [np.asarray(a) for a in fn(tuple(arrays), out[-4])]
+        stacked = [np.asarray(a) for a in fn(tuple(arrays), out[-5])]
         host: List[Optional[np.ndarray]] = [None] * len(arrays)
         for (_, idxs), mat in zip(layout, stacked):
             for row, i in enumerate(idxs):
                 host[i] = mat[row]
         return host
 
+    def _read_shard_stats(self, stats_out) -> None:
+        """Parse the program's stats vector output ([P*S] -> [P, S])
+        into per-key per-shard int lists; same-key entries (several
+        scans in one fragment) sum."""
+        raw = np.asarray(stats_out).reshape(self.nparts, -1)
+        folded: Dict[tuple, np.ndarray] = {}
+        order: List[tuple] = []
+        for i, key in enumerate(self._stat_keys):
+            if key not in folded:
+                folded[key] = np.zeros(self.nparts, np.int64)
+                order.append(key)
+            folded[key] += raw[:, i]
+        self._last_shard_stats = [(k, [int(v) for v in folded[k]])
+                                  for k in order]
+
+    def _note_stat(self, key: tuple, value) -> None:
+        self._shard_stats.append((key, value))
+
     def run_info(self) -> Dict:
-        """Exchange-mode + kernel-tier counters for the stats rollup
-        (recorded at trace time; cached re-runs report the same values
-        because the compiled program IS the same lowering)."""
+        """Exchange-mode + kernel-tier counters and the per-shard stats
+        read back from the LAST run, for the stats rollup (structure
+        recorded at trace time; cached re-runs re-read the same compiled
+        program's outputs)."""
         modes: Dict[str, int] = {}
         for _fid, kind in self.exchange_log:
             modes[kind] = modes.get(kind, 0) + 1
+        stats = dict(self._last_shard_stats)
+        fragments: Dict[int, Dict[str, List[int]]] = {}
+        boundaries = []
+        peak = stats.get(("program", "peak_live_bytes"),
+                         [0] * self.nparts)
+        for key, vals in self._last_shard_stats:
+            if key[0] == "fragment":
+                fragments.setdefault(key[1], {})[key[2]] = vals
+        for seq, (fid, kind) in enumerate(self.exchange_log):
+            boundaries.append({
+                "fragment": fid, "kind": kind,
+                "rows": stats.get(("boundary", seq, fid, kind, "rows"),
+                                  [0] * self.nparts),
+                "bytes": stats.get(("boundary", seq, fid, kind, "bytes"),
+                                   [0] * self.nparts),
+            })
         return {
             "exchange_modes": modes,
-            "boundaries": [{"fragment": fid, "kind": kind}
-                           for fid, kind in self.exchange_log],
+            "boundaries": boundaries,
             "kernel_tiers": [f"{label}:{tier}"
                              for label, tier in self.kernel_tiers],
             "nparts": self.nparts,
             "cap_scale": self.cap_scale,
+            "per_shard": {
+                "fragments": {
+                    fid: {"input_rows": d.get("input_rows",
+                                              [0] * self.nparts),
+                          "output_rows": d.get("output_rows",
+                                               [0] * self.nparts)}
+                    for fid, d in sorted(fragments.items())},
+                "peak_live_bytes": peak,
+            },
         }
 
     # ---------------- traced lowering ----------------
@@ -516,11 +631,17 @@ class _MeshProgram:
             return self._cache[fid]
         frag = self.dplan.fragments[fid]
         prev = getattr(self, "_cur_part", None)
+        prev_fid = getattr(self, "_cur_fid", None)
         self._cur_part = frag.partitioning
+        self._cur_fid = fid
         try:
             table = self._lower(frag.root)
         finally:
             self._cur_part = prev
+            self._cur_fid = prev_fid
+        # per-shard fragment output rows: live count of the fragment
+        # root (the TaskStats.output_rows feed of the synthetic rollup)
+        self._note_stat(("fragment", fid, "output_rows"), table.num_rows)
         self._cache[fid] = table
         return table
 
@@ -552,8 +673,9 @@ class _MeshProgram:
             if kind in ("broadcast", "single"):
                 # already the identical union on every shard — a gather
                 # here would multiply rows by the shard count (the
-                # boundary still counts: it lowered to an identity)
-                self.exchange_log.append((fid, kind))
+                # boundary still counts: it lowered to an identity,
+                # moving zero bytes)
+                self._note_boundary(fid, kind, table.num_rows, 0)
                 return table
             # hash-split of a replicated table: only ONE copy may enter
             # the exchange, so mask all but shard 0's
@@ -608,7 +730,14 @@ class _MeshProgram:
         else:
             raise MeshUnsupported(f"output partitioning {kind}")
         self._overflow.append((f'exchange f{fid} {kind}', of))
-        self.exchange_log.append((fid, kind))
+        # per-shard boundary telemetry: rows/bytes this shard RECEIVED
+        # through the collective (raw device arrays, so bytes = rows x
+        # static row width — no serde framing), plus the mid-program
+        # progress beacon when enabled
+        from presto_tpu.parallel.exchange import row_width_bytes
+
+        self._note_boundary(fid, kind, n_recv,
+                            n_recv * row_width_bytes(recv))
         cols = []
         for i, c in enumerate(table.cols):
             cols.append(MCol(recv[2 * i], recv[2 * i + 1], c.type,
@@ -616,6 +745,35 @@ class _MeshProgram:
         live = jnp.arange(out_cap) < n_recv
         return MTable(cols, live, out_cap, table.est, compacted=True,
                       replicated=kind in ("broadcast", "single"))
+
+    def _note_boundary(self, fid: int, kind: str, rows, bytes_) -> None:
+        """Record one fragment boundary: exchange-log entry, per-shard
+        rows/bytes stats keyed by boundary sequence (a fragment feeding
+        two consumers crosses two boundaries), and — when
+        ``mesh_progress_beacons`` is on — a ``jax.debug.callback``
+        beacon reporting (fragment, shard, rows) to the host collector
+        mid-program.  Beacons off traces NO callback: the program is
+        byte-identical to the PR 11 lowering."""
+        seq = len(self.exchange_log)
+        self.exchange_log.append((fid, kind))
+        self._note_stat(("boundary", seq, fid, kind, "rows"), rows)
+        self._note_stat(("boundary", seq, fid, kind, "bytes"), bytes_)
+        # beacons ride only the device-exchange tier: the local
+        # whole_query_execution tier traces through this module too and
+        # must stay callback-free (its progress plane is the operator
+        # tier's — and a no-op host callback is still a host sync)
+        if self.config.mesh_progress_beacons \
+                and self.config.mesh_device_exchange:
+            import jax
+            import jax.numpy as jnp
+
+            from presto_tpu.parallel import beacons
+            from presto_tpu.parallel.mesh import AXIS
+
+            jax.debug.callback(
+                beacons.emit, jnp.int32(fid),
+                jax.lax.axis_index(AXIS).astype(jnp.int32),
+                jnp.asarray(rows).astype(jnp.int64), ordered=False)
 
     def _hash_triple(self, c: MCol):
         """(values, valid, type) for exchange hashing — the SAME per-entry
@@ -626,6 +784,23 @@ class _MeshProgram:
         return value_hash_triple(c)
 
     def _lower(self, node: PlanNode) -> MTable:
+        table = self._lower_node(node)
+        # peak live-intermediate estimate: the largest live-rows x
+        # row-width of any lowered table on this shard — the mesh
+        # tier's peak_memory_bytes analogue (an estimate: padding and
+        # kernel scratch are excluded; capacities are static and the
+        # point is the LIVE working set)
+        import jax.numpy as jnp
+
+        from presto_tpu.parallel.exchange import row_width_bytes
+
+        width = row_width_bytes(
+            [c.values for c in table.cols]) + len(table.cols)
+        self._peak_live = jnp.maximum(
+            self._peak_live, table.num_rows * jnp.int64(max(width, 1)))
+        return table
+
+    def _lower_node(self, node: PlanNode) -> MTable:
         if isinstance(node, TableScanNode):
             return self._lower_scan(node)
         if isinstance(node, RemoteSourceNode):
@@ -750,6 +925,10 @@ class _MeshProgram:
             cols.append(MCol(self._traced[vslot],
                              self._traced[gslot] if gslot is not None
                              else None, typ, d))
+        # per-shard scan input rows, summed per fragment at readback
+        # (the TaskStats.input_rows feed of the synthetic rollup)
+        self._note_stat(("fragment", getattr(self, "_cur_fid", 0),
+                         "input_rows"), counts[0])
         live = jnp.arange(cap) < counts[0]
         return MTable(cols, live, cap, meta["total"], compacted=True)
 

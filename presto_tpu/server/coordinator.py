@@ -400,13 +400,20 @@ class QueryExecution:
         threshold = cfg.slow_query_log_threshold_s
         if threshold > 0 and elapsed >= threshold:
             top = self._top_operator()
+            # name the device-exchange disposition so the log line alone
+            # says which data plane ran (and why the collective tier was
+            # skipped, when it was)
+            fb = (self.device_exchange_info or {}).get("fallback")
+            plane = ("device" if "device" in self.exchange_modes
+                     else "http")
             logging.getLogger("presto_tpu.coordinator").warning(
                 "slow query %s [trace:%s] user=%s elapsed=%.3fs "
                 "(queued=%.3fs execution=%.3fs, threshold=%.3fs) "
-                "top_operator=%s sql=%r",
+                "top_operator=%s exchange_plane=%s device_fallback=%s "
+                "sql=%r",
                 self.query_id, self.trace_token, self.user, elapsed,
                 self.queued_s, execution_s, threshold, top or "?",
-                self.sql[:200])
+                plane, fb or "-", self.sql[:200])
             self.co.event_bus.slow_query(ev.SlowQueryEvent(
                 self.query_id, self.trace_token, self.user,
                 self.sql[:500], round(elapsed, 6),
@@ -419,10 +426,12 @@ class QueryExecution:
         freshly-planned and plan-cache-hit paths)."""
         self.column_names = dplan.column_names
         self.column_types = dplan.column_types
-        if not analyze and self._try_device_exchange(dplan):
+        if self._try_device_exchange(dplan, analyze):
             # the whole fragment DAG ran as ONE SPMD program; no tasks,
-            # no wire pages (EXPLAIN ANALYZE keeps the task plane: its
-            # contract is the per-task operator-stats rollup)
+            # no wire pages — per-shard stats read out of the program
+            # fold into the same StageStats/TaskStats rollup (and the
+            # device EXPLAIN ANALYZE rendering) a task-scheduled query
+            # gets
             return
         self.state = "SCHEDULING"
         with self._mark("schedule"):
@@ -438,7 +447,8 @@ class QueryExecution:
             self.column_types = [T.VARCHAR]
             self.result_rows = [(line,) for line in text.splitlines()]
 
-    def _try_device_exchange(self, dplan: DistributedPlan) -> bool:
+    def _try_device_exchange(self, dplan: DistributedPlan,
+                             analyze: bool = False) -> bool:
         """Collectives as the data plane (mesh_device_exchange): when
         every schedulable worker AND this coordinator share one device
         mesh (mesh fingerprints equal — same process/device set) and
@@ -449,43 +459,65 @@ class QueryExecution:
         miss (mixed mesh, unsupported shape, runtime capacity
         non-convergence) falls back to the task-scheduled HTTP plane,
         which stays the elastic / fault-tolerant / cross-slice tier.
-        Returns True when the query was fully answered here."""
+        Returns True when the query was fully answered here.
+
+        Telemetry contract (PR 12): the per-shard counters traced into
+        the program fold into synthetic per-shard TaskStats under real
+        per-fragment StageStats, progress beacons feed the sampler ring
+        MID-program, and EXPLAIN ANALYZE renders the device tier — a
+        mesh query reads like an HTTP query on every surface."""
         cfg = getattr(self, "_cfg", None) or self.co.config
         n_bound = sum(len(f.consumed_fragments) for f in dplan.fragments)
         if not cfg.mesh_device_exchange:
             return False
+        import contextlib
+
         import jax
 
+        from presto_tpu.parallel import beacons
         from presto_tpu.parallel.mesh import mesh_fingerprint
         from presto_tpu.parallel.sqlmesh import MeshUnsupported
         from presto_tpu.server.fragmenter import annotate_device_exchange
 
-        def fallback(reason: str) -> bool:
+        def fallback(reason: str, kind: str) -> bool:
             self.exchange_modes = {"http": n_bound}
-            self.device_exchange_info = {"fallback": reason[:200]}
+            self.device_exchange_info = {"fallback": reason[:200],
+                                         "fallback_kind": kind}
+            self.co.count_device_fallback(kind)
             return False
 
         workers = self.co.nodes.alive_nodes()
         shared_fp = self.co.nodes.common_mesh_fingerprint()
         if not workers or shared_fp is None \
                 or shared_fp != mesh_fingerprint():
-            return fallback("placements not co-resident on one mesh")
+            return fallback("placements not co-resident on one mesh",
+                            "not_co_resident")
         try:
             if not annotate_device_exchange(dplan):
-                return fallback("boundary outside the collective subset")
+                return fallback("boundary outside the collective subset",
+                                "unsupported_boundary")
         except Exception as e:  # noqa: BLE001 - annotation is advisory
-            return fallback(f"annotation failed: {e}")
+            return fallback(f"annotation failed: {e}", "annotation_error")
         nparts = max(1, min(len(workers), len(jax.devices())))
         key = (f"{self.catalog}|{self._plan_key_sql or self.sql}")
         self.state = "RUNNING"
+        collector = None
+        if cfg.mesh_progress_beacons:
+            collector = self._device_beacon_collector(n_bound, nparts, cfg)
         try:
             with self._mark("execute"):
+                exec_t0 = ev.now()
                 with self.co.mesh_executor_lock:
                     runner = self.co.mesh_executor(cfg, nparts)
-                    result = runner.execute_dplan(dplan, key)
+                    ctx = (beacons.install(collector)
+                           if collector is not None
+                           else contextlib.nullcontext())
+                    with ctx:
+                        result = runner.execute_dplan(dplan, key)
                     info = dict(runner.last_run_info)
+                exec_t1 = ev.now()
         except (MeshUnsupported, NotImplementedError) as e:
-            return fallback(f"mesh: {e}")
+            return fallback(f"mesh: {e}", "unsupported_shape")
         except ValueError:
             # query-semantic errors surfaced during mesh execution
             # ("scalar subquery returned more than one row") are the
@@ -495,7 +527,7 @@ class QueryExecution:
             self.co.log(f"device-exchange execution failed "
                         f"({type(e).__name__}: {e}); falling back to the "
                         f"task-scheduled plane")
-            return fallback(f"{type(e).__name__}: {e}")
+            return fallback(f"{type(e).__name__}: {e}", "execution_error")
         self.result_rows = [tuple(r) for r in result.rows]
         boundaries = info.get("boundaries", [])
         self.exchange_modes = {"device": len(boundaries) or n_bound}
@@ -504,20 +536,280 @@ class QueryExecution:
             "boundaries": boundaries,
             "kernel_tiers": info.get("kernel_tiers", []),
             "cap_scale": info.get("cap_scale", 1),
+            # compile attribution: XLA-compile wall this run paid (0 on
+            # a cross-query program-cache hit) + cache disposition
+            "compile_ns": int(info.get("compile_ns") or 0),
+            "program_cached": bool(info.get("program_cached")),
+            "per_shard": info.get("per_shard") or {},
         }
-        with self._stats_lock:
-            self.query_stats = {
-                "query_id": self.query_id,
-                "elapsed_s": round(ev.now() - self.create_time, 6),
-                "queued_s": round(self.queued_s, 6),
-                "execution_s": round(
-                    ev.now() - self.admit_time
-                    if self.admit_time is not None else 0.0, 6),
-                "output_rows": len(self.result_rows),
-                "exchange_modes": dict(self.exchange_modes),
-                "device_exchange": self.device_exchange_info,
-            }
+        # "lower"/"compile" span phases, only when THIS run built the
+        # program (a cache hit has nothing to attribute)
+        for name, window in (info.get("build_spans") or {}).items():
+            self._marks[name] = (float(window[0]), float(window[1]))
+        self.co.count_device_success(boundaries)
+        self._fold_device_stats(dplan, info, (exec_t0, exec_t1))
+        if collector is not None:
+            self._settle_device_progress(collector)
+        if analyze:
+            text = self._render_analyze_device(dplan, info)
+            self.column_names = ["Query Plan"]
+            self.column_types = [T.VARCHAR]
+            self.result_rows = [(line,) for line in text.splitlines()]
         return True
+
+    def _fold_device_stats(self, dplan: DistributedPlan, info: Dict,
+                           window: Tuple[float, float]) -> None:
+        """Per-shard program counters -> synthetic TaskStats -> real
+        per-fragment StageStats -> QueryStats: the SAME rollup shapes
+        _rollup_stats builds from remote task info, so every downstream
+        surface (EXPLAIN ANALYZE, /v1/query detail, system.runtime,
+        QueryCompletedEvent, the span tree, the web UI) renders a mesh
+        query without knowing which tier ran it.  'single' fragments
+        fold as ONE task (their per-shard copies are replicas, exactly
+        like the HTTP plane schedules one task); the program's single
+        dispatch + compile attribution land on the root task."""
+        from presto_tpu.exec.context import (
+            QueryStats, StageStats, TaskStats,
+        )
+
+        nparts = max(int(info.get("nparts") or 1), 1)
+        per = info.get("per_shard") or {}
+        frag_rows = per.get("fragments") or {}
+        peak = list(per.get("peak_live_bytes") or [])
+        bytes_by_frag: Dict[int, List[int]] = {}
+        for b in info.get("boundaries", []):
+            acc = bytes_by_frag.setdefault(b["fragment"], [0] * nparts)
+            for s, v in enumerate(b.get("bytes", [])[:nparts]):
+                acc[s] += int(v)
+        t0, t1 = window
+        root_fid = dplan.root_fragment_id
+        stage_stats: Dict[int, Dict] = {}
+        task_stats: Dict[int, List[Dict]] = {}
+        qs = QueryStats(query_id=self.query_id,
+                        elapsed_s=ev.now() - self.create_time)
+        for frag in dplan.fragments:
+            fid = frag.fragment_id
+            fr = frag_rows.get(fid, {})
+            n_tasks = 1 if frag.partitioning == "single" else nparts
+            st = StageStats(fragment_id=fid, tasks=n_tasks)
+            for s in range(n_tasks):
+                def at(key: str) -> int:
+                    vals = fr.get(key) or []
+                    return int(vals[s]) if s < len(vals) else 0
+
+                ts = TaskStats(
+                    task_id=f"{self.query_id}.{fid}.{s}",
+                    state="FINISHED", start_time=t0, end_time=t1,
+                    elapsed_s=round(max(t1 - t0, 0.0), 6),
+                    input_rows=at("input_rows"),
+                    output_rows=at("output_rows"),
+                    device_exchange_bytes=int(
+                        bytes_by_frag.get(fid, [0] * nparts)[s]))
+                # device bytes double as the processedBytes surface the
+                # wire tier reports as output_bytes
+                ts.output_bytes = ts.device_exchange_bytes
+                if fid == root_fid and s == 0:
+                    # the ONE SPMD program: one dispatch, the build
+                    # attributed where it was paid
+                    ts.jit_dispatches = 1
+                    ts.jit_compiles = (0 if info.get("program_cached")
+                                       else 1)
+                    ts.jit_compile_ns = int(info.get("compile_ns") or 0)
+                    ts.peak_memory_bytes = max(
+                        [int(v) for v in peak] or [0])
+                task_stats.setdefault(fid, []).append(ts.as_dict())
+                st.add_task(ts)
+            stage_stats[fid] = st.as_dict()
+            qs.add_stage(st)
+        qs.queued_s = round(self.queued_s, 6)
+        qs.execution_s = round(
+            ev.now() - self.admit_time if self.admit_time is not None
+            else qs.elapsed_s, 6)
+        qs_dict = qs.as_dict()
+        qs_dict["exchange_modes"] = dict(self.exchange_modes)
+        qs_dict["device_exchange"] = dict(self.device_exchange_info)
+        with self._stats_lock:
+            self.stage_stats = stage_stats
+            self.task_stats = task_stats
+            self.query_stats = qs_dict
+
+    def _device_beacon_collector(self, n_bound: int, nparts: int, cfg):
+        """Host-side sink for the in-program beacons: each NEW
+        (fragment, shard) unit appends one RUNNING sample to the PR 9
+        sampler ring and refreshes the client-poll progress object —
+        progress units are fragment-boundary crossings per shard, so
+        completed counts and cumulative rows are monotonic by
+        construction (parallel/beacons.ProgressCollector)."""
+        from presto_tpu.parallel import beacons
+
+        total_units = max(n_bound, 1) * max(nparts, 1)
+        cap = max(int(cfg.stats_timeseries_capacity), 1)
+
+        def on_progress(completed: int, total: int, rows: int) -> None:
+            sample = {
+                "t": round(ev.now(), 6),
+                "state": "RUNNING",
+                "splits_total": total,
+                "splits_queued": 0,
+                "splits_running": max(total - completed, 0),
+                "splits_completed": completed,
+                "input_rows": rows,
+                "output_rows": 0,
+                "output_bytes": 0,
+                "peak_memory_bytes": 0,
+                "exchange_backlog": 0,
+                "pages_enqueued": 0,
+                "pages_spooled": 0,
+                "jit_dispatches": 1,
+            }
+            with self._stats_lock:
+                self.timeseries.append(sample)
+                if len(self.timeseries) > cap:
+                    del self.timeseries[:len(self.timeseries) - cap]
+                self._progress = {
+                    "totalSplits": total,
+                    "queuedSplits": 0,
+                    "runningSplits": max(total - completed, 0),
+                    "completedSplits": completed,
+                    "processedRows": rows,
+                    "processedBytes": 0,
+                    "peakMemoryBytes": 0,
+                    "progressPercent": round(
+                        100.0 * completed / total, 2) if total else 0.0,
+                }
+
+        return beacons.ProgressCollector(
+            total_units, on_progress=on_progress,
+            on_beacon=getattr(self.co, "_beacon_test_hook", None))
+
+    def _settle_device_progress(self, collector) -> None:
+        """Final progress settle after the program returned (the device
+        analogue of the final _collect_stats sample): every unit
+        complete, processed rows from the query rollup."""
+        completed, total, rows = collector.snapshot()
+        qs = self.query_stats or {}
+        with self._stats_lock:
+            self._progress = {
+                "totalSplits": total, "queuedSplits": 0,
+                "runningSplits": 0, "completedSplits": total,
+                "processedRows": max(rows, qs.get("output_rows", 0)),
+                "processedBytes": qs.get("device_exchange_bytes", 0),
+                "peakMemoryBytes": qs.get("peak_memory_bytes", 0),
+                "progressPercent": 100.0,
+            }
+
+    _COLLECTIVE_OF = {"hash": "all_to_all", "arbitrary": "all_to_all",
+                      "broadcast": "all_gather", "single": "gather"}
+
+    def _boundary_footer(self, dplan: DistributedPlan,
+                         boundaries: Optional[List[Dict]] = None
+                         ) -> List[str]:
+        """EXPLAIN ANALYZE footer naming the exchange mode per fragment
+        boundary — 'via http' on the wire plane, 'via <collective>'
+        with rows/bytes when the device tier served the query."""
+        consumers: Dict[int, List[int]] = {}
+        for f in dplan.fragments:
+            for fid in f.consumed_fragments:
+                consumers.setdefault(fid, []).append(f.fragment_id)
+        mode = "device" if "device" in self.exchange_modes else "http"
+        lines = [f"exchange boundaries ({mode}):"]
+        if boundaries:
+            for b in boundaries:
+                fid, kind = b["fragment"], b["kind"]
+                cons = consumers.get(fid) or ["?"]
+                cid = cons.pop(0) if len(cons) > 1 else cons[0]
+                lines.append(
+                    f"  f{fid}->f{cid} {kind} via "
+                    f"{self._COLLECTIVE_OF.get(kind, kind)}: "
+                    f"rows={sum(b.get('rows', []))} "
+                    f"bytes={sum(b.get('bytes', []))}")
+            return lines
+        for f in dplan.fragments:
+            for fid in f.consumed_fragments:
+                kind = dplan.fragments[fid].output_partitioning[0]
+                lines.append(
+                    f"  f{fid}->f{f.fragment_id} {kind} via http")
+        return lines if len(lines) > 1 else []
+
+    def _render_analyze_device(self, dplan: DistributedPlan,
+                               info: Dict) -> str:
+        """Distributed EXPLAIN ANALYZE for the collective tier: the
+        fragment plan with PER-SHARD rows/bytes tables from the
+        program's own counters — the operator-stats table of the HTTP
+        renderer collapses to shard granularity because the whole DAG
+        is one fused program (there are no per-operator dispatches to
+        time), but the fragment structure, stage lines, hot totals, and
+        serving footer keep the same shape so the two tiers stay
+        diffable."""
+        from presto_tpu.sql.plan import format_plan
+
+        nparts = max(int(info.get("nparts") or 1), 1)
+        per = info.get("per_shard") or {}
+        frag_rows = per.get("fragments") or {}
+        boundaries = info.get("boundaries", [])
+        bytes_by_frag: Dict[int, List[int]] = {}
+        rows_by_frag: Dict[int, List[int]] = {}
+        for b in boundaries:
+            acc = bytes_by_frag.setdefault(b["fragment"], [0] * nparts)
+            racc = rows_by_frag.setdefault(b["fragment"], [0] * nparts)
+            for s in range(min(nparts, len(b.get("bytes", [])))):
+                acc[s] += int(b["bytes"][s])
+                racc[s] = max(racc[s], int(b["rows"][s]))
+        lines: List[str] = []
+        header = (f"{'shard':<8} {'in rows':>11} {'out rows':>11} "
+                  f"{'exchanged rows':>15} {'exchanged bytes':>16}")
+        for f in dplan.fragments:
+            fid = f.fragment_id
+            out_kind, out_ch = f.output_partitioning
+            lines.append(
+                f"Fragment {fid} [{f.partitioning}] x{nparts} shards "
+                f"=> output {out_kind}{list(out_ch) if out_ch else ''} "
+                f"(device)")
+            for ln in format_plan(f.root).splitlines():
+                lines.append("    " + ln)
+            fr = frag_rows.get(fid, {})
+            lines.append("    " + header)
+            lines.append("    " + "-" * len(header))
+            for s in range(nparts):
+                def at(key: str, table=fr) -> int:
+                    vals = table.get(key) or []
+                    return int(vals[s]) if s < len(vals) else 0
+
+                xb = bytes_by_frag.get(fid, [0] * nparts)[s]
+                xr = rows_by_frag.get(fid, [0] * nparts)[s]
+                lines.append(
+                    f"    {s:<8} {at('input_rows'):>11} "
+                    f"{at('output_rows'):>11} {xr:>15} {xb:>16}")
+            lines.append(
+                f"    stage: input {sum(fr.get('input_rows') or [0])} "
+                f"rows, output {sum(fr.get('output_rows') or [0])} rows, "
+                f"exchanged {sum(bytes_by_frag.get(fid, [0]))} bytes")
+        lines.extend(self._boundary_footer(dplan, boundaries))
+        peak = max([int(v) for v in per.get("peak_live_bytes") or []]
+                   or [0])
+        compile_ns = int(info.get("compile_ns") or 0)
+        lines.append(
+            f"device program: 1 SPMD dispatch over {nparts} shards, "
+            f"compiles: {0 if info.get('program_cached') else 1} "
+            f"({compile_ns / 1e6:.1f} ms compile"
+            + (", program cache hit" if info.get("program_cached")
+               else "")
+            + f"), cap_scale={info.get('cap_scale', 1)}, "
+            f"peak live-intermediate ~{peak / (1 << 20):.2f} MiB/shard")
+        if info.get("kernel_tiers"):
+            lines.append("kernel tiers: "
+                         + ", ".join(info["kernel_tiers"]))
+        qs = self.query_stats or {}
+        lines.append(
+            f"query: jit dispatches: {qs.get('jit_dispatches', 1)}, "
+            f"compiles: {qs.get('jit_compiles', 0)} "
+            f"({qs.get('jit_compile_ns', 0) / 1e6:.1f} ms compile); "
+            f"trace token: {self.trace_token}")
+        lines.append(
+            f"serving: queued {qs.get('queued_s', 0.0):.3f} s, "
+            f"execution {qs.get('execution_s', 0.0):.3f} s"
+            + (", plan cache hit" if self.plan_cached else ""))
+        return "\n".join(lines)
 
     def _lookup_plan_cache(self, key_sql: str):
         """Plan-cache probe (sql/plancache.py): a hit returns
@@ -1058,6 +1350,7 @@ class QueryExecution:
                     f"{st['exchange_fetched']}f/"
                     f"{st['exchange_consumed']}c/"
                     f"{st['exchange_purged']}p")
+        lines.extend(self._boundary_footer(dplan))
         lines.extend(_hot_operator_lines(hot))
         qs = self.query_stats
         if qs:
@@ -2948,6 +3241,16 @@ class CoordinatorServer:
         # nothing anyway.
         self._mesh_executors: Dict[Tuple, object] = {}
         self.mesh_executor_lock = threading.Lock()
+        # device-exchange observability counters (/metrics:
+        # presto_device_exchange_{queries,bytes,fallback}_total) —
+        # queries served, bytes moved per boundary mode, and fallbacks
+        # to the HTTP plane by reason category
+        self.device_exchange_counters: Dict = {
+            "queries": 0, "bytes": {}, "fallbacks": {}}
+        self._dx_lock = threading.Lock()
+        # test hook: called (fragment, shard, rows) on EVERY progress
+        # beacon (the slow-task-style hold for mid-query progress tests)
+        self._beacon_test_hook = None
         self.grants = GrantStore()
         self.authenticator = authenticator
         self.internal_auth = (InternalAuthenticator(internal_secret)
@@ -3295,15 +3598,36 @@ class CoordinatorServer:
                                         daemon=True, name="coordinator-http")
         self._thread.start()
 
+    def count_device_fallback(self, kind: str) -> None:
+        """One query fell back from the collective tier to the HTTP
+        plane for this reason category (bounded label set)."""
+        with self._dx_lock:
+            fb = self.device_exchange_counters["fallbacks"]
+            fb[kind] = fb.get(kind, 0) + 1
+
+    def count_device_success(self, boundaries: List[Dict]) -> None:
+        """One query was served by the collective tier: count it and
+        the bytes each boundary mode moved (per-shard sums)."""
+        with self._dx_lock:
+            self.device_exchange_counters["queries"] += 1
+            by_mode = self.device_exchange_counters["bytes"]
+            for b in boundaries:
+                kind = b.get("kind", "?")
+                by_mode[kind] = by_mode.get(kind, 0) + \
+                    sum(int(v) for v in b.get("bytes", []))
+
     def mesh_executor(self, cfg, nparts: int):
         """The shared mesh runner for one (shard count, lowering knobs)
         shape.  Callers hold ``mesh_executor_lock`` around execute +
-        last_run_info readback."""
+        last_run_info readback.  ``mesh_progress_beacons`` keys the
+        runner too: beacons are traced INTO the program, so on/off must
+        compile distinct programs."""
         from presto_tpu.parallel.sqlmesh import MeshQueryRunner
 
         key = (nparts, cfg.partitioned_join_build,
                cfg.grouped_mesh_execution, cfg.direct_groupby_max_domain,
-               cfg.device_join_probe_max_build_rows)
+               cfg.device_join_probe_max_build_rows,
+               cfg.mesh_progress_beacons)
         runner = self._mesh_executors.get(key)
         if runner is None:
             runner = MeshQueryRunner(self.registry, self.default_catalog,

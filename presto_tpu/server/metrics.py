@@ -184,6 +184,34 @@ def _resource_group_families(manager) -> List[Family]:
     ]
 
 
+def _device_exchange_families(co) -> List[Family]:
+    """presto_device_exchange_{queries,bytes,fallback}_total: the
+    collective data plane's scrape surface — queries served as ONE SPMD
+    program, bytes moved per boundary mode (from the program's own
+    per-shard counters), and HTTP-plane fallbacks by reason category
+    (the bounded-label form of QueryExecution.device_exchange_info)."""
+    dx = getattr(co, "device_exchange_counters", None) or {}
+    with getattr(co, "_dx_lock", threading.Lock()):
+        queries = dx.get("queries", 0)
+        by_mode = dict(dx.get("bytes", {}))
+        fallbacks = dict(dx.get("fallbacks", {}))
+    return [
+        ("presto_device_exchange_queries_total", "counter",
+         "queries served by the device-sharded exchange tier "
+         "(whole fragment DAG as one SPMD program)",
+         [({}, queries)]),
+        ("presto_device_exchange_bytes_total", "counter",
+         "bytes moved through in-program collectives per boundary mode",
+         [({"mode": m}, v) for m, v in sorted(by_mode.items())]
+         or [({"mode": "hash"}, 0)]),
+        ("presto_device_exchange_fallback_total", "counter",
+         "collective-tier queries that fell back to the HTTP plane, "
+         "by reason category",
+         [({"reason": r}, v) for r, v in sorted(fallbacks.items())]
+         or [({"reason": "none"}, 0)]),
+    ]
+
+
 def coordinator_metrics(co) -> str:
     """Render the coordinator's /metrics payload from live state."""
     by_state: Dict[str, int] = {}
@@ -232,6 +260,7 @@ def coordinator_metrics(co) -> str:
     ]
     fams.extend(_resource_group_families(
         getattr(co, "resource_groups", None)))
+    fams.extend(_device_exchange_families(co))
     fams.extend(_plan_cache_families("presto"))
     fams.extend(_spool_families("presto", getattr(co, "spool", None)))
     fams.extend(_kernel_cache_families("presto"))

@@ -128,6 +128,9 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
         "partitioned_join_build",
         lambda v: v.lower() in ("true", "1", "on")),
     "grouped_mesh_execution": ("grouped_mesh_execution", int),
+    "mesh_progress_beacons": (
+        "mesh_progress_beacons",
+        lambda v: v.lower() in ("true", "1", "on")),
     "stats_sampling_enabled": (
         "stats_sampling_enabled",
         lambda v: v.lower() in ("true", "1", "on")),

@@ -50,9 +50,13 @@ class QuerySpan:
         }
 
 
-#: coordinator phase order in the rendered timeline
+#: coordinator phase order in the rendered timeline.  "lower" and
+#: "compile" exist only for device-exchange queries that BUILT their
+#: SPMD program this run (trace+lower wall vs XLA-compile wall, the
+#: kernelcache.timed_first_call attribution); a program-cache hit
+#: records neither and its query reports compile_ns=0.
 PHASES = ("queue", "parse", "analyze", "optimize", "fragment", "schedule",
-          "execute")
+          "lower", "compile", "execute")
 
 
 def _clamp(start: float, end: float, lo: float, hi: float

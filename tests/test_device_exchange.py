@@ -15,6 +15,14 @@ Covers the PR 11 acceptance pins:
 - the partitioned lookup source (P8) and bucket-sequential grouped
   execution (P9) tiers hold parity on the mesh runner, and the
   exchange-mode / kernel-tier counters land in the stats rollup.
+
+And the PR 12 telemetry plane (TestDeviceTelemetry): per-shard stats
+read OUT of the SPMD program render in distributed EXPLAIN ANALYZE and
+fold into stageStats/taskStats on /v1/query/{id}; progress beacons make
+a mid-program client poll observe >=2 RUNNING samples with monotonic
+progress; beacons OFF restores the PR 11 sampling surfaces exactly;
+the span tree (with lower/compile attribution) round-trips through
+query.json; and fallback reasons / device bytes land on /metrics.
 """
 
 import dataclasses as dc
@@ -178,6 +186,216 @@ class TestForcedFallback:
         q = _last_query(dev)
         assert q._tasks_scheduled
         assert set(q.exchange_modes) == {"http"}
+
+
+class TestDeviceTelemetry:
+    def test_explain_analyze_renders_per_shard(self, clusters):
+        """Distributed EXPLAIN ANALYZE of a mesh query: per-fragment
+        sections with one row PER SHARD (in/out rows + exchanged
+        bytes), the boundary footer naming the collective per
+        boundary, and the single-dispatch program line."""
+        _http, dev = clusters
+        res = dev.execute("explain analyze " + TPCH[3])
+        text = "\n".join(r[0] for r in res.rows)
+        q = _last_query(dev)
+        assert set(q.exchange_modes) == {"device"}
+        assert "shard" in text and "exchanged bytes" in text
+        assert "exchange boundaries (device):" in text
+        assert "all_to_all" in text and "gather" in text
+        assert "1 SPMD dispatch" in text
+        # per-shard rows: both shards of a sharded fragment render
+        assert "x2 shards" in text
+
+    def test_http_analyze_gains_boundary_footer(self, clusters):
+        """The wire tier's EXPLAIN ANALYZE names its boundaries too, so
+        the two tiers stay diffable on the same footer shape."""
+        http, _dev = clusters
+        res = http.execute("explain analyze " + TPCH[6])
+        text = "\n".join(r[0] for r in res.rows)
+        assert "exchange boundaries (http):" in text
+        assert "via http" in text
+
+    def test_query_detail_stage_task_stats(self, clusters):
+        """/v1/query/{id} of a mesh query carries real per-fragment
+        stageStats and synthetic per-shard taskStats — the same
+        payload shape an HTTP query fills from remote task info."""
+        import json
+        import urllib.request
+
+        _http, dev = clusters
+        dev.execute(TPCH[3])
+        q = _last_query(dev)
+        with urllib.request.urlopen(
+                f"{dev.coordinator.uri}/v1/query/{q.query_id}") as r:
+            d = json.loads(r.read())
+        assert d["stageStats"] and d["taskStats"]
+        # sharded fragments fold one task per shard, FINISHED, with
+        # rows and device-boundary bytes
+        flat = [ts for lst in d["taskStats"].values() for ts in lst]
+        assert any(ts["output_rows"] > 0 for ts in flat)
+        assert any(ts["device_exchange_bytes"] > 0 for ts in flat)
+        assert all(ts["state"] == "FINISHED" for ts in flat)
+        sharded = [fid for fid, st in d["stageStats"].items()
+                   if st["tasks"] == 2]
+        assert sharded, "no sharded stage folded 2 per-shard tasks"
+        # the ONE program dispatch lands on the rollup
+        assert d["queryStats"]["jit_dispatches"] == 1
+        assert d["queryStats"]["device_exchange_bytes"] > 0
+        assert d["deviceExchange"]["per_shard"]["fragments"]
+
+    def test_mid_query_progress_beacons(self, clusters):
+        """The acceptance pin: while the SPMD program executes (held by
+        the beacon test hook), a client poll observes >=2 RUNNING
+        samples with monotonically increasing progress, and the
+        sampler ring fills mid-program."""
+        import threading
+        import time
+
+        _http, dev = clusters
+        co = dev.coordinator
+        sql = TPCH[3]
+        known = set(co.queries)
+
+        def hook(_fid, _shard, _rows):
+            time.sleep(0.25)
+
+        co._beacon_test_hook = hook
+        try:
+            done = []
+            t = threading.Thread(
+                target=lambda: done.append(dev.execute(sql)))
+            t.start()
+            polls = []
+            deadline = time.time() + 60
+            q = None
+            while time.time() < deadline and t.is_alive():
+                if q is None:
+                    fresh = [co.queries[k] for k in co.queries
+                             if k not in known]
+                    q = fresh[-1] if fresh else None
+                if q is not None:
+                    stats = q.protocol_stats()
+                    if stats["state"] == "RUNNING" \
+                            and "progressPercent" in stats:
+                        polls.append(stats["progressPercent"])
+                time.sleep(0.02)
+            t.join(timeout=60)
+            assert done, "query did not finish"
+        finally:
+            co._beacon_test_hook = None
+        running = polls
+        assert len(running) >= 2, f"saw {len(running)} RUNNING polls"
+        assert running == sorted(running), "progress regressed"
+        assert running[-1] > running[0], "progress never advanced"
+        # the sampler ring filled MID-program with monotonic units
+        ring = [s for s in q.timeseries if s["state"] == "RUNNING"]
+        assert len(ring) >= 2
+        completed = [s["splits_completed"] for s in ring]
+        assert completed == sorted(completed)
+        # and the final settle reports 100%
+        assert q._progress["progressPercent"] == 100.0
+
+    def test_beacons_off_restores_pr11_sampling(self):
+        """mesh_progress_beacons=false traces a beacon-free program:
+        no mid-run samples, no progress object — the PR 11 sampling
+        surfaces for a device query, exactly — while the per-shard
+        stats rollup (program outputs, not callbacks) stays intact."""
+        cfg = dc.replace(DEV_CFG, mesh_progress_beacons=False)
+        with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                         config=cfg) as dev:
+            rows = dev.execute(TPCH[6]).rows
+            q = _last_query(dev)
+            assert rows
+            assert set(q.exchange_modes) == {"device"}
+            assert q.timeseries == []
+            assert q._progress == {}
+            # tentpole (a) is beacon-independent: stats still fold
+            assert q.stage_stats and q.task_stats
+            assert q.query_stats["jit_dispatches"] == 1
+
+    def test_span_roundtrip_query_json(self, tmp_path):
+        """The span tree of a mesh query — with lower/compile phases
+        from the program build — validates structurally and
+        round-trips through QueryCompletedEvent/query.json identical
+        to the live /v1/query/{id}/spans payload."""
+        import json
+        import urllib.request
+
+        from presto_tpu.spans import validate_span_tree
+
+        log = tmp_path / "query.json"
+        with DistributedQueryRunner.tpch(
+                scale=0.01, n_workers=2, config=DEV_CFG,
+                event_log_path=str(log)) as dev:
+            dev.execute(TPCH[6])
+            q = _last_query(dev)
+            with urllib.request.urlopen(
+                    f"{dev.coordinator.uri}/v1/query/"
+                    f"{q.query_id}/spans") as r:
+                live = json.loads(r.read())
+        records = [json.loads(ln) for ln in
+                   log.read_text().splitlines()]
+        completed = [r for r in records
+                     if r["event"] == "QueryCompletedEvent"
+                     and r["query_id"] == q.query_id]
+        assert completed, "no QueryCompletedEvent in query.json"
+        tree = completed[-1]["spans"]
+        assert validate_span_tree(tree) == []
+        names = [c["name"] for c in tree["children"]]
+        # the program was BUILT by this fresh cluster: lower + compile
+        # phases recorded, execute always
+        assert "execute" in names
+        assert "lower" in names and "compile" in names
+        assert any(c["kind"] == "stage" for c in tree["children"])
+        # live endpoint serves the same phases for the same query
+        assert [c["name"] for c in live["children"]] == names
+
+    def test_fallback_and_device_metrics(self, clusters):
+        """/metrics: fallback reasons (bounded labels) from the
+        recorded device_exchange_info, plus served-query and
+        per-mode byte counters from the per-shard telemetry."""
+        import urllib.request
+
+        _http, dev = clusters
+        # one served query and one forced fallback
+        dev.execute(TPCH[6])
+        dev.execute("select approx_percentile(l_quantity, 0.5) "
+                    "from tpch.lineitem")
+        q = _last_query(dev)
+        assert q.device_exchange_info.get("fallback")
+        assert q.device_exchange_info.get("fallback_kind")
+        with urllib.request.urlopen(
+                f"{dev.coordinator.uri}/metrics") as r:
+            body = r.read().decode()
+        lines = [ln for ln in body.splitlines()
+                 if ln.startswith("presto_device_exchange")]
+        q_total = [ln for ln in lines
+                   if ln.startswith("presto_device_exchange_queries")]
+        assert q_total and float(q_total[0].split()[-1]) >= 1
+        assert any(ln.startswith("presto_device_exchange_bytes_total"
+                                 '{mode="hash"}')
+                   and float(ln.split()[-1]) > 0 for ln in lines)
+        fb = [ln for ln in lines
+              if ln.startswith("presto_device_exchange_fallback_total")
+              and 'reason="none"' not in ln]
+        assert fb and sum(float(ln.split()[-1]) for ln in fb) >= 1
+
+    def test_program_cache_hit_reports_zero_compile(self, clusters):
+        """Cross-query program-cache hits: the second execution of a
+        statement reports compile_ns=0 / program_cached=true while the
+        first paid (and recorded) the build."""
+        _http, dev = clusters
+        sql = ("select sum(l_extendedprice) from tpch.lineitem "
+               "where l_quantity < 10")
+        dev.execute(sql)
+        first = _last_query(dev).device_exchange_info
+        dev.execute(sql)
+        second = _last_query(dev).device_exchange_info
+        assert not first["program_cached"]
+        assert first["compile_ns"] > 0
+        assert second["program_cached"]
+        assert second["compile_ns"] == 0
+        assert _last_query(dev).query_stats["jit_compiles"] == 0
 
 
 class TestMeshJoinTiers:

@@ -151,6 +151,30 @@ class TestExchangeReport:
         assert "data plane: collective" in out
         assert "hash" in out and "single" in out
 
+    def test_live_per_shard_bytes_and_q3_pin(self, capsys):
+        """--live executes on a real mesh and reports per-boundary
+        rows/bytes from the program's per-shard telemetry; --check pins
+        TPC-H Q3 reporting nonzero device-boundary bytes on EVERY
+        collective boundary."""
+        import importlib
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        exchange_report = importlib.import_module("exchange_report")
+        rc = exchange_report.main(["q3", "--scale", "0.002", "--live",
+                                   "--check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "live mesh: 2 shards" in out
+        assert "bytes/shard" in out
+        assert "all_to_all" in out and "gather" in out
+        # every rendered boundary row carries a nonzero byte total
+        for ln in out.splitlines():
+            if ln.strip().startswith("f") and "all_" in ln:
+                assert ln.split()[-1].isdigit()
+
     def test_segments_column_names_boundary_roles(self, capsys):
         import importlib
         import os

@@ -22,11 +22,20 @@ exchange-feeding (partition-id computing) and exchange-fed (page
 coalescing) segment programs are exactly the work the collective tier
 splices away.
 
+With ``--live`` the report EXECUTES each query on a real
+``MeshQueryRunner`` mesh and adds per-boundary rows/bytes columns from
+the per-shard telemetry the SPMD program itself reports (PR 12): what
+each shard actually received through every ``all_to_all`` /
+``all_gather`` / gather, not the planning-time view.
+
 Usage:
     python tools/exchange_report.py                 # all TPC-H
     python tools/exchange_report.py q3 tpcds/q72    # subset
     python tools/exchange_report.py --check         # CI smoke: exit 1
         unless TPC-H Q3's boundaries ALL lower to the collective tier
+    python tools/exchange_report.py --live --check  # ALSO execute Q3 on
+        the mesh and require nonzero device-boundary bytes on every
+        collective boundary
 """
 
 import argparse
@@ -36,6 +45,15 @@ import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if "--live" in sys.argv and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # a live mesh run needs >1 virtual device for real collectives;
+    # only effective when jax has not been imported yet (standalone CLI
+    # use — the test suite already forces an 8-device host platform)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_"
+                                 "count=8").strip()
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -74,6 +92,20 @@ def boundary_rows(dplan, all_eligible):
     return rows
 
 
+def live_boundary_report(runner, sql: str) -> list:
+    """Execute ``sql`` on the mesh runner and return its per-boundary
+    telemetry rows: (kind, collective, per-shard rows, per-shard
+    bytes) straight from the program's own per-shard counters."""
+    runner.execute(sql)
+    info = runner.last_run_info
+    collective = {"hash": "all_to_all", "arbitrary": "all_to_all",
+                  "broadcast": "all_gather", "single": "gather"}
+    return [(b["fragment"], b["kind"],
+             collective.get(b["kind"], b["kind"]),
+             b.get("rows", []), b.get("bytes", []))
+            for b in info.get("boundaries", [])]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("queries", nargs="*",
@@ -81,9 +113,18 @@ def main(argv=None) -> int:
     ap.add_argument("--scale", type=float, default=0.01)
     ap.add_argument("--segments", action="store_true",
                     help="also list boundary-adjacent fused segments")
+    ap.add_argument("--live", action="store_true",
+                    help="execute each query on a MeshQueryRunner and "
+                         "report per-boundary rows/bytes from the "
+                         "per-shard telemetry")
+    ap.add_argument("--shards", type=int, default=2,
+                    help="mesh shard count for --live (clamped to the "
+                         "available devices)")
     ap.add_argument("--check", action="store_true",
                     help="CI smoke: exit 1 unless TPC-H Q3's boundaries "
-                         "all lower to the collective tier")
+                         "all lower to the collective tier (with --live: "
+                         "and report nonzero device bytes on every "
+                         "collective boundary)")
     args = ap.parse_args(argv)
 
     from presto_tpu.config import EngineConfig
@@ -98,8 +139,21 @@ def main(argv=None) -> int:
     cfg = dc.replace(EngineConfig(), mesh_device_exchange=True)
     runner = LocalQueryRunner.tpch(scale=args.scale, config=cfg)
 
+    mesh = None
+    if args.live:
+        import jax
+
+        from presto_tpu.parallel.sqlmesh import MeshQueryRunner
+
+        shards = max(1, min(args.shards, len(jax.devices())))
+        mesh = MeshQueryRunner.tpch(scale=args.scale, n_devices=shards,
+                                    config=cfg)
+        print(f"live mesh: {shards} shards "
+              f"({jax.devices()[0].platform} devices)")
+
     failures = []
     q3_collective = None
+    q3_live_bytes_ok = None
     for catalog, num, sql in load_queries(args.queries):
         label = f"{catalog}/q{num}"
         runner.metadata.default_catalog = catalog
@@ -123,6 +177,24 @@ def main(argv=None) -> int:
         if (catalog, num) == ("tpch", 3):
             q3_collective = all_eligible and all(
                 m == "collective" for _, _, _, m in rows)
+        if mesh is not None and all_eligible:
+            # execute on the mesh: per-boundary rows/bytes straight
+            # from the program's per-shard telemetry
+            mesh.metadata.default_catalog = catalog
+            try:
+                live = live_boundary_report(mesh, sql)
+            except Exception as e:  # noqa: BLE001 - report and continue
+                print(f"  live execution failed: {e}")
+                failures.append((label, "live"))
+                continue
+            print(f"  {'boundary':<12} {'collective':<12} "
+                  f"{'rows/shard':<24} {'bytes/shard':<28} total bytes")
+            for fid, _kind, coll, rws, byt in live:
+                print(f"  f{fid:<11} {coll:<12} {str(rws):<24} "
+                      f"{str(byt):<28} {sum(byt)}")
+            if (catalog, num) == ("tpch", 3):
+                q3_live_bytes_ok = bool(live) and all(
+                    sum(byt) > 0 for _, _, _, _, byt in live)
         if args.segments:
             # lower each fragment the way a worker task would (stub
             # producer URIs, real output sinks) so the boundary-adjacent
@@ -160,12 +232,20 @@ def main(argv=None) -> int:
                               f"{role}: {desc}")
     if args.check:
         if q3_collective is None:
-            # --check without q3 in the set: plan it now
-            rc = main(["q3", "--scale", str(args.scale)])
+            # --check without q3 in the set: plan (and with --live,
+            # execute) it now
+            extra = (["--live", "--shards", str(args.shards)]
+                     if args.live else [])
+            rc = main(["q3", "--scale", str(args.scale), "--check"]
+                      + extra)
             return rc if rc else 0
         if not q3_collective:
             print("FAIL: TPC-H Q3 boundaries do not lower to the "
                   "collective tier")
+            return 1
+        if args.live and not q3_live_bytes_ok:
+            print("FAIL: TPC-H Q3 live run did not report nonzero "
+                  "device-boundary bytes on every collective boundary")
             return 1
         if failures:
             return 1
