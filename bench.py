@@ -1326,6 +1326,12 @@ def _bench_tpcds_mesh(scale: float, spooling: bool):
                             for v in r) for r in rows)
 
     cfg = _dc.replace(DEFAULT, exchange_spooling_enabled=spooling)
+    # the spooled config swings wildly across single-shot runs
+    # (158-742 rows/s observed in the PR 12 variance investigation:
+    # write-through timing vs the 0.1s stats sampler beats) — report
+    # the MEDIAN of 3 mesh executions per query so perf_regress
+    # --check gates on the trend, not the noise
+    runs = 3 if spooling else 1
     out = {}
     with DistributedQueryRunner.tpcds(scale=scale, n_workers=2,
                                       config=cfg) as dqr:
@@ -1333,14 +1339,20 @@ def _bench_tpcds_mesh(scale: float, spooling: bool):
             t0 = time.perf_counter()
             want = local.execute(DS[qn]).rows
             t_local = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            got = dqr.execute(DS[qn]).rows
-            t_mesh = time.perf_counter() - t0
+            mesh_times, parity = [], True
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                got = dqr.execute(DS[qn]).rows
+                mesh_times.append(time.perf_counter() - t0)
+                parity = parity and norm(got) == norm(want)
+            t_mesh = sorted(mesh_times)[len(mesh_times) // 2]
             out[qn] = {"mesh_s": round(t_mesh, 3),
                        "local_s": round(t_local, 3),
-                       "parity": norm(got) == norm(want)}
+                       "mesh_runs_s": [round(t, 3)
+                                       for t in mesh_times],
+                       "parity": parity}
     suffix = "_spooled" if spooling else ""
-    return {
+    row = {
         "metric": f"tpcds_sf{scale:g}_q72q95_mesh_2worker"
                   f"{suffix}_fact_rows_per_sec",
         "value": round(n_rows / (out[72]["mesh_s"] + out[95]["mesh_s"]),
@@ -1350,9 +1362,17 @@ def _bench_tpcds_mesh(scale: float, spooling: bool):
             / (out[72]["mesh_s"] + out[95]["mesh_s"]), 3),
         "engine_path": True, "distributed": True, "workers": 2,
         "exchange_spooling": spooling,
+        "runs": runs, "aggregation": "median" if runs > 1 else "single",
         "q72": out[72], "q95": out[95],
         "parity": out[72]["parity"] and out[95]["parity"],
     }
+    if spooling:
+        # documented run-to-run spread of this config on the 1-core CI
+        # host (PR 12 investigation: 158-742 rows/s across reruns of
+        # one tree) — perf_regress widens its gate to this band for
+        # THIS config only, so the trajectory check gates on the trend
+        row["noise_band"] = 0.6
+    return row
 
 
 def bench_tpcds_mesh_q72q95(scale: float):
@@ -1396,6 +1416,28 @@ def bench_concurrent_qps(scale: float):
                                   "p95_ms", "p99_ms", "parity")}
         row["plan_cache_hit_rate"] = lv["plan_cache"]["hit_rate"]
         levels.append(row)
+    # hot-repeat tier (server/resultcache.py): the SAME dashboard-shape
+    # worklist with the cross-query result cache on vs off — the on/off
+    # ratio is the serving-tier headline a hit costs one spool lookup
+    # instead of a full execution.  Parity is per request in both runs.
+    hot = {}
+    for label, rc in (("cache_on", True), ("cache_off", False)):
+        rep = qps_run.run_qps(scale=scale, levels=(4,),
+                              requests_per_client=10, mode="closed",
+                              quiet=True, hot_repeat=True,
+                              result_cache=rc)
+        lv = rep["levels"][0]
+        hot[label] = {
+            "qps": lv["qps"], "p50_ms": lv["p50_ms"],
+            "p95_ms": lv["p95_ms"], "parity": rep["parity"],
+            "result_cache_hit_rate": rep["result_cache_hit_rate"],
+            "result_cache_bytes_served":
+                rep["result_cache_bytes_served"]}
+    hot["speedup"] = round(
+        hot["cache_on"]["qps"] / hot["cache_off"]["qps"], 2) \
+        if hot["cache_off"]["qps"] else 0.0
+    hot["parity"] = (hot["cache_on"]["parity"]
+                     and hot["cache_off"]["parity"])
     return {
         "metric": f"tpcds_sf{scale:g}_concurrent_qps_peak",
         "value": peak, "unit": "qps",
@@ -1409,7 +1451,8 @@ def bench_concurrent_qps(scale: float):
         "second_run_jit_compiles": report["second_run_jit_compiles"],
         "queries_queued": report["queries_queued"],
         "resource_groups": report["resource_groups"],
-        "parity": report["parity"],
+        "hot_repeat": hot,
+        "parity": report["parity"] and hot["parity"],
     }
 
 

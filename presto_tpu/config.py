@@ -305,6 +305,23 @@ class EngineConfig:
     # are removed (crashed-coordinator leftovers); the age guard keeps a
     # shared spool root safe across concurrent clusters
     exchange_spool_orphan_age_s: float = 3600.0
+    # spool backing tier: 'fs' = one file per page on the shared
+    # filesystem (the PR 7 tier, restored exactly); 'object' = the
+    # S3/GCS-role ObjectStoreSpoolStore — pages batch in memory and
+    # flush ASYNCHRONOUSLY as multi-page segment objects (compaction
+    # replaces one-file-per-page), with read-through to the FS tier for
+    # pages the object tier does not hold.  Every node of a cluster
+    # must run the same tier (§2.8/§2.9 tiering stance: exchange
+    # durability and result-cache capacity become independent of
+    # worker disks).
+    exchange_spool_tier: str = "fs"
+    # object tier: pending bytes per partition that force a segment
+    # flush ahead of the interval tick
+    exchange_spool_segment_bytes: int = 4 << 20
+    # object tier: background flush cadence for pending pages (writes
+    # are batched + async; set_complete always flushes synchronously so
+    # the COMPLETE marker never precedes its pages)
+    exchange_spool_flush_interval_s: float = 0.05
     # --- serving tier (server/dispatcher.py + sql/plancache.py) ----------
     # plan cache: repeated statements (same normalized SQL, catalog,
     # session-property fingerprint, current per-catalog stats epochs)
@@ -314,6 +331,29 @@ class EngineConfig:
     plan_cache_enabled: bool = True
     # entries kept in the shared plan cache (LRU)
     plan_cache_capacity: int = 128
+    # --- cross-query result cache (server/resultcache.py) ----------------
+    # Serve a REPEATED statement's rows straight from its first
+    # execution's root-output spool pages: zero task scheduling, zero
+    # physical plans, zero jit dispatches — admission/lifecycle still
+    # run through the dispatcher, so resource groups, events, stats,
+    # and the web UI see a FINISHED query with resultCached=true.
+    # Keyed exactly like the plan cache (normalized SQL + catalog +
+    # session fingerprint + per-catalog stats epochs), so any
+    # DML/DDL/ANALYZE invalidates correctly.  Requires
+    # exchange_spooling_enabled (the cache's values ARE spool pages).
+    # Off by default for the same reason mesh_device_exchange is: the
+    # execute-every-statement path stays the reference path the
+    # observability/retry planes instrument, and repeat-statement
+    # stats change shape under a hit; serving deployments (and the
+    # qps/bench hot-repeat configs) turn it on.
+    result_cache_enabled: bool = False
+    # entries kept in the result cache (LRU; eviction deletes the
+    # entry's spool pages)
+    result_cache_capacity: int = 64
+    # largest single result admitted, bytes of spooled wire pages
+    result_cache_max_entry_bytes: int = 16 << 20
+    # total spooled bytes the cache may hold before LRU eviction
+    result_cache_max_total_bytes: int = 256 << 20
     # how long a dispatched query may wait for a resource-group slot
     # before failing with the queue-timeout error (the reference's
     # query.max-queued-time role)

@@ -107,7 +107,12 @@ class SystemConnector(_VirtualConnector):
             # visible while the query is still RUNNING
             ("completed_splits", T.BIGINT),
             ("total_splits", T.BIGINT),
-            ("progress_percent", T.DOUBLE)], queries_fn)
+            ("progress_percent", T.DOUBLE),
+            # cross-query result cache (server/resultcache.py): served
+            # from spool pages with zero execution, and how many wire
+            # bytes came from the cache
+            ("result_cached", T.BOOLEAN),
+            ("result_cache_bytes", T.BIGINT)], queries_fn)
         self.add_table("tasks", [
             ("task_id", T.VARCHAR), ("state", T.VARCHAR),
             ("query_id", T.VARCHAR), ("output_rows", T.BIGINT),

@@ -269,6 +269,12 @@ class QueryStats:
     pages_spooled: int = 0
     pages_evicted: int = 0
     device_exchange_bytes: int = 0
+    # cross-query result cache (server/resultcache.py): 1 when this
+    # query was served ENTIRELY from cached spool pages (its jit /
+    # dispatch / stage counters are then genuine zeros), and the wire
+    # bytes served from the cache
+    result_cached: int = 0
+    result_cache_bytes: int = 0
     stages: int = 0
 
     def add_stage(self, st: StageStats) -> None:

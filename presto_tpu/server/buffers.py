@@ -108,9 +108,13 @@ class OutputBufferManager:
                 buf.pages.append(page)
                 self._bytes += len(page)
                 if self.spool is not None:
-                    # write-through: the page is durable the moment it
-                    # is enqueued (local-FS tier; an object-store tier
-                    # would batch, same contract)
+                    # write-through: the FS tier makes the page durable
+                    # right here; the object tier buffers it and
+                    # flushes asynchronously in segment batches — but
+                    # keeps it servable from THIS node's store
+                    # immediately, so eviction re-serves stay byte-exact
+                    # and set_complete (which flushes synchronously)
+                    # remains the durability barrier recovery checks
                     self.spool.write_page(self.task_id, p, token, page)
                     buf.spooled_to = token + 1
                     self.pages_spooled += 1

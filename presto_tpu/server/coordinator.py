@@ -237,6 +237,14 @@ class QueryExecution:
         # (prepared text + bound parameters), set by _session_statement
         self._plan_key_sql: Optional[str] = None
         self.plan_cached = False      # this run reused a cached plan
+        # this run was served ENTIRELY from the cross-query result
+        # cache (server/resultcache.py): no tasks, no physical plans,
+        # no jit dispatches — rows came straight from spool pages
+        self.result_cached = False
+        self.result_cache_bytes = 0   # spooled wire bytes served
+        # the SpoolStore the served entry lives in (equals co.spool in
+        # practice; kept per-hit so _drain_spool reads the right tier)
+        self._rc_store = None
         self.plan_text: str = ""
         self._tasks_scheduled = False
         # (fragment_id, task_id, worker_uri) per scheduled task — the
@@ -811,6 +819,145 @@ class QueryExecution:
             + (", plan cache hit" if self.plan_cached else ""))
         return "\n".join(lines)
 
+    # -- cross-query result cache (server/resultcache.py) ---------------
+    def _result_cache_key(self, key_sql: str):
+        from presto_tpu.server import resultcache
+        from presto_tpu.sql import plancache
+
+        epochs = plancache.epochs_for(self.co.registry)
+        return resultcache.cache_key(
+            epochs, key_sql, self.catalog, None,
+            self.session_properties), epochs
+
+    def _serve_result_cache(self, key_sql: str) -> bool:
+        """Probe the cross-query result cache; a hit serves the rows
+        straight from the entry's spool pages through the existing
+        spool drain — zero tasks scheduled, zero physical plans built,
+        zero jit dispatches.  The query still reports as a normal
+        FINISHED query (stats rollup, events, /v1/query, web UI) with
+        ``resultCached=true``."""
+        from presto_tpu.exec.context import QueryStats
+        from presto_tpu.server import resultcache
+
+        cfg = self._session().effective_config(self.co.config)
+        if not cfg.result_cache_enabled:
+            return False
+        self._cfg = cfg
+        key, epochs = self._result_cache_key(key_sql)
+        hit = resultcache.get(key, epochs)
+        if hit is None:
+            return False
+        self.result_cached = True
+        self.plan_text = hit.plan_text
+        self.column_names = list(hit.column_names)
+        self.column_types = list(hit.column_types)
+        self._rc_store = hit.store
+        self.state = "RUNNING"
+        locations = [f"spool://v1/task/{hit.task_id}/results/{i}"
+                     for i in range(hit.n_locations)]
+        try:
+            with self._mark("execute"):
+                self._drain(locations)
+        except Exception:  # noqa: BLE001 - entry unreadable
+            # the entry's pages vanished under us (eviction raced the
+            # lookup, or the store errored past its budget): drop the
+            # entry and fall through to a NORMAL execution — a cache
+            # problem must never fail a query the engine can run
+            if self.canceled:
+                raise
+            resultcache.invalidate(key)
+            self.result_cached = False
+            self._rc_store = None
+            self.result_rows = []
+            self.state = "PLANNING"
+            return False
+        resultcache.record_served(hit.bytes)
+        self.result_cache_bytes = hit.bytes
+        # the rollup a hit reports: the serving truth (rows/bytes out,
+        # nothing executed).  jit/dispatch counters are genuine zeros —
+        # the "zero work" pin tests and qps_run read them from here.
+        qs = QueryStats(query_id=self.query_id,
+                        elapsed_s=ev.now() - self.create_time)
+        qs.queued_s = round(self.queued_s, 6)
+        qs.execution_s = round(
+            ev.now() - self.admit_time
+            if self.admit_time is not None else qs.elapsed_s, 6)
+        qs.output_rows = len(self.result_rows)
+        qs.output_bytes = hit.bytes
+        qs.result_cached = 1
+        qs.result_cache_bytes = hit.bytes
+        with self._stats_lock:
+            self.query_stats = qs.as_dict()
+            self._progress = {
+                "totalSplits": 0, "queuedSplits": 0,
+                "runningSplits": 0, "completedSplits": 0,
+                "processedRows": len(self.result_rows),
+                "processedBytes": hit.bytes,
+                "peakMemoryBytes": 0,
+                "progressPercent": 100.0,
+            }
+        return True
+
+    def _maybe_admit_result_cache(self, dplan) -> None:
+        """Admit this (successful, task-scheduled, spooled) execution's
+        root-output pages into the result cache.  Strictly best-effort
+        and post-drain: adoption copies the root stream(s) out of the
+        query's spool directory into a stable ``rc*`` id BEFORE the
+        query's own spool GC, so the entry outlives the query."""
+        from presto_tpu.server import resultcache
+        from presto_tpu.server.spool import query_id_of
+        from presto_tpu.sql import plancache
+
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        if not (cfg.result_cache_enabled and self._spool_enabled()):
+            return
+        if (not self._tasks_scheduled or self.canceled
+                or self.error is not None):
+            return
+        cats = {self.catalog}
+        for f in dplan.fragments:
+            cats |= plancache.scan_catalogs(f.root)
+        if any(c in resultcache.UNCACHEABLE_CATALOGS for c in cats):
+            # live engine state (system.runtime...) has no stats epoch
+            # to invalidate on — rows over it must never be replayed
+            return
+        with self._recovery_lock:
+            root_tids = list(self._frag_tasks.get(
+                dplan.root_fragment_id) or [])
+        if not root_tids:
+            return
+        store = self.co.spool
+        rc_tid = resultcache.new_task_id()
+        total = 0
+        try:
+            for i, tid in enumerate(root_tids):
+                pages = resultcache.read_complete_stream(
+                    store, tid, 0,
+                    max_bytes=cfg.result_cache_max_entry_bytes
+                    - total)
+                if pages is None:
+                    raise ValueError("stream not adoptable")
+                for tok, page in enumerate(pages):
+                    store.write_page(rc_tid, i, tok, page)
+                store.set_complete(rc_tid, i, len(pages))
+                total += sum(len(p) for p in pages)
+        except Exception:  # noqa: BLE001 - admission never fails a query
+            try:
+                store.delete_query(query_id_of(rc_tid))
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        key, epochs = self._result_cache_key(
+            self._plan_key_sql or self.sql)
+        resultcache.put(
+            key,
+            resultcache.CachedResult(
+                rc_tid, len(root_tids), list(self.column_names),
+                list(self.column_types), len(self.result_rows), total,
+                store, self.plan_text),
+            epochs, cats, cfg.result_cache_capacity,
+            cfg.result_cache_max_total_bytes)
+
     def _lookup_plan_cache(self, key_sql: str):
         """Plan-cache probe (sql/plancache.py): a hit returns
         (DistributedPlan, plan text) and means parse/analyze/optimize
@@ -869,17 +1016,32 @@ class QueryExecution:
             # skipped.  Only plain queries are inserted under their raw
             # text (EXECUTE keys include the prepared text + parameters,
             # so a re-PREPARE under the same name can never alias).
+            # result-cache probe first (server/resultcache.py): a hit
+            # serves the repeated statement's rows straight from spool
+            # pages — parse, planning, scheduling, and execution are
+            # ALL skipped (the plan cache is not even consulted)
+            if self._serve_result_cache(self.sql):
+                self.state = "FINISHED"
+                return
             cached = self._lookup_plan_cache(self.sql)
             if cached is not None:
                 dplan, self.plan_text = cached
                 self.plan_cached = True
                 self._execute_query_dplan(dplan, analyze=False)
+                self._maybe_admit_result_cache(dplan)
                 self.state = "FINISHED"
                 return
             with self._mark("parse"):
                 stmt = parse_statement(self.sql)
             stmt = self._session_statement(stmt)
             if stmt is None:
+                self.state = "FINISHED"
+                return
+            if self._plan_key_sql is not None and \
+                    self._serve_result_cache(self._plan_key_sql):
+                # EXECUTE-bound statements key on (prepared text +
+                # bound parameters), so the probe runs after binding —
+                # a re-PREPARE under the same name can never alias
                 self.state = "FINISHED"
                 return
             if isinstance(stmt, t.CallProcedure):
@@ -942,6 +1104,8 @@ class QueryExecution:
             dplan = self._plan_query(stmt, metadata, cfg,
                                      cacheable=not analyze)
             self._execute_query_dplan(dplan, analyze)
+            if not analyze:
+                self._maybe_admit_result_cache(dplan)
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - query failure surface
             # keep a more specific error set by a killer (low-memory,
@@ -2874,13 +3038,15 @@ class QueryExecution:
         from presto_tpu.server.spool import parse_spool_url
 
         tid, part = parse_spool_url(loc)
-        return self.co.spool.get_pages(tid, part, token, wait_s=1.0)
+        store = self._rc_store or self.co.spool
+        return store.get_pages(tid, part, token, wait_s=1.0)
 
     def _drain_location(self, orig: str, deadline, cfg) -> List[tuple]:
         loc = orig
         token = 0
         rows: List[tuple] = []
         spool_errors = 0
+        spool_stall_at: Optional[float] = None
         while True:
             if getattr(self, "canceled", False):
                 raise RuntimeError("Query killed")
@@ -2917,6 +3083,23 @@ class QueryExecution:
                     time.sleep(0.1)
                     continue
                 spool_errors = 0
+                # stall guard (the root-drain analogue of the
+                # HttpPageClient one): a stream making no progress and
+                # never completing — pages deleted under us, or a
+                # producer lost without a failure channel — must not
+                # hang the drain forever
+                if not pages and not complete:
+                    now = time.monotonic()
+                    if spool_stall_at is None:
+                        spool_stall_at = now
+                    elif now - spool_stall_at > \
+                            cfg.exchange_spool_stall_s:
+                        raise RuntimeError(
+                            f"spool stream at {loc} stalled for "
+                            f"{cfg.exchange_spool_stall_s:g}s with no "
+                            "pages and no COMPLETE marker")
+                else:
+                    spool_stall_at = None
                 for page in pages:
                     rows.extend(deserialize_batch(page).to_pylist())
                 if complete:
@@ -3140,6 +3323,8 @@ async function showDetail(id) {
     '  queued: ' + (q.queuedS || 0).toFixed(3) + 's' +
     '  execution: ' + (q.executionS || 0).toFixed(3) + 's' +
     '  plan cache: ' + (q.planCached ? 'hit' : 'miss') +
+    '  result cache: ' + (q.resultCached ?
+        'hit (' + mib(q.resultCacheBytes) + ' served)' : 'miss') +
     '\ntrace token: ' + (q.traceToken || '') +
     '\noutput rows: ' + q.outputRows +
     '\npeak memory: ' + mib(qs.peak_memory_bytes) +
@@ -3200,10 +3385,9 @@ class CoordinatorServer:
         # crashed predecessor at start.  Always constructed (dirs are
         # lazy) so per-session toggles work; exchange_spooling_enabled
         # gates every use.
-        from presto_tpu.server.spool import FileSystemSpoolStore
+        from presto_tpu.server.spool import make_spool_store
 
-        self.spool = FileSystemSpoolStore(config.exchange_spool_path,
-                                          injector=fault_injector)
+        self.spool = make_spool_store(config, injector=fault_injector)
         if config.exchange_spooling_enabled:
             try:
                 self.spool.sweep_orphans(
@@ -3460,6 +3644,8 @@ class CoordinatorServer:
                          "queuedS": round(q.queued_s, 3),
                          "resourceGroup": q.resource_group_name,
                          "planCached": q.plan_cached,
+                         "resultCached": q.result_cached,
+                         "resultCacheBytes": q.result_cache_bytes,
                          # live progress (sampler-fed, mid-query)
                          "totalSplits": q._progress.get(
                              "totalSplits", 0),
@@ -3560,6 +3746,10 @@ class CoordinatorServer:
                         "queuedS": round(q.queued_s, 6),
                         "executionS": round(q.execution_s, 6),
                         "planCached": q.plan_cached,
+                        # result-cache disposition: true = this run was
+                        # served from spool pages with zero execution
+                        "resultCached": q.result_cached,
+                        "resultCacheBytes": q.result_cache_bytes,
                         "plan": q.plan_text,
                         "columns": q.column_names,
                         "outputRows": len(q.result_rows),
@@ -3686,5 +3876,6 @@ class CoordinatorServer:
         self._memory_stop.set()
         self.dispatcher.close()
         self.nodes.close()
+        self.spool.close()
         self._httpd.shutdown()
         self._httpd.server_close()

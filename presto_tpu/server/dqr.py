@@ -87,7 +87,9 @@ class DistributedQueryRunner:
                          q.get("planCached", False),
                          q.get("completedSplits", 0),
                          q.get("totalSplits", 0),
-                         q.get("progressPercent", 0.0))
+                         q.get("progressPercent", 0.0),
+                         q.get("resultCached", False),
+                         q.get("resultCacheBytes", 0))
                         for q in fetch("/v1/query")]
 
             def tasks_fn():

@@ -167,6 +167,30 @@ def _plan_cache_families(prefix: str) -> List[Family]:
     return fams
 
 
+def _result_cache_families(prefix: str) -> List[Family]:
+    """presto_result_cache_{hits,misses,evictions,bytes_served}_total
+    + size/bytes gauges: the cross-query result cache
+    (server/resultcache.py) — a hit serves a repeated statement from
+    spool pages with zero execution."""
+    from presto_tpu.server import resultcache
+
+    s = resultcache.stats()
+    fams: List[Family] = [
+        (f"{prefix}_result_cache_size", "gauge",
+         "cached results currently held", [({}, s.get("size", 0))]),
+        (f"{prefix}_result_cache_bytes", "gauge",
+         "spooled wire bytes currently held by the result cache",
+         [({}, s.get("bytes", 0))])]
+    for key in ("hits", "misses", "evictions", "bytes_served"):
+        fams.append((
+            f"{prefix}_result_cache_{key}_total", "counter",
+            f"result cache {key} (evictions include stats-epoch "
+            "invalidations; bytes_served = wire bytes drained to "
+            "clients from cached spool pages)",
+            [({}, s.get(key, 0))]))
+    return fams
+
+
 def _resource_group_families(manager) -> List[Family]:
     """Per-group admission gauges (queue depth + running count), the
     serving tier's contention surface."""
@@ -262,6 +286,7 @@ def coordinator_metrics(co) -> str:
         getattr(co, "resource_groups", None)))
     fams.extend(_device_exchange_families(co))
     fams.extend(_plan_cache_families("presto"))
+    fams.extend(_result_cache_families("presto"))
     fams.extend(_spool_families("presto", getattr(co, "spool", None)))
     fams.extend(_kernel_cache_families("presto"))
     text = prometheus_text(fams)

@@ -1,0 +1,211 @@
+"""Cross-query result cache: a repeated statement costs one lookup.
+
+Dashboard-style traffic repeats statements verbatim — the plan cache
+(sql/plancache.py) already skips parse/analyze/optimize for them, but
+the query still schedules tasks, dispatches kernels, and moves pages.
+This module closes the rest of the gap (the materialized-result stance
+of SURVEY §2.8/§2.9 forks: results ARE exchange output, so the spool
+that makes exchange durable also makes results re-servable): the value
+of a cache entry is the query's **root-output spool pages**, adopted
+out of the first execution's spool stream into a stable synthetic task
+id (``rc{token}.0.{i}``), and a hit is served straight back through the
+coordinator's existing spool drain — **zero task scheduling, zero
+physical plans, zero jit dispatches**.
+
+Keys and invalidation are EXACTLY the plan cache's
+(``plancache.cache_key``: epoch-domain token, catalog, schema,
+session-property fingerprint, whitespace-normalized SQL) and entries
+snapshot the per-catalog stats epochs of every catalog the plan scans —
+any DML/DDL/ANALYZE against one of them bumps its epoch and the next
+lookup drops the entry (counted as an eviction, its spool pages
+deleted) and re-executes.  One keying machinery, two caches: a
+statement that misses here but hits the plan cache still skips
+planning; a statement that hits here never consults the plan cache.
+
+Unlike the plan cache this LRU is NOT a kernelcache (eviction must
+delete spool pages and capacity is byte-denominated as well as
+entry-denominated), but it exposes the same counter surface —
+``stats()`` feeds ``presto_result_cache_{hits,misses,evictions,
+bytes_served}_total`` on /metrics and the qps/bench reports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import uuid
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from presto_tpu.sql import plancache
+
+#: shared keying machinery (sql/plancache.py): same normalization, same
+#: session fingerprint, same epoch-domain isolation
+cache_key = plancache.cache_key
+normalize_sql = plancache.normalize_sql
+
+#: catalogs whose tables change without bumping a stats epoch (live
+#: engine state): results over them must never be cached
+UNCACHEABLE_CATALOGS = ("system", "information_schema")
+
+
+@dataclasses.dataclass
+class CachedResult:
+    """One cached result: where its spool pages live plus the client
+    schema needed to serve them without a plan."""
+
+    #: synthetic spool task id ``rc{token}.0.0``; location i is
+    #: partition i (one per root location of the source execution)
+    task_id: str
+    n_locations: int
+    column_names: List[str]
+    column_types: List[Any]
+    row_count: int
+    bytes: int
+    #: the SpoolStore holding the pages (eviction deletes through it)
+    store: Any
+    plan_text: str = ""
+
+
+@dataclasses.dataclass
+class _Entry:
+    value: CachedResult
+    epoch_snapshot: Dict[str, int]
+
+
+_LOCK = threading.Lock()
+_CACHE: "OrderedDict[Tuple, _Entry]" = OrderedDict()
+_STATS = {"hits": 0, "misses": 0, "evictions": 0, "bytes_served": 0}
+_BYTES = 0   # total spooled bytes currently held
+
+
+def new_task_id() -> str:
+    """A fresh result-cache task id.  The ``rc{token}`` prefix is the
+    entry's spool 'query id': the source query's own spool GC
+    (``delete_query(query_id)``) never touches it, and eviction deletes
+    exactly ``rc{token}``."""
+    return f"rc{uuid.uuid4().hex[:12]}.0.0"
+
+
+def _delete_pages(entry: _Entry) -> None:
+    from presto_tpu.server.spool import query_id_of
+
+    try:
+        entry.value.store.delete_query(query_id_of(entry.value.task_id))
+    except Exception:  # noqa: BLE001 - eviction GC is best-effort
+        pass
+
+
+def get(key: Tuple, epochs: plancache.StatsEpochs
+        ) -> Optional[CachedResult]:
+    """Cached result, or None.  A hit whose recorded catalog epochs no
+    longer match is dropped — pages deleted, counted as an eviction —
+    and reported as a miss: the DML/DDL/ANALYZE invalidation path."""
+    with _LOCK:
+        entry = _CACHE.get(key)
+        if entry is None:
+            _STATS["misses"] += 1
+            return None
+        if not epochs.valid(entry.epoch_snapshot):
+            _evict_locked(key)
+            _STATS["misses"] += 1
+            return None
+        _CACHE.move_to_end(key)
+        _STATS["hits"] += 1
+        return entry.value
+
+
+def _evict_locked(key: Tuple) -> None:
+    global _BYTES
+    entry = _CACHE.pop(key, None)
+    if entry is None:
+        return
+    _BYTES -= entry.value.bytes
+    _STATS["evictions"] += 1
+    _delete_pages(entry)
+
+
+def put(key: Tuple, value: CachedResult, epochs: plancache.StatsEpochs,
+        catalogs: Iterable[str], capacity: int,
+        max_total_bytes: int) -> None:
+    """Insert (replacing any same-key entry — its pages are deleted)
+    and LRU-evict past ``capacity`` entries or ``max_total_bytes``
+    spooled bytes."""
+    global _BYTES
+    entry = _Entry(value, epochs.snapshot(catalogs))
+    with _LOCK:
+        old = _CACHE.pop(key, None)
+        if old is not None:
+            _BYTES -= old.value.bytes
+            _delete_pages(old)
+        _CACHE[key] = entry
+        _BYTES += value.bytes
+        while _CACHE and (len(_CACHE) > max(capacity, 1)
+                          or _BYTES > max_total_bytes):
+            if next(iter(_CACHE)) == key and len(_CACHE) == 1:
+                # the new entry alone exceeds the byte budget: keep it
+                # anyway (admission already bounded it per entry)
+                break
+            _evict_locked(next(iter(_CACHE)))
+
+
+def invalidate(key: Tuple) -> None:
+    """Drop one entry (pages deleted, counted as an eviction) — the
+    serve path calls this when a hit's pages turn out unreadable."""
+    with _LOCK:
+        _evict_locked(key)
+
+
+def record_served(n_bytes: int) -> None:
+    """Account one hit actually drained to a client (the
+    bytes-served-from-cache surface)."""
+    with _LOCK:
+        _STATS["bytes_served"] += int(n_bytes)
+
+
+def stats() -> Dict[str, int]:
+    """size/bytes gauges + hit/miss/eviction/bytes-served counters (the
+    /metrics, qps_run, and bench surface)."""
+    with _LOCK:
+        return {"size": len(_CACHE), "bytes": _BYTES, **_STATS}
+
+
+def clear() -> None:
+    """Drop every entry (pages deleted) and zero counters (test
+    isolation)."""
+    global _BYTES
+    with _LOCK:
+        for key in list(_CACHE):
+            entry = _CACHE.pop(key)
+            _delete_pages(entry)
+        _BYTES = 0
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def read_complete_stream(store, task_id: str, partition: int,
+                         max_bytes: int,
+                         wait_s: float = 0.5) -> Optional[List[bytes]]:
+    """Every page of one COMPLETE spooled stream, byte-exact, or None
+    when the stream is incomplete/oversized/unreadable (admission is
+    strictly best-effort: a result that cannot be adopted is simply
+    not cached)."""
+    pages: List[bytes] = []
+    token = 0
+    size = 0
+    try:
+        while True:
+            got, token, complete = store.get_pages(
+                task_id, partition, token, max_bytes=max_bytes,
+                wait_s=wait_s)
+            for p in got:
+                size += len(p)
+                if size > max_bytes:
+                    return None
+                pages.append(p)
+            if complete:
+                return pages
+            if not got:
+                return None
+    except Exception:  # noqa: BLE001 - spool faults void admission
+        return None

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import struct
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -73,6 +74,10 @@ class SpoolStore:
 
     def sweep_orphans(self, max_age_s: float) -> int:
         raise NotImplementedError
+
+    def close(self) -> None:
+        """Release background resources (the object tier's flusher);
+        a store without any is a no-op."""
 
 
 class FileSystemSpoolStore(SpoolStore):
@@ -212,6 +217,11 @@ class FileSystemSpoolStore(SpoolStore):
             return 0
         cutoff = time.time() - max_age_s
         for name in entries:
+            if name == "objects":
+                # reserved: the object tier's emulated bucket nests
+                # under the same root (make_spool_store) and has its
+                # own sweep — a quiet bucket is not an orphaned query
+                continue
             d = os.path.join(self.root, name)
             try:
                 if os.path.isdir(d) and os.path.getmtime(d) <= cutoff:
@@ -220,6 +230,382 @@ class FileSystemSpoolStore(SpoolStore):
             except OSError:
                 continue
         return removed
+
+
+# -- object-store tier ------------------------------------------------------
+
+class LocalObjectApi:
+    """A local-directory EMULATION of the S3/GCS object API: whole-object
+    atomic puts, gets, prefix listing, prefix deletes — and nothing else
+    (no append, no rename-publish, no partial reads).  The
+    ``ObjectStoreSpoolStore`` is written against exactly this surface so
+    a real S3/GCS client drops in behind the same five methods.
+
+    Keys are ``/``-separated strings (``{query}/{task}/{partition}/obj``)
+    mirrored as files under ``root``."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, *key.split("/"))
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        # atomic publish: list()/get() observe the whole object or none
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def exists(self, key: str) -> bool:
+        return os.path.isfile(self._path(key))
+
+    def list(self, prefix: str) -> List[str]:
+        """Keys under ``prefix`` (a key-name prefix, not only directory
+        boundaries), sorted — the S3 ListObjectsV2 contract restricted
+        to what the spool needs."""
+        head, _, name_prefix = prefix.rpartition("/")
+        d = os.path.join(self.root, *head.split("/")) if head else \
+            self.root
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return []
+        base = head + "/" if head else ""
+        return sorted(base + n for n in names
+                      if n.startswith(name_prefix)
+                      and not n.endswith(".tmp"))
+
+    def delete_prefix(self, prefix: str) -> bool:
+        d = os.path.join(self.root, *prefix.split("/"))
+        if not os.path.isdir(d):
+            return False
+        shutil.rmtree(d, ignore_errors=True)
+        return True
+
+
+#: segment object wire format: magic, page count, page lengths, pages.
+#: Pages stay byte-for-byte the exchange wire frames — a segment is pure
+#: concatenation plus an index, so re-served pages are byte-exact.
+_SEG_MAGIC = b"PSG1"
+
+
+def _pack_segment(pages: List[bytes]) -> bytes:
+    head = _SEG_MAGIC + struct.pack(">I", len(pages))
+    head += struct.pack(f">{len(pages)}I", *(len(p) for p in pages))
+    return head + b"".join(pages)
+
+
+def _unpack_segment(data: bytes) -> List[bytes]:
+    if data[:4] != _SEG_MAGIC:
+        raise ValueError("bad spool segment magic")
+    (count,) = struct.unpack_from(">I", data, 4)
+    lengths = struct.unpack_from(f">{count}I", data, 8)
+    out = []
+    off = 8 + 4 * count
+    for n in lengths:
+        out.append(data[off:off + n])
+        off += n
+    return out
+
+
+class ObjectStoreSpoolStore(SpoolStore):
+    """The S3/GCS-role spool tier (SURVEY §2.8/§2.9: durability
+    decoupled from worker disks, one storage bill for exchange state
+    AND the result cache).
+
+    Three deliberate departures from the FS tier:
+
+    - **async batched writes**: ``write_page`` only appends to an
+      in-memory pending buffer; a background flusher packs pending
+      pages into segment objects on a cadence (or early, past
+      ``segment_max_bytes``).  Pending pages are still servable from
+      memory by THIS node, so producer-local re-reads (buffer eviction
+      re-serve) never wait on a flush;
+    - **multi-page segment compaction**: one object per batch of pages
+      (``seg-{first_token:08d}-{count:04d}``) instead of one file per
+      page — object stores price per request, not per byte;
+    - **read-through**: a token the object tier does not hold is served
+      from the FS ``fallback`` tier, so mixed histories (pages written
+      before the tier switch, or by an FS-tier node) stay readable.
+
+    ``set_complete`` flushes synchronously before publishing the
+    COMPLETE object: completeness verification (``is_complete``) can
+    never observe the marker ahead of its pages, which is the ordering
+    every recovery repoint depends on."""
+
+    def __init__(self, api: LocalObjectApi, fallback: SpoolStore = None,
+                 injector=None, segment_max_bytes: int = 4 << 20,
+                 flush_interval_s: float = 0.05):
+        self.api = api
+        self.fallback = fallback
+        self.injector = injector
+        self.segment_max_bytes = segment_max_bytes
+        self.flush_interval_s = flush_interval_s
+        self.stats: Dict[str, int] = {
+            "bytes_written": 0, "bytes_read": 0,
+            "pages_written": 0, "pages_read": 0,
+            "segments_written": 0}
+        # (task_id, partition) -> {'first': token, 'pages': [bytes]}
+        self._pending: Dict[Tuple[str, int], Dict] = {}
+        self._lock = threading.Condition()
+        self._closed = False
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         daemon=True,
+                                         name="spool-object-flusher")
+        self._flusher.start()
+
+    # -- producer side ---------------------------------------------------
+    def write_page(self, task_id: str, partition: int, token: int,
+                   page: bytes) -> None:
+        with self._lock:
+            key = (task_id, partition)
+            pend = self._pending.get(key)
+            if pend is None or pend["first"] + len(pend["pages"]) != token:
+                # out-of-order write (restart under a reused id):
+                # flush what we hold and start a fresh run
+                if pend is not None:
+                    self._flush_locked(key)
+                self._pending[key] = pend = {"first": token, "pages": []}
+            pend["pages"].append(page)
+            # size trigger flushes inline; otherwise the page WAITS for
+            # the interval tick — waking the flusher per page would
+            # defeat batching (one tiny segment per write)
+            if sum(len(p) for p in pend["pages"]) >= \
+                    self.segment_max_bytes:
+                self._flush_locked(key)
+
+    def _flush_locked(self, key: Tuple[str, int]) -> None:
+        """Pack and put one pending run as a segment object (caller
+        holds the lock; the put itself is a local atomic write)."""
+        pend = self._pending.pop(key, None)
+        if pend is None or not pend["pages"]:
+            return
+        task_id, partition = key
+        first, pages = pend["first"], pend["pages"]
+        seg_key = (f"{query_id_of(task_id)}/{task_id}/{partition}/"
+                   f"seg-{first:08d}-{len(pages):04d}")
+        self.api.put(seg_key, _pack_segment(pages))
+        self.stats["segments_written"] += 1
+        self.stats["pages_written"] += len(pages)
+        self.stats["bytes_written"] += sum(len(p) for p in pages)
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._lock:
+                self._lock.wait(timeout=self.flush_interval_s)
+                if self._closed:
+                    return
+                for key in list(self._pending):
+                    self._flush_locked(key)
+
+    def flush(self) -> None:
+        """Force every pending page durable (tests; close path)."""
+        with self._lock:
+            for key in list(self._pending):
+                self._flush_locked(key)
+
+    def set_complete(self, task_id: str, partition: int,
+                     end_token: int) -> None:
+        with self._lock:
+            # durability ordering: every page precedes the marker
+            self._flush_locked((task_id, partition))
+        self.api.put(f"{query_id_of(task_id)}/{task_id}/{partition}/"
+                     f"COMPLETE", str(end_token).encode("ascii"))
+
+    # -- consumer side ---------------------------------------------------
+    def _partition_prefix(self, task_id: str, partition: int) -> str:
+        return f"{query_id_of(task_id)}/{task_id}/{partition}/"
+
+    def _end_token(self, task_id: str, partition: int) -> Optional[int]:
+        try:
+            return int(self.api.get(
+                self._partition_prefix(task_id, partition)
+                + "COMPLETE").decode("ascii").strip())
+        except FileNotFoundError:
+            pass
+        if isinstance(self.fallback, FileSystemSpoolStore):
+            return self.fallback._end_token(
+                self.fallback._partition_dir(task_id, partition))
+        return None
+
+    def _segments(self, task_id: str, partition: int
+                  ) -> List[Tuple[int, int, str]]:
+        """(first_token, count, key) per flushed segment, token-sorted."""
+        prefix = self._partition_prefix(task_id, partition) + "seg-"
+        out = []
+        for key in self.api.list(prefix):
+            name = key.rsplit("/", 1)[1]
+            try:
+                _, first, count = name.split("-")
+                out.append((int(first), int(count), key))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def _segment_page(self, task_id: str, partition: int, token: int,
+                      seg_cache: Dict) -> Optional[bytes]:
+        for first, count, key in self._segments(task_id, partition):
+            if first <= token < first + count:
+                if key not in seg_cache:
+                    try:
+                        seg_cache.clear()   # hold one segment at a time
+                        seg_cache[key] = _unpack_segment(
+                            self.api.get(key))
+                    except FileNotFoundError:
+                        continue            # raced a delete
+                return seg_cache[key][token - first]
+        return None
+
+    def _read_one(self, task_id: str, partition: int, token: int,
+                  seg_cache: Dict) -> Optional[bytes]:
+        """Page ``token`` from a flushed segment, the pending buffer, or
+        the read-through fallback; None when nobody holds it (yet)."""
+        page = self._segment_page(task_id, partition, token, seg_cache)
+        if page is not None:
+            return page
+        with self._lock:
+            pend = self._pending.get((task_id, partition))
+            if pend is not None and \
+                    pend["first"] <= token < pend["first"] + \
+                    len(pend["pages"]):
+                return pend["pages"][token - pend["first"]]
+        # a page only ever moves pending -> segment: if both probes
+        # missed, the flusher may have moved it BETWEEN them — one
+        # re-list of the segments closes the race
+        page = self._segment_page(task_id, partition, token, seg_cache)
+        if page is not None:
+            return page
+        if self.fallback is not None:
+            pages, _next, _c = self.fallback.get_pages(
+                task_id, partition, token, max_bytes=1)
+            if pages:
+                return pages[0]
+        return None
+
+    def get_pages(self, task_id: str, partition: int, token: int,
+                  max_bytes: int = 16 << 20,
+                  wait_s: float = 0.0) -> Tuple[List[bytes], int, bool]:
+        deadline = (time.monotonic() + wait_s) if wait_s > 0 else None
+        while True:
+            if self.injector is not None:
+                # same chaos surface as the FS tier (server/faults.py)
+                self.injector.apply_spool(
+                    f"{task_id}/{partition}/{token}")
+            out: List[bytes] = []
+            size = 0
+            t = token
+            seg_cache: Dict = {}
+            while True:
+                page = self._read_one(task_id, partition, t, seg_cache)
+                if page is None:
+                    break
+                if out and size + len(page) > max_bytes:
+                    break
+                out.append(page)
+                size += len(page)
+                t += 1
+            end = self._end_token(task_id, partition)
+            complete = end is not None and t >= end
+            if out or complete or deadline is None:
+                self.stats["bytes_read"] += size
+                self.stats["pages_read"] += len(out)
+                return out, t, complete
+            if time.monotonic() >= deadline:
+                return out, t, False
+            time.sleep(0.005)
+
+    def is_complete(self, task_id: str, n_partitions: int) -> bool:
+        for p in range(n_partitions):
+            if self.injector is not None:
+                self.injector.apply_spool(f"{task_id}/{p}/COMPLETE")
+            end = self._end_token(task_id, p)
+            if end is None:
+                return False
+            # snapshot pending BEFORE listing segments: a page only
+            # moves pending -> segment, so a pre-flush pending claim
+            # stays true when the flusher races this check
+            with self._lock:
+                pend = self._pending.get((task_id, p))
+                pend_span = (pend["first"],
+                             pend["first"] + len(pend["pages"])) \
+                    if pend is not None else None
+            covered = 0
+            for first, count, _key in self._segments(task_id, p):
+                if first <= covered:
+                    covered = max(covered, first + count)
+            if pend_span is not None and pend_span[0] <= covered:
+                covered = max(covered, pend_span[1])
+            if covered < end and self.fallback is not None:
+                # read-through completeness: the FS tier may hold the
+                # rest (mixed history)
+                d = self.fallback._partition_dir(task_id, p)
+                while covered < end and os.path.exists(os.path.join(
+                        d, FileSystemSpoolStore._page_name(covered))):
+                    covered += 1
+            if covered < end:
+                return False
+        return True
+
+    # -- lifecycle -------------------------------------------------------
+    def delete_query(self, query_id: str) -> bool:
+        with self._lock:
+            for key in [k for k in self._pending
+                        if query_id_of(k[0]) == query_id]:
+                del self._pending[key]
+        removed = self.api.delete_prefix(query_id)
+        if self.fallback is not None:
+            removed = self.fallback.delete_query(query_id) or removed
+        return removed
+
+    def sweep_orphans(self, max_age_s: float = 3600.0) -> int:
+        removed = 0
+        try:
+            entries = os.listdir(self.api.root)
+        except FileNotFoundError:
+            entries = []
+        cutoff = time.time() - max_age_s
+        for name in entries:
+            d = os.path.join(self.api.root, name)
+            try:
+                if os.path.isdir(d) and os.path.getmtime(d) <= cutoff:
+                    shutil.rmtree(d, ignore_errors=True)
+                    removed += 1
+            except OSError:
+                continue
+        if self.fallback is not None:
+            removed += self.fallback.sweep_orphans(max_age_s)
+        return removed
+
+    def close(self) -> None:
+        with self._lock:
+            for key in list(self._pending):
+                self._flush_locked(key)
+            self._closed = True
+            self._lock.notify_all()
+
+
+def make_spool_store(config, injector=None) -> SpoolStore:
+    """The node-side spool factory: every node of a cluster constructs
+    its store from the same config, so the tier choice
+    (``exchange_spool_tier``) is cluster-wide.  The object tier nests
+    its emulated bucket under ``{spool_path}/objects`` and reads
+    through to the FS tier at ``{spool_path}`` itself."""
+    root = config.exchange_spool_path
+    if getattr(config, "exchange_spool_tier", "fs") == "object":
+        return ObjectStoreSpoolStore(
+            LocalObjectApi(os.path.join(root, "objects")),
+            fallback=FileSystemSpoolStore(root),
+            injector=injector,
+            segment_max_bytes=config.exchange_spool_segment_bytes,
+            flush_interval_s=config.exchange_spool_flush_interval_s)
+    return FileSystemSpoolStore(root, injector=injector)
 
 
 # -- spool source urls ------------------------------------------------------

@@ -37,7 +37,7 @@ class WorkerServer:
                  drain_grace_s: float = 2.0):
         from presto_tpu.server.errortracker import RetryingHttpClient
         from presto_tpu.server.security import InternalAuthenticator
-        from presto_tpu.server.spool import FileSystemSpoolStore
+        from presto_tpu.server.spool import make_spool_store
 
         self.node_id = node_id
         # topology label (rack/zone) announced to the
@@ -64,8 +64,7 @@ class WorkerServer:
         # spooled exchange tier: the store is always constructed (dirs
         # are created lazily on first write) so a SET SESSION toggle can
         # enable spooling per query; exchange_spooling_enabled gates use
-        self.spool = FileSystemSpoolStore(config.exchange_spool_path,
-                                          injector=fault_injector)
+        self.spool = make_spool_store(config, injector=fault_injector)
         self.task_manager = SqlTaskManager(
             registry, config,
             fetch_headers=(self.internal_auth.header()
@@ -377,5 +376,6 @@ class WorkerServer:
 
     def close(self) -> None:
         self.task_manager.cancel_all()
+        self.spool.close()
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -101,6 +101,11 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "plan_cache_enabled": ("plan_cache_enabled",
                            lambda v: v.lower() in ("true", "1", "on")),
     "plan_cache_capacity": ("plan_cache_capacity", int),
+    "result_cache_enabled": (
+        "result_cache_enabled",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "result_cache_max_entry_bytes": ("result_cache_max_entry_bytes",
+                                     int),
     "query_queue_timeout_s": ("query_queue_timeout_s", float),
     "hash_groupby_enabled": (
         "hash_groupby_enabled",

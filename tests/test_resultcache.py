@@ -1,0 +1,416 @@
+"""Cross-query result cache (server/resultcache.py): a repeated
+statement's second execution is served ENTIRELY from its first
+execution's root-output spool pages.
+
+The acceptance pins:
+
+- second execution over HTTP: zero tasks created, zero physical plans
+  built, zero jit dispatches — pinned via queryStats/_tasks_scheduled/
+  sql.physical.PLANS_BUILT — with exact rows and a FINISHED query that
+  resource groups, events, /v1/query, system.runtime, and /metrics all
+  see (``resultCached=true``);
+- invalidation is the plan cache's: INSERT/CTAS/DDL between repeats
+  bumps the catalog stats epoch and the next execution re-runs with
+  exact rows; a session-property change misses (fingerprint);
+- ``result_cache_enabled=false`` (the default) restores PR 12 behavior
+  exactly: repeats schedule tasks and the cache sees zero traffic;
+- eviction (capacity or byte pressure) deletes the entry's spool
+  pages; the spool GC of the source query never touches them;
+- the object-store spool tier serves hits byte-exact, including under
+  a faults.py spool-read-error policy.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu import events as ev
+from presto_tpu.config import DEFAULT
+from presto_tpu.server import resultcache
+from presto_tpu.server.dqr import DistributedQueryRunner
+from presto_tpu.server.faults import FaultInjector
+from presto_tpu.sql import physical
+
+pytestmark = pytest.mark.chaos
+
+
+def _get_json(uri):
+    with urllib.request.urlopen(uri, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _cfg(tmp_path, **over):
+    return dataclasses.replace(
+        DEFAULT, result_cache_enabled=True,
+        exchange_spool_path=str(tmp_path / "spool"), **over)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    resultcache.clear()
+    yield
+    resultcache.clear()
+
+
+def _detail(dqr, client=None):
+    qid = (client or dqr.client).last_query_id
+    return _get_json(f"{dqr.coordinator.uri}/v1/query/{qid}")
+
+
+SQL = ("select l_returnflag, count(*) as c, sum(l_quantity) as q "
+       "from lineitem group by l_returnflag order by l_returnflag")
+
+
+# -- unit tier ---------------------------------------------------------------
+
+def test_unit_lru_and_byte_eviction_delete_pages(tmp_path):
+    """The cache's own LRU: capacity and byte caps evict oldest-first
+    and eviction deletes the entry's spool pages through its store."""
+    from presto_tpu.server.spool import FileSystemSpoolStore
+    from presto_tpu.sql.plancache import StatsEpochs
+
+    store = FileSystemSpoolStore(str(tmp_path / "s"))
+    epochs = StatsEpochs()
+
+    def entry(i, nbytes=100):
+        tid = resultcache.new_task_id()
+        store.write_page(tid, 0, 0, b"x" * nbytes)
+        store.set_complete(tid, 0, 1)
+        return resultcache.CachedResult(
+            tid, 1, ["c"], [], 1, nbytes, store)
+
+    e1, e2, e3 = entry(1), entry(2), entry(3)
+    k = resultcache.cache_key
+    resultcache.put(k(epochs, "q1", "t", None), e1, epochs, ["t"],
+                    capacity=2, max_total_bytes=1 << 20)
+    resultcache.put(k(epochs, "q2", "t", None), e2, epochs, ["t"],
+                    capacity=2, max_total_bytes=1 << 20)
+    assert resultcache.stats()["size"] == 2
+    # capacity eviction drops the LRU entry AND its pages
+    resultcache.put(k(epochs, "q3", "t", None), e3, epochs, ["t"],
+                    capacity=2, max_total_bytes=1 << 20)
+    st = resultcache.stats()
+    assert st["size"] == 2 and st["evictions"] == 1
+    assert store.get_pages(e1.task_id, 0, 0) == ([], 0, False)
+    assert store.get_pages(e3.task_id, 0, 0)[0]   # newest survives
+    # epoch invalidation on lookup: bump -> entry dropped, pages gone
+    epochs.bump("t")
+    assert resultcache.get(k(epochs, "q3", "t", None), epochs) is None
+    st = resultcache.stats()
+    assert st["evictions"] == 2 and st["misses"] == 1
+    assert store.get_pages(e3.task_id, 0, 0) == ([], 0, False)
+    # byte-cap eviction
+    big = entry(4, nbytes=200)
+    resultcache.put(k(epochs, "q4", "t", None), big, epochs, ["t"],
+                    capacity=10, max_total_bytes=250)
+    assert resultcache.stats()["size"] == 1   # e2 (100b) evicted: 300>250
+
+
+# -- serving tier ------------------------------------------------------------
+
+def test_second_execution_zero_tasks_zero_plans_zero_jit(tmp_path):
+    """THE acceptance pin: the second execution of a repeated statement
+    over HTTP is served entirely from the result cache — no tasks, no
+    physical plans, no jit dispatches — while lifecycle/events/stats
+    all still see a normal FINISHED query."""
+    events = []
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=_cfg(tmp_path)) as dqr:
+        dqr.event_bus.register(
+            type("L", (ev.EventListener,), {
+                "query_completed":
+                    staticmethod(lambda e: events.append(e))})())
+        r1 = dqr.execute(SQL)
+        d1 = _detail(dqr)
+        assert d1["resultCached"] is False
+        plans_before = physical.PLANS_BUILT
+        r2 = dqr.execute(SQL)
+        assert r2.rows == r1.rows
+        d2 = _detail(dqr)
+        q2 = dqr.coordinator.queries[d2["queryId"]]
+        # zero tasks created
+        assert q2._tasks_scheduled is False
+        assert q2._placements == []
+        # zero physical plans built anywhere in the process
+        assert physical.PLANS_BUILT == plans_before
+        # zero jit work, pinned via queryStats over HTTP
+        qs = d2["queryStats"]
+        assert d2["resultCached"] is True
+        assert d2["state"] == "FINISHED"
+        assert qs["jit_dispatches"] == 0 and qs["jit_compiles"] == 0
+        assert qs["stages"] == 0
+        assert qs["result_cached"] == 1
+        assert qs["result_cache_bytes"] == d2["resultCacheBytes"] > 0
+        assert qs["output_rows"] == len(r2.rows)
+        # the serving plane still saw a full lifecycle
+        assert any(e.query_id == d2["queryId"] and e.state == "FINISHED"
+                   for e in events)
+        listing = _get_json(f"{dqr.coordinator.uri}/v1/query")
+        row = next(x for x in listing if x["queryId"] == d2["queryId"])
+        assert row["resultCached"] is True
+        # system.runtime sees it (the third execution is ALSO a hit and
+        # must not disturb the listing's correctness)
+        rows = dqr.execute(
+            "select result_cached, result_cache_bytes from "
+            "system.runtime.queries where query_id = '"
+            + d2["queryId"] + "'").rows
+        assert rows == [(True, d2["resultCacheBytes"])]
+        # /metrics carries the counter families
+        with urllib.request.urlopen(
+                f"{dqr.coordinator.uri}/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        for fam in ("presto_result_cache_hits_total",
+                    "presto_result_cache_misses_total",
+                    "presto_result_cache_evictions_total",
+                    "presto_result_cache_bytes_served_total"):
+            assert fam in text, fam
+        st = resultcache.stats()
+        assert st["hits"] >= 1 and st["bytes_served"] > 0
+
+
+def test_insert_between_repeats_reexecutes_exact(tmp_path):
+    """INSERT between repeats bumps the target catalog's stats epoch:
+    the next execution is a MISS that re-runs (tasks scheduled) and
+    returns the new exact rows; the stale entry is evicted."""
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=_cfg(tmp_path)) as dqr:
+        dqr.execute("create table memory.rc as select * from region")
+        sql = "select count(*) as c from memory.rc"
+        assert dqr.execute(sql).rows == [(5,)]
+        assert dqr.execute(sql).rows == [(5,)]
+        assert _detail(dqr)["resultCached"] is True
+        ev_before = resultcache.stats()["evictions"]
+        dqr.execute("insert into memory.rc select * from region")
+        r = dqr.execute(sql)
+        d = _detail(dqr)
+        assert r.rows == [(10,)]
+        assert d["resultCached"] is False
+        assert dqr.coordinator.queries[d["queryId"]]._tasks_scheduled
+        assert resultcache.stats()["evictions"] == ev_before + 1
+        # and the refreshed result re-admits
+        assert dqr.execute(sql).rows == [(10,)]
+        assert _detail(dqr)["resultCached"] is True
+
+
+def test_ctas_and_ddl_invalidate(tmp_path):
+    """CTAS (distributed write) and DDL both bump the epoch: cached
+    results over the touched catalog re-run."""
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=_cfg(tmp_path)) as dqr:
+        dqr.execute("create table memory.src as select * from nation")
+        sql = ("select count(*) as c from memory.src")
+        dqr.execute(sql)
+        dqr.execute(sql)
+        assert _detail(dqr)["resultCached"] is True
+        # CTAS against the same catalog invalidates
+        dqr.execute("create table memory.other as select * from region")
+        dqr.execute(sql)
+        assert _detail(dqr)["resultCached"] is False
+        dqr.execute(sql)
+        assert _detail(dqr)["resultCached"] is True
+        # DDL (drop) invalidates too
+        dqr.execute("drop table memory.other")
+        dqr.execute(sql)
+        assert _detail(dqr)["resultCached"] is False
+
+
+def test_session_property_fingerprint_miss(tmp_path):
+    """A session-property change produces a different key: the repeat
+    under new properties re-executes (same rows)."""
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=_cfg(tmp_path)) as dqr:
+        base = dqr.new_client(user="fp")
+        base.execute(SQL)
+        _cols, d0 = base.execute(SQL)
+        assert _detail(dqr, base)["resultCached"] is True
+        other = dqr.new_client(user="fp")
+        other.session_properties["slow_query_log_threshold_s"] = "123"
+        _cols, d1 = other.execute(SQL)
+        det = _detail(dqr, other)
+        assert det["resultCached"] is False
+        assert sorted(map(tuple, d1)) == sorted(map(tuple, d0))
+        # and the new fingerprint now has its own entry
+        other.execute(SQL)
+        assert _detail(dqr, other)["resultCached"] is True
+
+
+def test_execute_bound_statements_key_on_parameters(tmp_path):
+    """EXECUTE statements hit under (prepared text + bound parameters):
+    the same EXECUTE repeats hit; different parameters miss."""
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=_cfg(tmp_path)) as dqr:
+        c = dqr.new_client(user="ex")
+        c.execute("prepare p1 from "
+                  "select count(*) as c from lineitem "
+                  "where l_quantity < ?")
+        _cols, a1 = c.execute("execute p1 using 10")
+        _cols, a2 = c.execute("execute p1 using 10")
+        assert a2 == a1
+        assert _detail(dqr, c)["resultCached"] is True
+        _cols, b1 = c.execute("execute p1 using 20")
+        assert _detail(dqr, c)["resultCached"] is False
+        assert b1 != a1
+
+
+def test_disabled_restores_pr12_exactly(tmp_path):
+    """The default (result_cache_enabled=false) is PR 12 exactly:
+    repeats schedule tasks, the plan cache serves them, and the result
+    cache sees ZERO traffic."""
+    cfg = dataclasses.replace(
+        DEFAULT, exchange_spool_path=str(tmp_path / "spool"))
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=cfg) as dqr:
+        r1 = dqr.execute(SQL)
+        r2 = dqr.execute(SQL)
+        assert r2.rows == r1.rows
+        d = _detail(dqr)
+        q = dqr.coordinator.queries[d["queryId"]]
+        assert d["resultCached"] is False
+        assert d["planCached"] is True       # the PR 8 path, untouched
+        assert q._tasks_scheduled is True
+        assert resultcache.stats() == {
+            "size": 0, "bytes": 0, "hits": 0, "misses": 0,
+            "evictions": 0, "bytes_served": 0}
+
+
+def test_system_runtime_results_never_cached(tmp_path):
+    """Live engine state has no stats epoch: queries over
+    system.runtime are never admitted (a cached queries-listing would
+    replay stale state forever)."""
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=_cfg(tmp_path)) as dqr:
+        sql = "select count(*) as c from system.runtime.nodes"
+        dqr.execute(sql)
+        dqr.execute(sql)
+        assert _detail(dqr)["resultCached"] is False
+        assert resultcache.stats()["size"] == 0
+
+
+def test_eviction_deletes_pages_and_source_gc_spares_them(tmp_path):
+    """Entry pages live under their own rc* spool id: the source
+    query's end-of-query spool GC leaves them servable, and capacity
+    eviction deletes exactly them."""
+    import os
+
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2,
+            config=_cfg(tmp_path, result_cache_capacity=1)) as dqr:
+        dqr.execute(SQL)
+        # the source query's spool dir is GC'd, the rc dir is not
+        spool_root = str(tmp_path / "spool")
+        dirs = [d for d in os.listdir(spool_root)
+                if d.startswith("rc")]
+        assert len(dirs) == 1
+        r2 = dqr.execute(SQL)
+        assert _detail(dqr)["resultCached"] is True
+        # capacity 1: a second statement evicts the first entry AND
+        # removes its rc directory
+        dqr.execute("select count(*) as c from nation")
+        dqr.execute("select count(*) as c from nation")
+        assert _detail(dqr)["resultCached"] is True
+        dirs_after = [d for d in os.listdir(spool_root)
+                      if d.startswith("rc")]
+        assert len(dirs_after) == 1
+        assert dirs_after != dirs
+        assert resultcache.stats()["evictions"] >= 1
+
+
+def test_concurrent_repeats_all_exact(tmp_path):
+    """4 clients hammering the same two statements: every response is
+    exact whether it came from execution or the cache, and hits
+    dominate after warmup."""
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=_cfg(tmp_path)) as dqr:
+        def norm(rows):
+            return sorted(
+                tuple(round(v, 6) if isinstance(v, float) else v
+                      for v in r) for r in rows)
+
+        sqls = [SQL, "select count(*) as c from orders"]
+        expected = [norm(dqr.execute(s).rows) for s in sqls]
+        failures = []
+
+        def loop(i):
+            client = dqr.new_client(user=f"hot{i}")
+            try:
+                for j in range(6):
+                    s = sqls[(i + j) % len(sqls)]
+                    _cols, data = client.execute(s)
+                    if norm(tuple(r) for r in data) != \
+                            expected[(i + j) % len(sqls)]:
+                        failures.append((i, s))
+            except Exception as e:  # noqa: BLE001
+                failures.append((i, repr(e)))
+
+        threads = [threading.Thread(target=loop, args=(i,),
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not failures, failures
+        assert resultcache.stats()["hits"] >= 2
+
+
+def test_vanished_entry_falls_back_to_execution(tmp_path):
+    """An entry whose spool pages vanished under it (eviction raced the
+    lookup / operator deleted the spool root) must NOT fail or hang
+    the query: the stalled drain gives up after exchange_spool_stall_s,
+    the entry is invalidated, and the statement re-executes normally
+    with exact rows."""
+    import shutil
+
+    cfg = _cfg(tmp_path, exchange_spool_stall_s=1.0)
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=cfg) as dqr:
+        r1 = dqr.execute(SQL)
+        assert resultcache.stats()["size"] == 1
+        # yank the pages out from under the live entry
+        import os
+
+        spool_root = str(tmp_path / "spool")
+        for d in os.listdir(spool_root):
+            if d.startswith("rc"):
+                shutil.rmtree(os.path.join(spool_root, d))
+        r2 = dqr.execute(SQL)
+        d2 = _detail(dqr)
+        assert r2.rows == r1.rows
+        assert d2["state"] == "FINISHED"
+        assert d2["resultCached"] is False   # served by real execution
+        assert dqr.coordinator.queries[d2["queryId"]]._tasks_scheduled
+        st = resultcache.stats()
+        assert st["evictions"] >= 1          # the dead entry was dropped
+        # and the fresh execution re-admitted: next repeat hits again
+        r3 = dqr.execute(SQL)
+        assert r3.rows == r1.rows
+        assert _detail(dqr)["resultCached"] is True
+
+
+def test_object_tier_hit_byte_exact_under_read_faults(tmp_path):
+    """The satellite pin: result-cache entries on the OBJECT spool
+    tier re-serve byte-exact, and a transient faults.py spool-read
+    error on the hit path retries on the error budget instead of
+    failing the query."""
+    inj = FaultInjector()
+    cfg = _cfg(tmp_path, exchange_spool_tier="object")
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            coordinator_injector=inj) as dqr:
+        from presto_tpu.server.spool import ObjectStoreSpoolStore
+
+        assert isinstance(dqr.coordinator.spool, ObjectStoreSpoolStore)
+        r1 = dqr.execute(SQL)
+        rule = inj.add_spool_rule(r"^rc", policy="spool-read-error",
+                                  times=2)
+        r2 = dqr.execute(SQL)
+        assert r2.rows == r1.rows
+        assert _detail(dqr)["resultCached"] is True
+        assert rule.remaining == 0          # both faults really fired
+        # eviction pressure on the object tier still re-serves the
+        # survivor byte-exact
+        r3 = dqr.execute(SQL)
+        assert r3.rows == r1.rows

@@ -423,15 +423,45 @@ def test_spool_missing_object_falls_back_to_cascading_retry(tmp_path):
         t = threading.Thread(target=run)
         t.start()
         victim_uri = dqr.workers[1].uri
-        deadline = time.monotonic() + 15.0
-        while time.monotonic() < deadline:
+        # wait for the CONDITION the kill is meant to hit — a NON-LEAF
+        # task actually scheduled on the victim — instead of assuming a
+        # wall-clock budget covers admission+planning+scheduling.  The
+        # old wait checked only "any task on the victim" and fell
+        # through SILENTLY on timeout: under a loaded full-suite run
+        # the kill then landed before (or without) a non-leaf placement
+        # and recovery was pure leaf-reschedule — no stage retry, and
+        # the >=1 assertion flaked.  The victim's /results/ always
+        # drop (injector), so its non-leaf output can never have been
+        # consumed pre-kill: the fallback MUST cascade into stage
+        # retry once the death is seen.
+        deadline = time.monotonic() + 60.0
+
+        def victim_has_nonleaf():
             qs = list(co.queries.values())
-            if qs and any(u == victim_uri
-                          for _, _, u in qs[0]._placements):
-                break
+            if not qs:
+                return False
+            q0 = qs[0]
+            with q0._recovery_lock:
+                placements = list(q0._placements)
+                specs = dict(q0._task_specs)
+            return any(u == victim_uri and specs.get(t, {}).get("remote")
+                       for _, t, u in placements)
+
+        while time.monotonic() < deadline and not victim_has_nonleaf():
             time.sleep(0.02)
+        assert victim_has_nonleaf(), \
+            "no non-leaf task ever scheduled onto the victim"
         q = list(co.queries.values())[0]
         dqr.kill_worker(1)
+        # release the drain only once the failure detector actually
+        # sees the death: recovery (and its spool-verification
+        # fallback) then deterministically runs while the query is
+        # still in flight
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and \
+                victim_uri not in co.nodes.dead_uris():
+            time.sleep(0.02)
+        assert victim_uri in co.nodes.dead_uris()
         hold.release()
         t.join(timeout=120)
         assert not t.is_alive()
@@ -630,3 +660,234 @@ def test_spooling_off_writes_nothing(tmp_path):
         q = list(dqr.coordinator.queries.values())[0]
         assert q.producer_reruns_total == 0
     assert not os.path.exists(str(tmp_path / "spool-off"))
+
+
+# -- object-store tier (ObjectStoreSpoolStore) ------------------------------
+
+def _object_store(tmp_path, **over):
+    from presto_tpu.server.spool import (
+        LocalObjectApi, ObjectStoreSpoolStore,
+    )
+
+    fb = FileSystemSpoolStore(str(tmp_path / "os"))
+    return ObjectStoreSpoolStore(
+        LocalObjectApi(str(tmp_path / "os" / "objects")), fallback=fb,
+        **over)
+
+
+def test_object_store_roundtrip_segments_byte_exact(tmp_path):
+    """The object tier honors the exact SpoolStore contract while
+    compacting pages into multi-page segment objects: fewer objects
+    than pages, every re-read byte-exact, resume at any token."""
+    store = _object_store(tmp_path, segment_max_bytes=64)
+    tid = "q9.1.0"
+    pages = [bytes([i]) * (20 + i) for i in range(12)]
+    for t, p in enumerate(pages):
+        store.write_page(tid, 0, t, p)
+    # pending (not-yet-flushed) pages are servable immediately
+    got, nxt, complete = store.get_pages(tid, 0, 0, max_bytes=1 << 20)
+    assert got == pages and not complete
+    assert not store.is_complete(tid, 1)
+    store.set_complete(tid, 0, len(pages))
+    assert store.is_complete(tid, 1)
+    got, nxt, complete = store.get_pages(tid, 0, 0, max_bytes=1 << 20)
+    assert got == pages and complete and nxt == 12
+    # mid-stream resume (the late re-fetch / repoint shape)
+    got, nxt, complete = store.get_pages(tid, 0, 7)
+    assert got == pages[7:] and complete
+    # compaction really happened: multiple pages per object
+    segs = store.api.list(f"q9/{tid}/0/seg-")
+    assert 0 < len(segs) < len(pages), segs
+    assert store.stats["segments_written"] == len(segs)
+    store.close()
+
+
+def test_object_store_read_through_and_gc(tmp_path):
+    """Tokens the object tier does not hold read through to the FS
+    tier (mixed history), and delete_query clears both tiers plus the
+    pending buffers."""
+    store = _object_store(tmp_path)
+    # an FS-tier node wrote this stream (pre-switch history)
+    fs = store.fallback
+    fs.write_page("qf.0.0", 0, 0, b"fs-page-0")
+    fs.write_page("qf.0.0", 0, 1, b"fs-page-1")
+    fs.set_complete("qf.0.0", 0, 2)
+    got, nxt, complete = store.get_pages("qf.0.0", 0, 0)
+    assert got == [b"fs-page-0", b"fs-page-1"] and complete
+    assert store.is_complete("qf.0.0", 1)
+    # GC drops both tiers
+    store.write_page("qf.0.0", 0, 2, b"obj-page")
+    assert store.delete_query("qf")
+    assert store.get_pages("qf.0.0", 0, 0) == ([], 0, False)
+    assert not store.is_complete("qf.0.0", 1)
+    store.close()
+
+
+def test_object_store_orphan_sweep_skips_bucket(tmp_path):
+    """The FS tier's orphan sweep must never mistake the nested object
+    bucket for a stale query directory, while the object tier's own
+    sweep age-guards per query prefix."""
+    store = _object_store(tmp_path)
+    store.write_page("old.0.0", 0, 0, b"x")
+    store.flush()
+    store.fallback.write_page("oldfs.0.0", 0, 0, b"y")
+    old_obj = os.path.join(store.api.root, "old")
+    old_fs = os.path.join(store.fallback.root, "oldfs")
+    past = time.time() - 7200
+    os.utime(old_obj, (past, past))
+    os.utime(old_fs, (past, past))
+    assert store.sweep_orphans(max_age_s=3600) == 2
+    assert not os.path.exists(old_obj)
+    assert not os.path.exists(old_fs)
+    # the bucket itself survived even though it is now quiet
+    assert os.path.isdir(store.api.root)
+    store.close()
+
+
+def _object_cfg(tmp_path, **over):
+    return _spool_cfg(tmp_path, exchange_spool_tier="object", **over)
+
+
+def test_object_tier_buffer_eviction_reserves_byte_exact(tmp_path):
+    """Output-buffer eviction against the OBJECT tier: evicted pages —
+    including ones still pending in the store's in-memory batch, not
+    yet flushed as segments — re-serve byte-exact on a late re-fetch,
+    before AND after the async flush."""
+    from presto_tpu.server.buffers import OutputBufferManager
+
+    # a huge flush interval pins pages in the pending buffer until the
+    # explicit flush below — the pre-flush re-serve path
+    store = _object_store(tmp_path, segment_max_bytes=1 << 20,
+                          flush_interval_s=60.0)
+    pages = [bytes([i]) * 100 for i in range(10)]
+    mgr = OutputBufferManager(1, max_buffer_bytes=250, spool=store,
+                              task_id="q8.0.0")
+    for p in pages:
+        mgr.enqueue(0, p)          # never blocks: eviction makes room
+    assert mgr.pages_evicted >= 8
+    # nothing flushed yet (60s interval, below the size trigger): the
+    # evicted prefix re-serves from the store's PENDING buffer
+    assert store.stats["segments_written"] == 0
+    pre, _nxt, _c = mgr.get_pages(0, 0, max_bytes=1 << 20)
+    assert pre and pre == pages[:len(pre)]
+    mgr.set_no_more_pages()        # flushes synchronously + COMPLETE
+    assert mgr.spooled_complete()
+    got, nxt, complete = mgr.get_pages(0, 0, max_bytes=1 << 20)
+    while not complete:
+        more, nxt, complete = mgr.get_pages(0, nxt, max_bytes=1 << 20)
+        got.extend(more)
+    assert got == pages and nxt == 10
+    # and again after everything is durable as segments
+    store.flush()
+    got2, nxt2, complete2 = store.get_pages("q8.0.0", 0, 0,
+                                            max_bytes=1 << 20)
+    assert got2 == pages and complete2
+    store.close()
+
+
+def test_object_tier_cluster_exact_rows_and_segments(tmp_path):
+    """A real 2-worker cluster on the object tier: exact rows end to
+    end, pages written through as batched segment objects on every
+    node."""
+    cfg = _object_cfg(tmp_path)
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=cfg) as dqr:
+        co = dqr.coordinator
+        _wait_nodes(co, 2)
+        rows = dqr.execute(
+            "select l_returnflag, count(*) as c from lineitem "
+            "group by l_returnflag order by l_returnflag").rows
+        assert [r[1] for r in rows] == [14613, 30502, 14670]
+        from presto_tpu.server.spool import ObjectStoreSpoolStore
+
+        assert isinstance(co.spool, ObjectStoreSpoolStore)
+        assert all(isinstance(w.spool, ObjectStoreSpoolStore)
+                   for w in dqr.workers)
+        spooled = sum(w.spool.stats["pages_written"]
+                      for w in dqr.workers)
+        assert spooled > 0
+
+
+def test_object_tier_kill_after_finish_zero_reruns(tmp_path):
+    """The PR 7 headline holds on the object tier: a worker lost after
+    its tasks finished costs zero producer re-runs — consumers repoint
+    at (object-store) spooled output whose completeness the
+    coordinator verified through segments + COMPLETE objects."""
+    cfg = _object_cfg(tmp_path)
+    co_inj, hold = _drain_hold_injector()
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            coordinator_injector=co_inj,
+            heartbeat_interval_s=0.05,
+            heartbeat_max_missed=2) as dqr:
+        co = dqr.coordinator
+        _wait_nodes(co, 2)
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = dqr.execute(
+                    "select count(*) from lineitem").rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        qid = _wait_all_spooled(co, dqr)
+        q = co.queries[qid]
+        dqr.kill_worker(1)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not co.nodes.dead_uris():
+            time.sleep(0.02)
+        hold.release()
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert "err" not in res, res
+        assert res["rows"] == [(59785,)]   # exact SF0.01 count
+        assert q.producer_reruns_total == 0
+
+
+def test_object_tier_spool_read_error_retried(tmp_path):
+    """faults.py spool policies hit the object tier's read path the
+    same way they hit the FS tier's: transient read errors retry on
+    the error-budget discipline, rows stay exact."""
+    cfg = _object_cfg(tmp_path)
+    co_inj, hold = _drain_hold_injector()
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=cfg,
+            coordinator_injector=co_inj,
+            heartbeat_interval_s=0.05,
+            heartbeat_max_missed=2) as dqr:
+        co = dqr.coordinator
+        _wait_nodes(co, 2)
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = dqr.execute(
+                    "select count(*) from lineitem").rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        qid = _wait_all_spooled(co, dqr)
+        q = co.queries[qid]
+        victim_idx, _uri = _root_worker(q, dqr)
+        dqr.kill_worker(victim_idx)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and not q._spool_moves:
+            time.sleep(0.02)
+        assert q._spool_moves
+        # NOW fault the coordinator's spool reads: the root drain must
+        # retry them against the OBJECT tier's segment path exactly as
+        # it retries the FS tier's page files
+        rule = co_inj.add_spool_rule(r".", policy="spool-read-error",
+                                     times=2)
+        hold.release()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert "err" not in res, res
+        assert res["rows"] == [(59785,)]   # exact SF0.01 count
+        assert q.producer_reruns_total == 0
+        assert rule.remaining == 0      # both faults really fired

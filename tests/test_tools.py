@@ -196,7 +196,8 @@ class TestQpsRun:
         closed-loop run at 2 concurrency levels against a live 2-worker
         DQR asserting per-client exact-rows parity, nonzero plan-cache
         hits, and zero jit compiles on the second execution of a cached
-        plan."""
+        plan — then a hot-repeat run with the result cache on asserting
+        nonzero result-cache hits with exact rows."""
         import importlib
         import json
         import os
@@ -212,11 +213,18 @@ class TestQpsRun:
         assert payload["check"] == {
             "parity": True, "plan_cache_hits": True,
             "zero_second_run_compiles": True,
-            "second_run_plan_cached": True}
+            "second_run_plan_cached": True,
+            "hot_parity": True, "result_cache_hits": True,
+            "result_cache_bytes_served": True,
+            "hot_second_run_result_cached": True}
         levels = payload["report"]["levels"]
         assert [lv["concurrency"] for lv in levels] == [1, 2]
         for lv in levels:
             assert lv["qps"] > 0 and lv["p99_ms"] >= lv["p50_ms"]
+        # the hot tier really served from the cache
+        hot = payload["hot_report"]
+        assert hot["result_cache_hit_rate"] > 0.0
+        assert hot["result_cache_bytes_served"] > 0
 
 
 class TestQueryProfile:

@@ -22,26 +22,38 @@ import json
 import sys
 
 
-def load_metrics(path: str) -> dict:
-    """{metric name: value} from one artifact: the headline metric plus
-    every extras entry carrying a (metric, value) pair."""
+def load_metrics(path: str) -> tuple:
+    """({metric name: value}, {metric name: noise_band}) from one
+    artifact: the headline metric plus every extras entry carrying a
+    (metric, value) pair.  ``noise_band`` is a config's DOCUMENTED
+    run-to-run spread (a fraction, carried on the extras entry by
+    bench configs whose single-host variance was measured to exceed
+    the global tolerance — e.g. the spooled tpcds mesh config's ~2x
+    swings); the gate widens to it for that config only."""
     with open(path, "r", encoding="utf-8") as f:
         doc = json.load(f)
     out = {}
+    bands = {}
     if doc.get("metric") is not None and doc.get("value") is not None:
         out[doc["metric"]] = float(doc["value"])
     for extra in doc.get("extras", []) or []:
         if extra.get("metric") is not None \
                 and extra.get("value") is not None:
             out[extra["metric"]] = float(extra["value"])
-    return out
+            if extra.get("noise_band") is not None:
+                bands[extra["metric"]] = float(extra["noise_band"])
+    return out, bands
 
 
-def compare(old: dict, new: dict, tolerance: float) -> list:
+def compare(old: dict, new: dict, tolerance: float,
+            bands: dict = None) -> list:
     """Per-config rows for one artifact pair: (metric, old, new,
     delta fraction or None, status).  Configs only one side has are
-    reported (NEW/DROPPED) but never gate."""
+    reported (NEW/DROPPED) but never gate.  A config with a declared
+    ``noise_band`` (from either artifact) gates on
+    max(tolerance, band)."""
     rows = []
+    bands = bands or {}
     for name in sorted(set(old) | set(new)):
         if name not in old:
             rows.append((name, None, new[name], None, "NEW"))
@@ -51,7 +63,10 @@ def compare(old: dict, new: dict, tolerance: float) -> list:
             continue
         o, n = old[name], new[name]
         delta = (n / o - 1.0) if o else 0.0
-        status = "REGRESSED" if delta < -tolerance else "OK"
+        band = max(tolerance, bands.get(name, 0.0))
+        status = "REGRESSED" if delta < -band else "OK"
+        if status == "OK" and delta < -tolerance:
+            status = "OK(noise)"
         rows.append((name, o, n, delta, status))
     return rows
 
@@ -66,15 +81,20 @@ def report(paths: list, tolerance: float) -> tuple:
     """Render every consecutive pair; returns (lines, regressed)."""
     lines = []
     regressed = []
-    metrics = [(p, load_metrics(p)) for p in paths]
-    for (old_path, old), (new_path, new) in zip(metrics, metrics[1:]):
+    metrics = [(p, *load_metrics(p)) for p in paths]
+    for (old_path, old, old_bands), (new_path, new, new_bands) in \
+            zip(metrics, metrics[1:]):
+        # a band declared by EITHER side widens the gate: the old
+        # artifact may predate the annotation
+        bands = {**old_bands, **new_bands}
         lines.append(f"{old_path} -> {new_path} "
                      f"(tolerance {tolerance:.0%})")
         header = (f"  {'config':<56} {'old':>14} {'new':>14} "
                   f"{'delta':>8}  status")
         lines.append(header)
         lines.append("  " + "-" * (len(header) - 2))
-        for name, o, n, delta, status in compare(old, new, tolerance):
+        for name, o, n, delta, status in compare(old, new, tolerance,
+                                                 bands):
             d = f"{delta:+.1%}" if delta is not None else "-"
             lines.append(f"  {name:<56} {_fmt(o):>14} {_fmt(n):>14} "
                          f"{d:>8}  {status}")

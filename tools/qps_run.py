@@ -25,10 +25,18 @@ Modes:
   *arrival* — queueing delay under overload is visible (the
   million-users shape).
 
+``--hot`` swaps in the hot-repeat mix (every statement repeated
+verbatim — the dashboard-refresh shape) and ``--result-cache`` turns
+the cross-query result cache (server/resultcache.py) on for the
+cluster; result-cache hit-rate and bytes-served-from-cache are
+reported per level beside the plan-cache hit rate either way.
+
 ``--check`` is the CI smoke tier: tiny scale, 2 concurrency levels,
 exits nonzero unless every client saw exact rows AND the plan cache
 recorded hits AND the repeated statement's second execution compiled
-nothing.
+nothing — then a hot-repeat run with the result cache on must show
+nonzero result-cache hits with exact rows and a result-cache-served
+second execution.
 
 Exit code 0 = all levels parity-clean (and --check assertions hold).
 """
@@ -78,6 +86,12 @@ PREPARE_SQL = ("prepare qps_param from select count(*) as c "
                "from tpch.lineitem where l_quantity < ?")
 EXECUTE_SQL = "execute qps_param using 10"
 
+#: the hot-repeat mix (``--hot``): two statements repeated verbatim —
+#: the dashboard-refresh shape the cross-query result cache
+#: (server/resultcache.py) exists for.  After each statement's first
+#: execution every repeat is a cache hit served from spool pages.
+HOT_STATEMENTS = ["tpch_q1_lite", "tpcds_store_agg"]
+
 
 def _norm_rows(rows):
     """Order-insensitive, float-tolerant row normalization for the
@@ -93,11 +107,14 @@ def _percentile(sorted_vals, q):
     return sorted_vals[i]
 
 
-def _client_worklist(n_requests, offset):
+def _client_worklist(n_requests, offset, hot=False):
     """The statement sequence one client walks: the shared mix, rotated
     per client so concurrent clients overlap on every statement (the
-    plan-cache contention case) without issuing in lockstep."""
-    names = [name for name, _ in STATEMENTS] + ["tpch_execute"]
+    plan-cache contention case) without issuing in lockstep.  ``hot``
+    walks the tiny HOT_STATEMENTS mix instead — every statement repeats
+    verbatim, the result-cache case."""
+    names = (HOT_STATEMENTS if hot
+             else [name for name, _ in STATEMENTS] + ["tpch_execute"])
     return [names[(offset + j) % len(names)] for j in range(n_requests)]
 
 
@@ -126,7 +143,7 @@ def _run_one(client, oracle, name):
 
 
 def run_closed_level(dqr, oracle, concurrency, requests_per_client,
-                     n_users=2):
+                     n_users=2, hot=False):
     """Closed loop: N clients, each back-to-back through its worklist."""
     lock = threading.Lock()
     lats, mismatches, errors = [], [], []
@@ -135,7 +152,7 @@ def run_closed_level(dqr, oracle, concurrency, requests_per_client,
         client = dqr.new_client(user=f"client{i % n_users}")
         try:
             client.execute(PREPARE_SQL)
-            for name in _client_worklist(requests_per_client, i):
+            for name in _client_worklist(requests_per_client, i, hot):
                 lat, ok = _run_one(client, oracle, name)
                 with lock:
                     lats.append(lat)
@@ -159,7 +176,7 @@ def run_closed_level(dqr, oracle, concurrency, requests_per_client,
 
 
 def run_open_level(dqr, oracle, concurrency, rate_per_s, n_requests,
-                   n_users=2):
+                   n_users=2, hot=False):
     """Open loop: arrivals on a fixed schedule; latency counts from
     scheduled arrival (queueing under overload is visible).  A pool of
     ``concurrency`` workers drains the arrival queue."""
@@ -167,7 +184,7 @@ def run_open_level(dqr, oracle, concurrency, rate_per_s, n_requests,
     lats, mismatches, errors = [], [], []
     work: "queue.Queue" = queue.Queue()
     start = time.perf_counter() + 0.05
-    for j, name in enumerate(_client_worklist(n_requests, 0)):
+    for j, name in enumerate(_client_worklist(n_requests, 0, hot)):
         work.put((start + j / rate_per_s, name))
 
     def worker(i):
@@ -232,7 +249,10 @@ def _level_report(concurrency, lats, wall, mismatches, errors, mode):
 def _second_run_jit_compiles(dqr, oracle):
     """Execute an already-cached statement once more and read its
     /v1/query detail: a warm plan-cache + kernel-cache run must show
-    jit_compiles == 0 (the cross-query compiled-tier reuse proof)."""
+    jit_compiles == 0 (the cross-query compiled-tier reuse proof).
+    With the result cache on, the second run is served from spool
+    pages instead (resultCached=true) — its jit counters are genuine
+    zeros and no plan was consulted at all."""
     client = dqr.new_client(user="probe")
     name = STATEMENTS[0][0]
     client.execute(oracle.sql[name])          # belt-and-braces warm
@@ -242,14 +262,23 @@ def _second_run_jit_compiles(dqr, oracle):
             f"{dqr.coordinator.uri}/v1/query/{qid}", timeout=10) as resp:
         detail = json.loads(resp.read())
     return (int((detail.get("queryStats") or {}).get("jit_compiles", -1)),
-            bool(detail.get("planCached")))
+            bool(detail.get("planCached")),
+            bool(detail.get("resultCached")))
 
 
 def run_qps(scale=0.003, levels=(1, 2, 4, 8), requests_per_client=4,
             mode="closed", rate_per_s=10.0, n_workers=2,
-            hard_concurrency=8, per_user_limit=4, quiet=False):
+            hard_concurrency=8, per_user_limit=4, quiet=False,
+            hot_repeat=False, result_cache=False):
     """Boot the cluster, run every concurrency level, return the report
-    dict (the bench_concurrent_qps payload)."""
+    dict (the bench_concurrent_qps payload).  ``hot_repeat`` drives the
+    repeated-verbatim statement mix; ``result_cache`` turns the
+    cross-query result cache on for the cluster (hits are reported per
+    level beside the plan-cache numbers either way)."""
+    import dataclasses
+
+    from presto_tpu.config import DEFAULT
+    from presto_tpu.server import resultcache
     from presto_tpu.server.dqr import DistributedQueryRunner
     from presto_tpu.session import ResourceGroupManager
     from presto_tpu.sql import plancache
@@ -257,35 +286,55 @@ def run_qps(scale=0.003, levels=(1, 2, 4, 8), requests_per_client=4,
     groups = ResourceGroupManager(
         hard_concurrency_limit=hard_concurrency,
         per_user_limit=per_user_limit)
+    # the result cache is process-global (like the plan cache): start
+    # each load run from a cold, unpolluted cache so hit rates and
+    # bytes-served are this run's own
+    resultcache.clear()
+    cfg = dataclasses.replace(DEFAULT,
+                              result_cache_enabled=result_cache)
     report = {"scale": scale, "mode": mode, "n_workers": n_workers,
+              "hot_repeat": hot_repeat, "result_cache": result_cache,
               "resource_groups": {"hard_concurrency": hard_concurrency,
                                   "per_user_limit": per_user_limit},
               "levels": []}
     with DistributedQueryRunner.tpcds(scale=scale, n_workers=n_workers,
-                                      resource_groups=groups) as dqr:
+                                      resource_groups=groups,
+                                      config=cfg) as dqr:
         oracle = _Oracle(dqr)          # also warms scan + kernel caches
         for conc in levels:
             before = plancache.stats()
+            rc_before = resultcache.stats()
             if mode == "open":
                 n_requests = max(requests_per_client * conc, conc)
                 level = run_open_level(dqr, oracle, conc, rate_per_s,
-                                       n_requests)
+                                       n_requests, hot=hot_repeat)
             else:
                 level = run_closed_level(dqr, oracle, conc,
-                                         requests_per_client)
+                                         requests_per_client,
+                                         hot=hot_repeat)
             after = plancache.stats()
+            rc_after = resultcache.stats()
             hits = after["hits"] - before["hits"]
             misses = after["misses"] - before["misses"]
             level["plan_cache"] = {
                 "hits": hits, "misses": misses,
                 "hit_rate": round(hits / (hits + misses), 3)
                 if hits + misses else 0.0}
+            rc_hits = rc_after["hits"] - rc_before["hits"]
+            rc_misses = rc_after["misses"] - rc_before["misses"]
+            level["result_cache"] = {
+                "hits": rc_hits, "misses": rc_misses,
+                "hit_rate": round(rc_hits / (rc_hits + rc_misses), 3)
+                if rc_hits + rc_misses else 0.0,
+                "bytes_served": rc_after["bytes_served"]
+                - rc_before["bytes_served"]}
             report["levels"].append(level)
             if not quiet:
                 print(json.dumps(level), flush=True)
-        jit, cached = _second_run_jit_compiles(dqr, oracle)
+        jit, cached, rcached = _second_run_jit_compiles(dqr, oracle)
         report["second_run_jit_compiles"] = jit
         report["second_run_plan_cached"] = cached
+        report["second_run_result_cached"] = rcached
         # admission engagement: how many queries actually waited
         with urllib.request.urlopen(
                 f"{dqr.coordinator.uri}/v1/query", timeout=10) as resp:
@@ -298,6 +347,14 @@ def run_qps(scale=0.003, levels=(1, 2, 4, 8), requests_per_client=4,
     misses = sum(lv["plan_cache"]["misses"] for lv in report["levels"])
     report["plan_cache_hit_rate"] = round(
         hits / (hits + misses), 3) if hits + misses else 0.0
+    rc_hits = sum(lv["result_cache"]["hits"] for lv in report["levels"])
+    rc_misses = sum(lv["result_cache"]["misses"]
+                    for lv in report["levels"])
+    report["result_cache_hit_rate"] = round(
+        rc_hits / (rc_hits + rc_misses), 3) if rc_hits + rc_misses \
+        else 0.0
+    report["result_cache_bytes_served"] = sum(
+        lv["result_cache"]["bytes_served"] for lv in report["levels"])
     return report
 
 
@@ -314,29 +371,55 @@ def main(argv=None) -> int:
     ap.add_argument("--rate", type=float, default=10.0,
                     help="open-loop arrival rate, statements/s")
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--hot", action="store_true",
+                    help="hot-repeat mix: repeat HOT_STATEMENTS "
+                         "verbatim (the result-cache shape)")
+    ap.add_argument("--result-cache", action="store_true",
+                    help="enable the cross-query result cache on the "
+                         "cluster")
     ap.add_argument("--check", action="store_true",
                     help="CI smoke: tiny run, assert parity + plan-cache "
-                         "hits + zero second-run compiles")
+                         "hits + zero second-run compiles, then a "
+                         "hot-repeat run asserting nonzero result-cache "
+                         "hits with exact-rows parity")
     args = ap.parse_args(argv)
 
     if args.check:
         report = run_qps(scale=0.003, levels=(1, 2),
                          requests_per_client=2, mode="closed",
                          n_workers=2, quiet=True)
+        # hot-repeat tier: result cache ON, every statement repeated —
+        # hits must happen and every row must still match the
+        # single-threaded oracle exactly (a cached result is served
+        # from spool pages; parity is per request)
+        hot = run_qps(scale=0.003, levels=(2,),
+                      requests_per_client=4, mode="closed",
+                      n_workers=2, quiet=True, hot_repeat=True,
+                      result_cache=True)
         checks = {
             "parity": report["parity"],
             "plan_cache_hits": report["plan_cache_hit_rate"] > 0.0,
             "zero_second_run_compiles":
                 report["second_run_jit_compiles"] == 0,
             "second_run_plan_cached": report["second_run_plan_cached"],
+            "hot_parity": hot["parity"],
+            "result_cache_hits":
+                hot["result_cache_hit_rate"] > 0.0,
+            "result_cache_bytes_served":
+                hot["result_cache_bytes_served"] > 0,
+            "hot_second_run_result_cached":
+                hot["second_run_result_cached"],
         }
-        print(json.dumps({"check": checks, "report": report}))
+        print(json.dumps({"check": checks, "report": report,
+                          "hot_report": hot}))
         return 0 if all(checks.values()) else 1
 
     levels = tuple(int(x) for x in args.levels.split(",") if x.strip())
     report = run_qps(scale=args.scale, levels=levels,
                      requests_per_client=args.requests, mode=args.mode,
-                     rate_per_s=args.rate, n_workers=args.workers)
+                     rate_per_s=args.rate, n_workers=args.workers,
+                     hot_repeat=args.hot,
+                     result_cache=args.result_cache)
     print(json.dumps(report, indent=2))
     return 0 if report["parity"] else 1
 
