@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import time
+import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence, Tuple
 
@@ -38,10 +39,24 @@ class StatementClient:
     on every subsequent statement."""
 
     def __init__(self, coordinator_uri: str, poll_interval_s: float = 0.05,
-                 user: Optional[str] = None):
+                 user: Optional[str] = None,
+                 standby_uris: Optional[Sequence[str]] = None,
+                 failover_timeout_s: float = 30.0):
         self.base = coordinator_uri.rstrip("/")
         self.poll_interval_s = poll_interval_s
         self.user = user
+        # coordinator HA failover-follow: when the active coordinator
+        # stops answering (connection refused, 404 for a query the
+        # standby has not adopted yet, 503 from a not-yet-active
+        # standby), retry the SAME protocol step against each address
+        # in turn until one answers — query ids are stable across
+        # failover (the standby adopts the journal), so the drain
+        # resumes idempotently (PR 5/7 token+attempt dedup contract).
+        # With no standbys configured (the default) every request keeps
+        # its original single-attempt behavior exactly.
+        self.addresses = [self.base] + [u.rstrip("/")
+                                        for u in (standby_uris or [])]
+        self.failover_timeout_s = failover_timeout_s
         self.session_properties: dict = {}
         self.catalog: Optional[str] = None
         self.schema: Optional[str] = None
@@ -90,15 +105,69 @@ class StatementClient:
         for k in payload.get("deallocatedPrepare", []):
             self.prepared_statements.pop(k, None)
 
+    def _rebase(self, url: str, base: str) -> str:
+        """Rewrite ``url``'s scheme://host:port to ``base`` (the
+        failover-follow address rotation; paths — including query ids —
+        are stable across coordinators)."""
+        import urllib.parse
+
+        parts = urllib.parse.urlsplit(url)
+        b = urllib.parse.urlsplit(base)
+        return urllib.parse.urlunsplit(
+            (b.scheme, b.netloc, parts.path, parts.query,
+             parts.fragment))
+
+    def _open_json(self, url: str, data: Optional[bytes] = None,
+                   method: str = "GET", headers: Optional[dict] = None,
+                   timeout: float = 30.0) -> dict:
+        """One protocol step, with failover-follow: on a transport
+        error / 404 / 503 and standby addresses configured, retry the
+        same step against each address until one answers or the
+        failover window closes.  Single-address clients keep the
+        original raise-through behavior byte-identically."""
+        if len(self.addresses) <= 1:
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=dict(headers or {}))
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read())
+        deadline = time.monotonic() + self.failover_timeout_s
+        last_error: Optional[Exception] = None
+        while True:
+            for base in self.addresses:
+                try:
+                    req = urllib.request.Request(
+                        self._rebase(url, base), data=data,
+                        method=method, headers=dict(headers or {}))
+                    with urllib.request.urlopen(req,
+                                                timeout=timeout) as resp:
+                        # remember the answering coordinator: session
+                        # updates and follow-up statements go there
+                        self.base = base
+                        return json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    if e.code not in (404, 503):
+                        raise
+                    # 404 = the standby has not adopted this query yet
+                    # (or this address is stale); 503 = standby not
+                    # active yet — both retryable within the window
+                    last_error = e
+                except (urllib.error.URLError, ConnectionError,
+                        TimeoutError, OSError) as e:
+                    last_error = e
+            if time.monotonic() > deadline:
+                raise QueryFailed(
+                    f"no coordinator answered within "
+                    f"{self.failover_timeout_s:g}s failover window: "
+                    f"{last_error}")
+            time.sleep(min(self.poll_interval_s * 2, 0.2))
+
     def execute(self, sql: str,
                 timeout_s: float = 300.0
                 ) -> Tuple[List[dict], List[list]]:
         """Returns (columns, rows); raises QueryFailed on query error."""
-        req = urllib.request.Request(
+        payload = self._open_json(
             f"{self.base}/v1/statement", data=sql.encode("utf-8"),
-            method="POST", headers=self._headers())
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            payload = json.loads(resp.read())
+            method="POST", headers=self._headers(), timeout=30)
         self.last_query_id = payload.get("id")
         self.stats_history = []
         deadline = time.monotonic() + timeout_s
@@ -111,9 +180,8 @@ class StatementClient:
                     and payload.get("nextUri"):
                 # the POST ack of a fast failure carries only the state;
                 # the detailed error lives at the results URI
-                with urllib.request.urlopen(payload["nextUri"],
-                                            timeout=30) as resp:
-                    payload = json.loads(resp.read())
+                payload = self._open_json(payload["nextUri"],
+                                          timeout=30)
             if state == "FAILED" or "error" in payload:
                 err = payload.get("error", {})
                 raise QueryFailed(err.get("message", "query failed"),
@@ -134,8 +202,7 @@ class StatementClient:
             if time.monotonic() > deadline:
                 raise QueryFailed("client timeout")
             time.sleep(self.poll_interval_s)
-            with urllib.request.urlopen(next_uri, timeout=120) as resp:
-                payload = json.loads(resp.read())
+            payload = self._open_json(next_uri, timeout=120)
 
 
 # ---------------------------------------------------------------------------

@@ -358,6 +358,30 @@ class EngineConfig:
     # before failing with the queue-timeout error (the reference's
     # query.max-queued-time role)
     query_queue_timeout_s: float = 300.0
+    # --- coordinator HA (server/statestore.py) ---------------------------
+    # Durable query-state journal + takeover lease root (an object-API
+    # directory; primary and standby coordinators must see the same
+    # storage, like the spool path).  Empty = HA journaling disabled —
+    # the default, which leaves every existing code path untouched.
+    coordinator_state_path: str = ""
+    # takeover lease TTL: the active coordinator renews every ttl/3; a
+    # standby that observes the lease expired claims the next
+    # generation (compare-and-swap) and adopts the journal
+    coordinator_lease_ttl_s: float = 2.0
+    # largest FINISHED-query result adopted into a durable ha* spool
+    # stream at terminal journaling (bigger results journal without
+    # rows and re-enter admission on adoption)
+    coordinator_journal_max_result_bytes: int = 16 << 20
+    # --- worker-side plan_fragment cache (server/task.py) ----------------
+    # Repeat task creates of the same statement (same fragment JSON,
+    # scan shard, output topology, session fingerprint, and coordinator
+    # stats epochs) reuse the lowered pipeline factories instead of
+    # re-running plan_fragment — the distributed half of the plan
+    # cache's physical-factory sharing.  Entries re-arm via
+    # reset_for_execution and rebind exchange sources + output buffers
+    # per task; an entry in use by a live task is never shared.
+    worker_fragment_cache_enabled: bool = True
+    worker_fragment_cache_capacity: int = 32
     # --- live query telemetry (the StatementStats/QueryProgressStats
     # role: progress observable MID-query, not just post-mortem) --------
     # coordinator sampler: while a query is RUNNING, poll every

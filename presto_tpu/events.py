@@ -149,6 +149,37 @@ class SpeculationEvent:
     time: float
 
 
+@dataclasses.dataclass(frozen=True)
+class CoordinatorFailoverEvent:
+    """A standby coordinator won the takeover lease and adopted the
+    durable query-state journal (server/statestore.py): every query the
+    dead coordinator owned is re-served, re-attached, restarted, or
+    re-queued through the standby."""
+
+    coordinator_uri: str
+    previous_owner: str
+    generation: int                # lease generation won by the claim
+    adopted_queries: int
+    time: float
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryAdoptedEvent:
+    """One journaled query adopted by a standby on failover.  Outcome:
+    'served' (FINISHED, rows straight from adopted spool pages),
+    'repointed' (all stages complete-in-spool, only the root drain
+    moved — zero re-execution), 'reattached' (live tasks re-announced
+    to the standby and kept producing), 'restarted' (unreachable tasks
+    re-run from the spool at fresh attempt ids), 'requeued' (QUEUED /
+    PLANNING: re-entered admission), or 'failed'."""
+
+    query_id: str
+    trace_token: str
+    from_state: str                # journaled lifecycle state at adopt
+    outcome: str
+    time: float
+
+
 class EventListener:
     """Implement any subset (EventListener SPI surface)."""
 
@@ -174,6 +205,13 @@ class EventListener:
         pass
 
     def slow_query(self, event: SlowQueryEvent) -> None:
+        pass
+
+    def coordinator_failover(self, event: CoordinatorFailoverEvent
+                             ) -> None:
+        pass
+
+    def query_adopted(self, event: QueryAdoptedEvent) -> None:
         pass
 
 
@@ -215,6 +253,13 @@ class EventBus:
     def slow_query(self, event: SlowQueryEvent) -> None:
         self._fire("slow_query", event)
 
+    def coordinator_failover(self, event: CoordinatorFailoverEvent
+                             ) -> None:
+        self._fire("coordinator_failover", event)
+
+    def query_adopted(self, event: QueryAdoptedEvent) -> None:
+        self._fire("query_adopted", event)
+
 
 class JsonLinesEventListener(EventListener):
     """The bundled ``query.json`` event log (the reference ships the
@@ -245,6 +290,8 @@ class JsonLinesEventListener(EventListener):
     worker_drain = _write
     speculation = _write
     slow_query = _write
+    coordinator_failover = _write
+    query_adopted = _write
 
 
 def read_event_log(path: str) -> List[Dict[str, Any]]:

@@ -20,5 +20,9 @@ reference's layered control plane (SURVEY §1 L5-L7, §2.5, §2.8, §5.8):
                      discovery, heartbeat failure detection, scheduling
 - ``dqr``          — DistributedQueryRunner: real coordinator + N workers
                      with real HTTP on ephemeral ports, in one process
-                     (DistributedQueryRunner.java:73 pattern)
+                     (DistributedQueryRunner.java:73 pattern); plus
+                     HAQueryRunner (primary + standby + shared journal)
+- ``statestore``   — coordinator HA: durable query-state journal +
+                     takeover lease over the pluggable object API
+                     (a standby adopts in-flight queries on failover)
 """

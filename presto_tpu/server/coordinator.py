@@ -187,6 +187,14 @@ class _SpoolUnavailable(Exception):
     retry."""
 
 
+class _CoordinatorKilled(Exception):
+    """Chaos control flow (coordinator HA): this coordinator was
+    process-level killed (``CoordinatorServer.kill``) — the query
+    thread must stop IMMEDIATELY with no externally visible side
+    effects (no events, no cancel fan-out, no spool GC), leaving worker
+    tasks producing into the spool for the standby to adopt."""
+
+
 class QueryExecution:
     """One query's lifecycle (QueryStateMachine + SqlQueryExecution)."""
 
@@ -335,6 +343,17 @@ class QueryExecution:
         # name -> (start, end) epoch seconds, coordinator-owned
         self._marks: Dict[str, Tuple[float, float]] = {}
         self._completed_fired = False
+        # -- coordinator HA (server/statestore.py) -------------------------
+        # durable-journal bookkeeping: serde'd plan cached per query,
+        # root-drain consumed tokens per original location, and the
+        # adopted-query flags a standby sets when it rebuilds this
+        # query from a dead coordinator's journal
+        self._journal_lock = threading.Lock()
+        self._dplan_json: Optional[Dict] = None
+        self._root_tokens: Dict[str, int] = {}
+        self._plan_epochs_cache: Optional[Dict] = None
+        self.adopted = False
+        self.adopt_outcome: Optional[str] = None
         self.co.event_bus.query_created(ev.QueryCreatedEvent(
             self.query_id, self.user, self.sql, self.create_time,
             trace_token=self.trace_token))
@@ -370,9 +389,369 @@ class QueryExecution:
             group.release()
             self._fire_completed()
 
+    # -- coordinator HA: durable journal + standby adoption ---------------
+    def _journal(self, state: Optional[str] = None) -> None:
+        """Write-through this query's durable state at a lifecycle
+        transition (server/statestore.py).  Strictly best-effort: a
+        journal problem must never fail a query the engine can run."""
+        store = getattr(self.co, "statestore", None)
+        if store is None:
+            return
+        try:
+            doc = self._journal_doc(state or self.state)
+            with self._journal_lock:
+                store.write(doc)
+        except Exception:  # noqa: BLE001 - journaling is best-effort
+            pass
+
+    def _journal_transition(self, state: str) -> None:
+        """Journal + the chaos phase hook: tests install
+        ``CoordinatorServer.phase_hook`` to hold a query AT a lifecycle
+        phase; when the hook returns on a killed coordinator, the query
+        thread stops with no side effects (the process-death shape)."""
+        self._journal(state)
+        hook = getattr(self.co, "phase_hook", None)
+        if hook is not None:
+            try:
+                hook(self, state)
+            except Exception:  # noqa: BLE001 - hooks never fail queries
+                pass
+        if getattr(self.co, "killed", False):
+            raise _CoordinatorKilled()
+
+    def _journal_doc(self, state: str):
+        from presto_tpu.server.statestore import QueryJournal
+        from presto_tpu.sql.planserde import dplan_to_json
+
+        j = QueryJournal(
+            query_id=self.query_id, sql=self.sql, user=self.user,
+            catalog=self.catalog,
+            session_properties=dict(self.session_properties),
+            prepared=dict(self.prepared), trace_token=self.trace_token,
+            plan_key_sql=self._plan_key_sql, state=state,
+            error=self.error, create_time=self.create_time)
+        if self._dplan is not None and self._tasks_scheduled:
+            if self._dplan_json is None:
+                self._dplan_json = dplan_to_json(self._dplan)
+            j.dplan = self._dplan_json
+            with self._recovery_lock:
+                j.placements = list(self._placements)
+                j.attempts = dict(self._attempts)
+                fid_of = {tid: fid for fid, tid, _ in self._placements}
+                j.task_specs = {
+                    tid: {"fid": fid_of[tid], "index": spec["index"],
+                          "scan_shard": list(spec["scan_shard"]),
+                          "n_out": spec["n_out"],
+                          "broadcast": spec["broadcast"],
+                          "consumer_index": spec["consumer_index"],
+                          "base": spec["base"]}
+                    for tid, spec in self._task_specs.items()
+                    if tid in fid_of}
+                j.root_locations = list(self._root_orig)
+                j.root_tokens = dict(self._root_tokens)
+        return j
+
+    def _journal_terminal(self) -> None:
+        """Terminal journal write, BEFORE the query's spool GC: a
+        FINISHED query's root output is adopted into a stable ``ha*``
+        spool stream (outliving the query) so a standby serves its rows
+        with zero re-execution; small or unspooled results journal
+        their rows inline."""
+        store = getattr(self.co, "statestore", None)
+        if store is None or getattr(self.co, "killed", False):
+            return
+        try:
+            j = self._journal_doc(self.state)
+            j.column_names = list(self.column_names)
+            j.column_types = [t.display() for t in self.column_types]
+            j.row_count = len(self.result_rows)
+            if self.state == "FINISHED" and \
+                    not self._journal_adopt_result(j):
+                cfg = getattr(self, "_cfg", None) or self.co.config
+                rows = [[_json_value(v) for v in row]
+                        for row in self.result_rows]
+                encoded = json.dumps(rows)
+                if len(encoded) <= \
+                        cfg.coordinator_journal_max_result_bytes:
+                    j.inline_rows = rows
+            with self._journal_lock:
+                store.write(j)
+        except Exception:  # noqa: BLE001 - journaling is best-effort
+            pass
+
+    def _journal_adopt_result(self, j) -> bool:
+        """Copy the root-output spool stream(s) into ``ha{token}.0.0``
+        (partition i per root location) — the result-cache adoption
+        shape, reused for the HA journal.  Returns False when the
+        stream is not adoptable (spooling off, incomplete, oversized)."""
+        import uuid as _uuid
+
+        from presto_tpu.server import resultcache
+        from presto_tpu.server.spool import query_id_of
+
+        cfg = getattr(self, "_cfg", None) or self.co.config
+        if not (self._tasks_scheduled and self._spool_enabled()
+                and self._dplan is not None):
+            return False
+        with self._recovery_lock:
+            root_tids = list(self._frag_tasks.get(
+                self._dplan.root_fragment_id) or [])
+        if not root_tids:
+            return False
+        store = self.co.spool
+        ha_tid = f"ha{_uuid.uuid4().hex[:12]}.0.0"
+        budget = cfg.coordinator_journal_max_result_bytes
+        total = 0
+        try:
+            for i, tid in enumerate(root_tids):
+                pages = resultcache.read_complete_stream(
+                    store, tid, 0, max_bytes=budget - total)
+                if pages is None:
+                    raise ValueError("stream not adoptable")
+                for tok, page in enumerate(pages):
+                    store.write_page(ha_tid, i, tok, page)
+                store.set_complete(ha_tid, i, len(pages))
+                total += sum(len(p) for p in pages)
+        except Exception:  # noqa: BLE001 - adoption is best-effort
+            try:
+                store.delete_query(query_id_of(ha_tid))
+            except Exception:  # noqa: BLE001
+                pass
+            return False
+        j.result_task_id = ha_tid
+        j.result_locations = len(root_tids)
+        j.result_bytes = total
+        return True
+
+    @classmethod
+    def adopt(cls, co: "CoordinatorServer", journal) -> "QueryExecution":
+        """Rebuild one journaled query on a standby coordinator that
+        just won the takeover lease, and start its adoption thread."""
+        q = cls(journal.query_id, journal.sql, co, user=journal.user,
+                session_properties=journal.session_properties,
+                catalog=journal.catalog, prepared=journal.prepared,
+                trace_token=journal.trace_token, auto_start=False)
+        q.adopted = True
+        if journal.create_time:
+            q.create_time = journal.create_time
+        q._plan_key_sql = journal.plan_key_sql
+        co.queries[journal.query_id] = q
+        q._thread = threading.Thread(
+            target=q._run_adopted, args=(journal,), daemon=True,
+            name=f"adopt-{journal.query_id}")
+        q._thread.start()
+        return q
+
+    def _run_adopted(self, journal) -> None:
+        outcome = "failed"
+        try:
+            if journal.state == "FAILED":
+                self.error = journal.error or "query failed"
+                self.state = "FAILED"
+                outcome = "served"
+            elif journal.state == "FINISHED":
+                self._serve_journal_result(journal)
+                outcome = "served"
+            else:
+                outcome = self._adopt_running(journal)
+        except Exception as e:  # noqa: BLE001 - adoption failure surface
+            self.error = self.error or f"adoption failed: {e}"
+            self.co.log(traceback.format_exc())
+            self.state = "FAILED"
+            outcome = "failed"
+        finally:
+            self.adopt_outcome = outcome
+            self.co.count_adopted(outcome)
+            self.co.event_bus.query_adopted(ev.QueryAdoptedEvent(
+                self.query_id, self.trace_token, journal.state, outcome,
+                ev.now()))
+            if self._tasks_scheduled:
+                try:
+                    self._collect_stats()
+                except Exception:  # noqa: BLE001 - stats best-effort
+                    pass
+            if self._tasks_scheduled:
+                # only a RUNNING adoption produced fresh state worth
+                # journaling; a served/failed terminal journal is
+                # already correct (re-writing it would drop the ha*
+                # page pointer a THIRD failover still needs)
+                self._journal_terminal()
+            self._fire_completed()
+            self.rows_done.set()
+            self._monitor_stop.set()
+            if self._tasks_scheduled:
+                self._cancel_worker_tasks()
+            if self._tasks_scheduled and self.co.spool is not None:
+                try:
+                    self.co.spool.delete_query(self.query_id)
+                except Exception:  # noqa: BLE001 - GC is best-effort
+                    pass
+
+    def _serve_journal_result(self, journal) -> None:
+        """FINISHED query: rows straight from the adopted ``ha*`` spool
+        pages (byte-exact re-drain), or the inline journal encoding."""
+        self.column_names = list(journal.column_names)
+        self.column_types = [T.parse_type(s)
+                             for s in journal.column_types]
+        if journal.result_task_id:
+            locations = [
+                f"spool://v1/task/{journal.result_task_id}/results/{i}"
+                for i in range(journal.result_locations)]
+            self.state = "RUNNING"
+            with self._mark("execute"):
+                self._drain(locations)
+        elif journal.inline_rows is not None:
+            self.result_rows = [
+                tuple(_client_value(v, t) for v, t in
+                      zip(row, self.column_types))
+                for row in journal.inline_rows]
+        else:
+            raise RuntimeError(
+                "journaled FINISHED query has no recoverable result "
+                "(no ha pages, no inline rows)")
+        self.state = "FINISHED"
+
+    def _adopt_running(self, journal) -> str:
+        """Adopt a mid-flight query: live tasks re-attach (they keep
+        producing into the spool), tasks complete-in-spool get their
+        consumers repointed (zero re-execution), unreachable tasks
+        restart through the EXISTING spool stage-retry machinery at
+        fresh attempt ids, and the root drain re-pulls the spooled root
+        stream from token 0 (idempotent under the token+attempt dedup
+        contract)."""
+        from presto_tpu.server.spool import spool_location
+        from presto_tpu.sql.planserde import dplan_from_json
+
+        cfg = self._session().effective_config(self.co.config)
+        self._cfg = cfg
+        if not (cfg.exchange_spooling_enabled
+                and self.co.spool is not None):
+            raise RuntimeError("adopting a RUNNING query requires the "
+                               "spooled exchange (its state lives in "
+                               "the spool)")
+        if not journal.placements or journal.dplan is None:
+            # _adopt_journal routes task-less queries to re-admission
+            # before building an adoption shell; reaching here means
+            # the journal is inconsistent
+            raise RuntimeError("RUNNING journal has no placements")
+        dplan = dplan_from_json(journal.dplan)
+        if any(f.partitioning == "scaled" for f in dplan.fragments):
+            raise RuntimeError(
+                "coordinator failed over mid-write: the write was "
+                "aborted (writer fragments are not adoptable)")
+        self._dplan = dplan
+        self.column_names = list(dplan.column_names)
+        self.column_types = list(dplan.column_types)
+        frag_by_id = {f.fragment_id: f for f in dplan.fragments}
+        for f in dplan.fragments:
+            for pfid in f.consumed_fragments:
+                self._consumers[pfid] = f.fragment_id
+        # placements + per-fragment task/uri tables, index-ordered like
+        # _schedule builds them (the recovery machinery's shape)
+        by_fid: Dict[int, List] = {}
+        for fid, tid, uri in journal.placements:
+            spec = journal.task_specs.get(tid)
+            if spec is None:
+                raise RuntimeError(f"journal lacks a spec for {tid}")
+            by_fid.setdefault(fid, []).append((spec["index"], tid, uri))
+        for fid, rows in by_fid.items():
+            rows.sort()
+            self._frag_tasks[fid] = [tid for _, tid, _ in rows]
+            self._task_uris[fid] = [
+                (spool_location(tid) if uri.startswith("spool://")
+                 else f"{uri}/v1/task/{tid}/results/{{part}}")
+                for _, tid, uri in rows]
+        self._attempts = dict(journal.attempts)
+        for fid, tid, uri in journal.placements:
+            spec = journal.task_specs[tid]
+            frag = frag_by_id[fid]
+            self._placements.append((fid, tid, uri))
+            self._task_specs[tid] = {
+                "frag": frag,
+                "scan_shard": tuple(spec["scan_shard"]),
+                "remote": {pfid: self._task_uris[pfid]
+                           for pfid in frag.consumed_fragments},
+                "n_out": spec["n_out"], "broadcast": spec["broadcast"],
+                "consumer_index": spec["consumer_index"],
+                "base": spec["base"], "index": spec["index"],
+                "created_at": time.monotonic()}
+        self._tasks_scheduled = True
+        self.state = "RUNNING"
+        self.admit_time = self.admit_time or ev.now()
+        # classify every placement: alive / complete-in-spool / lost
+        live = 0
+        repointed = 0
+        lost: List[Tuple[int, str]] = []
+        for fid, tid, uri in list(self._placements):
+            if uri.startswith("spool://"):
+                repointed += 1
+                continue
+            if self._reattach_task(tid, uri) == "alive":
+                live += 1
+                continue
+            spec = self._task_specs[tid]
+            complete = False
+            try:
+                complete = self._spool_complete(tid, spec)
+            except _SpoolUnavailable:
+                complete = False
+            if complete:
+                self._repoint_to_spool(fid, tid, uri, spec)
+                repointed += 1
+            else:
+                lost.append((fid, tid))
+        if lost:
+            self._retry_stages_spooled(
+                lost, f"failed-over coordinator "
+                      f"({len(lost)} unreachable task(s))")
+        self._start_recovery_monitor()
+        self._start_sampler()
+        self._journal("RUNNING")
+        # the root drain reads the spooled root stream(s) from token 0:
+        # write-through spooling means a live root task's stream fills
+        # progressively and a finished one is complete — zero
+        # re-execution either way
+        with self._recovery_lock:
+            root_tids = list(self._frag_tasks[dplan.root_fragment_id])
+        roots = [f"spool://v1/task/{tid}/results/0" for tid in root_tids]
+        with self._recovery_lock:
+            self._root_orig = {loc: loc for loc in roots}
+        with self._mark("execute"):
+            self._drain(roots)
+        self.state = "FINISHED"
+        if lost:
+            return "restarted"
+        if live:
+            return "reattached"
+        return "repointed"
+
+    def _reattach_task(self, tid: str, uri: str) -> str:
+        """The worker-side coordinator repoint: POST
+        /v1/task/{id}/coordinator re-announces this coordinator as the
+        task's owner.  'alive' means the worker holds the task and it
+        is not FAILED/CANCELED — it keeps producing into the spool."""
+        headers = {"Content-Type": "application/json"}
+        headers.update(self._internal_headers())
+        body = json.dumps({"coordinator": self.co.uri}).encode("utf-8")
+        try:
+            resp = self.co.http.request(
+                f"{uri}/v1/task/{tid}/coordinator", method="POST",
+                data=body, headers=headers, timeout=5, task_id=tid,
+                description="coordinator reattach",
+                max_error_duration_s=2.0)
+            info = resp.json()
+        except Exception:  # noqa: BLE001 - unreachable = lost
+            return "lost"
+        if info.get("status") != "reattached":
+            return "lost"
+        return ("alive" if info.get("state") in ("RUNNING", "FINISHED")
+                else "lost")
+
     def _fire_completed(self) -> None:
         """QueryCompletedEvent enriched with the stage-stats rollup
         (QueryMonitor.queryCompletedEvent role).  Fired exactly once."""
+        if getattr(self.co, "killed", False):
+            return
         if self._completed_fired:
             return
         self._completed_fired = True
@@ -494,6 +873,16 @@ class QueryExecution:
             self.co.count_device_fallback(kind)
             return False
 
+        sticky = getattr(dplan, "_device_fallback", None)
+        if sticky is not None:
+            # a previous execution of this cached plan already proved
+            # the shape cannot serve from the collective tier (capacity
+            # non-convergence / unsupported shape): go straight to the
+            # task-scheduled plane with the ALREADY-FRAGMENTED plan —
+            # no re-parse/analyze/optimize (the plan-cache hit carried
+            # the fragments here) and no re-attempted lowering.  Still
+            # counted under the bounded fallback-reason categories.
+            return fallback(sticky[0], sticky[1])
         workers = self.co.nodes.alive_nodes()
         shared_fp = self.co.nodes.common_mesh_fingerprint()
         if not workers or shared_fp is None \
@@ -525,6 +914,11 @@ class QueryExecution:
                     info = dict(runner.last_run_info)
                 exec_t1 = ev.now()
         except (MeshUnsupported, NotImplementedError) as e:
+            # deterministic per plan (capacity non-convergence exhausts
+            # every bucket scale; unsupported primitives never lower):
+            # record it ON the dplan so the plan-cache hit path skips
+            # the device attempt entirely on every repeat
+            dplan._device_fallback = (f"mesh: {e}", "unsupported_shape")
             return fallback(f"mesh: {e}", "unsupported_shape")
         except ValueError:
             # query-semantic errors surfaced during mesh execution
@@ -838,11 +1232,16 @@ class QueryExecution:
         ``resultCached=true``."""
         from presto_tpu.exec.context import QueryStats
         from presto_tpu.server import resultcache
+        from presto_tpu.sql import plancache
 
         cfg = self._session().effective_config(self.co.config)
         if not cfg.result_cache_enabled:
             return False
         self._cfg = cfg
+        if plancache.has_nondeterministic_functions(key_sql):
+            # now()/current_timestamp/random()-family: two executions
+            # legitimately differ — never admitted, so never probed
+            return False
         key, epochs = self._result_cache_key(key_sql)
         hit = resultcache.get(key, epochs)
         if hit is None:
@@ -913,6 +1312,12 @@ class QueryExecution:
             return
         if (not self._tasks_scheduled or self.canceled
                 or self.error is not None):
+            return
+        if plancache.has_nondeterministic_functions(
+                self._plan_key_sql or self.sql):
+            # the ROADMAP 4i non-determinism guard: a result over
+            # now()/random() is only true for THIS execution — the
+            # statement re-executes on every repeat
             return
         cats = {self.catalog}
         for f in dplan.fragments:
@@ -1010,6 +1415,7 @@ class QueryExecution:
     def _run_admitted(self) -> None:
         try:
             self.state = "PLANNING"
+            self._journal_transition("PLANNING")
             # pre-parse plan-cache probe: a repeated statement (same raw
             # SQL, catalog, session fingerprint, live stats epochs) goes
             # straight to scheduling — parse/analyze/optimize all
@@ -1107,6 +1513,12 @@ class QueryExecution:
             if not analyze:
                 self._maybe_admit_result_cache(dplan)
             self.state = "FINISHED"
+        except _CoordinatorKilled:
+            # chaos: this coordinator was process-level killed mid-query
+            # — stop with NO side effects (the finally's killed guard
+            # skips events, cancel fan-out, and spool GC); the standby
+            # adopts this query from the journal
+            pass
         except Exception as e:  # noqa: BLE001 - query failure surface
             # keep a more specific error set by a killer (low-memory,
             # kill_query) over the generic drain abort
@@ -1114,6 +1526,9 @@ class QueryExecution:
             self.co.log(traceback.format_exc())
             self.state = "FAILED"
         finally:
+            if getattr(self.co, "killed", False):
+                self._monitor_stop.set()
+                return
             # release worker-side state the drain did not consume: a
             # TopN merge stops early, and failed queries strand tasks
             # mid-run — cancel fans out DELETE /v1/query/{id} so output
@@ -1131,6 +1546,10 @@ class QueryExecution:
                     self._collect_stats()
                 except Exception:  # noqa: BLE001 - stats are best-effort
                     pass
+            # terminal journal write (coordinator HA) runs BEFORE the
+            # spool GC below so a FINISHED query's root pages can be
+            # adopted into their durable ha* stream first
+            self._journal_terminal()
             self._fire_completed()
             self.rows_done.set()
             self._monitor_stop.set()
@@ -1305,6 +1724,8 @@ class QueryExecution:
 
     def _sampler_loop(self, interval_s: float, cfg) -> None:
         while not self._monitor_stop.wait(interval_s):
+            if getattr(self.co, "killed", False):
+                return
             if self._stats_collected or self.state != "RUNNING":
                 return
             try:
@@ -1565,6 +1986,10 @@ class QueryExecution:
         ``cancel_fanout_budget_s`` error budget (config/session knob) so
         one hung worker cannot stall the fan-out for the full transport
         budget."""
+        if getattr(self.co, "killed", False):
+            # a killed coordinator must not reach out: worker tasks
+            # keep producing into the spool for the standby to adopt
+            return
         cfg = getattr(self, "_cfg", None) or self.co.config
         budget = min(cfg.cancel_fanout_budget_s,
                      cfg.remote_request_max_error_duration_s)
@@ -1687,6 +2112,9 @@ class QueryExecution:
                  for u in task_uris[dplan.root_fragment_id]]
         self._root_orig = {loc: loc for loc in roots}
         self._start_recovery_monitor()
+        # placements are final: journal the RUNNING snapshot (plan +
+        # placements + attempts) so a standby can adopt mid-flight
+        self._journal_transition("RUNNING")
         return roots
 
     # -- mid-query task recovery ----------------------------------------
@@ -1712,6 +2140,8 @@ class QueryExecution:
     def _monitor_loop(self, interval_s: float) -> None:
         cfg = getattr(self, "_cfg", None) or self.co.config
         while not self._monitor_stop.wait(interval_s):
+            if getattr(self.co, "killed", False):
+                return
             if self.state not in ("SCHEDULING", "RUNNING"):
                 return
             try:
@@ -1788,6 +2218,7 @@ class QueryExecution:
         if self._spool_enabled():
             try:
                 self._recover_worker_spooled(dead_uri, affected)
+                self._journal("RUNNING")
                 return
             except _SpoolUnavailable as e:
                 # spool verification failed (missing object, read
@@ -1797,6 +2228,7 @@ class QueryExecution:
                 self.co.log(f"spool recovery for {dead_uri} failed "
                             f"({e}); falling back to cascading retry")
         self._recover_worker_cascading(dead_uri, affected)
+        self._journal("RUNNING")
 
     def _recover_worker_cascading(self, dead_uri: str,
                                   affected) -> None:
@@ -2141,10 +2573,15 @@ class QueryExecution:
         esc: List[Tuple[int, str]] = []
         cons_fid = self._consumers.get(fid)
         if cons_fid is None:
+            from presto_tpu.server.spool import spool_prefix as _sp
+
             with self._recovery_lock:
                 old_loc, new_loc = old_prefix + "0", new_prefix + "0"
+                # an adopted root drain reads spool://…{old_tid}…/0 —
+                # that location shape moves to the fresh attempt too
+                old_locs = {old_loc, _sp(old_tid) + "0"}
                 for orig, cur in self._root_orig.items():
-                    if cur == old_loc:
+                    if cur in old_locs:
                         self._root_orig[orig] = new_loc
                         self._restarts[orig] = new_loc
                         self._spool_moves.pop(orig, None)
@@ -2764,6 +3201,25 @@ class QueryExecution:
                 self._cancel_tasks([(sp["clone"], sp["clone_uri"])])
                 self._fire_speculation(tid, sp)
 
+    def _plan_epochs(self) -> Optional[Dict]:
+        """The coordinator's per-catalog stats-epoch snapshot for this
+        plan, shipped on task create so the worker-side plan_fragment
+        cache is keyed like the plan cache: any DML/DDL bumps an epoch,
+        the key changes, and stale lowered pipelines LRU out."""
+        if self._dplan is None:
+            return None
+        if self._plan_epochs_cache is None:
+            from presto_tpu.sql import plancache
+
+            epochs = plancache.epochs_for(self.co.registry)
+            cats = {self.catalog}
+            for f in self._dplan.fragments:
+                cats |= plancache.scan_catalogs(f.root)
+            self._plan_epochs_cache = {
+                "token": epochs.token,
+                "epochs": epochs.snapshot(sorted(cats))}
+        return self._plan_epochs_cache
+
     def _create_remote_task(self, worker_uri: str, task_id: str, frag,
                             scan_shard, remote, n_out, broadcast,
                             consumer_index: int) -> None:
@@ -2788,6 +3244,10 @@ class QueryExecution:
             # the query's trace token: the worker stamps it into its
             # log lines, task errors, and worker->worker fetches
             "trace_token": self.trace_token,
+            # stats-epoch snapshot keying the worker-side plan_fragment
+            # cache (absent for plans without a coordinator epoch
+            # domain, which simply bypass that cache)
+            "plan_epochs": self._plan_epochs(),
         }).encode("utf-8")
         headers = {"Content-Type": "application/json"}
         headers.update(self._internal_headers())
@@ -3050,6 +3510,9 @@ class QueryExecution:
         while True:
             if getattr(self, "canceled", False):
                 raise RuntimeError("Query killed")
+            if getattr(self.co, "killed", False):
+                raise _CoordinatorKilled()
+            self._root_tokens[orig] = token
             if deadline is not None and time.monotonic() > deadline:
                 raise RuntimeError(
                     "Query exceeded maximum run time "
@@ -3219,6 +3682,20 @@ class QueryExecution:
         return out
 
 
+def _client_value(v, typ: T.Type):
+    """Invert ``_json_value`` for one cell (the journal's inline-row
+    encoding round-trip; same contract as the client protocol)."""
+    if v is None:
+        return None
+    if typ.name == "date" and isinstance(v, str):
+        return datetime.date.fromisoformat(v)
+    if typ.name == "timestamp" and isinstance(v, str):
+        return datetime.datetime.fromisoformat(v)
+    if isinstance(v, list):
+        return [x for x in v]
+    return v
+
+
 def _json_value(v):
     if isinstance(v, (datetime.date, datetime.datetime)):
         return v.isoformat()
@@ -3357,7 +3834,8 @@ class CoordinatorServer:
                  heartbeat_interval_s: float = 0.5,
                  heartbeat_max_missed: int = 3,
                  event_log_path: Optional[str] = None,
-                 resource_groups=None):
+                 resource_groups=None,
+                 standby_of: Optional[str] = None):
         from presto_tpu.server.errortracker import RetryingHttpClient
         from presto_tpu.server.security import InternalAuthenticator
         from presto_tpu.session import ResourceGroupManager
@@ -3388,7 +3866,28 @@ class CoordinatorServer:
         from presto_tpu.server.spool import make_spool_store
 
         self.spool = make_spool_store(config, injector=fault_injector)
-        if config.exchange_spooling_enabled:
+        # -- coordinator HA (server/statestore.py) -------------------------
+        # ``standby_of`` names the active coordinator this node shadows:
+        # a standby journals nothing, sweeps nothing, and serves no
+        # statements until it wins the takeover lease and ADOPTS the
+        # journal.  With no state path configured (the default) every
+        # HA code path is inert.
+        from presto_tpu.server.statestore import make_state_store
+
+        self.statestore = make_state_store(config)
+        self.standby_of = standby_of
+        self.killed = False
+        self.is_active = standby_of is None
+        # chaos/test hook: called (query, phase) at journaled lifecycle
+        # transitions — tests hold a query AT a phase to kill the
+        # coordinator there deterministically
+        self.phase_hook = None
+        self.ha_counters: Dict = {"failovers": 0, "adopted": {}}
+        self._ha_lock = threading.Lock()
+        self._ha_stop = threading.Event()
+        self._lease_generation = 0
+        self._owner_id = f"co-{uuid.uuid4().hex[:8]}"
+        if config.exchange_spooling_enabled and standby_of is None:
             try:
                 self.spool.sweep_orphans(
                     config.exchange_spool_orphan_age_s)
@@ -3513,6 +4012,12 @@ class CoordinatorServer:
                 if parts == ["v1", "statement"]:
                     n = int(self.headers.get("Content-Length", 0))
                     sql = self.rfile.read(n).decode("utf-8")
+                    if not co.is_active:
+                        # a standby serves nothing until it wins the
+                        # takeover lease; clients fail over by address
+                        self._json(503, {"error": "standby coordinator "
+                                                  "is not active"})
+                        return
                     user = self._authenticated_user()
                     if user is None:
                         return
@@ -3787,6 +4292,122 @@ class CoordinatorServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="coordinator-http")
         self._thread.start()
+        # HA: the active coordinator acquires + renews the takeover
+        # lease (heartbeat object with TTL); a standby watches it and
+        # claims the next generation on expiry, then adopts the journal
+        if self.statestore is not None:
+            if self.is_active:
+                try:
+                    gen = self.statestore.try_claim_lease(
+                        self._owner_id, config.coordinator_lease_ttl_s,
+                        force=True)
+                    self._lease_generation = gen or 0
+                except Exception:  # noqa: BLE001 - HA is best-effort
+                    pass
+            self._ha_thread = threading.Thread(
+                target=self._ha_loop, daemon=True, name="coordinator-ha")
+            self._ha_thread.start()
+
+    # -- coordinator HA ----------------------------------------------------
+    def kill(self) -> None:
+        """Chaos: process-level coordinator death (faults.py
+        ``kill_coordinator``).  Listeners stop, the lease stops
+        renewing (so a standby can claim it), and every query thread
+        aborts with NO external side effects — worker tasks keep
+        producing into the spool, the journal stays as written, and
+        nothing is GC'd.  This is NOT close(): close is a clean
+        shutdown, kill is the failure the standby exists for."""
+        self.killed = True
+        self._ha_stop.set()
+        self._memory_stop.set()
+        self.dispatcher.close()
+        self.nodes.close()
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 - already down
+            pass
+
+    def _ha_loop(self) -> None:
+        """One loop, both roles: the active coordinator renews the
+        lease every ttl/3; a standby watches for expiry and claims via
+        the compare-and-swap marker — exactly one of N racing standbys
+        wins the generation, adopts the journal, and activates."""
+        ttl = self.config.coordinator_lease_ttl_s
+        tick = max(ttl / 3.0, 0.05)
+        while not self._ha_stop.wait(tick):
+            if self.killed:
+                return
+            try:
+                if self.is_active:
+                    if self._lease_generation and not \
+                            self.statestore.renew_lease(
+                                self._owner_id, self._lease_generation,
+                                ttl):
+                        # superseded: another coordinator claimed a
+                        # newer generation — stop acting as primary
+                        self.log("coordinator lease superseded; "
+                                 "standing down")
+                        self.is_active = False
+                    continue
+                lease = self.statestore.read_lease()
+                gen = self.statestore.try_claim_lease(self._owner_id,
+                                                      ttl)
+                if gen is None:
+                    continue
+                self._lease_generation = gen
+                self.is_active = True
+                prev = (lease or {}).get("owner", "")
+                self.log(f"standby won takeover lease generation {gen} "
+                         f"(previous owner {prev or '?'})")
+                self._adopt_journal(prev, gen)
+            except Exception as e:  # noqa: BLE001 - HA must keep trying
+                self.log(f"HA loop error: {e}")
+
+    def _adopt_journal(self, previous_owner: str, generation: int
+                       ) -> None:
+        """Failover adoption: every journaled query the dead
+        coordinator owned is re-served (FINISHED: rows from adopted
+        spool pages), re-attached/repointed/restarted (RUNNING, through
+        the existing spool-recovery machinery), or re-queued
+        (QUEUED/PLANNING: back into admission) — then this coordinator
+        is open for business."""
+        adopted = 0
+        for qid in self.statestore.list_queries():
+            if qid in self.queries:
+                continue
+            try:
+                journal = self.statestore.read(qid)
+            except Exception:  # noqa: BLE001 - torn/unreadable doc
+                continue
+            if journal is None:
+                continue
+            adopted += 1
+            if journal.state in ("QUEUED", "PLANNING") or (
+                    journal.state not in ("FINISHED", "FAILED")
+                    and not journal.placements):
+                # never scheduled anything: plain re-admission under
+                # the SAME query id (client polls find it here)
+                self.dispatcher.submit(
+                    journal.sql, user=journal.user, query_id=qid,
+                    session_properties=journal.session_properties,
+                    catalog=journal.catalog, prepared=journal.prepared,
+                    trace_token=journal.trace_token)
+                self.count_adopted("requeued")
+                self.event_bus.query_adopted(ev.QueryAdoptedEvent(
+                    qid, journal.trace_token, journal.state, "requeued",
+                    ev.now()))
+                continue
+            QueryExecution.adopt(self, journal)
+        with self._ha_lock:
+            self.ha_counters["failovers"] += 1
+        self.event_bus.coordinator_failover(ev.CoordinatorFailoverEvent(
+            self.uri, previous_owner, generation, adopted, ev.now()))
+
+    def count_adopted(self, outcome: str) -> None:
+        with self._ha_lock:
+            a = self.ha_counters["adopted"]
+            a[outcome] = a.get(outcome, 0) + 1
 
     def count_device_fallback(self, kind: str) -> None:
         """One query fell back from the collective tier to the HTTP
@@ -3873,6 +4494,7 @@ class CoordinatorServer:
             print(msg)
 
     def close(self) -> None:
+        self._ha_stop.set()
         self._memory_stop.set()
         self.dispatcher.close()
         self.nodes.close()

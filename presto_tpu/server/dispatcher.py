@@ -79,6 +79,9 @@ class DispatchQuery(QueryExecution):
         self.error = self.error or message
         self.error_name, self.error_type, self.error_code = shape
         self.state = "FAILED"
+        # terminal journal write: a failover must re-serve the
+        # rejection, not re-admit the query
+        self._journal_terminal()
         self.rows_done.set()
         self._fire_completed()
 
@@ -125,6 +128,7 @@ class DispatchQuery(QueryExecution):
                 self.error_name, self.error_type, self.error_code = \
                     USER_CANCELED
                 self.state = "FAILED"
+                self._journal_terminal()
                 self.rows_done.set()
                 return
             self._run_admitted()
@@ -161,31 +165,58 @@ class DispatchManager:
         self.co = coordinator
         self._queue: "queue.Queue[Optional[DispatchQuery]]" = queue.Queue()
         self._stop = threading.Event()
+        # chaos/test hook (coordinator HA): while set, submitted
+        # queries stay QUEUED — the deterministic
+        # kill-the-coordinator-at-QUEUED shape
+        self._paused = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="dispatcher")
         self._thread.start()
+
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
 
     def submit(self, sql: str, *, user: str = "user",
                session_properties: Optional[Dict[str, str]] = None,
                catalog: Optional[str] = None,
                prepared: Optional[Dict[str, str]] = None,
-               trace_token: Optional[str] = None) -> DispatchQuery:
-        qid = uuid.uuid4().hex[:16]
+               trace_token: Optional[str] = None,
+               query_id: Optional[str] = None) -> DispatchQuery:
+        """``query_id`` is supplied by coordinator-HA adoption (a
+        re-queued journaled query keeps its id so client polls find
+        it); fresh submissions generate one."""
+        qid = query_id or uuid.uuid4().hex[:16]
         q = DispatchQuery(qid, sql, self.co, user=user,
                           session_properties=session_properties,
                           catalog=catalog, prepared=prepared,
                           trace_token=trace_token)
         self.co.queries[qid] = q
+        # durable journal write-through at QUEUED (server/statestore.py)
+        q._journal("QUEUED")
         self._queue.put(q)
         return q
 
     def _loop(self) -> None:
+        import time
+
         while not self._stop.is_set():
+            if self._paused.is_set():
+                time.sleep(0.02)
+                continue
             try:
                 q = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
             if q is None:
+                return
+            # a pause set while get() was already blocking must still
+            # hold THIS query (the deterministic kill-at-QUEUED shape)
+            while self._paused.is_set() and not self._stop.is_set():
+                time.sleep(0.02)
+            if self._stop.is_set() or getattr(self.co, "killed", False):
                 return
             if q.canceled or q._cancel_event.is_set():
                 # DELETE raced the dispatch loop: never start it

@@ -142,7 +142,8 @@ class DistributedQueryRunner:
         w.close()
         return w
 
-    def _announce(self, worker: WorkerServer) -> None:
+    def _announce(self, worker: WorkerServer,
+                  coordinator_uri: Optional[str] = None) -> None:
         import json
         import urllib.request
 
@@ -158,8 +159,8 @@ class DistributedQueryRunner:
             headers.update(
                 InternalAuthenticator(self.internal_secret).header())
         req = urllib.request.Request(
-            f"{self.coordinator.uri}/v1/announcement", data=body,
-            method="POST", headers=headers)
+            f"{coordinator_uri or self.coordinator.uri}/v1/announcement",
+            data=body, method="POST", headers=headers)
         with urllib.request.urlopen(req, timeout=10) as resp:
             assert resp.status == 200
 
@@ -227,6 +228,76 @@ class DistributedQueryRunner:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class HAQueryRunner(DistributedQueryRunner):
+    """A DistributedQueryRunner plus a STANDBY coordinator sharing the
+    same durable state (spool + query-state journal): the coordinator-HA
+    test/chaos harness.  The standby watches the takeover lease; when
+    the primary is killed (``kill_primary`` — the faults.py
+    ``kill_coordinator`` process-death shape), the standby claims the
+    lease, adopts the journal, and serves every in-flight query.
+    Workers are stateless announcers re-announcing to BOTH coordinators
+    on a cadence, and ``client`` follows failover across the address
+    list.  Requires ``config.coordinator_state_path`` to be set."""
+
+    def __init__(self, registry_factory, default_catalog: str,
+                 n_workers: int = 2, config: EngineConfig = DEFAULT,
+                 **kwargs):
+        if not config.coordinator_state_path:
+            raise ValueError("HAQueryRunner needs "
+                             "config.coordinator_state_path")
+        super().__init__(registry_factory, default_catalog, n_workers,
+                         config, **kwargs)
+        from presto_tpu.connectors.system import SystemConnector
+
+        self.standby = CoordinatorServer(
+            registry_factory(), default_catalog, config,
+            standby_of=self.coordinator.uri,
+            internal_secret=self.internal_secret,
+            heartbeat_interval_s=kwargs.get("heartbeat_interval_s", 0.5),
+            heartbeat_max_missed=kwargs.get("heartbeat_max_missed", 3),
+            event_log_path=kwargs.get("event_log_path"))
+        self.standby.registry.register("system", SystemConnector())
+        # stateless announcers: every worker re-announces to both
+        # coordinators on a cadence, so the standby knows the live
+        # cluster the moment it takes over
+        import threading
+
+        for w in self.workers:
+            w.announce_to = [self.coordinator.uri, self.standby.uri]
+            self._announce(w, self.standby.uri)
+            threading.Thread(
+                target=w._announce_loop, args=(0.5,), daemon=True,
+                name=f"announce-{w.node_id}").start()
+        self.client = StatementClient(
+            self.coordinator.uri, standby_uris=[self.standby.uri])
+
+    def new_client(self, user: Optional[str] = None) -> StatementClient:
+        return StatementClient(self.coordinator.uri, user=user,
+                               standby_uris=[self.standby.uri])
+
+    def kill_primary(self) -> None:
+        """Process-level death of the active coordinator mid-query
+        (server/faults.py ``kill_coordinator``)."""
+        from presto_tpu.server.faults import kill_coordinator
+
+        kill_coordinator(self.coordinator)
+
+    def wait_for_failover(self, timeout_s: float = 30.0) -> None:
+        """Block until the standby won the lease and is active."""
+        import time as _t
+
+        deadline = _t.monotonic() + timeout_s
+        while _t.monotonic() < deadline:
+            if self.standby.is_active:
+                return
+            _t.sleep(0.02)
+        raise TimeoutError("standby never became active")
+
+    def close(self) -> None:
+        super().close()
+        self.standby.close()
 
 
 def _from_json(v, typ: T.Type):

@@ -160,6 +160,13 @@ class PartitionedOutputOperatorFactory(OperatorFactory):
         # partition-id column (exec/fusion.py)
         self.precomputed = False
 
+    def rebind(self, buffers: OutputBufferManager) -> None:
+        """Point this (cached) sink at a new task's buffer manager —
+        the worker plan_fragment cache reuses the lowered factory chain
+        across task creates; topology (channels, fan-out, the fusion
+        ``precomputed`` flag) is part of the cache key and unchanged."""
+        self.buffers = buffers
+
     def create(self, ctx: OperatorContext):
         return PartitionedOutputOperator(ctx, self.buffers, self.channels,
                                          self.n_partitions,
@@ -171,6 +178,9 @@ class RoundRobinOutputOperatorFactory(OperatorFactory):
         self.buffers = buffers
         self.n_partitions = n_partitions
 
+    def rebind(self, buffers: OutputBufferManager) -> None:
+        self.buffers = buffers
+
     def create(self, ctx: OperatorContext):
         return RoundRobinOutputOperator(ctx, self.buffers,
                                         self.n_partitions)
@@ -178,6 +188,9 @@ class RoundRobinOutputOperatorFactory(OperatorFactory):
 
 class TaskOutputOperatorFactory(OperatorFactory):
     def __init__(self, buffers: OutputBufferManager):
+        self.buffers = buffers
+
+    def rebind(self, buffers: OutputBufferManager) -> None:
         self.buffers = buffers
 
     def create(self, ctx: OperatorContext):
@@ -668,6 +681,17 @@ class ExchangeOperatorFactory(OperatorFactory):
         self.spool_stall_s = spool_stall_s
         self._client: Optional[ExchangeClient] = None
 
+    def rebind(self, locations: Sequence[str], task_id: Optional[str],
+               trace_token: Optional[str]) -> None:
+        """Re-arm this (cached) remote source for a fresh task create:
+        new producer locations (they embed the new query id), fresh
+        exchange client, the new task's identity on fetch failures —
+        the worker plan_fragment cache's per-task rebinding."""
+        self.locations = list(locations)
+        self.task_id = task_id
+        self.trace_token = trace_token
+        self._client = None
+
     def repoint(self, old_prefix: str, new_prefix: str) -> str:
         if self._client is not None:
             return self._client.repoint(old_prefix, new_prefix)
@@ -865,6 +889,13 @@ class MergeExchangeOperatorFactory(OperatorFactory):
         self.spool = spool
         self.spool_stall_s = spool_stall_s
         self._live_clients: List[ExchangeClient] = []
+
+    def rebind(self, locations: Sequence[str], task_id: Optional[str],
+               trace_token: Optional[str]) -> None:
+        self.locations = list(locations)
+        self.task_id = task_id
+        self.trace_token = trace_token
+        self._live_clients = []
 
     def repoint(self, old_prefix: str, new_prefix: str) -> str:
         # probe every stream first: a partially-consumed one anywhere

@@ -48,6 +48,17 @@ SPOOL_READ_ERROR = "spool-read-error"
 SPOOL_MISSING = "spool-missing"
 
 
+def kill_coordinator(coordinator) -> None:
+    """Chaos: process-level coordinator death mid-query.  The
+    coordinator's HTTP listeners stop, its takeover lease stops
+    renewing, and every query thread halts with NO external side
+    effects — no cancel fan-out, no spool GC, no events.  Worker tasks
+    keep producing into the spool; the durable query-state journal
+    (server/statestore.py) stays exactly as last written, which is what
+    a standby coordinator adopts on takeover."""
+    coordinator.kill()
+
+
 class FaultRule:
     def __init__(self, pattern: str, method: str, policy: str, *,
                  times: Optional[int] = None, delay_s: float = 0.0,

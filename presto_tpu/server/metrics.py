@@ -236,6 +236,26 @@ def _device_exchange_families(co) -> List[Family]:
     ]
 
 
+def _ha_families(co) -> List[Family]:
+    """presto_coordinator_failover_total + presto_queries_adopted_total:
+    the coordinator-HA plane — standby takeovers won (lease claims) and
+    journaled queries adopted by outcome category (served / repointed /
+    reattached / restarted / requeued / failed)."""
+    ha = getattr(co, "ha_counters", None) or {}
+    with getattr(co, "_ha_lock", threading.Lock()):
+        failovers = ha.get("failovers", 0)
+        adopted = dict(ha.get("adopted", {}))
+    return [
+        ("presto_coordinator_failover_total", "counter",
+         "takeover leases won by this coordinator (journal adoptions)",
+         [({}, failovers)]),
+        ("presto_queries_adopted_total", "counter",
+         "journaled queries adopted on failover, by outcome",
+         [({"outcome": o}, v) for o, v in sorted(adopted.items())]
+         or [({"outcome": "served"}, 0)]),
+    ]
+
+
 def coordinator_metrics(co) -> str:
     """Render the coordinator's /metrics payload from live state."""
     by_state: Dict[str, int] = {}
@@ -285,6 +305,7 @@ def coordinator_metrics(co) -> str:
     fams.extend(_resource_group_families(
         getattr(co, "resource_groups", None)))
     fams.extend(_device_exchange_families(co))
+    fams.extend(_ha_families(co))
     fams.extend(_plan_cache_families("presto"))
     fams.extend(_result_cache_families("presto"))
     fams.extend(_spool_families("presto", getattr(co, "spool", None)))

@@ -263,6 +263,23 @@ class LocalObjectApi:
         with open(self._path(key), "rb") as f:
             return f.read()
 
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        """Atomic create-if-absent (the S3 ``If-None-Match: *`` /
+        GCS ``ifGenerationMatch=0`` conditional put): exactly ONE of N
+        concurrent callers wins.  The coordinator-HA lease claim
+        (server/statestore.py) is built on this primitive."""
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+        return True
+
     def exists(self, key: str) -> bool:
         return os.path.isfile(self._path(key))
 

@@ -1,0 +1,212 @@
+"""Coordinator HA: a durable query-state journal + a takeover lease.
+
+SURVEY §5.3 names the coordinator the reference's last single point of
+failure ("Checkpointing/restart: none for queries", §5.4): every
+worker-side failure is recoverable (stage retry, spool repoint,
+speculation, drain), but a coordinator crash loses every in-flight
+query.  This module closes that asymmetry with two small durable
+structures over the SAME pluggable object API the spool's object tier
+uses (``spool.LocalObjectApi`` — a real S3/GCS client drops in behind
+the same methods):
+
+- **QueryStateStore** — one JSON object per query
+  (``queries/{query_id}``), write-through at lifecycle transitions:
+  normalized SQL + session/catalog fingerprint, the serde'd fragmented
+  ``DistributedPlan``, task placements + attempt ids, root-drain
+  consumed tokens, result adoption ids, and terminal status.  A standby
+  coordinator ADOPTS the journal on failover: FINISHED queries serve
+  straight from their adopted spool pages, RUNNING queries re-attach to
+  live tasks (or repoint/restart through the existing spool-recovery
+  machinery), QUEUED queries re-enter admission.
+
+- **CoordinatorLease** — the mutual-exclusion heartbeat: one ``lease``
+  object ``{owner, generation, expires_at}`` renewed by the active
+  coordinator every ``ttl/3``; a standby that observes the lease
+  expired claims the NEXT generation via an atomic create-if-absent
+  (``claim-{generation:08d}``, the compare-and-swap) — exactly one of
+  N racing standbys wins, and the loser keeps watching.
+
+Journal writes are strictly best-effort on the primary (a journal
+problem must never fail a query the engine can run); adoption on the
+standby verifies everything it reads (a stream that is not complete in
+the spool restarts through stage retry, never serves partial rows).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from presto_tpu.server.spool import LocalObjectApi
+
+#: journal object keys live under one prefix so a state root can share
+#: a bucket with the spool's object tier
+_QUERY_PREFIX = "queries/"
+_LEASE_KEY = "lease"
+_CLAIM_PREFIX = "claim-"
+
+
+@dataclasses.dataclass
+class QueryJournal:
+    """One query's durable state — everything a standby needs to adopt
+    it at any lifecycle point.  ``state`` is the last journaled
+    lifecycle state, which may trail the live one by one transition
+    (writes happen AT transitions)."""
+
+    query_id: str
+    sql: str
+    user: str = "user"
+    catalog: Optional[str] = None
+    session_properties: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+    prepared: Dict[str, str] = dataclasses.field(default_factory=dict)
+    trace_token: str = ""
+    plan_key_sql: Optional[str] = None
+    state: str = "QUEUED"
+    error: Optional[str] = None
+    create_time: float = 0.0
+    # serde'd DistributedPlan (sql/planserde.dplan_to_json), present
+    # once planning finished
+    dplan: Optional[Dict[str, Any]] = None
+    # (fragment_id, task_id, worker_uri) per scheduled task — the live
+    # placements at the last journal write (attempt-qualified ids)
+    placements: List[Tuple[int, str, str]] = dataclasses.field(
+        default_factory=list)
+    # base task id -> attempt counter (fresh attempts on the standby
+    # continue from here, so ids never collide with superseded ones)
+    attempts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # task id -> minimal recreate spec {fid,index,scan_shard,n_out,
+    # broadcast,consumer_index,base}; the fragment itself comes from
+    # ``dplan`` by fid
+    task_specs: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
+    # root-drain bookkeeping: original root locations in drain order and
+    # consumed token per location at the last journal write (adoption
+    # re-pulls from the spool at token 0 — the token+attempt dedup
+    # contract makes the re-pull idempotent; the tokens are recorded so
+    # an operator can see how far the dead coordinator got)
+    root_locations: List[str] = dataclasses.field(default_factory=list)
+    root_tokens: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # terminal-result adoption: the query's root output copied into a
+    # stable ``ha*`` spool stream at FINISH (outlives the query's own
+    # spool GC), plus the client schema needed to serve it plan-free
+    result_task_id: Optional[str] = None
+    result_locations: int = 0
+    result_bytes: int = 0
+    column_names: List[str] = dataclasses.field(default_factory=list)
+    column_types: List[str] = dataclasses.field(default_factory=list)
+    row_count: int = 0
+    # small results (utility statements, or spooling off) journal their
+    # rows inline as the client-protocol JSON encoding
+    inline_rows: Optional[List[list]] = None
+    # cross-query result-cache adoption id (server/resultcache.py), when
+    # this execution was admitted — a standby can re-serve repeats
+    result_cache_task_id: Optional[str] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["placements"] = [list(p) for p in self.placements]
+        return d
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "QueryJournal":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        kw["placements"] = [
+            (int(f), str(t), str(u))
+            for f, t, u in (d.get("placements") or [])]
+        kw["attempts"] = {str(k): int(v)
+                         for k, v in (d.get("attempts") or {}).items()}
+        return cls(**kw)
+
+
+class QueryStateStore:
+    """The durable journal: one JSON object per query over the object
+    API.  Writes are whole-object atomic (``LocalObjectApi.put`` is a
+    tmp+rename publish), so a reader observes a consistent snapshot of
+    one transition — never a torn doc."""
+
+    def __init__(self, api: LocalObjectApi):
+        self.api = api
+
+    # -- journal ---------------------------------------------------------
+    def write(self, journal: QueryJournal) -> None:
+        self.api.put(_QUERY_PREFIX + journal.query_id,
+                     json.dumps(journal.to_json()).encode("utf-8"))
+
+    def read(self, query_id: str) -> Optional[QueryJournal]:
+        try:
+            data = self.api.get(_QUERY_PREFIX + query_id)
+        except FileNotFoundError:
+            return None
+        return QueryJournal.from_json(json.loads(data))
+
+    def list_queries(self) -> List[str]:
+        return [k[len(_QUERY_PREFIX):]
+                for k in self.api.list(_QUERY_PREFIX)]
+
+    def delete(self, query_id: str) -> None:
+        try:
+            os.remove(self.api._path(_QUERY_PREFIX + query_id))
+        except OSError:
+            pass
+
+    # -- lease -----------------------------------------------------------
+    def read_lease(self) -> Optional[Dict[str, Any]]:
+        try:
+            return json.loads(self.api.get(_LEASE_KEY))
+        except FileNotFoundError:
+            return None
+        except ValueError:
+            return None
+
+    def _write_lease(self, owner: str, generation: int,
+                     ttl_s: float) -> None:
+        self.api.put(_LEASE_KEY, json.dumps({
+            "owner": owner, "generation": generation,
+            "expires_at": time.time() + ttl_s}).encode("utf-8"))
+
+    def renew_lease(self, owner: str, generation: int,
+                    ttl_s: float) -> bool:
+        """Refresh the TTL; refuses when the lease moved to another
+        owner/generation (this node was superseded and must stop
+        acting as the coordinator)."""
+        lease = self.read_lease()
+        if lease is not None and (lease.get("owner") != owner
+                                  or int(lease.get("generation", 0))
+                                  != generation):
+            return False
+        self._write_lease(owner, generation, ttl_s)
+        return True
+
+    def try_claim_lease(self, owner: str, ttl_s: float,
+                        force: bool = False) -> Optional[int]:
+        """Compare-and-swap takeover: claim generation N+1 via an
+        atomic create-if-absent marker.  Returns the won generation, or
+        None (lease still live, or another claimant won the race).
+        ``force`` skips the expiry check (primary startup on a fresh or
+        crashed-over store)."""
+        lease = self.read_lease()
+        gen = int(lease.get("generation", 0)) if lease else 0
+        if lease is not None and not force:
+            if float(lease.get("expires_at", 0)) > time.time():
+                return None          # still live: no takeover
+        claim = f"{_CLAIM_PREFIX}{gen + 1:08d}"
+        if not self.api.put_if_absent(claim, owner.encode("utf-8")):
+            return None              # another claimant won this round
+        self._write_lease(owner, gen + 1, ttl_s)
+        return gen + 1
+
+
+def make_state_store(config) -> Optional[QueryStateStore]:
+    """Config-driven factory (``coordinator_state_path``); returns None
+    when HA journaling is disabled — the default, which leaves every
+    existing code path untouched."""
+    root = getattr(config, "coordinator_state_path", "") or ""
+    if not root:
+        return None
+    return QueryStateStore(LocalObjectApi(root))

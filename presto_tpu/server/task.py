@@ -35,6 +35,88 @@ from presto_tpu.sql.physical import PhysicalPlanner
 log = logging.getLogger("presto_tpu.worker")
 
 
+class _FragmentCacheEntry:
+    """One cached fragment lowering: the pipeline list plus the two
+    factory groups that need per-task rebinding (remote sources get new
+    producer locations, the sink gets the new task's buffer manager).
+    ``in_use`` guards the factories' runtime state: a task still
+    executing (or not yet reset) is never shared — a concurrent create
+    of the same key lowers privately."""
+
+    __slots__ = ("pipelines", "exchange_factories", "sink", "in_use")
+
+    def __init__(self, pipelines, exchange_factories, sink):
+        self.pipelines = pipelines
+        self.exchange_factories = exchange_factories
+        self.sink = sink
+        self.in_use = True
+
+
+class FragmentPlanCache:
+    """Worker-side plan_fragment cache (the distributed half of the
+    plan cache's physical-factory sharing): repeat task creates of the
+    same statement — same fragment JSON, scan shard, output topology,
+    session fingerprint, and coordinator stats epochs — reuse the
+    lowered operator-factory chains instead of re-running
+    ``PhysicalPlanner.plan_fragment``.  Keyed like ``sql/plancache.py``
+    with epoch validation folded INTO the key (the coordinator ships
+    its per-catalog epoch snapshot on task create, so any DML/DDL
+    changes the key and stale lowered pipelines LRU out)."""
+
+    def __init__(self, capacity: int = 32):
+        from collections import OrderedDict
+
+        self.capacity = max(capacity, 1)
+        self._entries: "OrderedDict[tuple, _FragmentCacheEntry]" = \
+            OrderedDict()
+        self._lock = threading.Lock()
+        self.stats: Dict[str, int] = {"hits": 0, "misses": 0,
+                                      "evictions": 0, "bypasses": 0}
+
+    def acquire(self, key) -> Optional[_FragmentCacheEntry]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.stats["misses"] += 1
+                return None
+            if entry.in_use:
+                # live task still owns the factories: lower privately
+                self.stats["bypasses"] += 1
+                return None
+            entry.in_use = True
+            self._entries.move_to_end(key)
+            self.stats["hits"] += 1
+            return entry
+
+    def insert(self, key, entry: _FragmentCacheEntry) -> None:
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.stats["evictions"] += 1
+            self._entries[key] = entry
+            # LRU-evict idle entries past capacity (in-use ones are
+            # owned by live tasks and must not vanish under them)
+            while len(self._entries) > self.capacity:
+                victim = next((k for k, e in self._entries.items()
+                               if not e.in_use), None)
+                if victim is None:
+                    break
+                del self._entries[victim]
+                self.stats["evictions"] += 1
+
+    def release(self, entry: _FragmentCacheEntry) -> None:
+        with self._lock:
+            entry.in_use = False
+
+
+def _fragment_has_writer(root) -> bool:
+    from presto_tpu.sql.plan import TableFinishNode, TableWriterNode
+
+    if isinstance(root, (TableWriterNode, TableFinishNode)):
+        return True
+    return any(_fragment_has_writer(s) for s in root.sources)
+
+
 class SqlTask:
     def __init__(self, task_id: str, fragment: PlanFragment,
                  scan_shard: Tuple[int, int],
@@ -44,7 +126,8 @@ class SqlTask:
                  config: EngineConfig = DEFAULT,
                  fetch_headers: Optional[Dict[str, str]] = None,
                  http_client=None, trace_token: str = "",
-                 spool=None):
+                 spool=None, frag_cache: Optional[FragmentPlanCache] = None,
+                 frag_cache_key=None):
         self.task_id = task_id
         self.fragment = fragment
         self.trace_token = trace_token
@@ -52,6 +135,12 @@ class SqlTask:
         self.error: Optional[str] = None
         self.start_time = time.time()
         self.end_time: Optional[float] = None
+        # coordinator HA: the coordinator currently owning this task —
+        # updated by POST /v1/task/{id}/coordinator when a standby
+        # adopts the query on failover (the re-attach repoint)
+        self.coordinator_uri: Optional[str] = None
+        self._frag_cache = frag_cache
+        self._cache_entry: Optional[_FragmentCacheEntry] = None
         # spooled exchange (server/spool.py): output pages write through
         # to the shared store as they are enqueued, and remote sources
         # can read producer streams back from it (spool:// locations)
@@ -73,30 +162,62 @@ class SqlTask:
         fetch_headers = dict(fetch_headers or {})
         if trace_token:
             fetch_headers["X-Presto-Trace-Token"] = trace_token
-        planner = PhysicalPlanner(registry, config,
-                                  scan_shard=scan_shard,
-                                  remote_sources=remote_sources,
-                                  fetch_headers=fetch_headers,
-                                  http_client=http_client,
-                                  task_id=task_id,
-                                  exchange_register=(
-                                      self.exchange_sources.append),
-                                  trace_token=trace_token or None,
-                                  spool=spool)
-        kind, channels = fragment.output_partitioning
-        if kind == "hash" and n_output_partitions > 1:
-            sink = PartitionedOutputOperatorFactory(
-                self.buffers, channels, n_output_partitions)
-        elif kind == "arbitrary" and n_output_partitions > 1:
-            from presto_tpu.server.exchangeop import (
-                RoundRobinOutputOperatorFactory,
-            )
+        reuse = None
+        if frag_cache is not None and frag_cache_key is not None:
+            reuse = frag_cache.acquire(frag_cache_key)
+        if reuse is not None:
+            # plan_fragment cache hit: the SAME lowered factory chains
+            # execute again — every factory re-arms its cross-execution
+            # state (the local-tier reset_for_execution contract),
+            # remote sources rebind to the new query's producer
+            # locations, and the sink rebinds to this task's buffers.
+            # Zero fragment lowerings (sql/physical.FRAGMENTS_LOWERED).
+            self._cache_entry = reuse
+            for p in reuse.pipelines:
+                for f in p.factories:
+                    f.reset_for_execution()
+            for fac in reuse.exchange_factories:
+                locs: List[str] = []
+                for fid in getattr(fac, "source_fragment_ids", ()):
+                    locs.extend(remote_sources.get(fid, ()))
+                fac.rebind(locs, task_id, trace_token or None)
+                fac.headers = fetch_headers
+                fac.spool = spool
+                fac.spool_stall_s = config.exchange_spool_stall_s
+                self.exchange_sources.append(fac)
+            reuse.sink.rebind(self.buffers)
+            self._pipelines = reuse.pipelines
+        else:
+            planner = PhysicalPlanner(registry, config,
+                                      scan_shard=scan_shard,
+                                      remote_sources=remote_sources,
+                                      fetch_headers=fetch_headers,
+                                      http_client=http_client,
+                                      task_id=task_id,
+                                      exchange_register=(
+                                          self.exchange_sources.append),
+                                      trace_token=trace_token or None,
+                                      spool=spool)
+            kind, channels = fragment.output_partitioning
+            if kind == "hash" and n_output_partitions > 1:
+                sink = PartitionedOutputOperatorFactory(
+                    self.buffers, channels, n_output_partitions)
+            elif kind == "arbitrary" and n_output_partitions > 1:
+                from presto_tpu.server.exchangeop import (
+                    RoundRobinOutputOperatorFactory,
+                )
 
-            sink = RoundRobinOutputOperatorFactory(
-                self.buffers, n_output_partitions)
-        else:  # 'single', 'broadcast', or 1-consumer output
-            sink = TaskOutputOperatorFactory(self.buffers)
-        self._pipelines = planner.plan_fragment(fragment.root, sink)
+                sink = RoundRobinOutputOperatorFactory(
+                    self.buffers, n_output_partitions)
+            else:  # 'single', 'broadcast', or 1-consumer output
+                sink = TaskOutputOperatorFactory(self.buffers)
+            self._pipelines = planner.plan_fragment(fragment.root, sink)
+            if frag_cache is not None and frag_cache_key is not None \
+                    and not _fragment_has_writer(fragment.root):
+                entry = _FragmentCacheEntry(
+                    self._pipelines, list(self.exchange_sources), sink)
+                frag_cache.insert(frag_cache_key, entry)
+                self._cache_entry = entry
         self._thread = threading.Thread(
             target=self._run, name=f"task-{task_id}", daemon=True)
         self._thread.start()
@@ -123,6 +244,11 @@ class SqlTask:
                 f"task {self.task_id}{trace}: {e}"))
         finally:
             self.end_time = time.time()
+            # release the cached fragment lowering only once this
+            # task's thread is actually done touching the factories
+            if self._frag_cache is not None and \
+                    self._cache_entry is not None:
+                self._frag_cache.release(self._cache_entry)
 
     def info(self) -> Dict:
         """TaskInfo with the per-operator stats rollup the coordinator's
@@ -269,8 +395,39 @@ class SqlTaskManager:
         # node-wide spool store (spooled exchange tier); the per-task
         # exchange_spooling_enabled knob gates its use per query
         self.spool = spool
+        # worker-side plan_fragment cache (lowered pipelines reused
+        # across repeat task creates of the same statement)
+        self.fragment_cache = (
+            FragmentPlanCache(config.worker_fragment_cache_capacity)
+            if config.worker_fragment_cache_enabled else None)
         self.tasks: Dict[str, SqlTask] = {}
         self._lock = threading.Lock()
+
+    def _fragment_cache_key(self, fragment: PlanFragment,
+                            scan_shard, n_out: int, broadcast: bool,
+                            session_properties, plan_epochs,
+                            config) -> Optional[tuple]:
+        """The plancache-shaped key: coordinator epoch-domain token +
+        per-catalog epoch snapshot (shipped on task create; any DML/DDL
+        bumps an epoch and changes the key), the fragment's canonical
+        JSON, the scan shard, output topology, and the session-property
+        fingerprint.  None = bypass (no epochs shipped, or writers)."""
+        if self.fragment_cache is None or not plan_epochs:
+            return None
+        import json as _json
+
+        from presto_tpu.sql import plancache
+        from presto_tpu.sql.planserde import fragment_to_json
+
+        return (
+            str(plan_epochs.get("token", "")),
+            tuple(sorted((str(c), int(e)) for c, e in
+                         (plan_epochs.get("epochs") or {}).items())),
+            _json.dumps(fragment_to_json(fragment), sort_keys=True),
+            tuple(scan_shard), int(n_out), bool(broadcast),
+            plancache.fingerprint(session_properties),
+            bool(config.exchange_spooling_enabled),
+        )
 
     def create_task(self, task_id: str, fragment: PlanFragment,
                     scan_shard: Tuple[int, int],
@@ -278,7 +435,8 @@ class SqlTaskManager:
                     n_output_partitions: int,
                     broadcast_output: bool,
                     session_properties: Optional[Dict[str, str]] = None,
-                    trace_token: str = ""
+                    trace_token: str = "",
+                    plan_epochs: Optional[Dict] = None
                     ) -> SqlTask:
         config = self.config
         if session_properties:
@@ -290,6 +448,15 @@ class SqlTaskManager:
             for k, v in session_properties.items():
                 session.set_property(k, str(v))
             config = session.effective_config(config)
+        key = None
+        if config.worker_fragment_cache_enabled:
+            try:
+                key = self._fragment_cache_key(
+                    fragment, scan_shard, n_output_partitions,
+                    broadcast_output, session_properties, plan_epochs,
+                    config)
+            except Exception:  # noqa: BLE001 - cache keying is advisory
+                key = None
         with self._lock:
             if task_id in self.tasks:
                 return self.tasks[task_id]
@@ -299,7 +466,9 @@ class SqlTaskManager:
                            fetch_headers=self.fetch_headers,
                            http_client=self.http_client,
                            trace_token=trace_token,
-                           spool=self.spool)
+                           spool=self.spool,
+                           frag_cache=self.fragment_cache,
+                           frag_cache_key=key)
             self.tasks[task_id] = task
             return task
 

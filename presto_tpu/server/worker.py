@@ -34,7 +34,9 @@ class WorkerServer:
                  internal_secret: Optional[str] = None,
                  location: str = "",
                  fault_injector=None, http_client=None,
-                 drain_grace_s: float = 2.0):
+                 drain_grace_s: float = 2.0,
+                 announce_to: Optional[list] = None,
+                 announce_interval_s: float = 1.0):
         from presto_tpu.server.errortracker import RetryingHttpClient
         from presto_tpu.server.security import InternalAuthenticator
         from presto_tpu.server.spool import make_spool_store
@@ -212,6 +214,33 @@ class WorkerServer:
                 if not self._internal_ok(parts):
                     return
                 if (parts[:2] == ["v1", "task"] and len(parts) == 4
+                        and parts[3] == "coordinator"):
+                    # coordinator HA re-attach: a standby that adopted
+                    # this task's query on failover announces itself as
+                    # the owning coordinator.  The task is untouched —
+                    # it keeps producing into the spool; the response
+                    # carries enough state for the standby to decide
+                    # re-attach vs spool-repoint vs restart.
+                    task = worker.task_manager.get(parts[2])
+                    if task is None:
+                        self._json(404, {"error": "no such task"})
+                        return
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        req = json.loads(self.rfile.read(n) or b"{}")
+                        task.coordinator_uri = str(
+                            req.get("coordinator") or "")
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad repoint: {e}"})
+                        return
+                    self._json(200, {
+                        "status": "reattached",
+                        "state": task.state,
+                        "pagesEnqueued": task.buffers.pages_enqueued,
+                        "spooledComplete":
+                            task.buffers.spooled_complete()})
+                    return
+                if (parts[:2] == ["v1", "task"] and len(parts) == 4
                         and parts[3] == "remote-sources"):
                     # mid-query task recovery: repoint this task's
                     # remote-source fetches at a replacement producer.
@@ -269,6 +298,9 @@ class WorkerServer:
                             req.get("trace_token")
                             or self.headers.get("X-Presto-Trace-Token")
                             or "")
+                        # coordinator stats-epoch snapshot keying the
+                        # worker-side plan_fragment cache
+                        plan_epochs = req.get("plan_epochs") or None
                     except (PlanSerdeError, KeyError, TypeError,
                             AttributeError, ValueError) as e:
                         self._json(400, {"error": f"bad task update: {e}"})
@@ -282,7 +314,8 @@ class WorkerServer:
                             n_output_partitions=n_out,
                             broadcast_output=broadcast,
                             session_properties=session_props,
-                            trace_token=trace_token)
+                            trace_token=trace_token,
+                            plan_epochs=plan_epochs)
                     except Exception as e:  # noqa: BLE001 - bad props
                         self._json(400, {"error": f"bad task update: {e}"})
                         return
@@ -339,6 +372,47 @@ class WorkerServer:
             target=self._httpd.serve_forever, daemon=True,
             name=f"worker-http-{self.port}")
         self._thread.start()
+        # stateless announcer (coordinator HA): re-announce this node
+        # to EVERY configured coordinator — primary and standby alike —
+        # so a standby that takes over already knows the live cluster
+        self.announce_to = list(announce_to or [])
+        self._announce_stop = threading.Event()
+        if self.announce_to:
+            threading.Thread(
+                target=self._announce_loop,
+                args=(max(announce_interval_s, 0.1),),
+                daemon=True,
+                name=f"announce-{self.node_id}").start()
+
+    def announce_once(self) -> None:
+        """One announcement round to every configured coordinator
+        (best-effort per target: a dead primary must not stop the
+        standby from hearing about this node)."""
+        import urllib.request
+
+        body = json.dumps({
+            "nodeId": self.node_id, "uri": self.uri,
+            "location": self.location,
+            "meshFingerprint": self.mesh_fingerprint}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.internal_auth is not None:
+            headers.update(self.internal_auth.header())
+        for target in self.announce_to:
+            try:
+                req = urllib.request.Request(
+                    f"{target}/v1/announcement", data=body,
+                    method="POST", headers=dict(headers))
+                with urllib.request.urlopen(req, timeout=5):
+                    pass
+            except Exception:  # noqa: BLE001 - a target may be down
+                pass
+
+    def _announce_loop(self, interval_s: float) -> None:
+        self.announce_once()
+        while not self._announce_stop.wait(interval_s):
+            if self.draining:
+                return
+            self.announce_once()
 
     def _start_drain(self) -> None:
         """Background drain-and-remove (the PUT /v1/info/state role):
@@ -375,6 +449,7 @@ class WorkerServer:
         self.close()
 
     def close(self) -> None:
+        self._announce_stop.set()
         self.task_manager.cancel_all()
         self.spool.close()
         self._httpd.shutdown()

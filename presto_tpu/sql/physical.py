@@ -59,6 +59,11 @@ from presto_tpu.sql.plan import (
 # execution of a cached statement must not bump it
 PLANS_BUILT = 0
 
+# process-wide count of worker-side fragment lowerings
+# (PhysicalPlanner.plan_fragment calls) — the worker plan_fragment
+# cache pin: repeat task creates of a cached statement must not bump it
+FRAGMENTS_LOWERED = 0
+
 
 @dataclasses.dataclass
 class PhysicalPlan:
@@ -130,6 +135,8 @@ class PhysicalPlanner:
                       sink_factory) -> List[Pipeline]:
         """Lower a fragment root and terminate it with the given output
         sink (PartitionedOutput/TaskOutput) — the worker-task entry."""
+        global FRAGMENTS_LOWERED
+        FRAGMENTS_LOWERED += 1
         factories, splits = self._lower(root)
         factories.append(sink_factory)
         self._done_pipelines.append(
@@ -187,6 +194,9 @@ class PhysicalPlanner:
                 http=self.http_client, task_id=self.task_id,
                 trace_token=self.trace_token, spool=self.spool,
                 spool_stall_s=self.config.exchange_spool_stall_s)
+            # producer fragment ids, so the worker plan_fragment cache
+            # can rebind this factory's locations per task create
+            fac.source_fragment_ids = tuple(node.fragment_ids)
             if self.exchange_register is not None:
                 self.exchange_register(fac)
             return ([fac], [])
@@ -205,6 +215,7 @@ class PhysicalPlanner:
                 task_id=self.task_id, trace_token=self.trace_token,
                 spool=self.spool,
                 spool_stall_s=self.config.exchange_spool_stall_s)
+            fac.source_fragment_ids = tuple(node.fragment_ids)
             if self.exchange_register is not None:
                 self.exchange_register(fac)
             return ([fac], [])

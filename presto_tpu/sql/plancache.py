@@ -126,6 +126,53 @@ def normalize_sql(sql: str) -> str:
     return text[:-1].rstrip() if text.endswith(";") else text
 
 
+#: the non-deterministic scalar families (FunctionRegistry's
+#: ``isDeterministic=false`` role): two executions of a statement
+#: containing any of these legitimately differ, so a RESULT over them
+#: must never be replayed from a cache.  Plans over them stay cacheable
+#: (the plan is deterministic; its rows are not) — this predicate gates
+#: result-cache admission only, sharing the plan cache's normalization.
+NONDETERMINISTIC_FUNCTIONS = frozenset({
+    "now", "current_timestamp", "current_date", "current_time",
+    "localtimestamp", "localtime", "random", "rand", "uuid",
+    "shuffle", "unix_timestamp",
+})
+
+_NONDET_RE = None
+
+
+def _strip_string_literals(sql: str) -> str:
+    out = []
+    in_string = False
+    for ch in sql:
+        if in_string:
+            if ch == "'":
+                in_string = False
+            continue
+        if ch == "'":
+            in_string = True
+            continue
+        out.append(ch)
+    return "".join(out)
+
+
+def has_nondeterministic_functions(sql: str) -> bool:
+    """True when the statement references a non-deterministic scalar
+    (``now()``/``current_timestamp``/``random()``-family).  Analyzer-side
+    admission predicate for the result cache (server/resultcache.py):
+    such a statement must RE-EXECUTE on every repeat.  Matches
+    word-boundary identifiers outside string literals; a same-named
+    column is a (safe) false positive — it only disables caching."""
+    global _NONDET_RE
+    if _NONDET_RE is None:
+        import re
+
+        _NONDET_RE = re.compile(
+            r"\b(" + "|".join(sorted(NONDETERMINISTIC_FUNCTIONS))
+            + r")\b", re.IGNORECASE)
+    return _NONDET_RE.search(_strip_string_literals(sql)) is not None
+
+
 def fingerprint(session_properties: Optional[Dict[str, Any]]) -> Tuple:
     """Order-independent session-property fingerprint."""
     return tuple(sorted((str(k), str(v))

@@ -390,3 +390,30 @@ def fragment_from_json(d: Dict[str, Any]) -> PlanFragment:
                             d.get("producer_subtree") or ()),
                         device_exchange_eligible=d.get(
                             "device_exchange_eligible"))
+
+
+# --------------------------------------------------------------------------
+# Whole distributed plans (the coordinator-HA journal format)
+# --------------------------------------------------------------------------
+
+def dplan_to_json(dplan) -> Dict[str, Any]:
+    """Serde the coordinator's fragmented plan for the durable
+    query-state journal (server/statestore.py): fragments ride the SAME
+    JSON contract task create uses, so a standby coordinator re-creates
+    tasks from the journal with byte-identical bodies."""
+    return {
+        "fragments": [fragment_to_json(f) for f in dplan.fragments],
+        "root_fragment_id": dplan.root_fragment_id,
+        "column_names": list(dplan.column_names),
+        "column_types": [t.display() for t in dplan.column_types],
+    }
+
+
+def dplan_from_json(d: Dict[str, Any]):
+    from presto_tpu.server.fragmenter import DistributedPlan
+
+    return DistributedPlan(
+        [fragment_from_json(f) for f in d["fragments"]],
+        int(d["root_fragment_id"]),
+        [str(n) for n in d["column_names"]],
+        [T.parse_type(s) for s in d["column_types"]])

@@ -454,3 +454,85 @@ class TestMeshJoinTiers:
             DEFAULT, grouped_mesh_execution=1), oracle)
         assert not any(t.startswith("grouped join")
                        for t in info["kernel_tiers"])
+
+
+class TestStickyFallback:
+    def test_boundary_fallback_annotations_cached(self, clusters):
+        """An annotation-level fallback (approx_percentile is outside
+        the collective subset) is already cheap on repeat: eligibility
+        is cached on the cached plan's fragments, and the repeat is
+        still counted under the same bounded reason."""
+        _http, dev = clusters
+        sql = ("select approx_percentile(l_tax, 0.5) as p, count(*) n "
+               "from tpch.lineitem where l_quantity < 10")
+        dev.execute(sql)
+        q1 = _last_query(dev)
+        assert q1.device_exchange_info.get("fallback_kind") == \
+            "unsupported_boundary"
+        fb1 = dict(
+            dev.coordinator.device_exchange_counters["fallbacks"])
+        dev.execute(sql)
+        q2 = _last_query(dev)
+        assert q2.plan_cached and q2._tasks_scheduled
+        fb2 = dev.coordinator.device_exchange_counters["fallbacks"]
+        assert fb2["unsupported_boundary"] == \
+            fb1["unsupported_boundary"] + 1
+
+    def test_capacity_nonconvergence_fallback_is_sticky(self, clusters):
+        """A capacity non-convergence (MeshUnsupported raised AT
+        lowering/execution, after annotation passed) records its
+        fallback ON the cached fragmented plan: the repeat statement
+        reuses the already-fragmented plan on the HTTP plane — plan
+        cache hit, ZERO mesh-executor attempts (no re-lowering, no
+        4-bucket overflow ladder per repeat) — and is still counted
+        under presto_device_exchange_fallback_total{reason=}."""
+        from presto_tpu.parallel import sqlmesh
+
+        _http, dev = clusters
+        sql = ("select l_linestatus, count(*) c from tpch.lineitem "
+               "where l_quantity < 4 group by l_linestatus")
+
+        orig = sqlmesh.MeshQueryRunner.execute_dplan
+
+        def non_converging(self, dplan, key):
+            raise sqlmesh.MeshUnsupported(
+                "mesh execution did not converge: overflow at "
+                "cap_scale=8")
+
+        sqlmesh.MeshQueryRunner.execute_dplan = non_converging
+        try:
+            want = dev.execute(sql).rows
+        finally:
+            sqlmesh.MeshQueryRunner.execute_dplan = orig
+        q1 = _last_query(dev)
+        assert q1.device_exchange_info.get("fallback_kind") == \
+            "unsupported_shape"
+        assert "did not converge" in \
+            q1.device_exchange_info.get("fallback", "")
+        assert q1._tasks_scheduled, "fallback ran the HTTP plane"
+        fb1 = dict(
+            dev.coordinator.device_exchange_counters["fallbacks"])
+        # the repeat must never touch the mesh executor again
+        calls = []
+        orig_ex = dev.coordinator.mesh_executor
+
+        def counting(cfg, nparts):
+            calls.append(nparts)
+            return orig_ex(cfg, nparts)
+
+        dev.coordinator.mesh_executor = counting
+        try:
+            got = dev.execute(sql).rows
+        finally:
+            dev.coordinator.mesh_executor = orig_ex
+        q2 = _last_query(dev)
+        assert sorted(got) == sorted(want)
+        assert q2.plan_cached, "repeat must hit the plan cache"
+        assert not calls, "sticky fallback must skip the mesh executor"
+        assert set(q2.exchange_modes) == {"http"}
+        assert q2.device_exchange_info.get("fallback_kind") == \
+            "unsupported_shape"
+        fb2 = dev.coordinator.device_exchange_counters["fallbacks"]
+        assert fb2["unsupported_shape"] == \
+            fb1.get("unsupported_shape", 0) + 1, \
+            "the repeat fallback must still be counted"

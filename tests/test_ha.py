@@ -1,0 +1,461 @@
+"""Coordinator HA (server/statestore.py): the kill-the-coordinator
+matrix.
+
+The last unaddressed failure domain — SURVEY §5.3 names the coordinator
+a SPOF with no checkpoint/resume.  These tests prove the closure:
+
+- a coordinator killed at EVERY lifecycle phase (QUEUED / PLANNING /
+  RUNNING-mid-drain / all-stages-complete-in-spool / FINISHED) yields
+  exact rows through the standby, via the durable query-state journal
+  + lease takeover + journal adoption;
+- stages already complete in the spool are NEVER re-executed on
+  failover (``producer_reruns_total == 0``, zero new task creates for
+  the all-spool-complete kill);
+- the takeover lease is mutually exclusive: two standbys racing the
+  claim produce exactly one winner (compare-and-swap marker);
+- the journal serde round-trips every field.
+
+The client follows failover transparently: ``StatementClient`` with a
+standby address list resumes its polls against whichever coordinator
+answers (query ids are stable across adoption).
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu.config import DEFAULT
+from presto_tpu.server.dqr import HAQueryRunner
+from presto_tpu.server.faults import FaultInjector
+from presto_tpu.server.spool import LocalObjectApi
+from presto_tpu.server.statestore import (
+    QueryJournal, QueryStateStore,
+)
+
+pytestmark = pytest.mark.chaos
+
+Q_AGG = ("select l_returnflag, count(*) c, sum(l_quantity) s "
+         "from lineitem group by l_returnflag order by l_returnflag")
+
+
+def _ha_cfg(tmp_path, **over):
+    return dataclasses.replace(
+        DEFAULT,
+        exchange_spooling_enabled=True,
+        exchange_spool_path=str(tmp_path / "spool"),
+        coordinator_state_path=str(tmp_path / "state"),
+        coordinator_lease_ttl_s=0.4,
+        task_recovery_interval_s=0.05, **over)
+
+
+def _oracle(sql, scale=0.01):
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.localrunner import LocalQueryRunner
+
+    reg = ConnectorRegistry()
+    reg.register("tpch", TpchConnector(scale=scale))
+    return LocalQueryRunner(reg, "tpch").execute(sql).rows
+
+
+def _submit_raw(co_uri, sql):
+    req = urllib.request.Request(
+        f"{co_uri}/v1/statement", data=sql.encode(),
+        method="POST", headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())["id"]
+
+
+def _poll_standby(standby_uri, qid, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"{standby_uri}/v1/statement/executing/{qid}/0",
+                    timeout=30) as resp:
+                p = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 503):
+                time.sleep(0.05)
+                continue
+            raise
+        if "error" in p:
+            raise AssertionError(f"standby failed the query: "
+                                 f"{p['error']}")
+        if "data" in p or "columns" in p:
+            return p
+        time.sleep(0.05)
+    raise AssertionError("standby never served the query")
+
+
+def _wait_running(co, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        for q in co.queries.values():
+            if q.state == "RUNNING" and q._placements:
+                return q
+        time.sleep(0.01)
+    raise AssertionError("query never reached RUNNING with placements")
+
+
+# -- unit tier: journal serde + lease ---------------------------------------
+
+def test_journal_roundtrip_serde(tmp_path):
+    store = QueryStateStore(LocalObjectApi(str(tmp_path / "state")))
+    j = QueryJournal(
+        query_id="q1", sql="select 1", user="alice", catalog="tpch",
+        session_properties={"k": "v"}, prepared={"p": "select 2"},
+        trace_token="tt-abc", plan_key_sql="select 1\0execute\0[]",
+        state="RUNNING", error=None, create_time=123.5,
+        dplan={"fragments": [], "root_fragment_id": 0,
+               "column_names": [], "column_types": []},
+        placements=[(0, "q1.0.0", "http://w1"),
+                    (1, "q1.1.0a2", "spool://v1/task/q1.1.0/results/")],
+        attempts={"q1.1.0": 2},
+        task_specs={"q1.0.0": {"fid": 0, "index": 0,
+                               "scan_shard": [0, 1], "n_out": 1,
+                               "broadcast": False, "consumer_index": 0,
+                               "base": "q1.0.0"}},
+        root_locations=["http://w1/v1/task/q1.0.0/results/0"],
+        root_tokens={"http://w1/v1/task/q1.0.0/results/0": 3},
+        result_task_id="haabc.0.0", result_locations=1,
+        result_bytes=42, column_names=["c"], column_types=["bigint"],
+        row_count=1, inline_rows=[[1]], result_cache_task_id=None)
+    store.write(j)
+    back = store.read("q1")
+    assert back == j
+    assert store.list_queries() == ["q1"]
+    store.delete("q1")
+    assert store.read("q1") is None
+
+
+def test_lease_takeover_mutual_exclusion(tmp_path):
+    """Two standbys race an expired lease: the compare-and-swap claim
+    admits exactly ONE winner per generation."""
+    store = QueryStateStore(LocalObjectApi(str(tmp_path / "state")))
+    assert store.try_claim_lease("primary", ttl_s=0.05,
+                                 force=True) == 1
+    assert store.renew_lease("primary", 1, 0.05)
+    # not expired yet: no takeover
+    assert store.try_claim_lease("standby-a", ttl_s=1.0) is None
+    time.sleep(0.1)   # lease expires
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def claim(name):
+        barrier.wait()
+        results[name] = store.try_claim_lease(name, ttl_s=5.0)
+
+    ts = [threading.Thread(target=claim, args=(n,))
+          for n in ("standby-a", "standby-b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wins = [n for n, gen in results.items() if gen is not None]
+    assert len(wins) == 1, results
+    assert results[wins[0]] == 2
+    # the loser cannot renew; the winner can
+    loser = next(n for n in results if n not in wins)
+    assert not store.renew_lease(loser, 2, 1.0)
+    assert store.renew_lease(wins[0], 2, 1.0)
+    # a superseded old primary is refused too
+    assert not store.renew_lease("primary", 1, 1.0)
+
+
+def test_two_standbys_one_winner(tmp_path):
+    """Cluster-level mutual exclusion: a primary plus TWO standbys;
+    kill the primary and exactly one standby activates."""
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpch import TpchConnector
+
+    cfg = _ha_cfg(tmp_path)
+
+    def registry():
+        reg = ConnectorRegistry()
+        reg.register("tpch", TpchConnector(scale=0.001))
+        return reg
+
+    primary = CoordinatorServer(registry(), "tpch", cfg)
+    standbys = [CoordinatorServer(registry(), "tpch", cfg,
+                                  standby_of=primary.uri)
+                for _ in range(2)]
+    try:
+        time.sleep(0.3)
+        assert primary.is_active
+        assert not any(s.is_active for s in standbys)
+        primary.kill()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if any(s.is_active for s in standbys):
+                break
+            time.sleep(0.02)
+        # settle one extra lease period: the loser must NOT also claim
+        time.sleep(3 * cfg.coordinator_lease_ttl_s)
+        active = [s for s in standbys if s.is_active]
+        assert len(active) == 1
+        assert active[0].ha_counters["failovers"] == 1
+    finally:
+        for s in standbys:
+            s.close()
+        primary.close()
+
+
+# -- the kill matrix --------------------------------------------------------
+
+def test_kill_at_queued(tmp_path):
+    """Kill with the query still QUEUED (dispatcher paused): the
+    standby re-enters it into admission under the SAME id and the
+    client's failover-follow gets exact rows."""
+    want = _oracle("select count(*) from orders")
+    cfg = _ha_cfg(tmp_path)
+    with HAQueryRunner.tpch(scale=0.01, n_workers=2, config=cfg,
+                            heartbeat_interval_s=0.05,
+                            heartbeat_max_missed=2) as ha:
+        ha.coordinator.dispatcher.pause()
+        qid = _submit_raw(ha.coordinator.uri,
+                          "select count(*) from orders")
+        time.sleep(0.2)   # journal write lands at submit
+        ha.kill_primary()
+        ha.wait_for_failover()
+        p = _poll_standby(ha.standby.uri, qid)
+        assert [tuple(r) for r in p["data"]] == want
+        assert ha.standby.ha_counters["adopted"].get("requeued") == 1
+
+
+def test_kill_at_planning(tmp_path):
+    """Kill while the query is held AT the PLANNING transition (phase
+    hook): no tasks existed, so adoption re-queues it."""
+    want = _oracle(Q_AGG)
+    cfg = _ha_cfg(tmp_path)
+    with HAQueryRunner.tpch(scale=0.01, n_workers=2, config=cfg,
+                            heartbeat_interval_s=0.05,
+                            heartbeat_max_missed=2) as ha:
+        at_planning = threading.Event()
+        release = threading.Event()
+
+        def hook(_q, phase):
+            if phase == "PLANNING":
+                at_planning.set()
+                release.wait(timeout=30.0)
+
+        ha.coordinator.phase_hook = hook
+        qid = _submit_raw(ha.coordinator.uri, Q_AGG)
+        assert at_planning.wait(timeout=15.0)
+        ha.kill_primary()
+        release.set()       # hook returns; killed check stops the thread
+        ha.wait_for_failover()
+        p = _poll_standby(ha.standby.uri, qid)
+        assert sorted(tuple(r) for r in p["data"]) == sorted(want)
+        assert ha.standby.ha_counters["adopted"].get("requeued") == 1
+
+
+def test_kill_at_running_mid_drain(tmp_path):
+    """Kill mid-drain (root results held by the injector): the standby
+    adopts the RUNNING query, re-attaches/repoints, and re-pulls the
+    spooled root stream from token 0 — exact rows, ZERO producer
+    re-runs."""
+    want = _oracle(Q_AGG)
+    cfg = _ha_cfg(tmp_path)
+    co_inj = FaultInjector()
+    co_inj.add_rule(r"/results/", method="GET", policy="slow-task")
+    with HAQueryRunner.tpch(scale=0.01, n_workers=2, config=cfg,
+                            coordinator_injector=co_inj,
+                            heartbeat_interval_s=0.05,
+                            heartbeat_max_missed=2) as ha:
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = ha.execute(Q_AGG).rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = repr(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        q = _wait_running(ha.coordinator)
+        time.sleep(0.3)   # let the RUNNING journal write land
+        ha.kill_primary()
+        ha.wait_for_failover()
+        t.join(timeout=90)
+        assert not t.is_alive(), "client never finished"
+        assert "err" not in res, res
+        assert sorted(res["rows"]) == sorted(want)
+        sq = ha.standby.queries[q.query_id]
+        assert sq.state == "FINISHED"
+        assert sq.producer_reruns_total == 0
+        assert sq.adopted
+        assert ha.standby.ha_counters["failovers"] == 1
+
+
+def test_kill_at_all_spool_complete(tmp_path):
+    """Kill once every stage is complete in the spool (drain held):
+    adoption is PURE repoint — zero re-execution, zero new task
+    creates, zero producer re-runs."""
+    want = _oracle(Q_AGG)
+    cfg = _ha_cfg(tmp_path)
+    co_inj = FaultInjector()
+    co_inj.add_rule(r"/results/", method="GET", policy="slow-task")
+    with HAQueryRunner.tpch(scale=0.01, n_workers=2, config=cfg,
+                            coordinator_injector=co_inj,
+                            heartbeat_interval_s=0.05,
+                            heartbeat_max_missed=2) as ha:
+        res = {}
+
+        def run():
+            try:
+                res["rows"] = ha.execute(Q_AGG).rows
+            except Exception as e:  # noqa: BLE001
+                res["err"] = repr(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        q = _wait_running(ha.coordinator)
+        # wait until EVERY task is complete in the spool
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            with q._recovery_lock:
+                placements = list(q._placements)
+            if placements and all(
+                    ha.coordinator.spool.is_complete(
+                        tid, q._task_specs[tid]["n_out"])
+                    for _, tid, _ in placements):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("stages never all completed in spool")
+        time.sleep(0.3)
+        # count worker-side task creates before the kill
+        n_tasks_before = sum(len(w.task_manager.tasks)
+                             for w in ha.workers)
+        ha.kill_primary()
+        ha.wait_for_failover()
+        t.join(timeout=90)
+        assert not t.is_alive(), "client never finished"
+        assert "err" not in res, res
+        assert sorted(res["rows"]) == sorted(want)
+        sq = ha.standby.queries[q.query_id]
+        assert sq.state == "FINISHED"
+        # the acceptance pin: nothing re-ran anywhere
+        assert sq.producer_reruns_total == 0
+        assert sq.stage_retry_rounds == 0
+        n_tasks_after = sum(len(w.task_manager.tasks)
+                            for w in ha.workers)
+        assert n_tasks_after == n_tasks_before, \
+            "adoption must not create tasks when all stages are " \
+            "complete in the spool"
+
+
+def test_kill_at_finished(tmp_path):
+    """Kill AFTER the query finished: the terminal journal adopted the
+    root output into a durable ha* spool stream, so the standby
+    re-serves the rows byte-exact with zero re-execution."""
+    cfg = _ha_cfg(tmp_path)
+    with HAQueryRunner.tpch(scale=0.01, n_workers=2, config=cfg,
+                            heartbeat_interval_s=0.05,
+                            heartbeat_max_missed=2) as ha:
+        cols, data = ha.client.execute(Q_AGG)
+        qid = ha.client.last_query_id
+        n_tasks_before = sum(len(w.task_manager.tasks)
+                             for w in ha.workers)
+        ha.kill_primary()
+        ha.wait_for_failover()
+        p = _poll_standby(ha.standby.uri, qid)
+        assert p["data"] == data
+        sq = ha.standby.queries[qid]
+        assert sq.adopt_outcome == "served"
+        assert sum(len(w.task_manager.tasks) for w in ha.workers) == \
+            n_tasks_before
+        # observability: the failover + adoption land on /metrics
+        with urllib.request.urlopen(f"{ha.standby.uri}/metrics",
+                                    timeout=5) as resp:
+            metrics = resp.read().decode()
+        assert "presto_coordinator_failover_total 1" in metrics
+        assert 'presto_queries_adopted_total{outcome="served"} 1' \
+            in metrics
+
+
+def test_failover_events_in_log(tmp_path):
+    """CoordinatorFailoverEvent + QueryAdoptedEvent ride the standby's
+    event bus (query.json shape)."""
+    cfg = _ha_cfg(tmp_path)
+    log = tmp_path / "events.json"
+    with HAQueryRunner.tpch(scale=0.01, n_workers=2, config=cfg,
+                            heartbeat_interval_s=0.05,
+                            heartbeat_max_missed=2,
+                            event_log_path=str(log)) as ha:
+        ha.client.execute("select count(*) from region")
+        qid = ha.client.last_query_id
+        ha.kill_primary()
+        ha.wait_for_failover()
+        _poll_standby(ha.standby.uri, qid)
+        from presto_tpu.events import read_event_log
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            events = read_event_log(str(log))
+            kinds = {e["event"] for e in events}
+            if {"CoordinatorFailoverEvent",
+                    "QueryAdoptedEvent"} <= kinds:
+                break
+            time.sleep(0.05)
+        fo = [e for e in events
+              if e["event"] == "CoordinatorFailoverEvent"]
+        ad = [e for e in events if e["event"] == "QueryAdoptedEvent"]
+        assert fo and fo[0]["adopted_queries"] >= 1
+        assert any(e["query_id"] == qid and e["outcome"] == "served"
+                   for e in ad)
+
+
+def test_no_state_path_leaves_paths_inert(tmp_path):
+    """standby_of=None + no state path (the default): no journal, no
+    lease, no HA thread — pinned by the statestore staying absent and
+    a normal query running exactly as before."""
+    cfg = dataclasses.replace(
+        DEFAULT, exchange_spooling_enabled=True,
+        exchange_spool_path=str(tmp_path / "spool"))
+    from presto_tpu.server.dqr import DistributedQueryRunner
+
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=cfg) as dqr:
+        assert dqr.coordinator.statestore is None
+        assert dqr.coordinator.is_active
+        assert not hasattr(dqr.coordinator, "_ha_thread")
+        r = dqr.execute("select count(*) from region")
+        assert r.rows == [(5,)]
+        q = list(dqr.coordinator.queries.values())[0]
+        assert not q.adopted
+
+
+@pytest.mark.slow
+def test_q72_mesh_full_phase_sweep():
+    """The acceptance sweep: kill the coordinator at EVERY lifecycle
+    phase of a TPC-DS Q72 2-worker mesh run (QUEUED / PLANNING /
+    RUNNING-mid-drain / all-spool-complete / FINISHED) — exact rows
+    through the standby each time, ZERO producer re-runs for
+    spool-complete stages, zero task creates for the all-spool-complete
+    kill (tools/chaos_run.py --mode ha is the CLI face)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import importlib
+
+    chaos_run = importlib.import_module("chaos_run")
+    report = chaos_run.run_ha_sweep(quiet=True)
+    assert report["ok"], report
+    assert report["total_producer_reruns"] == 0
+    by_phase = {s["phase"]: s for s in report["stages"]}
+    assert set(by_phase) == set(chaos_run.HA_PHASES)
+    assert by_phase["QUEUED"]["adopted_outcome"] is None or \
+        by_phase["QUEUED"].get("adopted_outcome") != "failed"
+    assert by_phase["SPOOL_COMPLETE"]["tasks_after"] == \
+        by_phase["SPOOL_COMPLETE"]["tasks_before"]
+    assert by_phase["FINISHED"]["adopted_outcome"] == "served"

@@ -414,3 +414,39 @@ def test_object_tier_hit_byte_exact_under_read_faults(tmp_path):
         # survivor byte-exact
         r3 = dqr.execute(SQL)
         assert r3.rows == r1.rows
+
+
+def test_nondeterministic_statements_never_cached(tmp_path):
+    """ROADMAP 4i non-determinism guard: a statement containing a
+    now()/current_timestamp/random()-family expression is rejected at
+    cache admission (the analyzer-side predicate shared with the plan
+    cache's keying module) and RE-EXECUTES on every repeat — the named
+    blocker for ``result_cache_enabled`` default-ON."""
+    from presto_tpu.sql import plancache
+
+    # the predicate itself (shared with the plan-cache key path)
+    assert plancache.has_nondeterministic_functions(
+        "select now(), count(*) from t")
+    assert plancache.has_nondeterministic_functions(
+        "select current_timestamp")
+    assert plancache.has_nondeterministic_functions(
+        "select random() * 2")
+    assert not plancache.has_nondeterministic_functions(
+        "select 'now()' from t")        # inside a string literal
+    assert not plancache.has_nondeterministic_functions(SQL)
+    with DistributedQueryRunner.tpch(
+            scale=0.01, n_workers=2, config=_cfg(tmp_path)) as dqr:
+        nondet = ("select count(*) + 0 * cast(to_unixtime(now()) "
+                  "as bigint) from lineitem")
+        dqr.execute(nondet)
+        assert resultcache.stats()["size"] == 0, \
+            "non-deterministic statement must never be admitted"
+        dqr.execute(nondet)
+        d = _detail(dqr)
+        assert d["resultCached"] is False
+        assert dqr.coordinator.queries[d["queryId"]]._tasks_scheduled, \
+            "repeat of a non-deterministic statement must re-execute"
+        # deterministic control: same cluster, cache engages normally
+        dqr.execute(SQL)
+        dqr.execute(SQL)
+        assert _detail(dqr)["resultCached"] is True

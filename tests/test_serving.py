@@ -514,3 +514,76 @@ def _wait_for_state(base_uri, qid, states, timeout=10.0):
             return state
         time.sleep(0.02)
     return state
+
+
+class TestWorkerFragmentCache:
+    def test_repeat_statement_lowers_zero_fragments(self):
+        """The distributed half of physical-factory sharing (ROADMAP
+        #3): repeat task creates of the same statement reuse the
+        worker-side lowered pipelines — the SECOND execution builds
+        ZERO fragment lowerings (sql/physical.FRAGMENTS_LOWERED), with
+        exact rows, across join + agg + merge-exchange shapes."""
+        from presto_tpu.sql import physical
+
+        with DistributedQueryRunner.tpch(scale=0.01,
+                                         n_workers=2) as dqr:
+            sqls = [
+                "select l_returnflag, count(*) c_wfc from lineitem "
+                "group by l_returnflag order by l_returnflag",
+                "select n_name, count(*) j_wfc from supplier, nation "
+                "where s_nationkey = n_nationkey group by n_name "
+                "order by n_name",
+            ]
+            for sql in sqls:
+                first = dqr.execute(sql).rows
+                lowered = physical.FRAGMENTS_LOWERED
+                second = dqr.execute(sql).rows
+                assert second == first
+                assert physical.FRAGMENTS_LOWERED == lowered, \
+                    f"worker re-lowered fragments on repeat of " \
+                    f"{sql[:40]!r}"
+                # cache counters moved on every worker that got tasks
+                hits = sum(w.task_manager.fragment_cache.stats["hits"]
+                           for w in dqr.workers)
+                assert hits > 0
+
+    def test_epoch_change_invalidates_worker_cache(self):
+        """A DML between repeats bumps the coordinator's stats epoch;
+        the shipped epoch snapshot changes the worker cache key, so the
+        repeat RE-LOWERS (fresh pipelines over fresh data) and returns
+        the new rows."""
+        from presto_tpu.sql import physical
+
+        with DistributedQueryRunner.tpch(scale=0.01,
+                                         n_workers=2) as dqr:
+            dqr.execute("create table memory.wfc as "
+                        "select n_nationkey, n_name from tpch.nation")
+            sql = "select count(*) c_ep from memory.wfc"
+            assert dqr.execute(sql).rows == [(25,)]
+            dqr.execute("insert into memory.wfc "
+                        "select n_nationkey, n_name from tpch.nation")
+            lowered = physical.FRAGMENTS_LOWERED
+            assert dqr.execute(sql).rows == [(50,)]
+            assert physical.FRAGMENTS_LOWERED > lowered, \
+                "epoch bump must force a fresh fragment lowering"
+
+    def test_disabled_lowering_every_create(self):
+        """worker_fragment_cache_enabled=false restores per-create
+        lowering exactly (no cache constructed, counter moves every
+        run)."""
+        import dataclasses
+
+        from presto_tpu.config import DEFAULT
+        from presto_tpu.sql import physical
+
+        cfg = dataclasses.replace(DEFAULT,
+                                  worker_fragment_cache_enabled=False)
+        with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                         config=cfg) as dqr:
+            assert all(w.task_manager.fragment_cache is None
+                       for w in dqr.workers)
+            sql = "select count(*) c_off from lineitem"
+            first = dqr.execute(sql).rows
+            lowered = physical.FRAGMENTS_LOWERED
+            assert dqr.execute(sql).rows == first
+            assert physical.FRAGMENTS_LOWERED > lowered

@@ -379,3 +379,31 @@ class TestPerfRegress:
                                 old, new])
         assert rc == 1
         capsys.readouterr()
+
+
+class TestChaosRunHA:
+    def test_ha_check_mode(self, capsys):
+        """tools/chaos_run.py --mode ha --check: the coordinator-HA CI
+        smoke — kill the PRIMARY COORDINATOR mid-drain of a TPC-DS Q72
+        run on a 2-worker HA mesh, headless; nonzero on inexact rows
+        through the standby or on any producer re-run for stages
+        already complete in the spool."""
+        import importlib
+        import json
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        chaos_run = importlib.import_module("chaos_run")
+        rc = chaos_run.main(["--mode", "ha", "--check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        report = json.loads(out[out.index("{\n"):])
+        assert report["mode"] == "ha"
+        assert report["phases"] == ["RUNNING"]
+        assert report["total_producer_reruns"] == 0
+        stage = report["stages"][0]
+        assert stage["ok"] and stage["failovers"] == 1
+        assert stage["adopted_outcome"] in ("reattached", "repointed",
+                                            "restarted")

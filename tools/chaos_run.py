@@ -169,6 +169,233 @@ def run_spool_sweep(scale: float = 0.003, spooling: bool = True,
     return report
 
 
+#: the coordinator-HA kill matrix (lifecycle phases of one query)
+HA_PHASES = ("QUEUED", "PLANNING", "RUNNING", "SPOOL_COMPLETE",
+             "FINISHED")
+
+
+def run_ha_sweep(phases=HA_PHASES, scale: float = 0.003,
+                 query_num: int = 72, quiet: bool = False) -> dict:
+    """Kill-the-COORDINATOR sweep (coordinator HA acceptance): run a
+    TPC-DS query on a 2-worker HA mesh (primary + standby sharing the
+    spool and the durable query-state journal), kill the primary at
+    each lifecycle phase in turn, and assert exact rows through the
+    standby — with ZERO producer re-runs for stages already complete in
+    the spool (and zero task creates at all for the
+    all-spool-complete kill)."""
+    import dataclasses as _dc
+    import tempfile
+    import threading as _th
+    import urllib.error
+    import urllib.request
+
+    from presto_tpu.config import DEFAULT
+    from presto_tpu.connectors.api import ConnectorRegistry
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+    from presto_tpu.localrunner import LocalQueryRunner
+    from presto_tpu.server.dqr import HAQueryRunner
+    from presto_tpu.server.faults import FaultInjector
+    from tests.tpcds_queries import QUERIES
+
+    sql = QUERIES[query_num]
+    reg = ConnectorRegistry()
+    reg.register("tpcds", TpcdsConnector(scale=scale))
+    want = sorted(LocalQueryRunner(reg, "tpcds").execute(sql).rows)
+
+    def poll_standby(standby_uri, qid, timeout_s=120.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"{standby_uri}/v1/statement/executing/{qid}/0",
+                        timeout=30) as resp:
+                    p = json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if e.code in (404, 503):
+                    time.sleep(0.05)
+                    continue
+                raise
+            if "error" in p:
+                raise RuntimeError(f"standby failed: {p['error']}")
+            if "data" in p:
+                return p
+            time.sleep(0.05)
+        raise RuntimeError("standby never served the query")
+
+    stages = []
+    for phase in phases:
+        t0 = time.monotonic()
+        tmp = tempfile.mkdtemp(prefix="ha-sweep-")
+        cfg = _dc.replace(
+            DEFAULT,
+            exchange_spooling_enabled=True,
+            exchange_spool_path=os.path.join(tmp, "spool"),
+            coordinator_state_path=os.path.join(tmp, "state"),
+            coordinator_lease_ttl_s=0.4,
+            task_recovery_interval_s=0.05)
+        co_inj = FaultInjector()
+        hold = None
+        if phase in ("RUNNING", "SPOOL_COMPLETE"):
+            hold = co_inj.add_rule(r"/results/", method="GET",
+                                   policy="slow-task", delay_s=120.0)
+        stage = {"phase": phase, "ok": False}
+        res = {}
+        with HAQueryRunner.tpcds(
+                scale=scale, n_workers=2, config=cfg,
+                coordinator_injector=co_inj,
+                heartbeat_interval_s=0.05,
+                heartbeat_max_missed=2) as ha:
+            co = ha.coordinator
+            while len(co.nodes.alive_nodes()) != 2:
+                time.sleep(0.02)
+            try:
+                if phase == "QUEUED":
+                    co.dispatcher.pause()
+                    qid = _ha_submit(co.uri, sql)
+                    time.sleep(0.2)
+                    ha.kill_primary()
+                elif phase == "PLANNING":
+                    at = _th.Event()
+                    release = _th.Event()
+
+                    def hook(_q, ph):
+                        if ph == "PLANNING":
+                            at.set()
+                            release.wait(timeout=60.0)
+
+                    co.phase_hook = hook
+                    qid = _ha_submit(co.uri, sql)
+                    if not at.wait(timeout=60.0):
+                        raise RuntimeError("never reached PLANNING")
+                    ha.kill_primary()
+                    release.set()
+                elif phase == "FINISHED":
+                    cols, data = ha.client.execute(sql)
+                    qid = ha.client.last_query_id
+                    stage["primary_rows"] = len(data)
+                    ha.kill_primary()
+                else:   # RUNNING / SPOOL_COMPLETE, drain held
+                    def run():
+                        try:
+                            res["rows"] = ha.execute(sql).rows
+                        except Exception as e:  # noqa: BLE001
+                            res["err"] = str(e)
+
+                    t = _th.Thread(target=run)
+                    t.start()
+                    q = None
+                    deadline = time.monotonic() + 120.0
+                    while time.monotonic() < deadline:
+                        qs = list(co.queries.values())
+                        if qs and qs[0]._placements and \
+                                qs[0].state == "RUNNING":
+                            q = qs[0]
+                            break
+                        time.sleep(0.02)
+                    if q is None:
+                        raise RuntimeError("never reached RUNNING")
+                    qid = q.query_id
+                    if phase == "SPOOL_COMPLETE":
+                        deadline = time.monotonic() + 120.0
+                        while time.monotonic() < deadline:
+                            with q._recovery_lock:
+                                pl = list(q._placements)
+                            if pl and all(co.spool.is_complete(
+                                    tid, q._task_specs[tid]["n_out"])
+                                    for _, tid, _ in pl):
+                                break
+                            time.sleep(0.05)
+                        else:
+                            raise RuntimeError(
+                                "stages never all spool-complete")
+                    time.sleep(0.3)   # journal writes settle
+                    stage["tasks_before"] = sum(
+                        len(w.task_manager.tasks) for w in ha.workers)
+                    ha.kill_primary()
+                ha.wait_for_failover(timeout_s=30.0)
+                if phase in ("RUNNING", "SPOOL_COMPLETE"):
+                    t.join(timeout=240.0)
+                    if t.is_alive():
+                        raise RuntimeError("client never finished")
+                    if "err" in res:
+                        raise RuntimeError(res["err"][:300])
+                    rows = sorted(res["rows"])
+                else:
+                    p = poll_standby(ha.standby.uri, qid)
+                    # decode the JSON payload through the client codec
+                    # so dates/timestamps compare against the oracle
+                    from presto_tpu import types as T
+                    from presto_tpu.server.dqr import _from_json
+
+                    types = [T.parse_type(c["type"])
+                             for c in p.get("columns", [])]
+                    rows = sorted(
+                        tuple(_from_json(v, ty)
+                              for v, ty in zip(r, types))
+                        for r in p["data"])
+                sq = ha.standby.queries.get(qid)
+                stage["adopted_outcome"] = getattr(
+                    sq, "adopt_outcome", None)
+                stage["producer_reruns"] = getattr(
+                    sq, "producer_reruns_total", 0)
+                stage["stage_retry_rounds"] = getattr(
+                    sq, "stage_retry_rounds", 0)
+                stage["failovers"] = \
+                    ha.standby.ha_counters["failovers"]
+                if phase == "FINISHED":
+                    # both sides are client-protocol JSON payloads:
+                    # the standby must re-serve the primary's rows
+                    exact = sorted(map(tuple, p["data"])) == \
+                        sorted(map(tuple, data))
+                else:
+                    exact = rows == want
+                if phase == "SPOOL_COMPLETE":
+                    stage["tasks_after"] = sum(
+                        len(w.task_manager.tasks) for w in ha.workers)
+                    if stage["tasks_after"] != stage["tasks_before"]:
+                        raise RuntimeError(
+                            "adoption created tasks for "
+                            "spool-complete stages")
+                    if stage["producer_reruns"] != 0:
+                        raise RuntimeError(
+                            "producer re-ran for a spool-complete "
+                            "stage")
+                if phase == "RUNNING" and \
+                        stage["producer_reruns"] != 0:
+                    raise RuntimeError(
+                        "producer re-ran under spooled HA adoption")
+                if not exact:
+                    raise RuntimeError("row mismatch through standby")
+                stage["ok"] = True
+            except Exception as e:  # noqa: BLE001 - per-phase verdict
+                stage["reason"] = str(e)[:300]
+            if hold is not None:
+                hold.release()
+        stage["wall_s"] = round(time.monotonic() - t0, 2)
+        stages.append(stage)
+        if not quiet:
+            print(json.dumps(stage))
+    report = {
+        "mode": "ha", "query": f"tpcds q{query_num}", "scale": scale,
+        "phases": [s["phase"] for s in stages],
+        "stages": stages,
+        "total_producer_reruns": sum(
+            s.get("producer_reruns", 0) for s in stages),
+        "ok": all(s["ok"] for s in stages),
+    }
+    return report
+
+
+def _ha_submit(co_uri: str, sql: str) -> str:
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{co_uri}/v1/statement", data=sql.encode(),
+        method="POST", headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())["id"]
+
+
 def run_check() -> int:
     """CI smoke: the chaos marker tier, headless (quick signal — the
     TPC-DS mesh cases are additionally marked slow and excluded)."""
@@ -186,21 +413,25 @@ def run_check() -> int:
     return r.returncode
 
 
-def main() -> int:
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--workers", type=int, default=3)
     ap.add_argument("--scale", type=float, default=0.01)
     ap.add_argument("--query", default="select count(*) from lineitem")
     ap.add_argument("--kill-index", type=int, default=None,
                     help="worker to kill (default: last)")
-    ap.add_argument("--mode", choices=["leaf", "stage", "spool"],
+    ap.add_argument("--mode", choices=["leaf", "stage", "spool", "ha"],
                     default="leaf",
                     help="leaf = kill a scan-task worker; stage = kill "
                          "a worker holding a non-leaf fragment "
                          "(whole-stage retry); spool = kill EVERY "
                          "stage of TPC-DS Q72 in turn on the spooled "
                          "exchange, reporting producer re-runs per "
-                         "stage (must be zero)")
+                         "stage (must be zero); ha = kill the "
+                         "COORDINATOR at every lifecycle phase of a "
+                         "TPC-DS Q72 HA mesh run and assert exact "
+                         "rows through the standby (with --check: "
+                         "just the kill-at-RUNNING smoke)")
     ap.add_argument("--no-spooling", action="store_true",
                     help="spool mode only: run the sweep with "
                          "exchange spooling disabled (PR 5 cascading "
@@ -211,7 +442,16 @@ def main() -> int:
     ap.add_argument("--event-log", default="query.json",
                     help="write the coordinator's query.json event "
                          "log here (JSON lines; '' disables)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
+    if args.mode == "ha":
+        # --check = the CI smoke: ONLY the kill-at-RUNNING scenario,
+        # nonzero on inexact rows or on any producer re-run for
+        # spool-complete stages
+        report = run_ha_sweep(
+            phases=("RUNNING",) if args.check else HA_PHASES,
+            scale=args.scale if args.scale != 0.01 else 0.003)
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
     if args.check:
         return run_check()
     if args.mode == "spool":
