@@ -372,6 +372,14 @@ class EngineConfig:
     # stream at terminal journaling (bigger results journal without
     # rows and re-enter admission on adoption)
     coordinator_journal_max_result_bytes: int = 16 << 20
+    # journal GC: terminal (FINISHED/FAILED) ``queries/{id}`` entries
+    # older than this are deleted by the active coordinator's lease
+    # tick instead of accumulating until the orphan sweep; in-flight
+    # entries are NEVER reaped.  0 disables age-based reaping.
+    coordinator_journal_retention_s: float = 3600.0
+    # journal GC count bound: at most this many terminal entries are
+    # retained (oldest reaped first); 0 = unbounded
+    coordinator_journal_retention_count: int = 1024
     # --- worker-side plan_fragment cache (server/task.py) ----------------
     # Repeat task creates of the same statement (same fragment JSON,
     # scan shard, output topology, session fingerprint, and coordinator
@@ -501,6 +509,27 @@ class EngineConfig:
     # behavior for device-exchange queries exactly (no mid-run samples,
     # no progress object until the final rollup).
     mesh_progress_beacons: bool = True
+    # Boundary checkpoints for the collective tier (PR 17): instead of
+    # ONE all-or-nothing SPMD program, the fragment DAG executes as a
+    # SEQUENCE of per-fragment SPMD programs; after each group the
+    # coordinator write-throughs the boundary's output pages into the
+    # SpoolStore (same LZ4 wire frames, spooled under the query's task
+    # ids) and journals a device-plane checkpoint record.  A mid-program
+    # failure then resumes from the last complete boundary instead of
+    # re-running the whole query.  OFF (default) restores the PR 14
+    # all-or-nothing lowering + fallback exactly.
+    mesh_checkpoint_boundaries: bool = False
+    # Recovery mode after a device-plane failure under checkpointing:
+    # 'device' re-runs ONLY the remaining checkpoint groups as fresh
+    # SPMD programs fed from the checkpointed boundary batches; 'http'
+    # degrades to the task-scheduled plane, scheduling ONLY the
+    # fragments whose producers are not spool-complete (completed
+    # fragments become zero-re-execution spool:// leaf inputs).
+    mesh_resume_mode: str = "device"
+    # Consecutive device-resume attempts before a checkpointed query
+    # degrades to the HTTP plane anyway (the device plane may be
+    # persistently broken; the spooled checkpoints are still honored).
+    mesh_resume_limit: int = 3
 
 
 DEFAULT = EngineConfig()

@@ -150,6 +150,26 @@ class SpeculationEvent:
 
 
 @dataclasses.dataclass(frozen=True)
+class DeviceResumeEvent:
+    """The collective data plane resumed a query from its last complete
+    boundary checkpoint after a mid-program device failure
+    (mesh_checkpoint_boundaries).  ``mode`` is 'device' (remaining
+    checkpoint groups re-lowered as a fresh SPMD program fed from the
+    spooled boundary pages) or 'http' (degraded to the HTTP plane
+    scheduling ONLY the remaining fragments, checkpointed producers
+    served as spool:// leaves).  ``resumed_from`` lists the fragment
+    ids whose checkpoints were reused — zero re-execution of those."""
+
+    query_id: str
+    trace_token: str
+    mode: str
+    failed_fragment: int           # fragment whose group hit the fault
+    resumed_from: tuple            # checkpointed fragment ids reused
+    reason: str
+    time: float
+
+
+@dataclasses.dataclass(frozen=True)
 class CoordinatorFailoverEvent:
     """A standby coordinator won the takeover lease and adopted the
     durable query-state journal (server/statestore.py): every query the
@@ -207,6 +227,9 @@ class EventListener:
     def slow_query(self, event: SlowQueryEvent) -> None:
         pass
 
+    def device_resume(self, event: DeviceResumeEvent) -> None:
+        pass
+
     def coordinator_failover(self, event: CoordinatorFailoverEvent
                              ) -> None:
         pass
@@ -253,6 +276,9 @@ class EventBus:
     def slow_query(self, event: SlowQueryEvent) -> None:
         self._fire("slow_query", event)
 
+    def device_resume(self, event: DeviceResumeEvent) -> None:
+        self._fire("device_resume", event)
+
     def coordinator_failover(self, event: CoordinatorFailoverEvent
                              ) -> None:
         self._fire("coordinator_failover", event)
@@ -290,6 +316,7 @@ class JsonLinesEventListener(EventListener):
     worker_drain = _write
     speculation = _write
     slow_query = _write
+    device_resume = _write
     coordinator_failover = _write
     query_adopted = _write
 

@@ -78,6 +78,13 @@ from presto_tpu import kernelcache as _kc  # noqa: E402
 
 _PROGRAM_CACHE = _kc.new_cache("mesh_program")
 
+#: fragments actually traced/lowered into SPMD programs, process-wide —
+#: the mesh-tier mirror of ``sql.physical.FRAGMENTS_LOWERED``.  The
+#: checkpoint-resume tests pin "completed fragments are never
+#: re-lowered" against deltas of this counter (a checkpoint-fed
+#: fragment does NOT bump it: its subtree is replaced by a host feed)
+FRAGMENTS_LOWERED = 0
+
 
 class MeshUnsupported(NotImplementedError):
     """Plan shape outside the mesh tier; caller falls back to operators."""
@@ -256,6 +263,94 @@ class MeshQueryRunner:
         vs PartitionedOutput -> wire pages -> ExchangeOperator)."""
         return self._execute_planned(key, lambda: dplan)
 
+    def execute_dplan_checkpointed(self, dplan, key: str, *,
+                                   completed=None, on_checkpoint=None,
+                                   fault_hook=None):
+        """Execute a fragmented plan as a SEQUENCE of per-fragment SPMD
+        programs (``mesh_checkpoint_boundaries``): fragments run in
+        topological (producers-first) order; each group's root output is
+        read back to the host — the boundary checkpoint — and handed to
+        ``on_checkpoint(fid, batch)`` (the coordinator spools it); later
+        groups are fed from the checkpointed batches instead of
+        re-lowering their producers.  ``completed`` maps fragment id ->
+        host Batch for checkpoints that already exist: on resume, those
+        groups are SKIPPED entirely (zero re-execution, zero
+        re-lowering).  ``fault_hook(fid)`` fires before each group, the
+        chaos-injection seam.  Checkpoint-group programs are never
+        program-cached: restartability is bought with per-group
+        dispatch, so repeat queries should run the whole-program path."""
+        from presto_tpu.localrunner import QueryResult
+
+        completed = {} if completed is None else completed
+        for frag in dplan.fragments:
+            _check_supported(frag.root)
+        all_info: List[Dict] = []
+        lowered: List[int] = []
+        for fid in self._group_order(dplan):
+            if fid != dplan.root_fragment_id and fid in completed:
+                continue
+            if fault_hook is not None:
+                fault_hook(fid)
+            prog = None
+            batch = None
+            for attempt in range(4):
+                prog = _MeshProgram(self, dplan,
+                                    cap_scale=1 << attempt,
+                                    prepared=prog, root_fid=fid,
+                                    ckpt=completed)
+                batch, overflowed = prog.run()
+                if not overflowed:
+                    break
+                batch = None
+            if batch is None:
+                raise MeshUnsupported(
+                    f"mesh execution did not converge on fragment {fid}"
+                    + (f" ({', '.join(prog.overflow_labels)})"
+                       if getattr(prog, 'overflow_labels', None)
+                       else ""))
+            all_info.append(dict(
+                prog.run_info(), compile_ns=prog.compile_ns,
+                build_spans=dict(prog.build_spans)))
+            lowered.extend(prog.lowered_fids)
+            if fid == dplan.root_fragment_id:
+                self.last_run_info = _merge_run_info(
+                    all_info,
+                    checkpoints=sorted(completed),
+                    lowered=sorted(set(lowered)))
+                return QueryResult(dplan.column_names,
+                                   dplan.column_types,
+                                   batch.to_pylist())
+            completed[fid] = batch
+            if on_checkpoint is not None:
+                on_checkpoint(fid, batch)
+        raise MeshUnsupported("plan has no reachable root fragment")
+
+    @staticmethod
+    def _group_order(dplan) -> List[int]:
+        """Checkpoint-group schedule: DFS postorder from the root, so
+        every fragment runs after all the fragments it consumes."""
+        order: List[int] = []
+        seen = set()
+
+        def visit(fid: int) -> None:
+            if fid in seen:
+                return
+            seen.add(fid)
+            stack = [dplan.fragments[fid].root]
+            child: List[int] = []
+            while stack:
+                node = stack.pop()
+                fids = getattr(node, "fragment_ids", None)
+                if fids:
+                    child.extend(fids)
+                stack.extend(node.sources)
+            for c in sorted(child):
+                visit(c)
+            order.append(fid)
+
+        visit(dplan.root_fragment_id)
+        return order
+
     def _execute_planned(self, sql: str, make_dplan):
         from presto_tpu.localrunner import QueryResult
 
@@ -304,16 +399,72 @@ class MeshQueryRunner:
                if getattr(prog, 'overflow_labels', None) else ""))
 
 
+def _merge_run_info(infos: List[Dict], checkpoints: List[int],
+                    lowered: List[int]) -> Dict:
+    """Fold per-checkpoint-group run_info dicts into ONE whole-query
+    view shaped exactly like a whole-program run_info, plus the
+    checkpoint accounting (group count, checkpointed fragment ids,
+    fragments actually lowered) the resume tests and the EXPLAIN
+    ANALYZE footer consume."""
+    merged: Dict = {
+        "exchange_modes": {}, "boundaries": [], "kernel_tiers": [],
+        "nparts": infos[-1]["nparts"] if infos else 0,
+        "cap_scale": max((i["cap_scale"] for i in infos), default=1),
+        "per_shard": {"fragments": {}, "peak_live_bytes": []},
+        "checkpoint_groups": len(infos),
+        "checkpoints": list(checkpoints),
+        "fragments_lowered": list(lowered),
+        "compile_ns": sum(i.get("compile_ns", 0) for i in infos),
+        "program_cached": False,
+    }
+    spans: Dict[str, Tuple[float, float]] = {}
+    peak: Optional[List[int]] = None
+    for info in infos:
+        for k, v in info["exchange_modes"].items():
+            merged["exchange_modes"][k] = \
+                merged["exchange_modes"].get(k, 0) + v
+        merged["boundaries"].extend(info["boundaries"])
+        merged["kernel_tiers"].extend(info["kernel_tiers"])
+        merged["per_shard"]["fragments"].update(
+            info["per_shard"]["fragments"])
+        p = info["per_shard"]["peak_live_bytes"]
+        peak = list(p) if peak is None else [max(a, b)
+                                            for a, b in zip(peak, p)]
+        for k, (s, e) in (info.get("build_spans") or {}).items():
+            cur = spans.get(k)
+            spans[k] = (s, e) if cur is None else (min(cur[0], s),
+                                                  max(cur[1], e))
+    merged["per_shard"]["peak_live_bytes"] = peak or []
+    merged["build_spans"] = spans
+    return merged
+
+
 class _MeshProgram:
-    """One capacity-bucket attempt: host scan prep + traced lowering."""
+    """One capacity-bucket attempt: host scan prep + traced lowering.
+
+    ``root_fid``/``ckpt`` carve one CHECKPOINT GROUP out of the DAG:
+    the program lowers only the subtree reachable from ``root_fid``,
+    replacing every checkpointed producer fragment in ``ckpt`` (fid ->
+    host Batch of that fragment's global output rows) with a sharded
+    host feed staged exactly like a base-table scan.  Defaults lower
+    the whole DAG from the plan root — byte-identical to PR 11."""
 
     def __init__(self, runner: MeshQueryRunner, dplan, cap_scale: int,
-                 prepared: Optional["_MeshProgram"] = None):
+                 prepared: Optional["_MeshProgram"] = None,
+                 root_fid: Optional[int] = None,
+                 ckpt: Optional[Dict[int, Batch]] = None):
         self.runner = runner
         self.dplan = dplan
         self.cap_scale = cap_scale
         self.nparts = runner.nparts
         self.config = runner.config
+        self.root_fid = (dplan.root_fragment_id if root_fid is None
+                         else root_fid)
+        self.ckpt = ckpt if ckpt is not None else {}
+        # fragments THIS program actually lowered (trace-time), the
+        # per-program never-re-lowered accounting
+        self.lowered_fids: List[int] = []
+        self._root_replicated = False
         self._jitted = None
         self._args = None
         # trace-time observability, kept across cached re-runs: one
@@ -335,22 +486,102 @@ class _MeshProgram:
         if prepared is not None:
             # overflow retry: only capacities change — reuse the loaded,
             # sharded scan inputs instead of re-reading every base table
+            # (and the staged checkpoint feeds alongside them)
             self.inputs = prepared.inputs
             self.scan_meta = prepared.scan_meta
+            self.ckpt_meta = prepared.ckpt_meta
         else:
             self.inputs: List[np.ndarray] = []
             self.scan_meta: Dict[int, dict] = {}
+            self.ckpt_meta: Dict[int, dict] = {}
             self._prepare_scans()
 
     # ---------------- host phase ----------------
     def _prepare_scans(self) -> None:
-        for frag in self.dplan.fragments:
+        if self.root_fid == self.dplan.root_fragment_id \
+                and not self.ckpt:
+            frags = list(self.dplan.fragments)
+        else:
+            # checkpoint group: stage scans only for the fragments this
+            # group lowers, and a host feed per checkpointed producer
+            needed, feeds = self._needed_fragments()
+            frags = [self.dplan.fragments[f] for f in needed]
+            for fid in sorted(feeds):
+                self._prepare_checkpoint_feed(fid, self.ckpt[fid])
+        for frag in frags:
             stack = [frag.root]
             while stack:
                 node = stack.pop()
                 if isinstance(node, TableScanNode):
                     self._prepare_scan(node, frag)
                 stack.extend(node.sources)
+
+    def _needed_fragments(self) -> Tuple[List[int], List[int]]:
+        """Fragment ids this group lowers (reachable from ``root_fid``
+        WITHOUT descending through checkpointed producers) and the
+        checkpointed fragment ids it consumes as host feeds."""
+        needed: List[int] = []
+        feeds: List[int] = []
+        stack = [self.root_fid]
+        seen = set()
+        while stack:
+            fid = stack.pop()
+            if fid in seen:
+                continue
+            seen.add(fid)
+            if fid != self.root_fid and fid in self.ckpt:
+                feeds.append(fid)
+                continue
+            needed.append(fid)
+            nstack = [self.dplan.fragments[fid].root]
+            while nstack:
+                node = nstack.pop()
+                fids = getattr(node, "fragment_ids", None)
+                if fids:
+                    stack.extend(fids)
+                nstack.extend(node.sources)
+        return needed, feeds
+
+    def _prepare_checkpoint_feed(self, fid: int, batch: Batch) -> None:
+        """Stage a checkpointed fragment's GLOBAL output rows as sharded
+        program inputs, exactly like a base-table scan: contiguous
+        split across shards into padded [P, cap] grids.  The consumer's
+        boundary collective rehashes/gathers the feed, so the
+        contiguous placement is semantically neutral — the checkpoint
+        captured the fragment root's output BEFORE the exchange."""
+        P = self.nparts
+        b = batch.to_numpy()
+        n = b.num_rows
+        base, rem = divmod(n, P)
+        counts = np.asarray([base + (i < rem) for i in range(P)],
+                            np.int64)
+        cap = next_bucket(int(counts.max()), minimum=8)
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        slots = []
+        col_meta = []
+        for col in b.columns:
+            vals = np.asarray(col.values)[:n]
+            g = np.zeros((P, cap), vals.dtype)
+            for i in range(P):
+                g[i, : counts[i]] = vals[offsets[i]:offsets[i + 1]]
+            vslot = len(self.inputs)
+            self.inputs.append(g.reshape(P * cap))
+            gslot = None
+            if col.valid is not None:
+                va = np.asarray(col.valid)[:n]
+                gv = np.zeros((P, cap), bool)
+                for i in range(P):
+                    gv[i, : counts[i]] = va[offsets[i]:offsets[i + 1]]
+                gslot = len(self.inputs)
+                self.inputs.append(gv.reshape(P * cap))
+            slots.append((vslot, gslot))
+            col_meta.append((col.type, col.dictionary))
+        cslot = len(self.inputs)
+        self.inputs.append(counts)
+        self.ckpt_meta[fid] = {
+            "slots": slots, "counts": cslot, "cap": cap, "total": n,
+            "meta": col_meta,
+        }
 
     def _prepare_scan(self, node: TableScanNode, frag) -> None:
         P = self.nparts
@@ -415,7 +646,7 @@ class _MeshProgram:
 
         from presto_tpu.parallel.mesh import AXIS, row_sharding
 
-        root_frag = self.dplan.fragments[self.dplan.root_fragment_id]
+        root_frag = self.dplan.fragments[self.root_fid]
         ncols = len(root_frag.root.columns)
         if self._jitted is None:
             # _out_meta/_flag_labels are trace-time side effects; cached
@@ -436,7 +667,8 @@ class _MeshProgram:
             # output — the program's own StageStats feed
             self._shard_stats: List[Tuple[tuple, object]] = []
             self._peak_live = jnp.zeros((), jnp.int64)
-            table = self._lower_fragment(self.dplan.root_fragment_id)
+            table = self._lower_fragment(self.root_fid)
+            self._root_replicated = table.replicated
             self._out_meta = [(c.type, c.dictionary) for c in table.cols]
             outs = []
             for c in table.cols:
@@ -514,6 +746,14 @@ class _MeshProgram:
         self._read_shard_stats(out[-1])
         live_g = np.asarray(out[-5])
         cap = live_g.shape[0] // self.nparts
+        if self.root_fid != self.dplan.root_fragment_id \
+                and not self._root_replicated:
+            # checkpoint-group readback of a DISTRIBUTED root: the
+            # boundary checkpoint is the fragment's GLOBAL live multiset
+            # (pre-exchange), so concatenate every shard's live rows.
+            # The plan root stays on the shard-0 fast path below — a
+            # 'single'-partitioned root gathers to shard 0 in-program.
+            return self._gather_all_shards(out, live_g, cap), False
         live = live_g[:cap]
         n_live = int(live.sum())
         ncols = len(self._out_meta)
@@ -530,6 +770,27 @@ class _MeshProgram:
             cols.append(Column(typ, vals,
                                None if valid.all() else valid, d))
         return Batch(tuple(cols), n_live), False
+
+    def _gather_all_shards(self, out, live_g: np.ndarray,
+                           cap: int) -> Batch:
+        """Host-side concat of every shard's live rows, shard order —
+        the checkpoint capture path.  Plain O(cap) transfers: checkpoint
+        groups are dispatched once per boundary, not per repeat query,
+        so the slicer machinery is not worth specializing here."""
+        P = self.nparts
+        live_pg = live_g.reshape(P, cap).astype(bool)
+        n_live = int(live_pg.sum())
+        cols = []
+        for i, (typ, d) in enumerate(self._out_meta):
+            vals_g = np.asarray(out[2 * i]).reshape(P, cap)
+            valid_g = np.asarray(out[2 * i + 1]).reshape(P, cap)
+            vals = np.concatenate([vals_g[p][live_pg[p]]
+                                   for p in range(P)])
+            valid = np.concatenate([valid_g[p][live_pg[p]]
+                                    for p in range(P)])
+            cols.append(Column(typ, vals,
+                               None if valid.all() else valid, d))
+        return Batch(tuple(cols), n_live)
 
     def _sliced_content(self, out, cap: int, bucket: int, ncols: int):
         """Device-side stable compaction of live rows + slice to the
@@ -629,6 +890,14 @@ class _MeshProgram:
     def _lower_fragment(self, fid: int) -> MTable:
         if fid in self._cache:
             return self._cache[fid]
+        if fid != self.root_fid and fid in self.ckpt:
+            table = self._ckpt_table(fid)
+            self._cache[fid] = table
+            return table
+        global FRAGMENTS_LOWERED
+        FRAGMENTS_LOWERED += 1
+        if fid not in self.lowered_fids:
+            self.lowered_fids.append(fid)
         frag = self.dplan.fragments[fid]
         prev = getattr(self, "_cur_part", None)
         prev_fid = getattr(self, "_cur_fid", None)
@@ -913,6 +1182,26 @@ class _MeshProgram:
             out_cols.append(MCol(vals, ok, rt, d))
         return MTable(out_cols, live, cap, table.est, compacted=True,
                       replicated=table.replicated)
+
+    def _ckpt_table(self, fid: int) -> MTable:
+        """A checkpointed fragment as a shard-local table: the staged
+        host feed read back through the traced inputs, mirroring
+        ``_lower_scan`` (``counts[0]`` inside shard_map is the LOCAL
+        shard's count).  NOT replicated — the feed is one global copy
+        split across shards, so the consumer's collective applies."""
+        import jax.numpy as jnp
+
+        meta = self.ckpt_meta[fid]
+        cap = meta["cap"]
+        counts = self._traced[meta["counts"]]
+        cols = []
+        for (vslot, gslot), (typ, d) in zip(meta["slots"], meta["meta"]):
+            cols.append(MCol(self._traced[vslot],
+                             self._traced[gslot] if gslot is not None
+                             else None, typ, d))
+        self.kernel_tiers.append((f"f{fid}", "ckpt_feed"))
+        live = jnp.arange(cap) < counts[0]
+        return MTable(cols, live, cap, meta["total"], compacted=True)
 
     def _lower_scan(self, node: TableScanNode) -> MTable:
         import jax.numpy as jnp

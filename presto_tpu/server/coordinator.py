@@ -195,6 +195,21 @@ class _CoordinatorKilled(Exception):
     tasks producing into the spool for the standby to adopt."""
 
 
+class _DeviceDegradeToHttp(Exception):
+    """Device-plane resume gave up (mesh_resume_mode='http', or the
+    device resume budget is spent): degrade to the HTTP plane,
+    scheduling ONLY the fragments whose checkpoints are not
+    spool-complete — completed fragments become spool:// leaf inputs
+    with zero re-execution."""
+
+    def __init__(self, reason: str, failed_fragment: int,
+                 resumed_from: List[int]):
+        super().__init__(reason)
+        self.reason = reason
+        self.failed_fragment = failed_fragment
+        self.resumed_from = list(resumed_from)
+
+
 class QueryExecution:
     """One query's lifecycle (QueryStateMachine + SqlQueryExecution)."""
 
@@ -354,6 +369,15 @@ class QueryExecution:
         self._plan_epochs_cache: Optional[Dict] = None
         self.adopted = False
         self.adopt_outcome: Optional[str] = None
+        # -- device-plane boundary checkpoints (mesh_checkpoint_boundaries)
+        # fid (str) -> {task_id, n_out, rows, bytes}: checkpoints this
+        # query spooled (or adopted from the journal); device_resumes is
+        # the /v1/query-visible resume log; _device_completed marks
+        # spool-complete checkpointed fragments for the HTTP-degrade
+        # scheduler (fid -> checkpoint task id)
+        self._device_ckpts: Dict[str, Dict] = {}
+        self.device_resumes: List[Dict] = []
+        self._device_completed: Dict[int, str] = {}
         self.co.event_bus.query_created(ev.QueryCreatedEvent(
             self.query_id, self.user, self.sql, self.create_time,
             trace_token=self.trace_token))
@@ -430,6 +454,11 @@ class QueryExecution:
             prepared=dict(self.prepared), trace_token=self.trace_token,
             plan_key_sql=self._plan_key_sql, state=state,
             error=self.error, create_time=self.create_time)
+        # device-plane checkpoints: journaled as soon as they exist so a
+        # standby (or the device resume path) can adopt mid-program
+        # progress even though no HTTP tasks were ever scheduled
+        if self._device_ckpts:
+            j.device_checkpoints = dict(self._device_ckpts)
         if self._dplan is not None and self._tasks_scheduled:
             if self._dplan_json is None:
                 self._dplan_json = dplan_to_json(self._dplan)
@@ -910,9 +939,28 @@ class QueryExecution:
                            if collector is not None
                            else contextlib.nullcontext())
                     with ctx:
-                        result = runner.execute_dplan(dplan, key)
+                        if cfg.mesh_checkpoint_boundaries:
+                            result = self._run_mesh_checkpointed(
+                                runner, dplan, key, cfg, nparts)
+                        else:
+                            result = runner.execute_dplan(dplan, key)
                     info = dict(runner.last_run_info)
                 exec_t1 = ev.now()
+        except _DeviceDegradeToHttp as e:
+            # resume budget spent (or mesh_resume_mode='http'): degrade
+            # to the task-scheduled plane.  _schedule consults
+            # _device_completed and serves every spool-complete
+            # checkpointed fragment as a spool:// leaf — only the
+            # REMAINING fragments get tasks
+            self.co.log(f"device-exchange degrading to http after "
+                        f"checkpoint f{e.failed_fragment}: {e.reason}")
+            self._note_device_resume("http", e.failed_fragment,
+                                     e.resumed_from, e.reason)
+            self._device_completed = {
+                int(fid): rec["task_id"]
+                for fid, rec in self._device_ckpts.items()}
+            return fallback(f"device resume degraded to http: "
+                            f"{e.reason}", "resume_degraded")
         except (MeshUnsupported, NotImplementedError) as e:
             # deterministic per plan (capacity non-convergence exhausts
             # every bucket scale; unsupported primitives never lower):
@@ -920,10 +968,11 @@ class QueryExecution:
             # the device attempt entirely on every repeat
             dplan._device_fallback = (f"mesh: {e}", "unsupported_shape")
             return fallback(f"mesh: {e}", "unsupported_shape")
-        except ValueError:
+        except (ValueError, _CoordinatorKilled):
             # query-semantic errors surfaced during mesh execution
             # ("scalar subquery returned more than one row") are the
-            # user's answer, not a lowering failure
+            # user's answer, not a lowering failure; coordinator death
+            # stops the thread with no side effects for the standby
             raise
         except Exception as e:  # noqa: BLE001 - HTTP tier can still run
             self.co.log(f"device-exchange execution failed "
@@ -944,6 +993,20 @@ class QueryExecution:
             "program_cached": bool(info.get("program_cached")),
             "per_shard": info.get("per_shard") or {},
         }
+        # checkpoint-mode accounting: groups run, checkpoints reused,
+        # fragments this execution actually lowered (the
+        # never-re-lowered pin), resumes taken, and spooled bytes
+        for k in ("checkpoint_groups", "checkpoints",
+                  "fragments_lowered"):
+            if k in info:
+                self.device_exchange_info[k] = info[k]
+        if self.device_resumes:
+            self.device_exchange_info["resumes"] = [
+                dict(r) for r in self.device_resumes]
+        if self._device_ckpts:
+            self.device_exchange_info["checkpoint_bytes"] = sum(
+                int(r.get("bytes") or 0)
+                for r in self._device_ckpts.values())
         # "lower"/"compile" span phases, only when THIS run built the
         # program (a cache hit has nothing to attribute)
         for name, window in (info.get("build_spans") or {}).items():
@@ -958,6 +1021,279 @@ class QueryExecution:
             self.column_types = [T.VARCHAR]
             self.result_rows = [(line,) for line in text.splitlines()]
         return True
+
+    # -- device-plane boundary checkpoints (mesh_checkpoint_boundaries) --
+    def _run_mesh_checkpointed(self, runner, dplan: DistributedPlan,
+                               key: str, cfg, nparts: int):
+        """The restartable collective data plane: checkpoint groups run
+        as a sequence of SPMD programs; each boundary's output is
+        write-through spooled + journaled.  A device-plane failure
+        resumes from the last complete boundary — up to
+        ``mesh_resume_limit`` times in 'device' mode (fresh SPMD
+        programs fed from the checkpointed batches), then (or
+        immediately in 'http' mode) degrades to the HTTP plane via
+        ``_DeviceDegradeToHttp``."""
+        from presto_tpu.parallel.sqlmesh import MeshUnsupported
+
+        completed = self._preload_checkpoints(dplan)
+        if completed:
+            # standby adoption / requeue after a coordinator kill: the
+            # journaled checkpoints short-circuit their groups entirely
+            self._note_device_resume(
+                "device", -1, sorted(completed),
+                "adopted checkpoint journal")
+        inj = getattr(self.co, "fault_injector", None)
+        current = {"fid": -1}
+
+        def fault_hook(fid: int) -> None:
+            current["fid"] = fid
+            # a killed coordinator stops between groups with no side
+            # effects: the journal keeps the checkpoints written so far
+            # for the standby to adopt (kill() contract)
+            if getattr(self.co, "killed", False):
+                raise _CoordinatorKilled()
+            if inj is None:
+                return
+            for s in range(nparts):
+                inj.apply_device(f"{self.query_id}/f{fid}/s{s}")
+
+        def on_checkpoint(fid: int, batch) -> None:
+            self._device_checkpoint(dplan, fid, batch)
+
+        resumes = 0
+        while True:
+            try:
+                return runner.execute_dplan_checkpointed(
+                    dplan, key, completed=completed,
+                    on_checkpoint=on_checkpoint, fault_hook=fault_hook)
+            except (MeshUnsupported, NotImplementedError, ValueError,
+                    _CoordinatorKilled):
+                # lowering misses, query-semantic errors and coordinator
+                # death are NOT device faults: the caller's taxonomy
+                # handles them
+                raise
+            except Exception as e:  # noqa: BLE001 - the resume seam
+                reason = f"{type(e).__name__}: {e}"
+                failed = current["fid"]
+                resumed_from = sorted(completed)
+                if cfg.mesh_resume_mode == "device" \
+                        and resumes < max(int(cfg.mesh_resume_limit), 0):
+                    resumes += 1
+                    self.co.log(
+                        f"device-plane failure at f{failed} "
+                        f"({reason}); resuming from checkpoints "
+                        f"{resumed_from} "
+                        f"({resumes}/{cfg.mesh_resume_limit})")
+                    self._note_device_resume("device", failed,
+                                             resumed_from, reason)
+                    continue
+                raise _DeviceDegradeToHttp(reason, failed,
+                                           resumed_from) from e
+
+    def _note_device_resume(self, mode: str, failed_fragment: int,
+                            resumed_from: List[int],
+                            reason: str) -> None:
+        """One resume decision on every surface: the process counter
+        (/metrics), the event stream (query.json), and the per-query
+        log served on /v1/query/{id} as ``deviceResumes``."""
+        self.co.count_device_resume(mode)
+        self.device_resumes.append({
+            "mode": mode, "failed_fragment": failed_fragment,
+            "resumed_from": list(resumed_from),
+            "reason": reason[:200]})
+        self.co.event_bus.device_resume(ev.DeviceResumeEvent(
+            self.query_id, self.trace_token, mode, failed_fragment,
+            tuple(resumed_from), reason[:200], ev.now()))
+
+    def _device_checkpoint(self, dplan: DistributedPlan, fid: int,
+                           batch) -> None:
+        """Write-through one boundary checkpoint: the fragment's GLOBAL
+        output rows, partitioned exactly like the HTTP plane's
+        PartitionedOutput sink (same hash kernel, same LZ4 wire frame),
+        spooled under this query's id — the spool contract, terminal
+        GC, and the spool:// remote-source path apply unchanged — then
+        journaled so a standby can adopt mid-program progress.
+        Best-effort: a spool problem only costs restartability."""
+        spool = getattr(self.co, "spool", None)
+        if spool is None:
+            return
+        frag = dplan.fragments[fid]
+        cons_fid = None
+        for f in dplan.fragments:
+            if fid in f.consumed_fragments:
+                cons_fid = f.fragment_id
+                break
+        workers = self.co.nodes.alive_nodes()
+        n_out = (self._task_count(dplan.fragments[cons_fid],
+                                  max(len(workers), 1))
+                 if cons_fid is not None else 1)
+        # 'ckpt{fid}' keeps checkpoint task ids disjoint from the HTTP
+        # plane's '{qid}.{fid}.{i}' ids while query_id_of still maps
+        # them to this query (terminal spool GC reaps them together)
+        tid = f"{self.query_id}.ckpt{fid}.0"
+        try:
+            batch = self._merge_sorted_checkpoint(dplan, fid, batch)
+            parts = self._partition_checkpoint(batch, frag, n_out)
+            total = 0
+            for p in range(n_out):
+                pages = parts.get(p) or []
+                for tok, page in enumerate(pages):
+                    spool.write_page(tid, p, tok, page)
+                    total += len(page)
+                spool.set_complete(tid, p, len(pages))
+        except Exception:  # noqa: BLE001 - checkpointing is best-effort
+            return
+        self.co.count_device_checkpoint_bytes(total)
+        self._device_ckpts[str(fid)] = {
+            "task_id": tid, "n_out": n_out,
+            "rows": int(batch.num_rows), "bytes": total,
+            "kind": frag.output_partitioning[0]}
+        self._journal()
+
+    def _merge_sorted_checkpoint(self, dplan: DistributedPlan, fid: int,
+                                 batch):
+        """A consumer that k-way merges (RemoteMergeNode — ORDER BY /
+        distributed TopN) requires every producer STREAM pre-sorted;
+        the checkpoint concatenates per-shard runs, so re-sort the
+        global batch by the merge keys before spooling — one fully
+        sorted stream is a valid 1-way merge input.  Other consumers
+        see a plain multiset and need no order."""
+        from presto_tpu.sql.plan import RemoteMergeNode
+
+        merge = None
+        for f in dplan.fragments:
+            if fid not in f.consumed_fragments:
+                continue
+            stack = [f.root]
+            while stack and merge is None:
+                n = stack.pop()
+                if isinstance(n, RemoteMergeNode) \
+                        and fid in n.fragment_ids:
+                    merge = n
+                    break
+                stack.extend(n.sources)
+            break
+        if merge is None or not merge.sort_keys or not batch.num_rows:
+            return batch
+        import jax.numpy as jnp
+
+        from presto_tpu.ops.sort import sort_permutation
+
+        b = batch.compact()
+        keys = []
+        for ch, asc, nulls_first in merge.sort_keys:
+            c = b.columns[ch]
+            vals, typ = c.values, c.type
+            if c.dictionary is not None:
+                # strings order by lexicographic rank over the
+                # dictionary, never by code (exec/sortop.py contract)
+                ranks = c.dictionary.sort_ranks()
+                vals = jnp.asarray(ranks)[vals]
+                typ = T.INTEGER
+            keys.append((vals, c.valid, typ, not asc,
+                         bool(nulls_first)))
+        perm = sort_permutation(keys, jnp.asarray(b.num_rows))
+        return b.take(perm)
+
+    def _partition_checkpoint(self, batch, frag,
+                              n_out: int) -> Dict[int, List[bytes]]:
+        """Partition a checkpoint batch for its consumer's fan-out,
+        mirroring PartitionedOutputOperator: hash output routes by the
+        shared value-hash kernel (co-partitioning with every other
+        producer), broadcast copies the whole batch per partition,
+        anything else lands in partition 0 (valid for 'single' and
+        'arbitrary' — consumers merge partitions without key
+        semantics)."""
+        from presto_tpu.serde import serialize_batch
+
+        kind, channels = frag.output_partitioning
+        if n_out == 1 or kind not in ("hash", "broadcast"):
+            return {0: [serialize_batch(batch)]}
+        if kind == "broadcast":
+            page = serialize_batch(batch)
+            return {p: [page] for p in range(n_out)}
+        import jax.numpy as jnp
+        import numpy as np
+
+        from presto_tpu.ops.hashing import (
+            partition_of, row_hash, value_hash_triple,
+        )
+
+        batch = batch.compact()
+        key_cols = [value_hash_triple(batch.columns[c])
+                    for c in channels]
+        hashes = row_hash(key_cols)
+        parts = np.asarray(partition_of(hashes, n_out))
+        order = np.argsort(parts, kind="stable")
+        bounds = np.searchsorted(parts[order], np.arange(n_out + 1))
+        out: Dict[int, List[bytes]] = {}
+        for p in range(n_out):
+            lo, hi = int(bounds[p]), int(bounds[p + 1])
+            if lo == hi:
+                continue
+            sub = batch.take(jnp.asarray(order[lo:hi]))
+            out[p] = [serialize_batch(sub)]
+        return out
+
+    def _preload_checkpoints(self, dplan: DistributedPlan) -> Dict:
+        """Recover this query id's completed boundary checkpoints: the
+        in-memory record first (same-execution device resume keeps the
+        batches live anyway), else the durable journal (standby
+        adoption / requeue after a coordinator kill).  Every record is
+        verified spool-complete before its pages are deserialized back
+        into the fragment's global output batch — an unverifiable
+        checkpoint is simply re-run."""
+        from presto_tpu.batch import batch_from_pylist, concat_batches
+        from presto_tpu.server import resultcache
+
+        recs = dict(self._device_ckpts)
+        if not recs:
+            store = getattr(self.co, "statestore", None)
+            if store is not None:
+                try:
+                    j = store.read(self.query_id)
+                    if j is not None:
+                        recs = dict(j.device_checkpoints)
+                except Exception:  # noqa: BLE001 - journal best-effort
+                    recs = {}
+        completed: Dict[int, object] = {}
+        spool = getattr(self.co, "spool", None)
+        if spool is None or not recs:
+            return completed
+        frag_by_id = {f.fragment_id: f for f in dplan.fragments}
+        for fid_s, rec in recs.items():
+            fid = int(fid_s)
+            frag = frag_by_id.get(fid)
+            tid = rec.get("task_id")
+            n_out = int(rec.get("n_out") or 0)
+            if frag is None or not tid or n_out <= 0 \
+                    or fid == dplan.root_fragment_id:
+                continue
+            try:
+                if not spool.is_complete(tid, n_out):
+                    continue
+                # broadcast checkpoints hold the FULL batch in every
+                # partition — read one copy; everything else unions
+                read_n = 1 if rec.get("kind") == "broadcast" else n_out
+                batches = []
+                for p in range(read_n):
+                    pages = resultcache.read_complete_stream(
+                        spool, tid, p, max_bytes=1 << 31)
+                    if pages is None:
+                        raise ValueError("incomplete stream")
+                    batches.extend(deserialize_batch(pg)
+                                   for pg in pages)
+            except Exception:  # noqa: BLE001 - re-run beats bad state
+                continue
+            if batches:
+                b = (concat_batches(batches) if len(batches) > 1
+                     else batches[0])
+            else:
+                b = batch_from_pylist(
+                    [t for _, t in frag.root.columns], [])
+            completed[fid] = b
+            self._device_ckpts[str(fid)] = dict(rec)
+        return completed
 
     def _fold_device_stats(self, dplan: DistributedPlan, info: Dict,
                            window: Tuple[float, float]) -> None:
@@ -1187,6 +1523,7 @@ class QueryExecution:
                 f"rows, output {sum(fr.get('output_rows') or [0])} rows, "
                 f"exchanged {sum(bytes_by_frag.get(fid, [0]))} bytes")
         lines.extend(self._boundary_footer(dplan, boundaries))
+        lines.extend(self._device_resume_footer())
         peak = max([int(v) for v in per.get("peak_live_bytes") or []]
                    or [0])
         compile_ns = int(info.get("compile_ns") or 0)
@@ -1212,6 +1549,30 @@ class QueryExecution:
             f"execution {qs.get('execution_s', 0.0):.3f} s"
             + (", plan cache hit" if self.plan_cached else ""))
         return "\n".join(lines)
+
+    def _device_resume_footer(self) -> List[str]:
+        """Checkpoint/resume lines shared by BOTH EXPLAIN ANALYZE
+        footers (device and HTTP-degraded renders), next to the
+        exchange-boundary lines: boundaries checkpointed + bytes
+        spooled, and one line per resume decision."""
+        lines: List[str] = []
+        if self._device_ckpts:
+            total = sum(int(r.get("bytes") or 0)
+                        for r in self._device_ckpts.values())
+            fids = sorted(int(f) for f in self._device_ckpts)
+            lines.append(
+                f"device checkpoints: {len(fids)} boundaries "
+                f"({', '.join(f'f{f}' for f in fids)}), "
+                f"{total} bytes spooled")
+        for r in self.device_resumes:
+            frm = ", ".join(f"f{f}" for f in r.get("resumed_from", []))
+            failed = r.get("failed_fragment", -1)
+            lines.append(
+                f"device resume ({r.get('mode')}): "
+                + (f"failed f{failed}, " if failed >= 0 else "")
+                + f"resumed from [{frm or 'none'}] — "
+                f"{r.get('reason', '')}")
+        return lines
 
     # -- cross-query result cache (server/resultcache.py) ---------------
     def _result_cache_key(self, key_sql: str):
@@ -1936,6 +2297,7 @@ class QueryExecution:
                     f"{st['exchange_consumed']}c/"
                     f"{st['exchange_purged']}p")
         lines.extend(self._boundary_footer(dplan))
+        lines.extend(self._device_resume_footer())
         lines.extend(_hot_operator_lines(hot))
         qs = self.query_stats
         if qs:
@@ -2040,9 +2402,26 @@ class QueryExecution:
         self._dplan = dplan
         self._consumers = consumers
 
+        # HTTP degrade of a checkpointed mesh query: every
+        # spool-complete checkpointed fragment becomes a spool:// leaf
+        # (zero re-execution), and nothing beneath it is scheduled
+        ckpt_leaves, ckpt_shadowed = self._degrade_schedule_skips(
+            dplan, counts, consumers)
         # producers first (fragments list is already topological)
         task_uris: Dict[int, List[str]] = {}
         for frag in dplan.fragments:
+            if frag.fragment_id in ckpt_shadowed:
+                task_uris[frag.fragment_id] = []
+                continue
+            if frag.fragment_id in ckpt_leaves:
+                from presto_tpu.server.spool import spool_location
+
+                tid = self._device_completed[frag.fragment_id]
+                uris = [spool_location(tid)]
+                task_uris[frag.fragment_id] = uris
+                self._frag_tasks[frag.fragment_id] = [tid]
+                self._task_uris[frag.fragment_id] = uris
+                continue
             n_tasks = counts[frag.fragment_id]
             cons_fid = consumers.get(frag.fragment_id)
             if cons_fid is None:
@@ -2116,6 +2495,44 @@ class QueryExecution:
         # placements + attempts) so a standby can adopt mid-flight
         self._journal_transition("RUNNING")
         return roots
+
+    def _degrade_schedule_skips(self, dplan: DistributedPlan,
+                                counts: Dict[int, int],
+                                consumers: Dict[int, int]
+                                ) -> Tuple[set, set]:
+        """(spool-leaf fids, shadowed fids) for the HTTP-degrade
+        scheduler.  A checkpointed fragment qualifies as a leaf only
+        when its spooled partition fan-out matches what THIS schedule
+        would give its consumer (worker count may have changed since
+        the checkpoint) and the spool verifies complete; its entire
+        producer subtree is then shadowed — not scheduled at all."""
+        if not self._device_completed:
+            return set(), set()
+        frag_by_id = {f.fragment_id: f for f in dplan.fragments}
+        leaves: set = set()
+        for fid, tid in self._device_completed.items():
+            if fid == dplan.root_fragment_id or fid not in frag_by_id:
+                continue
+            rec = self._device_ckpts.get(str(fid)) or {}
+            cons = consumers.get(fid)
+            n_out = counts[cons] if cons is not None else 1
+            if int(rec.get("n_out") or -1) != n_out:
+                continue
+            try:
+                if not self.co.spool.is_complete(tid, n_out):
+                    continue
+            except Exception:  # noqa: BLE001 - schedule normally
+                continue
+            leaves.add(fid)
+        shadowed: set = set()
+        stack = list(leaves)
+        while stack:
+            fid = stack.pop()
+            for p in frag_by_id[fid].consumed_fragments:
+                if p not in shadowed and p not in leaves:
+                    shadowed.add(p)
+                    stack.append(p)
+        return leaves, shadowed
 
     # -- mid-query task recovery ----------------------------------------
     def _start_recovery_monitor(self) -> None:
@@ -3866,6 +4283,9 @@ class CoordinatorServer:
         from presto_tpu.server.spool import make_spool_store
 
         self.spool = make_spool_store(config, injector=fault_injector)
+        # kept for the device-plane chaos seam: checkpoint groups
+        # consult apply_device before dispatch (faults.add_device_rule)
+        self.fault_injector = fault_injector
         # -- coordinator HA (server/statestore.py) -------------------------
         # ``standby_of`` names the active coordinator this node shadows:
         # a standby journals nothing, sweeps nothing, and serves no
@@ -3929,7 +4349,12 @@ class CoordinatorServer:
         # queries served, bytes moved per boundary mode, and fallbacks
         # to the HTTP plane by reason category
         self.device_exchange_counters: Dict = {
-            "queries": 0, "bytes": {}, "fallbacks": {}}
+            "queries": 0, "bytes": {}, "fallbacks": {},
+            # mid-program fault tolerance: resumes by mode
+            # (device re-lower vs http degrade) and boundary-checkpoint
+            # bytes spooled (presto_device_exchange_resume_total /
+            # presto_device_checkpoint_bytes_total)
+            "resumes": {}, "checkpoint_bytes": 0}
         self._dx_lock = threading.Lock()
         # test hook: called (fragment, shard, rows) on EVERY progress
         # beacon (the slow-task-style hold for mid-query progress tests)
@@ -4279,6 +4704,11 @@ class CoordinatorServer:
                         # (or the fallback reason)
                         "exchangeModes": dict(q.exchange_modes),
                         "deviceExchange": dict(q.device_exchange_info),
+                        # mid-program fault tolerance: boundary
+                        # checkpoints spooled and resume decisions
+                        "deviceCheckpoints": dict(q._device_ckpts),
+                        "deviceResumes": [dict(r)
+                                          for r in q.device_resumes],
                         # live progress + time-series depth (the web UI
                         # detail page shows mid-query movement)
                         "progress": dict(q._progress),
@@ -4349,6 +4779,7 @@ class CoordinatorServer:
                         self.log("coordinator lease superseded; "
                                  "standing down")
                         self.is_active = False
+                    self._journal_gc_tick()
                     continue
                 lease = self.statestore.read_lease()
                 gen = self.statestore.try_claim_lease(self._owner_id,
@@ -4392,7 +4823,8 @@ class CoordinatorServer:
                     journal.sql, user=journal.user, query_id=qid,
                     session_properties=journal.session_properties,
                     catalog=journal.catalog, prepared=journal.prepared,
-                    trace_token=journal.trace_token)
+                    trace_token=journal.trace_token,
+                    device_checkpoints=journal.device_checkpoints)
                 self.count_adopted("requeued")
                 self.event_bus.query_adopted(ev.QueryAdoptedEvent(
                     qid, journal.trace_token, journal.state, "requeued",
@@ -4408,6 +4840,31 @@ class CoordinatorServer:
         with self._ha_lock:
             a = self.ha_counters["adopted"]
             a[outcome] = a.get(outcome, 0) + 1
+
+    def _journal_gc_tick(self) -> None:
+        """Journal GC, ridden on the active coordinator's lease
+        heartbeat: TERMINAL ``queries/{id}`` entries older than the
+        retention window — or beyond the retention count — are reaped;
+        in-flight entries are never touched (a standby must always be
+        able to adopt them).  Runs at most once per retention_s/4."""
+        cfg = self.config
+        retention = float(
+            getattr(cfg, "coordinator_journal_retention_s", 0) or 0)
+        if retention <= 0 or self.statestore is None:
+            return
+        now = time.monotonic()
+        nxt = getattr(self, "_next_journal_gc", 0.0)
+        if now < nxt:
+            return
+        self._next_journal_gc = now + max(retention / 4.0, 0.05)
+        try:
+            deleted = self.statestore.gc_terminal(
+                retention, int(cfg.coordinator_journal_retention_count))
+            if deleted:
+                self.log(f"journal GC reaped {len(deleted)} terminal "
+                         f"entries")
+        except Exception:  # noqa: BLE001 - GC is best-effort
+            pass
 
     def count_device_fallback(self, kind: str) -> None:
         """One query fell back from the collective tier to the HTTP
@@ -4426,6 +4883,19 @@ class CoordinatorServer:
                 kind = b.get("kind", "?")
                 by_mode[kind] = by_mode.get(kind, 0) + \
                     sum(int(v) for v in b.get("bytes", []))
+
+    def count_device_resume(self, mode: str) -> None:
+        """One mid-program resume decision on the collective tier:
+        'device' (re-lowered remaining checkpoint groups) or 'http'
+        (degraded to the task-scheduled plane)."""
+        with self._dx_lock:
+            rs = self.device_exchange_counters["resumes"]
+            rs[mode] = rs.get(mode, 0) + 1
+
+    def count_device_checkpoint_bytes(self, n: int) -> None:
+        """Boundary-checkpoint wire bytes write-through spooled."""
+        with self._dx_lock:
+            self.device_exchange_counters["checkpoint_bytes"] += int(n)
 
     def mesh_executor(self, cfg, nparts: int):
         """The shared mesh runner for one (shard count, lowering knobs)

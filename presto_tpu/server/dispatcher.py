@@ -184,15 +184,22 @@ class DispatchManager:
                catalog: Optional[str] = None,
                prepared: Optional[Dict[str, str]] = None,
                trace_token: Optional[str] = None,
-               query_id: Optional[str] = None) -> DispatchQuery:
+               query_id: Optional[str] = None,
+               device_checkpoints=None) -> DispatchQuery:
         """``query_id`` is supplied by coordinator-HA adoption (a
         re-queued journaled query keeps its id so client polls find
-        it); fresh submissions generate one."""
+        it); fresh submissions generate one.  ``device_checkpoints``
+        carries the dead primary's journaled boundary checkpoints into
+        the requeued execution BEFORE the QUEUED journal write-through,
+        so re-admission never wipes mid-program mesh progress."""
         qid = query_id or uuid.uuid4().hex[:16]
         q = DispatchQuery(qid, sql, self.co, user=user,
                           session_properties=session_properties,
                           catalog=catalog, prepared=prepared,
                           trace_token=trace_token)
+        if device_checkpoints:
+            q._device_ckpts.update(
+                {str(k): dict(v) for k, v in device_checkpoints.items()})
         self.co.queries[qid] = q
         # durable journal write-through at QUEUED (server/statestore.py)
         q._journal("QUEUED")

@@ -46,6 +46,21 @@ SLOW_TASK = "slow-task"
 #: survive (or fall back from, to PR 5 cascading retry)
 SPOOL_READ_ERROR = "spool-read-error"
 SPOOL_MISSING = "spool-missing"
+#: device-plane fault policies (parallel checkpoint groups consult
+#: ``apply_device`` before dispatching each group's SPMD program; keys
+#: are ``{query_id}/f{fragment}/s{shard}``): a group fails with a
+#: generic execution error, fails with an XLA-style RESOURCE_EXHAUSTED
+#: message (the HBM-overflow shape), or is delayed before dispatch —
+#: the mid-program chaos the boundary-checkpoint resume path must
+#: survive with zero re-execution of checkpointed fragments
+DEVICE_FAIL = "device-fail"
+DEVICE_RESOURCE_EXHAUSTED = "device-resource-exhausted"
+
+
+class InjectedDeviceFault(RuntimeError):
+    """Simulated device-plane execution failure (a mid-program loss of
+    the collective data plane): distinguishable from query-semantic
+    errors, so the coordinator's resume path engages."""
 
 
 def kill_coordinator(coordinator) -> None:
@@ -64,7 +79,8 @@ class FaultRule:
                  times: Optional[int] = None, delay_s: float = 0.0,
                  status: int = 503):
         if policy not in (FAIL_N_TIMES, HTTP_503, DROP_CONNECTION, DELAY,
-                          SLOW_TASK, SPOOL_READ_ERROR, SPOOL_MISSING):
+                          SLOW_TASK, SPOOL_READ_ERROR, SPOOL_MISSING,
+                          DEVICE_FAIL, DEVICE_RESOURCE_EXHAUSTED):
             raise ValueError(f"unknown fault policy {policy!r}")
         self.pattern = pattern
         self.regex = re.compile(pattern)
@@ -152,6 +168,24 @@ class FaultInjector:
         return self.add_rule(pattern, method="SPOOL", policy=policy,
                              times=times, delay_s=delay_s)
 
+    def add_device_rule(self, pattern: str, policy: str = DEVICE_FAIL,
+                        *, times: Optional[int] = None,
+                        delay_s: float = 0.0) -> FaultRule:
+        """Device-plane chaos: ``pattern`` matches the checkpoint-group
+        dispatch key (``{query_id}/f{fragment_id}/s{shard}``), policy is
+        one of device-fail (generic execution error, default 1 shot),
+        device-resource-exhausted (the XLA HBM-overflow message shape),
+        or delay (slow dispatch).  Failure policies default to ONE shot
+        (a resume attempt must be able to get past the fault, exactly
+        like fail-n-times); delay fires until removed.  Device rules
+        are keyed method='DEVICE' so they never leak onto HTTP or spool
+        paths."""
+        if times is None and policy in (DEVICE_FAIL,
+                                        DEVICE_RESOURCE_EXHAUSTED):
+            times = 1
+        return self.add_rule(pattern, method="DEVICE", policy=policy,
+                             times=times, delay_s=delay_s)
+
     def release_all(self) -> None:
         with self._lock:
             for rule in self.rules:
@@ -224,6 +258,33 @@ class FaultInjector:
         if hit.policy == SPOOL_MISSING:
             raise FileNotFoundError(f"injected spool-missing on {key}")
         raise OSError(f"injected spool read error on {key}")
+
+    # -- device side ----------------------------------------------------
+    def apply_device(self, key: str) -> None:
+        """Raise (or delay) for a checkpoint-group dispatch touching
+        ``key``.  Only method='DEVICE' rules apply here."""
+        with self._lock:
+            hit = None
+            for rule in self.rules:
+                if rule.method != "DEVICE" or \
+                        rule.regex.search(key) is None:
+                    continue
+                if rule.remaining is not None:
+                    if rule.remaining <= 0:
+                        continue
+                    rule.remaining -= 1
+                self.injections.append((key, "DEVICE", rule.policy))
+                hit = rule
+                break
+        if hit is None:
+            return
+        if hit.policy == DELAY:
+            self.sleeper(hit.delay_s)
+            return
+        if hit.policy == DEVICE_RESOURCE_EXHAUSTED:
+            raise InjectedDeviceFault(
+                f"RESOURCE_EXHAUSTED: injected device OOM at {key}")
+        raise InjectedDeviceFault(f"injected device failure at {key}")
 
     # -- server side ----------------------------------------------------
     def apply_server(self, path: str, method: str

@@ -219,6 +219,8 @@ def _device_exchange_families(co) -> List[Family]:
         queries = dx.get("queries", 0)
         by_mode = dict(dx.get("bytes", {}))
         fallbacks = dict(dx.get("fallbacks", {}))
+        resumes = dict(dx.get("resumes", {}))
+        ckpt_bytes = dx.get("checkpoint_bytes", 0)
     return [
         ("presto_device_exchange_queries_total", "counter",
          "queries served by the device-sharded exchange tier "
@@ -233,6 +235,16 @@ def _device_exchange_families(co) -> List[Family]:
          "by reason category",
          [({"reason": r}, v) for r, v in sorted(fallbacks.items())]
          or [({"reason": "none"}, 0)]),
+        ("presto_device_exchange_resume_total", "counter",
+         "mid-program resumes from boundary checkpoints, by mode "
+         "(device: remaining groups re-run on the mesh; http: degraded "
+         "to the HTTP plane scheduling only remaining fragments)",
+         [({"mode": m}, v) for m, v in sorted(resumes.items())]
+         or [({"mode": "device"}, 0)]),
+        ("presto_device_checkpoint_bytes_total", "counter",
+         "boundary-checkpoint bytes write-through'd into the spool "
+         "between checkpoint groups (mesh_checkpoint_boundaries)",
+         [({}, ckpt_bytes)]),
     ]
 
 

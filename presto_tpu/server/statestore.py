@@ -106,6 +106,15 @@ class QueryJournal:
     # cross-query result-cache adoption id (server/resultcache.py), when
     # this execution was admitted — a standby can re-serve repeats
     result_cache_task_id: Optional[str] = None
+    # device-plane boundary checkpoints (parallel tier,
+    # mesh_checkpoint_boundaries): fragment id (as str) ->
+    # {task_id, n_out, rows, bytes}; the spooled pages live under
+    # ``task_id`` with the query's own id prefix, so they are adopted
+    # and GC'd exactly like HTTP task output.  A standby (or the
+    # primary after a device fault) resumes the SPMD program from these
+    # instead of re-running completed fragments
+    device_checkpoints: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -154,6 +163,40 @@ class QueryStateStore:
             os.remove(self.api._path(_QUERY_PREFIX + query_id))
         except OSError:
             pass
+
+    def gc_terminal(self, retention_s: float, max_entries: int,
+                    now: Optional[float] = None) -> List[str]:
+        """Journal GC: delete TERMINAL entries (FINISHED/FAILED) older
+        than ``retention_s``, then — oldest first — any beyond
+        ``max_entries`` terminal entries.  In-flight entries are never
+        touched regardless of age: a standby must always be able to
+        adopt them.  Returns the deleted query ids (sorted), for
+        observability and tests."""
+        now = time.time() if now is None else now
+        terminal: List[Tuple[float, str]] = []
+        for qid in self.list_queries():
+            journal = self.read(qid)
+            if journal is None or journal.state not in ("FINISHED",
+                                                        "FAILED"):
+                continue
+            try:
+                mtime = os.path.getmtime(
+                    self.api._path(_QUERY_PREFIX + qid))
+            except OSError:
+                continue
+            terminal.append((mtime, qid))
+        terminal.sort()
+        deleted = []
+        for mtime, qid in terminal:
+            if now - mtime > retention_s:
+                self.delete(qid)
+                deleted.append(qid)
+        kept = [(m, q) for m, q in terminal if q not in deleted]
+        if max_entries >= 0 and len(kept) > max_entries:
+            for _, qid in kept[:len(kept) - max_entries]:
+                self.delete(qid)
+                deleted.append(qid)
+        return sorted(deleted)
 
     # -- lease -----------------------------------------------------------
     def read_lease(self) -> Optional[Dict[str, Any]]:

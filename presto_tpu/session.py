@@ -136,6 +136,10 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "mesh_progress_beacons": (
         "mesh_progress_beacons",
         lambda v: v.lower() in ("true", "1", "on")),
+    "mesh_checkpoint_boundaries": (
+        "mesh_checkpoint_boundaries",
+        lambda v: v.lower() in ("true", "1", "on")),
+    "mesh_resume_mode": ("mesh_resume_mode", str),
     "stats_sampling_enabled": (
         "stats_sampling_enabled",
         lambda v: v.lower() in ("true", "1", "on")),

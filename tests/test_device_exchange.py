@@ -536,3 +536,295 @@ class TestStickyFallback:
         assert fb2["unsupported_shape"] == \
             fb1.get("unsupported_shape", 0) + 1, \
             "the repeat fallback must still be counted"
+
+
+# -- PR 17: boundary checkpoints + partial-state resume ----------------------
+
+_ORACLES = {}
+
+
+def _oracle(sql, scale=0.01):
+    from presto_tpu.localrunner import LocalQueryRunner
+
+    if scale not in _ORACLES:
+        _ORACLES[scale] = LocalQueryRunner.tpch(scale=scale)
+    return _ORACLES[scale].execute(sql).rows
+
+
+def _ckpt_cfg(tmp, **over):
+    return dc.replace(DEV_CFG, mesh_checkpoint_boundaries=True,
+                      exchange_spooling_enabled=True,
+                      exchange_spool_path=str(tmp / "spool"), **over)
+
+
+class TestMeshResume:
+    """PR 17: the collective data plane is restartable at
+    fragment-boundary granularity.
+
+    - clean checkpointed runs hold exact parity and spool every
+      non-root boundary (complete streams, counted bytes);
+    - the kill-every-checkpoint-boundary sweep (TPC-H Q3 and Q9)
+      recovers exact rows at EVERY kill point with zero re-execution of
+      checkpointed fragments: each fragment is lowered exactly once
+      across kill + resume (the FRAGMENTS_LOWERED pin);
+    - mesh_resume_mode='http' degrades to the task-scheduled plane
+      scheduling ONLY the remaining fragments — checkpointed producers
+      serve as spool:// leaf inputs, never as HTTP tasks;
+    - checkpoints off restores the PR 14 all-or-nothing device plane
+      exactly (DEVICE fault rules are dead code, no mid-program seams);
+    - a coordinator KILLED mid-mesh-query hands its checkpoint journal
+      to the standby, which resumes from the adopted boundaries.
+    """
+
+    @pytest.fixture(scope="class")
+    def ckpt(self, tmp_path_factory):
+        from presto_tpu.server.faults import FaultInjector
+
+        inj = FaultInjector()
+        cfg = _ckpt_cfg(tmp_path_factory.mktemp("mesh-ckpt"))
+        with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                         config=cfg,
+                                         coordinator_injector=inj) as dev:
+            yield dev, inj
+
+    # Q9 (the widest DAG) rides the slow tier: the checkpointed mode
+    # compiles every group per execution, so its kill-every-boundary
+    # sweep alone costs ~90s — tier-1 keeps the Q3 sweep
+    Q39 = [3, pytest.param(9, marks=pytest.mark.slow)]
+
+    @pytest.mark.parametrize("qn", Q39)
+    def test_clean_checkpointed_parity(self, ckpt, qn):
+        dev, _inj = ckpt
+        sql = TPCH[qn]
+        want = _oracle(sql)
+        got = dev.execute(sql).rows
+        q = _last_query(dev)
+        assert _close(got, want), f"q{qn} checkpointed rows diverge"
+        assert set(q.exchange_modes) == {"device"}
+        assert not q._tasks_scheduled
+        info = q.device_exchange_info
+        assert info.get("checkpoint_groups", 0) >= 2
+        assert not q.device_resumes
+        # every non-root boundary is spool-complete under the query's
+        # own checkpoint task ids, and the bytes are accounted
+        assert q._device_ckpts
+        for fid, rec in q._device_ckpts.items():
+            assert rec["task_id"].startswith(f"{q.query_id}.ckpt{fid}.")
+            assert dev.coordinator.spool.is_complete(rec["task_id"],
+                                                     rec["n_out"])
+        assert info.get("checkpoint_bytes", 0) > 0
+
+    @pytest.mark.parametrize("qn", Q39)
+    def test_kill_every_boundary_device_resume(self, ckpt, qn):
+        from presto_tpu.parallel import sqlmesh
+
+        dev, inj = ckpt
+        sql = TPCH[qn]
+        want = _oracle(sql)
+        dev.execute(sql)
+        info0 = _last_query(dev).device_exchange_info
+        kill_fids = sorted(info0.get("fragments_lowered") or [])
+        assert len(kill_fids) >= 2, "need a multi-group DAG to sweep"
+        for fid in kill_fids:
+            inj.add_device_rule(rf"/f{fid}/s\d+$")
+            hits0 = len(inj.injections)
+            lowered0 = sqlmesh.FRAGMENTS_LOWERED
+            got = dev.execute(sql).rows
+            q = _last_query(dev)
+            assert _close(got, want), f"kill at f{fid}: rows diverge"
+            assert len(inj.injections) > hits0, \
+                f"kill at f{fid}: fault never fired"
+            assert q.device_resumes, f"kill at f{fid}: no resume"
+            assert q.device_resumes[-1]["mode"] == "device"
+            assert q.device_resumes[-1]["failed_fragment"] == fid
+            assert not q._tasks_scheduled, "resume stayed on the mesh"
+            resumed_from = set(q.device_resumes[-1]["resumed_from"])
+            info = q.device_exchange_info
+            # the zero-re-execution pin: checkpointed fragments are fed
+            # from the spool, never re-lowered into the resumed program
+            assert not resumed_from & set(
+                info.get("fragments_lowered") or []), \
+                f"kill at f{fid}: checkpointed fragments re-lowered"
+            # and across kill + resume, each fragment of the DAG was
+            # lowered exactly once
+            assert sqlmesh.FRAGMENTS_LOWERED - lowered0 == \
+                len(kill_fids), f"kill at f{fid}: re-lowering happened"
+
+    def test_http_degrade_schedules_only_remaining_fragments(
+            self, tmp_path):
+        """mesh_resume_mode='http': every kill point degrades to the
+        HTTP plane with exact rows; fragments with complete checkpoints
+        become spool:// leaf inputs (zero HTTP tasks), only the
+        remaining fragments are scheduled."""
+        from presto_tpu.server.faults import FaultInjector
+
+        inj = FaultInjector()
+        cfg = _ckpt_cfg(tmp_path, mesh_resume_mode="http")
+        sql = TPCH[3]
+        want = _oracle(sql)
+        with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                         config=cfg,
+                                         coordinator_injector=inj) as dev:
+            dev.execute(sql)
+            info0 = _last_query(dev).device_exchange_info
+            kill_fids = sorted(info0.get("fragments_lowered") or [])
+            assert len(kill_fids) >= 2
+            # first (no checkpoints yet), a mid-DAG boundary, and the
+            # root group (the merge-consumer edge case) — the full
+            # every-point http sweep rides tools/chaos_run.py
+            kill_fids = sorted({kill_fids[0],
+                                kill_fids[len(kill_fids) // 2],
+                                kill_fids[-1]})
+            stages_with_leaves = 0
+            for fid in kill_fids:
+                inj.add_device_rule(rf"/f{fid}/s\d+$")
+                got = dev.execute(sql).rows
+                q = _last_query(dev)
+                assert _close(got, want), f"kill at f{fid}: rows diverge"
+                assert q.device_resumes
+                assert q.device_resumes[-1]["mode"] == "http"
+                assert q._tasks_scheduled, "degrade rides the HTTP plane"
+                resumed_from = set(q.device_resumes[-1]["resumed_from"])
+                placed = {f for f, _, _ in q._placements}
+                assert not placed & resumed_from, \
+                    f"kill at f{fid}: checkpointed fragments re-tasked"
+                leaves = {f for f, uris in q._task_uris.items()
+                          if uris and any(str(u).startswith("spool://")
+                                          for u in uris)}
+                assert leaves <= resumed_from
+                if leaves:
+                    stages_with_leaves += 1
+            # late kills must actually serve checkpoints as leaf inputs
+            assert stages_with_leaves >= 1
+
+    def test_checkpoints_off_restores_all_or_nothing(self, tmp_path):
+        """mesh_checkpoint_boundaries=False restores the PR 14 device
+        plane exactly: one SPMD program for the whole DAG, no
+        checkpoint spooling, no resume surfaces — DEVICE fault rules
+        never even fire (there is no mid-program seam to hook)."""
+        from presto_tpu.server.faults import FaultInjector
+
+        inj = FaultInjector()
+        sql = TPCH[3]
+        want = _oracle(sql)
+        with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                         config=DEV_CFG,
+                                         coordinator_injector=inj) as dev:
+            inj.add_device_rule(r"/f\d+/s\d+$")
+            got = dev.execute(sql).rows
+            q = _last_query(dev)
+            assert _close(got, want)
+            assert set(q.exchange_modes) == {"device"}
+            assert not inj.injections, \
+                "checkpoints off: DEVICE rules must be dead code"
+            assert not q.device_resumes
+            assert not q._device_ckpts
+            info = q.device_exchange_info
+            assert "checkpoint_groups" not in info
+            assert "checkpoint_bytes" not in info
+
+    def test_resume_surfaces_land_everywhere(self, ckpt):
+        """One killed boundary, every observability surface: /metrics
+        counters, /v1/query/{id} deviceCheckpoints/deviceResumes, and
+        the EXPLAIN ANALYZE footer."""
+        import json
+        import urllib.request
+
+        dev, inj = ckpt
+        sql = TPCH[3]
+        # the discovery run doubles as the EXPLAIN ANALYZE footer pin
+        analyze = dev.execute(f"explain analyze {sql}").rows
+        text = "\n".join(r[0] for r in analyze)
+        assert "device checkpoints:" in text
+        fids = sorted(
+            _last_query(dev).device_exchange_info["fragments_lowered"])
+        inj.add_device_rule(rf"/f{fids[-1]}/s\d+$")
+        dev.execute(sql)
+        q = _last_query(dev)
+        assert q.device_resumes
+        uri = dev.coordinator.uri
+        with urllib.request.urlopen(f"{uri}/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+        assert 'presto_device_exchange_resume_total{mode="device"}' \
+            in metrics
+        assert "presto_device_checkpoint_bytes_total" in metrics
+        for line in metrics.splitlines():
+            if line.startswith("presto_device_exchange_resume_total"
+                               '{mode="device"}'):
+                assert float(line.rsplit(" ", 1)[1]) >= 1
+        with urllib.request.urlopen(
+                f"{uri}/v1/query/{q.query_id}", timeout=10) as r:
+            detail = json.loads(r.read())
+        assert detail["deviceResumes"]
+        assert detail["deviceResumes"][-1]["mode"] == "device"
+        assert detail["deviceCheckpoints"]
+
+    def test_coordinator_kill_mid_mesh_adopts_checkpoint_journal(
+            self, tmp_path):
+        """The HA shape: kill the PRIMARY mid-checkpoint-sequence (the
+        mesh held by a DEVICE delay rule).  The standby requeues the
+        query seeded with the journaled checkpoints and resumes from
+        the adopted boundaries — exact rows, completed fragments never
+        re-lowered."""
+        import threading
+        import time
+
+        from presto_tpu.server.dqr import HAQueryRunner
+        from presto_tpu.server.faults import FaultInjector
+
+        inj = FaultInjector()
+        cfg = _ckpt_cfg(tmp_path,
+                        coordinator_state_path=str(tmp_path / "state"),
+                        coordinator_lease_ttl_s=0.4,
+                        task_recovery_interval_s=0.05)
+        sql = TPCH[3]
+        want = _oracle(sql)
+        with HAQueryRunner.tpch(scale=0.01, n_workers=2, config=cfg,
+                                coordinator_injector=inj,
+                                heartbeat_interval_s=0.05,
+                                heartbeat_max_missed=2) as ha:
+            # hold every checkpoint group ~0.8s on the PRIMARY only (the
+            # standby has no injector), so the kill lands mid-sequence
+            # with boundaries already journaled
+            inj.add_device_rule(r"/f\d+/s0$", policy="delay",
+                                delay_s=0.8)
+            res = {}
+
+            def run():
+                try:
+                    res["rows"] = ha.execute(sql).rows
+                except Exception as e:  # noqa: BLE001
+                    res["err"] = repr(e)
+
+            t = threading.Thread(target=run)
+            t.start()
+            q0 = None
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                for q in list(ha.coordinator.queries.values()):
+                    if q.sql == sql and q._device_ckpts:
+                        q0 = q
+                        break
+                if q0 is not None:
+                    break
+                time.sleep(0.02)
+            assert q0 is not None, "no boundary ever checkpointed"
+            time.sleep(0.1)   # let the checkpoint journal write land
+            ha.kill_primary()
+            ha.wait_for_failover()
+            t.join(timeout=120)
+            assert not t.is_alive(), "client never finished"
+            assert "err" not in res, res
+            assert _close(res["rows"], want)
+            sq = ha.standby.queries[q0.query_id]
+            assert sq.state == "FINISHED"
+            assert ha.standby.ha_counters["adopted"].get("requeued") == 1
+            assert sq.device_resumes
+            first = sq.device_resumes[0]
+            assert first["reason"] == "adopted checkpoint journal"
+            assert first["resumed_from"], \
+                "standby must resume from adopted boundaries"
+            assert not set(first["resumed_from"]) & set(
+                sq.device_exchange_info.get("fragments_lowered") or []), \
+                "adopted checkpoints were re-lowered on the standby"
+            assert not sq._tasks_scheduled

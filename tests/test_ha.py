@@ -134,6 +134,65 @@ def test_journal_roundtrip_serde(tmp_path):
     assert store.read("q1") is None
 
 
+def test_journal_gc_terminal_reaped_inflight_never(tmp_path):
+    """PR 17 journal GC: TERMINAL entries are reaped past the retention
+    window (then oldest-first past the retention count); in-flight
+    entries are NEVER touched regardless of age — a standby must always
+    be able to adopt them."""
+    store = QueryStateStore(LocalObjectApi(str(tmp_path / "state")))
+    for qid, state in (("t-fin", "FINISHED"), ("t-fail", "FAILED"),
+                       ("live-run", "RUNNING"), ("live-q", "QUEUED"),
+                       ("live-plan", "PLANNING")):
+        store.write(QueryJournal(query_id=qid, sql="select 1",
+                                 state=state))
+    # nothing is old enough: GC is a no-op
+    assert store.gc_terminal(3600.0, 1024) == []
+    # age-based reap: against a far-future clock, BOTH terminal entries
+    # go and every in-flight entry survives
+    deleted = store.gc_terminal(10.0, 1024, now=time.time() + 100.0)
+    assert deleted == ["t-fail", "t-fin"]
+    for qid in ("live-run", "live-q", "live-plan"):
+        assert store.read(qid) is not None, f"{qid} must never be GC'd"
+    # count-based reap: oldest terminal entries beyond the cap go, the
+    # newest stay, in-flight entries are still untouched
+    for i in range(4):
+        store.write(QueryJournal(query_id=f"fin-{i}", sql="select 1",
+                                 state="FINISHED"))
+        time.sleep(0.02)   # distinct mtimes for oldest-first ordering
+    deleted = store.gc_terminal(3600.0, 2)
+    assert deleted == ["fin-0", "fin-1"]
+    assert store.read("fin-2") is not None
+    assert store.read("fin-3") is not None
+    # maximum pressure (zero retention, zero cap, far-future clock):
+    # in-flight entries STILL survive
+    store.gc_terminal(0.0, 0, now=time.time() + 1e6)
+    for qid in ("live-run", "live-q", "live-plan"):
+        assert store.read(qid) is not None, f"{qid} must never be GC'd"
+    assert store.read("fin-2") is None and store.read("fin-3") is None
+
+
+def test_journal_gc_rides_the_active_lease_heartbeat(tmp_path):
+    """The wiring pin: a live coordinator's HA loop reaps a terminal
+    journal entry within the retention/4 throttle cadence."""
+    import os
+
+    cfg = _ha_cfg(tmp_path, coordinator_journal_retention_s=0.2)
+    with HAQueryRunner.tpch(scale=0.01, n_workers=1, config=cfg) as ha:
+        store = ha.coordinator.statestore
+        store.write(QueryJournal(query_id="old-fin", sql="select 1",
+                                 state="FINISHED"))
+        path = store.api._path("queries/old-fin")
+        old = time.time() - 60.0
+        os.utime(path, (old, old))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if store.read("old-fin") is None:
+                break
+            time.sleep(0.05)
+        assert store.read("old-fin") is None, \
+            "the active coordinator never reaped the terminal entry"
+
+
 def test_lease_takeover_mutual_exclusion(tmp_path):
     """Two standbys race an expired lease: the compare-and-swap claim
     admits exactly ONE winner per generation."""

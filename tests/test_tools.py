@@ -407,3 +407,36 @@ class TestChaosRunHA:
         assert stage["ok"] and stage["failovers"] == 1
         assert stage["adopted_outcome"] in ("reattached", "repointed",
                                             "restarted")
+
+
+class TestChaosRunMesh:
+    def test_mesh_check_mode(self, capsys):
+        """tools/chaos_run.py --mode mesh --check: the mid-program
+        fault-tolerance CI smoke — inject a device-plane fault at EVERY
+        checkpoint group of a TPC-H Q3 collective run in turn,
+        headless; nonzero on inexact rows, a fault that never fired, a
+        kill that never resumed, or ANY re-execution of a checkpointed
+        fragment (re-lowered into the resumed program or re-tasked on
+        the HTTP plane)."""
+        import importlib
+        import json
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        chaos_run = importlib.import_module("chaos_run")
+        rc = chaos_run.main(["--mode", "mesh", "--check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        report = json.loads(out[out.index("{\n"):])
+        assert report["mode"] == "mesh"
+        assert report["resume_mode"] == "device"
+        assert report["ok"]
+        assert len(report["stages"]) >= 2
+        assert report["total_resumes"] >= len(report["stages"])
+        for stage in report["stages"]:
+            assert stage["ok"], stage
+            assert stage["injections"] >= 1
+            assert stage["resumes"] >= 1
+            assert stage["resume_modes"] == ["device"]

@@ -8,6 +8,7 @@ pytest harness:
 
     JAX_PLATFORMS=cpu python tools/chaos_run.py --workers 3 --scale 0.01
     JAX_PLATFORMS=cpu python tools/chaos_run.py --mode stage
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --mode mesh --check
     JAX_PLATFORMS=cpu python tools/chaos_run.py --check
 
 ``--mode leaf`` (default) kills a worker holding leaf tasks; ``--mode
@@ -33,6 +34,15 @@ import time
 # path, not the repo root (same shim as fusion_report.py)
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
+if "--mode" in sys.argv and "mesh" in sys.argv and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # the mesh sweep needs >1 virtual device for real collectives; only
+    # effective before jax is imported (standalone CLI use — the test
+    # suite already forces an 8-device host platform)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_"
+                                 "count=8").strip()
 
 
 def run_spool_sweep(scale: float = 0.003, spooling: bool = True,
@@ -165,6 +175,141 @@ def run_spool_sweep(scale: float = 0.003, spooling: bool = True,
         "total_producer_reruns": total_reruns,
         "ok": all(s["ok"] for s in stages) and (
             total_reruns == 0 if spooling else True),
+    }
+    return report
+
+
+def run_mesh_sweep(scale: float = 0.01, query_num: int = 3,
+                   resume_mode: str = "device",
+                   quiet: bool = False, smoke: bool = False) -> dict:
+    """Kill-every-fragment sweep of the COLLECTIVE data plane (the
+    boundary-checkpoint acceptance proof): run a TPC-H query on the
+    2-worker mesh with ``mesh_checkpoint_boundaries`` on, inject a
+    device-plane fault at every checkpoint group in turn, and record
+    rows-exactness + resumes + re-lowered fragments per kill point.
+
+    ``resume_mode='device'`` must recover every kill by re-running ONLY
+    the remaining checkpoint groups (checkpointed fragments never
+    re-lowered); ``resume_mode='http'`` must degrade to the HTTP plane
+    scheduling ONLY the remaining fragments (checkpointed producers
+    served as spool:// leaves, zero tasks for them)."""
+    import dataclasses as _dc
+    import tempfile
+
+    from presto_tpu.config import DEFAULT
+    from presto_tpu.localrunner import LocalQueryRunner
+    from presto_tpu.parallel import sqlmesh
+    from presto_tpu.server.dqr import DistributedQueryRunner
+    from presto_tpu.server.faults import FaultInjector
+    from tests.tpch_queries import QUERIES
+
+    sql = QUERIES[query_num]
+    want = sorted(LocalQueryRunner.tpch(scale=scale).execute(sql).rows)
+    cfg = _dc.replace(
+        DEFAULT, mesh_device_exchange=True,
+        mesh_checkpoint_boundaries=True,
+        mesh_resume_mode=resume_mode,
+        exchange_spooling_enabled=True,
+        exchange_spool_path=os.path.join(
+            tempfile.mkdtemp(prefix="mesh-sweep-"), "spool"))
+    # ONE cluster for the whole sweep: checkpointed executions never
+    # share programs across queries, device rules are one-shot, and a
+    # degrade is not sticky on the cached plan — so each kill point is
+    # an independent execution on the same booted mesh (a fresh boot
+    # per stage would only re-pay data gen + worker startup)
+    inj = FaultInjector()
+    stages = []
+    with DistributedQueryRunner.tpch(scale=scale, n_workers=2,
+                                     config=cfg,
+                                     coordinator_injector=inj) as dqr:
+        # clean run: ground truth on the mesh + the kill matrix (every
+        # fragment the checkpointed execution lowers is one kill point)
+        rows = sorted(dqr.execute(sql).rows)
+        q0 = list(dqr.coordinator.queries.values())[-1]
+        info0 = dict(q0.device_exchange_info or {})
+        if rows != want:
+            return {"mode": "mesh", "resume_mode": resume_mode,
+                    "ok": False,
+                    "reason": "clean mesh run mismatched the local "
+                              "engine"}
+        kill_fids = sorted(info0.get("fragments_lowered") or [])
+        if not kill_fids or not info0.get("checkpoints"):
+            return {"mode": "mesh", "resume_mode": resume_mode,
+                    "ok": False,
+                    "reason": "checkpointed collective tier never "
+                              "engaged",
+                    "info": info0}
+        if smoke and len(kill_fids) > 3:
+            # CI smoke (--check): first group (no checkpoints yet), a
+            # mid-DAG boundary, and the root group — the ha-mode
+            # precedent (--check = kill-at-RUNNING only); the full run
+            # kills every fragment
+            kill_fids = sorted({kill_fids[0],
+                                kill_fids[len(kill_fids) // 2],
+                                kill_fids[-1]})
+        for fid in kill_fids:
+            t0 = time.monotonic()
+            # one-shot fault on this group's dispatch, any shard/query
+            # id; exhausted rules from earlier stages are inert
+            inj.add_device_rule(rf"/f{fid}/s\d+$")
+            hits_before = len(inj.injections)
+            lowered_before = sqlmesh.FRAGMENTS_LOWERED
+            stage = {"fragment": fid, "ok": False}
+            res = {}
+            try:
+                res["rows"] = sorted(dqr.execute(sql).rows)
+            except Exception as e:  # noqa: BLE001 - per-stage verdict
+                res["err"] = str(e)
+            q = list(dqr.coordinator.queries.values())[-1]
+            info = dict(q.device_exchange_info or {})
+            resumes = list(q.device_resumes)
+            resumed_from = sorted({f for r in resumes
+                                   for f in r["resumed_from"]})
+            stage["injections"] = len(inj.injections) - hits_before
+            stage["resumes"] = len(resumes)
+            stage["resume_modes"] = sorted({r["mode"] for r in resumes})
+            stage["resumed_from"] = resumed_from
+            stage["mesh_relowered"] = \
+                sqlmesh.FRAGMENTS_LOWERED - lowered_before
+            # zero re-execution of checkpointed fragments, per mode:
+            # device = never re-lowered into the resumed SPMD program;
+            # http = never given an HTTP task (spool:// leaves instead)
+            relowered = sorted(set(resumed_from)
+                               & set(info.get("fragments_lowered")
+                                     or []))
+            retasked = sorted({f for f, _, _ in q._placements
+                               if f in resumed_from})
+            stage["spool_leaves"] = sorted(
+                f for f, uris in q._task_uris.items()
+                if any(str(u).startswith("spool://") for u in uris))
+            stage["wall_s"] = round(time.monotonic() - t0, 2)
+            if "err" in res:
+                stage["reason"] = res["err"][:300]
+            elif res["rows"] != want:
+                stage["reason"] = "row mismatch"
+            elif not stage["injections"]:
+                stage["reason"] = "fault never fired"
+            elif not resumes:
+                stage["reason"] = "kill never triggered a resume"
+            elif relowered:
+                stage["reason"] = (f"checkpointed fragments re-lowered: "
+                                   f"{relowered}")
+            elif retasked:
+                stage["reason"] = (f"checkpointed fragments re-executed "
+                                   f"as HTTP tasks: {retasked}")
+            else:
+                stage["ok"] = True
+            stages.append(stage)
+            if not quiet:
+                print(json.dumps(stage))
+    report = {
+        "mode": "mesh", "resume_mode": resume_mode,
+        "query": f"tpch q{query_num}", "scale": scale,
+        "fragments": kill_fids,
+        "checkpoint_groups": info0.get("checkpoint_groups"),
+        "stages": stages,
+        "total_resumes": sum(s["resumes"] for s in stages),
+        "ok": all(s["ok"] for s in stages),
     }
     return report
 
@@ -420,7 +565,8 @@ def main(argv=None) -> int:
     ap.add_argument("--query", default="select count(*) from lineitem")
     ap.add_argument("--kill-index", type=int, default=None,
                     help="worker to kill (default: last)")
-    ap.add_argument("--mode", choices=["leaf", "stage", "spool", "ha"],
+    ap.add_argument("--mode",
+                    choices=["leaf", "stage", "spool", "ha", "mesh"],
                     default="leaf",
                     help="leaf = kill a scan-task worker; stage = kill "
                          "a worker holding a non-leaf fragment "
@@ -431,7 +577,19 @@ def main(argv=None) -> int:
                          "COORDINATOR at every lifecycle phase of a "
                          "TPC-DS Q72 HA mesh run and assert exact "
                          "rows through the standby (with --check: "
-                         "just the kill-at-RUNNING smoke)")
+                         "just the kill-at-RUNNING smoke); mesh = "
+                         "inject a device-plane fault at EVERY "
+                         "checkpoint group of a TPC-H Q3 collective "
+                         "run in turn (mesh_checkpoint_boundaries) "
+                         "and assert exact rows with zero "
+                         "re-execution of checkpointed fragments, in "
+                         "both resume modes (with --check: the "
+                         "device-resume sweep at first/middle/root "
+                         "kill points only)")
+    ap.add_argument("--resume-mode", choices=["device", "http", "both"],
+                    default="both",
+                    help="mesh mode only: which resume path(s) the "
+                         "sweep exercises")
     ap.add_argument("--no-spooling", action="store_true",
                     help="spool mode only: run the sweep with "
                          "exchange spooling disabled (PR 5 cascading "
@@ -443,6 +601,22 @@ def main(argv=None) -> int:
                     help="write the coordinator's query.json event "
                          "log here (JSON lines; '' disables)")
     args = ap.parse_args(argv)
+    if args.mode == "mesh":
+        # --check = the CI smoke: ONLY the device-resume sweep; the
+        # full run also proves the HTTP-degrade path.  Exit is nonzero
+        # on any inexact result or any re-execution of a checkpointed
+        # fragment (re-lowered OR re-tasked)
+        modes = (("device",) if args.check or args.resume_mode == "device"
+                 else ("http",) if args.resume_mode == "http"
+                 else ("device", "http"))
+        reports = [run_mesh_sweep(scale=args.scale, resume_mode=m,
+                                  smoke=args.check)
+                   for m in modes]
+        report = (reports[0] if len(reports) == 1 else
+                  {"mode": "mesh", "sweeps": reports,
+                   "ok": all(r["ok"] for r in reports)})
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
     if args.mode == "ha":
         # --check = the CI smoke: ONLY the kill-at-RUNNING scenario,
         # nonzero on inexact rows or on any producer re-run for
