@@ -1442,7 +1442,9 @@ def bench_concurrent_qps(scale: float):
     2-worker DistributedQueryRunner with resource-group admission
     engaged — QPS + p50/p95/p99 per concurrency level, exact-rows
     parity per client, plan-cache hit rate, and the zero-jit-compile
-    proof for the second execution of a cached plan."""
+    proof for the second execution of a cached plan — plus the
+    open-loop overload curve (bounded-pool dispatcher driven past
+    saturation: goodput/shed/latency per arrival rate)."""
     import sys as _sys
 
     _sys.path.insert(0, os.path.join(os.path.dirname(
@@ -1481,6 +1483,29 @@ def bench_concurrent_qps(scale: float):
         if hot["cache_off"]["qps"] else 0.0
     hot["parity"] = (hot["cache_on"]["parity"]
                      and hot["cache_off"]["parity"])
+    # open-loop overload tier (server/dispatcher.py bounded pool):
+    # arrivals PAST saturation must degrade to fast well-shaped
+    # QUERY_QUEUE_FULL rejections with retry hints while goodput holds
+    # — the graceful-degradation curve (goodput/shed/latency per rate)
+    # 4s per level: at 2s the goodput ratio is dominated by queue
+    # ramp/drain edge effects on the 1-core CI host (measured swings
+    # 0.60-1.04 across reruns of one tree); the longer window keeps
+    # the steady-state shed/goodput mix in charge of the number
+    ov = qps_run.run_overload(scale=scale, pool_size=4, max_queued=8,
+                              duration_s=4.0, quiet=True)
+    overload = {
+        "peak_qps": ov["peak_qps"],
+        "dispatcher": ov["dispatcher"],
+        "goodput_ratio_at_2x": ov["goodput_ratio_at_max"],
+        "shed_total": ov["shed_total"],
+        "graceful": ov["ok"],
+        # "errors" carries samples of any non-shaped failure so a
+        # parity=false artifact is diagnosable from the JSON alone
+        "levels": [{k: lv[k] for k in (
+            "rate_factor", "rate_per_s", "requests", "ok", "shed",
+            "other", "goodput_qps", "shed_rate", "p50_ms", "p95_ms",
+            "shed_p95_ms", "errors")} for lv in ov["levels"]],
+    }
     return {
         "metric": f"tpcds_sf{scale:g}_concurrent_qps_peak",
         "value": peak, "unit": "qps",
@@ -1495,7 +1520,12 @@ def bench_concurrent_qps(scale: float):
         "queries_queued": report["queries_queued"],
         "resource_groups": report["resource_groups"],
         "hot_repeat": hot,
-        "parity": report["parity"] and hot["parity"],
+        "overload": overload,
+        # overload folds only its SHAPE requirement into parity (zero
+        # non-error-shaped failures); the goodput ratio is a perf
+        # property recorded in the curve, not a correctness gate
+        "parity": report["parity"] and hot["parity"]
+        and all(lv["other"] == 0 for lv in overload["levels"]),
     }
 
 
@@ -1588,6 +1618,39 @@ def _emit(obj) -> None:
     print(json.dumps(obj), flush=True)
 
 
+# Documented single-host run-to-run spread, the PR 12/13 way but
+# measured wholesale (2026-08: three reruns of one tree on the 1-core
+# CI host; the stdlib-sqlite CONTROL config — zero repo code — swung
+# -31%/+50% between back-to-back runs, so the spread is host
+# scheduling noise, not engine drift).  Bands are the max measured
+# spread per config rounded up; perf_regress widens its gate to the
+# band for these configs only, so the trajectory still gates the
+# trend.  Matched by metric-name fragment (scale prefix varies).
+_HOST_NOISE_BANDS = (
+    ("cpu_sqlite_", 0.55),
+    ("q3_engine_rows_per_sec", 0.55),
+    ("concurrent_qps_peak", 0.40),
+    ("q1_mesh_2worker_rows_per_sec", 0.35),
+    ("q3_join_agg_rows_per_sec_per_chip", 0.30),
+    ("q17_join_agg_rows_per_sec_per_chip", 0.30),
+    ("sharded_join_rows_per_sec", 0.30),
+    ("q1_rows_per_sec_per_chip", 0.25),
+    ("q6_rows_per_sec_per_chip", 0.25),
+    ("q9_join_agg_rows_per_sec_per_chip", 0.25),
+    ("q1_engine_rows_per_sec", 0.25),
+)
+
+
+def _stamp_noise_band(row) -> None:
+    m = row.get("metric", "")
+    for frag, band in _HOST_NOISE_BANDS:
+        if frag in m:
+            # never narrow a band a config already declares (spooled
+            # tpcds carries 0.6 from its own investigation)
+            row["noise_band"] = max(row.get("noise_band", 0.0), band)
+            return
+
+
 def _run_jobs(headline, jobs, budget_s):
     extras = []
     t_start = time.perf_counter()
@@ -1612,6 +1675,9 @@ def _run_jobs(headline, jobs, budget_s):
     if not headline.pop("parity", True):
         headline = {"metric": "tpch_q1_parity_failure", "value": 0.0,
                     "unit": "rows/s", "vs_baseline": 0.0}
+    for row in extras:
+        _stamp_noise_band(row)
+    _stamp_noise_band(headline)
     headline["extras"] = extras
     return headline
 

@@ -24,11 +24,16 @@ class QueryFailed(RuntimeError):
 
     def __init__(self, message: str, error_name: Optional[str] = None,
                  error_type: Optional[str] = None,
-                 error_code: Optional[int] = None):
+                 error_code: Optional[int] = None,
+                 retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.error_name = error_name
         self.error_type = error_type
         self.error_code = error_code
+        # server retry hint (overload shedding: ``retryAfterSeconds`` in
+        # the error object / Retry-After on the POST ack); None = the
+        # failure is not retryable
+        self.retry_after_s = retry_after_s
 
 
 class StatementClient:
@@ -162,15 +167,37 @@ class StatementClient:
             time.sleep(min(self.poll_interval_s * 2, 0.2))
 
     def execute(self, sql: str,
-                timeout_s: float = 300.0
+                timeout_s: float = 300.0,
+                max_retries: int = 3
                 ) -> Tuple[List[dict], List[list]]:
-        """Returns (columns, rows); raises QueryFailed on query error."""
+        """Returns (columns, rows); raises QueryFailed on query error.
+
+        When the server sheds the statement with a retry hint
+        (``retryAfterSeconds``, the dispatcher's overload rejection),
+        the WHOLE statement is retried after the hinted delay — at most
+        ``max_retries`` times and never past ``timeout_s``.  Failures
+        without a hint keep the original single-attempt behavior
+        exactly; ``max_retries=0`` disables retrying."""
+        deadline = time.monotonic() + timeout_s
+        attempt = 0
+        while True:
+            try:
+                return self._execute_once(sql, deadline)
+            except QueryFailed as e:
+                attempt += 1
+                wait = e.retry_after_s
+                if (wait is None or attempt > max_retries
+                        or time.monotonic() + wait > deadline):
+                    raise
+                time.sleep(wait)
+
+    def _execute_once(self, sql: str, deadline: float
+                      ) -> Tuple[List[dict], List[list]]:
         payload = self._open_json(
             f"{self.base}/v1/statement", data=sql.encode("utf-8"),
             method="POST", headers=self._headers(), timeout=30)
         self.last_query_id = payload.get("id")
         self.stats_history = []
-        deadline = time.monotonic() + timeout_s
         while True:
             if isinstance(payload.get("stats"), dict):
                 self.last_stats = payload["stats"]
@@ -187,7 +214,9 @@ class StatementClient:
                 raise QueryFailed(err.get("message", "query failed"),
                                   error_name=err.get("errorName"),
                                   error_type=err.get("errorType"),
-                                  error_code=err.get("errorCode"))
+                                  error_code=err.get("errorCode"),
+                                  retry_after_s=err.get(
+                                      "retryAfterSeconds"))
             # only a results payload carries "columns"; the POST ack and
             # queued/running payloads carry just state+nextUri (a fast
             # statement can reach FINISHED before the first poll, so

@@ -358,6 +358,33 @@ class EngineConfig:
     # before failing with the queue-timeout error (the reference's
     # query.max-queued-time role)
     query_queue_timeout_s: float = 300.0
+    # --- cluster memory arbitration (server/memorypool.py + the
+    # coordinator's ClusterMemoryManager tick, SURVEY §2.2/§5) ------------
+    # per-node GENERAL pool: every query reservation on a worker charges
+    # this pool; a reservation past the cap BLOCKS the driver (condition
+    # wait) until another query frees bytes or the killer acts.
+    # 0 = unlimited — pure accounting, restores pre-pool behavior exactly.
+    worker_memory_pool_bytes: int = 0
+    # backstop behind the killer: how long one driver may stay blocked on
+    # a full pool before its reservation fails worker-side
+    memory_blocked_wait_s: float = 60.0
+    # cluster-wide ceiling on ONE query's summed worker reservations
+    # (the query_max_total_memory role); 0 = off
+    query_max_total_memory_bytes: int = 0
+    # a node pool continuously blocked for longer than this arms the
+    # coordinator's low-memory killer
+    low_memory_killer_delay_s: float = 5.0
+    # victim policy: 'total-reservation' (biggest query cluster-wide),
+    # 'total-reservation-on-blocked-nodes' (biggest query measured on
+    # the blocked nodes only — the reference default), or 'none'
+    low_memory_killer_policy: str = "total-reservation-on-blocked-nodes"
+    # --- bounded-pool admission (server/dispatcher.py) -------------------
+    # dispatch worker threads running admission + execution; 0 restores
+    # thread-per-query dispatch exactly
+    dispatcher_pool_size: int = 0
+    # dispatch queue depth past which submits are shed with the
+    # queue-full error shape + a Retry-After hint; 0 = never shed
+    dispatcher_max_queued: int = 0
     # --- coordinator HA (server/statestore.py) ---------------------------
     # Durable query-state journal + takeover lease root (an object-API
     # directory; primary and standby coordinators must see the same

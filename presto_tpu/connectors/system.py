@@ -112,7 +112,10 @@ class SystemConnector(_VirtualConnector):
             # from spool pages with zero execution, and how many wire
             # bytes came from the cache
             ("result_cached", T.BOOLEAN),
-            ("result_cache_bytes", T.BIGINT)], queries_fn)
+            ("result_cache_bytes", T.BIGINT),
+            # reference error shape of a FAILED query (NULL otherwise):
+            # kill/shed verdicts are auditable from SQL
+            ("error_name", T.VARCHAR)], queries_fn)
         self.add_table("tasks", [
             ("task_id", T.VARCHAR), ("state", T.VARCHAR),
             ("query_id", T.VARCHAR), ("output_rows", T.BIGINT),

@@ -170,6 +170,25 @@ class DeviceResumeEvent:
 
 
 @dataclasses.dataclass(frozen=True)
+class QueryKilledEvent:
+    """The cluster memory manager (or an operator via CALL
+    system.runtime.kill_query) failed a running query: ``reason`` names
+    the policy that selected it ('total-reservation',
+    'total-reservation-on-blocked-nodes', 'cluster-limit',
+    'per-query-total-limit', 'kill_query'), and the error triple is the
+    exact shape the client sees (CLUSTER_OUT_OF_MEMORY /
+    EXCEEDED_GLOBAL_MEMORY_LIMIT / ADMINISTRATIVELY_KILLED)."""
+
+    query_id: str
+    trace_token: str
+    user: str
+    reason: str
+    error_name: str
+    message: str
+    time: float
+
+
+@dataclasses.dataclass(frozen=True)
 class CoordinatorFailoverEvent:
     """A standby coordinator won the takeover lease and adopted the
     durable query-state journal (server/statestore.py): every query the
@@ -230,6 +249,9 @@ class EventListener:
     def device_resume(self, event: DeviceResumeEvent) -> None:
         pass
 
+    def query_killed(self, event: QueryKilledEvent) -> None:
+        pass
+
     def coordinator_failover(self, event: CoordinatorFailoverEvent
                              ) -> None:
         pass
@@ -279,6 +301,9 @@ class EventBus:
     def device_resume(self, event: DeviceResumeEvent) -> None:
         self._fire("device_resume", event)
 
+    def query_killed(self, event: QueryKilledEvent) -> None:
+        self._fire("query_killed", event)
+
     def coordinator_failover(self, event: CoordinatorFailoverEvent
                              ) -> None:
         self._fire("coordinator_failover", event)
@@ -317,6 +342,7 @@ class JsonLinesEventListener(EventListener):
     speculation = _write
     slow_query = _write
     device_resume = _write
+    query_killed = _write
     coordinator_failover = _write
     query_adopted = _write
 

@@ -26,10 +26,19 @@ class MemoryReservationError(RuntimeError):
 
 
 class MemoryContext:
-    """One node in the reservation tree (LocalMemoryContext analogue)."""
+    """One node in the reservation tree (LocalMemoryContext analogue).
+
+    A ROOT context may additionally charge its deltas into a per-node
+    ``MemoryPool`` (server/memorypool.py): growth charges the pool
+    BEFORE the tree applies (a full pool blocks the calling driver, and
+    a failed charge leaves the tree untouched), shrink frees the pool
+    after.  Cross-query frees arrive from other trees, so a driver
+    blocked here — holding this tree's lock — is still unblockable.
+    """
 
     def __init__(self, parent: Optional["MemoryContext"], name: str,
-                 limit: Optional[int] = None):
+                 limit: Optional[int] = None, pool=None,
+                 pool_query_id: str = "query"):
         self.parent = parent
         self.name = name
         self.limit = limit
@@ -37,6 +46,10 @@ class MemoryContext:
         self.peak = 0
         self._tree_lock = (parent._tree_lock if parent is not None
                            else threading.Lock())
+        if parent is None:
+            self.pool = pool
+            self.pool_query_id = pool_query_id
+            self._pool_charged = 0
 
     def reserve(self, bytes_: int) -> None:
         self.set_bytes(self.reserved + bytes_)
@@ -47,21 +60,50 @@ class MemoryContext:
         with self._tree_lock:
             self._set_bytes_locked(bytes_)
 
+    def root(self) -> "MemoryContext":
+        node = self
+        while node.parent is not None:
+            node = node.parent
+        return node
+
     def _set_bytes_locked(self, bytes_: int) -> None:
         delta = bytes_ - self.reserved
         node = self
+        root = node
         while node is not None:
             new = node.reserved + delta
             if delta > 0 and node.limit is not None and new > node.limit:
                 raise MemoryReservationError(
                     f"memory limit exceeded at {node.name}: "
                     f"{new} > {node.limit}")
+            root = node
             node = node.parent
+        pool = root.pool
+        if pool is not None and delta > 0:
+            pool.reserve(root.pool_query_id, delta)
+            root._pool_charged += delta
         node = self
         while node is not None:
             node.reserved += delta
             node.peak = max(node.peak, node.reserved)
             node = node.parent
+        if pool is not None and delta < 0:
+            freed = min(-delta, root._pool_charged)
+            if freed > 0:
+                root._pool_charged -= freed
+                pool.free(root.pool_query_id, freed)
+
+    def release_pool(self) -> None:
+        """Detach from the pool, returning any remaining charge: the
+        end-of-task backstop for reservations a failure path never freed
+        (a leak in a SHARED pool would block other queries forever)."""
+        with self._tree_lock:
+            root = self.root()
+            pool = root.pool
+            if pool is not None and root._pool_charged > 0:
+                pool.free(root.pool_query_id, root._pool_charged)
+                root._pool_charged = 0
+            root.pool = None
 
     def free(self) -> None:
         self.set_bytes(0)
@@ -328,10 +370,15 @@ def hot_operator_lines(ops, top_n: int = 5) -> List[str]:
 
 class QueryContext:
     def __init__(self, config: EngineConfig = DEFAULT,
-                 memory_limit: Optional[int] = None):
+                 memory_limit: Optional[int] = None, pool=None,
+                 pool_query_id: str = "query"):
         self.config = config
-        self.memory = MemoryContext(None, "query", limit=memory_limit)
+        self.memory = MemoryContext(None, "query", limit=memory_limit,
+                                    pool=pool, pool_query_id=pool_query_id)
         self.start_time = time.time()
+
+    def release_pool(self) -> None:
+        self.memory.release_pool()
 
 
 class TaskContext:
@@ -392,3 +439,17 @@ class OperatorContext:
         self.memory = MemoryContext(task.memory, f"op:{name}")
         self.stats = OperatorStats(operator=name)
         task.operator_stats.append(self.stats)
+
+    def should_spill(self, accumulated_bytes: int) -> bool:
+        """The revoke decision for accumulating operators (join build,
+        sort): shed state to the spill tier past the byte threshold, OR
+        as soon as the node's memory pool signals pressure — revocable
+        memory is reclaimed BEFORE anyone blocks or the killer fires."""
+        cfg = self.config
+        if not cfg.spill_enabled:
+            return False
+        if accumulated_bytes > cfg.spill_threshold_bytes:
+            return True
+        pool = self.memory.root().pool
+        return (pool is not None and accumulated_bytes > 0
+                and pool.needs_revoke())

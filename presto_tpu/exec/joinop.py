@@ -222,9 +222,10 @@ class HashBuildOperator(Operator):
         self._batches.append(batch)
         self.ctx.memory.reserve(batch.size_bytes)
         self._accumulated_bytes += batch.size_bytes
-        cfg = self.ctx.config
-        if (cfg.spill_enabled and self.f.allow_spill
-                and self._accumulated_bytes > cfg.spill_threshold_bytes):
+        # byte threshold OR node-pool pressure (revoke-first: shed
+        # revocable state before anyone blocks on the memory pool)
+        if self.f.allow_spill and \
+                self.ctx.should_spill(self._accumulated_bytes):
             self._spill_accumulated()
 
     def _spill_accumulated(self) -> None:

@@ -102,7 +102,8 @@ def _run_parallel(p: Pipeline, task: TaskContext, prefix: int,
 def execute_pipelines(pipelines: Sequence[Pipeline],
                       config: EngineConfig = DEFAULT,
                       memory_limit: Optional[int] = None,
-                      on_task_context=None) -> TaskContext:
+                      on_task_context=None, pool=None,
+                      pool_query_id: str = "query") -> TaskContext:
     """Run pipelines sequentially in the given (dependency) order.
 
     Build pipelines come before their probe pipelines — the planner emits
@@ -110,6 +111,8 @@ def execute_pipelines(pipelines: Sequence[Pipeline],
     LookupSourceFactory futures.  Returns the TaskContext (stats).
     ``on_task_context`` receives the TaskContext before execution starts
     so callers (worker memory reporting) can observe live reservations.
+    ``pool`` is the worker's shared MemoryPool; the reservation tree's
+    root charges it under ``pool_query_id`` (server/memorypool.py).
     """
     import time as _time
 
@@ -119,13 +122,14 @@ def execute_pipelines(pipelines: Sequence[Pipeline],
     # process-global; this sets the process default, cheap + idempotent)
     kernelcache.set_default_capacity(
         getattr(config, "kernel_cache_capacity", 0))
-    query = QueryContext(config, memory_limit)
+    query = QueryContext(config, memory_limit, pool=pool,
+                         pool_query_id=pool_query_id)
     task = TaskContext(query)
     deadline = (_time.monotonic() + config.query_max_run_time_s
                 if getattr(config, "query_max_run_time_s", 0) > 0 else None)
-    if on_task_context is not None:
-        on_task_context(task)
     try:
+        if on_task_context is not None:
+            on_task_context(task)
         for p in pipelines:
             if deadline is not None and _time.monotonic() > deadline:
                 raise RuntimeError(
@@ -140,4 +144,7 @@ def execute_pipelines(pipelines: Sequence[Pipeline],
                 driver.run_to_completion(deadline=deadline)
     finally:
         task.close()
+        # return any charge a failure path never freed — a leak in the
+        # SHARED node pool would block every other query on this node
+        query.release_pool()
     return task

@@ -40,9 +40,9 @@ class OrderByOperator(Operator):
         self.ctx.stats.input_rows += batch.num_rows
         self.ctx.memory.reserve(batch.size_bytes)
         self._accumulated_bytes += batch.size_bytes
-        cfg = self.ctx.config
-        if (cfg.spill_enabled
-                and self._accumulated_bytes > cfg.spill_threshold_bytes):
+        # byte threshold OR node-pool pressure (revoke-first: shed
+        # revocable state before anyone blocks on the memory pool)
+        if self.ctx.should_spill(self._accumulated_bytes):
             self._spill_run()
 
     def _sort_batches(self, batches: List[Batch]) -> Optional[Batch]:

@@ -97,6 +97,11 @@ class LocalQueryRunner:
         self._whole_query = None   # lazy MeshQueryRunner (1-device)
         # (key, epochs) while the in-flight statement is plan-cacheable
         self._plan_cache_key = None
+        # kill_query surface parity with the coordinator: ids this
+        # runner has executed (all terminal — execution is synchronous)
+        # and the statement currently on the caller's thread
+        self._query_ids: set = set()
+        self._current_query_id: Optional[str] = None
 
     @classmethod
     def tpch(cls, scale: float = 0.01,
@@ -135,6 +140,8 @@ class LocalQueryRunner:
 
         self._query_seq += 1
         qid = f"local-{self._query_seq}"
+        self._current_query_id = qid
+        self._query_ids.add(qid)
         trace = f"tt-{uuid.uuid4().hex[:12]}"
         created = ev.now()
         self.event_bus.query_created(ev.QueryCreatedEvent(
@@ -212,9 +219,7 @@ class LocalQueryRunner:
                 cat = self.metadata.default_catalog
             plancache.epochs_for(self.registry).bump(cat)
         if isinstance(stmt, t.CallProcedure):
-            raise ValueError(
-                "procedures (kill_query) run on a coordinator; the "
-                "single-process runner executes queries synchronously")
+            return self._run_kill_query(stmt)
         if isinstance(stmt, t.Explain):
             if stmt.analyze:
                 text = self.explain_analyze_text(stmt.statement)
@@ -491,6 +496,42 @@ class LocalQueryRunner:
         if len(parts) == 2:
             return parts[0], parts[1]
         raise ValueError(f"bad table name {'.'.join(parts)}")
+
+    def _run_kill_query(self, stmt: t.CallProcedure) -> QueryResult:
+        """CALL system.runtime.kill_query — the coordinator procedure's
+        single-process twin (KillQueryProcedure.java role): identical
+        name/argument validation and error messages, and the SAME
+        ADMINISTRATIVELY_KILLED shape in the fired ``QueryKilledEvent``.
+        Local statements execute synchronously on the caller's thread,
+        so any valid target is already terminal and the kill itself is
+        the same no-op the coordinator applies to terminal queries."""
+        from presto_tpu import events as ev
+        from presto_tpu.server.coordinator import ADMINISTRATIVELY_KILLED
+
+        name = ".".join(stmt.name)
+        if name not in ("system.runtime.kill_query", "kill_query"):
+            raise ValueError(f"unknown procedure {name}")
+        if len(stmt.args) < 1 or not isinstance(stmt.args[0],
+                                                t.StringLiteral):
+            raise ValueError("kill_query(query_id) requires a string id")
+        qid = stmt.args[0].value
+        message = "Query killed via kill_query"
+        if len(stmt.args) > 1:
+            if not isinstance(stmt.args[1], t.StringLiteral):
+                raise ValueError(
+                    "kill_query(query_id, message) requires a string "
+                    "message")
+            if stmt.args[1].value:
+                message = f"Query killed via kill_query: " \
+                          f"{stmt.args[1].value}"
+        if qid == self._current_query_id:
+            raise ValueError("a query cannot kill itself")
+        if qid not in self._query_ids:
+            raise ValueError(f"no such query {qid!r}")
+        self.event_bus.query_killed(ev.QueryKilledEvent(
+            qid, "", self.session.user, "kill_query",
+            ADMINISTRATIVELY_KILLED[0], message, ev.now()))
+        return QueryResult(["result"], [T.VARCHAR], [("killed",)])
 
     def _create_table(self, stmt: t.CreateTable) -> QueryResult:
         from presto_tpu.connectors.api import ColumnMetadata, TableSchema

@@ -48,6 +48,49 @@ from presto_tpu.sql.optimizer import optimize
 from presto_tpu.sql.parser import parse_statement
 from presto_tpu.sql.planner import Metadata, Planner
 
+#: (errorName, errorType, errorCode) triples for the memory-arbitration
+#: and administrative kill paths (StandardErrorCode layout: USER_ERROR
+#: codes are based at 0x0000_0000, INSUFFICIENT_RESOURCES at
+#: 0x0002_0000; the admission-layer triples live in server/dispatcher.py).
+EXCEEDED_GLOBAL_MEMORY_LIMIT = ("EXCEEDED_GLOBAL_MEMORY_LIMIT",
+                                "INSUFFICIENT_RESOURCES", 0x0002_0001)
+CLUSTER_OUT_OF_MEMORY = ("CLUSTER_OUT_OF_MEMORY",
+                         "INSUFFICIENT_RESOURCES", 0x0002_0004)
+ADMINISTRATIVELY_KILLED = ("ADMINISTRATIVELY_KILLED", "USER_ERROR",
+                           0x0000_0005)
+
+
+def pick_low_memory_victim(policy: str, per_query: Dict[str, int],
+                           per_query_blocked: Dict[str, int],
+                           killable: set) -> Optional[str]:
+    """The pluggable LowMemoryKiller (LowMemoryKiller.java SPI role):
+    given per-query cluster-wide reservations — total, and restricted
+    to nodes whose pools have blocked drivers — pick at most one victim.
+
+    - ``total-reservation`` (TotalReservationLowMemoryKiller): the
+      largest total reservation anywhere wins.
+    - ``total-reservation-on-blocked-nodes``
+      (TotalReservationOnBlockedNodesLowMemoryKiller, the default): the
+      largest reservation counting only blocked nodes — the query
+      actually holding the stuck pool hostage — falling back to total
+      reservation when no killable query reserves on a blocked node.
+    - ``none``: never kill (blocked drivers ride out the worker-side
+      ``memory_blocked_wait_s`` backstop instead).
+
+    Ties break on query id so repeated ticks are deterministic."""
+    if policy == "none":
+        return None
+    candidates = {qid: b for qid, b in per_query.items()
+                  if qid in killable}
+    if not candidates:
+        return None
+    if policy == "total-reservation-on-blocked-nodes":
+        on_blocked = {qid: b for qid, b in per_query_blocked.items()
+                      if qid in killable and b > 0}
+        if on_blocked:
+            return max(sorted(on_blocked), key=on_blocked.get)
+    return max(sorted(candidates), key=candidates.get)
+
 
 class NodeManager:
     """Live-node registry + heartbeat failure detector."""
@@ -249,6 +292,10 @@ class QueryExecution:
         self.error_name: Optional[str] = None
         self.error_type: Optional[str] = None
         self.error_code: Optional[int] = None
+        # overload shedding: the dispatcher's retry hint for rejected
+        # statements, surfaced as Retry-After on the POST ack and
+        # ``retryAfterSeconds`` in the protocol error object
+        self.retry_after_s: Optional[int] = None
         # serving-tier time split: seconds spent queued for admission
         # vs executing (planning through drain) — the queued-vs-execution
         # split QueryStats, /v1/query/{id}, and EXPLAIN ANALYZE report
@@ -3853,17 +3900,33 @@ class QueryExecution:
         self.result_rows = list(res.rows)
 
     def _run_procedure(self, stmt: t.CallProcedure) -> None:
-        """system.runtime.kill_query (KillQueryProcedure.java role)."""
+        """system.runtime.kill_query (KillQueryProcedure.java role).
+        Shares the low-memory killer's fail path: the error + shape are
+        stamped BEFORE the cancel fan-out, so the client sees the kill
+        message with the ADMINISTRATIVELY_KILLED triple rather than a
+        generic drain abort."""
         name = ".".join(stmt.name)
         if name not in ("system.runtime.kill_query", "kill_query"):
             raise ValueError(f"unknown procedure {name}")
         if len(stmt.args) < 1 or not isinstance(stmt.args[0],
                                                 t.StringLiteral):
             raise ValueError("kill_query(query_id) requires a string id")
-        target = self.co.queries.get(stmt.args[0].value)
+        qid = stmt.args[0].value
+        message = "Query killed via kill_query"
+        if len(stmt.args) > 1:
+            if not isinstance(stmt.args[1], t.StringLiteral):
+                raise ValueError(
+                    "kill_query(query_id, message) requires a string "
+                    "message")
+            if stmt.args[1].value:
+                message = f"Query killed via kill_query: " \
+                          f"{stmt.args[1].value}"
+        if qid == self.query_id:
+            raise ValueError("a query cannot kill itself")
+        target = self.co.queries.get(qid)
         if target is None:
-            raise ValueError(f"no such query {stmt.args[0].value!r}")
-        target.cancel()
+            raise ValueError(f"no such query {qid!r}")
+        target.kill(message, ADMINISTRATIVELY_KILLED, reason="kill_query")
         self.column_names = ["result"]
         self.column_types = [T.VARCHAR]
         self.result_rows = [("killed",)]
@@ -3873,6 +3936,27 @@ class QueryExecution:
         and cancel every worker task."""
         self.canceled = True
         self._cancel_worker_tasks()
+
+    def kill(self, message: str, shape: Tuple[str, str, int],
+             reason: str) -> None:
+        """Administratively fail this query (the low-memory killer and
+        CALL system.runtime.kill_query both land here): stamp the error
+        message + reference shape BEFORE cancelling so the drain abort
+        and dispatcher terminal paths preserve them, fire
+        ``QueryKilledEvent``, then run the normal cancel fan-out (which
+        also aborts the query's blocked pool reservations on every
+        worker).  Terminal queries are left untouched."""
+        if self.state in ("FINISHED", "FAILED"):
+            return
+        self.error = message
+        self.error_name, self.error_type, self.error_code = shape
+        counters = getattr(self.co, "kill_counters", None)
+        if counters is not None:
+            counters[reason] = counters.get(reason, 0) + 1
+        self.co.event_bus.query_killed(ev.QueryKilledEvent(
+            self.query_id, self.trace_token, self.user, reason,
+            shape[0], message, ev.now()))
+        self.cancel()
 
     def _drain(self, locations: List[str]) -> None:
         """Pull the root stage's pages, one location at a time.
@@ -4084,6 +4168,10 @@ class QueryExecution:
                 err["errorName"] = self.error_name
                 err["errorType"] = self.error_type
                 err["errorCode"] = self.error_code
+            if self.retry_after_s is not None:
+                # overload shedding: the client may retry this statement
+                # after the hinted delay (StatementClient honors it)
+                err["retryAfterSeconds"] = self.retry_after_s
             out["error"] = err
             return out
         if self.state != "FINISHED":
@@ -4368,15 +4456,25 @@ class CoordinatorServer:
         # workers before dispatching (0 = no requirement)
         self.min_workers = min_workers
         self.min_workers_wait_s = min_workers_wait_s
-        # ClusterMemoryManager + TotalReservationLowMemoryKiller role
+        # ClusterMemoryManager + pluggable LowMemoryKiller role
+        # (server/README.md "Memory model & overload").  The tick always
+        # runs: it folds worker MemoryInfo and feeds resource-group
+        # soft-memory accounting even with every kill knob off; killing
+        # only happens when a limit is configured or a worker pool has
+        # been blocked past the grace delay.
         self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
         self.memory_info: Dict[str, Dict] = {}   # node_id -> MemoryInfo
         self._memory_stop = threading.Event()
-        if cluster_memory_limit_bytes is not None:
-            self._memory_thread = threading.Thread(
-                target=self._memory_loop, daemon=True,
-                name="cluster-memory-manager")
-            self._memory_thread.start()
+        # node_id -> monotonic first-seen time with blocked pool drivers
+        # (the killer arms when any age exceeds low_memory_killer_delay_s)
+        self._blocked_seen: Dict[str, float] = {}
+        # reason -> administrative kills (/metrics:
+        # presto_cluster_killed_queries_total)
+        self.kill_counters: Dict[str, int] = {}
+        self._memory_thread = threading.Thread(
+            target=self._memory_loop, daemon=True,
+            name="cluster-memory-manager")
+        self._memory_thread.start()
         co = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -4385,9 +4483,12 @@ class CoordinatorServer:
             def log_message(self, *args):
                 pass
 
-            def _json(self, code: int, payload) -> None:
+            def _json(self, code: int, payload,
+                      extra_headers=None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, str(v))
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -4469,11 +4570,16 @@ class CoordinatorServer:
                             "X-Presto-Prepared-Statements"),
                         trace_token=self.headers.get(
                             "X-Presto-Trace-Token"))
+                    hdrs = {}
+                    if q.retry_after_s is not None:
+                        # shed at submit: the ack itself tells clients
+                        # (and proxies) when to come back
+                        hdrs["Retry-After"] = max(1, int(q.retry_after_s))
                     self._json(200, {
                         "id": q.query_id,
                         "nextUri": f"{co.uri}/v1/statement/executing/"
                                    f"{q.query_id}/0",
-                        "stats": {"state": q.state}})
+                        "stats": {"state": q.state}}, extra_headers=hdrs)
                     return
                 if parts == ["v1", "announcement"]:
                     # when a cluster secret exists, only peers holding
@@ -4490,6 +4596,11 @@ class CoordinatorServer:
                     co.nodes.announce(ann["nodeId"], ann["uri"],
                                       ann.get("location", ""),
                                       ann.get("meshFingerprint"))
+                    if ann.get("memoryInfo") is not None:
+                        # announcements push MemoryInfo so the cluster
+                        # memory manager sees fresh pool state even
+                        # between its own /v1/memory polls
+                        co.memory_info[ann["nodeId"]] = ann["memoryInfo"]
                     self._json(200, {"ok": True})
                     return
                 self._json(404, {"error": f"bad path {self.path}"})
@@ -4559,6 +4670,7 @@ class CoordinatorServer:
                          "user": q.user,
                          "query": q.sql[:200],
                          "traceToken": q.trace_token,
+                         "errorName": q.error_name,
                          "outputRows": len(q.result_rows),
                          "wallS": round((q.query_stats or {}).get(
                              "elapsed_s",
@@ -4917,47 +5029,147 @@ class CoordinatorServer:
         return runner
 
     def _memory_loop(self, interval_s: float = 0.5) -> None:
-        """Poll worker MemoryInfo; when the cluster total exceeds the
-        limit, kill the query with the largest total reservation
-        (ClusterMemoryManager.java:173-347 +
-        TotalReservationLowMemoryKiller policy)."""
+        """The ClusterMemoryManager loop (ClusterMemoryManager.java:
+        173-347): every tick polls worker MemoryInfo, feeds the
+        resource-group soft-memory gate, enforces the per-query and
+        cluster-wide memory limits, and — when a worker pool has had
+        blocked drivers past ``low_memory_killer_delay_s`` — runs the
+        configured LowMemoryKiller policy to fail exactly one victim."""
+        while not self._memory_stop.wait(interval_s):
+            if not self.is_active:
+                continue   # a standby arbitrates nothing until takeover
+            try:
+                self._memory_tick()
+            except Exception as e:  # noqa: BLE001 - the tick must survive
+                self.log(f"memory tick error: {e}")
+
+    def _poll_worker_memory(self) -> None:
+        """GET /v1/memory on every responsive node into
+        ``self.memory_info`` (announcements push the same MemoryInfo in
+        between polls)."""
         hdrs = (self.internal_auth.header()
                 if self.internal_auth is not None else {})
-        while not self._memory_stop.wait(interval_s):
-            total = 0
-            per_query: Dict[str, int] = {}
-            for nid, uri in self.nodes.responsive_nodes():
-                try:
-                    req = urllib.request.Request(f"{uri}/v1/memory",
-                                                 headers=dict(hdrs))
-                    with urllib.request.urlopen(req, timeout=2) as resp:
-                        info = json.loads(resp.read())
-                except Exception:  # noqa: BLE001 - node flaky
-                    continue
-                self.memory_info[nid] = info
-                total += int(info.get("reserved", 0))
-                for qid, q in info.get("queries", {}).items():
-                    per_query[qid] = per_query.get(qid, 0) + \
-                        int(q.get("reserved", 0))
-            # feed group memory usage so soft limits gate new admissions
-            # (InternalResourceGroup soft_memory_limit role)
-            per_user: Dict[str, int] = {}
-            for qid, used in per_query.items():
-                q = self.queries.get(qid)
-                if q is not None:
-                    per_user[q.user] = per_user.get(q.user, 0) + used
-            self.resource_groups.update_memory_usage(per_user)
-            if total <= self.cluster_memory_limit_bytes or not per_query:
+        for nid, uri in self.nodes.responsive_nodes():
+            try:
+                req = urllib.request.Request(f"{uri}/v1/memory",
+                                             headers=dict(hdrs))
+                with urllib.request.urlopen(req, timeout=2) as resp:
+                    info = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 - node flaky
                 continue
-            victim = max(per_query, key=per_query.get)
-            q = self.queries.get(victim)
-            if q is not None and q.state in ("RUNNING", "SCHEDULING"):
+            self.memory_info[nid] = info
+
+    def _memory_tick(self) -> None:
+        """One arbitration pass.  Kills at most ONE victim per tick (the
+        reference's one-kill-per-run posture: freeing one query's memory
+        unblocks pools cluster-wide; the next tick re-evaluates)."""
+        self._poll_worker_memory()
+        now = time.monotonic()
+        total = 0
+        per_query: Dict[str, int] = {}
+        per_query_blocked: Dict[str, int] = {}   # reservation on blocked
+        blocked_nodes = set()
+        for nid, info in list(self.memory_info.items()):
+            total += int(info.get("reserved", 0))
+            pool = info.get("pool") or {}
+            node_blocked = int(pool.get("blockedDrivers", 0)) > 0
+            if node_blocked:
+                blocked_nodes.add(nid)
+                self._blocked_seen.setdefault(nid, now)
+            else:
+                self._blocked_seen.pop(nid, None)
+            for qid, q in info.get("queries", {}).items():
+                used = int(q.get("reserved", 0))
+                per_query[qid] = per_query.get(qid, 0) + used
+                if node_blocked:
+                    per_query_blocked[qid] = \
+                        per_query_blocked.get(qid, 0) + used
+        # mesh-executed queries create no worker tasks; fold their live
+        # sampler peak (synthetic device TaskStats rollup) so the
+        # per-query total limit sees them too
+        for qid, q in list(self.queries.items()):
+            if qid in per_query or q.state not in ("RUNNING",
+                                                   "SCHEDULING"):
+                continue
+            peak = int((getattr(q, "_progress", None) or {})
+                       .get("peakMemoryBytes", 0) or 0)
+            if peak > 0:
+                per_query[qid] = peak
+        # feed group memory usage so soft limits gate new admissions
+        # (InternalResourceGroup soft_memory_limit role) — this ALWAYS
+        # runs, independent of any kill knob
+        per_user: Dict[str, int] = {}
+        for qid, used in per_query.items():
+            q = self.queries.get(qid)
+            if q is not None:
+                per_user[q.user] = per_user.get(q.user, 0) + used
+        self.resource_groups.update_memory_usage(per_user)
+
+        def _killable(qid):
+            q = self.queries.get(qid)
+            return (q if q is not None
+                    and q.state in ("RUNNING", "SCHEDULING") else None)
+
+        # 1) per-query cluster-wide total limit (the session-scoped
+        #    query_max_total_memory_bytes knob; reference
+        #    EXCEEDED_GLOBAL_MEMORY_LIMIT shape)
+        for qid in sorted(per_query):
+            q = _killable(qid)
+            if q is None:
+                continue
+            qcfg = getattr(q, "_cfg", None) or self.config
+            limit = int(getattr(qcfg, "query_max_total_memory_bytes",
+                                0) or 0)
+            if limit > 0 and per_query[qid] > limit:
+                self.log(f"killing {qid}: total reservation "
+                         f"{per_query[qid]} > per-query limit {limit}")
+                q.kill(
+                    f"Query exceeded distributed total memory limit of "
+                    f"{limit} bytes (reserved {per_query[qid]})",
+                    EXCEEDED_GLOBAL_MEMORY_LIMIT,
+                    reason="per-query-total-limit")
+                return
+        # 2) legacy cluster-wide total limit (kept message: tests and
+        #    operators match on "out of memory")
+        if (self.cluster_memory_limit_bytes is not None and per_query
+                and total > self.cluster_memory_limit_bytes):
+            victim = max(sorted(per_query), key=per_query.get)
+            q = _killable(victim)
+            if q is not None:
                 self.log(f"low-memory killer: killing {victim} "
                          f"(cluster {total} > "
                          f"{self.cluster_memory_limit_bytes})")
-                q.error = ("Query killed because the cluster is out of "
-                           "memory. Please try again in a few minutes.")
-                q.cancel()
+                q.kill("Query killed because the cluster is out of "
+                       "memory. Please try again in a few minutes.",
+                       CLUSTER_OUT_OF_MEMORY, reason="cluster-limit")
+                return
+        # 3) the low-memory killer proper: a pool with drivers blocked
+        #    past the grace delay means memory cannot free itself —
+        #    select one victim by policy and fail it
+        delay = float(self.config.low_memory_killer_delay_s)
+        stuck = [nid for nid in blocked_nodes
+                 if now - self._blocked_seen.get(nid, now) >= delay]
+        if not stuck or not per_query:
+            return
+        victim = pick_low_memory_victim(
+            self.config.low_memory_killer_policy, per_query,
+            per_query_blocked,
+            {qid for qid in per_query if _killable(qid) is not None})
+        q = _killable(victim) if victim is not None else None
+        if q is None:
+            return
+        self.log(f"low-memory killer "
+                 f"({self.config.low_memory_killer_policy}): killing "
+                 f"{victim} (pools blocked {sorted(stuck)})")
+        q.kill("Query killed because the cluster is out of memory "
+               f"(worker pools blocked on nodes {sorted(stuck)}). "
+               "Please try again in a few minutes.",
+               CLUSTER_OUT_OF_MEMORY,
+               reason=self.config.low_memory_killer_policy)
+        # fresh grace period before the next kill: give the cancel
+        # fan-out time to actually free the victim's reservations
+        for nid in stuck:
+            self._blocked_seen.pop(nid, None)
 
     def log(self, msg: str) -> None:
         if self.verbose:

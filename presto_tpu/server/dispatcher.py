@@ -75,9 +75,13 @@ class DispatchQuery(QueryExecution):
                        shape: Tuple[str, str, int]) -> None:
         """Terminal failure before execution ever started: no worker
         tasks, no stats — just the error shape, the completion event,
-        and an unblocked client."""
+        and an unblocked client.  A shape stamped earlier (the
+        low-memory killer / kill_query hitting a still-queued query)
+        wins over the generic dispatch shape, same as the error
+        message."""
         self.error = self.error or message
-        self.error_name, self.error_type, self.error_code = shape
+        if self.error_name is None:
+            self.error_name, self.error_type, self.error_code = shape
         self.state = "FAILED"
         # terminal journal write: a failover must re-serve the
         # rejection, not re-admit the query
@@ -125,8 +129,9 @@ class DispatchQuery(QueryExecution):
         try:
             if self._cancel_event.is_set():
                 self.error = self.error or "Query was canceled by the user"
-                self.error_name, self.error_type, self.error_code = \
-                    USER_CANCELED
+                if self.error_name is None:
+                    self.error_name, self.error_type, self.error_code = \
+                        USER_CANCELED
                 self.state = "FAILED"
                 self._journal_terminal()
                 self.rows_done.set()
@@ -159,19 +164,50 @@ class DispatchQuery(QueryExecution):
 class DispatchManager:
     """The asynchronous dispatch loop: ``submit`` enqueues, the loop
     starts each query's admission thread.  Submission is O(1) for the
-    HTTP handler regardless of what the cluster is doing."""
+    HTTP handler regardless of what the cluster is doing.
+
+    Two execution modes (``dispatcher_pool_size``):
+
+    - **0 (default)**: thread-per-query — the historical behavior,
+      byte-identical: the single dispatch loop starts each query's own
+      admission thread and total thread count tracks total in-flight
+      statements.
+    - **> 0**: bounded pool — N drainer threads run admitted queries
+      INLINE, so at most N statements are in admission/execution at
+      once and a submit burst costs queue entries, not threads.  With
+      ``dispatcher_max_queued > 0`` a submit that finds the backlog
+      full is SHED immediately: the reference's queue-full shape plus a
+      ``Retry-After`` hint scaled to the backlog, so overload degrades
+      to fast well-shaped rejections instead of collapse (open-loop
+      graceful degradation)."""
 
     def __init__(self, coordinator):
         self.co = coordinator
+        cfg = coordinator.config
+        self.pool_size = int(getattr(cfg, "dispatcher_pool_size", 0) or 0)
+        self.max_queued = int(getattr(cfg, "dispatcher_max_queued", 0)
+                              or 0)
+        # statements shed at submit (/metrics:
+        # presto_dispatcher_shed_queries_total)
+        self.shed_total = 0
+        self._shed_lock = threading.Lock()
         self._queue: "queue.Queue[Optional[DispatchQuery]]" = queue.Queue()
         self._stop = threading.Event()
         # chaos/test hook (coordinator HA): while set, submitted
         # queries stay QUEUED — the deterministic
         # kill-the-coordinator-at-QUEUED shape
         self._paused = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="dispatcher")
-        self._thread.start()
+        if self.pool_size > 0:
+            self._threads = [
+                threading.Thread(target=self._pool_loop, daemon=True,
+                                 name=f"dispatcher-{i}")
+                for i in range(self.pool_size)]
+            for th in self._threads:
+                th.start()
+        else:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="dispatcher")
+            self._thread.start()
 
     def pause(self) -> None:
         self._paused.set()
@@ -201,10 +237,29 @@ class DispatchManager:
             q._device_ckpts.update(
                 {str(k): dict(v) for k, v in device_checkpoints.items()})
         self.co.queries[qid] = q
+        if self.max_queued > 0 and self._queue.qsize() >= self.max_queued:
+            # overload shedding: fail fast with the reference's
+            # queue-full shape and a retry hint — never an unshaped 500,
+            # never an unbounded queue
+            q.retry_after_s = self._retry_after_hint()
+            with self._shed_lock:
+                self.shed_total += 1
+            q._fail_dispatch(
+                f"Query queue full: dispatcher backlog is "
+                f"{self._queue.qsize()} (max {self.max_queued}); retry "
+                f"after {q.retry_after_s}s", QUERY_QUEUE_FULL)
+            return q
         # durable journal write-through at QUEUED (server/statestore.py)
         q._journal("QUEUED")
         self._queue.put(q)
         return q
+
+    def _retry_after_hint(self) -> int:
+        """Seconds a shed client should wait: deeper backlog per drainer
+        -> longer hint, clamped to [1, 60] so clients neither stampede
+        back nor park forever."""
+        per = max(self.pool_size, 1)
+        return max(1, min(60, 1 + self._queue.qsize() // per))
 
     def _loop(self) -> None:
         import time
@@ -230,6 +285,35 @@ class DispatchManager:
                 q.finish_cancelled()
                 continue
             q._start()
+
+    def _pool_loop(self) -> None:
+        """One bounded-pool drainer: identical pause/stop/cancel
+        semantics to ``_loop``, but the query runs ON this thread —
+        pool_size drainers bound concurrent admission + execution."""
+        import time
+
+        while not self._stop.is_set():
+            if self._paused.is_set():
+                time.sleep(0.02)
+                continue
+            try:
+                q = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if q is None:
+                self._queue.put(None)   # wake the sibling drainers too
+                return
+            while self._paused.is_set() and not self._stop.is_set():
+                time.sleep(0.02)
+            if self._stop.is_set() or getattr(self.co, "killed", False):
+                return
+            if q.canceled or q._cancel_event.is_set():
+                q.finish_cancelled()
+                continue
+            try:
+                q._run()
+            except Exception:  # noqa: BLE001 - a query never kills a drainer
+                pass
 
     def close(self) -> None:
         self._stop.set()

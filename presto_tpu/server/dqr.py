@@ -89,7 +89,8 @@ class DistributedQueryRunner:
                          q.get("totalSplits", 0),
                          q.get("progressPercent", 0.0),
                          q.get("resultCached", False),
-                         q.get("resultCacheBytes", 0))
+                         q.get("resultCacheBytes", 0),
+                         q.get("errorName"))
                         for q in fetch("/v1/query")]
 
             def tasks_fn():

@@ -55,6 +55,12 @@ SPOOL_MISSING = "spool-missing"
 #: survive with zero re-execution of checkpointed fragments
 DEVICE_FAIL = "device-fail"
 DEVICE_RESOURCE_EXHAUSTED = "device-resource-exhausted"
+#: memory-plane fault policy (SqlTaskManager consults ``apply_memory``
+#: at task create; keys are task ids ``{query_id}.{fragment}.{i}``): a
+#: matching task reserves ``inflate_bytes`` extra for its lifetime — a
+#: deterministic runaway query that fills the worker memory pool, which
+#: is what the coordinator's low-memory killer must resolve
+MEMORY_INFLATE = "memory-inflate"
 
 
 class InjectedDeviceFault(RuntimeError):
@@ -77,10 +83,11 @@ def kill_coordinator(coordinator) -> None:
 class FaultRule:
     def __init__(self, pattern: str, method: str, policy: str, *,
                  times: Optional[int] = None, delay_s: float = 0.0,
-                 status: int = 503):
+                 status: int = 503, inflate_bytes: int = 0):
         if policy not in (FAIL_N_TIMES, HTTP_503, DROP_CONNECTION, DELAY,
                           SLOW_TASK, SPOOL_READ_ERROR, SPOOL_MISSING,
-                          DEVICE_FAIL, DEVICE_RESOURCE_EXHAUSTED):
+                          DEVICE_FAIL, DEVICE_RESOURCE_EXHAUSTED,
+                          MEMORY_INFLATE):
             raise ValueError(f"unknown fault policy {policy!r}")
         self.pattern = pattern
         self.regex = re.compile(pattern)
@@ -92,6 +99,8 @@ class FaultRule:
                           else (1 if policy == FAIL_N_TIMES else None))
         self.delay_s = delay_s
         self.status = status
+        # memory-inflate: extra bytes a matching task reserves
+        self.inflate_bytes = inflate_bytes
         # slow-task: requests block on this event rather than a timer,
         # so straggler tests are deterministic (release when ready);
         # ``delay_s`` > 0 doubles as a safety cap
@@ -137,9 +146,10 @@ class FaultInjector:
     def add_rule(self, pattern: str, method: str = "*",
                  policy: str = DROP_CONNECTION, *,
                  times: Optional[int] = None, delay_s: float = 0.0,
-                 status: int = 503) -> FaultRule:
+                 status: int = 503, inflate_bytes: int = 0) -> FaultRule:
         rule = FaultRule(pattern, method, policy, times=times,
-                         delay_s=delay_s, status=status)
+                         delay_s=delay_s, status=status,
+                         inflate_bytes=inflate_bytes)
         with self._lock:
             self.rules.append(rule)
         return rule
@@ -185,6 +195,28 @@ class FaultInjector:
             times = 1
         return self.add_rule(pattern, method="DEVICE", policy=policy,
                              times=times, delay_s=delay_s)
+
+    def add_memory_rule(self, pattern: str, inflate_bytes: int, *,
+                        times: Optional[int] = None,
+                        hold_s: float = 0.0) -> FaultRule:
+        """Memory-plane chaos: a task whose id matches ``pattern``
+        reserves ``inflate_bytes`` EXTRA for its lifetime (a real
+        reservation through the task's memory-context tree, charging
+        the node's pool) — the deterministic runaway query the
+        low-memory killer must select and kill.  Defaults to ONE shot
+        so exactly one victim inflates; memory rules are keyed
+        method='MEMORY' and never leak onto HTTP/spool/device paths.
+
+        ``hold_s`` > 0 makes the inflated task PARK after reserving —
+        holding the pool memory until ``rule.release()``, the hold cap
+        elapses, or the query is killed (pool abort) — so a runaway
+        stays resident long enough for arbitration to act instead of
+        finishing and freeing on its own."""
+        if times is None:
+            times = 1
+        return self.add_rule(pattern, method="MEMORY",
+                             policy=MEMORY_INFLATE, times=times,
+                             inflate_bytes=inflate_bytes, delay_s=hold_s)
 
     def release_all(self) -> None:
         with self._lock:
@@ -285,6 +317,26 @@ class FaultInjector:
             raise InjectedDeviceFault(
                 f"RESOURCE_EXHAUSTED: injected device OOM at {key}")
         raise InjectedDeviceFault(f"injected device failure at {key}")
+
+    # -- memory side ----------------------------------------------------
+    def apply_memory(self, task_id: str
+                     ) -> Tuple[int, Optional[FaultRule]]:
+        """(bytes, rule) of injected reservation for a task being
+        created ((0, None) = no inflation).  The rule rides along so
+        the task can honor a ``hold_s`` park and the test can
+        ``release()`` it.  Only method='MEMORY' rules apply here."""
+        with self._lock:
+            for rule in self.rules:
+                if rule.method != "MEMORY" or \
+                        rule.regex.search(task_id) is None:
+                    continue
+                if rule.remaining is not None:
+                    if rule.remaining <= 0:
+                        continue
+                    rule.remaining -= 1
+                self.injections.append((task_id, "MEMORY", rule.policy))
+                return rule.inflate_bytes, rule
+        return 0, None
 
     # -- server side ----------------------------------------------------
     def apply_server(self, path: str, method: str

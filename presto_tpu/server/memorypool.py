@@ -1,0 +1,152 @@
+"""Worker-side GENERAL memory pool (the reference MemoryPool role).
+
+The reference gives every worker a fixed GENERAL pool
+(presto-memory-context / MemoryPool.java): query memory contexts charge
+reservations into it, and a reservation that does not fit BLOCKS the
+driver (a future the pool completes on free) instead of failing — the
+coordinator's ClusterMemoryManager then either waits for memory to free,
+or OOM-kills a victim to unblock the node (SURVEY §2.2, §5).
+
+Same contract here, condition-variable flavored: the per-query
+``MemoryContext`` reservation tree (exec/context.py) charges its ROOT
+deltas into one per-node ``MemoryPool``.  ``reserve`` past the cap waits
+on the pool condition until another query frees bytes, the query is
+aborted (the killer's cancel fan-out), or ``blocked_wait_s`` expires —
+the backstop so a lone blocked driver cannot hang forever if no killer
+is armed.  ``max_bytes <= 0`` means UNLIMITED: the pool still accounts
+(per-query usage feeds MemoryInfo) but never blocks, which is the
+knobs-off behavior existing deployments see.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class MemoryPoolExhausted(RuntimeError):
+    """A driver waited ``blocked_wait_s`` on a full pool and gave up."""
+
+
+class QueryAborted(RuntimeError):
+    """The query was aborted (killed/cancelled) while blocked."""
+
+
+class MemoryPool:
+    """One per-node GENERAL pool; thread-safe; blocking reservations."""
+
+    def __init__(self, max_bytes: int = 0,
+                 blocked_wait_s: float = 60.0) -> None:
+        self.max_bytes = int(max_bytes or 0)
+        self.blocked_wait_s = blocked_wait_s
+        self._cond = threading.Condition()
+        self.reserved = 0
+        self.peak = 0
+        self._per_query: Dict[str, int] = {}
+        self._blocked = 0                       # drivers in cond-wait now
+        self._blocked_since: Optional[float] = None
+        self._aborted: Dict[str, bool] = {}     # qid -> killed mid-wait
+
+    @property
+    def limited(self) -> bool:
+        return self.max_bytes > 0
+
+    # --- reservation protocol (called by the MemoryContext root) --------
+    def reserve(self, query_id: str, delta: int) -> None:
+        """Charge ``delta`` bytes to ``query_id``; blocks while the pool
+        is full.  Raises QueryAborted if the query is killed mid-wait,
+        MemoryPoolExhausted after ``blocked_wait_s``."""
+        if delta <= 0:
+            return
+        with self._cond:
+            if not self.limited:
+                self._apply_locked(query_id, delta)
+                return
+            deadline = time.monotonic() + self.blocked_wait_s
+            while self.reserved + delta > self.max_bytes:
+                if self._aborted.get(query_id):
+                    raise QueryAborted(
+                        f"query {query_id} aborted while blocked on the "
+                        "memory pool")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise MemoryPoolExhausted(
+                        f"worker memory pool exhausted: {query_id} "
+                        f"blocked {self.blocked_wait_s:g}s waiting for "
+                        f"{delta} bytes (pool {self.max_bytes}, "
+                        f"reserved {self.reserved})")
+                self._blocked += 1
+                if self._blocked_since is None:
+                    self._blocked_since = time.monotonic()
+                try:
+                    self._cond.wait(timeout=min(remaining, 0.05))
+                finally:
+                    self._blocked -= 1
+                    if self._blocked == 0:
+                        self._blocked_since = None
+            self._apply_locked(query_id, delta)
+
+    def free(self, query_id: str, delta: int) -> None:
+        if delta <= 0:
+            return
+        with self._cond:
+            self._apply_locked(query_id, -delta)
+            self._cond.notify_all()
+
+    def _apply_locked(self, query_id: str, delta: int) -> None:
+        self.reserved = max(0, self.reserved + delta)
+        self.peak = max(self.peak, self.reserved)
+        new = self._per_query.get(query_id, 0) + delta
+        if new > 0:
+            self._per_query[query_id] = new
+        else:
+            self._per_query.pop(query_id, None)
+            # a fully-released query cannot be blocked anymore; drop the
+            # abort flag so a later query reusing the id starts clean
+            self._aborted.pop(query_id, None)
+
+    def abort_query(self, query_id: str) -> None:
+        """Mark ``query_id`` aborted and wake its blocked drivers (the
+        kill/cancel fan-out calls this so a victim blocked in reserve()
+        dies promptly instead of riding out the backstop timeout)."""
+        with self._cond:
+            self._aborted[query_id] = True
+            self._cond.notify_all()
+
+    def clear_abort(self, query_id: str) -> None:
+        """Forget an abort flag (a fresh task create for the query —
+        stage retry re-creates tasks under the same query id)."""
+        with self._cond:
+            self._aborted.pop(query_id, None)
+
+    def is_aborted(self, query_id: str) -> bool:
+        """True once ``abort_query`` marked this query killed (the
+        inflation hold polls this so a killed runaway releases its
+        injected reservation promptly)."""
+        with self._cond:
+            return bool(self._aborted.get(query_id))
+
+    # --- pressure signal (drives the revoke-first spill path) -----------
+    def needs_revoke(self) -> bool:
+        """True when accumulating operators should shed state to spill
+        ahead of their byte threshold: someone is already blocked, or
+        the pool is more than half charged."""
+        if not self.limited:
+            return False
+        with self._cond:
+            return self._blocked > 0 or self.reserved * 2 >= self.max_bytes
+
+    # --- MemoryInfo (rides /v1/memory, /v1/info, announcements) ---------
+    def info(self) -> Dict:
+        with self._cond:
+            since = self._blocked_since
+            return {
+                "maxBytes": self.max_bytes,
+                "reservedBytes": self.reserved,
+                "peakBytes": self.peak,
+                "blockedDrivers": self._blocked,
+                "blockedAgeS": (round(time.monotonic() - since, 3)
+                                if since is not None else 0.0),
+                "queries": dict(self._per_query),
+            }

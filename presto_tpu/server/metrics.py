@@ -312,6 +312,22 @@ def coordinator_metrics(co) -> str:
          "sum of worker-reported reservation bytes",
          [({"kind": "reserved"}, mem_reserved),
           ({"kind": "peak"}, mem_peak)]),
+        ("presto_cluster_pool_blocked_drivers", "gauge",
+         "drivers currently blocked on full worker memory pools, "
+         "summed over worker-reported MemoryInfo",
+         [({}, sum(int((i.get("pool") or {}).get("blockedDrivers", 0))
+                   for i in mem_infos))]),
+        ("presto_cluster_killed_queries_total", "counter",
+         "queries administratively failed, by kill reason (low-memory "
+         "killer policy / cluster-limit / per-query-total-limit / "
+         "kill_query)",
+         [({"reason": r}, v) for r, v in
+          sorted((getattr(co, "kill_counters", None) or {}).items())]
+         or [({"reason": "none"}, 0)]),
+        ("presto_dispatcher_shed_queries_total", "counter",
+         "statements rejected at submit because the dispatch backlog "
+         "was full (overload shedding)",
+         [({}, getattr(co.dispatcher, "shed_total", 0))]),
         _http_client_family("presto", co.http),
     ]
     fams.extend(_resource_group_families(
@@ -366,6 +382,7 @@ def worker_metrics(worker) -> str:
         mi = t.memory_info()
         reserved += mi["reserved"]
         peak = max(peak, mi["peak"])
+    pool_info = tm.memory_pool.info()
     fams: List[Family] = [
         ("presto_worker_tasks", "gauge", "tasks on this worker by state",
          [({"state": s}, n) for s, n in sorted(by_state.items())]),
@@ -373,6 +390,14 @@ def worker_metrics(worker) -> str:
          "task memory on this worker",
          [({"kind": "reserved"}, reserved),
           ({"kind": "peak_task"}, peak)]),
+        ("presto_worker_pool_bytes", "gauge",
+         "the worker GENERAL memory pool (0 max = unlimited)",
+         [({"kind": "max"}, pool_info["maxBytes"]),
+          ({"kind": "reserved"}, pool_info["reservedBytes"]),
+          ({"kind": "peak"}, pool_info["peakBytes"])]),
+        ("presto_worker_pool_blocked_drivers", "gauge",
+         "drivers blocked in reserve() on the full pool right now",
+         [({}, pool_info["blockedDrivers"])]),
         ("presto_worker_output_pages_total", "counter",
          "pages enqueued into output buffers", [({}, pages)]),
         ("presto_worker_exchange_pages_total", "counter",

@@ -127,10 +127,21 @@ class SqlTask:
                  fetch_headers: Optional[Dict[str, str]] = None,
                  http_client=None, trace_token: str = "",
                  spool=None, frag_cache: Optional[FragmentPlanCache] = None,
-                 frag_cache_key=None):
+                 frag_cache_key=None, memory_pool=None,
+                 inflate_bytes: int = 0, inflate_hold=None):
         self.task_id = task_id
         self.fragment = fragment
         self.trace_token = trace_token
+        # node-wide GENERAL memory pool this task's reservation tree
+        # charges into, keyed by the owning query (server/memorypool.py)
+        self._pool = memory_pool
+        self._pool_qid = task_id.rsplit(".", 2)[0]
+        # chaos substrate: extra bytes reserved up front (the faults.py
+        # memory-inflation policy — a runaway query without the wait);
+        # inflate_hold is the originating FaultRule when the runaway
+        # should PARK holding the bytes (hold_s) until released/killed
+        self._inflate_bytes = inflate_bytes
+        self._inflate_hold = inflate_hold
         self.state = "RUNNING"
         self.error: Optional[str] = None
         self.start_time = time.time()
@@ -225,12 +236,37 @@ class SqlTask:
     def _run(self) -> None:
         def observe(task_ctx):
             self._live = task_ctx
+            if self._inflate_bytes > 0:
+                # injected memory pressure: a child reservation held for
+                # the task's lifetime (freed by task-context cleanup;
+                # the pool backstop covers every failure path)
+                from presto_tpu.exec.context import MemoryContext
+
+                mem = MemoryContext(task_ctx.memory,
+                                    "fault:memory-inflation")
+                task_ctx.register_cleanup(mem.free)
+                mem.reserve(self._inflate_bytes)
+                rule = self._inflate_hold
+                if rule is not None and rule.delay_s > 0:
+                    # park holding the injected bytes: the runaway
+                    # stays resident until the test releases it, the
+                    # hold cap elapses, or the killer's cancel fan-out
+                    # aborts this query in the pool
+                    deadline = time.monotonic() + rule.delay_s
+                    while not rule.released.is_set() \
+                            and time.monotonic() < deadline:
+                        if self._pool is not None and \
+                                self._pool.is_aborted(self._pool_qid):
+                            break
+                        time.sleep(0.02)
 
         trace = f" [trace:{self.trace_token}]" if self.trace_token else ""
         log.info("task %s%s started", self.task_id, trace)
         try:
             self._stats = execute_pipelines(self._pipelines,
-                                            on_task_context=observe)
+                                            on_task_context=observe,
+                                            pool=self._pool,
+                                            pool_query_id=self._pool_qid)
             self.state = "FINISHED"
             log.info("task %s%s finished", self.task_id, trace)
         except Exception as e:  # noqa: BLE001 - task failure surface
@@ -385,7 +421,9 @@ class SqlTaskManager:
     def __init__(self, registry: ConnectorRegistry,
                  config: EngineConfig = DEFAULT,
                  fetch_headers: Optional[Dict[str, str]] = None,
-                 http_client=None, spool=None):
+                 http_client=None, spool=None, fault_injector=None):
+        from presto_tpu.server.memorypool import MemoryPool
+
         self.registry = registry
         self.config = config
         # intra-cluster auth headers this node's exchange fetches carry
@@ -395,6 +433,14 @@ class SqlTaskManager:
         # node-wide spool store (spooled exchange tier); the per-task
         # exchange_spooling_enabled knob gates its use per query
         self.spool = spool
+        # one per-node GENERAL pool all query reservation trees charge
+        # into (worker_memory_pool_bytes; 0 = unlimited accounting)
+        self.memory_pool = MemoryPool(
+            config.worker_memory_pool_bytes,
+            blocked_wait_s=config.memory_blocked_wait_s)
+        # chaos substrate: consulted at task create for the MEMORY
+        # inflation policy (server/faults.py)
+        self.fault_injector = fault_injector
         # worker-side plan_fragment cache (lowered pipelines reused
         # across repeat task creates of the same statement)
         self.fragment_cache = (
@@ -457,6 +503,13 @@ class SqlTaskManager:
                     config)
             except Exception:  # noqa: BLE001 - cache keying is advisory
                 key = None
+        inflate, inflate_hold = 0, None
+        apply_memory = getattr(self.fault_injector, "apply_memory", None)
+        if apply_memory is not None:   # custom injectors may not have it
+            inflate, inflate_hold = apply_memory(task_id)
+        # a fresh task for a query clears any stale abort flag (stage
+        # retry may re-create tasks under the same query id)
+        self.memory_pool.clear_abort(task_id.rsplit(".", 2)[0])
         with self._lock:
             if task_id in self.tasks:
                 return self.tasks[task_id]
@@ -468,7 +521,10 @@ class SqlTaskManager:
                            trace_token=trace_token,
                            spool=self.spool,
                            frag_cache=self.fragment_cache,
-                           frag_cache_key=key)
+                           frag_cache_key=key,
+                           memory_pool=self.memory_pool,
+                           inflate_bytes=inflate,
+                           inflate_hold=inflate_hold)
             self.tasks[task_id] = task
             return task
 
@@ -483,6 +539,10 @@ class SqlTaskManager:
     def cancel_query(self, query_id: str) -> int:
         """Cancel every task belonging to ``query_id`` (task ids are
         ``{queryId}.{fragment}.{i}``); the KillQueryProcedure role."""
+        # wake the query's drivers blocked in pool.reserve() FIRST — a
+        # killed victim stuck on a full pool must die promptly, not ride
+        # out the blocked-wait backstop
+        self.memory_pool.abort_query(query_id)
         n = 0
         with self._lock:
             tasks = list(self.tasks.values())
@@ -515,7 +575,8 @@ class SqlTaskManager:
             total_reserved += mi["reserved"]
             total_peak += mi["peak"]
         return {"reserved": total_reserved, "peak": total_peak,
-                "queries": per_query}
+                "queries": per_query,
+                "pool": self.memory_pool.info()}
 
     def running_count(self) -> int:
         with self._lock:

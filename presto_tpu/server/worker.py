@@ -71,7 +71,8 @@ class WorkerServer:
             registry, config,
             fetch_headers=(self.internal_auth.header()
                            if self.internal_auth else None),
-            http_client=self.http, spool=self.spool)
+            http_client=self.http, spool=self.spool,
+            fault_injector=fault_injector)
         # graceful shutdown (GracefulShutdownHandler.java role): once
         # draining, new tasks are refused, /v1/info advertises
         # SHUTTING_DOWN so the coordinator stops scheduling here, and
@@ -141,7 +142,12 @@ class WorkerServer:
                     self._json(200, {
                         "nodeId": worker.node_id,
                         "state": ("SHUTTING_DOWN" if worker.draining
-                                  else "ACTIVE")})
+                                  else "ACTIVE"),
+                        # live MemoryInfo rides the health surface so
+                        # any poller sees pool pressure without the
+                        # authenticated /v1/memory endpoint
+                        "memoryInfo":
+                            worker.task_manager.memory_info()})
                     return
                 if parts == ["metrics"]:
                     # Prometheus text plane (server/metrics.py); open
@@ -393,7 +399,10 @@ class WorkerServer:
         body = json.dumps({
             "nodeId": self.node_id, "uri": self.uri,
             "location": self.location,
-            "meshFingerprint": self.mesh_fingerprint}).encode()
+            "meshFingerprint": self.mesh_fingerprint,
+            # MemoryInfo rides announcements: the coordinator's memory
+            # tick folds it without waiting for its own poll round
+            "memoryInfo": self.task_manager.memory_info()}).encode()
         headers = {"Content-Type": "application/json"}
         if self.internal_auth is not None:
             headers.update(self.internal_auth.header())

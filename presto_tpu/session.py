@@ -84,6 +84,10 @@ SESSION_PROPERTIES: Dict[str, Tuple[str, Callable[[str], Any]]] = {
     "scaled_writer_rows_per_task": ("scaled_writer_rows_per_task", int),
     "hash_partition_count": ("hash_partition_count", int),
     "query_max_memory_bytes": ("query_max_memory_bytes", int),
+    # cluster-wide (summed over every worker) per-query reservation cap,
+    # enforced by the coordinator's memory tick
+    "query_max_total_memory_bytes": ("query_max_total_memory_bytes",
+                                     int),
     "query_max_run_time_s": ("query_max_run_time_s", float),
     "stage_retry_limit": ("stage_retry_limit", int),
     "cancel_fanout_budget_s": ("cancel_fanout_budget_s", float),

@@ -1,0 +1,265 @@
+"""Worker memory pool tests (server/memorypool.py + the reservation
+tree's pool charging in exec/context.py).
+
+Reference analogues: MemoryPool / LocalMemoryContext blocking semantics
+(presto-memory-context, presto-main/.../memory/MemoryPool.java): a
+reservation that does not fit BLOCKS the driver; frees (from any query)
+unblock it; a killed query's blocked drivers wake with an abort; the
+pool's pressure signal drives revoke-first spilling; and with the knob
+off (``worker_memory_pool_bytes = 0``) the pool accounts but NEVER
+blocks — the exact pre-pool behavior."""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from presto_tpu.config import DEFAULT
+from presto_tpu.exec.context import (
+    MemoryContext, OperatorContext, QueryContext, TaskContext,
+)
+from presto_tpu.server.memorypool import (
+    MemoryPool, MemoryPoolExhausted, QueryAborted,
+)
+
+
+def _spin_until(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# pool primitive
+# ---------------------------------------------------------------------------
+
+def test_unlimited_pool_accounts_but_never_blocks():
+    pool = MemoryPool(0, blocked_wait_s=0.05)
+    assert not pool.limited
+    pool.reserve("q1", 1 << 40)            # absurd: must not block/raise
+    pool.reserve("q2", 123)
+    info = pool.info()
+    assert info["maxBytes"] == 0
+    assert info["reservedBytes"] == (1 << 40) + 123
+    assert info["queries"] == {"q1": 1 << 40, "q2": 123}
+    assert info["blockedDrivers"] == 0
+    pool.free("q1", 1 << 40)
+    pool.free("q2", 123)
+    assert pool.info()["reservedBytes"] == 0
+    assert pool.info()["queries"] == {}
+    assert not pool.needs_revoke()         # pressure signal off too
+
+
+def test_full_pool_blocks_until_free():
+    pool = MemoryPool(1000, blocked_wait_s=10.0)
+    pool.reserve("holder", 900)
+    got = []
+
+    def blocked():
+        pool.reserve("waiter", 200)        # 1100 > 1000: blocks
+        got.append(True)
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    assert _spin_until(lambda: pool.info()["blockedDrivers"] == 1)
+    assert not got
+    assert pool.info()["blockedAgeS"] >= 0.0
+    pool.free("holder", 500)               # now 400 + 200 fits
+    t.join(timeout=5)
+    assert got == [True]
+    assert pool.info()["blockedDrivers"] == 0
+    assert pool.info()["queries"] == {"holder": 400, "waiter": 200}
+
+
+def test_blocked_wait_backstop_raises_exhausted():
+    pool = MemoryPool(100, blocked_wait_s=0.1)
+    pool.reserve("holder", 100)
+    t0 = time.monotonic()
+    with pytest.raises(MemoryPoolExhausted):
+        pool.reserve("waiter", 50)
+    assert time.monotonic() - t0 >= 0.09
+    # the failed charge left nothing behind
+    assert pool.info()["queries"] == {"holder": 100}
+
+
+def test_abort_wakes_blocked_driver_promptly():
+    pool = MemoryPool(100, blocked_wait_s=30.0)
+    pool.reserve("holder", 100)
+    err = []
+
+    def blocked():
+        try:
+            pool.reserve("victim", 50)
+        except QueryAborted as e:
+            err.append(e)
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    assert _spin_until(lambda: pool.info()["blockedDrivers"] == 1)
+    pool.abort_query("victim")
+    t.join(timeout=5)
+    assert len(err) == 1                   # promptly, not the 30s backstop
+    assert pool.is_aborted("victim")
+    pool.clear_abort("victim")
+    assert not pool.is_aborted("victim")
+
+
+def test_full_release_drops_abort_flag():
+    pool = MemoryPool(1000)
+    pool.reserve("q", 10)
+    pool.abort_query("q")
+    assert pool.is_aborted("q")
+    pool.free("q", 10)                     # fully released -> clean slate
+    assert not pool.is_aborted("q")
+
+
+def test_needs_revoke_pressure_signal():
+    pool = MemoryPool(1000, blocked_wait_s=10.0)
+    assert not pool.needs_revoke()
+    pool.reserve("q", 400)
+    assert not pool.needs_revoke()         # under half
+    pool.reserve("q", 100)
+    assert pool.needs_revoke()             # at half: revoke before blocking
+    pool.free("q", 400)
+    assert not pool.needs_revoke()
+    # a blocked driver is pressure regardless of fill level
+    pool2 = MemoryPool(100, blocked_wait_s=5.0)
+    pool2.reserve("holder", 100)
+    t = threading.Thread(target=lambda: pool2.reserve("w", 50),
+                         daemon=True)
+    t.start()
+    assert _spin_until(pool2.needs_revoke)
+    pool2.free("holder", 100)
+    t.join(timeout=5)
+
+
+def test_peak_tracks_high_water_mark():
+    pool = MemoryPool(0)
+    pool.reserve("a", 700)
+    pool.free("a", 600)
+    pool.reserve("b", 100)
+    assert pool.info()["peakBytes"] == 700
+    assert pool.info()["reservedBytes"] == 200
+
+
+# ---------------------------------------------------------------------------
+# reservation tree -> pool charging (exec/context.py)
+# ---------------------------------------------------------------------------
+
+def test_reservation_tree_charges_root_deltas_into_pool():
+    pool = MemoryPool(0)
+    q = QueryContext(pool=pool, pool_query_id="q7")
+    task = TaskContext(q, "q7.0.0")
+    op = OperatorContext(task, "sort")
+    op.memory.reserve(500)
+    assert pool.info()["queries"] == {"q7": 500}
+    op.memory.set_bytes(200)               # shrink frees the pool after
+    assert pool.info()["queries"] == {"q7": 200}
+    op.memory.free()
+    assert pool.info()["reservedBytes"] == 0
+    # two tasks of one query fold into one pool entry
+    op2 = OperatorContext(TaskContext(q, "q7.1.0"), "join")
+    op.memory.reserve(100)
+    op2.memory.reserve(50)
+    assert pool.info()["queries"] == {"q7": 150}
+
+
+def test_failed_tree_charge_leaves_tree_and_pool_untouched():
+    """Charge-before-apply: when the pool rejects (abort mid-block),
+    the reservation tree must not have grown."""
+    pool = MemoryPool(100, blocked_wait_s=5.0)
+    pool.reserve("other", 100)
+    q = QueryContext(pool=pool, pool_query_id="qx")
+    op = OperatorContext(TaskContext(q, "qx.0.0"), "agg")
+    pool.abort_query("qx")
+    with pytest.raises(QueryAborted):
+        op.memory.reserve(50)
+    assert op.memory.reserved == 0
+    assert q.memory.reserved == 0
+    assert pool.info()["queries"] == {"other": 100}
+
+
+def test_release_pool_backstop_returns_remaining_charge():
+    pool = MemoryPool(0)
+    q = QueryContext(pool=pool, pool_query_id="q9")
+    op = OperatorContext(TaskContext(q, "q9.0.0"), "scan")
+    op.memory.reserve(300)
+    q.release_pool()
+    assert pool.info()["reservedBytes"] == 0
+    # detached: further tree traffic never touches the pool
+    op.memory.reserve(100)
+    assert pool.info()["reservedBytes"] == 0
+
+
+def test_pool_free_capped_by_charged_bytes():
+    """A tree attached to the pool mid-life only frees what IT charged
+    (never another query's bytes)."""
+    pool = MemoryPool(0)
+    pool.reserve("q5", 1000)               # charged outside the tree
+    q = QueryContext(pool=pool, pool_query_id="q5")
+    ctx = MemoryContext(q.memory, "op")
+    ctx.reserve(100)
+    ctx.free()
+    ctx.reserve(40)
+    ctx.set_bytes(0)
+    assert pool.info()["queries"] == {"q5": 1000}
+
+
+# ---------------------------------------------------------------------------
+# revoke-first spill decision (OperatorContext.should_spill)
+# ---------------------------------------------------------------------------
+
+def _spill_cfg(**kw):
+    return dataclasses.replace(DEFAULT, spill_threshold_bytes=1000, **kw)
+
+
+def test_should_spill_threshold_path():
+    q = QueryContext(config=_spill_cfg())
+    op = OperatorContext(TaskContext(q), "join-build")
+    assert not op.should_spill(999)
+    assert op.should_spill(1001)
+
+
+def test_should_spill_on_pool_pressure_below_threshold():
+    pool = MemoryPool(1000)
+    pool.reserve("hog", 600)               # past half: needs_revoke
+    q = QueryContext(config=_spill_cfg(), pool=pool, pool_query_id="s1")
+    op = OperatorContext(TaskContext(q), "sort")
+    assert op.should_spill(10)             # far below threshold: revoke
+    pool.free("hog", 600)
+    assert not op.should_spill(10)
+
+
+def test_should_spill_disabled_ignores_pressure():
+    pool = MemoryPool(1000)
+    pool.reserve("hog", 999)
+    q = QueryContext(config=_spill_cfg(spill_enabled=False),
+                     pool=pool, pool_query_id="s2")
+    op = OperatorContext(TaskContext(q), "sort")
+    assert not op.should_spill(1 << 30)
+
+
+# ---------------------------------------------------------------------------
+# knobs-off identity
+# ---------------------------------------------------------------------------
+
+def test_knobs_off_defaults_pinned():
+    """The overload plane is OFF by default: unlimited pool, no killer
+    pressure, thread-per-query dispatch, no shedding — existing
+    deployments see exactly the old behavior."""
+    assert DEFAULT.worker_memory_pool_bytes == 0
+    assert DEFAULT.query_max_total_memory_bytes == 0
+    assert DEFAULT.dispatcher_pool_size == 0
+    assert DEFAULT.dispatcher_max_queued == 0
+    assert not MemoryPool(DEFAULT.worker_memory_pool_bytes).limited
+    # a query context built with no pool (the localrunner/default path)
+    # has zero pool coupling
+    q = QueryContext()
+    assert q.memory.pool is None
+    op = OperatorContext(TaskContext(q), "agg")
+    op.memory.reserve(1 << 20)             # plain tree accounting only
+    assert q.memory.reserved == 1 << 20

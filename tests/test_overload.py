@@ -1,0 +1,477 @@
+"""Overload-survival tests: bounded-pool admission + shedding
+(server/dispatcher.py), client retry hints (client.py), administrative
+kills via CALL system.runtime.kill_query (coordinator + localrunner),
+and the cluster memory manager's per-query limit / soft-memory feed
+(server/coordinator.py _memory_tick).
+
+Reference analogues: DispatchManager's bounded dispatch executor +
+QUERY_QUEUE_FULL rejection, StatementClientV1 retry-after handling,
+KillQueryProcedure.java, and ClusterMemoryManager's
+EXCEEDED_GLOBAL_MEMORY_LIMIT enforcement.  The error triple
+(errorName / errorType / errorCode) must be byte-identical on every
+surface: the protocol error object, /v1/query detail + listing,
+system.runtime.queries, and the query.json event log."""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu import events as ev
+from presto_tpu.client import QueryFailed, StatementClient
+from presto_tpu.config import DEFAULT
+from presto_tpu.localrunner import LocalQueryRunner
+from presto_tpu.server.coordinator import ADMINISTRATIVELY_KILLED
+from presto_tpu.server.dqr import DistributedQueryRunner
+from presto_tpu.server.faults import FaultInjector
+
+
+def _spin_until(pred, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def _cfg(**kw):
+    return dataclasses.replace(DEFAULT, **kw)
+
+
+def _post_statement(co_uri: str, sql: str):
+    """Raw POST /v1/statement: returns (ack_json, headers)."""
+    req = urllib.request.Request(
+        f"{co_uri}/v1/statement", data=sql.encode(), method="POST",
+        headers={"X-Presto-User": "user"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def _query_detail(co_uri: str, qid: str):
+    with urllib.request.urlopen(f"{co_uri}/v1/query/{qid}",
+                                timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class _KillRecorder(ev.EventListener):
+    def __init__(self):
+        self.killed = []
+
+    def query_killed(self, event):
+        self.killed.append(event)
+
+
+# ---------------------------------------------------------------------------
+# bounded-pool admission
+# ---------------------------------------------------------------------------
+
+def test_bounded_pool_runs_queries_exactly():
+    """dispatcher_pool_size > 0 switches to N drainer threads; results
+    are identical to thread-per-query."""
+    cfg = _cfg(dispatcher_pool_size=2, dispatcher_max_queued=16)
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=2,
+                                     config=cfg) as dqr:
+        co = dqr.coordinator
+        assert len(co.dispatcher._threads) == 2
+        assert not hasattr(co.dispatcher, "_thread")
+        assert dqr.execute("SELECT count(*) FROM nation").rows == [(25,)]
+        got = dqr.execute(
+            "SELECT l_returnflag, count(*) FROM lineitem "
+            "GROUP BY l_returnflag ORDER BY 1").rows
+        assert [r[0] for r in got] == ["A", "N", "R"]
+        # a burst wider than the pool still completes everything
+        results, errs = [], []
+
+        def one(i):
+            try:
+                c = dqr.new_client()
+                _, data = c.execute("SELECT count(*) FROM region")
+                results.append(data)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs
+        assert results == [[[5]]] * 6
+        assert co.dispatcher.shed_total == 0
+
+
+def test_thread_per_query_mode_pinned():
+    """Knobs off: the historical single dispatch loop, no drainer pool,
+    and no shedding no matter the backlog."""
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=1) as dqr:
+        co = dqr.coordinator
+        assert co.dispatcher.pool_size == 0
+        assert co.dispatcher.max_queued == 0
+        assert hasattr(co.dispatcher, "_thread")
+        assert not hasattr(co.dispatcher, "_threads")
+        co.dispatcher.pause()
+        try:
+            acks = [_post_statement(co.uri,
+                                    "SELECT count(*) FROM nation")[0]
+                    for _ in range(5)]
+            # nothing shed: every statement is queued, none failed
+            assert co.dispatcher.shed_total == 0
+            for ack in acks:
+                assert "error" not in ack
+        finally:
+            co.dispatcher.resume()
+        assert dqr.execute("SELECT count(*) FROM nation").rows == [(25,)]
+
+
+# ---------------------------------------------------------------------------
+# overload shedding: shape on every surface + Retry-After
+# ---------------------------------------------------------------------------
+
+def test_shed_shape_on_all_surfaces():
+    cfg = _cfg(dispatcher_pool_size=1, dispatcher_max_queued=1)
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=1,
+                                     config=cfg) as dqr:
+        co = dqr.coordinator
+        co.dispatcher.pause()
+        try:
+            # two held statements: the paused drainer may have grabbed
+            # the first off the queue before parking, so the second
+            # guarantees a resident backlog entry
+            held_acks = [_post_statement(
+                co.uri, "SELECT count(*) FROM nation")[0]
+                for _ in range(2)]
+            assert _spin_until(
+                lambda: co.dispatcher._queue.qsize() >= 1, 5.0)
+            # shed #1: raw POST — the ack itself carries Retry-After
+            shed_ack, shed_hdrs = _post_statement(
+                co.uri, "SELECT count(*) FROM region")
+            assert int(shed_hdrs["Retry-After"]) >= 1
+            shed_qid = shed_ack["id"]
+            # shed #2: the client surface (single attempt)
+            with pytest.raises(QueryFailed) as ei:
+                dqr.new_client().execute("SELECT count(*) FROM region",
+                                         max_retries=0)
+            e = ei.value
+            assert e.error_name == "QUERY_QUEUE_FULL"
+            assert e.error_type == "INSUFFICIENT_RESOURCES"
+            assert e.error_code == 0x0002_0002
+            assert e.retry_after_s is not None and e.retry_after_s >= 1
+            assert "queue full" in str(e).lower()
+            assert co.dispatcher.shed_total == 2
+            # /v1/query/{id} detail
+            detail = _query_detail(co.uri, shed_qid)
+            assert detail["state"] == "FAILED"
+            assert detail["errorName"] == "QUERY_QUEUE_FULL"
+            assert detail["errorType"] == "INSUFFICIENT_RESOURCES"
+            assert detail["errorCode"] == 0x0002_0002
+            # /v1/query listing
+            with urllib.request.urlopen(f"{co.uri}/v1/query",
+                                        timeout=10) as resp:
+                listing = json.loads(resp.read())
+            row = next(r for r in listing if r["queryId"] == shed_qid)
+            assert row["errorName"] == "QUERY_QUEUE_FULL"
+        finally:
+            co.dispatcher.resume()
+        # held statements survive the overload episode untouched
+        assert _spin_until(
+            lambda: all(co.queries[a["id"]].state == "FINISHED"
+                        for a in held_acks))
+        # system.runtime.queries carries the same errorName
+        got = dqr.execute(
+            "SELECT error_name FROM system.runtime.queries "
+            f"WHERE query_id = '{shed_qid}'").rows
+        assert got == [("QUERY_QUEUE_FULL",)]
+
+
+def test_client_honors_retry_after_hint():
+    """StatementClient retries ONLY on a server hint, at most
+    max_retries times, never past the deadline; hintless failures keep
+    the single-attempt behavior exactly."""
+    client = StatementClient("http://unreachable.invalid")
+    calls = []
+
+    def fail_with_hint(sql, deadline):
+        calls.append(sql)
+        raise QueryFailed("Query queue full",
+                          error_name="QUERY_QUEUE_FULL",
+                          error_type="INSUFFICIENT_RESOURCES",
+                          error_code=0x0002_0002, retry_after_s=0.01)
+
+    client._execute_once = fail_with_hint
+    with pytest.raises(QueryFailed):
+        client.execute("SELECT 1", max_retries=2)
+    assert len(calls) == 3                 # initial + 2 retries
+
+    calls.clear()
+    with pytest.raises(QueryFailed):
+        client.execute("SELECT 1", max_retries=0)
+    assert len(calls) == 1                 # retrying disabled
+
+    def fail_without_hint(sql, deadline):
+        calls.append(sql)
+        raise QueryFailed("boom", error_name="DIVISION_BY_ZERO",
+                          error_type="USER_ERROR", error_code=8)
+
+    calls.clear()
+    client._execute_once = fail_without_hint
+    with pytest.raises(QueryFailed):
+        client.execute("SELECT 1", max_retries=5)
+    assert len(calls) == 1                 # no hint -> no retry, ever
+
+    # a hinted shed that clears resolves transparently
+    attempts = []
+
+    def flaky(sql, deadline):
+        attempts.append(sql)
+        if len(attempts) == 1:
+            raise QueryFailed("Query queue full",
+                              error_name="QUERY_QUEUE_FULL",
+                              error_type="INSUFFICIENT_RESOURCES",
+                              error_code=0x0002_0002,
+                              retry_after_s=0.01)
+        return [{"name": "x", "type": "bigint"}], [[1]]
+
+    client._execute_once = flaky
+    assert client.execute("SELECT 1") == (
+        [{"name": "x", "type": "bigint"}], [[1]])
+    assert len(attempts) == 2
+
+    # the hint never pushes a retry past the statement deadline
+    calls.clear()
+
+    def fail_with_huge_hint(sql, deadline):
+        calls.append(sql)
+        raise QueryFailed("Query queue full",
+                          error_name="QUERY_QUEUE_FULL",
+                          error_type="INSUFFICIENT_RESOURCES",
+                          error_code=0x0002_0002, retry_after_s=3600)
+
+    client._execute_once = fail_with_huge_hint
+    t0 = time.monotonic()
+    with pytest.raises(QueryFailed):
+        client.execute("SELECT 1", timeout_s=0.2, max_retries=5)
+    assert time.monotonic() - t0 < 1.0
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# CALL system.runtime.kill_query
+# ---------------------------------------------------------------------------
+
+def test_kill_query_local_tier():
+    runner = LocalQueryRunner.tpch(scale=0.01)
+    rec = _KillRecorder()
+    runner.event_bus.register(rec)
+    assert runner.execute("SELECT count(*) FROM nation").rows == [(25,)]
+    res = runner.execute(
+        "CALL system.runtime.kill_query('local-1', 'be gone')")
+    assert res.rows == [("killed",)]
+    assert len(rec.killed) == 1
+    k = rec.killed[0]
+    assert k.query_id == "local-1"
+    assert k.reason == "kill_query"
+    assert k.error_name == ADMINISTRATIVELY_KILLED[0]
+    assert k.message == "Query killed via kill_query: be gone"
+    # default message without the optional second argument
+    runner.execute("CALL system.runtime.kill_query('local-2')")
+    assert rec.killed[-1].message == "Query killed via kill_query"
+    with pytest.raises(ValueError, match="no such query"):
+        runner.execute("CALL system.runtime.kill_query('nope')")
+    # the CALL below is this runner's 5th statement: killing its own id
+    with pytest.raises(ValueError, match="cannot kill itself"):
+        runner.execute("CALL system.runtime.kill_query('local-5')")
+    with pytest.raises(ValueError, match="unknown procedure"):
+        runner.execute("CALL system.runtime.not_a_proc('x')")
+
+
+@pytest.mark.slow
+def test_kill_query_http_running():
+    """Kill a RUNNING distributed query: the victim is parked by a
+    memory-inflation hold, the kill is issued via CALL, and the victim's
+    client sees the ADMINISTRATIVELY_KILLED triple with the custom
+    message — not a generic drain abort."""
+    inj = FaultInjector()
+    inj.add_memory_rule(".*", 1 << 20, times=1, hold_s=30.0)
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=1,
+                                     worker_injectors={0: inj},
+                                     heartbeat_interval_s=0.1) as dqr:
+        co = dqr.coordinator
+        rec = _KillRecorder()
+        co.event_bus.register(rec)
+        victim = dqr.new_client()
+        err = []
+
+        def run_victim():
+            try:
+                victim.execute("SELECT count(*) FROM lineitem",
+                               max_retries=0)
+            except QueryFailed as e:
+                err.append(e)
+
+        t = threading.Thread(target=run_victim, daemon=True)
+        t.start()
+        assert _spin_until(
+            lambda: victim.last_query_id is not None
+            and co.queries.get(victim.last_query_id) is not None
+            and co.queries[victim.last_query_id].state == "RUNNING")
+        qid = victim.last_query_id
+        res = dqr.execute(
+            f"CALL system.runtime.kill_query('{qid}', 'admin says stop')")
+        assert res.rows == [("killed",)]
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert len(err) == 1
+        e = err[0]
+        assert e.error_name == "ADMINISTRATIVELY_KILLED"
+        assert e.error_type == "USER_ERROR"
+        assert e.error_code == 0x0000_0005
+        assert "admin says stop" in str(e)
+        assert co.kill_counters.get("kill_query") == 1
+        assert [k.query_id for k in rec.killed] == [qid]
+        assert rec.killed[0].error_name == "ADMINISTRATIVELY_KILLED"
+        # the cluster is healthy afterwards
+        inj.release_all()
+        inj.clear()
+        assert dqr.execute("SELECT count(*) FROM nation").rows == [(25,)]
+
+
+def test_kill_preserves_shape_on_queued_query():
+    """A kill that lands while the query is still QUEUED must win over
+    the dispatcher's generic cancel shape (_fail_dispatch guard)."""
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=1) as dqr:
+        co = dqr.coordinator
+        co.dispatcher.pause()
+        try:
+            ack, _ = _post_statement(co.uri, "SELECT count(*) FROM nation")
+            qid = ack["id"]
+            assert _spin_until(lambda: co.queries[qid].state == "QUEUED")
+            co.queries[qid].kill("killed while queued",
+                                 ADMINISTRATIVELY_KILLED,
+                                 reason="kill_query")
+        finally:
+            co.dispatcher.resume()
+        assert _spin_until(lambda: co.queries[qid].state == "FAILED")
+        q = co.queries[qid]
+        assert q.error == "killed while queued"
+        assert (q.error_name, q.error_type, q.error_code) == \
+            ADMINISTRATIVELY_KILLED
+
+
+# ---------------------------------------------------------------------------
+# cluster memory manager: per-query limit on every surface
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_memory_exceeded_shape_on_all_surfaces(tmp_path):
+    """SET SESSION query_max_total_memory_bytes + an inflated resident
+    reservation: the ClusterMemoryManager kills the query with
+    EXCEEDED_GLOBAL_MEMORY_LIMIT, and the triple is identical on the
+    client error, /v1/query detail + listing, system.runtime.queries,
+    and the query.json event log."""
+    log = tmp_path / "query.json"
+    inj = FaultInjector()
+    inj.add_memory_rule(".*", 2_000_000, times=1, hold_s=30.0)
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=1,
+                                     worker_injectors={0: inj},
+                                     heartbeat_interval_s=0.1,
+                                     event_log_path=str(log)) as dqr:
+        co = dqr.coordinator
+        client = dqr.new_client()
+        client.execute("SET SESSION query_max_total_memory_bytes = 1000000")
+        with pytest.raises(QueryFailed) as ei:
+            client.execute("SELECT count(*) FROM lineitem", max_retries=0)
+        e = ei.value
+        assert e.error_name == "EXCEEDED_GLOBAL_MEMORY_LIMIT"
+        assert e.error_type == "INSUFFICIENT_RESOURCES"
+        assert e.error_code == 0x0002_0001
+        assert "total memory limit" in str(e)
+        qid = client.last_query_id
+        assert co.kill_counters.get("per-query-total-limit") == 1
+        detail = _query_detail(co.uri, qid)
+        assert detail["state"] == "FAILED"
+        assert detail["errorName"] == "EXCEEDED_GLOBAL_MEMORY_LIMIT"
+        assert detail["errorType"] == "INSUFFICIENT_RESOURCES"
+        assert detail["errorCode"] == 0x0002_0001
+        with urllib.request.urlopen(f"{co.uri}/v1/query",
+                                    timeout=10) as resp:
+            listing = json.loads(resp.read())
+        row = next(r for r in listing if r["queryId"] == qid)
+        assert row["errorName"] == "EXCEEDED_GLOBAL_MEMORY_LIMIT"
+        got = dqr.execute(
+            "SELECT error_name FROM system.runtime.queries "
+            f"WHERE query_id = '{qid}'").rows
+        assert got == [("EXCEEDED_GLOBAL_MEMORY_LIMIT",)]
+        inj.release_all()
+        inj.clear()
+    events = [json.loads(line) for line in
+              log.read_text().splitlines() if line.strip()]
+    killed = [r for r in events if r["event"] == "QueryKilledEvent"
+              and r["query_id"] == qid]
+    assert len(killed) == 1
+    assert killed[0]["error_name"] == "EXCEEDED_GLOBAL_MEMORY_LIMIT"
+    assert killed[0]["reason"] == "per-query-total-limit"
+    assert "total memory limit" in killed[0]["message"]
+
+
+# ---------------------------------------------------------------------------
+# resource-group soft memory fed by live worker MemoryInfo
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soft_memory_gate_fed_by_live_worker_memory():
+    """The memory tick feeds group usage WITHOUT any cluster memory
+    limit configured (the old loop only ran when
+    cluster_memory_limit_bytes was set): a group over its soft limit
+    queues new admissions until the hog's reservations drain."""
+    inj = FaultInjector()
+    inj.add_memory_rule(".*", 4_000_000, times=1, hold_s=30.0)
+    with DistributedQueryRunner.tpch(scale=0.01, n_workers=1,
+                                     worker_injectors={0: inj},
+                                     heartbeat_interval_s=0.1) as dqr:
+        co = dqr.coordinator
+        assert co.cluster_memory_limit_bytes is None
+        group = co.resource_groups.configure_group(
+            "alice", soft_memory_limit_bytes=1_000_000)
+        hog = dqr.new_client(user="alice")
+        hog_err = []
+
+        def run_hog():
+            try:
+                hog.execute("SELECT count(*) FROM lineitem",
+                            max_retries=0)
+            except QueryFailed as e:
+                hog_err.append(e)
+
+        th = threading.Thread(target=run_hog, daemon=True)
+        th.start()
+        # live MemoryInfo reaches the group within a few ticks
+        assert _spin_until(lambda: group.memory_usage >= 4_000_000)
+        # a second alice statement parks in admission (soft limit)
+        late_done = []
+
+        def run_late():
+            c = dqr.new_client(user="alice")
+            _, data = c.execute("SELECT count(*) FROM region",
+                                max_retries=0)
+            late_done.append(data)
+
+        tl = threading.Thread(target=run_late, daemon=True)
+        tl.start()
+        time.sleep(0.8)
+        assert not late_done          # still gated by the soft limit
+        waiting = [q for q in co.queries.values()
+                   if q.user == "alice"
+                   and q.state in ("QUEUED", "WAITING_FOR_RESOURCES")]
+        assert waiting
+        # release the hog: usage drains, the waiter admits and finishes
+        inj.release_all()
+        th.join(timeout=30)
+        assert not th.is_alive() and not hog_err
+        assert _spin_until(lambda: group.memory_usage == 0)
+        tl.join(timeout=30)
+        assert late_done == [[[5]]]

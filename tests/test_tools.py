@@ -409,6 +409,78 @@ class TestChaosRunHA:
                                             "restarted")
 
 
+class TestChaosRunOom:
+    def test_oom_check_mode(self, capsys):
+        """tools/chaos_run.py --mode oom --check: the memory-arbitration
+        CI smoke — a runaway query parks holding ~94% of an 8 MiB worker
+        pool, survivors block on the pool, and the low-memory killer
+        must fail EXACTLY the runaway with the CLUSTER_OUT_OF_MEMORY
+        shape; survivors return exact rows, pools drain to zero, and
+        both workers stay alive."""
+        import importlib
+        import json
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        chaos_run = importlib.import_module("chaos_run")
+        rc = chaos_run.main(["--mode", "oom", "--check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        report = json.loads(out[out.index("{\n"):])
+        assert report["mode"] == "oom"
+        assert report["ok"]
+        stages = {s["stage"]: s for s in report["stages"]}
+        assert set(stages) == {"runaway-resident", "kill", "survivors",
+                               "recovery"}
+        kill = stages["kill"]
+        assert kill["errorName"] == "CLUSTER_OUT_OF_MEMORY"
+        assert kill["errorType"] == "INSUFFICIENT_RESOURCES"
+        assert kill["errorCode"] == 0x0002_0004
+        # exactly one policy-selected kill, attributed to the default
+        # policy — nothing else died
+        assert kill["kill_counters"] == {
+            "total-reservation-on-blocked-nodes": 1}
+        rec = stages["recovery"]
+        assert rec["alive"] == 2
+        assert rec["pool_reserved_after"] == 0
+
+
+class TestQpsRunOverload:
+    def test_open_loop_check_mode(self, capsys):
+        """tools/qps_run.py --open-loop --check: the graceful-degradation
+        CI smoke — an open-loop arrival sweep at 1x and 2x the measured
+        saturated rate against a bounded-pool dispatcher; past
+        saturation every rejection must be the hinted queue-full shape
+        (zero unshaped failures) and goodput must hold >= 80% of the
+        closed-loop peak."""
+        import importlib
+        import json
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        qps_run = importlib.import_module("qps_run")
+        rc = qps_run.main(["--open-loop", "--check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        report = json.loads(out[out.index("{\n"):])
+        assert report["mode"] == "overload"
+        assert report["ok"]
+        assert report["peak_parity"]
+        assert report["dispatcher"] == {"pool_size": 2, "max_queued": 4}
+        top = report["levels"][-1]
+        assert top["rate_factor"] == 2.0
+        assert top["shed"] > 0            # overload actually shed
+        assert all(lv["other"] == 0 for lv in report["levels"])
+        assert report["shed_total"] >= top["shed"]
+        assert report["goodput_ratio_at_max"] >= 0.8
+        # sheds are FAST rejections, not queue waits
+        assert top["shed_p95_ms"] < 1000.0
+
+
 class TestChaosRunMesh:
     def test_mesh_check_mode(self, capsys):
         """tools/chaos_run.py --mode mesh --check: the mid-program

@@ -541,6 +541,170 @@ def _ha_submit(co_uri: str, sql: str) -> str:
         return json.loads(resp.read())["id"]
 
 
+def run_oom_sweep(scale: float = 0.01, survivors: int = 2,
+                  quiet: bool = False) -> dict:
+    """Overload-survival sweep (the low-memory-killer acceptance proof):
+    a held runaway task fills one worker's GENERAL pool (faults.py
+    memory-inflation with a hold), concurrent survivor statements then
+    BLOCK on the full pool, and the coordinator's arbitration must
+    resolve the stall by failing EXACTLY the policy-selected runaway
+    with the reference error shape (CLUSTER_OUT_OF_MEMORY /
+    INSUFFICIENT_RESOURCES) while every survivor returns exact rows and
+    ZERO workers die."""
+    import dataclasses as _dc
+    import threading as _th
+
+    from presto_tpu.client import QueryFailed
+    from presto_tpu.config import DEFAULT
+    from presto_tpu.server.dqr import DistributedQueryRunner
+    from presto_tpu.server.faults import FaultInjector
+
+    pool = 8 << 20
+    runaway_sql = ("select l_returnflag, count(*) from lineitem "
+                   "group by l_returnflag")
+    survivor_sql = "select count(*) from lineitem"
+    # clean run: the survivor ground truth the degraded cluster must
+    # still reproduce exactly
+    with DistributedQueryRunner.tpch(scale=scale, n_workers=2) as clean:
+        want = sorted(clean.execute(survivor_sql).rows)
+    cfg = _dc.replace(
+        DEFAULT,
+        worker_memory_pool_bytes=pool,
+        memory_blocked_wait_s=30.0,
+        low_memory_killer_delay_s=0.75)
+    inj = FaultInjector()
+    # the runaway: the first task created on worker 0 reserves ~94% of
+    # the node pool and PARKS holding it until the kill aborts it
+    inj.add_memory_rule(".*", int(pool * 0.94), times=1, hold_s=60.0)
+    t0 = time.monotonic()
+    stages = []
+    report = {"mode": "oom", "scale": scale, "pool_bytes": pool,
+              "survivors": survivors, "stages": stages}
+    with DistributedQueryRunner.tpch(
+            scale=scale, n_workers=2, config=cfg,
+            worker_injectors={0: inj},
+            heartbeat_interval_s=0.05,
+            heartbeat_max_missed=5) as dqr:
+        co = dqr.coordinator
+        while len(co.nodes.alive_nodes()) != 2:
+            time.sleep(0.02)
+
+        def pool_reserved() -> int:
+            return max((mi.get("pool", {}).get("reservedBytes", 0)
+                        for mi in co.memory_info.values()), default=0)
+
+        run_res: dict = {}
+
+        def run_runaway():
+            try:
+                run_res["rows"] = dqr.new_client("runaway").execute(
+                    runaway_sql, max_retries=0)[1]
+            except QueryFailed as e:
+                run_res["err"] = str(e)
+                run_res["errorName"] = e.error_name
+                run_res["errorType"] = e.error_type
+                run_res["errorCode"] = e.error_code
+
+        t_run = _th.Thread(target=run_runaway)
+        t_run.start()
+        # the runaway must be RUNNING and actually resident before the
+        # survivors arrive (deterministic pressure ordering)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            qs = list(co.queries.values())
+            if qs and qs[0].state == "RUNNING" and \
+                    pool_reserved() >= int(pool * 0.9):
+                break
+            time.sleep(0.02)
+        resident = pool_reserved()
+        stages.append({"stage": "runaway-resident",
+                       "pool_reserved": resident,
+                       "ok": resident >= int(pool * 0.9)})
+        runaway_qid = (list(co.queries.values())[0].query_id
+                       if co.queries else None)
+        # survivor tasks landing on the full node inflate a LITTLE too,
+        # so their drivers genuinely BLOCK on the pool (the stall the
+        # killer must resolve); no hold — they proceed once the victim's
+        # memory frees, and the inflations all fit in the freed pool
+        inj.add_memory_rule(".*", 1 << 20, times=4 * survivors)
+        sur_res = [dict() for _ in range(survivors)]
+
+        def run_survivor(i: int):
+            try:
+                sur_res[i]["rows"] = dqr.new_client(
+                    f"survivor{i}").execute(survivor_sql,
+                                            max_retries=0)[1]
+            except QueryFailed as e:
+                sur_res[i]["err"] = str(e)
+                sur_res[i]["errorName"] = e.error_name
+
+        threads = [_th.Thread(target=run_survivor, args=(i,))
+                   for i in range(survivors)]
+        for t in threads:
+            t.start()
+        t_run.join(timeout=60)
+        kill_stage = {
+            "stage": "kill", "victim": runaway_qid,
+            "errorName": run_res.get("errorName"),
+            "errorType": run_res.get("errorType"),
+            "errorCode": run_res.get("errorCode"),
+            "kill_counters": dict(co.kill_counters),
+        }
+        kill_stage["ok"] = (
+            not t_run.is_alive()
+            and run_res.get("errorName") == "CLUSTER_OUT_OF_MEMORY"
+            and run_res.get("errorType") == "INSUFFICIENT_RESOURCES"
+            and "out of memory" in run_res.get("err", ""))
+        if not kill_stage["ok"]:
+            kill_stage["reason"] = (
+                "runaway hung" if t_run.is_alive() else
+                f"unexpected runaway outcome: "
+                f"{str(run_res.get('err', run_res.get('rows')))[:300]}")
+        stages.append(kill_stage)
+        for t in threads:
+            t.join(timeout=60)
+        norm = [sorted(tuple(r) for r in res.get("rows", []))
+                for res in sur_res]
+        want_t = sorted(tuple(r) for r in want)
+        bad = [res for i, res in enumerate(sur_res)
+               if threads[i].is_alive() or "err" in res
+               or norm[i] != want_t]
+        sur_stage = {"stage": "survivors", "n": survivors,
+                     "ok": not bad}
+        if bad:
+            sur_stage["reason"] = f"{len(bad)} survivor(s) failed: " + \
+                "; ".join(str(r.get("err", "row mismatch"))[:120]
+                          for r in bad)
+        stages.append(sur_stage)
+        # post-chaos: clear the fault plane and prove the cluster is
+        # whole — both workers alive, pool fully drained, fresh
+        # statement exact (zero worker deaths is the acceptance bar)
+        inj.release_all()
+        inj.clear()
+        rec = {"stage": "recovery",
+               "alive": len(co.nodes.alive_nodes())}
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and pool_reserved() > 0:
+            time.sleep(0.05)
+        rec["pool_reserved_after"] = pool_reserved()
+        try:
+            rows = sorted(dqr.execute(survivor_sql).rows)
+            rec["ok"] = (rows == want and rec["alive"] == 2
+                         and rec["pool_reserved_after"] == 0)
+            if not rec["ok"]:
+                rec["reason"] = "cluster degraded after the kill"
+        except Exception as e:  # noqa: BLE001 - report must still emit
+            rec["ok"] = False
+            rec["reason"] = str(e)[:300]
+        stages.append(rec)
+        if not quiet:
+            for s in stages:
+                print(json.dumps(s))
+    report["wall_s"] = round(time.monotonic() - t0, 2)
+    report["ok"] = all(s["ok"] for s in stages)
+    return report
+
+
 def run_check() -> int:
     """CI smoke: the chaos marker tier, headless (quick signal — the
     TPC-DS mesh cases are additionally marked slow and excluded)."""
@@ -566,7 +730,8 @@ def main(argv=None) -> int:
     ap.add_argument("--kill-index", type=int, default=None,
                     help="worker to kill (default: last)")
     ap.add_argument("--mode",
-                    choices=["leaf", "stage", "spool", "ha", "mesh"],
+                    choices=["leaf", "stage", "spool", "ha", "mesh",
+                             "oom"],
                     default="leaf",
                     help="leaf = kill a scan-task worker; stage = kill "
                          "a worker holding a non-leaf fragment "
@@ -585,7 +750,14 @@ def main(argv=None) -> int:
                          "re-execution of checkpointed fragments, in "
                          "both resume modes (with --check: the "
                          "device-resume sweep at first/middle/root "
-                         "kill points only)")
+                         "kill points only); oom = fill one worker's "
+                         "memory pool with a held runaway, block "
+                         "concurrent survivors on it, and assert the "
+                         "low-memory killer fails exactly the runaway "
+                         "(CLUSTER_OUT_OF_MEMORY) while survivors "
+                         "return exact rows and zero workers die "
+                         "(with --check: one survivor at a smaller "
+                         "scale)")
     ap.add_argument("--resume-mode", choices=["device", "http", "both"],
                     default="both",
                     help="mesh mode only: which resume path(s) the "
@@ -624,6 +796,15 @@ def main(argv=None) -> int:
         report = run_ha_sweep(
             phases=("RUNNING",) if args.check else HA_PHASES,
             scale=args.scale if args.scale != 0.01 else 0.003)
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
+    if args.mode == "oom":
+        # --check = the CI smoke: one survivor at the smoke scale;
+        # nonzero when the wrong query dies, any survivor fails or
+        # returns inexact rows, or the cluster is degraded after
+        report = run_oom_sweep(
+            scale=0.003 if args.check else args.scale,
+            survivors=1 if args.check else 2)
         print(json.dumps(report, indent=2))
         return 0 if report["ok"] else 1
     if args.check:

@@ -36,6 +36,8 @@ def load_metrics(path: str) -> tuple:
     bands = {}
     if doc.get("metric") is not None and doc.get("value") is not None:
         out[doc["metric"]] = float(doc["value"])
+        if doc.get("noise_band") is not None:
+            bands[doc["metric"]] = float(doc["noise_band"])
     for extra in doc.get("extras", []) or []:
         if extra.get("metric") is not None \
                 and extra.get("value") is not None:
