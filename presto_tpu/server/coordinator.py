@@ -5065,6 +5065,17 @@ class CoordinatorServer:
         unblocks pools cluster-wide; the next tick re-evaluates)."""
         self._poll_worker_memory()
         now = time.monotonic()
+        # drop MemoryInfo for nodes the failure detector no longer
+        # considers responsive: a worker that dies while its pool
+        # reports blocked drivers would otherwise pin blocked_nodes
+        # forever (one healthy victim killed per grace period) and its
+        # stale reservations would permanently inflate the cluster and
+        # per-query totals the limits act on
+        live = {nid for nid, _uri in self.nodes.responsive_nodes()}
+        for nid in list(self.memory_info):
+            if nid not in live:
+                self.memory_info.pop(nid, None)
+                self._blocked_seen.pop(nid, None)
         total = 0
         per_query: Dict[str, int] = {}
         per_query_blocked: Dict[str, int] = {}   # reservation on blocked
@@ -5086,7 +5097,11 @@ class CoordinatorServer:
                         per_query_blocked.get(qid, 0) + used
         # mesh-executed queries create no worker tasks; fold their live
         # sampler peak (synthetic device TaskStats rollup) so the
-        # per-query total limit sees them too
+        # per-query total limit sees them too.  The sampler exposes no
+        # current-usage gauge, so mesh queries are judged on their
+        # LIFETIME PEAK: a mesh query whose usage already dropped back
+        # under query_max_total_memory_bytes can still be killed.
+        # Documented in server/README.md "Memory model & overload".
         for qid, q in list(self.queries.items()):
             if qid in per_query or q.state not in ("RUNNING",
                                                    "SCHEDULING"):

@@ -191,7 +191,11 @@ class DispatchManager:
         # presto_dispatcher_shed_queries_total)
         self.shed_total = 0
         self._shed_lock = threading.Lock()
-        self._queue: "queue.Queue[Optional[DispatchQuery]]" = queue.Queue()
+        # bounded when max_queued > 0: put_nowait + queue.Full make the
+        # shed bound exact under concurrent submits (a check-then-put on
+        # the approximate qsize() could overshoot it)
+        self._queue: "queue.Queue[Optional[DispatchQuery]]" = \
+            queue.Queue(maxsize=self.max_queued)
         self._stop = threading.Event()
         # chaos/test hook (coordinator HA): while set, submitted
         # queries stay QUEUED — the deterministic
@@ -237,10 +241,18 @@ class DispatchManager:
             q._device_ckpts.update(
                 {str(k): dict(v) for k, v in device_checkpoints.items()})
         self.co.queries[qid] = q
-        if self.max_queued > 0 and self._queue.qsize() >= self.max_queued:
+        # durable journal write-through at QUEUED (server/statestore.py)
+        q._journal("QUEUED")
+        try:
+            self._queue.put_nowait(q)
+        except queue.Full:
             # overload shedding: fail fast with the reference's
             # queue-full shape and a retry hint — never an unshaped 500,
-            # never an unbounded queue
+            # never an unbounded queue.  The bounded put IS the shed
+            # decision, so the backlog cap is exact under concurrent
+            # submits; _fail_dispatch's terminal journal write
+            # supersedes the QUEUED record above (a failover re-serves
+            # the rejection, never re-admits).
             q.retry_after_s = self._retry_after_hint()
             with self._shed_lock:
                 self.shed_total += 1
@@ -248,10 +260,6 @@ class DispatchManager:
                 f"Query queue full: dispatcher backlog is "
                 f"{self._queue.qsize()} (max {self.max_queued}); retry "
                 f"after {q.retry_after_s}s", QUERY_QUEUE_FULL)
-            return q
-        # durable journal write-through at QUEUED (server/statestore.py)
-        q._journal("QUEUED")
-        self._queue.put(q)
         return q
 
     def _retry_after_hint(self) -> int:
@@ -301,7 +309,11 @@ class DispatchManager:
             except queue.Empty:
                 continue
             if q is None:
-                self._queue.put(None)   # wake the sibling drainers too
+                try:   # wake the sibling drainers too; on a full queue
+                    # they exit via the 0.2s get timeout + _stop check
+                    self._queue.put_nowait(None)
+                except queue.Full:
+                    pass
                 return
             while self._paused.is_set() and not self._stop.is_set():
                 time.sleep(0.02)
@@ -317,4 +329,8 @@ class DispatchManager:
 
     def close(self) -> None:
         self._stop.set()
-        self._queue.put(None)
+        try:   # best-effort wake; a full queue falls back to the
+            # drainers' 0.2s get timeout + _stop check
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass

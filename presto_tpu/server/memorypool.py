@@ -80,7 +80,9 @@ class MemoryPool:
                 if self._blocked_since is None:
                     self._blocked_since = time.monotonic()
                 try:
-                    self._cond.wait(timeout=min(remaining, 0.05))
+                    # free() and abort_query() notify_all, so a full
+                    # remaining-time wait suffices — no poll interval
+                    self._cond.wait(timeout=remaining)
                 finally:
                     self._blocked -= 1
                     if self._blocked == 0:
@@ -115,8 +117,10 @@ class MemoryPool:
             self._cond.notify_all()
 
     def clear_abort(self, query_id: str) -> None:
-        """Forget an abort flag (a fresh task create for the query —
-        stage retry re-creates tasks under the same query id)."""
+        """Forget an abort flag.  The task manager refuses new tasks
+        for killed query ids rather than clearing the flag (clearing on
+        create could race the kill fan-out and resurrect a killed
+        query's reservations); full release also auto-clears."""
         with self._cond:
             self._aborted.pop(query_id, None)
 

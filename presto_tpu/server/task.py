@@ -16,6 +16,7 @@ import logging
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from presto_tpu.config import DEFAULT, EngineConfig
@@ -447,6 +448,12 @@ class SqlTaskManager:
             FragmentPlanCache(config.worker_fragment_cache_capacity)
             if config.worker_fragment_cache_enabled else None)
         self.tasks: Dict[str, SqlTask] = {}
+        # query ids whose tasks this node was told to kill
+        # (cancel_query fan-out): a task create that races the fan-out
+        # must be refused, not admitted with the abort flag wiped —
+        # bounded so ids from long-dead queries eventually age out
+        self._killed_queries: "OrderedDict[str, None]" = OrderedDict()
+        self._killed_queries_cap = 1024
         self._lock = threading.Lock()
 
     def _fragment_cache_key(self, fragment: PlanFragment,
@@ -507,12 +514,18 @@ class SqlTaskManager:
         apply_memory = getattr(self.fault_injector, "apply_memory", None)
         if apply_memory is not None:   # custom injectors may not have it
             inflate, inflate_hold = apply_memory(task_id)
-        # a fresh task for a query clears any stale abort flag (stage
-        # retry may re-create tasks under the same query id)
-        self.memory_pool.clear_abort(task_id.rsplit(".", 2)[0])
+        qid = task_id.rsplit(".", 2)[0]
         with self._lock:
             if task_id in self.tasks:
                 return self.tasks[task_id]
+            # a late placement racing the kill fan-out must not start:
+            # admitting it would resurrect reservations the killer just
+            # freed (and clearing the pool abort flag here would let the
+            # victim's drivers ride out the full blocked-wait backstop)
+            if qid in self._killed_queries:
+                raise RuntimeError(
+                    f"query {qid} was killed on this node; refusing "
+                    f"late task {task_id}")
             task = SqlTask(task_id, fragment, scan_shard, remote_sources,
                            n_output_partitions, broadcast_output,
                            self.registry, config,
@@ -539,13 +552,20 @@ class SqlTaskManager:
     def cancel_query(self, query_id: str) -> int:
         """Cancel every task belonging to ``query_id`` (task ids are
         ``{queryId}.{fragment}.{i}``); the KillQueryProcedure role."""
-        # wake the query's drivers blocked in pool.reserve() FIRST — a
-        # killed victim stuck on a full pool must die promptly, not ride
-        # out the blocked-wait backstop
+        # record the kill BEFORE aborting so a create_task racing this
+        # fan-out either sees the id and refuses, or registered its
+        # task earlier and gets cancelled by the sweep below
+        with self._lock:
+            self._killed_queries[query_id] = None
+            self._killed_queries.move_to_end(query_id)
+            while len(self._killed_queries) > self._killed_queries_cap:
+                self._killed_queries.popitem(last=False)
+            tasks = list(self.tasks.values())
+        # wake the query's drivers blocked in pool.reserve() — a killed
+        # victim stuck on a full pool must die promptly, not ride out
+        # the blocked-wait backstop
         self.memory_pool.abort_query(query_id)
         n = 0
-        with self._lock:
-            tasks = list(self.tasks.values())
         for t in tasks:
             if t.task_id.startswith(query_id + "."):
                 t.cancel()

@@ -186,7 +186,7 @@ def test_system_tasks_live(cluster):
     cluster.execute("select count(*) from lineitem")
     rows = cluster.execute("select * from system.tasks").rows
     assert rows, "no tasks reported"
-    for task_id, state, query_id, out_rows, wall_ms, peak in rows:
+    for task_id, state, query_id, out_rows, wall_ms, peak, _elapsed in rows:
         assert task_id.startswith(query_id)
         assert state in ("RUNNING", "FINISHED", "FAILED", "CANCELED")
         assert out_rows is None or out_rows >= 0
